@@ -1,0 +1,21 @@
+"""spark_druid_olap_tpu — a TPU-native OLAP aggregation framework.
+
+Brand-new implementation of the capabilities of tushargosavi/spark-druid-olap
+(the Sparkline BI Accelerator — SQL plan rewriting into Druid-style OLAP
+queries), redesigned TPU-first: the planner rewrites SQL/DataFrame aggregates
+over star schemas into compact query specs, and — where the reference POSTed
+those specs to an external Druid cluster — executes them as fused XLA/Pallas
+aggregation kernels over dictionary-encoded columns in HBM, with partial
+states merged across chips by ICI collectives.  See SURVEY.md for the layer
+map and the provenance caveat (reference mount empty; expected-path citations
+marked `[U]`).
+"""
+
+import jax as _jax
+
+# Timestamps are int64 milliseconds (Druid convention).  With x64 disabled JAX
+# silently truncates them to int32; enable it once here.  All hot-path arrays
+# are explicitly f32/int32, so TPU compute is unaffected.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

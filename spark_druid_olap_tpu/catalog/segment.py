@@ -1,0 +1,272 @@
+"""Columnar segment format for TPU-resident OLAP data.
+
+Reference parity: the reference (spark-druid-olap, Sparkline BI Accelerator)
+delegates storage to Druid *segments* — immutable, time-partitioned, columnar
+shards with dictionary-encoded string dimensions and numeric metric columns
+(see SURVEY.md L1/L2; reference mount was empty, paths unverified `[U]`,
+expected `org/sparklinedata/druid/metadata/`).  This module is the TPU-native
+analog: a `Segment` is a bundle of device-ready numpy/JAX arrays —
+dictionary-encoded int32 dimension columns, float32/int32 metric columns, and
+an int64 millisecond time column — padded to TPU-friendly tile multiples so
+Pallas/XLA kernels see static, (8,128)-aligned shapes.
+
+Design notes (TPU-first):
+  * Strings never reach the device: dimensions are dictionary-encoded at
+    ingest (Druid does the same) and only int32 codes are transferred.
+  * Segments are immutable-by-construction (plain frozen dataclasses holding
+    arrays we never mutate) — the reference guards its metadata cache with JVM
+    synchronization; we simply never write.
+  * All rows are padded to a multiple of `ROW_PAD` with a validity mask, so
+    every kernel sees a static shape and XLA compiles exactly once per
+    (schema, block-size), not once per segment length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Row-count padding granularity.  8*128 = one float32 VMEM tile lane*sublane
+# footprint; keeping row blocks a multiple of this keeps Pallas BlockSpecs and
+# XLA tiling aligned.
+ROW_PAD = 1024
+
+NULL_ID = -1  # dictionary code for null dimension values
+
+
+@dataclasses.dataclass(frozen=True)
+class DimensionDict:
+    """Dictionary for one string dimension: sorted unique values <-> int32 ids.
+
+    Sorted order is load-bearing: it makes dictionary codes order-preserving,
+    so range/bound filters on strings can be pushed down as integer range
+    filters on codes (the reference pushes Druid `bound` filters with
+    lexicographic ordering; sorted dicts give us the same for free).
+    """
+
+    values: Tuple[str, ...]
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.values)
+
+    def encode(self, col: Sequence[Optional[str]]) -> np.ndarray:
+        arr = np.asarray(col, dtype=object)
+        mask = np.array([v is not None for v in arr], dtype=bool)
+        out = np.full(len(arr), NULL_ID, dtype=np.int32)
+        if mask.any():
+            vals = np.asarray([v for v in arr[mask]], dtype=str)
+            idx = np.searchsorted(self.values, vals)
+            idx = np.clip(idx, 0, max(len(self.values) - 1, 0))
+            found = np.asarray(self.values, dtype=str)[idx] == vals
+            codes = np.where(found, idx, NULL_ID).astype(np.int32)
+            out[mask] = codes
+        return out
+
+    def decode(self, ids: np.ndarray) -> np.ndarray:
+        vals = np.asarray(self.values, dtype=object)
+        out = np.empty(len(ids), dtype=object)
+        ok = ids >= 0
+        out[ok] = vals[ids[ok]]
+        out[~ok] = None
+        return out
+
+    @staticmethod
+    def build(col: Sequence[Optional[str]]) -> "DimensionDict":
+        uniq = sorted({v for v in col if v is not None})
+        return DimensionDict(values=tuple(uniq))
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnMeta:
+    """Schema entry for one column of a datasource."""
+
+    name: str
+    kind: str  # "dimension" | "metric" | "time"
+    dtype: str  # "string" | "long" | "double" | "timestamp"
+    cardinality: Optional[int] = None  # dimensions only
+
+    @property
+    def is_dimension(self) -> bool:
+        return self.kind == "dimension"
+
+    @property
+    def is_metric(self) -> bool:
+        return self.kind == "metric"
+
+
+def _pad_rows(a: np.ndarray, n_padded: int, fill) -> np.ndarray:
+    if len(a) == n_padded:
+        return a
+    pad = np.full(n_padded - len(a), fill, dtype=a.dtype)
+    return np.concatenate([a, pad])
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """One immutable columnar shard, padded to ROW_PAD rows.
+
+    Arrays are host numpy; `exec.engine` moves them to device (and caches
+    residency).  `valid` marks real rows vs padding — kernels fold it into
+    their filter mask so padding never contributes to an aggregate.
+    """
+
+    segment_id: str
+    num_rows: int  # real (unpadded) rows
+    dims: Mapping[str, np.ndarray]  # name -> int32[n_padded]
+    metrics: Mapping[str, np.ndarray]  # name -> float32/int32[n_padded]
+    time: Optional[np.ndarray]  # int64 millis[n_padded] or None
+    valid: np.ndarray  # bool[n_padded]
+    interval: Optional[Tuple[int, int]] = None  # [min_ms, max_ms] of time col
+    time_name: Optional[str] = None  # source column name of the time column
+
+    @property
+    def num_rows_padded(self) -> int:
+        return len(self.valid)
+
+    def column(self, name: str) -> np.ndarray:
+        if name in self.dims:
+            return self.dims[name]
+        if name in self.metrics:
+            return self.metrics[name]
+        if self.time is not None and name in ("__time", self.time_name):
+            return self.time
+        raise KeyError(f"segment {self.segment_id} has no column {name!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSource:
+    """A named datasource: schema + dictionaries + a list of segments.
+
+    Analog of the reference's `DruidDataSource` metadata + segment list
+    (SURVEY.md §2 metadata cache row, `[U]`).
+    """
+
+    name: str
+    columns: Tuple[ColumnMeta, ...]
+    dicts: Mapping[str, DimensionDict]
+    segments: Tuple[Segment, ...]
+    time_column: Optional[str] = None
+
+    @property
+    def num_rows(self) -> int:
+        return sum(s.num_rows for s in self.segments)
+
+    def meta(self, name: str) -> ColumnMeta:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"datasource {self.name} has no column {name!r}")
+
+    def cardinality(self, dim: str) -> int:
+        return self.dicts[dim].cardinality
+
+    def interval(self) -> Optional[Tuple[int, int]]:
+        ivs = [s.interval for s in self.segments if s.interval is not None]
+        if not ivs:
+            return None
+        return (min(i[0] for i in ivs), max(i[1] for i in ivs))
+
+
+def build_datasource(
+    name: str,
+    columns: Mapping[str, np.ndarray],
+    dimension_cols: Sequence[str],
+    metric_cols: Sequence[str],
+    time_col: Optional[str] = None,
+    rows_per_segment: int = 1 << 22,
+    dicts: Optional[Mapping[str, DimensionDict]] = None,
+) -> DataSource:
+    """Build a DataSource from raw host columns.
+
+    String dimension columns are dictionary-encoded; integer-typed dimension
+    columns are treated as already-encoded codes (their dictionary is the
+    stringified value domain).  Metric columns become float32 (or int32 when
+    integral).  Rows are split into segments of `rows_per_segment` and padded.
+    """
+    n = None
+    for cname, col in columns.items():
+        if n is None:
+            n = len(col)
+        elif len(col) != n:
+            raise ValueError(f"column {cname} length {len(col)} != {n}")
+    if n is None:
+        raise ValueError("no columns")
+
+    dicts = dict(dicts) if dicts else {}
+    encoded: Dict[str, np.ndarray] = {}
+    metas: List[ColumnMeta] = []
+
+    for d in dimension_cols:
+        col = columns[d]
+        arr = np.asarray(col)
+        if arr.dtype.kind in ("U", "S", "O"):
+            if d not in dicts:
+                dicts[d] = DimensionDict.build(list(col))
+            codes = dicts[d].encode(list(col))
+        else:
+            codes = arr.astype(np.int32)
+            if d not in dicts:
+                hi = int(codes.max()) + 1 if len(codes) else 0
+                dicts[d] = DimensionDict(values=tuple(str(i) for i in range(hi)))
+        encoded[d] = codes
+        metas.append(
+            ColumnMeta(d, "dimension", "string", cardinality=dicts[d].cardinality)
+        )
+
+    for m in metric_cols:
+        arr = np.asarray(columns[m])
+        if arr.dtype.kind in ("i", "u", "b"):
+            enc = arr.astype(np.int32)
+            metas.append(ColumnMeta(m, "metric", "long"))
+        else:
+            enc = arr.astype(np.float32)
+            metas.append(ColumnMeta(m, "metric", "double"))
+        encoded[m] = enc
+
+    time_arr = None
+    if time_col is not None:
+        time_arr = np.asarray(columns[time_col]).astype(np.int64)
+        metas.append(ColumnMeta(time_col, "time", "timestamp"))
+
+    segments: List[Segment] = []
+    for si, start in enumerate(range(0, n, rows_per_segment)):
+        stop = min(start + rows_per_segment, n)
+        rows = stop - start
+        n_padded = -(-rows // ROW_PAD) * ROW_PAD
+        dims = {
+            d: _pad_rows(encoded[d][start:stop], n_padded, NULL_ID)
+            for d in dimension_cols
+        }
+        mets = {
+            m: _pad_rows(encoded[m][start:stop], n_padded, 0) for m in metric_cols
+        }
+        tcol = None
+        interval = None
+        if time_arr is not None:
+            t = time_arr[start:stop]
+            interval = (int(t.min()), int(t.max())) if rows else None
+            tcol = _pad_rows(t, n_padded, 0)
+        valid = _pad_rows(np.ones(rows, dtype=bool), n_padded, False)
+        segments.append(
+            Segment(
+                segment_id=f"{name}_{si:06d}",
+                num_rows=rows,
+                dims=dims,
+                metrics=mets,
+                time=tcol,
+                valid=valid,
+                interval=interval,
+                time_name=time_col,
+            )
+        )
+
+    return DataSource(
+        name=name,
+        columns=tuple(metas),
+        dicts=dicts,
+        segments=tuple(segments),
+        time_column=time_col,
+    )

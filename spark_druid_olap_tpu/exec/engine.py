@@ -1,0 +1,829 @@
+"""Single-device query engine: QuerySpec × DataSource -> result table.
+
+Reference parity: this layer replaces the external Druid cluster.  In
+spark-druid-olap, `DruidRDD.compute` POSTs the query JSON to a broker /
+historical and streams result rows back (SURVEY.md §3.3 `[U]`); the actual
+aggregation happens inside Druid.  Here `Engine.execute` runs the same query
+spec locally: segment columns (dictionary codes + metrics) are moved to TPU
+HBM once and cached (the analog of Druid's segment residency / page cache),
+the filter+aggregate runs as fused XLA (ops/groupby.py), and only the tiny
+[G, M] aggregate state returns to host for finalization (decode group ids,
+post-aggregations, having, sort/limit — the work Druid's broker does after
+its scatter-gather merge).
+
+Distributed execution (the broker scatter-gather analog over ICI) lives in
+parallel/distributed.py and reuses this module's lowering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..catalog.segment import DataSource, Segment
+from ..models import aggregations as A
+from ..models import query as Q
+from ..models.dimensions import DimensionSpec
+from ..models.filters import Filter
+from ..ops.filters import compile_filter
+from ..ops.groupby import (
+    DENSE_MAX_GROUPS,
+    combine_group_ids,
+    partial_aggregate,
+)
+from ..plan.expr import compile_expr
+from ..utils.granularity import bucket_starts, granularity_period_ms
+
+# ---------------------------------------------------------------------------
+# Dimension resolution
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResolvedDim:
+    """A dimension lowered to: device code producer + cardinality + decoder."""
+
+    spec: DimensionSpec
+    cardinality: int  # including the null slot when present
+    codes_fn: Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]
+    decode: Callable[[np.ndarray], np.ndarray]  # codes -> python values
+
+
+def _resolve_dims(
+    dims: Sequence[DimensionSpec],
+    ds: DataSource,
+    intervals: Tuple[Tuple[int, int], ...],
+) -> List[ResolvedDim]:
+    out: List[ResolvedDim] = []
+    for spec in dims:
+        if spec.dimension == "__time" or spec.granularity is not None:
+            out.append(_resolve_time_dim(spec, ds, intervals))
+            continue
+        d = ds.dicts[spec.dimension]
+        if spec.extraction is not None:
+            # Host-side dictionary rewrite: apply fn to each dict value once,
+            # build remap table code -> new code (SURVEY.md dimension-spec row).
+            extracted = spec.extraction.apply_to_dict(list(d.values))
+            new_vals = sorted(set(extracted))
+            index = {v: i for i, v in enumerate(new_vals)}
+            remap = np.array([index[v] for v in extracted], dtype=np.int32)
+            card = len(new_vals) + 1  # + null slot
+            remap_dev = jnp.asarray(remap)
+            name = spec.dimension
+
+            def codes_fn(cols, remap_dev=remap_dev, name=name, card=card):
+                c = cols[name]
+                return jnp.where(c >= 0, remap_dev[jnp.maximum(c, 0)],
+                                 jnp.int32(card - 1))
+
+            vals_arr = np.asarray(new_vals, dtype=object)
+
+            def decode(codes, vals_arr=vals_arr, card=card):
+                o = np.empty(len(codes), dtype=object)
+                isnull = codes == card - 1
+                o[~isnull] = vals_arr[codes[~isnull]]
+                o[isnull] = None
+                return o
+
+            out.append(ResolvedDim(spec, card, codes_fn, decode))
+        else:
+            card = d.cardinality + 1  # last slot = null
+            name = spec.dimension
+
+            def codes_fn(cols, name=name, card=card):
+                c = cols[name]
+                return jnp.where(c >= 0, c, jnp.int32(card - 1))
+
+            vals_arr = np.asarray(d.values, dtype=object)
+
+            def decode(codes, vals_arr=vals_arr, card=card):
+                o = np.empty(len(codes), dtype=object)
+                isnull = codes == card - 1
+                o[~isnull] = vals_arr[codes[~isnull]]
+                o[isnull] = None
+                return o
+
+            out.append(ResolvedDim(spec, card, codes_fn, decode))
+    return out
+
+
+def _resolve_time_dim(
+    spec: DimensionSpec, ds: DataSource, intervals
+) -> ResolvedDim:
+    gran = spec.granularity or "all"
+    iv = intervals[0] if intervals else ds.interval()
+    if iv is None:
+        raise ValueError("time-bucketed dimension requires a time column")
+    lo, hi = iv
+    if intervals:
+        lo = min(a for a, _ in intervals)
+        hi = max(b for _, b in intervals)
+    starts = bucket_starts(lo, hi, gran)  # host-computed bucket boundaries
+    card = len(starts)
+    starts_dev = jnp.asarray(starts)
+
+    def codes_fn(cols, starts_dev=starts_dev):
+        t = cols["__time"]
+        # bucket index via searchsorted over boundaries (log #buckets passes;
+        # handles calendar granularities month/quarter/year exactly)
+        return (
+            jnp.searchsorted(starts_dev, t, side="right").astype(jnp.int32) - 1
+        )
+
+    starts_np = np.asarray(starts)
+
+    def decode(codes, starts_np=starts_np):
+        ms = starts_np[np.clip(codes, 0, len(starts_np) - 1)]
+        return ms.astype("datetime64[ms]")
+
+    return ResolvedDim(spec, card, codes_fn, decode)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation lowering
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LoweredAggs:
+    """Aggregations split by merge class for the kernel ABI.
+
+    Layout contract with ops/groupby.py: sum-class aggs (psum merges) are the
+    columns of `sum_values`; min-class then max-class are the columns of
+    `minmax_values`.  Column 0 of sum_values is always the hidden `__rows`
+    presence counter."""
+
+    sum_names: List[str]
+    min_names: List[str]
+    max_names: List[str]
+    sketch_aggs: List[A.Aggregation]
+    long_valued: Dict[str, bool]
+    value_fns: Dict[str, Callable]  # name -> fn(cols) -> f32[R]
+    mask_fns: Dict[str, Optional[Callable]]  # name -> extra-mask fn or None
+
+
+def _lower_aggs(
+    aggs: Sequence[A.Aggregation], ds: DataSource
+) -> LoweredAggs:
+    la = LoweredAggs(["__rows"], [], [], [], {"__rows": True}, {}, {})
+    la.value_fns["__rows"] = lambda cols: None  # ones; handled specially
+    la.mask_fns["__rows"] = None
+
+    def add(agg: A.Aggregation, extra_filter: Optional[Filter]):
+        mask_fn = (
+            compile_filter(extra_filter, ds) if extra_filter is not None else None
+        )
+        if isinstance(agg, A.FilteredAgg):
+            inner_mask = compile_filter(agg.filter, ds)
+            if mask_fn is None:
+                combined = inner_mask
+            else:
+                outer = mask_fn
+                combined = lambda cols: outer(cols) & inner_mask(cols)
+            _add_base(agg.aggregator, combined)
+            return
+        _add_base(agg, mask_fn)
+
+    def _add_base(agg: A.Aggregation, mask_fn):
+        name = agg.name
+        la.mask_fns[name] = mask_fn
+        if isinstance(agg, A.Count):
+            la.sum_names.append(name)
+            la.long_valued[name] = True
+            la.value_fns[name] = lambda cols: None  # ones
+        elif isinstance(agg, (A.LongSum, A.DoubleSum)):
+            field = agg.field_name
+            la.sum_names.append(name)
+            la.long_valued[name] = isinstance(agg, A.LongSum)
+            la.value_fns[name] = (
+                lambda cols, field=field: cols[field].astype(jnp.float32)
+            )
+        elif isinstance(agg, (A.LongMin, A.DoubleMin)):
+            field = agg.field_name
+            la.min_names.append(name)
+            la.long_valued[name] = isinstance(agg, A.LongMin)
+            la.value_fns[name] = (
+                lambda cols, field=field: cols[field].astype(jnp.float32)
+            )
+        elif isinstance(agg, (A.LongMax, A.DoubleMax)):
+            field = agg.field_name
+            la.max_names.append(name)
+            la.long_valued[name] = isinstance(agg, A.LongMax)
+            la.value_fns[name] = (
+                lambda cols, field=field: cols[field].astype(jnp.float32)
+            )
+        elif isinstance(agg, A.ExpressionAgg):
+            fn = compile_expr(agg.expression)
+            target = {
+                "doubleSum": la.sum_names,
+                "longSum": la.sum_names,
+                "doubleMin": la.min_names,
+                "doubleMax": la.max_names,
+            }[agg.base]
+            target.append(name)
+            la.long_valued[name] = agg.base == "longSum"
+            la.value_fns[name] = (
+                lambda cols, fn=fn: jnp.asarray(fn(cols)).astype(jnp.float32)
+            )
+        elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch)):
+            la.sketch_aggs.append(agg)
+            la.long_valued[name] = True
+        else:
+            raise NotImplementedError(f"aggregation {type(agg).__name__}")
+
+    for agg in aggs:
+        add(agg, None)
+    return la
+
+
+# ---------------------------------------------------------------------------
+# Post-aggregation / having / limit finalization (host-side, tiny)
+# ---------------------------------------------------------------------------
+
+
+def eval_post_agg(
+    p: A.PostAggregation,
+    table: Mapping[str, np.ndarray],
+    states: Optional[Mapping[str, np.ndarray]] = None,
+) -> np.ndarray:
+    """`states` maps sketch-agg name -> raw per-group sketch state (HLL
+    registers / theta hash sets); sketch post-aggs must finalize from the raw
+    state, not from the already-finalized estimate column in `table`."""
+    if isinstance(p, A.FieldAccess):
+        return np.asarray(table[p.field_name])
+    if isinstance(p, A.ConstantPost):
+        return np.asarray(p.value)
+    if isinstance(p, A.Arithmetic):
+        vals = [eval_post_agg(f, table, states) for f in p.fields]
+        acc = vals[0].astype(np.float64)
+        for v in vals[1:]:
+            if p.fn == "+":
+                acc = acc + v
+            elif p.fn == "-":
+                acc = acc - v
+            elif p.fn == "*":
+                acc = acc * v
+            elif p.fn in ("/", "quotient"):
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    acc = np.where(v != 0, acc / np.where(v == 0, 1, v), 0.0)
+            else:
+                raise ValueError(f"arithmetic fn {p.fn!r}")
+        return acc
+    if isinstance(p, A.HyperUniqueCardinality):
+        from ..ops.hll import estimate as hll_estimate
+
+        if states is None or p.field_name not in states:
+            raise KeyError(
+                f"hyperUniqueCardinality over {p.field_name!r}: no raw HLL "
+                "state available (field must name a hyperUnique/cardinality "
+                "aggregation in the same query)"
+            )
+        return hll_estimate(states[p.field_name])
+    if isinstance(p, A.ThetaSketchEstimate):
+        from ..ops.theta import estimate as theta_estimate
+
+        if states is None or p.field_name not in states:
+            raise KeyError(
+                f"thetaSketchEstimate over {p.field_name!r}: no raw theta "
+                "state available (field must name a thetaSketch aggregation "
+                "in the same query)"
+            )
+        return theta_estimate(states[p.field_name])
+    raise NotImplementedError(f"post-aggregation {type(p).__name__}")
+
+
+def _eval_having(h: Q.Having, table: Mapping[str, np.ndarray]) -> np.ndarray:
+    if isinstance(h, Q.HavingCompare):
+        v = np.asarray(table[h.aggregation], dtype=np.float64)
+        return {
+            ">": v > h.value,
+            "<": v < h.value,
+            ">=": v >= h.value,
+            "<=": v <= h.value,
+            "==": v == h.value,
+            "!=": v != h.value,
+        }[h.op]
+    if isinstance(h, Q.HavingAnd):
+        m = _eval_having(h.specs[0], table)
+        for s in h.specs[1:]:
+            m &= _eval_having(s, table)
+        return m
+    if isinstance(h, Q.HavingOr):
+        m = _eval_having(h.specs[0], table)
+        for s in h.specs[1:]:
+            m |= _eval_having(s, table)
+        return m
+    raise NotImplementedError(type(h).__name__)
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class Engine:
+    """Executes query specs on the local device set.
+
+    `strategy` mirrors the reference's cost-model execution choice
+    (SURVEY.md §2 DruidQueryCostModel `[U]`): "auto" lets plan/cost.py pick
+    dense-one-hot vs scatter from the group cardinality."""
+
+    def __init__(self, strategy: str = "auto"):
+        self.strategy = strategy
+        self._device_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
+
+    # -- segment residency ---------------------------------------------------
+
+    def _device_cols(self, seg: Segment, names) -> Dict[str, jnp.ndarray]:
+        cols: Dict[str, jnp.ndarray] = {}
+        for n in names:
+            key = (seg.segment_id, n)
+            if key not in self._device_cache:
+                self._device_cache[key] = jnp.asarray(seg.column(n))
+            cols[n] = self._device_cache[key]
+        key = (seg.segment_id, "__valid")
+        if key not in self._device_cache:
+            self._device_cache[key] = jnp.asarray(seg.valid)
+        cols["__valid"] = self._device_cache[key]
+        return cols
+
+    def clear_cache(self):
+        """Analog of the reference's metadata/cache clear command."""
+        self._device_cache.clear()
+
+    # -- entry points --------------------------------------------------------
+
+    def execute(self, q: Q.QuerySpec, ds: DataSource):
+        import pandas as pd
+
+        if isinstance(q, Q.GroupByQuery):
+            return self._execute_groupby(q, ds)
+        if isinstance(q, Q.TimeseriesQuery):
+            return self._execute_timeseries(q, ds)
+        if isinstance(q, Q.TopNQuery):
+            return self._execute_topn(q, ds)
+        if isinstance(q, Q.ScanQuery):
+            return self._execute_scan(q, ds)
+        if isinstance(q, Q.SearchQuery):
+            return self._execute_search(q, ds)
+        raise NotImplementedError(type(q).__name__)
+
+    # -- groupby -------------------------------------------------------------
+
+    def _needed_columns(self, q, ds: DataSource, dims) -> List[str]:
+        names: List[str] = []
+        for d in dims:
+            if d.spec.dimension != "__time" and d.spec.granularity is None:
+                names.append(d.spec.dimension)
+        for a in q.aggregations:
+            names.extend(_agg_columns(a))
+        if q.filter is not None:
+            names.extend(_filter_columns(q.filter))
+        for v in q.virtual_columns:
+            names.extend(v.expression.columns())
+        virt = {v.name for v in q.virtual_columns}
+        need = [
+            n
+            for n in dict.fromkeys(names)
+            if n not in virt and n != "__time"
+        ]
+        if ds.time_column and (
+            any(d.spec.dimension == "__time" or d.spec.granularity for d in dims)
+            or q.intervals
+            or "__time" in names
+        ):
+            need.append(ds.time_column)
+        return need
+
+    def _segments_in_scope(self, q, ds: DataSource) -> List[Segment]:
+        """Segment pruning by interval — the analog of the reference narrowing
+        the Druid query interval from time predicates (§3.2)."""
+        if not q.intervals:
+            return list(ds.segments)
+        out = []
+        for s in ds.segments:
+            if s.interval is None:
+                out.append(s)
+                continue
+            lo, hi = s.interval
+            if any(a <= hi and lo < b for a, b in q.intervals):
+                out.append(s)
+        return out
+
+    def _partials_for_query(self, q: Q.GroupByQuery, ds: DataSource):
+        """Compute merged partial state across local segments.
+
+        Returns (dims, la, G, sums[G, Ms], mins, maxs, sketch_states)."""
+        dims = _resolve_dims(q.dimensions, ds, q.intervals)
+        la = _lower_aggs(q.aggregations, ds)
+        G = 1
+        for d in dims:
+            G *= d.cardinality
+        if G > (1 << 26):
+            raise ValueError(
+                f"combined group cardinality {G} too large for dense domain; "
+                "sort-based path not yet wired for this size"
+            )
+
+        filter_fn = compile_filter(q.filter, ds) if q.filter is not None else None
+        vcol_fns = {v.name: compile_expr(v.expression) for v in q.virtual_columns}
+        need = self._needed_columns(q, ds, dims)
+
+        sums = mins = maxs = None
+        sketch_states: Dict[str, Any] = {}
+        segs = self._segments_in_scope(q, ds)
+        if not segs:
+            # empty time range is a valid query: zero-row result, not an error
+            sums = jnp.zeros((G, len(la.sum_names)), jnp.float32)
+            mins = jnp.full((G, len(la.min_names)), jnp.inf, jnp.float32)
+            maxs = jnp.full((G, len(la.max_names)), -jnp.inf, jnp.float32)
+            for agg in la.sketch_aggs:
+                if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+                    sketch_states[agg.name] = jnp.zeros(
+                        (G, 1 << agg.precision), jnp.int32
+                    )
+                else:
+                    from ..ops.theta import SENTINEL
+
+                    sketch_states[agg.name] = jnp.full(
+                        (G, agg.size), SENTINEL, jnp.uint32
+                    )
+            return dims, la, G, sums, mins, maxs, sketch_states
+        for seg in segs:
+            cols = self._device_cols(seg, need)
+            if ds.time_column and ds.time_column in cols:
+                cols["__time"] = cols[ds.time_column]
+            for name, fn in vcol_fns.items():
+                cols[name] = jnp.asarray(fn(cols))
+            mask = cols["__valid"]
+            if q.intervals:
+                t = cols["__time"]
+                im = jnp.zeros(t.shape, jnp.bool_)
+                for a, b in q.intervals:
+                    im = im | ((t >= a) & (t < b))
+                mask = mask & im
+            if filter_fn is not None:
+                mask = mask & filter_fn(cols)
+
+            gid, _ = combine_group_ids(
+                [d.codes_fn(cols) for d in dims], [d.cardinality for d in dims]
+            )
+            if not dims:
+                gid = jnp.zeros(mask.shape, jnp.int32)
+
+            R = mask.shape[0]
+            maskf = mask.astype(jnp.float32)
+            sum_cols = []
+            for n in la.sum_names:
+                base = la.value_fns[n](
+                    {**cols}
+                ) if la.value_fns[n] is not None else None
+                v = maskf if base is None else base * maskf
+                mfn = la.mask_fns.get(n)
+                if mfn is not None:
+                    v = v * mfn(cols).astype(jnp.float32)
+                sum_cols.append(v)
+            sum_values = jnp.stack(sum_cols, axis=1)
+
+            mm_names = la.min_names + la.max_names
+            if mm_names:
+                mm_vals, mm_masks = [], []
+                for n in mm_names:
+                    mm_vals.append(la.value_fns[n](cols))
+                    mfn = la.mask_fns.get(n)
+                    mm_masks.append(
+                        mfn(cols) if mfn is not None
+                        else jnp.ones((R,), jnp.bool_)
+                    )
+                minmax_values = jnp.stack(mm_vals, axis=1)
+                minmax_masks = jnp.stack(mm_masks, axis=1)
+            else:
+                minmax_values = jnp.zeros((R, 0), jnp.float32)
+                minmax_masks = jnp.zeros((R, 0), jnp.bool_)
+
+            s, mn, mx = partial_aggregate(
+                gid,
+                mask,
+                sum_values,
+                minmax_values,
+                minmax_masks,
+                num_groups=G,
+                num_min=len(la.min_names),
+                num_max=len(la.max_names),
+                strategy=self.strategy,
+            )
+            sums = s if sums is None else sums + s
+            mins = mn if mins is None else jnp.minimum(mins, mn)
+            maxs = mx if maxs is None else jnp.maximum(maxs, mx)
+
+            for agg in la.sketch_aggs:
+                from ..ops import hll as hll_ops
+                from ..ops import theta as theta_ops
+
+                if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+                    st = hll_ops.partial_hll(agg, cols, gid, mask, G)
+                    prev = sketch_states.get(agg.name)
+                    sketch_states[agg.name] = (
+                        st if prev is None else jnp.maximum(prev, st)
+                    )
+                elif isinstance(agg, A.ThetaSketch):
+                    st = theta_ops.partial_theta(agg, cols, gid, mask, G)
+                    prev = sketch_states.get(agg.name)
+                    sketch_states[agg.name] = (
+                        st
+                        if prev is None
+                        else theta_ops.merge_states(prev, st, agg.size)
+                    )
+        return dims, la, G, sums, mins, maxs, sketch_states
+
+    def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
+        # Druid semantics: a non-"all" granularity on GroupBy adds an implicit
+        # leading time-bucket dimension (one result row per bucket per group).
+        if q.granularity not in ("all", None) and not any(
+            d.dimension == "__time" or d.granularity for d in q.dimensions
+        ):
+            q = dataclasses.replace(
+                q,
+                dimensions=(
+                    DimensionSpec(
+                        "__time", "timestamp", granularity=q.granularity
+                    ),
+                )
+                + tuple(q.dimensions),
+                granularity="all",
+            )
+        dims, la, G, sums, mins, maxs, sketch_states = self._partials_for_query(
+            q, ds
+        )
+        return finalize_groupby(
+            q, dims, la, np.asarray(sums), np.asarray(mins), np.asarray(maxs),
+            {k: np.asarray(v) for k, v in sketch_states.items()},
+        )
+
+    # -- timeseries: a groupby whose only dimension is the time bucket -------
+
+    def _execute_timeseries(self, q: Q.TimeseriesQuery, ds: DataSource):
+        gq = Q.GroupByQuery(
+            datasource=q.datasource,
+            dimensions=(
+                DimensionSpec("__time", "__bucket", granularity=q.granularity),
+            ),
+            aggregations=q.aggregations,
+            post_aggregations=q.post_aggregations,
+            filter=q.filter,
+            intervals=q.intervals,
+            virtual_columns=q.virtual_columns,
+        )
+        df = self._execute_groupby(gq, ds)
+        df = df.rename(columns={"__bucket": "timestamp"})
+        if not q.skip_empty_buckets:
+            # Druid skipEmptyBuckets=false: emit zero rows for empty buckets.
+            iv = q.intervals[0] if q.intervals else ds.interval()
+            if iv is not None:
+                lo = min(a for a, _ in q.intervals) if q.intervals else iv[0]
+                hi = max(b for _, b in q.intervals) if q.intervals else iv[1]
+                all_buckets = bucket_starts(lo, hi, q.granularity).astype(
+                    "datetime64[ms]"
+                )
+                import pandas as pd
+
+                df = (
+                    df.set_index("timestamp")
+                    .reindex(pd.Index(all_buckets, name="timestamp"))
+                    .reset_index()
+                )
+                for a in q.aggregations:
+                    if a.merge_op == "psum" and a.name in df:
+                        filled = df[a.name].fillna(0)
+                        if df[a.name].dtype.kind in ("i", "u"):
+                            filled = filled.astype(np.int64)
+                        df[a.name] = filled
+        df = df.sort_values("timestamp", ascending=not q.descending)
+        return df.reset_index(drop=True)
+
+    # -- topn: single-dim groupby + rank (exact; Druid's is approximate) -----
+
+    def _execute_topn(self, q: Q.TopNQuery, ds: DataSource):
+        gq = Q.GroupByQuery(
+            datasource=q.datasource,
+            dimensions=(q.dimension,),
+            aggregations=q.aggregations,
+            post_aggregations=q.post_aggregations,
+            filter=q.filter,
+            intervals=q.intervals,
+            granularity=q.granularity,
+            virtual_columns=q.virtual_columns,
+        )
+        df = self._execute_groupby(gq, ds)
+        df = df.sort_values(q.metric, ascending=not q.descending, kind="stable")
+        if q.granularity not in ("all", None):
+            # per-bucket topN: rank within each time bucket
+            df = (
+                df.groupby("timestamp", sort=True, group_keys=False)
+                .head(q.threshold)
+                .sort_values(
+                    ["timestamp", q.metric],
+                    ascending=[True, not q.descending],
+                    kind="stable",
+                )
+            )
+            return df.reset_index(drop=True)
+        return df.head(q.threshold).reset_index(drop=True)
+
+    # -- scan / search -------------------------------------------------------
+
+    def _execute_scan(self, q: Q.ScanQuery, ds: DataSource):
+        import pandas as pd
+
+        filter_fn = compile_filter(q.filter, ds) if q.filter is not None else None
+        vcol_fns = {v.name: compile_expr(v.expression) for v in q.virtual_columns}
+        need = [c for c in q.columns if c not in vcol_fns and c != "__time"]
+        if q.filter is not None:
+            need += [c for c in _filter_columns(q.filter) if c != "__time"]
+        for v in q.virtual_columns:
+            need += [c for c in v.expression.columns() if c != "__time"]
+        if ds.time_column:
+            need.append(ds.time_column)
+        need = dict.fromkeys(need)
+        frames = []
+        remaining = q.limit
+        for seg in self._segments_in_scope(q, ds):
+            cols = self._device_cols(seg, need)
+            if ds.time_column and ds.time_column in cols:
+                cols["__time"] = cols[ds.time_column]
+            for name, fn in vcol_fns.items():
+                cols[name] = jnp.asarray(fn(cols))
+            mask = cols["__valid"]
+            if q.intervals:
+                t = cols["__time"]
+                im = jnp.zeros(t.shape, jnp.bool_)
+                for a, b in q.intervals:
+                    im = im | ((t >= a) & (t < b))
+                mask = mask & im
+            if filter_fn is not None:
+                mask = mask & filter_fn(cols)
+            keep = np.asarray(mask)
+            data = {}
+            for c in q.columns:
+                arr = np.asarray(cols[c])[keep]
+                if c in ds.dicts:
+                    arr = ds.dicts[c].decode(arr)
+                data[c] = arr
+            f = pd.DataFrame(data)
+            if remaining is not None:
+                f = f.head(remaining)
+                remaining -= len(f)
+            frames.append(f)
+            if remaining is not None and remaining <= 0:
+                break
+        return (
+            pd.concat(frames, ignore_index=True)
+            if frames
+            else pd.DataFrame(columns=list(q.columns))
+        )
+
+    def _execute_search(self, q: Q.SearchQuery, ds: DataSource):
+        import pandas as pd
+
+        rows = []
+        needle = q.query.lower()
+        for dim in q.dimensions:
+            if len(rows) >= q.limit:
+                break
+            for v in ds.dicts[dim].values:
+                if needle in v.lower():
+                    rows.append({"dimension": dim, "value": v})
+                    if len(rows) >= q.limit:
+                        break
+        return pd.DataFrame(rows, columns=["dimension", "value"])
+
+
+# ---------------------------------------------------------------------------
+# Shared finalization (also used by the distributed path)
+# ---------------------------------------------------------------------------
+
+
+def finalize_groupby(
+    q: Q.GroupByQuery,
+    dims: List[ResolvedDim],
+    la: LoweredAggs,
+    sums: np.ndarray,
+    mins: np.ndarray,
+    maxs: np.ndarray,
+    sketch_states: Dict[str, np.ndarray],
+):
+    """Merged partial state -> result DataFrame (decode, post-aggs, having,
+    order/limit) — the broker-side finalization of SURVEY.md §3.3."""
+    import pandas as pd
+
+    rows_per_group = sums[:, 0]
+    present = rows_per_group > 0
+    idx = np.nonzero(present)[0].astype(np.int64)
+
+    table: Dict[str, np.ndarray] = {}
+    # decode combined gid -> per-dimension codes (row-major order)
+    rem = idx
+    codes_list = []
+    for d in reversed(dims):
+        codes_list.append((rem % d.cardinality).astype(np.int64))
+        rem = rem // d.cardinality
+    codes_list.reverse()
+    for d, codes in zip(dims, codes_list):
+        table[d.spec.name] = d.decode(codes)
+
+    for j, n in enumerate(la.sum_names):
+        if n == "__rows":
+            continue
+        v = sums[idx, j].astype(np.float64)
+        table[n] = np.rint(v).astype(np.int64) if la.long_valued[n] else v
+    def _finalize_extremum(v: np.ndarray, long_valued: bool) -> np.ndarray:
+        v = v.astype(np.float64)
+        v = np.where(np.isinf(v), np.nan, v)
+        if long_valued and not np.isnan(v).any():
+            return np.rint(v).astype(np.int64)
+        return v
+
+    for j, n in enumerate(la.min_names):
+        table[n] = _finalize_extremum(mins[idx, j], la.long_valued[n])
+    for j, n in enumerate(la.max_names):
+        table[n] = _finalize_extremum(maxs[idx, j], la.long_valued[n])
+
+    raw_states: Dict[str, np.ndarray] = {}
+    for agg in la.sketch_aggs:
+        from ..ops import hll as hll_ops
+        from ..ops import theta as theta_ops
+
+        st = sketch_states[agg.name][idx]
+        raw_states[agg.name] = st
+        if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+            table[agg.name] = np.rint(hll_ops.estimate(st)).astype(np.int64)
+        else:
+            table[agg.name] = np.rint(theta_ops.estimate(st)).astype(np.int64)
+
+    for p in q.post_aggregations:
+        table[p.name] = np.broadcast_to(
+            eval_post_agg(p, table, raw_states), idx.shape
+        ).copy()
+
+    if q.having is not None:
+        m = _eval_having(q.having, table)
+        table = {k: np.asarray(v)[m] for k, v in table.items()}
+
+    df = pd.DataFrame(table)
+
+    # grouping-set subtotals (CUBE/ROLLUP) are handled by the planner issuing
+    # one query per set and concatenating — see plan/transforms.py.
+
+    if q.limit_spec is not None:
+        ls = q.limit_spec
+        if ls.columns:
+            df = df.sort_values(
+                [c.dimension for c in ls.columns],
+                ascending=[c.direction == "ascending" for c in ls.columns],
+                kind="stable",
+            )
+        if ls.offset:
+            df = df.iloc[ls.offset :]
+        if ls.limit is not None:
+            df = df.head(ls.limit)
+    return df.reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Column discovery helpers
+# ---------------------------------------------------------------------------
+
+
+def _agg_columns(a: A.Aggregation) -> List[str]:
+    if isinstance(a, A.FilteredAgg):
+        return _filter_columns(a.filter) + _agg_columns(a.aggregator)
+    if isinstance(a, A.ExpressionAgg):
+        return list(a.expression.columns())
+    if isinstance(a, A.Count):
+        return []
+    if isinstance(a, A.CardinalityAgg):
+        return list(a.field_names)
+    return [a.field_name]  # type: ignore[attr-defined]
+
+
+def _filter_columns(f: Filter) -> List[str]:
+    from ..models import filters as F
+
+    if isinstance(f, (F.Selector, F.InFilter, F.Bound, F.Regex, F.LikeFilter)):
+        return [f.dimension]
+    if isinstance(f, (F.And, F.Or)):
+        out: List[str] = []
+        for x in f.fields:
+            out.extend(_filter_columns(x))
+        return out
+    if isinstance(f, F.Not):
+        return _filter_columns(f.field)
+    if isinstance(f, F.IntervalFilter):
+        return ["__time"] if f.dimension == "__time" else [f.dimension]
+    if isinstance(f, F.ExpressionFilter):
+        return list(f.expression.columns())
+    return []

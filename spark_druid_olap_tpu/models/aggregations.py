@@ -1,0 +1,302 @@
+"""Aggregation + post-aggregation spec families — Druid JSON mirror.
+
+Reference parity: `AggregationSpec` family (count, long/double sum/min/max,
+hyperUnique, cardinality, javascript, filtered) and `PostAggregationSpec`
+family (arithmetic, fieldAccess, constant, hyperUniqueCardinality) —
+SURVEY.md §2 query-model row, expected `org/sparklinedata/druid/DruidQuery.scala`
+`[U]`.  The reference maps Spark aggregate functions onto these in
+`AggregateTransform` (AVG becomes sum+count plus an arithmetic post-agg;
+approx_count_distinct becomes cardinality/hyperUnique) — our planner does the
+same mapping in `plan/transforms.py`.
+
+Each aggregator here is also the *merge contract* for the distributed engine:
+`merge_op` names the ICI collective used to combine per-device partial states
+("psum" for sums/counts, "pmin"/"pmax" for extrema and HLL registers,
+"union" for theta sketches / TopN candidates) — see `parallel/merge.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from .filters import Filter
+
+
+class Aggregation:
+    name: str
+
+    def to_druid(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    @property
+    def merge_op(self) -> str:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Count(Aggregation):
+    name: str
+
+    def to_druid(self):
+        return {"type": "count", "name": self.name}
+
+    merge_op = "psum"
+
+
+@dataclasses.dataclass(frozen=True)
+class LongSum(Aggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "longSum", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "psum"
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleSum(Aggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "doubleSum", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "psum"
+
+
+@dataclasses.dataclass(frozen=True)
+class LongMin(Aggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "longMin", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "pmin"
+
+
+@dataclasses.dataclass(frozen=True)
+class LongMax(Aggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "longMax", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "pmax"
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleMin(Aggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "doubleMin", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "pmin"
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleMax(Aggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "doubleMax", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "pmax"
+
+
+@dataclasses.dataclass(frozen=True)
+class HyperUnique(Aggregation):
+    """Approximate COUNT(DISTINCT) via HyperLogLog register arrays.
+
+    Druid's `hyperUnique` aggregates a pre-built HLL metric; its `cardinality`
+    aggregator builds HLL from dimension values at query time.  On TPU both are
+    the same kernel (ops/hll.py): hash -> (bucket, rho) -> per-group
+    register-max.  Partial state = uint8/int32 registers[G, 2^p]; merge =
+    element-wise max (pmax over ICI).
+    """
+
+    name: str
+    field_name: str
+    precision: int = 11  # 2^11 = 2048 registers; ~2.3% relative std error
+
+    def to_druid(self):
+        return {"type": "hyperUnique", "name": self.name, "fieldName": self.field_name}
+
+    merge_op = "pmax"
+
+
+@dataclasses.dataclass(frozen=True)
+class CardinalityAgg(Aggregation):
+    """Druid `cardinality` aggregator (HLL over dimension values at query time)."""
+
+    name: str
+    field_names: tuple
+    by_row: bool = False
+    precision: int = 11
+
+    def to_druid(self):
+        return {
+            "type": "cardinality",
+            "name": self.name,
+            "fields": list(self.field_names),
+            "byRow": self.by_row,
+        }
+
+    merge_op = "pmax"
+
+
+@dataclasses.dataclass(frozen=True)
+class ThetaSketch(Aggregation):
+    """KMV/theta sketch distinct-count: keep the K smallest 64-bit hashes.
+
+    Partial state = sorted uint hashes[G, K]; merge = concat + sort + take-K
+    (set union in the KMV sense) — `merge_op = "union"`, implemented with an
+    all_gather + re-sort in `parallel/merge.py` (Druid merges theta sketches
+    on the broker the same way, SURVEY.md §2 scatter-gather row `[U]`).
+    """
+
+    name: str
+    field_name: str
+    size: int = 4096  # K
+
+    def to_druid(self):
+        return {
+            "type": "thetaSketch",
+            "name": self.name,
+            "fieldName": self.field_name,
+            "size": self.size,
+        }
+
+    merge_op = "union"
+
+
+@dataclasses.dataclass(frozen=True)
+class FilteredAgg(Aggregation):
+    """Druid `filtered` aggregator: inner aggregation under an extra predicate
+    (how `SUM(x) FILTER (WHERE p)` / conditional counts push down)."""
+
+    filter: Filter
+    aggregator: Aggregation
+
+    @property
+    def name(self):
+        return self.aggregator.name
+
+    def to_druid(self):
+        return {
+            "type": "filtered",
+            "filter": self.filter.to_druid(),
+            "aggregator": self.aggregator.to_druid(),
+        }
+
+    @property
+    def merge_op(self):
+        return self.aggregator.merge_op
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpressionAgg(Aggregation):
+    """Aggregate over a derived scalar expression (virtual column) — the
+    TPU-native replacement for the reference's JavaScript aggregator
+    (SURVEY.md L0 `[U]`): the expression compiles to fused XLA element-wise
+    ops feeding the aggregation kernel, instead of JS source for Druid.
+    `base` is the underlying exact aggregator (sum/min/max) applied to the
+    expression's value."""
+
+    name: str
+    expression: Any  # plan.expr.Expr
+    base: str = "doubleSum"  # doubleSum | doubleMin | doubleMax | longSum
+
+    def to_druid(self):
+        return {
+            "type": "javascript",  # wire-compat slot the reference would use
+            "name": self.name,
+            "expression": str(self.expression),
+            "base": self.base,
+        }
+
+    @property
+    def merge_op(self):
+        return {"doubleSum": "psum", "longSum": "psum", "doubleMin": "pmin",
+                "doubleMax": "pmax"}[self.base]
+
+
+# ----------------------------------------------------------------------------
+# Post-aggregations (computed host-side over merged aggregate outputs — tiny)
+# ----------------------------------------------------------------------------
+
+
+class PostAggregation:
+    name: str
+
+    def to_druid(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldAccess(PostAggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {"type": "fieldAccess", "name": self.name, "fieldName": self.field_name}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstantPost(PostAggregation):
+    name: str
+    value: float
+
+    def to_druid(self):
+        return {"type": "constant", "name": self.name, "value": self.value}
+
+
+@dataclasses.dataclass(frozen=True)
+class Arithmetic(PostAggregation):
+    """fn in {+, -, *, /, quotient}; fields are other post-aggs."""
+
+    name: str
+    fn: str
+    fields: tuple  # Tuple[PostAggregation, ...]
+
+    def to_druid(self):
+        return {
+            "type": "arithmetic",
+            "name": self.name,
+            "fn": self.fn,
+            "fields": [f.to_druid() for f in self.fields],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class HyperUniqueCardinality(PostAggregation):
+    """Finalize an HLL state into a cardinality estimate."""
+
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {
+            "type": "hyperUniqueCardinality",
+            "name": self.name,
+            "fieldName": self.field_name,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ThetaSketchEstimate(PostAggregation):
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {
+            "type": "thetaSketchEstimate",
+            "name": self.name,
+            "field": {"type": "fieldAccess", "fieldName": self.field_name},
+        }

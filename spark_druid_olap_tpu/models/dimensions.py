@@ -1,0 +1,118 @@
+"""Dimension specs + extraction functions — Druid JSON mirror.
+
+Reference parity: `DimensionSpec` (default, extraction) +
+`ExtractionFunctionSpec` (timeFormat, javascript, regex, substring…) —
+SURVEY.md §2 query-model row `[U]`.  Extraction functions are how the
+reference pushes `GROUP BY f(dim)` down to Druid; on TPU an extraction is a
+host-side *dictionary rewrite*: we apply the function to the (small) dictionary
+once, producing a code→newcode remap table that the kernel applies per row with
+one int32 gather — never per-row string work on device.
+
+Time-granularity bucketing (`GROUP BY date_trunc(...)`) is the exception: it
+is arithmetic on the int64 time column, done on device (ops/timeseries.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+class ExtractionFn:
+    def to_druid(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def apply_to_dict(self, values):
+        """Map each dictionary value -> extracted string (host-side)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class RegexExtraction(ExtractionFn):
+    pattern: str
+    index: int = 1
+    replace_missing: Optional[str] = None
+
+    def to_druid(self):
+        return {"type": "regex", "expr": self.pattern}
+
+    def apply_to_dict(self, values):
+        import re
+
+        rx = re.compile(self.pattern)
+        out = []
+        for v in values:
+            m = rx.search(v)
+            out.append(m.group(self.index) if m else (self.replace_missing or v))
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SubstringExtraction(ExtractionFn):
+    index: int
+    length: Optional[int] = None
+
+    def to_druid(self):
+        d = {"type": "substring", "index": self.index}
+        if self.length is not None:
+            d["length"] = self.length
+        return d
+
+    def apply_to_dict(self, values):
+        if self.length is None:
+            return [v[self.index:] for v in values]
+        return [v[self.index : self.index + self.length] for v in values]
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeFormatExtraction(ExtractionFn):
+    """Druid `timeFormat` — used when grouping the time column by a calendar
+    granularity that isn't a fixed millisecond period (month/quarter/year)."""
+
+    format: str  # strftime-style
+    granularity: Optional[str] = None
+
+    def to_druid(self):
+        d = {"type": "timeFormat", "format": self.format}
+        if self.granularity:
+            d["granularity"] = self.granularity
+        return d
+
+    def apply_to_dict(self, values):  # applied to time bucket starts, host-side
+        import datetime
+
+        return [
+            datetime.datetime.fromtimestamp(int(v) / 1000.0, tz=datetime.timezone.utc)
+            .strftime(self.format)
+            for v in values
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class DimensionSpec:
+    """Output dimension of a GroupBy/TopN: a physical dimension (or __time),
+    an optional extraction fn, and the output name."""
+
+    dimension: str
+    output_name: Optional[str] = None
+    extraction: Optional[ExtractionFn] = None
+    # time-dimension bucketing (when dimension == "__time")
+    granularity: Optional[str] = None  # e.g. "hour", "day", "month", "P3M"
+
+    @property
+    def name(self) -> str:
+        return self.output_name or self.dimension
+
+    def to_druid(self):
+        if self.extraction is None:
+            return {
+                "type": "default",
+                "dimension": self.dimension,
+                "outputName": self.name,
+            }
+        return {
+            "type": "extraction",
+            "dimension": self.dimension,
+            "outputName": self.name,
+            "extractionFn": self.extraction.to_druid(),
+        }

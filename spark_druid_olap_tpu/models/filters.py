@@ -1,0 +1,204 @@
+"""Filter spec family — mirror of Druid's filter JSON sub-language.
+
+Reference parity: the reference's `FilterSpec` case-class family
+(selector / bound / in / regex / logical and-or-not / javascript), SURVEY.md §2
+query-model row, expected `org/sparklinedata/druid/DruidQuery.scala` `[U]`.
+Here each spec additionally knows how to *evaluate itself on device* —
+`exec/filters.py` compiles a spec tree into a jittable boolean-mask function
+over segment columns (the TPU analog of Druid evaluating the filter inside its
+historical engine).  Where the reference escapes to JavaScript filters
+(JS codegen layer, SURVEY.md L0), we escape to `ExpressionFilter`, compiled to
+XLA element-wise ops by `ops/expressions.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+
+class Filter:
+    """Base class.  `to_druid()` produces wire-compatible Druid JSON."""
+
+    def to_druid(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # sugar for building trees
+    def __and__(self, other: "Filter") -> "Filter":
+        return And(tuple(f for f in (self, other)))
+
+    def __or__(self, other: "Filter") -> "Filter":
+        return Or(tuple(f for f in (self, other)))
+
+    def __invert__(self) -> "Filter":
+        return Not(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Selector(Filter):
+    """dimension == value (Druid `selector`)."""
+
+    dimension: str
+    value: Optional[str]
+
+    def to_druid(self):
+        return {"type": "selector", "dimension": self.dimension, "value": self.value}
+
+
+@dataclasses.dataclass(frozen=True)
+class InFilter(Filter):
+    """dimension IN (values) (Druid `in`)."""
+
+    dimension: str
+    values: Tuple[str, ...]
+
+    def to_druid(self):
+        return {"type": "in", "dimension": self.dimension, "values": list(self.values)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Bound(Filter):
+    """Range filter (Druid `bound`).  `ordering` is "lexicographic" for string
+    dimensions (sound because our dictionaries are sorted — codes preserve
+    order) or "numeric" for metric/time columns."""
+
+    dimension: str
+    lower: Optional[str] = None
+    upper: Optional[str] = None
+    lower_strict: bool = False
+    upper_strict: bool = False
+    ordering: str = "lexicographic"
+
+    def to_druid(self):
+        d: Dict[str, Any] = {"type": "bound", "dimension": self.dimension}
+        if self.lower is not None:
+            d["lower"] = self.lower
+            d["lowerStrict"] = self.lower_strict
+        if self.upper is not None:
+            d["upper"] = self.upper
+            d["upperStrict"] = self.upper_strict
+        d["ordering"] = self.ordering
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class Regex(Filter):
+    """Druid `regex` filter.  Evaluated host-side against the dictionary (the
+    dictionary is small; match once per dict entry, then it's an `in` filter on
+    codes — strictly better than Druid's per-row regex)."""
+
+    dimension: str
+    pattern: str
+
+    def to_druid(self):
+        return {"type": "regex", "dimension": self.dimension, "pattern": self.pattern}
+
+
+@dataclasses.dataclass(frozen=True)
+class LikeFilter(Filter):
+    """SQL LIKE — compiled to regex on the dictionary like `Regex`."""
+
+    dimension: str
+    pattern: str  # SQL pattern with % and _
+
+    def to_druid(self):
+        return {"type": "like", "dimension": self.dimension, "pattern": self.pattern}
+
+
+@dataclasses.dataclass(frozen=True)
+class And(Filter):
+    fields: Tuple[Filter, ...]
+
+    def to_druid(self):
+        return {"type": "and", "fields": [f.to_druid() for f in self.fields]}
+
+
+@dataclasses.dataclass(frozen=True)
+class Or(Filter):
+    fields: Tuple[Filter, ...]
+
+    def to_druid(self):
+        return {"type": "or", "fields": [f.to_druid() for f in self.fields]}
+
+
+@dataclasses.dataclass(frozen=True)
+class Not(Filter):
+    field: Filter
+
+    def to_druid(self):
+        return {"type": "not", "field": self.field.to_druid()}
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpressionFilter(Filter):
+    """Residual scalar predicate over columns, compiled to XLA element-wise ops
+    by `ops/expressions.py` — the TPU-native analog of the reference's
+    JavaScript filter escape hatch (SURVEY.md L0 jscodegen `[U]`): instead of
+    emitting JS source for Druid's Rhino interpreter, we emit a jittable
+    function."""
+
+    expression: Any  # plan.expr.Expr
+
+    def to_druid(self):
+        return {"type": "expression", "expression": str(self.expression)}
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalFilter(Filter):
+    """Half-open [start_ms, end_ms) intervals over the time column.  The
+    reference turns time-column predicates into the *query interval* rather
+    than a filter (ProjectFilterTransform, SURVEY.md §2 `[U]`); we keep both
+    paths — interval narrowing prunes whole segments, and this filter handles
+    row-level residue."""
+
+    dimension: str  # usually "__time"
+    intervals: Tuple[Tuple[int, int], ...]
+
+    def to_druid(self):
+        def fmt(iv):
+            return f"{_ms_to_iso(iv[0])}/{_ms_to_iso(iv[1])}"
+
+        return {
+            "type": "interval",
+            "dimension": self.dimension,
+            "intervals": [fmt(iv) for iv in self.intervals],
+        }
+
+
+def _ms_to_iso(ms: int) -> str:
+    import datetime
+
+    return (
+        datetime.datetime.fromtimestamp(ms / 1000.0, tz=datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3]
+        + "Z"
+    )
+
+
+def filter_from_druid(d: Dict[str, Any]) -> Filter:
+    """Parse Druid filter JSON back into the spec tree (wire-compat round trip)."""
+    t = d["type"]
+    if t == "selector":
+        return Selector(d["dimension"], d.get("value"))
+    if t == "in":
+        return InFilter(d["dimension"], tuple(d["values"]))
+    if t == "bound":
+        return Bound(
+            d["dimension"],
+            d.get("lower"),
+            d.get("upper"),
+            d.get("lowerStrict", False),
+            d.get("upperStrict", False),
+            d.get("ordering", "lexicographic"),
+        )
+    if t == "regex":
+        return Regex(d["dimension"], d["pattern"])
+    if t == "like":
+        return LikeFilter(d["dimension"], d["pattern"])
+    if t == "and":
+        return And(tuple(filter_from_druid(f) for f in d["fields"]))
+    if t == "or":
+        return Or(tuple(filter_from_druid(f) for f in d["fields"]))
+    if t == "not":
+        return Not(filter_from_druid(d["field"]))
+    raise ValueError(f"unsupported filter type {t!r}")

@@ -1,0 +1,293 @@
+"""Query specs — the compact execution contract between planner and engine.
+
+Reference parity: `QuerySpec` hierarchy (GroupBy / TopN / Timeseries / Select /
+Search / Scan), `HavingSpec`, `LimitSpec`, `OrderByColumnSpec` — SURVEY.md §2
+query-model row, expected `org/sparklinedata/druid/DruidQuery.scala` `[U]`.
+In the reference these serialize to JSON and travel over HTTP to a Druid
+broker; here the same objects are *kernel launch specs* consumed by
+`exec/engine.py` (and they still serialize to Druid-wire JSON via
+`to_druid()`, preserving the option of differential testing against a real
+Druid, per SURVEY.md §7 L-spec).
+
+Specificity order for planner choice (reference: Timeseries ⊂ TopN ⊂ GroupBy,
+SURVEY.md §3.2): a Timeseries is a GroupBy whose only dimension is the time
+bucket; a TopN is a single-dimension GroupBy with a metric-ordered limit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from .aggregations import Aggregation, PostAggregation
+from .dimensions import DimensionSpec
+from .filters import Filter, _ms_to_iso
+
+
+@dataclasses.dataclass(frozen=True)
+class VirtualColumn:
+    """Derived per-row scalar column computed on device before aggregation
+    (e.g. `l_extendedprice * (1 - l_discount)`).  Compiled by
+    `ops/expressions.py` into fused XLA element-wise ops — the TPU-native
+    replacement for the reference's JS-codegen virtual metrics."""
+
+    name: str
+    expression: Any  # plan.expr.Expr
+    dtype: str = "double"
+
+    def to_druid(self):
+        return {
+            "type": "expression",
+            "name": self.name,
+            "expression": str(self.expression),
+            "outputType": "DOUBLE" if self.dtype == "double" else "LONG",
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class OrderByColumnSpec:
+    dimension: str
+    direction: str = "ascending"  # ascending | descending
+
+    def to_druid(self):
+        return {"dimension": self.dimension, "direction": self.direction}
+
+
+@dataclasses.dataclass(frozen=True)
+class LimitSpec:
+    limit: Optional[int]
+    columns: Tuple[OrderByColumnSpec, ...] = ()
+    offset: int = 0
+
+    def to_druid(self):
+        d: Dict[str, Any] = {"type": "default"}
+        if self.limit is not None:
+            d["limit"] = self.limit
+        if self.offset:
+            d["offset"] = self.offset
+        d["columns"] = [c.to_druid() for c in self.columns]
+        return d
+
+
+class Having:
+    def to_druid(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class HavingCompare(Having):
+    """aggregate <op> value, op in {>, <, ==, >=, <=, !=}."""
+
+    aggregation: str
+    op: str
+    value: float
+
+    def to_druid(self):
+        m = {">": "greaterThan", "<": "lessThan", "==": "equalTo"}
+        if self.op in m:
+            return {
+                "type": m[self.op],
+                "aggregation": self.aggregation,
+                "value": self.value,
+            }
+        inner = {">=": "lessThan", "<=": "greaterThan", "!=": "equalTo"}[self.op]
+        return {
+            "type": "not",
+            "havingSpec": {
+                "type": inner,
+                "aggregation": self.aggregation,
+                "value": self.value,
+            },
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class HavingAnd(Having):
+    specs: Tuple[Having, ...]
+
+    def to_druid(self):
+        return {"type": "and", "havingSpecs": [s.to_druid() for s in self.specs]}
+
+
+@dataclasses.dataclass(frozen=True)
+class HavingOr(Having):
+    specs: Tuple[Having, ...]
+
+    def to_druid(self):
+        return {"type": "or", "havingSpecs": [s.to_druid() for s in self.specs]}
+
+
+def _ivs(intervals):
+    return [f"{_ms_to_iso(a)}/{_ms_to_iso(b)}" for a, b in intervals] or [
+        "0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"
+    ]
+
+
+class QuerySpec:
+    """Base of all query specs."""
+
+    datasource: str
+
+    def to_druid(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupByQuery(QuerySpec):
+    datasource: str
+    dimensions: Tuple[DimensionSpec, ...]
+    aggregations: Tuple[Aggregation, ...]
+    post_aggregations: Tuple[PostAggregation, ...] = ()
+    filter: Optional[Filter] = None
+    having: Optional[Having] = None
+    limit_spec: Optional[LimitSpec] = None
+    intervals: Tuple[Tuple[int, int], ...] = ()
+    granularity: str = "all"
+    virtual_columns: Tuple[VirtualColumn, ...] = ()
+    # grouping-set support (GROUP BY CUBE/ROLLUP/GROUPING SETS): each entry is
+    # a bitmask over `dimensions` marking which dims are active in that set.
+    subtotals: Tuple[Tuple[int, ...], ...] = ()
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "queryType": "groupBy",
+            "dataSource": self.datasource,
+            "granularity": self.granularity,
+            "dimensions": [x.to_druid() for x in self.dimensions],
+            "aggregations": [a.to_druid() for a in self.aggregations],
+            "intervals": _ivs(self.intervals),
+        }
+        if self.virtual_columns:
+            d["virtualColumns"] = [v.to_druid() for v in self.virtual_columns]
+        if self.post_aggregations:
+            d["postAggregations"] = [p.to_druid() for p in self.post_aggregations]
+        if self.filter is not None:
+            d["filter"] = self.filter.to_druid()
+        if self.having is not None:
+            d["having"] = self.having.to_druid()
+        if self.limit_spec is not None:
+            d["limitSpec"] = self.limit_spec.to_druid()
+        if self.subtotals:
+            d["subtotalsSpec"] = [
+                [self.dimensions[i].name for i in s] for s in self.subtotals
+            ]
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class TopNQuery(QuerySpec):
+    datasource: str
+    dimension: DimensionSpec
+    metric: str  # aggregation/post-agg name to rank by
+    threshold: int
+    aggregations: Tuple[Aggregation, ...]
+    post_aggregations: Tuple[PostAggregation, ...] = ()
+    filter: Optional[Filter] = None
+    intervals: Tuple[Tuple[int, int], ...] = ()
+    granularity: str = "all"
+    virtual_columns: Tuple[VirtualColumn, ...] = ()
+    descending: bool = True
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "queryType": "topN",
+            "dataSource": self.datasource,
+            "granularity": self.granularity,
+            "dimension": self.dimension.to_druid(),
+            "metric": self.metric
+            if self.descending
+            else {"type": "inverted", "metric": self.metric},
+            "threshold": self.threshold,
+            "aggregations": [a.to_druid() for a in self.aggregations],
+            "intervals": _ivs(self.intervals),
+        }
+        if self.virtual_columns:
+            d["virtualColumns"] = [v.to_druid() for v in self.virtual_columns]
+        if self.post_aggregations:
+            d["postAggregations"] = [p.to_druid() for p in self.post_aggregations]
+        if self.filter is not None:
+            d["filter"] = self.filter.to_druid()
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeseriesQuery(QuerySpec):
+    datasource: str
+    granularity: str  # "hour", "day", ... or ISO period "PT1H"
+    aggregations: Tuple[Aggregation, ...]
+    post_aggregations: Tuple[PostAggregation, ...] = ()
+    filter: Optional[Filter] = None
+    intervals: Tuple[Tuple[int, int], ...] = ()
+    virtual_columns: Tuple[VirtualColumn, ...] = ()
+    descending: bool = False
+    skip_empty_buckets: bool = True
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "queryType": "timeseries",
+            "dataSource": self.datasource,
+            "granularity": self.granularity,
+            "aggregations": [a.to_druid() for a in self.aggregations],
+            "intervals": _ivs(self.intervals),
+            "descending": self.descending,
+        }
+        if self.virtual_columns:
+            d["virtualColumns"] = [v.to_druid() for v in self.virtual_columns]
+        if self.post_aggregations:
+            d["postAggregations"] = [p.to_druid() for p in self.post_aggregations]
+        if self.filter is not None:
+            d["filter"] = self.filter.to_druid()
+        if self.skip_empty_buckets:
+            d["context"] = {"skipEmptyBuckets": True}
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class ScanQuery(QuerySpec):
+    """Row scan (the reference's Select/Scan path for non-aggregate queries,
+    gated by its `nonAggregateQueryHandling` option)."""
+
+    datasource: str
+    columns: Tuple[str, ...]
+    filter: Optional[Filter] = None
+    intervals: Tuple[Tuple[int, int], ...] = ()
+    limit: Optional[int] = None
+    virtual_columns: Tuple[VirtualColumn, ...] = ()
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "queryType": "scan",
+            "dataSource": self.datasource,
+            "columns": list(self.columns),
+            "intervals": _ivs(self.intervals),
+        }
+        if self.virtual_columns:
+            d["virtualColumns"] = [v.to_druid() for v in self.virtual_columns]
+        if self.filter is not None:
+            d["filter"] = self.filter.to_druid()
+        if self.limit is not None:
+            d["limit"] = self.limit
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchQuery(QuerySpec):
+    """Dimension-value search (Druid `search`): find dimension values matching
+    a substring/regex.  On TPU this is pure host-side dictionary work."""
+
+    datasource: str
+    dimensions: Tuple[str, ...]
+    query: str  # case-insensitive contains
+    filter: Optional[Filter] = None
+    intervals: Tuple[Tuple[int, int], ...] = ()
+    limit: int = 1000
+
+    def to_druid(self):
+        return {
+            "queryType": "search",
+            "dataSource": self.datasource,
+            "searchDimensions": list(self.dimensions),
+            "query": {"type": "insensitive_contains", "value": self.query},
+            "intervals": _ivs(self.intervals),
+            "limit": self.limit,
+        }

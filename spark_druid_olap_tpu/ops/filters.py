@@ -1,0 +1,167 @@
+"""Compile Filter spec trees into jittable boolean-mask functions.
+
+Reference parity: in spark-druid-olap, `FilterSpec`s travel to Druid which
+evaluates them against its bitmap indexes inside historicals (SURVEY.md §2
+ProjectFilterTransform row `[U]`).  Here the planner-produced spec tree
+compiles into a fused element-wise mask over device-resident columns; XLA
+fuses the whole predicate into the aggregation kernel's first pass, so a
+filter costs one pass over the (already HBM-resident) filtered columns.
+
+Dictionary tricks (all host-side, per-query, O(dictionary) not O(rows)):
+* Selector / In   -> int equality / isin on codes.
+* Bound on string -> because dictionaries are sorted (catalog/segment.py),
+  lexicographic bounds become integer range tests on codes.
+* Regex / Like    -> run the regex over dictionary values once; the matching
+  code set becomes an isin — strictly cheaper than Druid's per-row regex.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..catalog.segment import DataSource
+from ..models import filters as F
+from ..plan.expr import compile_expr
+
+MaskFn = Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]
+
+
+def _like_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
+    """Returns fn(cols) -> bool[R].  `cols` maps column name -> device array
+    (dimension codes, metric values, and "__time")."""
+
+    if isinstance(f, F.Selector):
+        dim = f.dimension
+        if dim in ds.dicts:
+            d = ds.dicts[dim]
+            if f.value is None:
+                return lambda cols: cols[dim] == jnp.int32(-1)
+            try:
+                code = d.values.index(f.value)
+            except ValueError:
+                return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
+            return lambda cols: cols[dim] == jnp.int32(code)
+        # numeric column equality
+        v = float(f.value)  # type: ignore[arg-type]
+        return lambda cols: cols[dim] == v
+
+    if isinstance(f, F.InFilter):
+        dim = f.dimension
+        if dim in ds.dicts:
+            d = ds.dicts[dim]
+            codes = np.array(
+                [d.values.index(v) for v in f.values if v in d.values],
+                dtype=np.int32,
+            )
+        else:
+            codes = np.asarray([float(v) for v in f.values])
+        if len(codes) == 0:
+            return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
+        return lambda cols: jnp.isin(cols[dim], codes)
+
+    if isinstance(f, F.Bound):
+        dim = f.dimension
+        if dim in ds.dicts and f.ordering == "lexicographic":
+            vals = np.asarray(ds.dicts[dim].values, dtype=str)
+            lo_code = hi_code = None
+            if f.lower is not None:
+                side = "right" if f.lower_strict else "left"
+                lo_code = int(np.searchsorted(vals, f.lower, side=side))
+            if f.upper is not None:
+                side = "left" if f.upper_strict else "right"
+                hi_code = int(np.searchsorted(vals, f.upper, side=side)) - 1
+
+            def bound_dict(cols, lo=lo_code, hi=hi_code, dim=dim):
+                c = cols[dim]
+                m = c >= 0
+                if lo is not None:
+                    m = m & (c >= lo)
+                if hi is not None:
+                    m = m & (c <= hi)
+                return m
+
+            return bound_dict
+
+        lo = float(f.lower) if f.lower is not None else None
+        hi = float(f.upper) if f.upper is not None else None
+
+        def bound_num(cols, lo=lo, hi=hi, f=f, dim=dim):
+            c = cols[dim]
+            m = jnp.ones(c.shape, jnp.bool_)
+            if lo is not None:
+                m = m & ((c > lo) if f.lower_strict else (c >= lo))
+            if hi is not None:
+                m = m & ((c < hi) if f.upper_strict else (c <= hi))
+            return m
+
+        return bound_num
+
+    if isinstance(f, (F.Regex, F.LikeFilter)):
+        dim = f.dimension
+        pat = (
+            f.pattern
+            if isinstance(f, F.Regex)
+            else _like_to_regex(f.pattern)
+        )
+        rx = re.compile(pat)
+        d = ds.dicts[dim]
+        codes = np.array(
+            [i for i, v in enumerate(d.values) if rx.search(v)], dtype=np.int32
+        )
+        if len(codes) == 0:
+            return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
+        return lambda cols: jnp.isin(cols[dim], codes)
+
+    if isinstance(f, F.And):
+        fns = [compile_filter(x, ds) for x in f.fields]
+        return lambda cols: _fold(jnp.logical_and, fns, cols)
+
+    if isinstance(f, F.Or):
+        fns = [compile_filter(x, ds) for x in f.fields]
+        return lambda cols: _fold(jnp.logical_or, fns, cols)
+
+    if isinstance(f, F.Not):
+        fn = compile_filter(f.field, ds)
+        return lambda cols: jnp.logical_not(fn(cols))
+
+    if isinstance(f, F.IntervalFilter):
+        dim = f.dimension
+        ivs = f.intervals
+
+        def interval(cols, ivs=ivs, dim=dim):
+            t = cols[dim]
+            m = jnp.zeros(t.shape, jnp.bool_)
+            for a, b in ivs:
+                m = m | ((t >= a) & (t < b))
+            return m
+
+        return interval
+
+    if isinstance(f, F.ExpressionFilter):
+        fn = compile_expr(f.expression)
+        return lambda cols: jnp.asarray(fn(cols)).astype(jnp.bool_)
+
+    raise TypeError(f"cannot compile filter {f!r}")
+
+
+def _fold(op, fns, cols):
+    acc = fns[0](cols)
+    for fn in fns[1:]:
+        acc = op(acc, fn(cols))
+    return acc

@@ -1,0 +1,102 @@
+"""Theta (KMV) sketches on TPU — bottom-K hash sets per group, union-merge.
+
+Reference parity: Druid's DataSketches `thetaSketch` aggregator (the other
+approx-distinct the reference can push down, SURVEY.md §2 / BASELINE config #5
+`[U]`).  Per-segment partial sketches union on the broker; here per-shard
+partial states union across devices via all_gather + re-sort
+(`merge_op="union"`, parallel/merge.py).
+
+TPU-first shape (SURVEY.md §7 hard-part #3: "theta union needs sorted-unique —
+do as sort + segmented ops"): no per-row hash-table scatter.  A shard's rows
+are (group, hash) pairs; one `lexsort` groups them and orders hashes within
+each group; duplicate hashes collapse to a sentinel; ranks within each group
+come from a searchsorted against group starts; rows with rank < K land in the
+state via a *unique-index* scatter (XLA handles unique scatters efficiently).
+
+State: uint32[G, K], ascending, padded with SENTINEL (0xFFFFFFFF).
+Estimate: count < K ⇒ exact distinct-hash count; else (K-1) / (kth / 2^32).
+32-bit hash space ⇒ ~n²/2³³ collision under-count (~1% at n=10⁸); acceptable
+for approx_count_distinct, noted for parity tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.hashing import hash_column
+
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "k"))
+def _bottom_k(h: jnp.ndarray, gid: jnp.ndarray, mask: jnp.ndarray,
+              num_groups: int, k: int) -> jnp.ndarray:
+    R = h.shape[0]
+    g = jnp.where(mask, gid, num_groups)  # masked rows to trash group
+    hh = jnp.where(mask, h, SENTINEL)
+    # sort by (group, hash) — jnp.lexsort: last key is primary
+    order = jnp.lexsort((hh, g))
+    gs = g[order]
+    hs = hh[order]
+    # collapse duplicate (group, hash) pairs
+    dup = jnp.zeros(R, jnp.bool_).at[1:].set(
+        (gs[1:] == gs[:-1]) & (hs[1:] == hs[:-1])
+    )
+    hs = jnp.where(dup, SENTINEL, hs)
+    # re-sort within group so sentinels sink to the end
+    order2 = jnp.lexsort((hs, gs))
+    gs2 = gs[order2]
+    hs2 = hs[order2]
+    starts = jnp.searchsorted(gs2, jnp.arange(num_groups + 1, dtype=gs2.dtype))
+    rank = jnp.arange(R, dtype=jnp.int32) - starts[
+        jnp.clip(gs2, 0, num_groups)
+    ].astype(jnp.int32)
+    keep = (rank < k) & (gs2 < num_groups) & (hs2 != SENTINEL)
+    out = jnp.full((num_groups * k,), SENTINEL, dtype=jnp.uint32)
+    flat_idx = jnp.where(keep, gs2 * k + rank, num_groups * k)
+    out = out.at[flat_idx].set(hs2, mode="drop")
+    return out.reshape(num_groups, k)
+
+
+def partial_theta(
+    agg, cols: Mapping[str, jnp.ndarray], gid, mask, num_groups: int
+) -> jnp.ndarray:
+    h = hash_column(cols[agg.field_name], seed=7)
+    return _bottom_k(h, gid, mask, num_groups, agg.size)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_states(a: jnp.ndarray, b: jnp.ndarray, k: int) -> jnp.ndarray:
+    """KMV union: concat, sort, dedupe, keep bottom-K. a,b: uint32[G, K]."""
+    cat = jnp.concatenate([a, b], axis=1)
+    s = jnp.sort(cat, axis=1)
+    dup = jnp.zeros(s.shape, jnp.bool_).at[:, 1:].set(s[:, 1:] == s[:, :-1])
+    s = jnp.where(dup, SENTINEL, s)
+    s = jnp.sort(s, axis=1)
+    return s[:, :k]
+
+
+def merge_many(states, k: int) -> jnp.ndarray:
+    acc = states[0]
+    for s in states[1:]:
+        acc = merge_states(acc, s, k)
+    return acc
+
+
+def estimate(state: np.ndarray) -> np.ndarray:
+    """Distinct estimate per group from uint32[..., K] KMV state."""
+    s = np.asarray(state)
+    k = s.shape[-1]
+    valid = s != np.uint32(0xFFFFFFFF)
+    count = valid.sum(axis=-1)
+    kth = s[..., -1].astype(np.float64)  # largest kept hash
+    frac = (kth + 1.0) / 2.0**32
+    full = count >= k
+    with np.errstate(divide="ignore", invalid="ignore"):
+        est = np.where(full, (k - 1) / np.maximum(frac, 1e-12), count)
+    return est

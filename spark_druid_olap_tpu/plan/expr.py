@@ -1,0 +1,310 @@
+"""Scalar expression tree + compiler to jittable XLA element-wise functions.
+
+Reference parity: this is the TPU-native replacement for the reference's
+JS-codegen layer (`JSCodeGenerator`, `JSExpr` — SURVEY.md §2/L0, expected
+`org/sparklinedata/druid/jscodegen/` `[U]`).  The reference widened pushdown
+by compiling Catalyst expressions (arithmetic, casts, date/string functions)
+into JavaScript snippets embedded in Druid query JSON, interpreted row-by-row
+by Druid's Rhino engine.  We compile the same expression class into *fused XLA
+element-wise ops* over device-resident columns — traced once under jit, fused
+into the aggregation kernel, zero interpretation cost.
+
+Expressions are also the SQL/DataFrame AST for projections, predicates, and
+virtual columns; the planner walks them (plan/transforms.py) to decide
+pushability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Expr:
+    def __add__(self, o):
+        return BinaryOp("+", self, _lit(o))
+
+    def __radd__(self, o):
+        return BinaryOp("+", _lit(o), self)
+
+    def __sub__(self, o):
+        return BinaryOp("-", self, _lit(o))
+
+    def __rsub__(self, o):
+        return BinaryOp("-", _lit(o), self)
+
+    def __mul__(self, o):
+        return BinaryOp("*", self, _lit(o))
+
+    def __rmul__(self, o):
+        return BinaryOp("*", _lit(o), self)
+
+    def __truediv__(self, o):
+        return BinaryOp("/", self, _lit(o))
+
+    def __rtruediv__(self, o):
+        return BinaryOp("/", _lit(o), self)
+
+    def __neg__(self):
+        return UnaryOp("-", self)
+
+    def __gt__(self, o):
+        return Comparison(">", self, _lit(o))
+
+    def __ge__(self, o):
+        return Comparison(">=", self, _lit(o))
+
+    def __lt__(self, o):
+        return Comparison("<", self, _lit(o))
+
+    def __le__(self, o):
+        return Comparison("<=", self, _lit(o))
+
+    def eq(self, o):
+        return Comparison("==", self, _lit(o))
+
+    def ne(self, o):
+        return Comparison("!=", self, _lit(o))
+
+    def and_(self, o):
+        return BoolOp("and", (self, _lit(o)))
+
+    def or_(self, o):
+        return BoolOp("or", (self, _lit(o)))
+
+    def not_(self):
+        return BoolOp("not", (self,))
+
+    def isin(self, values):
+        return InExpr(self, tuple(values))
+
+    def between(self, lo, hi):
+        return BoolOp("and", (Comparison(">=", self, _lit(lo)),
+                              Comparison("<=", self, _lit(hi))))
+
+    def columns(self) -> Tuple[str, ...]:
+        """All column names referenced (planner uses this for pushability)."""
+        out: list = []
+        _collect_cols(self, out)
+        return tuple(dict.fromkeys(out))
+
+
+def _collect_cols(e: Expr, out: list):
+    if isinstance(e, Col):
+        out.append(e.name)
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, Expr):
+            _collect_cols(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, Expr):
+                    _collect_cols(x, out)
+
+
+def _lit(x) -> Expr:
+    return x if isinstance(x, Expr) else Literal(x)
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class Col(Expr):
+    name: str
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class Literal(Expr):
+    value: Any
+
+    def __str__(self):
+        return repr(self.value)
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class BinaryOp(Expr):
+    op: str  # + - * / %
+    left: Expr
+    right: Expr
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class UnaryOp(Expr):
+    op: str  # - abs floor ceil sqrt exp ln
+    operand: Expr
+
+    def __str__(self):
+        return f"{self.op}({self.operand})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class Comparison(Expr):
+    op: str  # > >= < <= == !=
+    left: Expr
+    right: Expr
+
+    def __str__(self):
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class BoolOp(Expr):
+    op: str  # and or not
+    operands: Tuple[Expr, ...]
+
+    def __str__(self):
+        if self.op == "not":
+            return f"not({self.operands[0]})"
+        return "(" + f" {self.op} ".join(str(o) for o in self.operands) + ")"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class InExpr(Expr):
+    operand: Expr
+    values: Tuple[Any, ...]
+
+    def __str__(self):
+        return f"({self.operand} in {self.values})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class IfExpr(Expr):
+    cond: Expr
+    then: Expr
+    otherwise: Expr
+
+    def __str__(self):
+        return f"if({self.cond}, {self.then}, {self.otherwise})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class Cast(Expr):
+    operand: Expr
+    to: str  # "double" | "long" | "bool"
+
+    def __str__(self):
+        return f"cast({self.operand} as {self.to})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class TimeBucket(Expr):
+    """floor(__time to granularity) — device-side int64 arithmetic on the time
+    column; the expression behind Timeseries bucketing and GROUP BY
+    date_trunc."""
+
+    operand: Expr
+    period_ms: int
+
+    def __str__(self):
+        return f"time_floor({self.operand}, {self.period_ms}ms)"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class AggRef(Expr):
+    """Reference to an aggregation output by name — appears in HAVING and in
+    post-aggregation expressions (`sum_x / count_x`), never on the row path."""
+
+    name: str
+
+    def __str__(self):
+        return f"agg:{self.name}"
+
+
+_UNARY = {
+    "-": lambda x: -x,
+    "abs": jnp.abs,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "sqrt": jnp.sqrt,
+    "exp": jnp.exp,
+    "ln": jnp.log,
+}
+
+_BINARY = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b,
+    "%": lambda a, b: a % b,
+}
+
+_CMP = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+def compile_expr(e: Expr) -> Callable[[Mapping[str, Any]], Any]:
+    """Compile an Expr tree into `fn(columns_dict) -> array`, jit-traceable.
+
+    The returned function is pure and shape-preserving: it maps a dict of
+    row-aligned column arrays to one array.  XLA fuses the whole tree into the
+    consuming kernel.
+    """
+    if isinstance(e, Col):
+        name = e.name
+        return lambda cols: cols[name]
+    if isinstance(e, Literal):
+        v = e.value
+        return lambda cols: v
+    if isinstance(e, BinaryOp):
+        lf, rf, op = compile_expr(e.left), compile_expr(e.right), _BINARY[e.op]
+        return lambda cols: op(lf(cols), rf(cols))
+    if isinstance(e, UnaryOp):
+        f, op = compile_expr(e.operand), _UNARY[e.op]
+        return lambda cols: op(f(cols))
+    if isinstance(e, Comparison):
+        lf, rf, op = compile_expr(e.left), compile_expr(e.right), _CMP[e.op]
+        return lambda cols: op(lf(cols), rf(cols))
+    if isinstance(e, BoolOp):
+        fs = [compile_expr(o) for o in e.operands]
+        if e.op == "not":
+            f0 = fs[0]
+            return lambda cols: jnp.logical_not(f0(cols))
+        if e.op == "and":
+            return lambda cols: _fold(jnp.logical_and, fs, cols)
+        return lambda cols: _fold(jnp.logical_or, fs, cols)
+    if isinstance(e, InExpr):
+        f = compile_expr(e.operand)
+        vals = np.asarray(e.values)
+        return lambda cols: jnp.isin(f(cols), vals)
+    if isinstance(e, IfExpr):
+        cf, tf, of = compile_expr(e.cond), compile_expr(e.then), compile_expr(e.otherwise)
+        return lambda cols: jnp.where(cf(cols), tf(cols), of(cols))
+    if isinstance(e, Cast):
+        f = compile_expr(e.operand)
+        dt = {"double": jnp.float32, "long": jnp.int32, "bool": jnp.bool_}[e.to]
+        return lambda cols: jnp.asarray(f(cols)).astype(dt)
+    if isinstance(e, TimeBucket):
+        f, p = compile_expr(e.operand), e.period_ms
+        return lambda cols: (jnp.asarray(f(cols)) // p).astype(jnp.int64)
+    if isinstance(e, AggRef):
+        name = e.name
+        return lambda cols: cols[name]
+    raise TypeError(f"cannot compile expression {e!r}")
+
+
+def _fold(op, fs, cols):
+    acc = fs[0](cols)
+    for f in fs[1:]:
+        acc = op(acc, f(cols))
+    return acc
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(v) -> Literal:
+    return Literal(v)

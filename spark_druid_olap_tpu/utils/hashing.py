@@ -1,0 +1,59 @@
+"""Vectorized 32-bit hashing on device — shared by HLL and theta sketches.
+
+TPU note: JAX runs with x64 disabled (int64 lowers poorly on TPU), so sketch
+hashing uses 32-bit murmur3-finalizer-style mixing on uint32 lanes.  Classic
+HyperLogLog was specified on 32-bit hashes (Flajolet et al.) with a large-range
+correction, so this is faithful; KMV/theta on 32-bit space carries ~n²/2³³
+collision bias (≈1% at n=10⁸), documented in ops/theta.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def mix32(x: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """murmur3 fmix32 over uint32 lanes; decorrelated by seed."""
+    h = x.astype(jnp.uint32) ^ jnp.uint32(
+        (seed * 0x9E3779B9 + 0x85EBCA6B) & 0xFFFFFFFF
+    )
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_column(col: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """Hash one column (int codes / int64-ms times / float metrics) to uint32."""
+    if col.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+        bits = jnp.asarray(col, jnp.float32).view(jnp.uint32)
+        # normalize -0.0 / 0.0 so equal SQL values hash equal
+        bits = jnp.where(col == 0, jnp.uint32(0), bits)
+        return mix32(bits, seed)
+    if col.dtype == jnp.int64:
+        lo = (col & 0xFFFFFFFF).astype(jnp.uint32)
+        hi = ((col >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+        return mix32(lo ^ mix32(hi, seed + 1), seed)
+    return mix32(col.astype(jnp.uint32), seed)
+
+
+def combine_hashes(hashes) -> jnp.ndarray:
+    """Order-dependent combine for multi-column (byRow) cardinality."""
+    acc = hashes[0]
+    for h in hashes[1:]:
+        acc = mix32(acc * jnp.uint32(31) + h)
+    return acc
+
+
+def mix32_np(x: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Numpy twin of mix32 (for oracle tests)."""
+    h = x.astype(np.uint32) ^ np.uint32((seed * 0x9E3779B9 + 0x85EBCA6B) & 0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    h = (h * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
+    h = h ^ (h >> 13)
+    h = (h * np.uint32(0xC2B2AE35)) & np.uint32(0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    return h

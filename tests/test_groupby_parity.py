@@ -1,0 +1,164 @@
+"""Differential parity: TPU-path GroupBy vs float64 numpy/pandas oracle.
+
+SURVEY.md §4 implication: the reference asserted "plan contains DruidQuery"
+plus result parity vs un-accelerated Spark; our analog is engine results vs a
+trivially-correct pandas groupby on the same columns — exact for counts and
+min/max, tight rtol for float sums (blocked f32 matmul vs f64 sequential)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu.exec.engine import Engine
+from spark_druid_olap_tpu.models.aggregations import (
+    Count,
+    DoubleMax,
+    DoubleMin,
+    DoubleSum,
+    ExpressionAgg,
+    FilteredAgg,
+)
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.filters import Bound, InFilter, Selector
+from spark_druid_olap_tpu.models.query import GroupByQuery
+from spark_druid_olap_tpu.plan.expr import col
+
+_MS_DAY = 86_400_000
+
+
+def _oracle(cols, mask, by, aggspec):
+    df = pd.DataFrame({k: np.asarray(v) for k, v in cols.items()})
+    if mask is not None:
+        df = df[mask]
+    g = df.groupby(by, sort=True)
+    return g.agg(**aggspec).reset_index()
+
+
+@pytest.mark.parametrize("strategy", ["dense", "segment"])
+def test_tpch_q1_parity(lineitem_ds, lineitem_cols, strategy):
+    """TPC-H Q1 (BASELINE config #1): filter + 2-dim groupby, sums of raw and
+    derived measures, count."""
+    cutoff = (np.datetime64("1998-09-02").astype("datetime64[D]").astype(int) + 1) * _MS_DAY
+    q = GroupByQuery(
+        datasource="tpch",
+        dimensions=(
+            DimensionSpec("l_returnflag"),
+            DimensionSpec("l_linestatus"),
+        ),
+        aggregations=(
+            DoubleSum("sum_qty", "l_quantity"),
+            DoubleSum("sum_base_price", "l_extendedprice"),
+            ExpressionAgg(
+                "sum_disc_price",
+                col("l_extendedprice") * (1 - col("l_discount")),
+            ),
+            ExpressionAgg(
+                "sum_charge",
+                col("l_extendedprice") * (1 - col("l_discount")) * (1 + col("l_tax")),
+            ),
+            Count("count_order"),
+        ),
+        filter=Bound("l_shipdate", upper=str(cutoff), ordering="numeric"),
+        limit_spec=None,
+    )
+    got = Engine(strategy=strategy).execute(q, lineitem_ds)
+    got = got.sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+
+    c = {k: np.asarray(v) for k, v in lineitem_cols.items()}
+    mask = c["l_shipdate"] <= cutoff
+    df = pd.DataFrame({k: v[mask] for k, v in c.items()})
+    df["disc_price"] = df.l_extendedprice.astype(np.float64) * (1 - df.l_discount)
+    df["charge"] = df["disc_price"] * (1 + df.l_tax)
+    want = (
+        df.groupby(["l_returnflag", "l_linestatus"], sort=True)
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            count_order=("l_quantity", "size"),
+        )
+        .reset_index()
+    )
+    assert list(got.l_returnflag) == list(want.l_returnflag)
+    assert list(got.l_linestatus) == list(want.l_linestatus)
+    np.testing.assert_array_equal(got.count_order, want.count_order)
+    for col_ in ["sum_qty", "sum_base_price", "sum_disc_price", "sum_charge"]:
+        np.testing.assert_allclose(got[col_], want[col_], rtol=2e-5)
+
+
+def test_min_max_and_filtered_agg(lineitem_ds, lineitem_cols):
+    q = GroupByQuery(
+        datasource="tpch",
+        dimensions=(DimensionSpec("l_returnflag"),),
+        aggregations=(
+            DoubleMin("min_price", "l_extendedprice"),
+            DoubleMax("max_price", "l_extendedprice"),
+            FilteredAgg(
+                filter=Selector("l_linestatus", "O"),
+                aggregator=Count("open_count"),
+            ),
+            Count("n"),
+        ),
+    )
+    got = Engine().execute(q, lineitem_ds).sort_values("l_returnflag")
+    c = lineitem_cols
+    df = pd.DataFrame(
+        {
+            "f": c["l_returnflag"],
+            "s": c["l_linestatus"],
+            "p": np.asarray(c["l_extendedprice"], dtype=np.float64),
+        }
+    )
+    want = (
+        df.groupby("f", sort=True)
+        .agg(
+            min_price=("p", "min"),
+            max_price=("p", "max"),
+            n=("p", "size"),
+        )
+        .reset_index()
+    )
+    want_open = df[df.s == "O"].groupby("f").size()
+    np.testing.assert_array_equal(got.n, want.n)
+    np.testing.assert_allclose(got.min_price, want.min_price, rtol=1e-6)
+    np.testing.assert_allclose(got.max_price, want.max_price, rtol=1e-6)
+    np.testing.assert_array_equal(
+        got.open_count, [int(want_open.get(f, 0)) for f in want.f]
+    )
+
+
+def test_in_filter_and_no_dims(lineitem_ds, lineitem_cols):
+    q = GroupByQuery(
+        datasource="tpch",
+        dimensions=(),
+        aggregations=(Count("n"), DoubleSum("s", "l_quantity")),
+        filter=InFilter("l_returnflag", ("A", "R")),
+    )
+    got = Engine().execute(q, lineitem_ds)
+    c = lineitem_cols
+    m = np.isin(np.asarray(c["l_returnflag"], dtype=object), ["A", "R"])
+    assert int(got.n[0]) == int(m.sum())
+    np.testing.assert_allclose(
+        got.s[0], np.asarray(c["l_quantity"], np.float64)[m].sum(), rtol=2e-5
+    )
+
+
+def test_interval_pushdown_prunes(lineitem_ds, lineitem_cols):
+    c = lineitem_cols
+    t = np.asarray(c["l_shipdate"])
+    lo, hi = int(np.quantile(t, 0.4)), int(np.quantile(t, 0.6))
+    q = GroupByQuery(
+        datasource="tpch",
+        dimensions=(DimensionSpec("l_linestatus"),),
+        aggregations=(Count("n"),),
+        intervals=((lo, hi),),
+    )
+    got = Engine().execute(q, lineitem_ds).sort_values("l_linestatus")
+    m = (t >= lo) & (t < hi)
+    want = (
+        pd.Series(np.asarray(c["l_linestatus"], dtype=object)[m])
+        .value_counts()
+        .sort_index()
+    )
+    np.testing.assert_array_equal(got.n, want.values)

@@ -1,0 +1,120 @@
+"""HLL + theta sketch accuracy and merge-semantics tests.
+
+Parity model (SURVEY.md §4 implication): exact equality is impossible for
+probabilistic sketches, so we assert (a) estimate within the sketch's
+theoretical error bound of the true distinct count, (b) merge-invariance:
+merging per-shard partials equals the single-shot sketch (Druid's broker-merge
+contract — register max / KMV union must be lossless)."""
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.catalog.segment import build_datasource
+from spark_druid_olap_tpu.exec.engine import Engine
+from spark_druid_olap_tpu.models.aggregations import (
+    CardinalityAgg,
+    Count,
+    HyperUnique,
+    ThetaSketch,
+)
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.query import GroupByQuery
+
+
+def _make_ds(n=50_000, groups=4, card=3000, seed=0, segs=4):
+    rng = np.random.default_rng(seed)
+    g = rng.integers(0, groups, size=n)
+    # distinct-value domain differs per group so truth varies
+    v = np.empty(n, dtype=np.int64)
+    for i in range(groups):
+        m = g == i
+        v[m] = rng.integers(0, card * (i + 1), size=int(m.sum()))
+    ds = build_datasource(
+        "sk",
+        {"g": g.astype(np.int32), "v": v},
+        dimension_cols=["g"],
+        metric_cols=["v"],
+        rows_per_segment=n // segs,
+    )
+    truth = pd.DataFrame({"g": g, "v": v}).groupby("g").v.nunique()
+    return ds, truth
+
+
+def test_hll_groupby_accuracy():
+    ds, truth = _make_ds()
+    q = GroupByQuery(
+        datasource="sk",
+        dimensions=(DimensionSpec("g"),),
+        aggregations=(HyperUnique("u", "v", precision=11), Count("n")),
+    )
+    got = Engine().execute(q, ds).sort_values("g").reset_index(drop=True)
+    # HLL relative std error ≈ 1.04/sqrt(2^11) ≈ 2.3%; assert within 4 sigma
+    for i, gname in enumerate(got.g):
+        t = truth[int(gname)]
+        assert abs(got.u[i] - t) / t < 0.10, (gname, got.u[i], t)
+
+
+def test_theta_groupby_exact_below_k():
+    ds, truth = _make_ds(card=300)  # all groups < K distinct
+    q = GroupByQuery(
+        datasource="sk",
+        dimensions=(DimensionSpec("g"),),
+        aggregations=(ThetaSketch("d", "v", size=4096),),
+    )
+    got = Engine().execute(q, ds).sort_values("g").reset_index(drop=True)
+    # below K the KMV state holds every distinct hash: exact (bar 32-bit hash
+    # collisions, negligible at this scale)
+    for i, gname in enumerate(got.g):
+        assert got.d[i] == truth[int(gname)], (gname, got.d[i], truth[int(gname)])
+
+
+def test_theta_estimate_above_k():
+    ds, truth = _make_ds(n=120_000, card=20_000, segs=3)
+    q = GroupByQuery(
+        datasource="sk",
+        dimensions=(DimensionSpec("g"),),
+        aggregations=(ThetaSketch("d", "v", size=2048),),
+    )
+    got = Engine().execute(q, ds).sort_values("g").reset_index(drop=True)
+    # KMV rel std err ≈ 1/sqrt(K-2) ≈ 2.2%; 4-sigma bound
+    for i, gname in enumerate(got.g):
+        t = truth[int(gname)]
+        assert abs(got.d[i] - t) / t < 0.09, (gname, got.d[i], t)
+
+
+def test_sketch_merge_invariance():
+    """One segment vs many segments must give identical sketch estimates —
+    the broker-merge contract (register-max / KMV-union lossless)."""
+    n = 40_000
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 3, size=n).astype(np.int32)
+    v = rng.integers(0, 5000, size=n).astype(np.int64)
+    cols = {"g": g, "v": v}
+    ds1 = build_datasource("a", cols, ["g"], ["v"], rows_per_segment=n)
+    ds8 = build_datasource("b", cols, ["g"], ["v"], rows_per_segment=n // 8)
+    for agg in (HyperUnique("x", "v"), ThetaSketch("x", "v", size=1024)):
+        q1 = GroupByQuery("a", (DimensionSpec("g"),), (agg,))
+        q8 = GroupByQuery("b", (DimensionSpec("g"),), (agg,))
+        r1 = Engine().execute(q1, ds1).sort_values("g").x.values
+        r8 = Engine().execute(q8, ds8).sort_values("g").x.values
+        np.testing.assert_array_equal(r1, r8)
+
+
+def test_cardinality_agg_multifield():
+    rng = np.random.default_rng(9)
+    n = 30_000
+    a = rng.integers(0, 50, size=n).astype(np.int32)
+    b = rng.integers(0, 40, size=n).astype(np.int32)
+    ds = build_datasource(
+        "c", {"a": a, "b": b, "m": np.ones(n, np.float32)}, ["a", "b"], ["m"]
+    )
+    q = GroupByQuery(
+        datasource="c",
+        dimensions=(),
+        aggregations=(
+            CardinalityAgg("pairs", ("a", "b"), by_row=True, precision=12),
+        ),
+    )
+    got = Engine().execute(q, ds)
+    truth = len(pd.DataFrame({"a": a, "b": b}).drop_duplicates())
+    assert abs(got.pairs[0] - truth) / truth < 0.08

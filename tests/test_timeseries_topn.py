@@ -1,0 +1,138 @@
+"""Timeseries / TopN / granularity semantics vs pandas oracle."""
+
+import numpy as np
+import pandas as pd
+
+from spark_druid_olap_tpu.exec.engine import Engine
+from spark_druid_olap_tpu.models.aggregations import Count, DoubleSum
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.query import (
+    GroupByQuery,
+    TimeseriesQuery,
+    TopNQuery,
+)
+from spark_druid_olap_tpu.utils.granularity import bucket_starts
+
+_MS_DAY = 86_400_000
+
+
+def test_timeseries_month_rollup(lineitem_ds, lineitem_cols):
+    q = TimeseriesQuery(
+        datasource="tpch",
+        granularity="month",
+        aggregations=(DoubleSum("rev", "l_extendedprice"), Count("n")),
+    )
+    got = Engine().execute(q, lineitem_ds)
+    t = np.asarray(lineitem_cols["l_shipdate"]).astype("datetime64[ms]")
+    df = pd.DataFrame(
+        {
+            "m": t.astype("datetime64[M]"),
+            "p": np.asarray(lineitem_cols["l_extendedprice"], np.float64),
+        }
+    )
+    want = df.groupby("m", sort=True).agg(rev=("p", "sum"), n=("p", "size"))
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(
+        got.timestamp.values.astype("datetime64[M]"), want.index.values
+    )
+    np.testing.assert_array_equal(got.n, want.n)
+    np.testing.assert_allclose(got.rev, want.rev, rtol=2e-5)
+
+
+def test_timeseries_empty_buckets_kept():
+    """skip_empty_buckets=False zero-fills gaps (Druid default parity)."""
+    from spark_druid_olap_tpu.catalog.segment import build_datasource
+
+    t = np.array([0, 2 * _MS_DAY, 2 * _MS_DAY + 5])  # gap at day 1
+    ds = build_datasource(
+        "gap",
+        {"t": t, "x": np.array([1.0, 2.0, 3.0], np.float32)},
+        dimension_cols=[],
+        metric_cols=["x"],
+        time_col="t",
+    )
+    q = TimeseriesQuery(
+        datasource="gap",
+        granularity="day",
+        aggregations=(Count("n"), DoubleSum("s", "x")),
+        skip_empty_buckets=False,
+    )
+    got = Engine().execute(q, ds)
+    assert len(got) == 3
+    assert list(got.n) == [1, 0, 2]
+    assert list(got.s) == [1.0, 0.0, 5.0]
+
+    got2 = Engine().execute(
+        TimeseriesQuery(
+            datasource="gap",
+            granularity="day",
+            aggregations=(Count("n"),),
+            skip_empty_buckets=True,
+        ),
+        ds,
+    )
+    assert list(got2.n) == [1, 2]
+
+
+def test_week_buckets_monday_aligned():
+    # 2024-01-01 is a Monday; it must start its own bucket.
+    monday = int(np.datetime64("2024-01-01").astype("datetime64[ms]").astype(int))
+    sunday = monday - _MS_DAY
+    starts = bucket_starts(sunday, monday + _MS_DAY, "week")
+    # epoch day 0 = Thursday, so Mondays are day ≡ 4 (mod 7)
+    days = (starts // _MS_DAY) % 7
+    assert (days == 4).all()
+    assert monday in starts.tolist()
+
+
+def test_empty_interval_returns_zero_rows(lineitem_ds):
+    q = GroupByQuery(
+        datasource="tpch",
+        dimensions=(DimensionSpec("l_returnflag"),),
+        aggregations=(Count("n"),),
+        intervals=((0, 1000),),  # 1970: nothing in scope
+    )
+    got = Engine().execute(q, lineitem_ds)
+    assert len(got) == 0
+
+
+def test_topn_exact(ssb_ds, ssb_cols):
+    q = TopNQuery(
+        datasource="ssb",
+        dimension=DimensionSpec("c_city"),
+        metric="rev",
+        threshold=10,
+        aggregations=(DoubleSum("rev", "lo_revenue"),),
+    )
+    got = Engine().execute(q, ssb_ds)
+    df = pd.DataFrame(
+        {
+            "c": np.asarray(ssb_cols["c_city"], dtype=object),
+            "r": np.asarray(ssb_cols["lo_revenue"], np.float64),
+        }
+    )
+    want = df.groupby("c").r.sum().sort_values(ascending=False).head(10)
+    assert list(got.c_city) == list(want.index)
+    np.testing.assert_allclose(got.rev, want.values, rtol=2e-5)
+
+
+def test_groupby_granularity_year(ssb_ds, ssb_cols):
+    q = GroupByQuery(
+        datasource="ssb",
+        dimensions=(DimensionSpec("s_region"),),
+        aggregations=(Count("n"),),
+        granularity="year",
+    )
+    got = Engine().execute(q, ssb_ds)
+    t = np.asarray(ssb_cols["lo_orderdate"]).astype("datetime64[ms]")
+    df = pd.DataFrame(
+        {
+            "y": t.astype("datetime64[Y]"),
+            "r": np.asarray(ssb_cols["s_region"], dtype=object),
+        }
+    )
+    want = df.groupby(["y", "r"]).size().reset_index(name="n")
+    got = got.sort_values(["timestamp", "s_region"]).reset_index(drop=True)
+    want = want.sort_values(["y", "r"]).reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got.n, want.n)
