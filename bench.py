@@ -1,0 +1,130 @@
+"""Benchmark driver: TPC-H Q1 (SF1) end-to-end on the local device.
+
+BASELINE config #1 — "TPC-H Q1 single-table GROUP BY (sum/avg/count on
+lineitem, SF1)".  Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` compares against a single-threaded pandas/numpy groupby of the
+same query on the same host — the stand-in for the reference's
+"Spark-on-Parquet without acceleration" baseline (the reference's own Druid
+numbers are unavailable: empty reference mount, see SURVEY.md §0/§6).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from spark_druid_olap_tpu.catalog.segment import build_datasource
+    from spark_druid_olap_tpu.exec.engine import Engine
+    from spark_druid_olap_tpu.models.aggregations import (
+        Count,
+        DoubleSum,
+        ExpressionAgg,
+    )
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.filters import Bound
+    from spark_druid_olap_tpu.models.query import GroupByQuery
+    from spark_druid_olap_tpu.plan.expr import col
+    from spark_druid_olap_tpu.utils import datagen
+
+    scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+    cols = datagen.gen_lineitem(scale=scale, seed=0)
+    n_rows = len(cols["l_quantity"])
+
+    ds = build_datasource(
+        "tpch",
+        cols,
+        dimension_cols=datagen.LINEITEM_DIMS,
+        metric_cols=["l_quantity", "l_extendedprice", "l_discount", "l_tax"],
+        time_col="l_shipdate",
+        rows_per_segment=1 << 23,
+    )
+
+    cutoff = (
+        np.datetime64("1998-09-02").astype("datetime64[D]").astype(int) + 1
+    ) * 86_400_000
+    q = GroupByQuery(
+        datasource="tpch",
+        dimensions=(
+            DimensionSpec("l_returnflag"),
+            DimensionSpec("l_linestatus"),
+        ),
+        aggregations=(
+            DoubleSum("sum_qty", "l_quantity"),
+            DoubleSum("sum_base_price", "l_extendedprice"),
+            ExpressionAgg(
+                "sum_disc_price",
+                col("l_extendedprice") * (1 - col("l_discount")),
+            ),
+            ExpressionAgg(
+                "sum_charge",
+                col("l_extendedprice")
+                * (1 - col("l_discount"))
+                * (1 + col("l_tax")),
+            ),
+            DoubleSum("sum_disc", "l_discount"),
+            Count("count_order"),
+        ),
+        filter=Bound("l_shipdate", upper=str(int(cutoff)), ordering="numeric"),
+    )
+
+    eng = Engine()
+    out = eng.execute(q, ds)  # warmup: compile + device transfer
+    assert len(out) == 6, out
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        eng.execute(q, ds)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    rows_per_sec = n_rows / p50
+
+    # pandas oracle baseline (single-threaded host groupby, float64)
+    import pandas as pd
+
+    t0 = time.perf_counter()
+    m = cols["l_shipdate"] <= cutoff
+    df = pd.DataFrame(
+        {
+            "f": cols["l_returnflag"][m],
+            "s": cols["l_linestatus"][m],
+            "q": cols["l_quantity"][m].astype(np.float64),
+            "p": cols["l_extendedprice"][m].astype(np.float64),
+            "d": cols["l_discount"][m].astype(np.float64),
+            "t": cols["l_tax"][m].astype(np.float64),
+        }
+    )
+    df["dp"] = df.p * (1 - df.d)
+    df["ch"] = df.dp * (1 + df.t)
+    df.groupby(["f", "s"]).agg(
+        {"q": "sum", "p": "sum", "dp": "sum", "ch": "sum", "d": "sum"}
+    )
+    pandas_time = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_q1_sf%g_rows_per_sec_per_chip" % scale,
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(pandas_time / p50, 2),
+                "detail": {
+                    "p50_s": round(p50, 5),
+                    "pandas_baseline_s": round(pandas_time, 5),
+                    "device": str(jax.devices()[0]),
+                    "rows": n_rows,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -241,6 +241,218 @@ def _lower_aggs(
 
 
 # ---------------------------------------------------------------------------
+# Query lowering (shared by the local engine and parallel/distributed.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GroupByLowering:
+    """A GroupByQuery lowered to device-executable pieces:
+
+    * `columns` — physical columns to fetch per segment
+    * `row_arrays(cols)` — pure, jit/shard_map-traceable row-wise kernel
+      producing (gid, mask, sum_values, minmax_values, minmax_masks)
+    * `dims` / `la` / `num_groups` — the finalization contract
+    """
+
+    query: Q.GroupByQuery
+    dims: List[ResolvedDim]
+    la: LoweredAggs
+    num_groups: int
+    columns: List[str]
+    filter_fn: Optional[Callable]
+    vcol_fns: Dict[str, Callable]
+
+    def add_virtual(self, cols: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        for name, fn in self.vcol_fns.items():
+            if name not in cols:
+                cols[name] = jnp.asarray(fn(cols))
+        return cols
+
+    def row_mask(self, cols) -> jnp.ndarray:
+        mask = cols["__valid"]
+        q = self.query
+        if q.intervals:
+            t = cols["__time"]
+            im = jnp.zeros(t.shape, jnp.bool_)
+            for a, b in q.intervals:
+                im = im | ((t >= a) & (t < b))
+            mask = mask & im
+        if self.filter_fn is not None:
+            mask = mask & self.filter_fn(cols)
+        return mask
+
+    def row_arrays(self, cols: Dict[str, jnp.ndarray]):
+        """cols: name -> row-aligned device array (must include "__valid",
+        and "__time" when the query touches time).  Returns the kernel ABI
+        tuple for ops/groupby.py."""
+        cols = dict(cols)
+        self.add_virtual(cols)
+        mask = self.row_mask(cols)
+        la = self.la
+        gid, _ = combine_group_ids(
+            [d.codes_fn(cols) for d in self.dims],
+            [d.cardinality for d in self.dims],
+        )
+        if not self.dims:
+            gid = jnp.zeros(mask.shape, jnp.int32)
+        R = mask.shape[0]
+        maskf = mask.astype(jnp.float32)
+        sum_cols = []
+        for n in la.sum_names:
+            base = la.value_fns[n](cols) if la.value_fns[n] is not None else None
+            v = maskf if base is None else base * maskf
+            mfn = la.mask_fns.get(n)
+            if mfn is not None:
+                v = v * mfn(cols).astype(jnp.float32)
+            sum_cols.append(v)
+        sum_values = jnp.stack(sum_cols, axis=1)
+        mm_names = la.min_names + la.max_names
+        if mm_names:
+            mm_vals, mm_masks = [], []
+            for n in mm_names:
+                mm_vals.append(la.value_fns[n](cols))
+                mfn = la.mask_fns.get(n)
+                mm_masks.append(
+                    mfn(cols) if mfn is not None else jnp.ones((R,), jnp.bool_)
+                )
+            minmax_values = jnp.stack(mm_vals, axis=1)
+            minmax_masks = jnp.stack(mm_masks, axis=1)
+        else:
+            minmax_values = jnp.zeros((R, 0), jnp.float32)
+            minmax_masks = jnp.zeros((R, 0), jnp.bool_)
+        return gid, mask, sum_values, minmax_values, minmax_masks
+
+
+def schema_signature(ds: DataSource) -> Tuple:
+    """Identity of a datasource's schema for program caches: name + per-column
+    kind/cardinality + segment ids.  Two datasources with the same signature
+    lower to the same XLA program shape."""
+    return (
+        ds.name,
+        tuple((c.name, c.kind, c.cardinality) for c in ds.columns),
+        tuple(s.segment_id for s in ds.segments),
+    )
+
+
+def timeseries_to_groupby(q: Q.TimeseriesQuery) -> Q.GroupByQuery:
+    """Shared Timeseries->GroupBy rewrite (a Timeseries is a GroupBy whose
+    only dimension is the time bucket) — used by both engines so semantics
+    cannot drift."""
+    return Q.GroupByQuery(
+        datasource=q.datasource,
+        dimensions=(
+            DimensionSpec("__time", "timestamp", granularity=q.granularity),
+        ),
+        aggregations=q.aggregations,
+        post_aggregations=q.post_aggregations,
+        filter=q.filter,
+        intervals=q.intervals,
+        virtual_columns=q.virtual_columns,
+    )
+
+
+def finalize_timeseries(df, q: Q.TimeseriesQuery, ds: DataSource):
+    """Shared Timeseries finalization: empty-bucket zero-fill + ordering."""
+    import pandas as pd
+
+    if not q.skip_empty_buckets:
+        iv = q.intervals[0] if q.intervals else ds.interval()
+        if iv is not None:
+            lo = min(a for a, _ in q.intervals) if q.intervals else iv[0]
+            hi = max(b for _, b in q.intervals) if q.intervals else iv[1]
+            all_buckets = bucket_starts(lo, hi, q.granularity).astype(
+                "datetime64[ms]"
+            )
+            df = (
+                df.set_index("timestamp")
+                .reindex(pd.Index(all_buckets, name="timestamp"))
+                .reset_index()
+            )
+            for a in q.aggregations:
+                if a.merge_op == "psum" and a.name in df:
+                    filled = df[a.name].fillna(0)
+                    if df[a.name].dtype.kind in ("i", "u"):
+                        filled = filled.astype(np.int64)
+                    df[a.name] = filled
+    df = df.sort_values("timestamp", ascending=not q.descending)
+    return df.reset_index(drop=True)
+
+
+def topn_to_groupby(q: Q.TopNQuery) -> Q.GroupByQuery:
+    """Shared TopN->GroupBy rewrite (exact TopN: full groupby then rank;
+    Druid's native TopN is approximate — ours is exact and still one kernel)."""
+    return Q.GroupByQuery(
+        datasource=q.datasource,
+        dimensions=(q.dimension,),
+        aggregations=q.aggregations,
+        post_aggregations=q.post_aggregations,
+        filter=q.filter,
+        intervals=q.intervals,
+        granularity=q.granularity,
+        virtual_columns=q.virtual_columns,
+    )
+
+
+def finalize_topn(df, q: Q.TopNQuery):
+    """Shared TopN ranking, including per-bucket ranking under a non-'all'
+    granularity."""
+    df = df.sort_values(q.metric, ascending=not q.descending, kind="stable")
+    if q.granularity not in ("all", None):
+        df = (
+            df.groupby("timestamp", sort=True, group_keys=False)
+            .head(q.threshold)
+            .sort_values(
+                ["timestamp", q.metric],
+                ascending=[True, not q.descending],
+                kind="stable",
+            )
+        )
+        return df.reset_index(drop=True)
+    return df.head(q.threshold).reset_index(drop=True)
+
+
+def lower_groupby(q: Q.GroupByQuery, ds: DataSource) -> GroupByLowering:
+    dims = _resolve_dims(q.dimensions, ds, q.intervals)
+    la = _lower_aggs(q.aggregations, ds)
+    G = 1
+    for d in dims:
+        G *= d.cardinality
+    if G > (1 << 26):
+        raise ValueError(
+            f"combined group cardinality {G} too large for dense domain; "
+            "sort-based path not yet wired for this size"
+        )
+    filter_fn = compile_filter(q.filter, ds) if q.filter is not None else None
+    vcol_fns = {v.name: compile_expr(v.expression) for v in q.virtual_columns}
+    return GroupByLowering(
+        q, dims, la, G, _needed_columns(q, ds, dims), filter_fn, vcol_fns
+    )
+
+
+def _needed_columns(q, ds: DataSource, dims) -> List[str]:
+    names: List[str] = []
+    for d in dims:
+        if d.spec.dimension != "__time" and d.spec.granularity is None:
+            names.append(d.spec.dimension)
+    for a in q.aggregations:
+        names.extend(_agg_columns(a))
+    if q.filter is not None:
+        names.extend(_filter_columns(q.filter))
+    for v in q.virtual_columns:
+        names.extend(v.expression.columns())
+    virt = {v.name for v in q.virtual_columns}
+    need = [n for n in dict.fromkeys(names) if n not in virt and n != "__time"]
+    if ds.time_column and (
+        any(d.spec.dimension == "__time" or d.spec.granularity for d in dims)
+        or q.intervals
+        or "__time" in names
+    ):
+        need.append(ds.time_column)
+    return need
+
+
+# ---------------------------------------------------------------------------
 # Post-aggregation / having / limit finalization (host-side, tiny)
 # ---------------------------------------------------------------------------
 
@@ -335,6 +547,11 @@ class Engine:
     def __init__(self, strategy: str = "auto"):
         self.strategy = strategy
         self._device_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
+        # (query-json, datasource, strategy) -> jitted per-segment program.
+        # One fused XLA program per query shape: without this, every eager op
+        # in the row pipeline is a separate device dispatch — ruinous when the
+        # TPU sits behind a network tunnel (hundreds of ms of pure latency).
+        self._query_fn_cache: Dict[Tuple[str, str, str], Callable] = {}
 
     # -- segment residency ---------------------------------------------------
 
@@ -374,31 +591,6 @@ class Engine:
 
     # -- groupby -------------------------------------------------------------
 
-    def _needed_columns(self, q, ds: DataSource, dims) -> List[str]:
-        names: List[str] = []
-        for d in dims:
-            if d.spec.dimension != "__time" and d.spec.granularity is None:
-                names.append(d.spec.dimension)
-        for a in q.aggregations:
-            names.extend(_agg_columns(a))
-        if q.filter is not None:
-            names.extend(_filter_columns(q.filter))
-        for v in q.virtual_columns:
-            names.extend(v.expression.columns())
-        virt = {v.name for v in q.virtual_columns}
-        need = [
-            n
-            for n in dict.fromkeys(names)
-            if n not in virt and n != "__time"
-        ]
-        if ds.time_column and (
-            any(d.spec.dimension == "__time" or d.spec.granularity for d in dims)
-            or q.intervals
-            or "__time" in names
-        ):
-            need.append(ds.time_column)
-        return need
-
     def _segments_in_scope(self, q, ds: DataSource) -> List[Segment]:
         """Segment pruning by interval — the analog of the reference narrowing
         the Druid query interval from time predicates (§3.2)."""
@@ -418,20 +610,9 @@ class Engine:
         """Compute merged partial state across local segments.
 
         Returns (dims, la, G, sums[G, Ms], mins, maxs, sketch_states)."""
-        dims = _resolve_dims(q.dimensions, ds, q.intervals)
-        la = _lower_aggs(q.aggregations, ds)
-        G = 1
-        for d in dims:
-            G *= d.cardinality
-        if G > (1 << 26):
-            raise ValueError(
-                f"combined group cardinality {G} too large for dense domain; "
-                "sort-based path not yet wired for this size"
-            )
-
-        filter_fn = compile_filter(q.filter, ds) if q.filter is not None else None
-        vcol_fns = {v.name: compile_expr(v.expression) for v in q.virtual_columns}
-        need = self._needed_columns(q, ds, dims)
+        lowering = lower_groupby(q, ds)
+        dims, la, G = lowering.dims, lowering.la, lowering.num_groups
+        need = lowering.columns
 
         sums = mins = maxs = None
         sketch_states: Dict[str, Any] = {}
@@ -453,92 +634,76 @@ class Engine:
                         (G, agg.size), SENTINEL, jnp.uint32
                     )
             return dims, la, G, sums, mins, maxs, sketch_states
+        seg_fn = self._segment_program(q, ds, lowering)
         for seg in segs:
             cols = self._device_cols(seg, need)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]
-            for name, fn in vcol_fns.items():
-                cols[name] = jnp.asarray(fn(cols))
-            mask = cols["__valid"]
-            if q.intervals:
-                t = cols["__time"]
-                im = jnp.zeros(t.shape, jnp.bool_)
-                for a, b in q.intervals:
-                    im = im | ((t >= a) & (t < b))
-                mask = mask & im
-            if filter_fn is not None:
-                mask = mask & filter_fn(cols)
-
-            gid, _ = combine_group_ids(
-                [d.codes_fn(cols) for d in dims], [d.cardinality for d in dims]
-            )
-            if not dims:
-                gid = jnp.zeros(mask.shape, jnp.int32)
-
-            R = mask.shape[0]
-            maskf = mask.astype(jnp.float32)
-            sum_cols = []
-            for n in la.sum_names:
-                base = la.value_fns[n](
-                    {**cols}
-                ) if la.value_fns[n] is not None else None
-                v = maskf if base is None else base * maskf
-                mfn = la.mask_fns.get(n)
-                if mfn is not None:
-                    v = v * mfn(cols).astype(jnp.float32)
-                sum_cols.append(v)
-            sum_values = jnp.stack(sum_cols, axis=1)
-
-            mm_names = la.min_names + la.max_names
-            if mm_names:
-                mm_vals, mm_masks = [], []
-                for n in mm_names:
-                    mm_vals.append(la.value_fns[n](cols))
-                    mfn = la.mask_fns.get(n)
-                    mm_masks.append(
-                        mfn(cols) if mfn is not None
-                        else jnp.ones((R,), jnp.bool_)
-                    )
-                minmax_values = jnp.stack(mm_vals, axis=1)
-                minmax_masks = jnp.stack(mm_masks, axis=1)
-            else:
-                minmax_values = jnp.zeros((R, 0), jnp.float32)
-                minmax_masks = jnp.zeros((R, 0), jnp.bool_)
-
-            s, mn, mx = partial_aggregate(
-                gid,
-                mask,
-                sum_values,
-                minmax_values,
-                minmax_masks,
-                num_groups=G,
-                num_min=len(la.min_names),
-                num_max=len(la.max_names),
-                strategy=self.strategy,
-            )
+            s, mn, mx, sk = seg_fn(cols)
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
-
             for agg in la.sketch_aggs:
-                from ..ops import hll as hll_ops
                 from ..ops import theta as theta_ops
 
-                if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
-                    st = hll_ops.partial_hll(agg, cols, gid, mask, G)
-                    prev = sketch_states.get(agg.name)
-                    sketch_states[agg.name] = (
-                        st if prev is None else jnp.maximum(prev, st)
-                    )
-                elif isinstance(agg, A.ThetaSketch):
-                    st = theta_ops.partial_theta(agg, cols, gid, mask, G)
-                    prev = sketch_states.get(agg.name)
-                    sketch_states[agg.name] = (
-                        st
-                        if prev is None
-                        else theta_ops.merge_states(prev, st, agg.size)
+                st = sk[agg.name]
+                prev = sketch_states.get(agg.name)
+                if prev is None:
+                    sketch_states[agg.name] = st
+                elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+                    sketch_states[agg.name] = jnp.maximum(prev, st)
+                else:
+                    sketch_states[agg.name] = theta_ops.merge_states(
+                        prev, st, agg.size
                     )
         return dims, la, G, sums, mins, maxs, sketch_states
+
+    def _segment_program(
+        self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
+    ) -> Callable:
+        """One fused, cached XLA program per query: row pipeline (virtual
+        columns, filter mask, group ids) + partial aggregation + sketch
+        partials in a single dispatch.  The analog of Druid compiling a query
+        into one engine pass per segment."""
+        import json as _json
+
+        key = (
+            _json.dumps(q.to_druid(), sort_keys=True, default=str),
+            schema_signature(ds),  # a re-ingested datasource (new dict
+            # cardinalities => new G) must not reuse a stale program
+            self.strategy,
+        )
+        if key in self._query_fn_cache:
+            return self._query_fn_cache[key]
+        la, G = lowering.la, lowering.num_groups
+        strategy = self.strategy
+
+        from ..ops import hll as hll_ops
+        from ..ops import theta as theta_ops
+
+        @jax.jit
+        def seg_fn(cols):
+            cols = lowering.add_virtual(dict(cols))  # sketches read virtuals
+            gid, mask, sv, mmv, mmm = lowering.row_arrays(cols)
+            s, mn, mx = partial_aggregate(
+                gid, mask, sv, mmv, mmm,
+                num_groups=G,
+                num_min=len(la.min_names),
+                num_max=len(la.max_names),
+                strategy=strategy,
+            )
+            sk = {}
+            for agg in la.sketch_aggs:
+                if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+                    sk[agg.name] = hll_ops.partial_hll(agg, cols, gid, mask, G)
+                else:
+                    sk[agg.name] = theta_ops.partial_theta(
+                        agg, cols, gid, mask, G
+                    )
+            return s, mn, mx, sk
+
+        self._query_fn_cache[key] = seg_fn
+        return seg_fn
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
         # Druid semantics: a non-"all" granularity on GroupBy adds an implicit
@@ -567,72 +732,14 @@ class Engine:
     # -- timeseries: a groupby whose only dimension is the time bucket -------
 
     def _execute_timeseries(self, q: Q.TimeseriesQuery, ds: DataSource):
-        gq = Q.GroupByQuery(
-            datasource=q.datasource,
-            dimensions=(
-                DimensionSpec("__time", "__bucket", granularity=q.granularity),
-            ),
-            aggregations=q.aggregations,
-            post_aggregations=q.post_aggregations,
-            filter=q.filter,
-            intervals=q.intervals,
-            virtual_columns=q.virtual_columns,
-        )
-        df = self._execute_groupby(gq, ds)
-        df = df.rename(columns={"__bucket": "timestamp"})
-        if not q.skip_empty_buckets:
-            # Druid skipEmptyBuckets=false: emit zero rows for empty buckets.
-            iv = q.intervals[0] if q.intervals else ds.interval()
-            if iv is not None:
-                lo = min(a for a, _ in q.intervals) if q.intervals else iv[0]
-                hi = max(b for _, b in q.intervals) if q.intervals else iv[1]
-                all_buckets = bucket_starts(lo, hi, q.granularity).astype(
-                    "datetime64[ms]"
-                )
-                import pandas as pd
-
-                df = (
-                    df.set_index("timestamp")
-                    .reindex(pd.Index(all_buckets, name="timestamp"))
-                    .reset_index()
-                )
-                for a in q.aggregations:
-                    if a.merge_op == "psum" and a.name in df:
-                        filled = df[a.name].fillna(0)
-                        if df[a.name].dtype.kind in ("i", "u"):
-                            filled = filled.astype(np.int64)
-                        df[a.name] = filled
-        df = df.sort_values("timestamp", ascending=not q.descending)
-        return df.reset_index(drop=True)
+        df = self._execute_groupby(timeseries_to_groupby(q), ds)
+        return finalize_timeseries(df, q, ds)
 
     # -- topn: single-dim groupby + rank (exact; Druid's is approximate) -----
 
     def _execute_topn(self, q: Q.TopNQuery, ds: DataSource):
-        gq = Q.GroupByQuery(
-            datasource=q.datasource,
-            dimensions=(q.dimension,),
-            aggregations=q.aggregations,
-            post_aggregations=q.post_aggregations,
-            filter=q.filter,
-            intervals=q.intervals,
-            granularity=q.granularity,
-            virtual_columns=q.virtual_columns,
-        )
-        df = self._execute_groupby(gq, ds)
-        df = df.sort_values(q.metric, ascending=not q.descending, kind="stable")
-        if q.granularity not in ("all", None):
-            # per-bucket topN: rank within each time bucket
-            df = (
-                df.groupby("timestamp", sort=True, group_keys=False)
-                .head(q.threshold)
-                .sort_values(
-                    ["timestamp", q.metric],
-                    ascending=[True, not q.descending],
-                    kind="stable",
-                )
-            )
-            return df.reset_index(drop=True)
-        return df.head(q.threshold).reset_index(drop=True)
+        df = self._execute_groupby(topn_to_groupby(q), ds)
+        return finalize_topn(df, q)
 
     # -- scan / search -------------------------------------------------------
 

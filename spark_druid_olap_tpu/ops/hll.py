@@ -74,8 +74,10 @@ def _fold_registers(h, gid, mask, num_groups, p):
     m = 1 << p
     bucket = (h & jnp.uint32(m - 1)).astype(jnp.int32)
     rho = _rho(h, p)
-    rho = jnp.where(mask, rho, 0)
-    idx = jnp.where(mask, gid * m + bucket, num_groups * m)  # trash slot
+    # group-sharded callers pass shifted gids that may fall outside [0, G)
+    ok = mask & (gid >= 0) & (gid < num_groups)
+    rho = jnp.where(ok, rho, 0)
+    idx = jnp.where(ok, gid * m + bucket, num_groups * m)  # trash slot
     regs = jax.ops.segment_max(
         rho, idx, num_segments=num_groups * m + 1
     )[: num_groups * m]

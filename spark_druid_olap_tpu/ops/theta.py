@@ -37,8 +37,10 @@ SENTINEL = jnp.uint32(0xFFFFFFFF)
 def _bottom_k(h: jnp.ndarray, gid: jnp.ndarray, mask: jnp.ndarray,
               num_groups: int, k: int) -> jnp.ndarray:
     R = h.shape[0]
-    g = jnp.where(mask, gid, num_groups)  # masked rows to trash group
-    hh = jnp.where(mask, h, SENTINEL)
+    # group-sharded callers pass shifted gids that may fall outside [0, G)
+    ok = mask & (gid >= 0) & (gid < num_groups)
+    g = jnp.where(ok, gid, num_groups)  # masked rows to trash group
+    hh = jnp.where(ok, h, SENTINEL)
     # sort by (group, hash) — jnp.lexsort: last key is primary
     order = jnp.lexsort((hh, g))
     gs = g[order]

@@ -1,0 +1,54 @@
+"""Device mesh construction for distributed OLAP aggregation.
+
+Reference parity: the reference's "cluster" is Druid's broker + historicals
+discovered via ZooKeeper (SURVEY.md §2 ZK-discovery row `[U]`); its
+parallelism is one Spark partition per (historical, segment-group).  The
+TPU-native equivalent is a `jax.sharding.Mesh` whose axes carry the two ways
+an aggregation can be decomposed:
+
+* ``data``   — row/segment shards (the historicals-analog; DP/SP axis).  Each
+  device aggregates its rows; partial states merge with `psum`/`pmin`/`pmax`
+  over ICI.
+* ``groups`` — group-domain shards (the TP-analog).  Each device owns a slice
+  of the group-id domain [0, G); useful when G is large enough that the
+  one-hot block or the sketch state per group dominates memory.
+
+Discovery is the JAX runtime (`jax.distributed` across hosts) — no ZooKeeper.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+GROUPS_AXIS = "groups"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_groups: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a (data, groups) mesh.  Defaults to all devices on the data
+    axis.  With multi-host meshes the data axis should map to the
+    DCN-connected dimension and groups to ICI (group-state merges are the
+    bandwidth-heavy collective)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devs) // n_groups
+    if n_data * n_groups != len(devs):
+        devs = devs[: n_data * n_groups]
+    arr = np.array(devs).reshape(n_data, n_groups)
+    return Mesh(arr, (DATA_AXIS, GROUPS_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

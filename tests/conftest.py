@@ -4,13 +4,19 @@ never had — its tests demanded a live Druid cluster; ours demand nothing)."""
 
 import os
 
-if os.environ.get("SDOL_TEST_TPU") != "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The TPU plugin (axon) registers itself from sitecustomize at interpreter
+# startup, so jax is already imported and env-var overrides are too late —
+# switch platform via jax.config before any backend initializes.
+import jax
+
+if os.environ.get("SDOL_TEST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
