@@ -18,4 +18,19 @@ import jax as _jax
 # are explicitly f32/int32, so TPU compute is unaffected.
 _jax.config.update("jax_enable_x64", True)
 
+from .api import (  # noqa: E402,F401
+    TPUOlapContext,
+    default_context,
+    explain,
+    register_table,
+    sql,
+    table,
+)
+from .catalog.star import (  # noqa: E402,F401
+    FunctionalDependency,
+    StarRelationInfo,
+    StarSchemaInfo,
+)
+from .config import SessionConfig, TableOptions  # noqa: E402,F401
+
 __version__ = "0.1.0"

@@ -1,0 +1,334 @@
+"""User-facing surface: register tables, run SQL, explain rewrites.
+
+Reference parity (SURVEY.md L6 `[U]`): the reference's surface is
+`CREATE TEMPORARY TABLE ... USING org.sparklinedata.druid OPTIONS(...)` +
+ordinary Spark SQL with `DruidPlanner` strategies injected, plus the
+`EXPLAIN DRUID REWRITE` command.  Here:
+
+    import spark_druid_olap_tpu as sd
+    ctx = sd.TPUOlapContext()
+    ctx.register_table("lineitem", cols, dimensions=[...], metrics=[...],
+                       time_column="l_shipdate", star_schema=...)
+    df  = ctx.sql("SELECT l_returnflag, sum(l_quantity) FROM lineitem "
+                  "GROUP BY l_returnflag")
+    print(ctx.explain("SELECT ..."))      # EXPLAIN DRUID REWRITE analog
+    ctx.clear_cache()                      # clear-metadata-cache analog
+
+Execution routes through the planner's PhysicalPlan: local Engine or
+DistributedEngine (mesh), with grouping-set (CUBE/ROLLUP) expansion and
+host-side residual having/projection evaluation handled here — the
+"projection fixup over the scan node" role of the reference's DruidStrategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .catalog.cache import MetadataCache
+from .catalog.segment import DataSource, build_datasource
+from .catalog.star import StarSchemaInfo
+from .config import SessionConfig, TableOptions
+from .exec.engine import Engine
+from .models import query as Q
+from .plan import expr as E
+from .plan import logical as L
+from .plan.planner import Planner, Rewrite, RewriteError
+from .sql.parser import parse_sql
+
+
+class TPUOlapContext:
+    def __init__(self, config: Optional[SessionConfig] = None):
+        self.config = config or SessionConfig()
+        self.catalog = MetadataCache()
+        self.engine = Engine()
+        self._dist_engine = None
+
+    # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
+
+    def register_table(
+        self,
+        name: str,
+        source,
+        dimensions: Sequence[str] = (),
+        metrics: Sequence[str] = (),
+        time_column: Optional[str] = None,
+        star_schema: Optional[StarSchemaInfo] = None,
+        column_mapping: Optional[Mapping[str, str]] = None,
+        rows_per_segment: int = 1 << 22,
+    ) -> DataSource:
+        """Register a datasource from a pandas DataFrame, a dict of numpy
+        columns, or a parquet/csv path (catalog/ingest.py)."""
+        from .catalog.ingest import to_columns
+
+        cols = to_columns(source)
+        if column_mapping:
+            cols = {column_mapping.get(k, k): v for k, v in cols.items()}
+        if not dimensions and not metrics:
+            dimensions, metrics = _infer_schema(cols, time_column)
+        ds = build_datasource(
+            name,
+            cols,
+            dimension_cols=list(dimensions),
+            metric_cols=list(metrics),
+            time_col=time_column,
+            rows_per_segment=rows_per_segment,
+        )
+        if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
+            star_schema = StarSchemaInfo.from_json(star_schema)
+        self.catalog.put(ds, star_schema)
+        return ds
+
+    def drop_table(self, name: str):
+        self.catalog.drop(name)
+
+    def clear_cache(self):
+        """Reference's clear-metadata-cache command + HBM residency drop."""
+        self.catalog.clear()
+        self.engine.clear_cache()
+        if self._dist_engine is not None:
+            self._dist_engine.clear_cache()
+
+    # -- planning ------------------------------------------------------------
+
+    def _planner(self) -> Planner:
+        import jax
+
+        return Planner(self.catalog, self.config, n_devices=len(jax.devices()))
+
+    def plan_sql(self, sql_text: str) -> Rewrite:
+        lp, _, _ = parse_sql(sql_text)
+        return self._planner().plan(lp)
+
+    def explain(self, sql_text: str) -> str:
+        """EXPLAIN DRUID REWRITE analog: logical plan -> chosen query spec
+        JSON -> physical plan."""
+        lp, _, _ = parse_sql(sql_text)
+        return self._planner().explain(lp)
+
+    # -- execution -----------------------------------------------------------
+
+    def sql(self, sql_text: str):
+        lp, explain, out_names = parse_sql(sql_text)
+        planner = self._planner()
+        if explain:
+            import pandas as pd
+
+            return pd.DataFrame({"plan": planner.explain(lp).split("\n")})
+        rw = planner.plan(lp)
+        return self.execute_rewrite(rw)
+
+    def execute_rewrite(self, rw: Rewrite):
+        import pandas as pd
+
+        ds = self.catalog.get(rw.datasource)
+        if ds is None:
+            raise RewriteError(f"unknown table {rw.datasource!r}")
+        engine = self._engine_for(rw)
+
+        if rw.grouping_sets and isinstance(rw.query, Q.GroupByQuery):
+            df = self._execute_grouping_sets(rw, ds, engine)
+        else:
+            df = engine.execute(rw.query, ds)
+
+        # host-side residuals (the DruidStrategy projection-fixup analog)
+        for name, e in rw.host_post_exprs:
+            df[name] = _eval_host(e, df)
+        if rw.residual_having is not None:
+            mask = np.asarray(_eval_host(rw.residual_having, df), dtype=bool)
+            df = df[mask].reset_index(drop=True)
+        if rw.output_columns:
+            cols = [c for c in rw.output_columns if c in df.columns]
+            extra = [c for c in df.columns if c not in cols and c == "__grouping_id"]
+            df = df[cols + extra]
+        return df
+
+    def _execute_grouping_sets(self, rw: Rewrite, ds, engine):
+        """CUBE/ROLLUP/GROUPING SETS: one kernel pass per set, absent
+        dimensions emitted as nulls, plus a __grouping_id bitmask (SQL
+        GROUPING_ID semantics: bit i set => dim i aggregated away)."""
+        import pandas as pd
+
+        q = rw.query
+        assert isinstance(q, Q.GroupByQuery)
+        all_dims = q.dimensions
+        frames = []
+        k = len(all_dims)
+        for s in rw.grouping_sets:
+            dims = tuple(all_dims[i] for i in s)
+            sub = dataclasses.replace(q, dimensions=dims, subtotals=())
+            f = engine.execute(sub, ds)
+            gid = 0
+            present = set(s)
+            for i in range(k):
+                if i not in present:
+                    gid |= 1 << (k - 1 - i)
+                    f[all_dims[i].name] = None
+            f["__grouping_id"] = gid
+            frames.append(f)
+        df = pd.concat(frames, ignore_index=True)
+        order = [d.name for d in all_dims]
+        rest = [c for c in df.columns if c not in order]
+        return df[order + rest]
+
+    def _engine_for(self, rw: Rewrite):
+        phys = rw.physical
+        if phys.distributed and phys.mesh_shape is not None:
+            import jax
+
+            if len(jax.devices()) >= phys.mesh_shape[0] * phys.mesh_shape[1]:
+                if self._dist_engine is None:
+                    from .parallel.distributed import DistributedEngine
+                    from .parallel.mesh import make_mesh
+
+                    self._dist_engine = DistributedEngine(
+                        mesh=make_mesh(*phys.mesh_shape)
+                    )
+                return self._dist_engine
+        if self.engine.strategy != phys.strategy:
+            self.engine.strategy = phys.strategy
+            # strategy participates in the engine's program cache key, so
+            # flipping it is safe (distinct cache entries)
+        return self.engine
+
+    # -- DataFrame-ish builder (the reference's "sourceDataframe" analog) ----
+
+    def table(self, name: str) -> "TableQuery":
+        return TableQuery(self, name)
+
+
+def _eval_host(e: E.Expr, df) -> np.ndarray:
+    """Evaluate a residual expression over the result table (aggregate
+    outputs / dimensions) host-side — tiny data, numpy semantics."""
+    from .plan.expr import compile_expr
+
+    cols = {c: np.asarray(df[c]) for c in df.columns}
+    fn = compile_expr(_aggref_to_col(e))
+    return np.asarray(fn(cols))
+
+
+def _aggref_to_col(e: E.Expr) -> E.Expr:
+    if isinstance(e, E.AggRef):
+        return E.Col(e.name)
+    if isinstance(e, (E.Literal, E.Col)):
+        return e
+    kw = {}
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr):
+            kw[f.name] = _aggref_to_col(v)
+        elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+            kw[f.name] = tuple(_aggref_to_col(x) for x in v)
+        else:
+            kw[f.name] = v
+    return type(e)(**kw)
+
+
+def _infer_schema(cols, time_column):
+    dims, mets = [], []
+    for k, v in cols.items():
+        if k == time_column:
+            continue
+        arr = np.asarray(v)
+        if arr.dtype.kind in ("U", "S", "O"):
+            dims.append(k)
+        else:
+            mets.append(k)
+    return dims, mets
+
+
+# ---------------------------------------------------------------------------
+# Fluent DataFrame-style query builder
+# ---------------------------------------------------------------------------
+
+
+class TableQuery:
+    """Small fluent API over the same planner (groupBy/agg/filter/orderBy),
+    the analog of driving the reference through DataFrames instead of SQL."""
+
+    def __init__(self, ctx: TPUOlapContext, table: str):
+        self.ctx = ctx
+        self._table = table
+        self._filter: Optional[E.Expr] = None
+        self._groups: List[Tuple[str, E.Expr]] = []
+        self._aggs: List[L.AggExpr] = []
+        self._sort: List[L.SortKey] = []
+        self._limit: Optional[int] = None
+
+    def filter(self, e: E.Expr) -> "TableQuery":
+        self._filter = e if self._filter is None else E.BoolOp(
+            "and", (self._filter, e)
+        )
+        return self
+
+    def group_by(self, *exprs) -> "TableQuery":
+        for x in exprs:
+            e = E.Col(x) if isinstance(x, str) else x
+            name = x if isinstance(x, str) else str(e)
+            self._groups.append((name, e))
+        return self
+
+    def agg(self, **named) -> "TableQuery":
+        """agg(total=("sum", "revenue"), n=("count", None), ...)"""
+        for name, spec in named.items():
+            fn, arg = spec if isinstance(spec, tuple) else (spec, None)
+            arg_e = E.Col(arg) if isinstance(arg, str) else arg
+            self._aggs.append(L.AggExpr(name, fn, arg_e))
+        return self
+
+    def order_by(self, name: str, ascending: bool = True) -> "TableQuery":
+        self._sort.append(L.SortKey(E.Col(name), ascending))
+        return self
+
+    def limit(self, n: int) -> "TableQuery":
+        self._limit = n
+        return self
+
+    def _logical(self) -> L.LogicalPlan:
+        base: L.LogicalPlan = L.Scan(self._table)
+        if self._filter is not None:
+            base = L.Filter(self._filter, base)
+        plan: L.LogicalPlan = L.Aggregate(
+            tuple(self._groups), tuple(self._aggs), base
+        )
+        if self._sort:
+            plan = L.Sort(tuple(self._sort), plan)
+        if self._limit is not None:
+            plan = L.Limit(self._limit, plan)
+        return plan
+
+    def collect(self):
+        rw = self.ctx._planner().plan(self._logical())
+        return self.ctx.execute_rewrite(rw)
+
+    def explain(self) -> str:
+        return self.ctx._planner().explain(self._logical())
+
+
+# module-level default context (the implicit SQLContext analog)
+_default_ctx: Optional[TPUOlapContext] = None
+
+
+def default_context() -> TPUOlapContext:
+    global _default_ctx
+    if _default_ctx is None:
+        _default_ctx = TPUOlapContext()
+    return _default_ctx
+
+
+def register_table(*a, **kw):
+    return default_context().register_table(*a, **kw)
+
+
+def sql(text: str):
+    return default_context().sql(text)
+
+
+def table(name: str) -> TableQuery:
+    return default_context().table(name)
+
+
+def explain(text: str) -> str:
+    return default_context().explain(text)

@@ -1,0 +1,59 @@
+"""Metadata cache — the registry of datasources and their stats.
+
+Reference parity: `DruidMetadataCache` (SURVEY.md §2 metadata-cache row `[U]`,
+expected `org/sparklinedata/druid/metadata/`): a process-wide cache of
+datasource schemas, segment lists and server assignments, refreshed from the
+Druid coordinator and guarded by JVM synchronization.  Locally there is no
+remote cluster: datasources are registered (ingested) into the cache, entries
+are immutable-by-construction (frozen dataclasses holding arrays nobody
+mutates — SURVEY.md §5 race-detection note), and the explicit `clear()`
+mirrors the reference's clear-metadata-cache SQL command.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .segment import DataSource
+from .star import StarSchemaInfo
+
+
+class MetadataCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tables: Dict[str, DataSource] = {}
+        self._stars: Dict[str, StarSchemaInfo] = {}
+
+    def put(self, ds: DataSource, star: Optional[StarSchemaInfo] = None):
+        with self._lock:
+            self._tables[ds.name] = ds
+            if star is not None:
+                self._stars[ds.name] = star
+
+    def get(self, name: str) -> Optional[DataSource]:
+        with self._lock:
+            return self._tables.get(name)
+
+    def star_schema(self, name: str) -> Optional[StarSchemaInfo]:
+        with self._lock:
+            return self._stars.get(name)
+
+    def star_schemas(self) -> Dict[str, StarSchemaInfo]:
+        with self._lock:
+            return dict(self._stars)
+
+    def tables(self):
+        with self._lock:
+            return list(self._tables)
+
+    def drop(self, name: str):
+        with self._lock:
+            self._tables.pop(name, None)
+            self._stars.pop(name, None)
+
+    def clear(self):
+        """The reference's clear-metadata-cache command analog."""
+        with self._lock:
+            self._tables.clear()
+            self._stars.clear()

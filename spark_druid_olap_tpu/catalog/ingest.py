@@ -1,0 +1,63 @@
+"""Ingest: external data -> host columns ready for dictionary encoding.
+
+Reference parity: the reference's data lives in Druid (indexed out-of-band);
+its `sourceDataframe` option points at the raw Spark table for parity checks
+(SURVEY.md §2 DefaultSource row `[U]`).  Our framework owns ingest: pandas
+DataFrames, dicts of numpy arrays, and parquet/CSV paths all normalize to a
+dict of row-aligned numpy columns; datetimes become int64 epoch-ms (the Druid
+time convention).  The C++ fast path for CSV decode + dictionary encoding
+lives in native/ (ctypes), with this pure-python fallback always available.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+
+def to_columns(source) -> Dict[str, np.ndarray]:
+    if isinstance(source, dict):
+        return {k: np.asarray(v) for k, v in source.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(source, pd.DataFrame):
+            return _from_pandas(source)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(source, str):
+        if source.endswith(".parquet"):
+            import pandas as pd
+
+            return _from_pandas(pd.read_parquet(source))
+        if source.endswith(".csv"):
+            return read_csv_columns(source)
+        raise ValueError(f"unsupported source path {source!r}")
+    raise TypeError(f"unsupported source type {type(source).__name__}")
+
+
+def _from_pandas(df) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for c in df.columns:
+        s = df[c]
+        if str(s.dtype).startswith("datetime64"):
+            out[c] = s.values.astype("datetime64[ms]").astype(np.int64)
+        elif s.dtype == object or str(s.dtype) in ("string", "category"):
+            out[c] = s.astype(object).values
+        else:
+            out[c] = s.values
+    return out
+
+
+def read_csv_columns(path: str) -> Dict[str, np.ndarray]:
+    """CSV -> columns.  Uses the native C++ decoder when built (native/),
+    else pandas."""
+    try:
+        from ..native import csv_decode  # ctypes binding
+
+        return csv_decode.read_csv(path)
+    except Exception:
+        import pandas as pd
+
+        return _from_pandas(pd.read_csv(path))

@@ -1,0 +1,82 @@
+"""Two-tier configuration: session flags + per-table options.
+
+Reference parity (SURVEY.md §5 config row `[U]`): the reference has (1)
+per-table options in `CREATE TABLE ... USING ... OPTIONS(...)` (DefaultSource
+row of SURVEY.md §2) and (2) session flags registered by `DruidPlanner` under
+SQLConf keys `spark.sparklinedata.druid.*` (rewrite enables, cost-model
+constants, max cardinality, smile encoding, historical-query toggles).  We
+mirror both tiers with dataclasses; option names keep the reference's
+vocabulary where a TPU equivalent exists, and each field documents the
+mapping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class SessionConfig:
+    """Session-wide planner/engine flags (the SQLConf analog)."""
+
+    # rewrite enables (reference: per-transform enable flags)
+    enable_rewrites: bool = True
+    enable_topn_rewrite: bool = True  # Sort+Limit -> TopN
+    enable_timeseries_rewrite: bool = True  # time-only groupby -> Timeseries
+    enable_join_collapse: bool = True  # star-schema join elimination
+
+    # approx-distinct mapping (reference: pushHLLTODruid / useApproxCountDistinct)
+    approx_count_distinct_sketch: str = "hll"  # "hll" | "theta"
+    hll_precision: int = 11
+    theta_size: int = 4096
+    # COUNT(DISTINCT x) handling: "approx" rewrites to a sketch (Druid
+    # default); "exact" uses the exact distinct path; "error" rejects.
+    count_distinct_mode: str = "approx"
+
+    # cost model (reference: DruidQueryCostModel constants via SQLConf)
+    cost_model_enabled: bool = True
+    dense_max_groups: int = 1 << 17  # dense one-hot vs scatter cutover
+    onehot_vmem_budget_mb: int = 32
+    cost_per_row_dense: float = 1.0  # relative per-row cost constants
+    cost_per_row_scatter: float = 8.0
+    cost_per_group_state: float = 0.5
+    collective_bytes_per_us: float = 100.0  # ICI bandwidth guess for planning
+
+    # result guards (reference: maxCardinality / maxResultCardinality)
+    max_result_cardinality: int = 1 << 22
+    # non-aggregate queries (reference: nonAggregateQueryHandling = push/scan)
+    non_aggregate_query_handling: str = "scan"  # "scan" | "error"
+
+    # distributed execution (reference: queryHistoricalServers,
+    # numSegmentsPerHistoricalQuery -> mesh shape decisions)
+    prefer_distributed: bool = False
+    mesh_data_axis: Optional[int] = None
+    mesh_groups_axis: int = 1
+
+
+@dataclasses.dataclass
+class TableOptions:
+    """Per-table registration options (the OPTIONS(...) map analog).
+
+    Reference option -> field mapping:
+      timeDimensionColumn      -> time_column
+      druidDatasource          -> (the registered name)
+      columnMapping            -> column_mapping
+      functionalDependencies   -> functional_dependencies (catalog/star.py)
+      starSchema               -> star_schema (catalog/star.py)
+      rows per segment/historical -> rows_per_segment
+      loadMetadataFromAllSegments -> eager_stats
+    """
+
+    time_column: Optional[str] = None
+    dimensions: Tuple[str, ...] = ()
+    metrics: Tuple[str, ...] = ()
+    column_mapping: Optional[dict] = None  # source col -> datasource col
+    rows_per_segment: int = 1 << 22
+    eager_stats: bool = True
+    star_schema: Optional[object] = None  # catalog.star.StarSchemaInfo
+    functional_dependencies: Tuple = ()
+
+
+DEFAULT_SESSION = SessionConfig()

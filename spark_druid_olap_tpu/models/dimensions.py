@@ -65,6 +65,19 @@ class SubstringExtraction(ExtractionFn):
 
 
 @dataclasses.dataclass(frozen=True)
+class CaseExtraction(ExtractionFn):
+    """UPPER/LOWER over a dimension — a pure dictionary rewrite."""
+
+    upper: bool
+
+    def to_druid(self):
+        return {"type": "upper" if self.upper else "lower"}
+
+    def apply_to_dict(self, values):
+        return [v.upper() if self.upper else v.lower() for v in values]
+
+
+@dataclasses.dataclass(frozen=True)
 class TimeFormatExtraction(ExtractionFn):
     """Druid `timeFormat` — used when grouping the time column by a calendar
     granularity that isn't a fixed millisecond period (month/quarter/year)."""

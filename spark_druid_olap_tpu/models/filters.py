@@ -165,13 +165,21 @@ class IntervalFilter(Filter):
         }
 
 
+_MIN_ISO_MS = -62135596800000  # 0001-01-01
+_MAX_ISO_MS = 253402300799999  # 9999-12-31
+
+
 def _ms_to_iso(ms: int) -> str:
+    """Integer-exact ISO-8601: float seconds lose the last millisecond near
+    the range ends, and strftime %Y does not zero-pad years < 1000."""
     import datetime
 
+    ms = max(_MIN_ISO_MS, min(int(ms), _MAX_ISO_MS))  # clamp open-bound sentinels
+    sec, frac = divmod(ms, 1000)  # Python floor-div: exact for negatives too
+    dt = datetime.datetime.fromtimestamp(sec, tz=datetime.timezone.utc)
     return (
-        datetime.datetime.fromtimestamp(ms / 1000.0, tz=datetime.timezone.utc)
-        .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3]
-        + "Z"
+        f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+        f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}.{frac:03d}Z"
     )
 
 

@@ -98,16 +98,31 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
 
             return bound_dict
 
+        from ..utils.floatcmp import f32_adjusted_compare
+
         lo = float(f.lower) if f.lower is not None else None
         hi = float(f.upper) if f.upper is not None else None
+        # f32-exact comparators precompiled once (shared helper with expr.py);
+        # the f64 fallback handles int64 columns (time ms exceeds f32 precision)
+        lo_op = ">" if f.lower_strict else ">="
+        hi_op = "<" if f.upper_strict else "<="
+        lo32 = f32_adjusted_compare(lo_op, lo) if lo is not None else None
+        hi32 = f32_adjusted_compare(hi_op, hi) if hi is not None else None
 
         def bound_num(cols, lo=lo, hi=hi, f=f, dim=dim):
             c = cols[dim]
+            is_f32 = c.dtype == jnp.float32
             m = jnp.ones(c.shape, jnp.bool_)
             if lo is not None:
-                m = m & ((c > lo) if f.lower_strict else (c >= lo))
+                m = m & (
+                    lo32(c) if is_f32
+                    else ((c > lo) if f.lower_strict else (c >= lo))
+                )
             if hi is not None:
-                m = m & ((c < hi) if f.upper_strict else (c <= hi))
+                m = m & (
+                    hi32(c) if is_f32
+                    else ((c < hi) if f.upper_strict else (c <= hi))
+                )
             return m
 
         return bound_num

@@ -1,0 +1,119 @@
+"""Cost model: execution-strategy choice for a lowered query.
+
+Reference parity: `DruidQueryCostModel` (SURVEY.md §2 `[U]`, expected
+`org/apache/spark/sql/sources/druid/DruidQueryCostModel.scala`) chooses
+between one broker scatter-gather query and N direct per-historical queries,
+from tunable per-row/shuffle cost constants.  The TPU analog chooses:
+
+* **kernel strategy** — dense one-hot matmul (MXU; cost grows with G) vs
+  scatter segment-sum (VPU serial; cost per row ~constant but high);
+* **execution target** — single device vs SPMD mesh (the broker-vs-
+  historicals analog: one device is the "broker-only" plan, the mesh is
+  "query the historicals directly and merge"), weighing the per-group
+  collective bytes against per-device row savings.
+
+Constants live in SessionConfig (the SQLConf analog) so they are tunable the
+same way the reference's are.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from ..catalog.segment import DataSource
+from ..config import SessionConfig
+from ..models import query as Q
+
+
+@dataclasses.dataclass(frozen=True)
+class PhysicalPlan:
+    """The planner's final execution decision for one query spec."""
+
+    query: Q.QuerySpec
+    strategy: str  # "dense" | "segment"
+    distributed: bool
+    mesh_shape: Optional[Tuple[int, int]]  # (data, groups) or None
+    est_cost_local: float
+    est_cost_dist: float
+    num_groups: int
+    rows: int
+
+    def describe(self) -> str:
+        tgt = (
+            f"mesh(data={self.mesh_shape[0]}, groups={self.mesh_shape[1]})"
+            if self.distributed and self.mesh_shape
+            else "single-device"
+        )
+        return (
+            f"TPUAggregateScan[strategy={self.strategy}, target={tgt}, "
+            f"groups={self.num_groups}, rows={self.rows}, "
+            f"cost(local)={self.est_cost_local:.3g}, "
+            f"cost(dist)={self.est_cost_dist:.3g}]"
+        )
+
+
+def groupby_state_bytes(q: Q.QuerySpec, num_groups: int, cfg: SessionConfig) -> int:
+    """Bytes of per-group aggregate state that must cross the merge
+    collective (the analog of broker-merge payload size)."""
+    from ..models import aggregations as A
+
+    per_group = 0
+    aggs = getattr(q, "aggregations", ())
+    for a in aggs:
+        base = a.aggregator if isinstance(a, A.FilteredAgg) else a
+        if isinstance(base, (A.HyperUnique, A.CardinalityAgg)):
+            per_group += 4 * (1 << base.precision)
+        elif isinstance(base, A.ThetaSketch):
+            per_group += 4 * base.size
+        else:
+            per_group += 4
+    return (per_group + 4) * num_groups  # +4: hidden __rows counter
+
+
+def choose_physical(
+    q: Q.QuerySpec,
+    ds: DataSource,
+    num_groups: int,
+    cfg: SessionConfig,
+    n_devices: int = 1,
+) -> PhysicalPlan:
+    rows = ds.num_rows
+    # kernel strategy: one-hot row cost scales with G/128 vector lanes;
+    # scatter cost is flat-but-large per row (serialized updates)
+    dense_cost = rows * cfg.cost_per_row_dense * max(num_groups / 128.0, 1.0)
+    scatter_cost = rows * cfg.cost_per_row_scatter
+    if num_groups <= cfg.dense_max_groups and (
+        not cfg.cost_model_enabled or dense_cost <= scatter_cost * 4
+    ):
+        strategy, per_row = "dense", dense_cost
+    else:
+        strategy, per_row = "segment", scatter_cost
+
+    state_bytes = groupby_state_bytes(q, num_groups, cfg)
+    collective_cost = (
+        state_bytes / 1e6 * (n_devices - 1) / max(cfg.collective_bytes_per_us, 1e-9)
+        if n_devices > 1
+        else 0.0
+    )
+    local_cost = per_row
+    dist_cost = per_row / max(n_devices, 1) + collective_cost
+
+    distributed = cfg.prefer_distributed and n_devices > 1 and (
+        not cfg.cost_model_enabled or dist_cost < local_cost
+    )
+    mesh_shape = None
+    if distributed:
+        ngroups_axis = cfg.mesh_groups_axis
+        ndata = cfg.mesh_data_axis or (n_devices // max(ngroups_axis, 1))
+        mesh_shape = (ndata, ngroups_axis)
+    return PhysicalPlan(
+        query=q,
+        strategy=strategy,
+        distributed=distributed,
+        mesh_shape=mesh_shape,
+        est_cost_local=local_cost,
+        est_cost_dist=dist_cost,
+        num_groups=num_groups,
+        rows=rows,
+    )

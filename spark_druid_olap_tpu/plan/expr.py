@@ -195,15 +195,62 @@ class Cast(Expr):
 
 @dataclasses.dataclass(frozen=True, eq=True)
 class TimeBucket(Expr):
-    """floor(__time to granularity) — device-side int64 arithmetic on the time
-    column; the expression behind Timeseries bucketing and GROUP BY
-    date_trunc."""
+    """floor(__time to granularity) — the expression behind Timeseries
+    bucketing and GROUP BY date_trunc.  In a GROUP BY position the planner
+    turns it into a time DimensionSpec (bucketing via host-computed boundary
+    searchsorted, exact for calendar granularities); on the row path it
+    compiles to int64 arithmetic, which requires a fixed period."""
 
     operand: Expr
-    period_ms: int
+    granularity: str  # "hour", "month", ISO period, ...
+
+    @property
+    def period_ms(self) -> Optional[int]:
+        from ..utils.granularity import granularity_period_ms
+
+        return granularity_period_ms(self.granularity)
 
     def __str__(self):
-        return f"time_floor({self.operand}, {self.period_ms}ms)"
+        return f"time_floor({self.operand}, {self.granularity})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class LikeExpr(Expr):
+    """SQL LIKE — translatable to a dictionary-evaluated filter on dims."""
+
+    operand: Expr
+    pattern: str
+    negated: bool = False
+
+    def __str__(self):
+        return f"({self.operand} {'NOT ' if self.negated else ''}LIKE {self.pattern!r})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StrFunc(Expr):
+    """String function over a dimension (SUBSTR/UPPER/LOWER) — only legal in
+    GROUP BY / filter positions, where it becomes a host-side dictionary
+    rewrite (models/dimensions.py extraction fns); never row-path device code."""
+
+    fn: str  # substr | upper | lower
+    operand: Expr
+    args: Tuple[Any, ...] = ()
+
+    def __str__(self):
+        a = ", ".join(str(x) for x in (self.operand,) + self.args)
+        return f"{self.fn}({a})"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class TimeExtract(Expr):
+    """EXTRACT(field FROM time) — YEAR/MONTH/DAY/HOUR...; on the row path
+    compiles to civil-calendar integer arithmetic on the int64 ms column."""
+
+    field: str  # year | month | day | hour | minute
+    operand: Expr
+
+    def __str__(self):
+        return f"extract({self.field} from {self.operand})"
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
@@ -265,6 +312,34 @@ def compile_expr(e: Expr) -> Callable[[Mapping[str, Any]], Any]:
         f, op = compile_expr(e.operand), _UNARY[e.op]
         return lambda cols: op(f(cols))
     if isinstance(e, Comparison):
+        # f32 column vs f64 literal: SQL promotes to double; we get exact
+        # double semantics in f32 via host-adjusted thresholds (utils/floatcmp)
+        def _num_lit(v):
+            return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+        lit_side = None
+        if isinstance(e.right, Literal) and _num_lit(e.right.value):
+            lit_side, lit_val, other = "right", e.right.value, e.left
+        elif isinstance(e.left, Literal) and _num_lit(e.left.value):
+            lit_side, lit_val, other = "left", e.left.value, e.right
+        if lit_side is not None and e.op in (">", ">=", "<", "<=", "==", "!="):
+            from ..utils.floatcmp import f32_adjusted_compare
+
+            of = compile_expr(other)
+            op_name = e.op
+            if lit_side == "left" and op_name in (">", ">=", "<", "<="):
+                op_name = {">": "<", ">=": "<=", "<": ">", "<=": ">="}[op_name]
+            # all threshold adjustment precomputed at compile time
+            cmp32 = f32_adjusted_compare(op_name, float(lit_val))
+
+            def cmp_fn(cols, of=of, op_name=op_name, lit_val=lit_val,
+                       cmp32=cmp32):
+                x = jnp.asarray(of(cols))
+                if x.dtype == jnp.float32:
+                    return cmp32(x)
+                return _CMP[op_name](x, lit_val)
+
+            return cmp_fn
         lf, rf, op = compile_expr(e.left), compile_expr(e.right), _CMP[e.op]
         return lambda cols: op(lf(cols), rf(cols))
     if isinstance(e, BoolOp):
@@ -288,7 +363,24 @@ def compile_expr(e: Expr) -> Callable[[Mapping[str, Any]], Any]:
         return lambda cols: jnp.asarray(f(cols)).astype(dt)
     if isinstance(e, TimeBucket):
         f, p = compile_expr(e.operand), e.period_ms
-        return lambda cols: (jnp.asarray(f(cols)) // p).astype(jnp.int64)
+        if p is None:
+            raise ValueError(
+                f"calendar granularity {e.granularity!r} has no fixed period; "
+                "only legal in GROUP BY position (dimension bucketing)"
+            )
+        return lambda cols: (jnp.asarray(f(cols)) // p * p).astype(jnp.int64)
+    if isinstance(e, TimeExtract):
+        if e.field not in _EXTRACT_FIELDS:
+            raise ValueError(
+                f"EXTRACT field {e.field!r}; supported: {sorted(_EXTRACT_FIELDS)}"
+            )
+        f, field = compile_expr(e.operand), e.field
+        return lambda cols: _time_extract(jnp.asarray(f(cols)), field)
+    if isinstance(e, (LikeExpr, StrFunc)):
+        raise ValueError(
+            f"{type(e).__name__} is dictionary-evaluated (filter / GROUP BY "
+            "position only); it cannot compile to a device row expression"
+        )
     if isinstance(e, AggRef):
         name = e.name
         return lambda cols: cols[name]
@@ -300,6 +392,38 @@ def _fold(op, fs, cols):
     for f in fs[1:]:
         acc = op(acc, f(cols))
     return acc
+
+
+_EXTRACT_FIELDS = {"year", "month", "day", "hour", "minute", "second"}
+
+
+def _time_extract(t_ms: Any, field: str):
+    """Civil-calendar field from int64 epoch-ms — pure integer ops (vector-
+    friendly); days-to-(y,m,d) via the standard era/cycle decomposition."""
+    if field == "second":
+        return ((t_ms // 1_000) % 60).astype(jnp.int32)
+    if field == "minute":
+        return ((t_ms // 60_000) % 60).astype(jnp.int32)
+    if field == "hour":
+        return ((t_ms // 3_600_000) % 24).astype(jnp.int32)
+    days = t_ms // 86_400_000
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    if field == "year":
+        return y.astype(jnp.int32)
+    if field == "month":
+        return m.astype(jnp.int32)
+    if field == "day":
+        return d.astype(jnp.int32)
+    raise ValueError(f"EXTRACT field {field!r}")
 
 
 def col(name: str) -> Col:

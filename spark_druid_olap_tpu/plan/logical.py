@@ -1,0 +1,172 @@
+"""Logical plan — the planner's input language.
+
+Reference parity: the reference pattern-matches *Catalyst* logical plans
+(Aggregate / Project / Filter / Sort / Limit / Join over a relation) inside
+`DruidPlanner`'s transforms (SURVEY.md §2 DruidPlanner/AggregateTransform rows
+`[U]`).  We are standalone, so we define our own small logical algebra with
+the same node set; the SQL frontend (sql/) and the DataFrame-style builder
+(api.py) both lower to it.  Expressions inside nodes are `plan.expr.Expr`
+trees (the Catalyst-expression analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from .expr import Expr
+
+
+class LogicalPlan:
+    def children(self) -> Tuple["LogicalPlan", ...]:
+        return ()
+
+    def pretty(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        head = pad + self._label()
+        return "\n".join([head] + [c.pretty(indent + 1) for c in self.children()])
+
+    def _label(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass(frozen=True)
+class Scan(LogicalPlan):
+    """Scan of a registered datasource (the `DruidRelation` leaf analog)."""
+
+    table: str
+
+    def _label(self):
+        return f"Scan({self.table})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(LogicalPlan):
+    condition: Expr
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        return f"Filter({self.condition})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(LogicalPlan):
+    exprs: Tuple[Tuple[str, Expr], ...]  # (output name, expression)
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        return "Project(" + ", ".join(n for n, _ in self.exprs) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class AggExpr:
+    """One aggregate output: fn over an expression, optional DISTINCT and
+    FILTER (the Catalyst AggregateExpression analog)."""
+
+    name: str
+    fn: str  # sum | count | min | max | avg | count_distinct |
+    #          approx_count_distinct | hll | theta
+    arg: Optional[Expr]  # None for count(*)
+    distinct: bool = False
+    filter: Optional[Expr] = None
+
+    def __str__(self):
+        inner = "*" if self.arg is None else str(self.arg)
+        d = "DISTINCT " if self.distinct else ""
+        f = f" FILTER ({self.filter})" if self.filter is not None else ""
+        return f"{self.fn}({d}{inner}){f}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(LogicalPlan):
+    group_exprs: Tuple[Tuple[str, Expr], ...]
+    agg_exprs: Tuple[AggExpr, ...]
+    child: LogicalPlan
+    # post-aggregate projections: expressions over agg output names (AggRef)
+    post_exprs: Tuple[Tuple[str, Expr], ...] = ()
+    # grouping sets: tuples of indices into group_exprs; () = plain GROUP BY
+    grouping_sets: Tuple[Tuple[int, ...], ...] = ()
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        g = ", ".join(n for n, _ in self.group_exprs)
+        a = ", ".join(str(a) for a in self.agg_exprs)
+        gs = f" sets={self.grouping_sets}" if self.grouping_sets else ""
+        return f"Aggregate(by=[{g}], aggs=[{a}]{gs})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Having(LogicalPlan):
+    condition: Expr  # over AggRef / group columns
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        return f"Having({self.condition})"
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    expr: Expr
+    ascending: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(LogicalPlan):
+    keys: Tuple[SortKey, ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        return "Sort(" + ", ".join(
+            f"{k.expr} {'asc' if k.ascending else 'desc'}" for k in self.keys
+        ) + ")"
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(LogicalPlan):
+    n: int
+    child: LogicalPlan
+    offset: int = 0
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        return f"Limit({self.n}" + (f", offset={self.offset})" if self.offset else ")")
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(LogicalPlan):
+    """Equi-join; the star-schema collapse (JoinTransform analog) eliminates
+    these when they conform to the declared star schema."""
+
+    left: LogicalPlan
+    right: LogicalPlan
+    left_keys: Tuple[str, ...]
+    right_keys: Tuple[str, ...]
+    how: str = "inner"
+
+    def children(self):
+        return (self.left, self.right)
+
+    def _label(self):
+        return (
+            f"Join({self.how}, "
+            + " AND ".join(
+                f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys)
+            )
+            + ")"
+        )

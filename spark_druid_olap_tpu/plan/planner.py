@@ -1,0 +1,355 @@
+"""TPUPlanner — drives the rewrite rules over a logical plan.
+
+Reference parity: `DruidPlanner` + `DruidStrategy` (SURVEY.md §2/§3.2 `[U]`):
+fold the registered transforms over the logical plan, threading an immutable
+`QueryBuilder`; a failure drops the candidate; the surviving builder picks the
+most specific query type and the cost model picks the execution shape.  Where
+the reference's fallback is "let vanilla Spark run the plan", our fallback for
+non-aggregate plans is a Scan query (`nonAggregateQueryHandling=scan` analog);
+plans we cannot rewrite raise `RewriteError` with the reason (surfaced by
+`explain`, the `EXPLAIN DRUID REWRITE` analog, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..catalog.segment import DataSource
+from ..config import SessionConfig
+from ..models import query as Q
+from . import expr as E
+from . import logical as L
+from .builder import QueryBuilder
+from .cost import PhysicalPlan, choose_physical
+from .transforms import (
+    RewriteError,
+    apply_sort_limit,
+    substitute,
+    translate_aggregate,
+    translate_filter,
+    translate_group_expr,
+    translate_having,
+    translate_post_expr,
+)
+
+
+@dataclasses.dataclass
+class Rewrite:
+    """The planner's output: query spec + everything the execution layer
+    needs to finalize results (the DruidStrategy 'projection fixup' analog)."""
+
+    datasource: str
+    builder: QueryBuilder
+    query: Q.QuerySpec
+    physical: PhysicalPlan
+    output_columns: Tuple[str, ...]
+    dim_names: Tuple[str, ...]
+    residual_having: Optional[E.Expr]
+    host_post_exprs: Tuple[Tuple[str, E.Expr], ...]
+    grouping_sets: Tuple[Tuple[int, ...], ...]
+    is_scan: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(self.query.to_druid(), indent=2, default=str)
+
+
+class Planner:
+    def __init__(self, catalog, cfg: Optional[SessionConfig] = None,
+                 n_devices: int = 1):
+        self.catalog = catalog  # name -> DataSource (catalog/cache.py)
+        self.cfg = cfg or SessionConfig()
+        self.n_devices = n_devices
+
+    # -- plan walking --------------------------------------------------------
+
+    def plan(self, lp: L.LogicalPlan) -> Rewrite:
+        if not self.cfg.enable_rewrites:
+            raise RewriteError("rewrites disabled by config")
+        limit: Optional[int] = None
+        offset = 0
+        sort_keys: List[L.SortKey] = []
+        having_cond: Optional[E.Expr] = None
+        top_projections: Optional[Tuple[Tuple[str, E.Expr], ...]] = None
+
+        node = lp
+        while True:
+            if isinstance(node, L.Limit):
+                limit, offset = node.n, node.offset
+                node = node.child
+            elif isinstance(node, L.Sort):
+                sort_keys = list(node.keys)
+                node = node.child
+            elif isinstance(node, L.Having):
+                having_cond = node.condition
+                node = node.child
+            elif isinstance(node, L.Project) and _contains_aggregate(node.child):
+                top_projections = node.exprs
+                node = node.child
+            else:
+                break
+
+        if isinstance(node, L.Aggregate):
+            return self._plan_aggregate(
+                node, limit, offset, sort_keys, having_cond, top_projections
+            )
+        # non-aggregate query -> Scan (reference nonAggregateQueryHandling)
+        if self.cfg.non_aggregate_query_handling != "scan":
+            raise RewriteError("non-aggregate query (scan handling disabled)")
+        return self._plan_scan(node, limit, offset, sort_keys, top_projections)
+
+    # -- aggregate path ------------------------------------------------------
+
+    def _collapse_below(
+        self, node: L.LogicalPlan
+    ) -> Tuple[str, Dict[str, E.Expr], List[E.Expr]]:
+        """Walk Filter/Project chain below the Aggregate to the Scan leaf.
+        Returns (table, projection env, filter conditions bottom-up).
+        Join subtrees are collapsed by the star-schema transform
+        (plan/star_join.py) before this walk."""
+        env: Dict[str, E.Expr] = {}
+        filters: List[E.Expr] = []
+        while True:
+            if isinstance(node, L.Scan):
+                return node.table, env, filters
+            if isinstance(node, L.Filter):
+                filters.append(substitute(node.condition, env))
+                node = node.child
+                continue
+            if isinstance(node, L.Project):
+                for name, e in node.exprs:
+                    env[name] = substitute(e, env)
+                node = node.child
+                continue
+            if isinstance(node, L.Join):
+                from .star_join import collapse_star_join
+
+                node = collapse_star_join(node, self.catalog, self.cfg)
+                continue
+            raise RewriteError(
+                f"cannot rewrite plan node {type(node).__name__} under Aggregate"
+            )
+
+    def _plan_aggregate(
+        self,
+        agg: L.Aggregate,
+        limit: Optional[int],
+        offset: int,
+        sort_keys: List[L.SortKey],
+        having_cond: Optional[E.Expr],
+        top_projections,
+    ) -> Rewrite:
+        table, env, filters = self._collapse_below(agg.child)
+        ds = self._ds(table)
+        b = QueryBuilder(datasource=table)
+
+        # ProjectFilterTransform
+        for cond in filters:
+            b = translate_filter(cond, ds, b)
+
+        # AggregateTransform: grouping exprs
+        dims = []
+        dim_names = []
+        for name, ge in agg.group_exprs:
+            spec, b = translate_group_expr(name, substitute(ge, env), ds, b)
+            dims.append(spec)
+            dim_names.append(spec.name)
+        b = b.with_(dimensions=tuple(dims))
+
+        # AggregateTransform: aggregate functions
+        aggs: List = []
+        posts: List = []
+        for ae in agg.agg_exprs:
+            ae2 = L.AggExpr(
+                ae.name,
+                ae.fn,
+                substitute(ae.arg, env) if ae.arg is not None else None,
+                ae.distinct,
+                substitute(ae.filter, env) if ae.filter is not None else None,
+            )
+            a_list, p_list, b = translate_aggregate(ae2, ds, b, self.cfg)
+            aggs.extend(a_list)
+            posts.extend(p_list)
+        b = b.with_(
+            aggregations=tuple(aggs), post_aggregations=tuple(posts)
+        )
+
+        # post-aggregate projections (SELECT exprs over agg outputs)
+        host_posts: List[Tuple[str, E.Expr]] = []
+        output_columns: List[str] = []
+        post_names = {p.name for p in posts}
+        agg_names = [a.name for a in aggs]
+        if top_projections is not None:
+            out_exprs = top_projections
+        elif agg.post_exprs:
+            out_exprs = agg.post_exprs
+        else:
+            out_exprs = None
+        if out_exprs is not None:
+            new_posts = list(b.post_aggregations)
+            for name, pe in out_exprs:
+                if isinstance(pe, E.Col) and pe.name in dim_names:
+                    output_columns.append(pe.name)
+                    continue
+                if isinstance(pe, E.AggRef) and (
+                    pe.name in agg_names or pe.name in post_names
+                ):
+                    output_columns.append(pe.name)
+                    continue
+                p = translate_post_expr(name, pe)
+                if p is not None:
+                    new_posts.append(p)
+                else:
+                    host_posts.append((name, pe))
+                output_columns.append(name)
+            b = b.with_(post_aggregations=tuple(new_posts))
+        else:
+            output_columns = dim_names + [
+                n for n in agg_names if not _is_avg_helper(n, post_names)
+            ] + list(post_names)
+
+        # HAVING
+        residual_having = None
+        if having_cond is not None:
+            spec, residual_having = translate_having(having_cond)
+            if spec is not None:
+                b = b.with_(having=spec)
+
+        # grouping sets (CUBE/ROLLUP)
+        if agg.grouping_sets:
+            b = b.with_(grouping_sets=tuple(agg.grouping_sets))
+
+        # LimitTransform
+        rankable = agg_names + list(post_names)
+        b = apply_sort_limit(b, sort_keys, limit, offset, self.cfg, rankable)
+        b = b.with_(output_columns=tuple(output_columns))
+
+        # guards (maxResultCardinality analog)
+        G = 1
+        for d in dims:
+            card = (
+                ds.cardinality(d.dimension) + 1
+                if d.dimension in ds.dicts
+                else 4096
+            )
+            G *= card
+        if G > self.cfg.max_result_cardinality:
+            raise RewriteError(
+                f"estimated result cardinality {G} exceeds "
+                f"max_result_cardinality={self.cfg.max_result_cardinality}"
+            )
+
+        q = b.build()
+        phys = choose_physical(q, ds, G, self.cfg, self.n_devices)
+        return Rewrite(
+            datasource=table,
+            builder=b,
+            query=q,
+            physical=phys,
+            output_columns=tuple(output_columns),
+            dim_names=tuple(dim_names),
+            residual_having=residual_having,
+            host_post_exprs=tuple(host_posts),
+            grouping_sets=tuple(agg.grouping_sets),
+        )
+
+    # -- scan path -----------------------------------------------------------
+
+    def _plan_scan(
+        self, node, limit, offset, sort_keys, top_projections
+    ) -> Rewrite:
+        env: Dict[str, E.Expr] = {}
+        filters: List[E.Expr] = []
+        proj: Optional[Tuple[Tuple[str, E.Expr], ...]] = top_projections
+        while not isinstance(node, L.Scan):
+            if isinstance(node, L.Filter):
+                filters.append(substitute(node.condition, env))
+                node = node.child
+            elif isinstance(node, L.Project):
+                if proj is None:
+                    proj = node.exprs
+                for name, e in node.exprs:
+                    env[name] = substitute(e, env)
+                node = node.child
+            else:
+                raise RewriteError(
+                    f"cannot rewrite scan node {type(node).__name__}"
+                )
+        ds = self._ds(node.table)
+        b = QueryBuilder(datasource=node.table)
+        for cond in filters:
+            b = translate_filter(cond, ds, b)
+        columns: List[str] = []
+        vcols: List[Q.VirtualColumn] = []
+        if proj:
+            for name, e in proj:
+                e = substitute(e, env)
+                if isinstance(e, E.Col):
+                    columns.append(e.name)
+                else:
+                    vcols.append(Q.VirtualColumn(name, e))
+                    columns.append(name)
+        else:
+            columns = [c.name for c in ds.columns]
+        q = Q.ScanQuery(
+            datasource=node.table,
+            columns=tuple(columns),
+            filter=b.filter,
+            intervals=b.intervals,
+            limit=limit,
+            virtual_columns=tuple(vcols),
+        )
+        phys = choose_physical(q, ds, 1, self.cfg, self.n_devices)
+        return Rewrite(
+            datasource=node.table,
+            builder=b,
+            query=q,
+            physical=phys,
+            output_columns=tuple(columns),
+            dim_names=(),
+            residual_having=None,
+            host_post_exprs=(),
+            grouping_sets=(),
+            is_scan=True,
+        )
+
+    # -- explain (EXPLAIN DRUID REWRITE analog, SURVEY.md §3.4) --------------
+
+    def explain(self, lp: L.LogicalPlan) -> str:
+        lines = ["== Logical Plan ==", lp.pretty(), ""]
+        try:
+            rw = self.plan(lp)
+            lines += [
+                "== Rewrite: %s ==" % type(rw.query).__name__,
+                rw.to_json(),
+                "",
+                "== Physical Plan ==",
+                rw.physical.describe(),
+            ]
+            if rw.residual_having is not None:
+                lines.append(f"residual HAVING (host): {rw.residual_having}")
+            if rw.host_post_exprs:
+                lines.append(
+                    "residual projections (host): "
+                    + ", ".join(n for n, _ in rw.host_post_exprs)
+                )
+        except RewriteError as e:
+            lines += ["== Rewrite FAILED ==", str(e)]
+        return "\n".join(lines)
+
+    def _ds(self, table: str) -> DataSource:
+        ds = self.catalog.get(table)
+        if ds is None:
+            raise RewriteError(f"unknown table {table!r}")
+        return ds
+
+
+def _contains_aggregate(n: L.LogicalPlan) -> bool:
+    if isinstance(n, L.Aggregate):
+        return True
+    return any(_contains_aggregate(c) for c in n.children())
+
+
+def _is_avg_helper(name: str, post_names) -> bool:
+    return name.endswith("__sum") or name.endswith("__cnt")

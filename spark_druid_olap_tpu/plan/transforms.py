@@ -1,0 +1,468 @@
+"""Rewrite rules: logical plan -> QueryBuilder extensions.
+
+Reference parity (SURVEY.md §2/§3.2 `[U]`): mirrors the reference's transform
+pipeline and order —
+  * `ProjectFilterTransform` -> `translate_filter` (predicates to the
+    FilterSpec tree; time-column predicates narrow the query interval instead;
+    untranslatable predicates fall back to an `ExpressionFilter`, the TPU
+    analog of the reference's JS-filter escape hatch — unlike the reference we
+    never abort on residuals because we own the engine)
+  * `AggregateTransform` -> `translate_aggregate` (grouping exprs to
+    DimensionSpecs incl. time-granularity buckets and dictionary extractions;
+    SUM/MIN/MAX/COUNT to AggregationSpecs; AVG to sum+count plus an arithmetic
+    post-agg; COUNT(DISTINCT) to HLL/theta sketch aggs per session config;
+    FILTER clauses to `filtered` aggregators)
+  * post-agg / having     -> `translate_post_exprs` / `translate_having`
+  * `LimitTransform`      -> `apply_sort_limit` (Sort+Limit over a
+    single-dimension aggregate becomes a TopN; otherwise a LimitSpec)
+Each step either extends the immutable QueryBuilder or raises
+`RewriteError` — the analog of a transform dropping the rewrite candidate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..catalog.segment import DataSource
+from ..config import SessionConfig
+from ..models import aggregations as A
+from ..models import filters as F
+from ..models import query as Q
+from ..models.dimensions import (
+    DimensionSpec,
+    RegexExtraction,
+    SubstringExtraction,
+)
+from . import expr as E
+from .builder import QueryBuilder
+from .logical import AggExpr
+
+
+class RewriteError(Exception):
+    """A transform could not translate this plan (candidate dropped)."""
+
+
+# ---------------------------------------------------------------------------
+# Expression utilities
+# ---------------------------------------------------------------------------
+
+
+def substitute(e: E.Expr, env: Dict[str, E.Expr]) -> E.Expr:
+    """Inline projection-defined names (the analog of Catalyst's alias
+    resolution when the reference matches Project under Aggregate)."""
+    if isinstance(e, E.Col):
+        if e.name in env:
+            return substitute(env[e.name], {k: v for k, v in env.items()
+                                            if k != e.name})
+        return e
+    if isinstance(e, E.Literal) or isinstance(e, E.AggRef):
+        return e
+    kw = {}
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr):
+            kw[f.name] = substitute(v, env)
+        elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+            kw[f.name] = tuple(substitute(x, env) for x in v)
+        else:
+            kw[f.name] = v
+    return type(e)(**kw)
+
+
+def _is_time_col(e: E.Expr, ds: DataSource) -> bool:
+    return isinstance(e, E.Col) and (
+        e.name == "__time" or e.name == ds.time_column
+    )
+
+
+def _literal_ms(e: E.Expr) -> Optional[int]:
+    if isinstance(e, E.Literal) and isinstance(e.value, (int, float, np.integer)):
+        return int(e.value)
+    return None
+
+
+_MAX_MS = 1 << 62
+
+
+# ---------------------------------------------------------------------------
+# ProjectFilterTransform analog
+# ---------------------------------------------------------------------------
+
+
+def translate_filter(
+    e: E.Expr, ds: DataSource, b: QueryBuilder
+) -> QueryBuilder:
+    """Fold one predicate into the builder: conjuncts split; time bounds
+    become intervals; dimension predicates become Filter specs; anything
+    else becomes an ExpressionFilter residual."""
+    for conj in _conjuncts(e):
+        iv = _as_interval(conj, ds)
+        if iv is not None:
+            b = _intersect_interval(b, iv)
+            continue
+        f = _as_filter_spec(conj, ds)
+        if f is not None:
+            b = b.add_filter(f)
+            continue
+        # residual: compile later on the row path (JS-codegen analog)
+        _validate_columns(conj, ds)
+        b = b.add_filter(F.ExpressionFilter(conj))
+    return b
+
+
+def _conjuncts(e: E.Expr) -> List[E.Expr]:
+    if isinstance(e, E.BoolOp) and e.op == "and":
+        out: List[E.Expr] = []
+        for o in e.operands:
+            out.extend(_conjuncts(o))
+        return out
+    return [e]
+
+
+def _as_interval(e: E.Expr, ds: DataSource) -> Optional[Tuple[int, int]]:
+    """Time-column comparisons -> half-open [lo, hi) interval (the
+    reference's interval narrowing instead of a Druid filter)."""
+    if not isinstance(e, E.Comparison):
+        return None
+    l, r, op = e.left, e.right, e.op
+    if not _is_time_col(l, ds):
+        if _is_time_col(r, ds):
+            l, r = r, l
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}[op]
+        else:
+            return None
+    ms = _literal_ms(r)
+    if ms is None:
+        return None
+    if op == "<":
+        return (-_MAX_MS, ms)
+    if op == "<=":
+        return (-_MAX_MS, ms + 1)
+    if op == ">":
+        return (ms + 1, _MAX_MS)
+    if op == ">=":
+        return (ms, _MAX_MS)
+    if op == "==":
+        return (ms, ms + 1)
+    return None
+
+
+def _intersect_interval(b: QueryBuilder, iv: Tuple[int, int]) -> QueryBuilder:
+    if not b.intervals:
+        return b.with_(intervals=(iv,))
+    out = []
+    for a0, b0 in b.intervals:
+        lo, hi = max(a0, iv[0]), min(b0, iv[1])
+        if lo < hi:
+            out.append((lo, hi))
+    return b.with_(intervals=tuple(out) if out else ((0, 0),))
+
+
+def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
+    """Dimension predicate -> Druid-style FilterSpec, when directly
+    expressible.  Dictionary-order tricks make string bounds sound."""
+    if isinstance(e, E.Comparison):
+        l, r, op = e.left, e.right, e.op
+        if isinstance(r, E.Col) and isinstance(l, E.Literal):
+            l, r = r, l
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                  "==": "==", "!=": "!="}[op]
+        if not (isinstance(l, E.Col) and isinstance(r, E.Literal)):
+            return None
+        name, val = l.name, r.value
+        is_dim = name in ds.dicts
+        sval = str(val)
+        ordering = "lexicographic" if is_dim and isinstance(val, str) else "numeric"
+        if op == "==":
+            if is_dim and isinstance(val, str):
+                return F.Selector(name, sval)
+            return F.Bound(name, lower=sval, upper=sval, ordering="numeric")
+        if op == "!=":
+            if is_dim and isinstance(val, str):
+                return F.Not(F.Selector(name, sval))
+            return F.Not(F.Bound(name, lower=sval, upper=sval, ordering="numeric"))
+        if op in ("<", "<="):
+            return F.Bound(name, upper=sval, upper_strict=(op == "<"),
+                           ordering=ordering)
+        if op in (">", ">="):
+            return F.Bound(name, lower=sval, lower_strict=(op == ">"),
+                           ordering=ordering)
+        return None
+    if isinstance(e, E.InExpr):
+        if isinstance(e.operand, E.Col):
+            return F.InFilter(e.operand.name, tuple(str(v) for v in e.values))
+        return None
+    if isinstance(e, E.LikeExpr):
+        if isinstance(e.operand, E.Col):
+            f: F.Filter = F.LikeFilter(e.operand.name, e.pattern)
+            return F.Not(f) if e.negated else f
+        return None
+    if isinstance(e, E.BoolOp):
+        if e.op == "not":
+            inner = _as_filter_spec(e.operands[0], ds)
+            return F.Not(inner) if inner is not None else None
+        subs = [_as_filter_spec(o, ds) for o in e.operands]
+        if any(s is None for s in subs):
+            return None
+        return F.And(tuple(subs)) if e.op == "and" else F.Or(tuple(subs))
+    return None
+
+
+def _validate_columns(e: E.Expr, ds: DataSource):
+    for c in e.columns():
+        if c == "__time":
+            continue
+        try:
+            ds.meta(c)
+        except KeyError as ke:
+            raise RewriteError(str(ke)) from None
+
+
+# ---------------------------------------------------------------------------
+# AggregateTransform analog
+# ---------------------------------------------------------------------------
+
+
+def translate_group_expr(
+    name: str, e: E.Expr, ds: DataSource, b: QueryBuilder
+) -> Tuple[DimensionSpec, QueryBuilder]:
+    """Grouping expression -> DimensionSpec (+ builder extension)."""
+    if isinstance(e, E.Col):
+        if e.name in ds.dicts:
+            return DimensionSpec(e.name, name), b
+        if _is_time_col(e, ds):
+            raise RewriteError(
+                "grouping by raw time requires a granularity (DATE_TRUNC)"
+            )
+        raise RewriteError(f"GROUP BY over metric column {e.name!r}")
+    if isinstance(e, E.TimeBucket):
+        if not _is_time_col(e.operand, ds):
+            raise RewriteError("DATE_TRUNC over non-time column")
+        return DimensionSpec("__time", name, granularity=e.granularity), b
+    if isinstance(e, E.TimeExtract):
+        # EXTRACT in GROUP BY: device row expression as a dimension is not
+        # dictionary-backed; use a virtual int dimension via time bucketing
+        # when possible (year/month), else reject.
+        raise RewriteError(
+            "EXTRACT in GROUP BY not yet dictionary-backed; use DATE_TRUNC"
+        )
+    if isinstance(e, E.StrFunc):
+        if not isinstance(e.operand, E.Col) or e.operand.name not in ds.dicts:
+            raise RewriteError(f"{e.fn} over non-dimension in GROUP BY")
+        dim = e.operand.name
+        if e.fn == "substr":
+            start = int(e.args[0]) - 1  # SQL is 1-based
+            length = int(e.args[1]) if len(e.args) > 1 else None
+            return (
+                DimensionSpec(dim, name,
+                              extraction=SubstringExtraction(start, length)),
+                b,
+            )
+        if e.fn in ("upper", "lower"):
+            from ..models.dimensions import CaseExtraction
+
+            return (
+                DimensionSpec(dim, name,
+                              extraction=CaseExtraction(upper=(e.fn == "upper"))),
+                b,
+            )
+        raise RewriteError(f"string function {e.fn!r} in GROUP BY")
+    raise RewriteError(f"cannot group by expression {e}")
+
+
+def translate_aggregate(
+    agg: AggExpr, ds: DataSource, b: QueryBuilder, cfg: SessionConfig
+) -> Tuple[List[A.Aggregation], List[A.PostAggregation], QueryBuilder]:
+    """One SQL aggregate -> engine aggregations (+post-aggs for AVG)."""
+    name = agg.name
+    extra_filter = None
+    if agg.filter is not None:
+        spec = _as_filter_spec(agg.filter, ds)
+        if spec is None:
+            _validate_columns(agg.filter, ds)
+            spec = F.ExpressionFilter(agg.filter)
+        extra_filter = spec
+
+    def wrap(a: A.Aggregation) -> A.Aggregation:
+        return A.FilteredAgg(extra_filter, a) if extra_filter is not None else a
+
+    fn = agg.fn.lower()
+    arg = agg.arg
+
+    if fn == "count" and not agg.distinct:
+        return [wrap(A.Count(name))], [], b
+
+    if fn in ("count_distinct", "approx_count_distinct") or (
+        fn == "count" and agg.distinct
+    ):
+        if not isinstance(arg, E.Col):
+            raise RewriteError("COUNT(DISTINCT) over expressions unsupported")
+        if cfg.count_distinct_mode == "error" and fn == "count":
+            raise RewriteError("exact COUNT(DISTINCT) disabled by config")
+        sketch = cfg.approx_count_distinct_sketch
+        if fn == "approx_count_distinct":
+            sketch = cfg.approx_count_distinct_sketch
+        if sketch == "theta":
+            return [wrap(A.ThetaSketch(name, arg.name, size=cfg.theta_size))], [], b
+        return (
+            [wrap(A.HyperUnique(name, arg.name, precision=cfg.hll_precision))],
+            [],
+            b,
+        )
+
+    if fn == "avg":
+        sum_name, cnt_name = f"{name}__sum", f"{name}__cnt"
+        aggs, _, b = translate_aggregate(
+            AggExpr(sum_name, "sum", arg, filter=agg.filter), ds, b, cfg
+        )
+        cnt: A.Aggregation = A.Count(cnt_name)
+        if arg is not None and not isinstance(arg, E.Literal):
+            # COUNT over the arg (non-null count); columns here are non-null
+            # metrics so plain count matches SQL AVG semantics
+            pass
+        aggs.append(wrap(cnt))
+        post = A.Arithmetic(
+            name,
+            "/",
+            (A.FieldAccess(f"{name}__fa_s", sum_name),
+             A.FieldAccess(f"{name}__fa_c", cnt_name)),
+        )
+        return aggs, [post], b
+
+    if fn in ("sum", "min", "max"):
+        if arg is None:
+            raise RewriteError(f"{fn} requires an argument")
+        if isinstance(arg, E.Col):
+            meta = None
+            try:
+                meta = ds.meta(arg.name)
+            except KeyError:
+                raise RewriteError(f"unknown column {arg.name!r}")
+            is_long = meta.dtype == "long"
+            cls = {
+                ("sum", True): A.LongSum,
+                ("sum", False): A.DoubleSum,
+                ("min", True): A.LongMin,
+                ("min", False): A.DoubleMin,
+                ("max", True): A.LongMax,
+                ("max", False): A.DoubleMax,
+            }[(fn, is_long)]
+            return [wrap(cls(name, arg.name))], [], b
+        # expression argument -> ExpressionAgg (fused virtual column)
+        _validate_columns(arg, ds)
+        base = {"sum": "doubleSum", "min": "doubleMin", "max": "doubleMax"}[fn]
+        return [wrap(A.ExpressionAgg(name, arg, base=base))], [], b
+
+    raise RewriteError(f"aggregate function {agg.fn!r}")
+
+
+# ---------------------------------------------------------------------------
+# Post-aggregate projections & HAVING
+# ---------------------------------------------------------------------------
+
+
+def translate_post_expr(
+    name: str, e: E.Expr
+) -> Optional[A.PostAggregation]:
+    """Expression over aggregate outputs -> arithmetic PostAggregationSpec
+    (None => host-evaluated residual)."""
+    if isinstance(e, E.AggRef):
+        return A.FieldAccess(name, e.name)
+    if isinstance(e, E.Literal):
+        return A.ConstantPost(name, float(e.value))
+    if isinstance(e, E.BinaryOp) and e.op in ("+", "-", "*", "/"):
+        l = translate_post_expr(f"{name}__l", e.left)
+        r = translate_post_expr(f"{name}__r", e.right)
+        if l is None or r is None:
+            return None
+        return A.Arithmetic(name, e.op, (l, r))
+    return None
+
+
+def translate_having(e: E.Expr) -> Tuple[Optional[Q.Having], Optional[E.Expr]]:
+    """HAVING over aggregate outputs -> HavingSpec; residual stays host-side.
+
+    Returns (spec, residual_expr) — exactly one is non-None unless both
+    (split conjunction)."""
+    spec, residual = _having_rec(e)
+    return spec, residual
+
+
+def _having_rec(e: E.Expr):
+    if isinstance(e, E.Comparison):
+        if isinstance(e.left, E.AggRef) and isinstance(e.right, E.Literal):
+            return Q.HavingCompare(e.left.name, e.op, float(e.right.value)), None
+        if isinstance(e.right, E.AggRef) and isinstance(e.left, E.Literal):
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                    "==": "==", "!=": "!="}[e.op]
+            return Q.HavingCompare(e.right.name, flip, float(e.left.value)), None
+        return None, e
+    if isinstance(e, E.BoolOp) and e.op == "and":
+        specs, residuals = [], []
+        for o in e.operands:
+            s, r = _having_rec(o)
+            if s is not None:
+                specs.append(s)
+            if r is not None:
+                residuals.append(r)
+        spec = Q.HavingAnd(tuple(specs)) if len(specs) > 1 else (
+            specs[0] if specs else None
+        )
+        if not residuals:
+            return spec, None
+        res = residuals[0]
+        for r in residuals[1:]:
+            res = E.BoolOp("and", (res, r))
+        return spec, res
+    if isinstance(e, E.BoolOp) and e.op == "or":
+        subs = [_having_rec(o) for o in e.operands]
+        if all(s is not None and r is None for s, r in subs):
+            return Q.HavingOr(tuple(s for s, _ in subs)), None
+        return None, e
+    return None, e
+
+
+# ---------------------------------------------------------------------------
+# LimitTransform analog
+# ---------------------------------------------------------------------------
+
+
+def apply_sort_limit(
+    b: QueryBuilder,
+    sort_keys: Sequence,  # List[logical.SortKey] resolved to output names
+    limit: Optional[int],
+    offset: int,
+    cfg: SessionConfig,
+    agg_output_names: Sequence[str],
+) -> QueryBuilder:
+    """Sort+Limit over a single-dimension aggregate -> TopN; else LimitSpec
+    (reference LimitTransform, SURVEY.md §2 `[U]`)."""
+    cols = []
+    for k in sort_keys:
+        if not isinstance(k.expr, (E.Col, E.AggRef)):
+            raise RewriteError(f"ORDER BY expression {k.expr} unsupported")
+        cols.append(Q.OrderByColumnSpec(
+            k.expr.name, "ascending" if k.ascending else "descending"
+        ))
+    if (
+        cfg.enable_topn_rewrite
+        and limit is not None
+        and offset == 0
+        and len(b.dimensions) == 1
+        and b.dimensions[0].granularity is None
+        and len(cols) == 1
+        and cols[0].dimension in agg_output_names
+        and b.having is None
+        and not b.grouping_sets
+    ):
+        return b.with_(
+            topn_metric=cols[0].dimension,
+            topn_threshold=limit,
+            topn_descending=(cols[0].direction == "descending"),
+        )
+    if limit is None and not cols:
+        return b
+    return b.with_(limit_spec=Q.LimitSpec(limit, tuple(cols), offset))

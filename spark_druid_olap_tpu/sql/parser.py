@@ -1,0 +1,804 @@
+"""SQL parser + analyzer: SQL text -> logical plan.
+
+Recursive descent over the lexer's tokens.  The grammar covers the OLAP
+subset the reference accelerates (SURVEY.md §2/§4 `[U]`: aggregate SELECTs
+with filters, time predicates, GROUP BY (+CUBE/ROLLUP/GROUPING SETS), HAVING,
+ORDER BY/LIMIT, star joins) plus `EXPLAIN REWRITE <sql>` — the analog of the
+reference's `EXPLAIN DRUID REWRITE` parser extension.
+
+The analyzer (bottom of file) splits SELECT items into grouping outputs,
+aggregate calls, and post-aggregate expressions (AggRef substitution), then
+assembles the logical plan tree the planner consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..plan import expr as E
+from ..plan import logical as L
+from .lexer import Token, tokenize
+
+AGG_FNS = {"sum", "count", "avg", "min", "max", "approx_count_distinct"}
+
+
+class ParseError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall(E.Expr):
+    """Parser-level aggregate call; the analyzer lifts these out of SELECT
+    expressions into Aggregate.agg_exprs and replaces them with AggRefs."""
+
+    fn: str
+    arg: Optional[E.Expr]
+    distinct: bool = False
+    filter: Optional[E.Expr] = None
+
+    def __str__(self):
+        inner = "*" if self.arg is None else str(self.arg)
+        return f"{self.fn}({'DISTINCT ' if self.distinct else ''}{inner})"
+
+
+@dataclasses.dataclass
+class SelectStmt:
+    items: List[Tuple[Optional[str], E.Expr]]  # (alias, expr)
+    table: Any  # str | JoinClause
+    where: Optional[E.Expr]
+    group_by: List[E.Expr]
+    group_mode: str  # "plain" | "cube" | "rollup" | "sets"
+    grouping_sets: List[List[E.Expr]]
+    having: Optional[E.Expr]
+    order_by: List[Tuple[E.Expr, bool]]
+    limit: Optional[int]
+    offset: int
+    explain: bool = False
+
+
+@dataclasses.dataclass
+class JoinClause:
+    left: Any  # str | JoinClause
+    right: str
+    right_alias: Optional[str]
+    on: List[Tuple[str, str]]  # (left col, right col) qualified names
+    how: str
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+        self.aliases: Dict[str, str] = {}  # alias -> table
+
+    # -- token helpers -------------------------------------------------------
+
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "KW" and t.value.lower() in kws:
+            self.next()
+            return t.value.lower()
+        return None
+
+    def expect_kw(self, kw: str):
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()} at {self.peek().value!r}")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "OP" and t.value == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r} at {self.peek().value!r}")
+
+    def expect_ident(self) -> str:
+        t = self.peek()
+        if t.kind == "IDENT":
+            self.next()
+            return t.value
+        if t.kind == "KW":  # permissive: keywords as idents where unambiguous
+            self.next()
+            return t.value
+        raise ParseError(f"expected identifier at {t.value!r}")
+
+    # -- statement -----------------------------------------------------------
+
+    def parse(self) -> SelectStmt:
+        explain = False
+        if self.accept_kw("explain"):
+            self.accept_kw("rewrite")  # EXPLAIN [REWRITE]
+            explain = True
+        stmt = self.select()
+        stmt.explain = explain
+        if self.accept_op(";"):
+            pass
+        if self.peek().kind != "EOF":
+            raise ParseError(f"trailing input at {self.peek().value!r}")
+        return stmt
+
+    def select(self) -> SelectStmt:
+        self.expect_kw("select")
+        items: List[Tuple[Optional[str], E.Expr]] = []
+        while True:
+            if self.accept_op("*"):
+                items.append((None, E.Col("*")))
+            else:
+                e = self.expr()
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.expect_ident()
+                elif self.peek().kind == "IDENT":
+                    alias = self.expect_ident()
+                items.append((alias, e))
+            if not self.accept_op(","):
+                break
+        self.expect_kw("from")
+        table = self.table_ref()
+        where = self.expr() if self.accept_kw("where") else None
+        group_by: List[E.Expr] = []
+        group_mode = "plain"
+        grouping_sets: List[List[E.Expr]] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            if self.accept_kw("cube"):
+                group_mode = "cube"
+                self.expect_op("(")
+                group_by = self._expr_list()
+                self.expect_op(")")
+            elif self.accept_kw("rollup"):
+                group_mode = "rollup"
+                self.expect_op("(")
+                group_by = self._expr_list()
+                self.expect_op(")")
+            elif self.accept_kw("grouping"):
+                self.expect_kw("sets")
+                group_mode = "sets"
+                self.expect_op("(")
+                while True:
+                    self.expect_op("(")
+                    s = self._expr_list() if not self.accept_op(")") else []
+                    if s:
+                        self.expect_op(")")
+                    grouping_sets.append(s)
+                    for e in s:
+                        if not any(_expr_eq(e, g) for g in group_by):
+                            group_by.append(e)
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                group_by = self._expr_list()
+        having = self.expr() if self.accept_kw("having") else None
+        order_by: List[Tuple[E.Expr, bool]] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.expr()
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                elif self.accept_kw("asc"):
+                    asc = True
+                order_by.append((e, asc))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        offset = 0
+        if self.accept_kw("limit"):
+            limit = int(self.next().value)
+        if self.accept_kw("offset"):
+            offset = int(self.next().value)
+        return SelectStmt(
+            items, table, where, group_by, group_mode, grouping_sets,
+            having, order_by, limit, offset,
+        )
+
+    def _expr_list(self) -> List[E.Expr]:
+        out = [self.expr()]
+        while self.accept_op(","):
+            out.append(self.expr())
+        return out
+
+    def table_ref(self):
+        name = self.expect_ident()
+        alias = None
+        t = self.peek()
+        if t.kind == "IDENT":
+            alias = self.expect_ident()
+        self.aliases[alias or name] = name
+        node: Any = name
+        while True:
+            how = None
+            if self.accept_kw("inner"):
+                self.expect_kw("join")
+                how = "inner"
+            elif self.accept_kw("left"):
+                self.expect_kw("join")
+                how = "left"
+            elif self.accept_kw("join"):
+                how = "inner"
+            else:
+                break
+            rname = self.expect_ident()
+            ralias = None
+            if self.peek().kind == "IDENT":
+                ralias = self.expect_ident()
+            self.aliases[ralias or rname] = rname
+            self.expect_kw("on")
+            on: List[Tuple[str, str]] = []
+            while True:
+                l = self._qualified_name()
+                self.expect_op("=")
+                r = self._qualified_name()
+                on.append((l, r))
+                if not self.accept_kw("and"):
+                    break
+            node = JoinClause(node, rname, ralias, on, how)
+        return node
+
+    def _qualified_name(self) -> str:
+        a = self.expect_ident()
+        if self.accept_op("."):
+            b = self.expect_ident()
+            return f"{a}.{b}"
+        return a
+
+    # -- expressions ---------------------------------------------------------
+
+    def expr(self) -> E.Expr:
+        return self._or()
+
+    def _or(self) -> E.Expr:
+        left = self._and()
+        while self.accept_kw("or"):
+            left = E.BoolOp("or", (left, self._and()))
+        return left
+
+    def _and(self) -> E.Expr:
+        left = self._not()
+        while self.accept_kw("and"):
+            left = E.BoolOp("and", (left, self._not()))
+        return left
+
+    def _not(self) -> E.Expr:
+        if self.accept_kw("not"):
+            return E.BoolOp("not", (self._not(),))
+        return self._cmp()
+
+    def _cmp(self) -> E.Expr:
+        left = self._add()
+        t = self.peek()
+        if t.kind == "OP" and t.value in ("=", "==", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            op = {"=": "==", "<>": "!="}.get(t.value, t.value)
+            return E.Comparison(op, left, self._add())
+        negated = False
+        if self.peek().kind == "KW" and self.peek().value.lower() == "not":
+            nxt = self.toks[self.i + 1]
+            if nxt.kind == "KW" and nxt.value.lower() in ("in", "like", "between"):
+                self.next()
+                negated = True
+        if self.accept_kw("between"):
+            lo = self._add()
+            self.expect_kw("and")
+            hi = self._add()
+            e: E.Expr = E.BoolOp(
+                "and",
+                (E.Comparison(">=", left, lo), E.Comparison("<=", left, hi)),
+            )
+            return E.BoolOp("not", (e,)) if negated else e
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            vals = []
+            while True:
+                v = self._primary()
+                if not isinstance(v, E.Literal):
+                    raise ParseError("IN list must be literals")
+                vals.append(v.value)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            e = E.InExpr(left, tuple(vals))
+            return E.BoolOp("not", (e,)) if negated else e
+        if self.accept_kw("like"):
+            t = self.next()
+            if t.kind != "STRING":
+                raise ParseError("LIKE requires a string pattern")
+            return E.LikeExpr(left, t.value, negated=negated)
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            isnull = E.Comparison("==", left, E.Literal(None))
+            return E.BoolOp("not", (isnull,)) if neg else isnull
+        return left
+
+    def _add(self) -> E.Expr:
+        left = self._mul()
+        while True:
+            if self.accept_op("+"):
+                left = E.BinaryOp("+", left, self._mul())
+            elif self.accept_op("-"):
+                left = E.BinaryOp("-", left, self._mul())
+            else:
+                return left
+
+    def _mul(self) -> E.Expr:
+        left = self._unary()
+        while True:
+            if self.accept_op("*"):
+                left = E.BinaryOp("*", left, self._unary())
+            elif self.accept_op("/"):
+                left = E.BinaryOp("/", left, self._unary())
+            elif self.accept_op("%"):
+                left = E.BinaryOp("%", left, self._unary())
+            else:
+                return left
+
+    def _unary(self) -> E.Expr:
+        if self.accept_op("-"):
+            return E.UnaryOp("-", self._unary())
+        return self._primary()
+
+    def _primary(self) -> E.Expr:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            v = float(t.value)
+            if v.is_integer() and "." not in t.value and "e" not in t.value.lower():
+                return E.Literal(int(t.value))
+            return E.Literal(v)
+        if t.kind == "STRING":
+            self.next()
+            return E.Literal(t.value)
+        if t.kind == "KW":
+            kw = t.value.lower()
+            if kw in ("date", "timestamp"):
+                self.next()
+                s = self.next()
+                if s.kind != "STRING":
+                    raise ParseError(f"{kw.upper()} requires a string literal")
+                ms = int(
+                    np.datetime64(s.value).astype("datetime64[ms]").astype(np.int64)
+                )
+                return E.Literal(ms)
+            if kw == "cast":
+                self.next()
+                self.expect_op("(")
+                inner = self.expr()
+                self.expect_kw("as")
+                ty = self.expect_ident().lower()
+                self.expect_op(")")
+                to = {
+                    "double": "double", "float": "double", "real": "double",
+                    "bigint": "long", "int": "long", "integer": "long",
+                    "long": "long", "boolean": "bool",
+                }.get(ty)
+                if to is None:
+                    raise ParseError(f"CAST to {ty!r} unsupported")
+                return E.Cast(inner, to)
+            if kw == "extract":
+                self.next()
+                self.expect_op("(")
+                field = self.expect_ident().lower()
+                from ..plan.expr import _EXTRACT_FIELDS
+
+                if field not in _EXTRACT_FIELDS:
+                    raise ParseError(
+                        f"EXTRACT field {field!r}; supported: "
+                        f"{sorted(_EXTRACT_FIELDS)}"
+                    )
+                self.expect_kw("from")
+                inner = self.expr()
+                self.expect_op(")")
+                return E.TimeExtract(field, inner)
+            if kw == "case":
+                return self._case()
+            if kw in ("true", "false"):
+                self.next()
+                return E.Literal(kw == "true")
+            if kw == "null":
+                self.next()
+                return E.Literal(None)
+            if kw == "interval":
+                raise ParseError("INTERVAL literals not supported; use ms")
+        if t.kind == "IDENT" or t.kind == "KW":
+            name = self.expect_ident()
+            if self.accept_op("("):
+                return self._call(name.lower())
+            if self.accept_op("."):
+                col = self.expect_ident()
+                return E.Col(f"{name}.{col}")
+            return E.Col(name)
+        if self.accept_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        raise ParseError(f"unexpected token {t.value!r}")
+
+    def _case(self) -> E.Expr:
+        self.expect_kw("case")
+        whens: List[Tuple[E.Expr, E.Expr]] = []
+        otherwise: E.Expr = E.Literal(None)
+        while self.accept_kw("when"):
+            c = self.expr()
+            self.expect_kw("then")
+            v = self.expr()
+            whens.append((c, v))
+        if self.accept_kw("else"):
+            otherwise = self.expr()
+        self.expect_kw("end")
+        out = otherwise
+        for c, v in reversed(whens):
+            out = E.IfExpr(c, v, out)
+        return out
+
+    def _call(self, fn: str) -> E.Expr:
+        if fn in AGG_FNS or fn == "count":
+            distinct = bool(self.accept_kw("distinct"))
+            if self.accept_op("*"):
+                arg = None
+            elif self.accept_op(")"):
+                raise ParseError(f"{fn} requires an argument")
+            else:
+                arg = self.expr()
+            if arg is not None:
+                self.expect_op(")")
+            else:
+                self.expect_op(")")
+            filt = None
+            if self.accept_kw("filter"):
+                self.expect_op("(")
+                self.expect_kw("where")
+                filt = self.expr()
+                self.expect_op(")")
+            return AggCall(fn, arg, distinct, filt)
+        if fn == "date_trunc":
+            gran = self.expr()
+            self.expect_op(",")
+            arg = self.expr()
+            self.expect_op(")")
+            if not isinstance(gran, E.Literal):
+                raise ParseError("DATE_TRUNC granularity must be a literal")
+            return E.TimeBucket(arg, str(gran.value))
+        if fn in ("time_floor",):
+            arg = self.expr()
+            self.expect_op(",")
+            gran = self.expr()
+            self.expect_op(")")
+            return E.TimeBucket(arg, str(gran.value))  # type: ignore[union-attr]
+        if fn in ("substr", "substring"):
+            arg = self.expr()
+            self.expect_op(",")
+            start = self.expr()
+            length = None
+            if self.accept_op(","):
+                length = self.expr()
+            self.expect_op(")")
+            args = (int(start.value),)  # type: ignore[union-attr]
+            if length is not None:
+                args = args + (int(length.value),)  # type: ignore[union-attr]
+            return E.StrFunc("substr", arg, args)
+        if fn in ("upper", "lower"):
+            arg = self.expr()
+            self.expect_op(")")
+            return E.StrFunc(fn, arg)
+        if fn in ("year", "month", "day", "hour", "minute"):
+            arg = self.expr()
+            self.expect_op(")")
+            return E.TimeExtract(fn, arg)
+        if fn in ("abs", "floor", "ceil", "sqrt", "exp", "ln"):
+            arg = self.expr()
+            self.expect_op(")")
+            return E.UnaryOp(fn, arg)
+        if fn == "coalesce":
+            args = self._expr_list()
+            self.expect_op(")")
+            out = args[-1]
+            for a in reversed(args[:-1]):
+                out = E.IfExpr(E.Comparison("!=", a, E.Literal(None)), a, out)
+            return out
+        raise ParseError(f"unknown function {fn!r}")
+
+
+# ---------------------------------------------------------------------------
+# Analyzer: SelectStmt -> logical plan
+# ---------------------------------------------------------------------------
+
+
+def _expr_eq(a: E.Expr, b: E.Expr) -> bool:
+    return a == b
+
+
+def _find_group(e: E.Expr, group_keys: Sequence[E.Expr]) -> Optional[int]:
+    for i, g in enumerate(group_keys):
+        if _expr_eq(e, g):
+            return i
+    return None
+
+
+def _contains_agg(e: E.Expr) -> bool:
+    if isinstance(e, AggCall):
+        return True
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr) and _contains_agg(v):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, E.Expr) and _contains_agg(x) for x in v
+        ):
+            return True
+    return False
+
+
+def _strip_qualifiers(e: E.Expr, aliases: Dict[str, str]) -> E.Expr:
+    """table.col -> col (the engine's datasources are flat); alias tables
+    resolve through the FROM-clause alias map."""
+    if isinstance(e, E.Col) and "." in e.name:
+        return E.Col(e.name.split(".", 1)[1])
+    if isinstance(e, (E.Literal, E.AggRef)):
+        return e
+    kw = {}
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr):
+            kw[f.name] = _strip_qualifiers(v, aliases)
+        elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+            kw[f.name] = tuple(_strip_qualifiers(x, aliases) for x in v)
+        else:
+            kw[f.name] = v
+    return type(e)(**kw)
+
+
+class Analyzer:
+    """SelectStmt -> logical plan (the Catalyst-analyzer analog)."""
+
+    def __init__(self, stmt: SelectStmt, aliases: Dict[str, str]):
+        self.stmt = stmt
+        self.aliases = aliases
+        self.agg_exprs: List[L.AggExpr] = []
+        self.agg_by_key: Dict[str, str] = {}  # str(AggCall) -> assigned name
+
+    def to_logical(self) -> L.LogicalPlan:
+        stmt = self.stmt
+        base = self._from_clause(stmt.table)
+        if stmt.where is not None:
+            base = L.Filter(_strip_qualifiers(stmt.where, self.aliases), base)
+
+        has_agg = (
+            bool(stmt.group_by)
+            or any(_contains_agg(e) for _, e in stmt.items)
+            or (stmt.having is not None)
+        )
+        if not has_agg:
+            exprs = []
+            for alias, e in stmt.items:
+                if isinstance(e, E.Col) and e.name == "*":
+                    exprs = []  # SELECT * -> project all (planner fills)
+                    break
+                e = _strip_qualifiers(e, self.aliases)
+                exprs.append((alias or _auto_name(e), e))
+            plan: L.LogicalPlan = (
+                L.Project(tuple(exprs), base) if exprs else base
+            )
+            plan = self._order_limit(plan, post_agg=False)
+            return plan
+
+        # aggregate query
+        group_exprs: List[Tuple[str, E.Expr]] = []
+        group_keys: List[E.Expr] = []
+        alias_of_item: Dict[str, E.Expr] = {}
+        for alias, e in stmt.items:
+            if alias is not None:
+                alias_of_item[alias] = e
+        for ge in stmt.group_by:
+            ge = self._resolve_group_ref(ge, stmt.items)
+            ge_s = _strip_qualifiers(ge, self.aliases)
+            name = None
+            for alias, ie in stmt.items:
+                if _expr_eq(_strip_qualifiers(ie, self.aliases), ge_s):
+                    name = alias or _auto_name(ge_s)
+                    break
+            group_exprs.append((name or _auto_name(ge_s), ge_s))
+            group_keys.append(ge_s)
+
+        # SELECT items -> outputs
+        post_exprs: List[Tuple[str, E.Expr]] = []
+        for alias, e in stmt.items:
+            es = _strip_qualifiers(e, self.aliases)
+            if _contains_agg(es):
+                name = alias or _auto_name(es)
+                post = self._lift_aggs(es, name)
+                post_exprs.append((name, post))
+            else:
+                idx = _find_group(es, group_keys)
+                if idx is None:
+                    raise ParseError(
+                        f"SELECT item {e} is neither aggregated nor grouped"
+                    )
+                post_exprs.append(
+                    (alias or group_exprs[idx][0], E.Col(group_exprs[idx][0]))
+                )
+
+        having_expr = None
+        if stmt.having is not None:
+            hs = _strip_qualifiers(stmt.having, self.aliases)
+            having_expr = self._lift_aggs(hs, "having")
+
+        grouping_sets: Tuple[Tuple[int, ...], ...] = ()
+        k = len(group_exprs)
+        if stmt.group_mode == "cube":
+            grouping_sets = tuple(
+                tuple(i for i in range(k) if (m >> i) & 1)
+                for m in range(1 << k)
+            )
+        elif stmt.group_mode == "rollup":
+            grouping_sets = tuple(
+                tuple(range(j)) for j in range(k, -1, -1)
+            )
+        elif stmt.group_mode == "sets":
+            sets = []
+            for s in stmt.grouping_sets:
+                idxs = []
+                for e in s:
+                    es = _strip_qualifiers(
+                        self._resolve_group_ref(e, stmt.items), self.aliases
+                    )
+                    i = _find_group(es, group_keys)
+                    if i is None:
+                        raise ParseError(f"grouping set expr {e} not in GROUP BY")
+                    idxs.append(i)
+                sets.append(tuple(idxs))
+            grouping_sets = tuple(sets)
+
+        plan = L.Aggregate(
+            group_exprs=tuple(group_exprs),
+            agg_exprs=tuple(self.agg_exprs),
+            child=base,
+            post_exprs=tuple(post_exprs),
+            grouping_sets=grouping_sets,
+        )
+        if having_expr is not None:
+            plan = L.Having(having_expr, plan)
+        return self._order_limit(plan, post_agg=True)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _from_clause(self, t) -> L.LogicalPlan:
+        if isinstance(t, str):
+            return L.Scan(t)
+        assert isinstance(t, JoinClause)
+        left = self._from_clause(t.left)
+        lk, rk = [], []
+        for l, r in t.on:
+            lk.append(self._resolve_qualified(l))
+            rk.append(self._resolve_qualified(r))
+        return L.Join(left, L.Scan(t.right), tuple(lk), tuple(rk), t.how)
+
+    def _resolve_qualified(self, name: str) -> str:
+        if "." in name:
+            tbl, col = name.split(".", 1)
+            tbl = self.aliases.get(tbl, tbl)
+            return f"{tbl}.{col}"
+        return name
+
+    def _resolve_group_ref(self, ge: E.Expr, items) -> E.Expr:
+        # positional GROUP BY 1,2 and alias references
+        if isinstance(ge, E.Literal) and isinstance(ge.value, int):
+            idx = ge.value - 1
+            if not (0 <= idx < len(items)):
+                raise ParseError(f"GROUP BY position {ge.value} out of range")
+            return items[idx][1]
+        if isinstance(ge, E.Col):
+            for alias, ie in items:
+                if alias == ge.name and not _contains_agg(ie):
+                    return ie
+        return ge
+
+    def _lift_aggs(self, e: E.Expr, hint: str) -> E.Expr:
+        """Replace AggCall subtrees with AggRefs, accumulating agg_exprs."""
+        if isinstance(e, AggCall):
+            key = str(e) + (f" FILTER {e.filter}" if e.filter else "")
+            if key in self.agg_by_key:
+                return E.AggRef(self.agg_by_key[key])
+            if isinstance(e, AggCall) and _is_simple_output(e, hint):
+                name = hint
+            else:
+                name = f"__agg{len(self.agg_exprs)}"
+            fn = e.fn
+            if fn == "count" and e.distinct:
+                fn = "count_distinct"
+            self.agg_exprs.append(
+                L.AggExpr(name, fn, e.arg, e.distinct, e.filter)
+            )
+            self.agg_by_key[key] = name
+            return E.AggRef(name)
+        if isinstance(e, (E.Literal, E.Col, E.AggRef)):
+            return e
+        kw = {}
+        for f in dataclasses.fields(e):  # type: ignore[arg-type]
+            v = getattr(e, f.name)
+            if isinstance(v, E.Expr):
+                kw[f.name] = self._lift_aggs(v, hint)
+            elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+                kw[f.name] = tuple(self._lift_aggs(x, hint) for x in v)
+            else:
+                kw[f.name] = v
+        return type(e)(**kw)
+
+    def _order_limit(self, plan: L.LogicalPlan, post_agg: bool) -> L.LogicalPlan:
+        stmt = self.stmt
+        if stmt.order_by:
+            keys = []
+            for e, asc in stmt.order_by:
+                es = _strip_qualifiers(e, self.aliases)
+                if post_agg and _contains_agg(es):
+                    es = self._lift_aggs(es, _auto_name(es))
+                    if not isinstance(es, E.AggRef):
+                        raise ParseError(
+                            "ORDER BY over aggregate expressions must be "
+                            "a plain aggregate or a SELECT alias"
+                        )
+                elif isinstance(es, E.Literal) and isinstance(es.value, int):
+                    idx = es.value - 1
+                    alias, ie = stmt.items[idx]
+                    es = E.Col(alias or _auto_name(
+                        _strip_qualifiers(ie, self.aliases)
+                    ))
+                keys.append(L.SortKey(es, asc))
+            plan = L.Sort(tuple(keys), plan)
+        if stmt.limit is not None or stmt.offset:
+            plan = L.Limit(
+                stmt.limit if stmt.limit is not None else (1 << 62),
+                plan,
+                stmt.offset,
+            )
+        return plan
+
+
+def _is_simple_output(e: AggCall, hint: str) -> bool:
+    return not hint.startswith("__")
+
+
+def _auto_name(e: E.Expr) -> str:
+    if isinstance(e, E.Col):
+        return e.name
+    if isinstance(e, AggCall):
+        base = e.fn
+        if isinstance(e.arg, E.Col):
+            return f"{base}_{e.arg.name}"
+        return base
+    if isinstance(e, E.TimeBucket):
+        return "__time_bucket"
+    s = "".join(ch if ch.isalnum() else "_" for ch in str(e))[:40]
+    return f"expr_{s}" if s else "expr"
+
+
+def parse_sql(sql: str) -> Tuple[L.LogicalPlan, bool, List[str]]:
+    """Returns (logical plan, explain?, SELECT-order output names)."""
+    p = Parser(sql)
+    stmt = p.parse()
+    analyzer = Analyzer(stmt, p.aliases)
+    plan = analyzer.to_logical()
+    out_names: List[str] = []
+    for alias, e in stmt.items:
+        if isinstance(e, E.Col) and e.name == "*":
+            out_names = []
+            break
+        es = _strip_qualifiers(e, p.aliases)
+        out_names.append(alias or _auto_name(es))
+    return plan, stmt.explain, out_names

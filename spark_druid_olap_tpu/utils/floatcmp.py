@@ -1,0 +1,85 @@
+"""Exact f32-column vs f64-literal comparison semantics.
+
+SQL promotes a float32 column compared against a decimal literal to double;
+a TPU engine computing in f32 would silently flip rows whose f32 value
+round-trips above/below the literal (e.g. f32(0.05) > 0.05 in f64, == in
+f32).  Comparing correctly does NOT require f64 on device: for f32 x and f64
+threshold c,
+
+    x >  c  ⇔  x >= (smallest f32 strictly greater than c)
+    x >= c  ⇔  x >= (smallest f32 >= c)
+    x <  c  ⇔  x <= (largest  f32 strictly less  than c)
+    x <= c  ⇔  x <= (largest  f32 <= c)
+    x == c  ⇔  c exactly representable in f32 ∧ x == f32(c)
+
+so each predicate compiles to a single f32 compare against a host-adjusted
+threshold — exact double semantics at f32 speed (SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+_INF32 = np.float32(np.inf)
+
+
+def _up(c: float) -> np.float32:
+    """Smallest f32 strictly greater than f64 c."""
+    f = np.float32(c)
+    return f if float(f) > c else np.nextafter(f, _INF32)
+
+
+def _ceil(c: float) -> np.float32:
+    f = np.float32(c)
+    return f if float(f) >= c else np.nextafter(f, _INF32)
+
+
+def _down(c: float) -> np.float32:
+    f = np.float32(c)
+    return f if float(f) < c else np.nextafter(f, -_INF32)
+
+
+def _floor(c: float) -> np.float32:
+    f = np.float32(c)
+    return f if float(f) <= c else np.nextafter(f, -_INF32)
+
+
+def f32_compare_threshold(op: str, c: float) -> Tuple[str, np.float32]:
+    """(new_op, f32 threshold) such that `x new_op threshold` in f32 equals
+    `x op c` in f64, for all f32 x."""
+    if op == ">":
+        return ">=", _up(c)
+    if op == ">=":
+        return ">=", _ceil(c)
+    if op == "<":
+        return "<=", _down(c)
+    if op == "<=":
+        return "<=", _floor(c)
+    raise ValueError(op)
+
+
+def f32_representable(c: float) -> bool:
+    return float(np.float32(c)) == float(c)
+
+
+def f32_adjusted_compare(op: str, c: float):
+    """Precompiled comparison `x op c` with f64-exact semantics for f32 x.
+    Returns fn(x_f32_array) -> bool array; all threshold math happens here,
+    once, at compile time (shared by plan/expr.py and ops/filters.py)."""
+    import jax.numpy as jnp
+
+    if op in ("==", "!="):
+        if not f32_representable(c):
+            if op == "==":
+                return lambda x: jnp.zeros(x.shape, jnp.bool_)
+            return lambda x: jnp.ones(x.shape, jnp.bool_)
+        cv = np.float32(c)
+        if op == "==":
+            return lambda x: x == cv
+        return lambda x: x != cv
+    adj_op, thr = f32_compare_threshold(op, c)
+    if adj_op == ">=":
+        return lambda x: x >= thr
+    return lambda x: x <= thr

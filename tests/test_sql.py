@@ -1,0 +1,250 @@
+"""SQL end-to-end: parse -> plan -> execute -> parity vs pandas oracle.
+
+The analog of the reference's `DruidRewritesTest` + `TPCHTest` suites
+(SURVEY.md §4 `[U]`): run SQL, assert the rewrite produced the expected query
+type (the "plan contains DruidQuery" assertion), and check results against an
+un-accelerated oracle on the same data."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu import TPUOlapContext
+from spark_druid_olap_tpu.models.query import (
+    GroupByQuery,
+    ScanQuery,
+    TimeseriesQuery,
+    TopNQuery,
+)
+from spark_druid_olap_tpu.utils import datagen
+
+
+@pytest.fixture(scope="module")
+def ctx(lineitem_cols, ssb_cols):
+    c = TPUOlapContext()
+    c.register_table(
+        "lineitem",
+        lineitem_cols,
+        dimensions=datagen.LINEITEM_DIMS,
+        metrics=datagen.LINEITEM_METRICS,
+        time_column="l_shipdate",
+        rows_per_segment=16384,
+    )
+    c.register_table(
+        "lineorder",
+        ssb_cols,
+        dimensions=datagen.SSB_DIMS,
+        metrics=datagen.SSB_METRICS,
+        time_column="lo_orderdate",
+        rows_per_segment=16384,
+    )
+    return c
+
+
+def test_tpch_q1_sql(ctx, lineitem_cols):
+    """BASELINE config #1 via the SQL surface."""
+    got = ctx.sql(
+        """
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice) AS sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               avg(l_quantity) AS avg_qty,
+               avg(l_extendedprice) AS avg_price,
+               avg(l_discount) AS avg_disc,
+               count(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= DATE '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+        """
+    )
+    c = lineitem_cols
+    cutoff = int(np.datetime64("1998-09-02").astype("datetime64[ms]").astype(np.int64))
+    m = np.asarray(c["l_shipdate"]) <= cutoff
+    df = pd.DataFrame({k: np.asarray(v)[m] for k, v in c.items()})
+    df["dp"] = df.l_extendedprice.astype(np.float64) * (1 - df.l_discount)
+    df["ch"] = df.dp * (1 + df.l_tax)
+    want = (
+        df.groupby(["l_returnflag", "l_linestatus"], sort=True)
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_disc_price=("dp", "sum"),
+            sum_charge=("ch", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            count_order=("l_quantity", "size"),
+        )
+        .reset_index()
+    )
+    assert list(got.columns) == [
+        "l_returnflag", "l_linestatus", "sum_qty", "sum_base_price",
+        "sum_disc_price", "sum_charge", "avg_qty", "avg_price", "avg_disc",
+        "count_order",
+    ]
+    np.testing.assert_array_equal(got.count_order, want.count_order)
+    np.testing.assert_allclose(got.sum_qty, want.sum_qty, rtol=2e-5)
+    np.testing.assert_allclose(got.sum_disc_price, want.sum_disc_price, rtol=2e-5)
+    np.testing.assert_allclose(got.sum_charge, want.sum_charge, rtol=2e-5)
+    np.testing.assert_allclose(got.avg_qty, want.avg_qty, rtol=2e-5)
+
+
+def test_rewrite_types(ctx):
+    """The 'plan contains DruidQuery' analog: most specific spec wins."""
+    rw = ctx.plan_sql(
+        "SELECT date_trunc('hour', l_shipdate) h, count(*) n "
+        "FROM lineitem GROUP BY date_trunc('hour', l_shipdate)"
+    )
+    assert isinstance(rw.query, TimeseriesQuery)
+
+    rw = ctx.plan_sql(
+        "SELECT l_returnflag, sum(l_quantity) q FROM lineitem "
+        "GROUP BY l_returnflag ORDER BY q DESC LIMIT 2"
+    )
+    assert isinstance(rw.query, TopNQuery)
+
+    rw = ctx.plan_sql(
+        "SELECT l_returnflag, l_linestatus, count(*) n FROM lineitem "
+        "GROUP BY l_returnflag, l_linestatus"
+    )
+    assert isinstance(rw.query, GroupByQuery)
+
+    rw = ctx.plan_sql("SELECT l_returnflag FROM lineitem WHERE l_quantity > 49")
+    assert isinstance(rw.query, ScanQuery)
+
+
+def test_interval_extraction(ctx):
+    rw = ctx.plan_sql(
+        "SELECT count(*) n FROM lineitem "
+        "WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'"
+    )
+    assert rw.query.intervals, "time predicates must narrow the interval"
+    (lo, hi), = rw.query.intervals
+    assert np.datetime64(int(lo), "ms") == np.datetime64("1994-01-01")
+    assert np.datetime64(int(hi), "ms") == np.datetime64("1995-01-01")
+    assert rw.query.filter is None, "time bounds must not duplicate as filters"
+
+
+def test_having_and_alias_order(ctx, lineitem_cols):
+    got = ctx.sql(
+        "SELECT l_returnflag f, count(*) n FROM lineitem "
+        "GROUP BY l_returnflag HAVING count(*) > 1000 ORDER BY n DESC"
+    )
+    c = pd.Series(np.asarray(lineitem_cols["l_returnflag"], dtype=object))
+    want = c.value_counts()
+    want = want[want > 1000].sort_values(ascending=False)
+    assert list(got.f) == list(want.index)
+    np.testing.assert_array_equal(got.n, want.values)
+
+
+def test_filtered_agg_and_case(ctx, lineitem_cols):
+    got = ctx.sql(
+        "SELECT l_returnflag f, "
+        "count(*) FILTER (WHERE l_linestatus = 'O') AS open_n, "
+        "sum(CASE WHEN l_discount > 0.05 THEN l_quantity ELSE 0 END) AS disc_qty "
+        "FROM lineitem GROUP BY l_returnflag ORDER BY f"
+    )
+    df = pd.DataFrame(
+        {
+            "f": np.asarray(lineitem_cols["l_returnflag"], dtype=object),
+            "s": np.asarray(lineitem_cols["l_linestatus"], dtype=object),
+            "d": np.asarray(lineitem_cols["l_discount"], np.float64),
+            "q": np.asarray(lineitem_cols["l_quantity"], np.float64),
+        }
+    )
+    want_open = df[df.s == "O"].groupby("f").size()
+    want_disc = df.assign(x=np.where(df.d > 0.05, df.q, 0)).groupby("f").x.sum()
+    np.testing.assert_array_equal(got.open_n, want_open.values)
+    np.testing.assert_allclose(got.disc_qty, want_disc.values, rtol=2e-5)
+
+
+def test_approx_count_distinct(ctx, lineitem_cols):
+    got = ctx.sql(
+        "SELECT approx_count_distinct(l_orderkey) u FROM lineitem"
+    )
+    truth = len(np.unique(np.asarray(lineitem_cols["l_orderkey"])))
+    assert abs(int(got.u[0]) - truth) / truth < 0.1
+
+
+def test_cube(ctx, ssb_cols):
+    got = ctx.sql(
+        "SELECT c_region, s_region, sum(lo_revenue) rev "
+        "FROM lineorder GROUP BY CUBE(c_region, s_region)"
+    )
+    df = pd.DataFrame(
+        {
+            "c": np.asarray(ssb_cols["c_region"], dtype=object),
+            "s": np.asarray(ssb_cols["s_region"], dtype=object),
+            "r": np.asarray(ssb_cols["lo_revenue"], np.float64),
+        }
+    )
+    # 4 grouping sets: (), (c), (s), (c,s)
+    n_c = df.c.nunique()
+    n_s = df.s.nunique()
+    assert len(got) == 1 + n_c + n_s + n_c * n_s
+    total = got[got.__grouping_id == 3].rev.iloc[0]
+    np.testing.assert_allclose(total, df.r.sum(), rtol=2e-5)
+    full = got[got.__grouping_id == 0]
+    want = df.groupby(["c", "s"]).r.sum().reset_index()
+    np.testing.assert_allclose(
+        full.sort_values(["c_region", "s_region"]).rev.values,
+        want.sort_values(["c", "s"]).r.values,
+        rtol=2e-5,
+    )
+
+
+def test_ssb_q1_1(ctx, ssb_cols):
+    """SSB Q1.1 (BASELINE config #2 shape, flat form)."""
+    got = ctx.sql(
+        "SELECT sum(lo_extendedprice * lo_discount / 100) AS revenue "
+        "FROM lineorder WHERE d_year = 1993 "
+        "AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25"
+    )
+    y = np.asarray(ssb_cols["d_year"])
+    d = np.asarray(ssb_cols["lo_discount"], np.float64)
+    q = np.asarray(ssb_cols["lo_quantity"], np.float64)
+    p = np.asarray(ssb_cols["lo_extendedprice"], np.float64)
+    m = (y == 1993) & (d >= 1) & (d <= 3) & (q < 25)
+    np.testing.assert_allclose(got.revenue[0], (p[m] * d[m] / 100).sum(), rtol=2e-5)
+
+
+def test_explain(ctx):
+    out = ctx.explain(
+        "SELECT l_returnflag, sum(l_quantity) FROM lineitem GROUP BY l_returnflag"
+    )
+    assert "Logical Plan" in out
+    assert "groupBy" in out
+    assert "TPUAggregateScan" in out
+
+
+def test_scan_query(ctx, lineitem_cols):
+    got = ctx.sql(
+        "SELECT l_returnflag, l_quantity FROM lineitem "
+        "WHERE l_quantity >= 50 LIMIT 37"
+    )
+    assert list(got.columns) == ["l_returnflag", "l_quantity"]
+    assert len(got) == 37
+    assert (got.l_quantity >= 50).all()
+
+
+def test_dataframe_builder(ctx, lineitem_cols):
+    from spark_druid_olap_tpu.plan.expr import col
+
+    got = (
+        ctx.table("lineitem")
+        .filter(col("l_linestatus").eq("F"))
+        .group_by("l_returnflag")
+        .agg(n=("count", None), qty=("sum", "l_quantity"))
+        .order_by("l_returnflag")
+        .collect()
+    )
+    df = pd.DataFrame(
+        {
+            "f": np.asarray(lineitem_cols["l_returnflag"], dtype=object),
+            "s": np.asarray(lineitem_cols["l_linestatus"], dtype=object),
+            "q": np.asarray(lineitem_cols["l_quantity"], np.float64),
+        }
+    )
+    want = df[df.s == "F"].groupby("f").agg(n=("q", "size"), qty=("q", "sum"))
+    np.testing.assert_array_equal(got.n, want.n.values)
+    np.testing.assert_allclose(got.qty, want.qty.values, rtol=2e-5)
