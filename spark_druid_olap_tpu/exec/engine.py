@@ -546,6 +546,7 @@ class Engine:
 
     def __init__(self, strategy: str = "auto"):
         self.strategy = strategy
+        self._pallas_broken = False  # set on first Mosaic-compile failure
         self._device_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
         # (query-json, datasource, strategy) -> jitted per-segment program.
         # One fused XLA program per query shape: without this, every eager op
@@ -639,7 +640,29 @@ class Engine:
             cols = self._device_cols(seg, need)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]
-            s, mn, mx, sk = seg_fn(cols)
+            try:
+                s, mn, mx, sk = seg_fn(cols)
+            except Exception:
+                # Auto-selected Pallas may fail to Mosaic-compile on exotic
+                # backends: retry once on the XLA dense path.  Only 'auto'
+                # falls back (explicit strategy='pallas' should surface the
+                # error), only pallas-keyed programs are evicted, and if the
+                # dense retry fails too the failure wasn't Pallas — unflag.
+                if (
+                    self.strategy != "auto"
+                    or self._pallas_broken
+                    or self._resolve_strategy(G) != "pallas"
+                ):
+                    raise
+                self._pallas_broken = True
+                for k in [k for k in self._query_fn_cache if k[2] == "pallas"]:
+                    del self._query_fn_cache[k]
+                seg_fn = self._segment_program(q, ds, lowering)
+                try:
+                    s, mn, mx, sk = seg_fn(cols)
+                except Exception:
+                    self._pallas_broken = False
+                    raise
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
@@ -658,6 +681,15 @@ class Engine:
                     )
         return dims, la, G, sums, mins, maxs, sketch_states
 
+    def _resolve_strategy(self, num_groups: int) -> str:
+        """Resolve 'auto' to a concrete kernel strategy (ops.groupby's shared
+        resolver + this engine's compile-failure fallback flag)."""
+        from ..ops.groupby import resolve_strategy
+
+        return resolve_strategy(
+            self.strategy, num_groups, pallas_ok=not self._pallas_broken
+        )
+
     def _segment_program(
         self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
     ) -> Callable:
@@ -667,16 +699,16 @@ class Engine:
         into one engine pass per segment."""
         import json as _json
 
+        la, G = lowering.la, lowering.num_groups
+        strategy = self._resolve_strategy(G)
         key = (
             _json.dumps(q.to_druid(), sort_keys=True, default=str),
             schema_signature(ds),  # a re-ingested datasource (new dict
             # cardinalities => new G) must not reuse a stale program
-            self.strategy,
+            strategy,
         )
         if key in self._query_fn_cache:
             return self._query_fn_cache[key]
-        la, G = lowering.la, lowering.num_groups
-        strategy = self.strategy
 
         from ..ops import hll as hll_ops
         from ..ops import theta as theta_ops

@@ -196,6 +196,22 @@ def scatter_partial_aggregate(
     return sums, mins, maxs
 
 
+def resolve_strategy(
+    strategy: str, num_groups: int, pallas_ok: bool = True
+) -> str:
+    """Single source of truth for 'auto' strategy resolution (shared by this
+    dispatcher and Engine's program-cache keying)."""
+    if strategy != "auto":
+        return strategy
+    if num_groups > DENSE_MAX_GROUPS:
+        return "segment"
+    from .pallas_groupby import pallas_available
+
+    if pallas_ok and pallas_available():
+        return "pallas"
+    return "dense"
+
+
 def partial_aggregate(
     gid,
     mask,
@@ -208,9 +224,20 @@ def partial_aggregate(
     strategy: str = "auto",
     block_rows: Optional[int] = None,
 ):
-    """Strategy dispatcher.  'auto' uses dense one-hot below DENSE_MAX_GROUPS."""
+    """Strategy dispatcher.  'auto' uses the Pallas kernel on TPU (dense
+    one-hot in VMEM) below DENSE_MAX_GROUPS, the XLA scan on other backends,
+    scatter above the dense cutover."""
     if strategy == "auto":
-        strategy = "dense" if num_groups <= DENSE_MAX_GROUPS else "segment"
+        strategy = resolve_strategy("auto", num_groups)
+    if strategy == "pallas":
+        from .pallas_groupby import pallas_available, pallas_partial_aggregate
+
+        interpret = not pallas_available()
+        return pallas_partial_aggregate(
+            gid, mask, sum_values, minmax_values, minmax_masks,
+            num_groups=num_groups, num_min=num_min, num_max=num_max,
+            interpret=interpret,
+        )
     if strategy in ("dense", "onehot"):
         br = block_rows or choose_block_rows(gid.shape[0], num_groups)
         # shrink to divide R (segments are ROW_PAD-padded so 1024 always divides)
