@@ -24,9 +24,16 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import functools
+import itertools
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+# Process-unique segment identity: device-residency and compiled-program
+# caches key on this, so re-ingesting a same-name datasource (same segment
+# ids, different arrays) can never hit a stale cache entry.
+_SEGMENT_UIDS = itertools.count(1)
 
 # Row-count padding granularity.  8*128 = one float32 VMEM tile lane*sublane
 # footprint; keeping row blocks a multiple of this keeps Pallas BlockSpecs and
@@ -38,19 +45,76 @@ NULL_ID = -1  # dictionary code for null dimension values
 
 @dataclasses.dataclass(frozen=True)
 class DimensionDict:
-    """Dictionary for one string dimension: sorted unique values <-> int32 ids.
+    """Dictionary for one dimension: sorted unique values <-> int32 ids.
 
     Sorted order is load-bearing: it makes dictionary codes order-preserving,
     so range/bound filters on strings can be pushed down as integer range
     filters on codes (the reference pushes Druid `bound` filters with
     lexicographic ordering; sorted dicts give us the same for free).
+
+    Integer-typed dimensions (years, yearmonth codes, bucket ids, ...) keep
+    their values as python ints sorted numerically, and their codes are the
+    dense rank in the *actual* value domain — NOT the raw value.  This keeps
+    the combined group-id domain tight (d_year spans 7 codes, not 1999), which
+    is what lets the dense one-hot kernel cover the common OLAP case.  Filters
+    translate numeric literals into code space (ops/filters.py); expressions
+    see decoded values via `DecodedView`.
     """
 
-    values: Tuple[str, ...]
+    values: Tuple  # str (string dims, sorted) or int (numeric dims, sorted)
 
     @property
     def cardinality(self) -> int:
         return len(self.values)
+
+    @functools.cached_property
+    def content_key(self) -> int:
+        """Stable hash of the value domain.  Rank codes are data-dependent
+        (code 0 = smallest actual value), so any compiled-program cache keyed
+        on a datasource MUST include this — two same-cardinality dictionaries
+        with different domains give the same codes different meanings."""
+        return hash(self.values)
+
+    @functools.cached_property
+    def numeric_values(self) -> Optional[np.ndarray]:
+        """int64 value array when this is a numeric dictionary, else None."""
+        if self.values and all(
+            isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+            for v in self.values
+        ):
+            return np.asarray(self.values, dtype=np.int64)
+        return None
+
+    def code_of(self, value) -> Optional[int]:
+        """Exact-match dictionary code for a literal (str or numeric), or
+        None when the literal is not in the domain."""
+        nv = self.numeric_values
+        if nv is not None:
+            try:
+                x = float(value)
+            except (TypeError, ValueError):
+                return None
+            if x != int(x):
+                return None
+            i = int(np.searchsorted(nv, int(x)))
+            if i < len(nv) and int(nv[i]) == int(x):
+                return i
+            return None
+        try:
+            return self.values.index(value)
+        except ValueError:
+            return None
+
+    def encode_numeric(self, arr: np.ndarray) -> np.ndarray:
+        """Rank-encode an int column; negatives and out-of-domain -> NULL_ID."""
+        nv = self.numeric_values
+        a = np.asarray(arr).astype(np.int64)
+        if nv is None or len(nv) == 0:
+            # empty domain (all-null / zero-row column): everything is null
+            return np.full(len(a), NULL_ID, dtype=np.int32)
+        idx = np.clip(np.searchsorted(nv, a), 0, len(nv) - 1)
+        ok = (nv[idx] == a) & (a >= 0)
+        return np.where(ok, idx, NULL_ID).astype(np.int32)
 
     def encode(self, col: Sequence[Optional[str]]) -> np.ndarray:
         arr = np.asarray(col, dtype=object)
@@ -121,6 +185,7 @@ class Segment:
     valid: np.ndarray  # bool[n_padded]
     interval: Optional[Tuple[int, int]] = None  # [min_ms, max_ms] of time col
     time_name: Optional[str] = None  # source column name of the time column
+    uid: int = 0  # process-unique identity (see _SEGMENT_UIDS)
 
     @property
     def num_rows_padded(self) -> int:
@@ -207,13 +272,15 @@ def build_datasource(
                 dicts[d] = DimensionDict.build(list(col))
             codes = dicts[d].encode(list(col))
         else:
-            codes = arr.astype(np.int32)
+            raw = arr.astype(np.int64)
             if d not in dicts:
-                hi = int(codes.max()) + 1 if len(codes) else 0
-                dicts[d] = DimensionDict(values=tuple(str(i) for i in range(hi)))
+                uniq = np.unique(raw[raw >= 0]) if len(raw) else raw
+                dicts[d] = DimensionDict(values=tuple(int(v) for v in uniq))
+            codes = dicts[d].encode_numeric(raw)
+        dtype = "long" if dicts[d].numeric_values is not None else "string"
         encoded[d] = codes
         metas.append(
-            ColumnMeta(d, "dimension", "string", cardinality=dicts[d].cardinality)
+            ColumnMeta(d, "dimension", dtype, cardinality=dicts[d].cardinality)
         )
 
     for m in metric_cols:
@@ -260,6 +327,7 @@ def build_datasource(
                 valid=valid,
                 interval=interval,
                 time_name=time_col,
+                uid=next(_SEGMENT_UIDS),
             )
         )
 

@@ -29,7 +29,7 @@ from ..models import aggregations as A
 from ..models import query as Q
 from ..models.dimensions import DimensionSpec
 from ..models.filters import Filter
-from ..ops.filters import compile_filter
+from ..ops.filters import DecodedView, compile_filter
 from ..ops.groupby import (
     DENSE_MAX_GROUPS,
     combine_group_ids,
@@ -67,7 +67,10 @@ def _resolve_dims(
         if spec.extraction is not None:
             # Host-side dictionary rewrite: apply fn to each dict value once,
             # build remap table code -> new code (SURVEY.md dimension-spec row).
-            extracted = spec.extraction.apply_to_dict(list(d.values))
+            # Extraction fns are string fns; numeric dictionaries stringify.
+            extracted = spec.extraction.apply_to_dict(
+                [v if isinstance(v, str) else str(v) for v in d.values]
+            )
             new_vals = sorted(set(extracted))
             index = {v: i for i, v in enumerate(new_vals)}
             remap = np.array([index[v] for v in extracted], dtype=np.int32)
@@ -164,6 +167,7 @@ class LoweredAggs:
     long_valued: Dict[str, bool]
     value_fns: Dict[str, Callable]  # name -> fn(cols) -> f32[R]
     mask_fns: Dict[str, Optional[Callable]]  # name -> extra-mask fn or None
+    count_like: set = dataclasses.field(default_factory=set)  # COUNT aggs
 
 
 def _lower_aggs(
@@ -194,28 +198,26 @@ def _lower_aggs(
         if isinstance(agg, A.Count):
             la.sum_names.append(name)
             la.long_valued[name] = True
+            la.count_like.add(name)
             la.value_fns[name] = lambda cols: None  # ones
         elif isinstance(agg, (A.LongSum, A.DoubleSum)):
             field = agg.field_name
             la.sum_names.append(name)
             la.long_valued[name] = isinstance(agg, A.LongSum)
-            la.value_fns[name] = (
-                lambda cols, field=field: cols[field].astype(jnp.float32)
-            )
+            la.value_fns[name] = _field_value_fn(field, ds)
+            _add_null_skip(la, name, field, ds)
         elif isinstance(agg, (A.LongMin, A.DoubleMin)):
             field = agg.field_name
             la.min_names.append(name)
             la.long_valued[name] = isinstance(agg, A.LongMin)
-            la.value_fns[name] = (
-                lambda cols, field=field: cols[field].astype(jnp.float32)
-            )
+            la.value_fns[name] = _field_value_fn(field, ds)
+            _add_null_skip(la, name, field, ds)
         elif isinstance(agg, (A.LongMax, A.DoubleMax)):
             field = agg.field_name
             la.max_names.append(name)
             la.long_valued[name] = isinstance(agg, A.LongMax)
-            la.value_fns[name] = (
-                lambda cols, field=field: cols[field].astype(jnp.float32)
-            )
+            la.value_fns[name] = _field_value_fn(field, ds)
+            _add_null_skip(la, name, field, ds)
         elif isinstance(agg, A.ExpressionAgg):
             fn = compile_expr(agg.expression)
             target = {
@@ -226,9 +228,10 @@ def _lower_aggs(
             }[agg.base]
             target.append(name)
             la.long_valued[name] = agg.base == "longSum"
-            la.value_fns[name] = (
-                lambda cols, fn=fn: jnp.asarray(fn(cols)).astype(jnp.float32)
-            )
+            dicts = ds.dicts
+            la.value_fns[name] = lambda cols, fn=fn, dicts=dicts: jnp.asarray(
+                fn(DecodedView(cols, dicts))
+            ).astype(jnp.float32)
         elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch)):
             la.sketch_aggs.append(agg)
             la.long_valued[name] = True
@@ -238,6 +241,33 @@ def _lower_aggs(
     for agg in aggs:
         add(agg, None)
     return la
+
+
+def _field_value_fn(field: str, ds: DataSource):
+    """Value reader for sum/min/max: metric columns pass through; numeric-
+    dictionary dimension columns decode rank codes back to values (so
+    sum(d_year)-style aggregates see years, not ranks)."""
+    d = ds.dicts.get(field) if hasattr(ds.dicts, "get") else None
+    if d is not None and d.numeric_values is not None:
+        dicts = ds.dicts
+        return lambda cols, field=field, dicts=dicts: DecodedView(cols, dicts)[
+            field
+        ].astype(jnp.float32)
+    return lambda cols, field=field: cols[field].astype(jnp.float32)
+
+
+def _add_null_skip(la: LoweredAggs, name: str, field: str, ds: DataSource):
+    """SQL aggregates skip NULLs: for a dictionary-dimension field, rows with
+    a null code (-1) must not contribute (they'd otherwise decode to -1 and
+    poison SUM/MIN/MAX).  Metrics have no null representation — no-op."""
+    d = ds.dicts.get(field) if hasattr(ds.dicts, "get") else None
+    if d is None:
+        return
+    nm = lambda cols, field=field: cols[field] >= 0
+    prev = la.mask_fns.get(name)
+    la.mask_fns[name] = (
+        nm if prev is None else lambda cols, p=prev, nm=nm: p(cols) & nm(cols)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -326,12 +356,22 @@ class GroupByLowering:
 
 def schema_signature(ds: DataSource) -> Tuple:
     """Identity of a datasource's schema for program caches: name + per-column
-    kind/cardinality + segment ids.  Two datasources with the same signature
-    lower to the same XLA program shape."""
+    kind/cardinality + dictionary content + segment ids.  Dictionary content
+    matters because rank codes are data-dependent: re-ingesting a same-name
+    datasource with an equal-cardinality but different value domain must MISS
+    the cache (compiled filters bake in literal->code translations)."""
     return (
         ds.name,
-        tuple((c.name, c.kind, c.cardinality) for c in ds.columns),
-        tuple(s.segment_id for s in ds.segments),
+        tuple(
+            (
+                c.name,
+                c.kind,
+                c.cardinality,
+                ds.dicts[c.name].content_key if c.name in ds.dicts else None,
+            )
+            for c in ds.columns
+        ),
+        tuple(s.uid for s in ds.segments),
     )
 
 
@@ -424,10 +464,19 @@ def lower_groupby(q: Q.GroupByQuery, ds: DataSource) -> GroupByLowering:
             "sort-based path not yet wired for this size"
         )
     filter_fn = compile_filter(q.filter, ds) if q.filter is not None else None
-    vcol_fns = {v.name: compile_expr(v.expression) for v in q.virtual_columns}
+    vcol_fns = {
+        v.name: _decoded_expr_fn(v.expression, ds) for v in q.virtual_columns
+    }
     return GroupByLowering(
         q, dims, la, G, _needed_columns(q, ds, dims), filter_fn, vcol_fns
     )
+
+
+def _decoded_expr_fn(expression, ds: DataSource):
+    """Compile an expression so dimension references read decoded values."""
+    fn = compile_expr(expression)
+    dicts = ds.dicts
+    return lambda cols, fn=fn, dicts=dicts: fn(DecodedView(cols, dicts))
 
 
 def _needed_columns(q, ds: DataSource, dims) -> List[str]:
@@ -559,11 +608,11 @@ class Engine:
     def _device_cols(self, seg: Segment, names) -> Dict[str, jnp.ndarray]:
         cols: Dict[str, jnp.ndarray] = {}
         for n in names:
-            key = (seg.segment_id, n)
+            key = (seg.uid, n)
             if key not in self._device_cache:
                 self._device_cache[key] = jnp.asarray(seg.column(n))
             cols[n] = self._device_cache[key]
-        key = (seg.segment_id, "__valid")
+        key = (seg.uid, "__valid")
         if key not in self._device_cache:
             self._device_cache[key] = jnp.asarray(seg.valid)
         cols["__valid"] = self._device_cache[key]
@@ -779,7 +828,10 @@ class Engine:
         import pandas as pd
 
         filter_fn = compile_filter(q.filter, ds) if q.filter is not None else None
-        vcol_fns = {v.name: compile_expr(v.expression) for v in q.virtual_columns}
+        vcol_fns = {
+            v.name: _decoded_expr_fn(v.expression, ds)
+            for v in q.virtual_columns
+        }
         need = [c for c in q.columns if c not in vcol_fns and c != "__time"]
         if q.filter is not None:
             need += [c for c in _filter_columns(q.filter) if c != "__time"]
@@ -834,7 +886,7 @@ class Engine:
             if len(rows) >= q.limit:
                 break
             for v in ds.dicts[dim].values:
-                if needle in v.lower():
+                if needle in str(v).lower():
                     rows.append({"dimension": dim, "value": v})
                     if len(rows) >= q.limit:
                         break
@@ -861,7 +913,12 @@ def finalize_groupby(
 
     rows_per_group = sums[:, 0]
     present = rows_per_group > 0
+    if not dims:
+        # SQL: a global aggregate always yields one row (COUNT=0, SUM/MIN/
+        # MAX=NULL when nothing matched) — never an empty result
+        present = np.ones_like(present, dtype=bool)
     idx = np.nonzero(present)[0].astype(np.int64)
+    empty_group = rows_per_group[idx] == 0
 
     table: Dict[str, np.ndarray] = {}
     # decode combined gid -> per-dimension codes (row-major order)
@@ -878,7 +935,11 @@ def finalize_groupby(
         if n == "__rows":
             continue
         v = sums[idx, j].astype(np.float64)
-        table[n] = np.rint(v).astype(np.int64) if la.long_valued[n] else v
+        if n in la.count_like or not empty_group.any():
+            table[n] = np.rint(v).astype(np.int64) if la.long_valued[n] else v
+        else:
+            # SQL: SUM over zero rows is NULL; COUNT stays 0
+            table[n] = np.where(empty_group, np.nan, v)
     def _finalize_extremum(v: np.ndarray, long_valued: bool) -> np.ndarray:
         v = v.astype(np.float64)
         v = np.where(np.isinf(v), np.nan, v)

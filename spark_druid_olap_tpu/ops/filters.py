@@ -30,6 +30,33 @@ from ..plan.expr import compile_expr
 MaskFn = Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]
 
 
+class DecodedView:
+    """Column mapping for *expression* evaluation: numeric-dictionary
+    dimension codes decode back to their integer values (a device gather the
+    compiler fuses/DCEs); all other columns pass through.  Filters, by
+    contrast, are translated into code space at compile time and read the raw
+    mapping — the two views share the same underlying device arrays."""
+
+    def __init__(self, cols: Mapping, dicts: Mapping):
+        self._cols = cols
+        self._dicts = dicts
+
+    def __getitem__(self, name):
+        c = self._cols[name]
+        d = self._dicts[name] if name in self._dicts else None
+        if d is not None and d.numeric_values is not None:
+            nv = jnp.asarray(d.numeric_values)
+            # null codes (-1) decode to -1, matching the raw-value convention
+            return jnp.where(c >= 0, nv[jnp.maximum(c, 0)], jnp.int64(-1))
+        return c
+
+    def __contains__(self, name):
+        return name in self._cols
+
+    def get(self, name, default=None):
+        return self[name] if name in self._cols else default
+
+
 def _like_to_regex(pattern: str) -> str:
     out = []
     for ch in pattern:
@@ -52,9 +79,8 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
             d = ds.dicts[dim]
             if f.value is None:
                 return lambda cols: cols[dim] == jnp.int32(-1)
-            try:
-                code = d.values.index(f.value)
-            except ValueError:
+            code = d.code_of(f.value)
+            if code is None:
                 return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
             return lambda cols: cols[dim] == jnp.int32(code)
         # numeric column equality
@@ -66,7 +92,7 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
         if dim in ds.dicts:
             d = ds.dicts[dim]
             codes = np.array(
-                [d.values.index(v) for v in f.values if v in d.values],
+                [c for c in (d.code_of(v) for v in f.values) if c is not None],
                 dtype=np.int32,
             )
         else:
@@ -77,6 +103,52 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
 
     if isinstance(f, F.Bound):
         dim = f.dimension
+        nv = ds.dicts[dim].numeric_values if dim in ds.dicts else None
+        if nv is not None:
+            # numeric dictionary: value bounds -> dense-code bounds (sound:
+            # codes are the numeric rank, so value order == code order).
+            # Honors an explicit lexicographic ordering, and falls back to
+            # lexicographic when a bound literal isn't numeric.
+            use_numeric = f.ordering != "lexicographic"
+            lo_f = hi_f = None
+            if use_numeric:
+                try:
+                    lo_f = float(f.lower) if f.lower is not None else None
+                    hi_f = float(f.upper) if f.upper is not None else None
+                except (TypeError, ValueError):
+                    use_numeric = False
+            if use_numeric:
+                lo_code = hi_code = None
+                if lo_f is not None:
+                    side = "right" if f.lower_strict else "left"
+                    lo_code = int(np.searchsorted(nv, lo_f, side=side))
+                if hi_f is not None:
+                    side = "left" if f.upper_strict else "right"
+                    hi_code = int(np.searchsorted(nv, hi_f, side=side)) - 1
+
+                def bound_numdict(cols, lo=lo_code, hi=hi_code, dim=dim):
+                    c = cols[dim]
+                    m = c >= 0
+                    if lo is not None:
+                        m = m & (c >= lo)
+                    if hi is not None:
+                        m = m & (c <= hi)
+                    return m
+
+                return bound_numdict
+            # lexicographic semantics over a numerically-sorted domain: the
+            # two orders differ, so compare stringified values per code and
+            # push the matching code set (O(dictionary), like Regex)
+            vals = np.asarray([str(v) for v in ds.dicts[dim].values], dtype=str)
+            ok = np.ones(len(vals), dtype=bool)
+            if f.lower is not None:
+                ok &= (vals > f.lower) if f.lower_strict else (vals >= f.lower)
+            if f.upper is not None:
+                ok &= (vals < f.upper) if f.upper_strict else (vals <= f.upper)
+            codes = np.nonzero(ok)[0].astype(np.int32)
+            if len(codes) == 0:
+                return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
+            return lambda cols: jnp.isin(cols[dim], codes)
         if dim in ds.dicts and f.ordering == "lexicographic":
             vals = np.asarray(ds.dicts[dim].values, dtype=str)
             lo_code = hi_code = None
@@ -137,7 +209,8 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
         rx = re.compile(pat)
         d = ds.dicts[dim]
         codes = np.array(
-            [i for i, v in enumerate(d.values) if rx.search(v)], dtype=np.int32
+            [i for i, v in enumerate(d.values) if rx.search(str(v))],
+            dtype=np.int32,
         )
         if len(codes) == 0:
             return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
@@ -170,7 +243,10 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
 
     if isinstance(f, F.ExpressionFilter):
         fn = compile_expr(f.expression)
-        return lambda cols: jnp.asarray(fn(cols)).astype(jnp.bool_)
+        dicts = ds.dicts
+        return lambda cols: jnp.asarray(
+            fn(DecodedView(cols, dicts))
+        ).astype(jnp.bool_)
 
     raise TypeError(f"cannot compile filter {f!r}")
 

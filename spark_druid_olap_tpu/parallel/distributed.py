@@ -93,7 +93,7 @@ class DistributedEngine:
         chunk = nd * ROW_PAD
         padded = -(-max(total, 1) // chunk) * chunk
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        seg_sig = tuple(s.segment_id for s in segs)
+        seg_sig = tuple(s.uid for s in segs)
 
         def build(name: str, fill) -> jax.Array:
             key = (ds.name, name, nd, seg_sig)

@@ -208,6 +208,35 @@ def test_ssb_q1_1(ctx, ssb_cols):
     np.testing.assert_allclose(got.revenue[0], (p[m] * d[m] / 100).sum(), rtol=2e-5)
 
 
+def test_global_agg_empty_match(ctx):
+    """SQL: a global aggregate over zero matching rows yields ONE row with
+    COUNT=0 and NULL sums/extrema — never an empty frame."""
+    got = ctx.sql(
+        "SELECT count(*) n, sum(lo_revenue) s, min(lo_quantity) mn "
+        "FROM lineorder WHERE d_year = 2050"
+    )
+    assert len(got) == 1
+    assert int(got.n[0]) == 0
+    assert np.isnan(got.s[0]) and np.isnan(got.mn[0])
+
+
+def test_numeric_dim_dictionary_tightness(ctx, ssb_cols):
+    """Integer dims are rank-encoded against their actual value domain, so
+    group cardinality stays tight (d_year: 7 codes, not max-value codes)."""
+    ds = ctx.catalog.get("lineorder")
+    assert ds.cardinality("d_year") == len(np.unique(ssb_cols["d_year"]))
+    got = ctx.sql(
+        "SELECT d_yearmonthnum ym, count(*) n FROM lineorder "
+        "WHERE d_yearmonthnum >= 199401 AND d_yearmonthnum <= 199403 "
+        "GROUP BY d_yearmonthnum ORDER BY ym"
+    )
+    ym = np.asarray(ssb_cols["d_yearmonthnum"])
+    m = (ym >= 199401) & (ym <= 199403)
+    want = pd.Series(ym[m]).value_counts().sort_index()
+    assert list(got.ym) == list(want.index)
+    np.testing.assert_array_equal(got.n, want.values)
+
+
 def test_explain(ctx):
     out = ctx.explain(
         "SELECT l_returnflag, sum(l_quantity) FROM lineitem GROUP BY l_returnflag"
