@@ -58,9 +58,11 @@ class TPUOlapContext:
         star_schema: Optional[StarSchemaInfo] = None,
         column_mapping: Optional[Mapping[str, str]] = None,
         rows_per_segment: int = 1 << 22,
+        dicts: Optional[Mapping] = None,
     ) -> DataSource:
         """Register a datasource from a pandas DataFrame, a dict of numpy
-        columns, or a parquet/csv path (catalog/ingest.py)."""
+        columns, or a parquet/csv path (catalog/ingest.py).  `dicts` supplies
+        pre-built dimension dictionaries for already-encoded columns."""
         from .catalog.ingest import to_columns
 
         cols = to_columns(source)
@@ -75,6 +77,7 @@ class TPUOlapContext:
             metric_cols=list(metrics),
             time_col=time_column,
             rows_per_segment=rows_per_segment,
+            dicts=dicts,
         )
         if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
             star_schema = StarSchemaInfo.from_json(star_schema)

@@ -271,11 +271,15 @@ def build_datasource(
             if d not in dicts:
                 dicts[d] = DimensionDict.build(list(col))
             codes = dicts[d].encode(list(col))
+        elif d in dicts:
+            # caller contract: an integer column WITH a supplied dictionary is
+            # already dictionary-encoded (codes), whatever the dict's kind —
+            # the fast path for pre-flattened star datasources (workloads/)
+            codes = arr.astype(np.int32)
         else:
             raw = arr.astype(np.int64)
-            if d not in dicts:
-                uniq = np.unique(raw[raw >= 0]) if len(raw) else raw
-                dicts[d] = DimensionDict(values=tuple(int(v) for v in uniq))
+            uniq = np.unique(raw[raw >= 0]) if len(raw) else raw
+            dicts[d] = DimensionDict(values=tuple(int(v) for v in uniq))
             codes = dicts[d].encode_numeric(raw)
         dtype = "long" if dicts[d].numeric_values is not None else "string"
         encoded[d] = codes

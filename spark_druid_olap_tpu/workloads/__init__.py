@@ -1,0 +1,3 @@
+"""Benchmark / parity workloads: schema declarations, data generators, query
+suites and oracles for the BASELINE.md configurations (SSB star schema,
+TPC-H Q1, rollup and sketch workloads)."""
