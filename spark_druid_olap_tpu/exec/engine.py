@@ -732,9 +732,18 @@ class Engine:
 
     def _resolve_strategy(self, num_groups: int) -> str:
         """Resolve 'auto' to a concrete kernel strategy (ops.groupby's shared
-        resolver + this engine's compile-failure fallback flag)."""
-        from ..ops.groupby import resolve_strategy
+        resolver + this engine's compile-failure fallback flag).
 
+        "dense" from the cost model is a kernel *class* (one-hot vs scatter);
+        the Pallas kernel is its hand-scheduled implementation and is
+        preferred whenever a TPU backend is present."""
+        from ..ops.groupby import resolve_strategy
+        from ..ops.pallas_groupby import pallas_available
+
+        if self.strategy == "dense":
+            if not self._pallas_broken and pallas_available():
+                return "pallas"
+            return "dense"
         return resolve_strategy(
             self.strategy, num_groups, pallas_ok=not self._pallas_broken
         )
@@ -805,6 +814,12 @@ class Engine:
         dims, la, G, sums, mins, maxs, sketch_states = self._partials_for_query(
             q, ds
         )
+        # ONE device_get for everything: each separate host fetch of a device
+        # buffer pays a full round trip (dozens of ms when the TPU sits
+        # behind a network tunnel); a single pytree fetch pays one.
+        sums, mins, maxs, sketch_states = jax.device_get(
+            (sums, mins, maxs, sketch_states)
+        )
         return finalize_groupby(
             q, dims, la, np.asarray(sums), np.asarray(mins), np.asarray(maxs),
             {k: np.asarray(v) for k, v in sketch_states.items()},
@@ -857,10 +872,14 @@ class Engine:
                 mask = mask & im
             if filter_fn is not None:
                 mask = mask & filter_fn(cols)
-            keep = np.asarray(mask)
+            # one round trip for the mask + all projected columns
+            fetched = jax.device_get(
+                {"__mask": mask, **{c: cols[c] for c in q.columns}}
+            )
+            keep = fetched.pop("__mask")
             data = {}
             for c in q.columns:
-                arr = np.asarray(cols[c])[keep]
+                arr = fetched[c][keep]
                 if c in ds.dicts:
                     arr = ds.dicts[c].decode(arr)
                 data[c] = arr

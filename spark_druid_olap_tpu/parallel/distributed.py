@@ -242,7 +242,8 @@ class DistributedEngine:
         cols, padded = self._global_columns(ds, lowering.columns, q.intervals)
         local_rows = padded // self.mesh.shape[DATA_AXIS]
         run = self._spmd_fn(lowering, local_rows, ds, tuple(cols.keys()))
-        sums, mins, maxs, sk = run(cols)
+        # single host fetch (one round trip — see engine._execute_groupby)
+        sums, mins, maxs, sk = jax.device_get(run(cols))
         return finalize_groupby(
             q,
             lowering.dims,
