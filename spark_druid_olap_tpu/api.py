@@ -63,11 +63,48 @@ class TPUOlapContext:
         """Register a datasource from a pandas DataFrame, a dict of numpy
         columns, or a parquet/csv path (catalog/ingest.py).  `dicts` supplies
         pre-built dimension dictionaries for already-encoded columns."""
-        from .catalog.ingest import to_columns
+        from .catalog.ingest import to_columns_encoded
 
-        cols = to_columns(source)
+        cols, native_dicts = to_columns_encoded(source)
         if column_mapping:
             cols = {column_mapping.get(k, k): v for k, v in cols.items()}
+            native_dicts = {
+                column_mapping.get(k, k): v for k, v in native_dicts.items()
+            }
+        if dicts:
+            # caller-supplied dictionaries win — by re-encoding the raw
+            # values, never by reinterpreting native rank codes under a
+            # different domain (codes are ranks over the FILE's domain)
+            for k in [k for k in native_dicts if k in dicts]:
+                cols[k] = native_dicts.pop(k).decode(np.asarray(cols[k]))
+        if time_column and time_column in native_dicts:
+            # a string-typed time column arrived as rank codes; translate
+            # through the (tiny) dictionary: parse each distinct value once
+            d = native_dicts.pop(time_column)
+            codes = np.asarray(cols[time_column])
+            try:
+                ms = np.asarray(d.values, dtype="datetime64[ms]").astype(
+                    np.int64
+                )
+                if (codes < 0).any():
+                    raise ValueError("null time values")
+                cols[time_column] = ms[codes]
+            except Exception:
+                # non-datetime strings: surface the raw values so
+                # build_datasource fails loudly (pandas-path behavior)
+                cols[time_column] = d.decode(codes)
+        if native_dicts:
+            merged = dict(native_dicts)
+            if dicts:
+                merged.update(dicts)
+            dicts = merged
+            if not dimensions and not metrics:
+                # encoded string columns are int32 codes now; classify the
+                # ones with a native dictionary as dimensions
+                dims, mets = _infer_schema(cols, time_column)
+                dims += [m for m in mets if m in native_dicts]
+                mets = [m for m in mets if m not in native_dicts]
+                dimensions, metrics = dims, mets
         if not dimensions and not metrics:
             dimensions, metrics = _infer_schema(cols, time_column)
         ds = build_datasource(

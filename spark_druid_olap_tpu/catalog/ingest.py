@@ -37,6 +37,26 @@ def to_columns(source) -> Dict[str, np.ndarray]:
     raise TypeError(f"unsupported source type {type(source).__name__}")
 
 
+def to_columns_encoded(source):
+    """source -> (columns, dicts).
+
+    The single dispatch point for ingest (register_table calls only this).
+    CSV paths use the native C++ single-pass parse + dictionary-encode when
+    the toolchain is available: string columns come back as int32 rank codes
+    with their `DimensionDict` in `dicts`.  Any native failure — missing
+    toolchain, parse error, ragged rows the stricter C parser rejects —
+    falls back to `to_columns` (pandas), which returns no prebuilt
+    dictionaries."""
+    if isinstance(source, str) and source.endswith(".csv"):
+        try:
+            from ..native import csv_decode
+
+            return csv_decode.read_csv_encoded(source)
+        except Exception:
+            pass
+    return to_columns(source), {}
+
+
 def _from_pandas(df) -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
     for c in df.columns:

@@ -694,11 +694,13 @@ class Engine:
             except Exception:
                 # Auto-selected Pallas may fail to Mosaic-compile on exotic
                 # backends: retry once on the XLA dense path.  Only 'auto'
-                # falls back (explicit strategy='pallas' should surface the
-                # error), only pallas-keyed programs are evicted, and if the
-                # dense retry fails too the failure wasn't Pallas — unflag.
+                # and 'dense' (a kernel *class* the cost model picks, which
+                # _resolve_strategy upgrades to Pallas) fall back — explicit
+                # strategy='pallas' should surface the error.  Only
+                # pallas-keyed programs are evicted, and if the dense retry
+                # fails too the failure wasn't Pallas — unflag.
                 if (
-                    self.strategy != "auto"
+                    self.strategy not in ("auto", "dense")
                     or self._pallas_broken
                     or self._resolve_strategy(G) != "pallas"
                 ):
@@ -741,7 +743,13 @@ class Engine:
         from ..ops.pallas_groupby import pallas_available
 
         if self.strategy == "dense":
-            if not self._pallas_broken and pallas_available():
+            from ..ops.groupby import SCATTER_CUTOVER
+
+            if (
+                num_groups <= SCATTER_CUTOVER
+                and not self._pallas_broken
+                and pallas_available()
+            ):
                 return "pallas"
             return "dense"
         return resolve_strategy(

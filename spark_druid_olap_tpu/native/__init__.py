@@ -1,0 +1,127 @@
+"""Native (C++) host-side runtime components, bound via ctypes.
+
+The reference has no native code — its engine is an external Druid cluster
+(SURVEY.md §2 "Native components: NONE in reference").  The obligation moves
+here: the host hot paths around the TPU compute (columnar decode, dictionary
+encoding) are implemented in C++ (`olap_native.cc`) and loaded through a
+plain C ABI.  pybind11 is not available in this image, so bindings are
+ctypes; the library is compiled on first use with g++ and cached next to the
+source.  Every caller has a pure-python fallback — the native layer is an
+accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "olap_native.cc")
+_SO = os.path.join(_HERE, "_olap_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    return os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+
+
+def _build() -> bool:
+    """Compile olap_native.cc -> _olap_native.so.  Atomic (tmp + rename) so
+    concurrent processes can race safely."""
+    tmp = _SO + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", tmp, _SRC,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if necessary; None when no
+    toolchain is available (callers then use their python fallbacks)."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build() and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        _declare(lib)
+        if lib.olap_abi_version() != 1:
+            _build_failed = True
+            return None
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.olap_csv_read.argtypes = [c.c_char_p]
+    lib.olap_csv_read.restype = c.c_void_p
+    lib.olap_csv_error.argtypes = [c.c_void_p]
+    lib.olap_csv_error.restype = c.c_char_p
+    lib.olap_csv_num_rows.argtypes = [c.c_void_p]
+    lib.olap_csv_num_rows.restype = c.c_longlong
+    lib.olap_csv_num_cols.argtypes = [c.c_void_p]
+    lib.olap_csv_num_cols.restype = c.c_int
+    lib.olap_csv_col_name.argtypes = [c.c_void_p, c.c_int]
+    lib.olap_csv_col_name.restype = c.c_char_p
+    lib.olap_csv_col_type.argtypes = [c.c_void_p, c.c_int]
+    lib.olap_csv_col_type.restype = c.c_int
+    lib.olap_csv_col_int64.argtypes = [c.c_void_p, c.c_int, c.c_void_p]
+    lib.olap_csv_col_int64.restype = None
+    lib.olap_csv_col_double.argtypes = [c.c_void_p, c.c_int, c.c_void_p]
+    lib.olap_csv_col_double.restype = None
+    lib.olap_csv_col_codes.argtypes = [c.c_void_p, c.c_int, c.c_void_p]
+    lib.olap_csv_col_codes.restype = None
+    lib.olap_csv_dict_size.argtypes = [c.c_void_p, c.c_int]
+    lib.olap_csv_dict_size.restype = c.c_int
+    lib.olap_csv_dict_value.argtypes = [c.c_void_p, c.c_int, c.c_int]
+    lib.olap_csv_dict_value.restype = c.c_char_p
+    lib.olap_csv_free.argtypes = [c.c_void_p]
+    lib.olap_csv_free.restype = None
+
+    lib.olap_dict_encode.argtypes = [c.POINTER(c.c_char_p), c.c_longlong]
+    lib.olap_dict_encode.restype = c.c_void_p
+    lib.olap_dict_codes.argtypes = [c.c_void_p, c.c_void_p]
+    lib.olap_dict_codes.restype = None
+    lib.olap_dict_size.argtypes = [c.c_void_p]
+    lib.olap_dict_size.restype = c.c_int
+    lib.olap_dict_value.argtypes = [c.c_void_p, c.c_int]
+    lib.olap_dict_value.restype = c.c_char_p
+    lib.olap_dict_free.argtypes = [c.c_void_p]
+    lib.olap_dict_free.restype = None
+    lib.olap_abi_version.argtypes = []
+    lib.olap_abi_version.restype = c.c_int

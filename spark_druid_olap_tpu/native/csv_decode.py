@@ -1,0 +1,121 @@
+"""ctypes bindings over the native CSV decoder (olap_native.cc).
+
+Two read modes:
+
+* `read_csv(path)` — drop-in for the pandas fallback in catalog/ingest.py:
+  string columns come back as object arrays (None for empty fields).
+* `read_csv_encoded(path)` — the fast path register_table uses: string
+  columns come back as int32 rank codes plus a `DimensionDict` (sorted-unique
+  domain, identical contract to catalog/segment.py), so build_datasource
+  skips re-encoding entirely.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Tuple
+
+import numpy as np
+
+from . import load
+
+COL_INT64, COL_DOUBLE, COL_STRING = 0, 1, 2
+
+
+class _Handle:
+    def __init__(self, lib, h):
+        self._lib = lib
+        self._h = h
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.olap_csv_free(self._h)
+            self._h = None
+
+
+def _open(path: str):
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    h = lib.olap_csv_read(path.encode())
+    if not h:
+        raise RuntimeError(f"native csv_read failed for {path!r}")
+    handle = _Handle(lib, h)
+    err = lib.olap_csv_error(h)
+    if err:
+        raise ValueError(f"csv parse error in {path!r}: {err.decode()}")
+    return lib, handle
+
+
+def _columns(lib, handle, decode_strings: bool):
+    h = handle._h
+    n_rows = lib.olap_csv_num_rows(h)
+    n_cols = lib.olap_csv_num_cols(h)
+    cols: Dict[str, np.ndarray] = {}
+    dicts: Dict[str, "DimensionDict"] = {}
+    from ..catalog.segment import DimensionDict
+
+    for c in range(n_cols):
+        name = lib.olap_csv_col_name(h, c).decode()
+        t = lib.olap_csv_col_type(h, c)
+        if t == COL_INT64:
+            out = np.empty(n_rows, dtype=np.int64)
+            lib.olap_csv_col_int64(h, c, out.ctypes.data_as(ctypes.c_void_p))
+            cols[name] = out
+        elif t == COL_DOUBLE:
+            out = np.empty(n_rows, dtype=np.float64)
+            lib.olap_csv_col_double(h, c, out.ctypes.data_as(ctypes.c_void_p))
+            cols[name] = out
+        else:
+            codes = np.empty(n_rows, dtype=np.int32)
+            lib.olap_csv_col_codes(h, c, codes.ctypes.data_as(ctypes.c_void_p))
+            k = lib.olap_csv_dict_size(h, c)
+            values = tuple(
+                lib.olap_csv_dict_value(h, c, i).decode() for i in range(k)
+            )
+            d = DimensionDict(values=values)
+            if decode_strings:
+                cols[name] = d.decode(codes)
+            else:
+                cols[name] = codes
+                dicts[name] = d
+    return cols, dicts
+
+
+def read_csv(path: str) -> Dict[str, np.ndarray]:
+    lib, handle = _open(path)
+    cols, _ = _columns(lib, handle, decode_strings=True)
+    return cols
+
+
+def read_csv_encoded(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """(columns, dicts): string columns pre-encoded as rank codes."""
+    lib, handle = _open(path)
+    return _columns(lib, handle, decode_strings=False)
+
+
+def encode_strings(values) -> Tuple[np.ndarray, Tuple[str, ...]]:
+    """Native sorted-unique dictionary encode of a python string sequence
+    (None -> null code -1).  Returns (int32 codes, sorted values)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(values)
+    arr = (ctypes.c_char_p * n)()
+    keepalive = []
+    for i, v in enumerate(values):
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            arr[i] = None
+        else:
+            b = v.encode() if isinstance(v, str) else str(v).encode()
+            keepalive.append(b)
+            arr[i] = b
+    h = lib.olap_dict_encode(arr, n)
+    try:
+        codes = np.empty(n, dtype=np.int32)
+        lib.olap_dict_codes(h, codes.ctypes.data_as(ctypes.c_void_p))
+        k = lib.olap_dict_size(h)
+        vals = tuple(lib.olap_dict_value(h, i).decode() for i in range(k))
+    finally:
+        lib.olap_dict_free(h)
+    return codes, vals

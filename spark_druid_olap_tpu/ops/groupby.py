@@ -231,8 +231,8 @@ def partial_aggregate(
     block_rows: Optional[int] = None,
 ):
     """Strategy dispatcher.  'auto' uses the Pallas kernel on TPU (dense
-    one-hot in VMEM) below DENSE_MAX_GROUPS, the XLA scan on other backends,
-    scatter above the dense cutover."""
+    one-hot in VMEM) up to SCATTER_CUTOVER groups (the XLA dense scan on
+    non-TPU backends), and the scatter/segment path above it."""
     if strategy == "auto":
         strategy = resolve_strategy("auto", num_groups)
     if strategy == "pallas":

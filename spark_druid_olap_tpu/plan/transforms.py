@@ -79,8 +79,17 @@ def _is_time_col(e: E.Expr, ds: DataSource) -> bool:
 
 
 def _literal_ms(e: E.Expr) -> Optional[int]:
-    if isinstance(e, E.Literal) and isinstance(e.value, (int, float, np.integer)):
-        return int(e.value)
+    if isinstance(e, E.Literal):
+        if isinstance(e.value, (int, float, np.integer)):
+            return int(e.value)
+        if isinstance(e.value, str):
+            # ISO date/datetime string against the time column — the Druid
+            # interval convention (and the reference's spark-datetime
+            # predicates, SURVEY.md §2 build-deps row [U])
+            try:
+                return int(np.datetime64(e.value, "ms").astype(np.int64))
+            except ValueError:
+                return None
     return None
 
 
