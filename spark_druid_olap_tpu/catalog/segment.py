@@ -235,6 +235,39 @@ class DataSource:
         return (min(i[0] for i in ivs), max(i[1] for i in ivs))
 
 
+def schema_datasource(
+    name: str,
+    dims: Mapping[str, "DimensionDict"],
+    metric_cols: Mapping[str, str],
+    time_col: Optional[str] = None,
+) -> DataSource:
+    """A zero-segment DataSource carrying only schema + dictionaries — the
+    anchor for streaming execution (exec/streaming.py), where row chunks
+    arrive incrementally and never materialize as catalog segments.
+    `dims` values may be DimensionDicts or plain value sequences;
+    `metric_cols` maps name -> "long"|"double"."""
+    ddicts: Dict[str, DimensionDict] = {}
+    metas: List[ColumnMeta] = []
+    for d, v in dims.items():
+        dd = v if isinstance(v, DimensionDict) else DimensionDict(
+            values=tuple(sorted(set(v)))
+        )
+        ddicts[d] = dd
+        dtype = "long" if dd.numeric_values is not None else "string"
+        metas.append(ColumnMeta(d, "dimension", dtype, cardinality=dd.cardinality))
+    for m, dtype in metric_cols.items():
+        metas.append(ColumnMeta(m, "metric", dtype))
+    if time_col is not None:
+        metas.append(ColumnMeta(time_col, "time", "timestamp"))
+    return DataSource(
+        name=name,
+        columns=tuple(metas),
+        dicts=ddicts,
+        segments=(),
+        time_column=time_col,
+    )
+
+
 def build_datasource(
     name: str,
     columns: Mapping[str, np.ndarray],

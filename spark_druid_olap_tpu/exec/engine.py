@@ -669,68 +669,51 @@ class Engine:
         segs = self._segments_in_scope(q, ds)
         if not segs:
             # empty time range is a valid query: zero-row result, not an error
-            sums = jnp.zeros((G, len(la.sum_names)), jnp.float32)
-            mins = jnp.full((G, len(la.min_names)), jnp.inf, jnp.float32)
-            maxs = jnp.full((G, len(la.max_names)), -jnp.inf, jnp.float32)
-            for agg in la.sketch_aggs:
-                if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
-                    sketch_states[agg.name] = jnp.zeros(
-                        (G, 1 << agg.precision), jnp.int32
-                    )
-                else:
-                    from ..ops.theta import SENTINEL
-
-                    sketch_states[agg.name] = jnp.full(
-                        (G, agg.size), SENTINEL, jnp.uint32
-                    )
+            sums, mins, maxs, sketch_states = empty_partials(la, G)
             return dims, la, G, sums, mins, maxs, sketch_states
         seg_fn = self._segment_program(q, ds, lowering)
         for seg in segs:
             cols = self._device_cols(seg, need)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]
-            try:
-                s, mn, mx, sk = seg_fn(cols)
-            except Exception:
-                # Auto-selected Pallas may fail to Mosaic-compile on exotic
-                # backends: retry once on the XLA dense path.  Only 'auto'
-                # and 'dense' (a kernel *class* the cost model picks, which
-                # _resolve_strategy upgrades to Pallas) fall back — explicit
-                # strategy='pallas' should surface the error.  Only
-                # pallas-keyed programs are evicted, and if the dense retry
-                # fails too the failure wasn't Pallas — unflag.
-                if (
-                    self.strategy not in ("auto", "dense")
-                    or self._pallas_broken
-                    or self._resolve_strategy(G) != "pallas"
-                ):
-                    raise
-                self._pallas_broken = True
-                for k in [k for k in self._query_fn_cache if k[2] == "pallas"]:
-                    del self._query_fn_cache[k]
-                seg_fn = self._segment_program(q, ds, lowering)
-                try:
-                    s, mn, mx, sk = seg_fn(cols)
-                except Exception:
-                    self._pallas_broken = False
-                    raise
+            (s, mn, mx, sk), seg_fn = self._call_segment_program(
+                q, ds, lowering, seg_fn, cols
+            )
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
-            for agg in la.sketch_aggs:
-                from ..ops import theta as theta_ops
-
-                st = sk[agg.name]
-                prev = sketch_states.get(agg.name)
-                if prev is None:
-                    sketch_states[agg.name] = st
-                elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
-                    sketch_states[agg.name] = jnp.maximum(prev, st)
-                else:
-                    sketch_states[agg.name] = theta_ops.merge_states(
-                        prev, st, agg.size
-                    )
+            _merge_sketch_states(la, sketch_states, sk)
         return dims, la, G, sums, mins, maxs, sketch_states
+
+    def _call_segment_program(self, q, ds, lowering, seg_fn, cols):
+        """Run one segment program with the Pallas compile-failure fallback.
+        Returns (result, seg_fn) — seg_fn may be a rebuilt XLA-dense program
+        after a Mosaic failure."""
+        try:
+            return seg_fn(cols), seg_fn
+        except Exception:
+            # Auto-selected Pallas may fail to Mosaic-compile on exotic
+            # backends: retry once on the XLA dense path.  Only 'auto'
+            # and 'dense' (a kernel *class* the cost model picks, which
+            # _resolve_strategy upgrades to Pallas) fall back — explicit
+            # strategy='pallas' should surface the error.  Only
+            # pallas-keyed programs are evicted, and if the dense retry
+            # fails too the failure wasn't Pallas — unflag.
+            if (
+                self.strategy not in ("auto", "dense")
+                or self._pallas_broken
+                or self._resolve_strategy(lowering.num_groups) != "pallas"
+            ):
+                raise
+            self._pallas_broken = True
+            for k in [k for k in self._query_fn_cache if k[2] == "pallas"]:
+                del self._query_fn_cache[k]
+            seg_fn = self._segment_program(q, ds, lowering)
+            try:
+                return seg_fn(cols), seg_fn
+            except Exception:
+                self._pallas_broken = False
+                raise
 
     def _resolve_strategy(self, num_groups: int) -> str:
         """Resolve 'auto' to a concrete kernel strategy (ops.groupby's shared
@@ -804,21 +787,7 @@ class Engine:
         return seg_fn
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
-        # Druid semantics: a non-"all" granularity on GroupBy adds an implicit
-        # leading time-bucket dimension (one result row per bucket per group).
-        if q.granularity not in ("all", None) and not any(
-            d.dimension == "__time" or d.granularity for d in q.dimensions
-        ):
-            q = dataclasses.replace(
-                q,
-                dimensions=(
-                    DimensionSpec(
-                        "__time", "timestamp", granularity=q.granularity
-                    ),
-                )
-                + tuple(q.dimensions),
-                granularity="all",
-            )
+        q = groupby_with_time_granularity(q)
         dims, la, G, sums, mins, maxs, sketch_states = self._partials_for_query(
             q, ds
         )
@@ -918,6 +887,63 @@ class Engine:
                     if len(rows) >= q.limit:
                         break
         return pd.DataFrame(rows, columns=["dimension", "value"])
+
+
+def empty_partials(la: LoweredAggs, G: int):
+    """Zero-row partial state (identity of every merge class) — shared by
+    the segment-pruned-to-nothing path and the empty-stream path."""
+    sums = jnp.zeros((G, len(la.sum_names)), jnp.float32)
+    mins = jnp.full((G, len(la.min_names)), jnp.inf, jnp.float32)
+    maxs = jnp.full((G, len(la.max_names)), -jnp.inf, jnp.float32)
+    sketch_states: Dict[str, jnp.ndarray] = {}
+    for agg in la.sketch_aggs:
+        if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+            sketch_states[agg.name] = jnp.zeros(
+                (G, 1 << agg.precision), jnp.int32
+            )
+        else:
+            from ..ops.theta import SENTINEL
+
+            sketch_states[agg.name] = jnp.full(
+                (G, agg.size), SENTINEL, jnp.uint32
+            )
+    return sums, mins, maxs, sketch_states
+
+
+def groupby_with_time_granularity(q: Q.GroupByQuery) -> Q.GroupByQuery:
+    """Druid semantics shared by all executors: a non-'all' granularity on
+    GroupBy adds an implicit leading time-bucket dimension (one result row
+    per bucket per group)."""
+    if q.granularity in ("all", None) or any(
+        d.dimension == "__time" or d.granularity for d in q.dimensions
+    ):
+        return q
+    return dataclasses.replace(
+        q,
+        dimensions=(
+            DimensionSpec("__time", "timestamp", granularity=q.granularity),
+        )
+        + tuple(q.dimensions),
+        granularity="all",
+    )
+
+
+def _merge_sketch_states(
+    la: LoweredAggs, acc: Dict[str, Any], new: Dict[str, Any]
+) -> None:
+    """Merge one segment's sketch partials into the accumulator in place:
+    HLL registers max-merge; theta states union (shared with streaming)."""
+    from ..ops import theta as theta_ops
+
+    for agg in la.sketch_aggs:
+        st = new[agg.name]
+        prev = acc.get(agg.name)
+        if prev is None:
+            acc[agg.name] = st
+        elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+            acc[agg.name] = jnp.maximum(prev, st)
+        else:
+            acc[agg.name] = theta_ops.merge_states(prev, st, agg.size)
 
 
 # ---------------------------------------------------------------------------

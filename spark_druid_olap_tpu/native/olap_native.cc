@@ -20,6 +20,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <algorithm>
+#include <deque>
 #include <memory>
 #include <string>
 #include <unordered_map>
@@ -53,10 +54,29 @@ struct Column {
 struct CsvTable {
   std::string error;
   std::string buf;                     // whole file
-  std::vector<std::string> arena;      // unescaped quoted fields live here
+  // Unescaped quoted fields live here.  Field.ptr points INTO these strings,
+  // so the container must never move elements — deque (stable addresses on
+  // push_back), not vector.
+  std::deque<std::string> arena;
   std::vector<Column> cols;
   int64_t num_rows = 0;
 };
+
+// pandas' default na_values set: these read as null in every column type
+// (the python fallback is pd.read_csv — inference must not fork from it).
+bool is_null_field(const char* p, int64_t len) {
+  if (len == 0) return true;
+  if (len > 9) return false;
+  static const char* kNa[] = {
+      "#N/A", "#N/A N/A", "#NA", "-1.#IND", "-1.#QNAN", "-NaN", "-nan",
+      "1.#IND", "1.#QNAN", "<NA>", "N/A", "NA", "NULL", "NaN", "None",
+      "n/a", "nan", "null"};
+  for (const char* s : kNa) {
+    if ((int64_t)strlen(s) == len && memcmp(p, s, (size_t)len) == 0)
+      return true;
+  }
+  return false;
+}
 
 bool parse_i64(const char* p, int64_t len, int64_t* out) {
   if (len == 0) return false;
@@ -209,7 +229,7 @@ void infer_and_build(CsvTable* t, const std::vector<Field>& fields, int ncols) {
     bool all_int = true, all_num = true, any_null = false, any_val = false;
     for (int64_t r = 0; r < R; ++r) {
       const Field& f = fields[(size_t)r * ncols + c];
-      if (f.len == 0) { any_null = true; continue; }
+      if (is_null_field(f.ptr, f.len)) { any_null = true; continue; }
       any_val = true;
       int64_t iv;
       double dv;
@@ -235,7 +255,10 @@ void infer_and_build(CsvTable* t, const std::vector<Field>& fields, int ncols) {
       for (int64_t r = 0; r < R; ++r) {
         const Field& f = fields[(size_t)r * ncols + c];
         double dv;
-        col.f64[r] = parse_f64(f.ptr, f.len, &dv) ? dv : NAN;
+        col.f64[r] = (!is_null_field(f.ptr, f.len) &&
+                      parse_f64(f.ptr, f.len, &dv))
+                         ? dv
+                         : NAN;
       }
     } else {
       col.type = COL_STRING;
@@ -245,7 +268,7 @@ void infer_and_build(CsvTable* t, const std::vector<Field>& fields, int ncols) {
       std::vector<int32_t> tmp((size_t)R);
       for (int64_t r = 0; r < R; ++r) {
         const Field& f = fields[(size_t)r * ncols + c];
-        if (f.len == 0) { tmp[r] = -1; continue; }
+        if (is_null_field(f.ptr, f.len)) { tmp[r] = -1; continue; }
         SV sv{f.ptr, f.len};
         auto it = seen.find(sv);
         if (it == seen.end()) {

@@ -141,10 +141,14 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
             # push the matching code set (O(dictionary), like Regex)
             vals = np.asarray([str(v) for v in ds.dicts[dim].values], dtype=str)
             ok = np.ones(len(vals), dtype=bool)
+            # Druid coerces bound literals to strings on the wire — accept
+            # numeric literals under lexicographic ordering the same way
             if f.lower is not None:
-                ok &= (vals > f.lower) if f.lower_strict else (vals >= f.lower)
+                lo_s = str(f.lower)
+                ok &= (vals > lo_s) if f.lower_strict else (vals >= lo_s)
             if f.upper is not None:
-                ok &= (vals < f.upper) if f.upper_strict else (vals <= f.upper)
+                hi_s = str(f.upper)
+                ok &= (vals < hi_s) if f.upper_strict else (vals <= hi_s)
             codes = np.nonzero(ok)[0].astype(np.int32)
             if len(codes) == 0:
                 return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)

@@ -182,3 +182,30 @@ def test_quoted_multiline_field(tmp_path):
     got = read_csv(str(p))
     assert list(got["a"]) == ["line1\nline2", "plain"]
     np.testing.assert_array_equal(got["b"], [3, 4])
+
+
+def test_many_short_escaped_quotes(tmp_path):
+    """Arena stability: many short quoted-escaped fields must not corrupt
+    earlier fields when the arena grows (dangling-SSO regression)."""
+    from spark_druid_olap_tpu.native.csv_decode import read_csv
+
+    rows = [f'"v""{i:02d}"' for i in range(64)]
+    p = tmp_path / "esc.csv"
+    p.write_text("a\n" + "\n".join(rows) + "\n")
+    got = read_csv(str(p))
+    assert list(got["a"]) == [f'v"{i:02d}' for i in range(64)]
+
+
+def test_na_sentinels_match_pandas(tmp_path):
+    """pandas' default na_values must read as nulls, keeping type inference
+    identical to the pd.read_csv fallback."""
+    from spark_druid_olap_tpu.native.csv_decode import read_csv
+
+    p = tmp_path / "na.csv"
+    p.write_text("x,v,s\na,1.5,foo\nb,NA,NaN\nc,3.0,null\n")
+    got = read_csv(str(p))
+    want = pd.read_csv(p)
+    assert str(want["v"].dtype) == "float64"
+    assert got["v"].dtype == np.float64
+    np.testing.assert_array_equal(np.isnan(got["v"]), want["v"].isna().values)
+    assert list(got["s"]) == ["foo", None, None]
