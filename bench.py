@@ -1,24 +1,93 @@
-"""Benchmark driver: TPC-H Q1 (SF1) end-to-end on the local device.
+"""Benchmark driver.  Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
-BASELINE config #1 — "TPC-H Q1 single-table GROUP BY (sum/avg/count on
-lineitem, SF1)".  Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Modes (argv[1], default "ssb" — the BASELINE.md north star ★):
 
-`vs_baseline` compares against a single-threaded pandas/numpy groupby of the
-same query on the same host — the stand-in for the reference's
+    ssb        [scale=1.0]   SSB Q1.1-Q4.3 p50 latency + rows/sec/chip
+    tpch_q1    [scale=1.0]   config #1: single-table GROUP BY on lineitem
+    topn_hll   [scale=1.0]   config #3: top-100 city by revenue + HLL distinct
+    timeseries [chunks=12]   config #4: hourly rollup over a streamed event
+                             stream (2M-row chunks; 1B rows = chunks=512)
+    cube_theta [scale=0.25]  config #5: GROUP BY CUBE + approx_count_distinct
+
+`vs_baseline` compares against single-threaded pandas/numpy float64 on the
+same host and the same columns — the stand-in for the reference's
 "Spark-on-Parquet without acceleration" baseline (the reference's own Druid
-numbers are unavailable: empty reference mount, see SURVEY.md §0/§6).
+numbers are unavailable: empty reference mount, SURVEY.md §0/§6).  >1 means
+the TPU path is faster.
 """
 
 import json
+import statistics
 import sys
 import time
 
-import numpy as np
 
-
-def main():
+def _device() -> str:
     import jax
+
+    return str(jax.devices()[0])
+
+
+def _timed(fn, reps=3, warmup=1):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+# ---------------------------------------------------------------------------
+# ★ north star: SSB Q1.1-Q4.3
+# ---------------------------------------------------------------------------
+
+
+def bench_ssb(scale: float):
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.workloads import ssb
+
+    ctx = sd.TPUOlapContext()
+    tables = ssb.gen_tables(scale=scale)
+    ssb.register(ctx, tables=tables)
+    n_rows = ctx.catalog.get("lineorder").num_rows
+
+    f = ssb.flat_frame(tables)
+    per_q = {}
+    tpu_times, ratios = [], []
+    for name in ssb.QUERIES:
+        t_tpu = _timed(lambda n=name: ctx.sql(ssb.QUERIES[n]))
+        t_pd = _timed(lambda n=name: ssb.oracle(f, n), reps=1, warmup=0)
+        per_q[name] = {
+            "tpu_ms": round(t_tpu * 1e3, 2),
+            "pandas_ms": round(t_pd * 1e3, 2),
+        }
+        tpu_times.append(t_tpu)
+        ratios.append(t_pd / t_tpu)
+    p50 = statistics.median(tpu_times)
+    return {
+        "metric": "ssb_sf%g_q1-q4_p50_latency" % scale,
+        "value": round(p50 * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(statistics.median(ratios), 2),
+        "detail": {
+            "rows": n_rows,
+            "rows_per_sec_per_chip": round(n_rows / p50),
+            "queries": per_q,
+            "device": _device(),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# config #1: TPC-H Q1
+# ---------------------------------------------------------------------------
+
+
+def bench_tpch_q1(scale: float):
+    import numpy as np
 
     from spark_druid_olap_tpu.catalog.segment import build_datasource
     from spark_druid_olap_tpu.exec.engine import Engine
@@ -33,7 +102,6 @@ def main():
     from spark_druid_olap_tpu.plan.expr import col
     from spark_druid_olap_tpu.utils import datagen
 
-    scale = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
     cols = datagen.gen_lineitem(scale=scale, seed=0)
     n_rows = len(cols["l_quantity"])
 
@@ -77,14 +145,7 @@ def main():
     eng = Engine()
     out = eng.execute(q, ds)  # warmup: compile + device transfer
     assert len(out) == 6, out
-
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        eng.execute(q, ds)
-        times.append(time.perf_counter() - t0)
-    p50 = sorted(times)[len(times) // 2]
-    rows_per_sec = n_rows / p50
+    p50 = _timed(lambda: eng.execute(q, ds), reps=5, warmup=0)
 
     # pandas oracle baseline (single-threaded host groupby, float64)
     import pandas as pd
@@ -108,22 +169,217 @@ def main():
     )
     pandas_time = time.perf_counter() - t0
 
-    print(
-        json.dumps(
-            {
-                "metric": "tpch_q1_sf%g_rows_per_sec_per_chip" % scale,
-                "value": round(rows_per_sec),
-                "unit": "rows/s",
-                "vs_baseline": round(pandas_time / p50, 2),
-                "detail": {
-                    "p50_s": round(p50, 5),
-                    "pandas_baseline_s": round(pandas_time, 5),
-                    "device": str(jax.devices()[0]),
-                    "rows": n_rows,
-                },
-            }
-        )
+    return {
+        "metric": "tpch_q1_sf%g_rows_per_sec_per_chip" % scale,
+        "value": round(n_rows / p50),
+        "unit": "rows/s",
+        "vs_baseline": round(pandas_time / p50, 2),
+        "detail": {
+            "p50_s": round(p50, 5),
+            "pandas_baseline_s": round(pandas_time, 5),
+            "device": _device(),
+            "rows": n_rows,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# config #3: TopN + HLL
+# ---------------------------------------------------------------------------
+
+
+def bench_topn_hll(scale: float):
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.models.aggregations import DoubleSum, HyperUnique
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.query import TopNQuery
+    from spark_druid_olap_tpu.workloads import ssb
+
+    ctx = sd.TPUOlapContext()
+    tables = ssb.gen_tables(scale=scale)
+    ssb.register(ctx, tables=tables)
+    ds = ctx.catalog.get("lineorder")
+    n_rows = ds.num_rows
+    q = TopNQuery(
+        datasource="lineorder",
+        dimension=DimensionSpec("c_city"),
+        metric="revenue",
+        threshold=100,
+        aggregations=(
+            DoubleSum("revenue", "lo_revenue"),
+            HyperUnique("uniq_custs", "lo_custkey"),
+        ),
     )
+    t_tpu = _timed(lambda: ctx.engine.execute(q, ds))
+
+    f = ssb.flat_frame(tables)
+
+    def pandas_topn():
+        g = f.groupby("c_city").agg(
+            revenue=("lo_revenue", "sum"),
+            uniq_custs=("lo_custkey", "nunique"),
+        )
+        return g.sort_values("revenue", ascending=False).head(100)
+
+    t_pd = _timed(pandas_topn, reps=1, warmup=0)
+    return {
+        "metric": "topn100_hll_sf%g_rows_per_sec_per_chip" % scale,
+        "value": round(n_rows / t_tpu),
+        "unit": "rows/s",
+        "vs_baseline": round(t_pd / t_tpu, 2),
+        "detail": {
+            "p50_s": round(t_tpu, 5),
+            "pandas_baseline_s": round(t_pd, 5),
+            "device": _device(),
+            "rows": n_rows,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# config #4: streaming hourly rollup
+# ---------------------------------------------------------------------------
+
+
+def bench_timeseries(n_chunks: int):
+    """Throughput counts end-to-end wall time including host chunk generation
+    and H2D streaming — the honest streaming number."""
+    from spark_druid_olap_tpu.exec.streaming import StreamExecutor
+    from spark_druid_olap_tpu.models.aggregations import (
+        Count,
+        DoubleMax,
+        DoubleSum,
+    )
+    from spark_druid_olap_tpu.models.query import TimeseriesQuery
+    from spark_druid_olap_tpu.utils import datagen
+
+    chunk = 1 << 21
+    q = TimeseriesQuery(
+        datasource="events",
+        granularity="hour",
+        aggregations=(
+            Count("n"),
+            DoubleSum("v", "value"),
+            DoubleMax("mx", "latency"),
+        ),
+        intervals=(datagen.event_stream_interval(),),
+    )
+    ds = datagen.event_stream_schema()
+    ex = StreamExecutor()
+    # warmup / compile on one chunk
+    ex.execute(q, ds, (datagen.gen_event_chunk(0, chunk) for _ in range(1)), chunk)
+    t0 = time.perf_counter()
+    ex.execute(
+        q, ds, (datagen.gen_event_chunk(i, chunk) for i in range(n_chunks)), chunk
+    )
+    dt = time.perf_counter() - t0
+    rows = ex.stats.rows
+
+    # pandas baseline on one chunk, extrapolated (materializing the whole
+    # stream host-side is exactly what streaming avoids)
+    import pandas as pd
+
+    c = datagen.gen_event_chunk(0, chunk)
+    t0 = time.perf_counter()
+    pd.DataFrame(
+        {"h": c["ts"] // 3_600_000, "v": c["value"], "lat": c["latency"]}
+    ).groupby("h").agg(n=("v", "count"), v=("v", "sum"), mx=("lat", "max"))
+    t_pd = (time.perf_counter() - t0) * n_chunks
+    return {
+        "metric": "timeseries_hourly_rollup_%dM_rows_per_sec" % (rows // 1_000_000),
+        "value": round(rows / dt),
+        "unit": "rows/s",
+        "vs_baseline": round(t_pd / dt, 2),
+        "detail": {
+            "wall_s": round(dt, 2),
+            "rows": rows,
+            "chunks": n_chunks,
+            "pandas_extrapolated_s": round(t_pd, 2),
+            "device": _device(),
+            "note": "H2D-bound behind the axon tunnel; host PCIe is ~50x",
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# config #5: CUBE + theta
+# ---------------------------------------------------------------------------
+
+
+def bench_cube_theta(scale: float):
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.workloads import ssb
+
+    ctx = sd.TPUOlapContext()
+    tables = ssb.gen_tables(scale=scale)
+    ssb.register(ctx, tables=tables)
+    n_rows = ctx.catalog.get("lineorder").num_rows
+    sql = (
+        "SELECT c_region, s_region, d_year, sum(lo_revenue) AS revenue, "
+        "approx_count_distinct(lo_custkey) AS uniq_custs "
+        "FROM lineorder GROUP BY CUBE (c_region, s_region, d_year)"
+    )
+    t_tpu = _timed(lambda: ctx.sql(sql))
+
+    f = ssb.flat_frame(tables)
+
+    def pandas_cube():
+        import itertools
+
+        import pandas as pd
+
+        dims = ["c_region", "s_region", "d_year"]
+        frames = []
+        for r in range(len(dims) + 1):
+            for sub in itertools.combinations(dims, r):
+                if sub:
+                    g = f.groupby(list(sub)).agg(
+                        revenue=("lo_revenue", "sum"),
+                        uniq_custs=("lo_custkey", "nunique"),
+                    ).reset_index()
+                else:
+                    g = pd.DataFrame(
+                        {
+                            "revenue": [f.lo_revenue.sum()],
+                            "uniq_custs": [f.lo_custkey.nunique()],
+                        }
+                    )
+                frames.append(g)
+        return pd.concat(frames, ignore_index=True)
+
+    t_pd = _timed(pandas_cube, reps=1, warmup=0)
+    return {
+        "metric": "cube3_theta_sf%g_latency" % scale,
+        "value": round(t_tpu * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(t_pd / t_tpu, 2),
+        "detail": {
+            "rows": n_rows,
+            "grouping_sets": 8,
+            "pandas_baseline_s": round(t_pd, 5),
+            "device": _device(),
+        },
+    }
+
+
+MODES = {
+    "ssb": (bench_ssb, 1.0),
+    "tpch_q1": (bench_tpch_q1, 1.0),
+    "topn_hll": (bench_topn_hll, 1.0),
+    "timeseries": (bench_timeseries, 12),
+    "cube_theta": (bench_cube_theta, 0.25),
+}
+
+
+def main():
+    args = sys.argv[1:]
+    mode = "ssb"
+    if args and args[0] in MODES:
+        mode = args[0]
+        args = args[1:]
+    fn, default_arg = MODES[mode]
+    arg = type(default_arg)(args[0]) if args else default_arg
+    print(json.dumps(fn(arg)))
 
 
 if __name__ == "__main__":

@@ -43,6 +43,7 @@ from ..exec.engine import (
     finalize_groupby,
     finalize_timeseries,
     finalize_topn,
+    groupby_with_time_granularity,
     lower_groupby,
     schema_signature,
     timeseries_to_groupby,
@@ -223,20 +224,7 @@ class DistributedEngine:
             df = self.execute(topn_to_groupby(q), ds)
             return finalize_topn(df, q)
         assert isinstance(q, Q.GroupByQuery), type(q)
-        if q.granularity not in ("all", None) and not any(
-            d.dimension == "__time" or d.granularity for d in q.dimensions
-        ):
-            import dataclasses as _dc
-
-            q = _dc.replace(
-                q,
-                dimensions=(
-                    DimensionSpec("__time", "timestamp",
-                                  granularity=q.granularity),
-                )
-                + tuple(q.dimensions),
-                granularity="all",
-            )
+        q = groupby_with_time_granularity(q)
 
         lowering = lower_groupby(q, ds)
         cols, padded = self._global_columns(ds, lowering.columns, q.intervals)
