@@ -354,6 +354,17 @@ class GroupByLowering:
         return gid, mask, sum_values, minmax_values, minmax_masks
 
 
+def _query_key(q: Q.QuerySpec, ds: DataSource) -> Tuple:
+    """Identity of (query, datasource-schema) for program/state caches —
+    single definition so every cache keys the same way."""
+    import json as _json
+
+    return (
+        _json.dumps(q.to_druid(), sort_keys=True, default=str),
+        schema_signature(ds),
+    )
+
+
 def schema_signature(ds: DataSource) -> Tuple:
     """Identity of a datasource's schema for program caches: name + per-column
     kind/cardinality + dictionary content + segment ids.  Dictionary content
@@ -596,6 +607,11 @@ class Engine:
     def __init__(self, strategy: str = "auto"):
         self.strategy = strategy
         self._pallas_broken = False  # set on first Mosaic-compile failure
+        # queries pinned off the sparse accelerator: compaction overflowed
+        # SPARSE_SLOTS distinct groups, or the sparse program failed even
+        # after the Pallas-inner retry (sparse is best-effort; pinning stops
+        # us re-paying a doomed trace+compile on every execution)
+        self._sparse_disabled: set = set()
         self._device_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
         # (query-json, datasource, strategy) -> jitted per-segment program.
         # One fused XLA program per query shape: without this, every eager op
@@ -656,11 +672,14 @@ class Engine:
                 out.append(s)
         return out
 
-    def _partials_for_query(self, q: Q.GroupByQuery, ds: DataSource):
+    def _partials_for_query(
+        self, q: Q.GroupByQuery, ds: DataSource, lowering=None
+    ):
         """Compute merged partial state across local segments.
 
         Returns (dims, la, G, sums[G, Ms], mins, maxs, sketch_states)."""
-        lowering = lower_groupby(q, ds)
+        if lowering is None:
+            lowering = lower_groupby(q, ds)
         dims, la, G = lowering.dims, lowering.la, lowering.num_groups
         need = lowering.columns
 
@@ -735,6 +754,13 @@ class Engine:
             ):
                 return "pallas"
             return "dense"
+        if self.strategy == "sparse":
+            # "sparse" is an execution-layer accelerator, not a kernel
+            # strategy: when the sparse path declines a query (low G, sketch
+            # aggs, overflow) the standard path resolves as if "auto"
+            return resolve_strategy(
+                "auto", num_groups, pallas_ok=not self._pallas_broken
+            )
         return resolve_strategy(
             self.strategy, num_groups, pallas_ok=not self._pallas_broken
         )
@@ -746,16 +772,11 @@ class Engine:
         columns, filter mask, group ids) + partial aggregation + sketch
         partials in a single dispatch.  The analog of Druid compiling a query
         into one engine pass per segment."""
-        import json as _json
-
         la, G = lowering.la, lowering.num_groups
         strategy = self._resolve_strategy(G)
-        key = (
-            _json.dumps(q.to_druid(), sort_keys=True, default=str),
-            schema_signature(ds),  # a re-ingested datasource (new dict
-            # cardinalities => new G) must not reuse a stale program
-            strategy,
-        )
+        # _query_key includes schema_signature: a re-ingested datasource
+        # (new dict cardinalities => new G) must not reuse a stale program
+        key = _query_key(q, ds) + (strategy,)
         if key in self._query_fn_cache:
             return self._query_fn_cache[key]
 
@@ -786,10 +807,136 @@ class Engine:
         self._query_fn_cache[key] = seg_fn
         return seg_fn
 
+    # -- sparse (sort-compaction) path for high-cardinality domains ----------
+
+    def _sparse_eligible(self, lowering: "GroupByLowering") -> bool:
+        """Sparse applies when the scatter path would otherwise run: huge
+        combined domain, plain (non-sketch) aggregates, and real dimensions.
+        Sketch states are [G, registers] dense — compaction would have to
+        re-key them too; at high G those queries stay on scatter."""
+        from ..ops.groupby import SCATTER_CUTOVER
+
+        return (
+            lowering.num_groups > SCATTER_CUTOVER
+            and not lowering.la.sketch_aggs
+            and bool(lowering.dims)
+            and self.strategy in ("auto", "dense", "segment", "sparse")
+        )
+
+    def _sparse_program(
+        self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
+    ) -> Callable:
+        from ..ops.pallas_groupby import pallas_available
+        from ..ops.sparse_groupby import sparse_partial_aggregate
+
+        la = lowering.la
+        # inner kernel over the compacted slots: the Pallas one-hot on TPU;
+        # scatter on CPU backends (4096-slot one-hot matmuls starve a CPU,
+        # and at `slots` segments CPU scatter is cheap)
+        inner = (
+            "pallas"
+            if not self._pallas_broken and pallas_available()
+            else "segment"
+        )
+        key = _query_key(q, ds) + (f"sparse:{inner}",)
+        if key in self._query_fn_cache:
+            return self._query_fn_cache[key]
+
+        @jax.jit
+        def seg_fn(cols):
+            gid, mask, sv, mmv, mmm = lowering.row_arrays(dict(cols))
+            return sparse_partial_aggregate(
+                gid, mask, sv, mmv, mmm,
+                num_groups=lowering.num_groups,
+                num_min=len(la.min_names),
+                num_max=len(la.max_names),
+                inner_strategy=inner,
+            )
+
+        self._query_fn_cache[key] = seg_fn
+        return seg_fn
+
+    def _execute_groupby_sparse(
+        self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
+    ):
+        """Sparse execution attempt over the (non-empty) segment scope.
+        Returns the result DataFrame, or None to fall back (overflow; any
+        sparse-path compile/runtime failure even after the Pallas-inner
+        retry — correctness never depends on this path)."""
+        from ..ops.sparse_groupby import merge_sparse_states
+
+        segs = self._segments_in_scope(q, ds)
+        G = lowering.num_groups
+
+        def run():
+            seg_fn = self._sparse_program(q, ds, lowering)
+            state = None
+            for seg in segs:
+                cols = self._device_cols(seg, lowering.columns)
+                if ds.time_column and ds.time_column in cols:
+                    cols["__time"] = cols[ds.time_column]
+                st = seg_fn(cols)
+                state = (
+                    st
+                    if state is None
+                    else merge_sparse_states(state, st, num_groups=G)
+                )
+            return jax.device_get(state)
+
+        def evict():
+            # only THIS query's sparse programs — other queries' compiled
+            # sparse programs are fine and expensive to rebuild
+            base = _query_key(q, ds)
+            for k in [
+                k
+                for k in self._query_fn_cache
+                if k[:2] == base and str(k[2]).startswith("sparse")
+            ]:
+                del self._query_fn_cache[k]
+
+        from ..ops.pallas_groupby import pallas_available
+
+        try:
+            host = run()
+        except Exception:
+            evict()
+            # mirror _call_segment_program: a Mosaic failure of the Pallas
+            # inner kernel downgrades to the scatter inner, not to the
+            # whole-query scatter path
+            if self._pallas_broken or not pallas_available():
+                return None
+            self._pallas_broken = True
+            try:
+                host = run()
+            except Exception:
+                self._pallas_broken = False
+                evict()
+                return None
+        if bool(host["overflow"]):
+            return None
+        return finalize_groupby(
+            q,
+            lowering.dims,
+            lowering.la,
+            np.asarray(host["sums"]),
+            np.asarray(host["mins"]),
+            np.asarray(host["maxs"]),
+            {},
+            slot_gids=np.asarray(host["gids"]),
+        )
+
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
         q = groupby_with_time_granularity(q)
+        lowering = lower_groupby(q, ds)
+        if self._sparse_eligible(lowering) and self._segments_in_scope(q, ds):
+            qkey = _query_key(q, ds)
+            if qkey not in self._sparse_disabled:
+                out = self._execute_groupby_sparse(q, ds, lowering)
+                if out is not None:
+                    return out
+                self._sparse_disabled.add(qkey)
         dims, la, G, sums, mins, maxs, sketch_states = self._partials_for_query(
-            q, ds
+            q, ds, lowering=lowering
         )
         # ONE device_get for everything: each separate host fetch of a device
         # buffer pays a full round trip (dozens of ms when the TPU sits
@@ -959,19 +1106,31 @@ def finalize_groupby(
     mins: np.ndarray,
     maxs: np.ndarray,
     sketch_states: Dict[str, np.ndarray],
+    slot_gids: Optional[np.ndarray] = None,
 ):
     """Merged partial state -> result DataFrame (decode, post-aggs, having,
-    order/limit) — the broker-side finalization of SURVEY.md §3.3."""
+    order/limit) — the broker-side finalization of SURVEY.md §3.3.
+
+    `slot_gids` switches to sparse-state layout (ops/sparse_groupby.py):
+    arrays are slot-indexed and slot_gids maps slot -> combined gid (-1 =
+    empty slot)."""
     import pandas as pd
 
     rows_per_group = sums[:, 0]
-    present = rows_per_group > 0
-    if not dims:
-        # SQL: a global aggregate always yields one row (COUNT=0, SUM/MIN/
-        # MAX=NULL when nothing matched) — never an empty result
-        present = np.ones_like(present, dtype=bool)
-    idx = np.nonzero(present)[0].astype(np.int64)
-    empty_group = rows_per_group[idx] == 0
+    if slot_gids is not None:
+        present = (slot_gids >= 0) & (rows_per_group > 0)
+        sel = np.nonzero(present)[0]
+        idx = slot_gids[sel].astype(np.int64)  # combined gid per kept row
+        empty_group = np.zeros(len(sel), dtype=bool)
+    else:
+        present = rows_per_group > 0
+        if not dims:
+            # SQL: a global aggregate always yields one row (COUNT=0, SUM/
+            # MIN/MAX=NULL when nothing matched) — never an empty result
+            present = np.ones_like(present, dtype=bool)
+        sel = np.nonzero(present)[0]
+        idx = sel.astype(np.int64)
+        empty_group = rows_per_group[sel] == 0
 
     table: Dict[str, np.ndarray] = {}
     # decode combined gid -> per-dimension codes (row-major order)
@@ -987,7 +1146,7 @@ def finalize_groupby(
     for j, n in enumerate(la.sum_names):
         if n == "__rows":
             continue
-        v = sums[idx, j].astype(np.float64)
+        v = sums[sel, j].astype(np.float64)
         if n in la.count_like or not empty_group.any():
             table[n] = np.rint(v).astype(np.int64) if la.long_valued[n] else v
         else:
@@ -1001,16 +1160,16 @@ def finalize_groupby(
         return v
 
     for j, n in enumerate(la.min_names):
-        table[n] = _finalize_extremum(mins[idx, j], la.long_valued[n])
+        table[n] = _finalize_extremum(mins[sel, j], la.long_valued[n])
     for j, n in enumerate(la.max_names):
-        table[n] = _finalize_extremum(maxs[idx, j], la.long_valued[n])
+        table[n] = _finalize_extremum(maxs[sel, j], la.long_valued[n])
 
     raw_states: Dict[str, np.ndarray] = {}
     for agg in la.sketch_aggs:
         from ..ops import hll as hll_ops
         from ..ops import theta as theta_ops
 
-        st = sketch_states[agg.name][idx]
+        st = sketch_states[agg.name][sel]
         raw_states[agg.name] = st
         if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
             table[agg.name] = np.rint(hll_ops.estimate(st)).astype(np.int64)
@@ -1019,7 +1178,7 @@ def finalize_groupby(
 
     for p in q.post_aggregations:
         table[p.name] = np.broadcast_to(
-            eval_post_agg(p, table, raw_states), idx.shape
+            eval_post_agg(p, table, raw_states), sel.shape
         ).copy()
 
     if q.having is not None:

@@ -1,0 +1,152 @@
+"""Sort-compaction GroupBy for high-cardinality group domains.
+
+TPUs hate scatter: above ~4k groups the engine's fallback is
+`jax.ops.segment_sum`, whose serialized conflicting updates make it ~5-10x
+slower than the dense one-hot kernel (measured on SSB q3_x/q4_3, SURVEY.md
+§7 hard-part #1).  But the OLAP reality those queries embody is a *huge
+combined domain with few distinct groups actually present* (city x city x
+year = 437k cells, ~700 populated after filters).  So: compact first, then
+go dense.
+
+    gid in [0, G)  --jnp.unique(size=SLOTS)-->  slot in [0, SLOTS)
+                   --dense/Pallas one-hot over SLOTS--> [SLOTS, M] partials
+                   + uniq[SLOTS] mapping slot -> original gid
+
+The sort inside `unique` is TPU-friendly (bitonic, no scatter), and the
+one-hot matmul over <=4096 slots rides the MXU like any low-cardinality
+query.  Partial states stay sparse across segment merges (concat + re-unique
++ tiny scatter over 2*SLOTS rows).  If a block holds more distinct groups
+than SLOTS, `unique` would silently truncate — every row whose gid got
+dropped maps to a wrong slot — so each kernel also emits an `overflow` flag
+(any row whose slot doesn't round-trip to its gid); the engine checks it at
+fetch time and reruns the query on the scatter path.  Sparse states use
+gid = -1 for empty/trash slots.
+
+The reference has no analog (Druid's historicals do hash aggregation in
+JVM); this is the TPU-native replacement for that engine interior.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .groupby import partial_aggregate
+
+SPARSE_SLOTS = 4096
+
+
+def sparse_partial_aggregate(
+    gid: jnp.ndarray,
+    mask: jnp.ndarray,
+    sum_values: jnp.ndarray,
+    minmax_values: jnp.ndarray,
+    minmax_masks: jnp.ndarray,
+    *,
+    num_groups: int,
+    num_min: int,
+    num_max: int,
+    slots: int = SPARSE_SLOTS,
+    inner_strategy: str = "auto",
+) -> Dict[str, jnp.ndarray]:
+    """Compact gids to slots, aggregate dense over slots.
+
+    Returns {"gids": i32[slots] (-1 = empty/trash), "sums": f32[slots, Ms],
+    "mins": f32[slots, Mn], "maxs": f32[slots, Mx], "overflow": bool[]}.
+    """
+    G = num_groups
+    R = gid.shape[0]
+    n_state = slots + 1  # + 1 so the masked-row trash run never eats a slot
+    g = jnp.where(mask, gid, jnp.int32(G))  # trash value for masked rows
+    # TPU-idiomatic compaction: one argsort, then ONLY gathers — no R-sized
+    # scatter (what jnp.unique's return_inverse would cost us).  The row
+    # values ride the permutation instead of the slot ids riding an inverse.
+    order = jnp.argsort(g)
+    sg = g[order]
+    firsts = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sg[1:] != sg[:-1]]
+    )
+    ranks = jnp.cumsum(firsts.astype(jnp.int32)) - 1  # run index per row
+    n_distinct = ranks[-1] + 1
+    # the trash run (all gid==G) sorts last, so it never displaces a real
+    # group; capacity is `slots` REAL groups exactly
+    n_real = n_distinct - (sg[-1] == G).astype(jnp.int32)
+    overflow = n_real > slots  # clipped slots hold garbage -> rerun
+    slot_sorted = jnp.minimum(ranks, n_state - 1)
+    # first sorted position of each run -> that slot's gid
+    pos = jnp.nonzero(firsts, size=n_state, fill_value=R)[0]
+    uniq = jnp.where(
+        pos < R, sg[jnp.minimum(pos, R - 1)], jnp.int32(G)
+    )
+    sums, mins, maxs = partial_aggregate(
+        slot_sorted,
+        mask[order],
+        sum_values[order],
+        minmax_values[order],
+        minmax_masks[order],
+        num_groups=n_state,
+        num_min=num_min,
+        num_max=num_max,
+        strategy=inner_strategy,
+    )
+    gids = jnp.where(uniq >= G, jnp.int32(-1), uniq.astype(jnp.int32))
+    return {
+        "gids": gids,
+        "sums": sums,
+        "mins": mins,
+        "maxs": maxs,
+        "overflow": overflow,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def merge_sparse_states(
+    a: Dict[str, jnp.ndarray],
+    b: Dict[str, jnp.ndarray],
+    num_groups: int,
+) -> Dict[str, jnp.ndarray]:
+    """Merge two sparse partial states (same slot count) into one.
+
+    concat -> re-unique -> scatter over 2*n_state rows (tiny, scatter is
+    fine at this size).  Empty slots carry the merge identities
+    (+inf/-inf/0), so they never contaminate a real slot they get co-mapped
+    with.  State arrays are slots+1 long (see sparse_partial_aggregate), so
+    `slots` real gids plus the shared empty/trash sentinel always fit —
+    round-trip mismatch therefore fires exactly when real distinct > slots."""
+    n_state = a["gids"].shape[0]
+    G = num_groups
+    cg = jnp.concatenate([a["gids"], b["gids"]])
+    cg = jnp.where(cg < 0, jnp.int32(G), cg)  # sentinel back to sortable form
+    uniq, inv = jnp.unique(
+        cg, size=n_state, fill_value=jnp.int32(G), return_inverse=True
+    )
+    inv = inv.reshape(cg.shape)
+    overflow = (
+        a["overflow"] | b["overflow"] | jnp.any(uniq[inv] != cg)
+    )
+    sums = (
+        jnp.zeros((n_state,) + a["sums"].shape[1:], a["sums"].dtype)
+        .at[inv]
+        .add(jnp.concatenate([a["sums"], b["sums"]]))
+    )
+    mins = (
+        jnp.full((n_state,) + a["mins"].shape[1:], jnp.inf, a["mins"].dtype)
+        .at[inv]
+        .min(jnp.concatenate([a["mins"], b["mins"]]))
+    )
+    maxs = (
+        jnp.full((n_state,) + a["maxs"].shape[1:], -jnp.inf, a["maxs"].dtype)
+        .at[inv]
+        .max(jnp.concatenate([a["maxs"], b["maxs"]]))
+    )
+    gids = jnp.where(uniq >= G, jnp.int32(-1), uniq.astype(jnp.int32))
+    return {
+        "gids": gids,
+        "sums": sums,
+        "mins": mins,
+        "maxs": maxs,
+        "overflow": overflow,
+    }
