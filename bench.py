@@ -371,15 +371,127 @@ MODES = {
 }
 
 
-def main():
-    args = sys.argv[1:]
+def _parse_args(argv):
     mode = "ssb"
-    if args and args[0] in MODES:
-        mode = args[0]
-        args = args[1:]
+    if argv and argv[0] in MODES:
+        mode = argv[0]
+        argv = argv[1:]
     fn, default_arg = MODES[mode]
-    arg = type(default_arg)(args[0]) if args else default_arg
-    print(json.dumps(fn(arg)))
+    arg = type(default_arg)(argv[0]) if argv else default_arg
+    return mode, fn, arg
+
+
+def _run_child():
+    mode, fn, arg = _parse_args(sys.argv[1:])
+    result = fn(arg)
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator: the benchmark must ALWAYS emit one parseable JSON line, even
+# when the TPU backend hook is wedged (round-1 failure mode: the axon
+# sitecustomize shim hangs `import jax` at interpreter startup when its
+# tunnel is down, so no in-process guard can help).  The parent process never
+# imports jax; it probes the backend in a child under a watchdog, runs the
+# real bench in a child, and falls back to a sanitized-CPU child on any
+# failure or timeout.
+# ---------------------------------------------------------------------------
+
+import os
+import subprocess
+
+
+def _cpu_env():
+    from __graft_entry__ import sanitized_cpu_env
+
+    return sanitized_cpu_env()
+
+
+def _child(env, timeout):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"] + sys.argv[1:]
+    try:
+        proc = subprocess.run(
+            cmd,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout after %ss" % timeout
+    if proc.returncode != 0:
+        return None, "rc=%d stderr: %s" % (proc.returncode, proc.stderr[-1500:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, "no JSON in stdout: %s" % proc.stdout[-500:]
+
+
+def _probe_backend(timeout):
+    """Ask a child interpreter (inheriting this env, TPU hook and all) what
+    backend JAX lands on.  Returns the platform string or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            env=dict(os.environ),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    out = proc.stdout.strip().splitlines()
+    return out[-1] if out else None
+
+
+def main():
+    if sys.argv[1:2] == ["--child"]:
+        sys.argv = [sys.argv[0]] + sys.argv[2:]
+        _run_child()
+        return
+
+    mode, _, _ = _parse_args(sys.argv[1:])
+    probe_s = int(os.environ.get("SD_BENCH_PROBE_TIMEOUT_S", "120"))
+    run_s = int(os.environ.get("SD_BENCH_TIMEOUT_S", "1500"))
+
+    platform = _probe_backend(probe_s)
+    result, err = None, None
+    degraded = False
+    if platform is not None and platform != "cpu":
+        result, err = _child(dict(os.environ), run_s)
+        if result is None:
+            degraded = True
+    if result is None:
+        # Backend unavailable/wedged or the accelerated run failed: rerun on
+        # a sanitized CPU interpreter so the round still gets a number.
+        if platform is None:
+            degraded = True
+        cpu_result, cpu_err = _child(_cpu_env(), run_s)
+        result, err = cpu_result, err or cpu_err
+    if result is not None:
+        result["degraded"] = degraded
+        result["device"] = result.get("detail", {}).get("device", platform or "cpu")
+        print(json.dumps(result))
+    else:
+        # Last resort: still one parseable JSON line, never a bare traceback.
+        print(
+            json.dumps(
+                {
+                    "metric": mode,
+                    "value": 0.0,
+                    "unit": "error",
+                    "vs_baseline": 0.0,
+                    "degraded": True,
+                    "device": platform or "unavailable",
+                    "detail": {"error": (err or "unknown")[:2000]},
+                }
+            )
+        )
 
 
 if __name__ == "__main__":

@@ -245,7 +245,9 @@ def _eval_host(e: E.Expr, df) -> np.ndarray:
     from .plan.expr import compile_expr
 
     cols = {c: np.asarray(df[c]) for c in df.columns}
-    fn = compile_expr(_aggref_to_col(e))
+    # raw_strings: result columns hold decoded strings, so HAVING/post-expr
+    # string comparisons use plain numpy elementwise semantics
+    fn = compile_expr(_aggref_to_col(e), raw_strings=True)
     return np.asarray(fn(cols))
 
 

@@ -219,7 +219,7 @@ def _lower_aggs(
             la.value_fns[name] = _field_value_fn(field, ds)
             _add_null_skip(la, name, field, ds)
         elif isinstance(agg, A.ExpressionAgg):
-            fn = compile_expr(agg.expression)
+            fn = compile_expr(agg.expression, ds.dicts)
             target = {
                 "doubleSum": la.sum_names,
                 "longSum": la.sum_names,
@@ -485,7 +485,7 @@ def lower_groupby(q: Q.GroupByQuery, ds: DataSource) -> GroupByLowering:
 
 def _decoded_expr_fn(expression, ds: DataSource):
     """Compile an expression so dimension references read decoded values."""
-    fn = compile_expr(expression)
+    fn = compile_expr(expression, ds.dicts)
     dicts = ds.dicts
     return lambda cols, fn=fn, dicts=dicts: fn(DecodedView(cols, dicts))
 

@@ -51,6 +51,7 @@ PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
 # attribute -> (owning table, fact-side index resolver)
 DIM_ATTRS = {
     "o_orderpriority": "orders",
+    "o_orderdate": "orders",  # numeric-dict dimension: ~2.4k distinct days
     "o_orderdate_year": "orders",
     "c_mktsegment": "orders",  # customer attrs ride the orders row (snowflake)
     "c_nation": "orders",

