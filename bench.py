@@ -45,11 +45,43 @@ def _timed(fn, reps=3, warmup=1):
 # ---------------------------------------------------------------------------
 
 
+def _calibrated_ctx():
+    """Context with measured cost constants (plan/calibrate.py): the planner
+    then picks kernel strategy + mesh from numbers measured on THIS backend
+    (e.g. on CPU the scatter kernel beats the MXU-shaped one-hot by ~200x,
+    and the calibrated model routes accordingly)."""
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    return sd.TPUOlapContext(SessionConfig.load_calibrated())
+
+
+def _ensure_calibration():
+    """Calibrate once per backend (cheap, ~seconds); reuse the saved file
+    when it was measured on the same device kind."""
+    import json as _json
+    import os as _os
+
+    from spark_druid_olap_tpu.plan import calibrate as C
+
+    try:
+        import jax
+
+        dev = str(jax.devices()[0])
+        if _os.path.exists(C.DEFAULT_PATH):
+            with open(C.DEFAULT_PATH) as f:
+                if _json.load(f).get("device") == dev:
+                    return
+        C.calibrate(rows=1 << 19)
+    except Exception:
+        pass  # calibration is an optimization; never fail the bench on it
+
+
 def bench_ssb(scale: float):
     import spark_druid_olap_tpu as sd
     from spark_druid_olap_tpu.workloads import ssb
 
-    ctx = sd.TPUOlapContext()
+    ctx = _calibrated_ctx()
     tables = ssb.gen_tables(scale=scale)
     ssb.register(ctx, tables=tables)
     n_rows = ctx.catalog.get("lineorder").num_rows
@@ -189,13 +221,12 @@ def bench_tpch_q1(scale: float):
 
 
 def bench_topn_hll(scale: float):
-    import spark_druid_olap_tpu as sd
     from spark_druid_olap_tpu.models.aggregations import DoubleSum, HyperUnique
     from spark_druid_olap_tpu.models.dimensions import DimensionSpec
     from spark_druid_olap_tpu.models.query import TopNQuery
     from spark_druid_olap_tpu.workloads import ssb
 
-    ctx = sd.TPUOlapContext()
+    ctx = _calibrated_ctx()
     tables = ssb.gen_tables(scale=scale)
     ssb.register(ctx, tables=tables)
     ds = ctx.catalog.get("lineorder")
@@ -307,10 +338,9 @@ def bench_timeseries(n_chunks: int):
 
 
 def bench_cube_theta(scale: float):
-    import spark_druid_olap_tpu as sd
     from spark_druid_olap_tpu.workloads import ssb
 
-    ctx = sd.TPUOlapContext()
+    ctx = _calibrated_ctx()
     tables = ssb.gen_tables(scale=scale)
     ssb.register(ctx, tables=tables)
     n_rows = ctx.catalog.get("lineorder").num_rows
@@ -362,12 +392,31 @@ def bench_cube_theta(scale: float):
     }
 
 
+# ---------------------------------------------------------------------------
+# cost-model calibration (writes calibration.json; SessionConfig.load_calibrated)
+# ---------------------------------------------------------------------------
+
+
+def bench_calibrate(rows_log2: int):
+    from spark_druid_olap_tpu.plan.calibrate import calibrate
+
+    out = calibrate(rows=1 << rows_log2)
+    return {
+        "metric": "calibration_cost_per_row_dense",
+        "value": out["cost_per_row_dense"],
+        "unit": "us/row/tile",
+        "vs_baseline": 1.0,
+        "detail": out,
+    }
+
+
 MODES = {
     "ssb": (bench_ssb, 1.0),
     "tpch_q1": (bench_tpch_q1, 1.0),
     "topn_hll": (bench_topn_hll, 1.0),
     "timeseries": (bench_timeseries, 12),
     "cube_theta": (bench_cube_theta, 0.25),
+    "calibrate": (bench_calibrate, 20),
 }
 
 
@@ -383,6 +432,8 @@ def _parse_args(argv):
 
 def _run_child():
     mode, fn, arg = _parse_args(sys.argv[1:])
+    if mode != "calibrate":
+        _ensure_calibration()
     result = fn(arg)
     print(json.dumps(result))
 

@@ -34,14 +34,22 @@ class SessionConfig:
     # default); "exact" uses the exact distinct path; "error" rejects.
     count_distinct_mode: str = "approx"
 
-    # cost model (reference: DruidQueryCostModel constants via SQLConf)
+    # cost model (reference: DruidQueryCostModel constants via SQLConf).
+    # Units are MICROSECONDS so the constants are physically measurable:
+    # `plan/calibrate.py` measures them on the live backend and
+    # `SessionConfig.load_calibrated()` picks up the saved values; the
+    # defaults below are v5e-flavoured estimates used until calibration runs.
     cost_model_enabled: bool = True
     dense_max_groups: int = 1 << 17  # dense one-hot vs scatter cutover
     onehot_vmem_budget_mb: int = 32
-    cost_per_row_dense: float = 1.0  # relative per-row cost constants
-    cost_per_row_scatter: float = 8.0
-    cost_per_group_state: float = 0.5
-    collective_bytes_per_us: float = 100.0  # ICI bandwidth guess for planning
+    # us per row per 128-wide group tile for the dense one-hot kernel
+    cost_per_row_dense: float = 1e-4
+    # us per row for the scatter (segment-sum) kernel
+    cost_per_row_scatter: float = 2e-3
+    # merge-collective throughput, bytes per us (ICI ring allreduce)
+    collective_bytes_per_us: float = 40_000.0
+    # fixed overhead of one SPMD dispatch + multi-device host gather, us
+    cost_dispatch_us: float = 300.0
 
     # result guards (reference: maxCardinality / maxResultCardinality)
     max_result_cardinality: int = 1 << 22
@@ -49,10 +57,37 @@ class SessionConfig:
     non_aggregate_query_handling: str = "scan"  # "scan" | "error"
 
     # distributed execution (reference: queryHistoricalServers,
-    # numSegmentsPerHistoricalQuery -> mesh shape decisions)
-    prefer_distributed: bool = False
+    # numSegmentsPerHistoricalQuery -> mesh shape decisions).  With
+    # prefer_distributed=True (default) the cost model picks the mesh
+    # whenever the modelled distributed cost beats single-device cost.
+    prefer_distributed: bool = True
     mesh_data_axis: Optional[int] = None
     mesh_groups_axis: int = 1
+
+    @classmethod
+    def load_calibrated(cls, path: Optional[str] = None) -> "SessionConfig":
+        """SessionConfig with measured cost constants, when a calibration
+        file (plan/calibrate.py) exists; plain defaults otherwise."""
+        import json
+        import os
+
+        cfg = cls()
+        p = path or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "calibration.json",
+        )
+        if os.path.exists(p):
+            with open(p) as f:
+                data = json.load(f)
+            for k in (
+                "cost_per_row_dense",
+                "cost_per_row_scatter",
+                "collective_bytes_per_us",
+                "cost_dispatch_us",
+            ):
+                if k in data and data[k] > 0:
+                    setattr(cfg, k, float(data[k]))
+        return cfg
 
 
 @dataclasses.dataclass

@@ -816,11 +816,15 @@ class Engine:
         re-key them too; at high G those queries stay on scatter."""
         from ..ops.groupby import SCATTER_CUTOVER
 
+        # explicit strategy='segment' is the raw-scatter escape hatch and is
+        # honored as such (ADVICE r1: the sparse accelerator must not hijack
+        # an explicitly requested kernel); the cost model emits 'sparse' when
+        # compaction should run
         return (
             lowering.num_groups > SCATTER_CUTOVER
             and not lowering.la.sketch_aggs
             and bool(lowering.dims)
-            and self.strategy in ("auto", "dense", "segment", "sparse")
+            and self.strategy in ("auto", "dense", "sparse")
         )
 
     def _sparse_program(

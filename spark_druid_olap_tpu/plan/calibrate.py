@@ -1,0 +1,174 @@
+"""Measure cost-model constants on the live backend.
+
+Reference parity: the reference's `DruidQueryCostModel` ships tunable cost
+constants via SQLConf with documented defaults the operator is expected to
+re-tune per deployment (SURVEY.md §2 cost-model row `[U]`).  Round 1 shipped
+guessed constants; this module replaces guessing with measurement: it times
+the actual kernels the engine dispatches —
+
+* dense one-hot partial aggregation (`ops/groupby.dense_partial_aggregate`)
+  -> `cost_per_row_dense` (us per row per 128-wide group tile),
+* scatter segment-sum                    -> `cost_per_row_scatter` (us/row),
+* psum of a [G, M] state over the mesh   -> `collective_bytes_per_us`,
+* a tiny end-to-end SPMD dispatch        -> `cost_dispatch_us`
+
+— and writes `calibration.json` at the repo root, which
+`SessionConfig.load_calibrated()` reads.  Run on the TPU to get real-chip
+constants; on CPU the constants are CPU-honest (the planner's choices then
+match the backend that will actually execute).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_PATH = os.path.join(_REPO_ROOT, "calibration.json")
+
+
+def _timeit(fn, reps: int = 5) -> float:
+    """Median wall seconds of fn() with block_until_ready semantics assumed
+    inside fn; one warmup for compile."""
+    fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def calibrate(
+    rows: int = 1 << 20,
+    groups: int = 1024,
+    save_path: Optional[str] = DEFAULT_PATH,
+) -> Dict[str, float]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..catalog.segment import ROW_PAD
+    from ..ops.groupby import dense_partial_aggregate
+
+    rng = np.random.default_rng(0)
+    gid = jnp.asarray(rng.integers(0, groups, size=rows).astype(np.int32))
+    mask = jnp.ones(rows, jnp.bool_)
+    sv = jnp.asarray(rng.random((rows, 2)).astype(np.float32))
+    mmv = jnp.zeros((rows, 0), jnp.float32)
+    mmm = jnp.zeros((rows, 0), jnp.bool_)
+
+    # dense one-hot kernel: us / row / 128-tile
+    dense_fn = functools.partial(
+        dense_partial_aggregate,
+        num_groups=groups,
+        block_rows=min(rows, 1 << 15),
+        num_min=0,
+        num_max=0,
+    )
+    t_dense = _timeit(
+        lambda: jax.block_until_ready(dense_fn(gid, mask, sv, mmv, mmm))
+    )
+    tiles = max(1, -(-groups // 128))
+    cost_per_row_dense = t_dense * 1e6 / rows / tiles
+
+    # scatter kernel: us / row
+    @jax.jit
+    def scatter(gid, v):
+        return jax.ops.segment_sum(v, gid, num_segments=groups)
+
+    t_scatter = _timeit(lambda: jax.block_until_ready(scatter(gid, sv)))
+    cost_per_row_scatter = t_scatter * 1e6 / rows
+
+    out = {
+        "cost_per_row_dense": cost_per_row_dense,
+        "cost_per_row_scatter": cost_per_row_scatter,
+        "rows": rows,
+        "groups": groups,
+        "device": str(jax.devices()[0]),
+        "n_devices": len(jax.devices()),
+    }
+
+    # mesh measurements need >1 device (real chips or a CPU-forced mesh)
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS, make_mesh
+
+        mesh = make_mesh(n_data=n_dev, n_groups=1)
+        state_g, state_m = 4096, 64  # 1 MiB of f32 merge state
+        local = jnp.asarray(
+            rng.random((n_dev * state_g, state_m)).astype(np.float32)
+        )
+        sharded = jax.device_put(local, NamedSharding(mesh, P(DATA_AXIS)))
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS),),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def allreduce(x):
+            return jax.lax.psum(x, DATA_AXIS)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS),),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+        def no_comm(x):
+            return x * 2.0
+
+        t_ar = _timeit(lambda: jax.block_until_ready(allreduce(sharded)))
+        t_base = _timeit(lambda: jax.block_until_ready(no_comm(sharded)))
+        bytes_moved = 2.0 * (n_dev - 1) / n_dev * state_g * state_m * 4
+        t_comm = max(t_ar - t_base, 1e-7)
+        out["collective_bytes_per_us"] = bytes_moved / (t_comm * 1e6)
+
+        # dispatch overhead: end-to-end tiny SPMD aggregate incl. host gather
+        tiny_rows = ROW_PAD * n_dev
+        tgid = jax.device_put(
+            np.zeros(tiny_rows, np.int32), NamedSharding(mesh, P(DATA_AXIS))
+        )
+        tsv = jax.device_put(
+            np.ones((tiny_rows, 1), np.float32),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        )
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def tiny_agg(gid, v):
+            return jax.lax.psum(
+                jax.ops.segment_sum(v, gid, num_segments=8), DATA_AXIS
+            )
+
+        t_tiny = _timeit(lambda: np.asarray(tiny_agg(tgid, tsv)))
+        out["cost_dispatch_us"] = t_tiny * 1e6
+
+    if save_path:
+        with open(save_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(calibrate()))

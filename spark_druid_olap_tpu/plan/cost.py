@@ -71,6 +71,11 @@ def groupby_state_bytes(q: Q.QuerySpec, num_groups: int, cfg: SessionConfig) -> 
     return (per_group + 4) * num_groups  # +4: hidden __rows counter
 
 
+def _g_tiles(num_groups: int) -> int:
+    """128-wide vector-lane tiles the one-hot block spans."""
+    return max(1, -(-num_groups // 128))
+
+
 def choose_physical(
     q: Q.QuerySpec,
     ds: DataSource,
@@ -78,35 +83,72 @@ def choose_physical(
     cfg: SessionConfig,
     n_devices: int = 1,
 ) -> PhysicalPlan:
+    """Pick kernel strategy + execution target (the DruidQueryCostModel
+    broker-vs-historicals analog).  All costs in microseconds, from the
+    calibratable SessionConfig constants (plan/calibrate.py)."""
     rows = ds.num_rows
-    # kernel strategy: one-hot row cost scales with G/128 vector lanes;
-    # scatter cost is flat-but-large per row (serialized updates)
-    dense_cost = rows * cfg.cost_per_row_dense * max(num_groups / 128.0, 1.0)
+    # kernel strategy: one-hot row cost scales with ceil(G/128) vector-lane
+    # tiles; scatter cost is flat-but-large per row (serialized updates)
+    dense_cost = rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
     scatter_cost = rows * cfg.cost_per_row_scatter
     if num_groups <= cfg.dense_max_groups and (
         not cfg.cost_model_enabled or dense_cost <= scatter_cost * 4
     ):
-        strategy, per_row = "dense", dense_cost
+        strategy, local_cost = "dense", dense_cost
     else:
-        strategy, per_row = "segment", scatter_cost
+        # scatter class.  When the sort-compaction accelerator applies (real
+        # dims, no sketch states to re-key, domain past the scatter cutover)
+        # name it "sparse" so the engine tries compaction first; an explicit
+        # user "segment" stays raw scatter (ADVICE r1).
+        from ..ops.groupby import SCATTER_CUTOVER
+        from ..models import aggregations as A
 
-    state_bytes = groupby_state_bytes(q, num_groups, cfg)
-    collective_cost = (
-        state_bytes / 1e6 * (n_devices - 1) / max(cfg.collective_bytes_per_us, 1e-9)
-        if n_devices > 1
-        else 0.0
-    )
-    local_cost = per_row
-    dist_cost = per_row / max(n_devices, 1) + collective_cost
+        aggs = getattr(q, "aggregations", ())
+        has_sketch = any(
+            isinstance(
+                a.aggregator if isinstance(a, A.FilteredAgg) else a,
+                (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch),
+            )
+            for a in aggs
+        )
+        sparse_ok = (
+            num_groups > SCATTER_CUTOVER
+            and not has_sketch
+            and bool(getattr(q, "dimensions", ()))
+        )
+        strategy, local_cost = ("sparse" if sparse_ok else "segment"), scatter_cost
 
-    distributed = cfg.prefer_distributed and n_devices > 1 and (
-        not cfg.cost_model_enabled or dist_cost < local_cost
+    # distributed target: only the dense GroupBy-family path runs SPMD
+    # (parallel/distributed.py); scans and the scatter/sparse strategies are
+    # single-device by construction
+    aggregate_family = isinstance(
+        q, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
     )
+    distributed = False
     mesh_shape = None
-    if distributed:
-        ngroups_axis = cfg.mesh_groups_axis
-        ndata = cfg.mesh_data_axis or (n_devices // max(ngroups_axis, 1))
-        mesh_shape = (ndata, ngroups_axis)
+    dist_cost = local_cost
+    if n_devices > 1 and aggregate_family and strategy == "dense":
+        ng = max(1, cfg.mesh_groups_axis)
+        nd = cfg.mesh_data_axis or max(1, n_devices // ng)
+        nd = min(nd, max(1, n_devices // ng))
+        # rows shard over the data axis (replicated across the groups axis);
+        # the groups axis shards the one-hot block, shrinking per-device G
+        per_device_groups = -(-num_groups // ng)
+        compute = (
+            rows / nd * cfg.cost_per_row_dense * _g_tiles(per_device_groups)
+        )
+        state_bytes = groupby_state_bytes(q, per_device_groups, cfg)
+        # ring allreduce over the data axis moves ~2*(nd-1)/nd of the state
+        collective = (
+            2.0 * (nd - 1) / nd * state_bytes
+            / max(cfg.collective_bytes_per_us, 1e-9)
+        )
+        dist_cost = compute + collective + cfg.cost_dispatch_us
+        distributed = cfg.prefer_distributed and (
+            not cfg.cost_model_enabled or dist_cost < local_cost
+        )
+        if distributed:
+            mesh_shape = (nd, ng)
     return PhysicalPlan(
         query=q,
         strategy=strategy,
