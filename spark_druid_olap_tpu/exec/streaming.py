@@ -17,9 +17,12 @@ moment:
   * only the tiny [G, M] partial-aggregate state persists across chunks
     (summed / min-maxed / sketch-merged on device).
 
-The partial state is mergeable across streams and across chips (same merge
-classes the distributed engine psums over ICI), so a multichip streaming
-rollup is just this executor under shard_map.
+Multichip streaming (BASELINE config #4 at v5e-8 scale): pass a `mesh` and
+every chunk is sharded over the mesh's data axis (`jax.device_put` with a
+NamedSharding), the per-chunk program is the DistributedEngine's SPMD
+shard_map (dense partials + psum/pmin/pmax/sketch merges over ICI), and only
+the tiny replicated [G, M] state accumulates across chunks.  Chunk k+1's H2D
+scatter overlaps chunk k's compute exactly as in the single-chip path.
 """
 
 from __future__ import annotations
@@ -67,9 +70,15 @@ class StreamExecutor:
     padded (a validity mask keeps padding out of every aggregate).
     """
 
-    def __init__(self, engine: Optional[Engine] = None, prefetch: int = 2):
+    def __init__(
+        self,
+        engine: Optional[Engine] = None,
+        prefetch: int = 2,
+        mesh=None,
+    ):
         self.engine = engine or Engine()
         self.prefetch = prefetch
+        self.mesh = mesh  # jax.sharding.Mesh -> multichip streaming
         self.stats = StreamStats()
 
     # -- public entry points -------------------------------------------------
@@ -108,8 +117,13 @@ class StreamExecutor:
         chunk_rows: int,
     ):
         q = groupby_with_time_granularity(q)
-        if chunk_rows % ROW_PAD:
-            chunk_rows = -(-chunk_rows // ROW_PAD) * ROW_PAD
+        pad_unit = ROW_PAD
+        if self.mesh is not None:
+            from ..parallel.mesh import DATA_AXIS
+
+            pad_unit = ROW_PAD * self.mesh.shape[DATA_AXIS]
+        if chunk_rows % pad_unit:
+            chunk_rows = -(-chunk_rows // pad_unit) * pad_unit
         if (
             any(d.dimension == "__time" or d.granularity for d in q.dimensions)
             and not q.intervals
@@ -124,7 +138,25 @@ class StreamExecutor:
         la, G = lowering.la, lowering.num_groups
         need = list(lowering.columns)
         eng = self.engine
-        seg_fn = eng._segment_program(q, ds, lowering)
+
+        dist_run = None
+        if self.mesh is not None:
+            # per-chunk SPMD program shared with DistributedEngine: dense
+            # partials on each device's row shard, psum/pmin/pmax + sketch
+            # merges over ICI, replicated [G, M] state back
+            from ..parallel.distributed import DistributedEngine
+            from ..parallel.mesh import DATA_AXIS
+
+            nd = self.mesh.shape[DATA_AXIS]
+            dist = DistributedEngine(mesh=self.mesh)
+            col_keys = list(need) + ["__valid"]
+            if ds.time_column and ds.time_column in need:
+                col_keys.append("__time")
+            dist_run = dist._spmd_fn(
+                lowering, chunk_rows // nd, ds, tuple(col_keys)
+            )
+        else:
+            seg_fn = eng._segment_program(q, ds, lowering)
 
         sums = mins = maxs = None
         sketch_states: Dict[str, jnp.ndarray] = {}
@@ -133,9 +165,12 @@ class StreamExecutor:
         for dev_cols in self._prefetched_device_chunks(
             chunks, need, ds, chunk_rows
         ):
-            (s, mn, mx, sk), seg_fn = eng._call_segment_program(
-                q, ds, lowering, seg_fn, dev_cols
-            )
+            if dist_run is not None:
+                s, mn, mx, sk = dist_run(dev_cols)
+            else:
+                (s, mn, mx, sk), seg_fn = eng._call_segment_program(
+                    q, ds, lowering, seg_fn, dev_cols
+                )
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
@@ -223,6 +258,14 @@ class StreamExecutor:
             except BaseException as e:  # surface producer errors to consumer
                 _put(e)
 
+        sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import DATA_AXIS
+
+            sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         try:
@@ -233,7 +276,9 @@ class StreamExecutor:
                 if isinstance(item, BaseException):
                     raise item
                 rows = item.pop("__rows")
-                dev = {k: jax.device_put(v) for k, v in item.items()}
+                dev = {
+                    k: jax.device_put(v, sharding) for k, v in item.items()
+                }
                 if ds.time_column and ds.time_column in dev:
                     dev["__time"] = dev[ds.time_column]
                 self.stats.rows += int(rows)
