@@ -211,6 +211,9 @@ def bench_tpch_q1(scale: float):
             "pandas_baseline_s": round(pandas_time, 5),
             "device": _device(),
             "rows": n_rows,
+            "metrics": (
+                eng.last_metrics.to_dict() if eng.last_metrics else None
+            ),
         },
     }
 

@@ -45,6 +45,7 @@ class TPUOlapContext:
         self.catalog = MetadataCache()
         self.engine = Engine()
         self._dist_engine = None
+        self._last_engine_metrics = None  # metrics of the engine that last ran
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -148,6 +149,33 @@ class TPUOlapContext:
         lp, _, _ = parse_sql(sql_text)
         return self._planner().explain(lp)
 
+    @property
+    def last_metrics(self):
+        """QueryMetrics of the most recent execution (exec/metrics.py) —
+        rows/sec, H2D bytes streamed, compile/device/collective/finalize
+        phase times — from whichever engine ran it."""
+        dm = self._dist_engine.last_metrics if self._dist_engine else None
+        em = self.engine.last_metrics
+        if dm is None:
+            return em
+        if em is None:
+            return dm
+        # whichever ran last (engines stamp at completion; compare by
+        # object recency via a monotonic counter would be overkill — the
+        # distributed engine only runs when the planner chose it, so prefer
+        # the one matching the last rewrite if known; default local)
+        return self._last_engine_metrics or em
+
+    def explain_analyze(self, sql_text: str):
+        """EXPLAIN ANALYZE analog: run the query, return (DataFrame,
+        explain text + measured QueryMetrics)."""
+        df = self.sql(sql_text)
+        text = self.explain(sql_text)
+        m = self.last_metrics
+        if m is not None:
+            text += "\n\n== Execution Metrics ==\n" + m.describe()
+        return df, text
+
     # -- execution -----------------------------------------------------------
 
     def sql(self, sql_text: str):
@@ -172,6 +200,7 @@ class TPUOlapContext:
             df = self._execute_grouping_sets(rw, ds, engine)
         else:
             df = engine.execute(rw.query, ds)
+        self._last_engine_metrics = getattr(engine, "last_metrics", None)
 
         # host-side residuals (the DruidStrategy projection-fixup analog)
         for name, e in rw.host_post_exprs:

@@ -606,6 +606,9 @@ class Engine:
 
     def __init__(self, strategy: str = "auto"):
         self.strategy = strategy
+        # observability (SURVEY.md §5): populated on every execution
+        self.last_metrics = None
+        self._m = None  # metrics object being filled during one execution
         self._pallas_broken = False  # set on first Mosaic-compile failure
         # queries pinned off the sparse accelerator: compaction overflowed
         # SPARSE_SLOTS distinct groups, or the sparse program failed even
@@ -622,17 +625,33 @@ class Engine:
     # -- segment residency ---------------------------------------------------
 
     def _device_cols(self, seg: Segment, names) -> Dict[str, jnp.ndarray]:
+        import time as _time
+
         cols: Dict[str, jnp.ndarray] = {}
+
+        def put(key, host):
+            t0 = _time.perf_counter()
+            arr = jnp.asarray(host)
+            self._device_cache[key] = arr
+            if self._m is not None:  # streamed-bytes metric (cache misses only)
+                self._m.h2d_bytes += int(np.asarray(host).nbytes)
+                self._m.h2d_ms += (_time.perf_counter() - t0) * 1e3
+            return arr
+
         for n in names:
             key = (seg.uid, n)
             if key not in self._device_cache:
-                self._device_cache[key] = jnp.asarray(seg.column(n))
+                put(key, seg.column(n))
             cols[n] = self._device_cache[key]
         key = (seg.uid, "__valid")
         if key not in self._device_cache:
-            self._device_cache[key] = jnp.asarray(seg.valid)
+            put(key, seg.valid)
         cols["__valid"] = self._device_cache[key]
         return cols
+
+    def bytes_resident(self) -> int:
+        """HBM bytes held by the segment residency cache."""
+        return sum(int(a.nbytes) for a in self._device_cache.values())
 
     def clear_cache(self):
         """Analog of the reference's metadata/cache clear command."""
@@ -708,8 +727,22 @@ class Engine:
         """Run one segment program with the Pallas compile-failure fallback.
         Returns (result, seg_fn) — seg_fn may be a rebuilt XLA-dense program
         after a Mosaic failure."""
+        import time as _time
+
         try:
-            return seg_fn(cols), seg_fn
+            # first call of a newly-built program = trace+compile (+async
+            # dispatch); attribute it to compile_ms (see metrics.py)
+            t0 = (
+                _time.perf_counter()
+                if self._m is not None
+                and not self._m.program_cache_hit
+                and self._m.compile_ms == 0
+                else None
+            )
+            result = seg_fn(cols)
+            if t0 is not None:
+                self._m.compile_ms = (_time.perf_counter() - t0) * 1e3
+            return result, seg_fn
         except Exception:
             # Auto-selected Pallas may fail to Mosaic-compile on exotic
             # backends: retry once on the XLA dense path.  Only 'auto'
@@ -778,6 +811,8 @@ class Engine:
         # (new dict cardinalities => new G) must not reuse a stale program
         key = _query_key(q, ds) + (strategy,)
         if key in self._query_fn_cache:
+            if self._m is not None:
+                self._m.program_cache_hit = True
             return self._query_fn_cache[key]
 
         from ..ops import hll as hll_ops
@@ -844,6 +879,8 @@ class Engine:
         )
         key = _query_key(q, ds) + (f"sparse:{inner}",)
         if key in self._query_fn_cache:
+            if self._m is not None:
+                self._m.program_cache_hit = True
             return self._query_fn_cache[key]
 
         @jax.jit
@@ -930,28 +967,61 @@ class Engine:
         )
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
+        import time as _time
+
+        from .metrics import QueryMetrics
+
+        t_total = _time.perf_counter()
         q = groupby_with_time_granularity(q)
         lowering = lower_groupby(q, ds)
-        if self._sparse_eligible(lowering) and self._segments_in_scope(q, ds):
-            qkey = _query_key(q, ds)
-            if qkey not in self._sparse_disabled:
-                out = self._execute_groupby_sparse(q, ds, lowering)
-                if out is not None:
-                    return out
-                self._sparse_disabled.add(qkey)
-        dims, la, G, sums, mins, maxs, sketch_states = self._partials_for_query(
-            q, ds, lowering=lowering
+        segs = self._segments_in_scope(q, ds)
+        m = self._m = QueryMetrics(
+            query_type="groupBy",
+            strategy=self._resolve_strategy(lowering.num_groups),
+            rows_scanned=sum(s.num_rows for s in segs),
+            segments=len(segs),
+            num_groups=lowering.num_groups,
         )
-        # ONE device_get for everything: each separate host fetch of a device
-        # buffer pays a full round trip (dozens of ms when the TPU sits
-        # behind a network tunnel); a single pytree fetch pays one.
-        sums, mins, maxs, sketch_states = jax.device_get(
-            (sums, mins, maxs, sketch_states)
-        )
-        return finalize_groupby(
-            q, dims, la, np.asarray(sums), np.asarray(mins), np.asarray(maxs),
-            {k: np.asarray(v) for k, v in sketch_states.items()},
-        )
+        try:
+            if self._sparse_eligible(lowering) and segs:
+                qkey = _query_key(q, ds)
+                if qkey not in self._sparse_disabled:
+                    m.strategy = "sparse"
+                    t0 = _time.perf_counter()
+                    out = self._execute_groupby_sparse(q, ds, lowering)
+                    if out is not None:
+                        m.device_ms = (_time.perf_counter() - t0) * 1e3
+                        return out
+                    self._sparse_disabled.add(qkey)
+                    m.strategy = self._resolve_strategy(lowering.num_groups)
+            t0 = _time.perf_counter()
+            dims, la, G, sums, mins, maxs, sketch_states = (
+                self._partials_for_query(q, ds, lowering=lowering)
+            )
+            # ONE device_get for everything: each separate host fetch of a
+            # device buffer pays a full round trip (dozens of ms when the TPU
+            # sits behind a network tunnel); a single pytree fetch pays one.
+            sums, mins, maxs, sketch_states = jax.device_get(
+                (sums, mins, maxs, sketch_states)
+            )
+            m.device_ms = (
+                (_time.perf_counter() - t0) * 1e3
+                - m.h2d_ms
+                - m.compile_ms
+            )
+            t0 = _time.perf_counter()
+            out = finalize_groupby(
+                q, dims, la,
+                np.asarray(sums), np.asarray(mins), np.asarray(maxs),
+                {k: np.asarray(v) for k, v in sketch_states.items()},
+            )
+            m.finalize_ms = (_time.perf_counter() - t0) * 1e3
+            return out
+        finally:
+            m.total_ms = (_time.perf_counter() - t_total) * 1e3
+            m.bytes_resident = self.bytes_resident()
+            self.last_metrics = m
+            self._m = None
 
     # -- timeseries: a groupby whose only dimension is the time bucket -------
 

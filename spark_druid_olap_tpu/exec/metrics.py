@@ -1,0 +1,97 @@
+"""Per-query execution metrics (the observability subsystem).
+
+Reference parity: the reference leans on Spark SQL scan-node metrics plus
+Druid's server-side query metrics and has no dedicated tracer (SURVEY.md §5);
+the TPU build owes the BASELINE metric set — rows/sec/chip, HBM bytes
+streamed, kernel vs collective time.  `QueryMetrics` is populated by the
+engines on every execution and surfaced via `TPUOlapContext.last_metrics`,
+`explain_analyze()`, and bench detail JSON.
+
+Phase semantics (wall-clock, single process):
+  * `h2d_ms` / `h2d_bytes` — host->device column transfers this query caused
+    (zero on residency-cache hits: the streamed-bytes metric).
+  * `compile_ms` — time of the first program invocation when the XLA program
+    for this (query, shape) was not yet compiled; includes that first
+    execution (JAX jit compiles lazily; isolating pure-compile would need
+    AOT shape pinning the segment loop doesn't want).  0 on warm paths.
+  * `device_ms` — dispatch + block time of the remaining (steady-state)
+    program calls plus the result fetch.
+  * `est_collective_ms` — modelled ICI merge time for distributed runs
+    (state bytes x ring factor / configured bandwidth); measured split of
+    kernel-vs-collective inside one fused SPMD program is profiler
+    territory: use `trace()` below.
+  * `finalize_ms` — host-side result materialization.
+
+`trace(logdir)` wraps `jax.profiler.trace` for the deep-dive path
+(tensorboard-viewable device timelines incl. per-collective timing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class QueryMetrics:
+    query_type: str = ""
+    strategy: str = ""
+    distributed: bool = False
+    mesh_shape: Optional[tuple] = None
+    rows_scanned: int = 0
+    segments: int = 0
+    num_groups: int = 0
+    h2d_bytes: int = 0
+    h2d_ms: float = 0.0
+    compile_ms: float = 0.0
+    device_ms: float = 0.0
+    est_collective_ms: float = 0.0
+    finalize_ms: float = 0.0
+    total_ms: float = 0.0
+    bytes_resident: int = 0
+    program_cache_hit: bool = False
+
+    @property
+    def rows_per_sec(self) -> float:
+        if self.total_ms <= 0:
+            return 0.0
+        return self.rows_scanned / (self.total_ms / 1e3)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["rows_per_sec"] = round(self.rows_per_sec)
+        for k, v in list(d.items()):
+            if isinstance(v, float):
+                d[k] = round(v, 3)
+        return d
+
+    def describe(self) -> str:
+        tgt = (
+            f"mesh{self.mesh_shape}" if self.distributed else "single-device"
+        )
+        return (
+            f"QueryMetrics[{self.query_type} strategy={self.strategy} "
+            f"target={tgt} rows={self.rows_scanned} segments={self.segments} "
+            f"groups={self.num_groups} total={self.total_ms:.2f}ms "
+            f"(h2d={self.h2d_ms:.2f}ms/{self.h2d_bytes}B "
+            f"compile={self.compile_ms:.2f}ms device={self.device_ms:.2f}ms "
+            f"est_collective={self.est_collective_ms:.2f}ms "
+            f"finalize={self.finalize_ms:.2f}ms) "
+            f"rows/s={self.rows_per_sec:,.0f} "
+            f"resident={self.bytes_resident}B "
+            f"cache_hit={self.program_cache_hit}]"
+        )
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace context for deep dives (kernel + collective
+    timelines in tensorboard); no-op if the profiler is unavailable."""
+    import jax
+
+    try:
+        with jax.profiler.trace(logdir):
+            yield
+    except Exception:
+        yield

@@ -68,6 +68,7 @@ class DistributedEngine:
 
     def __init__(self, mesh: Optional[Mesh] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
+        self.last_metrics = None  # observability (exec/metrics.py)
         # row-shard cache: keyed by the exact segment set the shard was built
         # from (interval pruning changes the set => different global layout)
         self._shard_cache: Dict[Tuple, jax.Array] = {}
@@ -224,15 +225,55 @@ class DistributedEngine:
             df = self.execute(topn_to_groupby(q), ds)
             return finalize_topn(df, q)
         assert isinstance(q, Q.GroupByQuery), type(q)
+        import time as _time
+
+        from ..config import SessionConfig
+        from ..exec.metrics import QueryMetrics
+        from ..plan.cost import groupby_state_bytes
+
+        t_total = _time.perf_counter()
         q = groupby_with_time_granularity(q)
 
         lowering = lower_groupby(q, ds)
+        m = QueryMetrics(
+            query_type="groupBy",
+            strategy="dense",
+            distributed=True,
+            mesh_shape=tuple(self.mesh.shape.values()),
+            rows_scanned=ds.num_rows,
+            segments=len(ds.segments),
+            num_groups=lowering.num_groups,
+        )
+        t0 = _time.perf_counter()
+        known = len(self._shard_cache)
         cols, padded = self._global_columns(ds, lowering.columns, q.intervals)
+        if len(self._shard_cache) > known:  # new shards were placed
+            m.h2d_ms = (_time.perf_counter() - t0) * 1e3
+            m.h2d_bytes = sum(
+                int(a.nbytes) for a in self._shard_cache.values()
+            )
         local_rows = padded // self.mesh.shape[DATA_AXIS]
+        compiled = self._spmd_cache
+        key_count = len(compiled)
         run = self._spmd_fn(lowering, local_rows, ds, tuple(cols.keys()))
+        m.program_cache_hit = len(compiled) == key_count
+        nd = self.mesh.shape[DATA_AXIS]
+        m.est_collective_ms = (
+            2.0 * (nd - 1) / nd
+            * groupby_state_bytes(q, lowering.num_groups, None)
+            / SessionConfig().collective_bytes_per_us
+            / 1e3
+        )
+        t0 = _time.perf_counter()
         # single host fetch (one round trip — see engine._execute_groupby)
         sums, mins, maxs, sk = jax.device_get(run(cols))
-        return finalize_groupby(
+        dt = (_time.perf_counter() - t0) * 1e3
+        if m.program_cache_hit:
+            m.device_ms = dt
+        else:  # first call: trace+compile dominates (metrics.py semantics)
+            m.compile_ms = dt
+        t0 = _time.perf_counter()
+        out = finalize_groupby(
             q,
             lowering.dims,
             lowering.la,
@@ -241,3 +282,10 @@ class DistributedEngine:
             np.asarray(maxs),
             {k: np.asarray(v) for k, v in sk.items()},
         )
+        m.finalize_ms = (_time.perf_counter() - t0) * 1e3
+        m.total_ms = (_time.perf_counter() - t_total) * 1e3
+        m.bytes_resident = sum(
+            int(a.nbytes) for a in self._shard_cache.values()
+        )
+        self.last_metrics = m
+        return out

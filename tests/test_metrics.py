@@ -1,0 +1,128 @@
+"""Observability subsystem (SURVEY.md §5 / VERDICT r1 missing #4): every
+execution must populate QueryMetrics — H2D bytes streamed, compile vs device
+phase times, rows/sec, residency — on both the local and distributed engines,
+and explain_analyze() must surface them."""
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.catalog.segment import build_datasource
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.exec.engine import Engine
+from spark_druid_olap_tpu.models.aggregations import Count, DoubleSum
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.query import GroupByQuery
+
+
+@pytest.fixture(scope="module")
+def ds():
+    n = 20_000
+    rng = np.random.default_rng(5)
+    return build_datasource(
+        "m",
+        {
+            "d": rng.integers(0, 16, n).astype(np.int64),
+            "v": rng.random(n).astype(np.float32),
+        },
+        dimension_cols=["d"],
+        metric_cols=["v"],
+    )
+
+
+def _q():
+    return GroupByQuery(
+        datasource="m",
+        dimensions=(DimensionSpec("d"),),
+        aggregations=(DoubleSum("s", "v"), Count("n")),
+    )
+
+
+def test_local_engine_metrics_populated(ds):
+    eng = Engine()
+    eng.execute(_q(), ds)
+    m = eng.last_metrics
+    assert m is not None and m.query_type == "groupBy"
+    assert m.rows_scanned == 20_000 and m.segments == 1
+    # cold run: columns were streamed and the program was compiled
+    assert m.h2d_bytes > 0
+    assert m.compile_ms > 0 and not m.program_cache_hit
+    assert m.total_ms > 0 and m.rows_per_sec > 0
+    assert m.bytes_resident >= m.h2d_bytes
+
+    # warm run: residency + program cache hits, no new H2D traffic
+    eng.execute(_q(), ds)
+    m2 = eng.last_metrics
+    assert m2.h2d_bytes == 0
+    assert m2.program_cache_hit and m2.compile_ms == 0
+    assert m2.device_ms >= 0
+
+
+def test_metrics_to_dict_roundtrip(ds):
+    eng = Engine()
+    eng.execute(_q(), ds)
+    d = eng.last_metrics.to_dict()
+    for k in (
+        "h2d_bytes",
+        "compile_ms",
+        "device_ms",
+        "finalize_ms",
+        "total_ms",
+        "rows_per_sec",
+        "bytes_resident",
+    ):
+        assert k in d
+    import json
+
+    json.dumps(d)  # must be JSON-serializable for bench detail
+
+
+def test_distributed_metrics_populated():
+    ctx = sd.TPUOlapContext(SessionConfig(cost_dispatch_us=0.0))
+    n = 100_000
+    rng = np.random.default_rng(2)
+    ctx.register_table(
+        "dm",
+        {
+            "d": rng.integers(0, 8, n).astype(np.int64),
+            "v": rng.random(n).astype(np.float32),
+        },
+        dimensions=["d"],
+        metrics=["v"],
+    )
+    rw = ctx.plan_sql("SELECT d, sum(v) AS s FROM dm GROUP BY d")
+    assert rw.physical.distributed
+    ctx.sql("SELECT d, sum(v) AS s FROM dm GROUP BY d")
+    m = ctx.last_metrics
+    assert m is not None and m.distributed
+    assert m.mesh_shape is not None
+    assert m.est_collective_ms >= 0
+    assert m.rows_scanned == n and m.total_ms > 0
+
+
+def test_explain_analyze_surfaces_metrics(ds):
+    ctx = sd.TPUOlapContext()
+    n = 5000
+    rng = np.random.default_rng(3)
+    ctx.register_table(
+        "ea",
+        {
+            "d": rng.integers(0, 4, n).astype(np.int64),
+            "v": rng.random(n).astype(np.float32),
+        },
+        dimensions=["d"],
+        metrics=["v"],
+    )
+    df, text = ctx.explain_analyze("SELECT d, sum(v) AS s FROM ea GROUP BY d")
+    assert len(df) == 4
+    assert "== Execution Metrics ==" in text
+    assert "rows/s=" in text
+
+
+def test_profiler_trace_context(tmp_path, ds):
+    from spark_druid_olap_tpu.exec.metrics import trace
+
+    eng = Engine()
+    with trace(str(tmp_path / "jaxtrace")):
+        eng.execute(_q(), ds)
+    assert eng.last_metrics is not None
