@@ -125,9 +125,38 @@ def _resolve_time_dim(
     if intervals:
         lo = min(a for a, _ in intervals)
         hi = max(b for _, b in intervals)
+        # open-ended predicate intervals (t >= x -> hi = 2^62) would expand
+        # the bucket table unboundedly; the data's own range bounds it
+        dsiv = ds.interval()
+        if dsiv is not None:
+            lo = max(lo, dsiv[0])
+            hi = max(lo, min(hi, dsiv[1]))
     starts = bucket_starts(lo, hi, gran)  # host-computed bucket boundaries
     card = len(starts)
     starts_dev = jnp.asarray(starts)
+
+    if spec.extraction is not None:
+        # EXTRACT-style dims: many buckets fold to one extracted value
+        # (e.g. MONTH over 3 years: 36 buckets -> 12 groups).  Host-side
+        # remap over bucket starts; the kernel adds one tiny gather.
+        extracted = spec.extraction.apply_to_dict([int(s) for s in starts])
+        new_vals = sorted(set(extracted))
+        index = {v: i for i, v in enumerate(new_vals)}
+        remap_dev = jnp.asarray(
+            np.array([index[v] for v in extracted], dtype=np.int32)
+        )
+
+        def codes_fn(cols, starts_dev=starts_dev, remap_dev=remap_dev):
+            t = cols["__time"]
+            b = jnp.searchsorted(starts_dev, t, side="right").astype(jnp.int32) - 1
+            return remap_dev[jnp.clip(b, 0, remap_dev.shape[0] - 1)]
+
+        vals_arr = np.asarray(new_vals, dtype=object)
+
+        def decode(codes, vals_arr=vals_arr):
+            return vals_arr[np.clip(codes, 0, len(vals_arr) - 1)]
+
+        return ResolvedDim(spec, len(new_vals), codes_fn, decode)
 
     def codes_fn(cols, starts_dev=starts_dev):
         t = cols["__time"]

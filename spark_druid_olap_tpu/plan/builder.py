@@ -69,6 +69,9 @@ class QueryBuilder:
             len(self.dimensions) == 1
             and self.dimensions[0].dimension == "__time"
             and self.dimensions[0].granularity is not None
+            # an extraction folds buckets (EXTRACT(MONTH...)): the result is
+            # keyed by the extracted value, not the bucket timeline
+            and self.dimensions[0].extraction is None
             and self.topn_threshold is None
             and not self.grouping_sets
         )

@@ -228,12 +228,7 @@ class Planner:
         # guards (maxResultCardinality analog)
         G = 1
         for d in dims:
-            card = (
-                ds.cardinality(d.dimension) + 1
-                if d.dimension in ds.dicts
-                else 4096
-            )
-            G *= card
+            G *= _estimate_dim_cardinality(d, ds)
         if G > self.cfg.max_result_cardinality:
             raise RewriteError(
                 f"estimated result cardinality {G} exceeds "
@@ -343,6 +338,36 @@ class Planner:
         if ds is None:
             raise RewriteError(f"unknown table {table!r}")
         return ds
+
+
+def _estimate_dim_cardinality(d, ds: DataSource) -> int:
+    """Plan-time group-count estimate per dimension (drives the result-
+    cardinality guard and the cost model; the engine computes exact counts
+    at lowering)."""
+    from ..models.dimensions import TimeFieldExtraction
+
+    if isinstance(d.extraction, TimeFieldExtraction):
+        field = d.extraction.field
+        if field == "year":
+            iv = ds.interval()
+            if iv is not None:
+                return max(1, int((iv[1] - iv[0]) // 31_536_000_000) + 2)
+            return 300
+        return {"month": 12, "day": 31, "hour": 24, "minute": 60,
+                "second": 60}[field]
+    if d.dimension in ds.dicts:
+        return ds.cardinality(d.dimension) + 1
+    if d.dimension == "__time" and d.granularity is not None:
+        iv = ds.interval()
+        from ..utils.granularity import granularity_period_ms
+
+        try:
+            p = granularity_period_ms(d.granularity)
+        except ValueError:
+            p = None
+        if iv is not None and p:
+            return max(1, int((iv[1] - iv[0]) // p) + 2)
+    return 4096
 
 
 def _contains_aggregate(n: L.LogicalPlan) -> bool:

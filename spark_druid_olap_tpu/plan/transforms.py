@@ -262,11 +262,29 @@ def translate_group_expr(
             raise RewriteError("DATE_TRUNC over non-time column")
         return DimensionSpec("__time", name, granularity=e.granularity), b
     if isinstance(e, E.TimeExtract):
-        # EXTRACT in GROUP BY: device row expression as a dimension is not
-        # dictionary-backed; use a virtual int dimension via time bucketing
-        # when possible (year/month), else reject.
+        # EXTRACT in GROUP BY plans as a dictionary-backed dimension
+        # (SURVEY.md §2 DimensionSpec/timeFormat row): over the time column
+        # it buckets at the field's granularity and remaps bucket starts;
+        # over a numeric-dict date dimension it rewrites the dictionary.
+        from ..models.dimensions import TimeFieldExtraction
+
+        ex = TimeFieldExtraction(e.field)
+        if _is_time_col(e.operand, ds):
+            return (
+                DimensionSpec(
+                    "__time", name, extraction=ex, granularity=ex.granularity
+                ),
+                b,
+            )
+        if (
+            isinstance(e.operand, E.Col)
+            and e.operand.name in ds.dicts
+            and ds.dicts[e.operand.name].numeric_values is not None
+        ):
+            return DimensionSpec(e.operand.name, name, extraction=ex), b
         raise RewriteError(
-            "EXTRACT in GROUP BY not yet dictionary-backed; use DATE_TRUNC"
+            f"EXTRACT({e.field}) in GROUP BY requires the time column or a "
+            "numeric-dictionary date dimension"
         )
     if isinstance(e, E.StrFunc):
         if not isinstance(e.operand, E.Col) or e.operand.name not in ds.dicts:

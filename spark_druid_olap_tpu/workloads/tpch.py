@@ -315,6 +315,20 @@ QUERIES: Dict[str, str] = {
         GROUP BY l_shipmode
         ORDER BY l_shipmode
     """,
+    # Q8 via EXTRACT(YEAR FROM o_orderdate) — no pre-materialized year
+    # column needed (dictionary-backed EXTRACT dimension)
+    "q8_extract": f"""
+        SELECT EXTRACT(YEAR FROM o_orderdate) AS o_orderdate_year,
+               sum(CASE WHEN s_nation = 'BRAZIL'
+                        THEN l_extendedprice * (1 - l_discount)
+                        ELSE 0 END) AS brazil_volume,
+               sum(l_extendedprice * (1 - l_discount)) AS total_volume
+        FROM lineitem {_J_ORD} {_J_CUST} {_J_SUPP} {_J_PART}
+        WHERE c_region = 'AMERICA' AND p_type = 'ECONOMY ANODIZED STEEL'
+          AND o_orderdate >= '1995-01-01' AND o_orderdate <= '1996-12-31'
+        GROUP BY EXTRACT(YEAR FROM o_orderdate)
+        ORDER BY o_orderdate_year
+    """,
     # Q8-class: market share numerator/denominator via CASE over nation
     "q8": f"""
         SELECT o_orderdate_year,

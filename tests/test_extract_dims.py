@@ -1,0 +1,132 @@
+"""EXTRACT(field FROM ts) as a GROUP BY dimension (VERDICT r1 missing #7).
+
+Two plan shapes: over the datasource's time column (bucket + remap) and over
+a numeric-dictionary date dimension (dictionary rewrite).  Both must fold
+buckets correctly (MONTH over multiple years merges across years) and decode
+as integers per SQL EXTRACT semantics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    n = 30_000
+    rng = np.random.default_rng(11)
+    ts = (
+        np.datetime64("1993-05-01", "ms").astype(np.int64)
+        + rng.integers(0, 900, n) * 86_400_000
+        + rng.integers(0, 86_400_000, n)
+    )
+    d2 = (
+        np.datetime64("1994-01-01", "ms").astype(np.int64)
+        + rng.integers(0, 400, n) * 86_400_000
+    )
+    c.register_table(
+        "ev",
+        {"ts": ts, "d2": d2, "v": rng.random(n).astype(np.float32)},
+        dimensions=["d2"],
+        metrics=["v"],
+        time_column="ts",
+    )
+    df = pd.DataFrame(
+        {
+            "ts": ts.astype("datetime64[ms]"),
+            "d2": d2.astype("datetime64[ms]"),
+            "v": np.asarray(
+                c.catalog.get("ev").segments[0].metrics["v"][:n],
+                dtype=np.float64,
+            ),
+        }
+    )
+    return c, df
+
+
+def test_extract_year_from_time_col(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT EXTRACT(YEAR FROM ts) AS y, sum(v) AS s, count(*) AS n "
+        "FROM ev GROUP BY EXTRACT(YEAR FROM ts) ORDER BY y"
+    )
+    want = (
+        df.assign(y=df.ts.dt.year)
+        .groupby("y", as_index=False)
+        .agg(s=("v", "sum"), n=("v", "count"))
+        .sort_values("y")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(np.asarray(got["y"], np.int64), want["y"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    np.testing.assert_array_equal(got["n"], want["n"])
+
+
+def test_extract_month_folds_across_years(ctx):
+    """MONTH over a ~2.5-year span: buckets from different years must merge
+    into at most 12 groups."""
+    c, df = ctx
+    got = c.sql(
+        "SELECT EXTRACT(MONTH FROM ts) AS m, count(*) AS n "
+        "FROM ev GROUP BY EXTRACT(MONTH FROM ts) ORDER BY m"
+    )
+    want = (
+        df.assign(m=df.ts.dt.month)
+        .groupby("m", as_index=False)
+        .agg(n=("v", "count"))
+        .sort_values("m")
+        .reset_index(drop=True)
+    )
+    assert len(got) <= 12
+    np.testing.assert_array_equal(np.asarray(got["m"], np.int64), want["m"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+
+
+def test_extract_year_from_dict_dimension(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT EXTRACT(YEAR FROM d2) AS y, sum(v) AS s "
+        "FROM ev GROUP BY EXTRACT(YEAR FROM d2) ORDER BY y"
+    )
+    want = (
+        df.assign(y=df.d2.dt.year)
+        .groupby("y", as_index=False)
+        .agg(s=("v", "sum"))
+        .sort_values("y")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(np.asarray(got["y"], np.int64), want["y"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+
+
+def test_extract_with_filter_and_second_dim(ctx):
+    c, df = ctx
+    got = c.sql(
+        "SELECT EXTRACT(YEAR FROM ts) AS y, EXTRACT(MONTH FROM ts) AS m, "
+        "count(*) AS n FROM ev WHERE ts >= '1994-01-01' "
+        "GROUP BY EXTRACT(YEAR FROM ts), EXTRACT(MONTH FROM ts) "
+        "ORDER BY y, m"
+    )
+    f = df[df.ts >= np.datetime64("1994-01-01")]
+    want = (
+        f.assign(y=f.ts.dt.year, m=f.ts.dt.month)
+        .groupby(["y", "m"], as_index=False)
+        .agg(n=("v", "count"))
+        .sort_values(["y", "m"])
+        .reset_index(drop=True)
+    )
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(np.asarray(got["n"]), want["n"])
+
+
+def test_extract_over_metric_rejected(ctx):
+    c, _ = ctx
+    from spark_druid_olap_tpu.plan.planner import RewriteError
+
+    with pytest.raises(RewriteError):
+        c.plan_sql(
+            "SELECT EXTRACT(YEAR FROM v) AS y, count(*) AS n FROM ev "
+            "GROUP BY EXTRACT(YEAR FROM v)"
+        )
