@@ -225,18 +225,40 @@ class Planner:
         b = apply_sort_limit(b, sort_keys, limit, offset, self.cfg, rankable)
         b = b.with_(output_columns=tuple(output_columns))
 
-        # guards (maxResultCardinality analog)
-        G = 1
+        # guards (maxResultCardinality analog).  Declared functional
+        # dependencies tighten the estimate: grouping by a dependent column
+        # alongside its determinant cannot multiply the group count
+        # (c_city -> c_nation: |city x nation| is really <= |city|).
+        star = (
+            self.catalog.star_schema(table)
+            if hasattr(self.catalog, "star_schema")
+            else None
+        )
+        fd_dependents = set()
+        if star is not None:
+            grouped = {d.dimension for d in dims}
+            for fd in star.functional_dependencies:
+                if (
+                    fd.determinant in grouped
+                    and fd.dependent in grouped
+                    and fd.dependent != fd.determinant
+                ):
+                    fd_dependents.add(fd.dependent)
+        G_result = 1  # distinct output rows (FD-aware): the result guard
+        G_kernel = 1  # kernel group-id domain (row-major product): cost model
         for d in dims:
-            G *= _estimate_dim_cardinality(d, ds)
-        if G > self.cfg.max_result_cardinality:
+            card = _estimate_dim_cardinality(d, ds)
+            G_kernel *= card
+            if d.dimension not in fd_dependents:
+                G_result *= card
+        if G_result > self.cfg.max_result_cardinality:
             raise RewriteError(
-                f"estimated result cardinality {G} exceeds "
+                f"estimated result cardinality {G_result} exceeds "
                 f"max_result_cardinality={self.cfg.max_result_cardinality}"
             )
 
         q = b.build()
-        phys = choose_physical(q, ds, G, self.cfg, self.n_devices)
+        phys = choose_physical(q, ds, G_kernel, self.cfg, self.n_devices)
         return Rewrite(
             datasource=table,
             builder=b,
