@@ -179,6 +179,11 @@ class TPUOlapContext:
     # -- execution -----------------------------------------------------------
 
     def sql(self, sql_text: str):
+        from .sql.commands import parse_command, run_command
+
+        cmd = parse_command(sql_text)
+        if cmd is not None:
+            return run_command(self, cmd)
         lp, explain, out_names = parse_sql(sql_text)
         planner = self._planner()
         if explain:

@@ -1,0 +1,57 @@
+"""Non-SELECT SQL commands (the reference's parser-extension commands).
+
+Reference parity: SURVEY.md §2 "SQL commands / parser extras" row `[U]` —
+beyond `EXPLAIN DRUID REWRITE` the reference registers a clear-metadata-cache
+command and small DDL helpers.  Here: `CLEAR CACHE`, `DROP TABLE [IF EXISTS]
+t`, and `SHOW TABLES`, dispatched by `TPUOlapContext.sql` before the SELECT
+parser runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+_CLEAR = re.compile(r"^\s*clear\s+cache\s*;?\s*$", re.IGNORECASE)
+_DROP = re.compile(
+    r"^\s*drop\s+table\s+(?P<ife>if\s+exists\s+)?(?P<name>[A-Za-z_]\w*)\s*;?\s*$",
+    re.IGNORECASE,
+)
+_SHOW = re.compile(r"^\s*show\s+tables\s*;?\s*$", re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    kind: str  # "clear_cache" | "drop_table" | "show_tables"
+    table: Optional[str] = None
+    if_exists: bool = False
+
+
+def parse_command(sql: str) -> Optional[Command]:
+    if _CLEAR.match(sql):
+        return Command("clear_cache")
+    m = _DROP.match(sql)
+    if m:
+        return Command(
+            "drop_table", table=m.group("name"), if_exists=bool(m.group("ife"))
+        )
+    if _SHOW.match(sql):
+        return Command("show_tables")
+    return None
+
+
+def run_command(ctx, cmd: Command):
+    import pandas as pd
+
+    if cmd.kind == "clear_cache":
+        ctx.clear_cache()
+        return pd.DataFrame({"status": ["cache cleared"]})
+    if cmd.kind == "drop_table":
+        if ctx.catalog.get(cmd.table) is None and not cmd.if_exists:
+            raise KeyError(f"table {cmd.table!r} does not exist")
+        ctx.drop_table(cmd.table)
+        return pd.DataFrame({"status": [f"dropped {cmd.table}"]})
+    if cmd.kind == "show_tables":
+        return pd.DataFrame({"table": sorted(ctx.catalog.tables())})
+    raise ValueError(cmd.kind)

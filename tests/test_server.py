@@ -1,0 +1,196 @@
+"""L7 serving surface: native Druid queries and SQL over HTTP, plus wire
+round-trip of query JSON (VERDICT r1 missing #8 / SURVEY.md §1 L7)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.models.wire import query_from_druid
+from spark_druid_olap_tpu.server import OlapServer
+
+
+@pytest.fixture(scope="module")
+def served():
+    ctx = sd.TPUOlapContext()
+    n = 10_000
+    rng = np.random.default_rng(9)
+    city = rng.choice(np.array(["NY", "SF", "LA", "CHI"], dtype=object), n)
+    ts = (
+        np.datetime64("2021-01-01", "ms").astype(np.int64)
+        + rng.integers(0, 60, n) * 86_400_000
+    )
+    ctx.register_table(
+        "ev",
+        {
+            "city": city,
+            "v": rng.random(n).astype(np.float32),
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "ts": ts,
+        },
+        dimensions=["city"],
+        metrics=["v", "k"],
+        time_column="ts",
+    )
+    srv = OlapServer(ctx, port=0).start()
+    yield ctx, srv, pd.DataFrame(
+        {
+            "city": city,
+            "v": np.asarray(
+                ctx.catalog.get("ev").segments[0].metrics["v"][:n], np.float64
+            ),
+        }
+    )
+    srv.shutdown()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health_and_metadata(served):
+    _, srv, _ = served
+    assert _get(srv, "/status/health") is True
+    assert _get(srv, "/druid/v2/datasources") == ["ev"]
+    meta = _get(srv, "/druid/v2/datasources/ev")
+    assert meta["dimensions"] == ["city"]
+    assert set(meta["metrics"]) == {"v", "k"}
+    assert meta["numRows"] == 10_000
+
+
+def test_native_groupby_query(served):
+    ctx, srv, df = served
+    q = {
+        "queryType": "groupBy",
+        "dataSource": "ev",
+        "granularity": "all",
+        "dimensions": [{"type": "default", "dimension": "city"}],
+        "aggregations": [
+            {"type": "doubleSum", "name": "s", "fieldName": "v"},
+            {"type": "count", "name": "n"},
+        ],
+    }
+    code, out = _post(srv, "/druid/v2", q)
+    assert code == 200
+    events = {r["event"]["city"]: r["event"] for r in out}
+    want = df.groupby("city").agg(s=("v", "sum"), n=("v", "count"))
+    assert set(events) == set(want.index)
+    for city, ev in events.items():
+        assert ev["n"] == int(want.loc[city, "n"])
+        np.testing.assert_allclose(ev["s"], want.loc[city, "s"], rtol=2e-5)
+    assert all(r["version"] == "v1" for r in out)
+
+
+def test_native_topn_and_timeseries(served):
+    ctx, srv, df = served
+    code, out = _post(
+        srv,
+        "/druid/v2",
+        {
+            "queryType": "topN",
+            "dataSource": "ev",
+            "dimension": {"type": "default", "dimension": "city"},
+            "metric": "s",
+            "threshold": 2,
+            "aggregations": [
+                {"type": "doubleSum", "name": "s", "fieldName": "v"}
+            ],
+        },
+    )
+    assert code == 200 and len(out[0]["result"]) == 2
+    want_top = df.groupby("city")["v"].sum().sort_values(ascending=False)
+    assert out[0]["result"][0]["city"] == want_top.index[0]
+
+    code, ts = _post(
+        srv,
+        "/druid/v2",
+        {
+            "queryType": "timeseries",
+            "dataSource": "ev",
+            "granularity": "day",
+            "aggregations": [{"type": "count", "name": "n"}],
+            "context": {"skipEmptyBuckets": True},
+        },
+    )
+    assert code == 200
+    assert sum(r["result"]["n"] for r in ts) == len(df)
+
+
+def test_sql_endpoint(served):
+    ctx, srv, df = served
+    code, rows = _post(
+        srv,
+        "/druid/v2/sql",
+        {"query": "SELECT city, count(*) AS n FROM ev GROUP BY city ORDER BY city"},
+    )
+    assert code == 200
+    want = df.groupby("city").size().sort_index()
+    assert [r["city"] for r in rows] == list(want.index)
+    assert [r["n"] for r in rows] == [int(x) for x in want]
+
+
+def test_error_shapes(served):
+    _, srv, _ = served
+    code, out = _post(srv, "/druid/v2", {"queryType": "groupBy", "dataSource": "nope",
+                                         "dimensions": [], "aggregations": []})
+    assert code == 400 and "unknown dataSource" in out["error"]
+    code, out = _post(srv, "/druid/v2", {"queryType": "mystery"})
+    assert code == 400
+    code, out = _post(srv, "/druid/v2/sql", {"query": "SELEC bogus"})
+    assert code == 500 or code == 400
+
+
+def test_wire_roundtrip_through_planner(served):
+    """Planner output JSON -> wire decoder -> engine must equal ctx.sql."""
+    ctx, srv, df = served
+    sql = (
+        "SELECT city, sum(v) AS s, count(*) AS n FROM ev "
+        "WHERE city <> 'LA' GROUP BY city"
+    )
+    rw = ctx.plan_sql(sql)
+    q2 = query_from_druid(rw.query.to_druid())
+    got = ctx.engine.execute(q2, ctx.catalog.get("ev"))
+    want = ctx.sql(sql)
+    got = got.sort_values("city").reset_index(drop=True)[["city", "s", "n"]]
+    want = want.sort_values("city").reset_index(drop=True)[["city", "s", "n"]]
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_wire_roundtrip_expression_agg(served):
+    ctx, srv, _ = served
+    sql = "SELECT city, sum(v * 2) AS d FROM ev GROUP BY city"
+    rw = ctx.plan_sql(sql)
+    q2 = query_from_druid(rw.query.to_druid())
+    got = ctx.engine.execute(q2, ctx.catalog.get("ev"))
+    want = ctx.sql(sql)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(got["d"])), np.sort(np.asarray(want["d"])), rtol=2e-5
+    )
+
+
+def test_status_metrics_after_query(served):
+    ctx, srv, _ = served
+    _post(srv, "/druid/v2/sql", {"query": "SELECT count(*) AS n FROM ev"})
+    st = _get(srv, "/status")
+    assert st["last_query_metrics"] is not None
+    assert st["last_query_metrics"]["rows_scanned"] == 10_000

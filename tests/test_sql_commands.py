@@ -1,0 +1,50 @@
+"""Non-SELECT SQL commands (reference parser-extension analogs)."""
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+
+
+@pytest.fixture()
+def ctx():
+    c = sd.TPUOlapContext()
+    rng = np.random.default_rng(0)
+    for name in ("a", "b"):
+        c.register_table(
+            name,
+            {
+                "d": rng.integers(0, 4, 1000).astype(np.int64),
+                "v": rng.random(1000).astype(np.float32),
+            },
+            dimensions=["d"],
+            metrics=["v"],
+        )
+    return c
+
+
+def test_show_tables(ctx):
+    out = ctx.sql("SHOW TABLES")
+    assert list(out["table"]) == ["a", "b"]
+
+
+def test_drop_table(ctx):
+    ctx.sql("DROP TABLE a")
+    assert ctx.catalog.get("a") is None
+    with pytest.raises(KeyError):
+        ctx.sql("DROP TABLE a")
+    ctx.sql("DROP TABLE IF EXISTS a")  # no raise
+
+
+def test_clear_cache(ctx):
+    ctx.sql("SELECT d, sum(v) AS s FROM a GROUP BY d")
+    assert ctx.engine.bytes_resident() > 0
+    out = ctx.sql("CLEAR CACHE")
+    assert out["status"][0] == "cache cleared"
+    assert ctx.engine.bytes_resident() == 0
+    assert ctx.catalog.tables() == []
+
+
+def test_select_still_works_after_command_dispatch(ctx):
+    out = ctx.sql("SELECT count(*) AS n FROM b")
+    assert int(out["n"][0]) == 1000
