@@ -42,10 +42,14 @@ class SessionConfig:
     cost_model_enabled: bool = True
     dense_max_groups: int = 1 << 17  # dense one-hot vs scatter cutover
     onehot_vmem_budget_mb: int = 32
-    # us per row per 128-wide group tile for the dense one-hot kernel
+    # us per row per 128-wide group tile for the dense one-hot kernel (MXU)
     cost_per_row_dense: float = 1e-4
-    # us per row for the scatter (segment-sum) kernel
-    cost_per_row_scatter: float = 2e-3
+    # us per row for the scatter (segment-sum) kernel — serializes on TPU
+    cost_per_row_scatter: float = 0.05
+    # us per row for the sort-compaction (sparse) path
+    cost_per_row_sparse: float = 5e-3
+    # us per group of dense scatter state (alloc + merge traffic)
+    cost_per_group_state: float = 2e-5
     # merge-collective throughput, bytes per us (ICI ring allreduce)
     collective_bytes_per_us: float = 40_000.0
     # fixed overhead of one SPMD dispatch + multi-device host gather, us
@@ -82,6 +86,8 @@ class SessionConfig:
             for k in (
                 "cost_per_row_dense",
                 "cost_per_row_scatter",
+                "cost_per_row_sparse",
+                "cost_per_group_state",
                 "collective_bytes_per_us",
                 "cost_dispatch_us",
             ):

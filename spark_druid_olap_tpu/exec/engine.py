@@ -324,13 +324,22 @@ class Engine:
 
         # explicit strategy='segment' is the raw-scatter escape hatch and is
         # honored as such (ADVICE r1: the sparse accelerator must not hijack
-        # an explicitly requested kernel); the cost model emits 'sparse' when
-        # compaction should run
+        # an explicitly requested kernel).  The cost model emits 'sparse'
+        # when compaction should run; 'auto'/'dense' only self-upgrade on a
+        # TPU backend — measured on CPU, raw scatter beats sort-compaction
+        # at every domain size, so auto-sparse there is a pure loss.
+        from ..ops.pallas_groupby import pallas_available
+
+        auto_upgrade = (
+            self.strategy in ("auto", "dense")
+            and pallas_available()
+            and not self._pallas_broken
+        )
         return (
             lowering.num_groups > SCATTER_CUTOVER
             and not lowering.la.sketch_aggs
             and bool(lowering.dims)
-            and self.strategy in ("auto", "dense", "sparse")
+            and (auto_upgrade or self.strategy == "sparse")
         )
 
     def _sparse_program(

@@ -79,7 +79,8 @@ def calibrate(
     tiles = max(1, -(-groups // 128))
     cost_per_row_dense = t_dense * 1e6 / rows / tiles
 
-    # scatter kernel: us / row
+    # scatter kernel: us/row at the base domain, plus the per-group state
+    # slope measured from a much wider domain
     @jax.jit
     def scatter(gid, v):
         return jax.ops.segment_sum(v, gid, num_segments=groups)
@@ -87,14 +88,47 @@ def calibrate(
     t_scatter = _timeit(lambda: jax.block_until_ready(scatter(gid, sv)))
     cost_per_row_scatter = t_scatter * 1e6 / rows
 
+    wide = 1 << 20
+    gid_w = jnp.asarray(rng.integers(0, wide, size=rows).astype(np.int32))
+
+    @jax.jit
+    def scatter_wide(gid, v):
+        return jax.ops.segment_sum(v, gid, num_segments=wide)
+
+    t_wide = _timeit(lambda: jax.block_until_ready(scatter_wide(gid_w, sv)))
+    cost_per_group_state = max(
+        (t_wide - t_scatter) * 1e6 / max(wide - groups, 1), 0.0
+    )
+
+    # sort-compaction (sparse) path: us/row on the same wide domain
+    from ..ops.sparse_groupby import sparse_partial_aggregate
+
+    sp = functools.partial(
+        sparse_partial_aggregate,
+        num_groups=wide,
+        num_min=0,
+        num_max=0,
+        inner_strategy="segment",
+    )
+    try:
+        t_sparse = _timeit(
+            lambda: jax.block_until_ready(sp(gid_w, mask, sv, mmv, mmm))
+        )
+        cost_per_row_sparse = t_sparse * 1e6 / rows
+    except Exception:
+        cost_per_row_sparse = None  # declined (overflow etc.): keep default
+
     out = {
         "cost_per_row_dense": cost_per_row_dense,
         "cost_per_row_scatter": cost_per_row_scatter,
+        "cost_per_group_state": cost_per_group_state,
         "rows": rows,
         "groups": groups,
         "device": str(jax.devices()[0]),
         "n_devices": len(jax.devices()),
     }
+    if cost_per_row_sparse is not None:
+        out["cost_per_row_sparse"] = cost_per_row_sparse
 
     # mesh measurements need >1 device (real chips or a CPU-forced mesh)
     n_dev = len(jax.devices())

@@ -87,36 +87,57 @@ def choose_physical(
     broker-vs-historicals analog).  All costs in microseconds, from the
     calibratable SessionConfig constants (plan/calibrate.py)."""
     rows = ds.num_rows
-    # kernel strategy: one-hot row cost scales with ceil(G/128) vector-lane
-    # tiles; scatter cost is flat-but-large per row (serialized updates)
-    dense_cost = rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
-    scatter_cost = rows * cfg.cost_per_row_scatter
-    if num_groups <= cfg.dense_max_groups and (
-        not cfg.cost_model_enabled or dense_cost <= scatter_cost * 4
-    ):
-        strategy, local_cost = "dense", dense_cost
-    else:
-        # scatter class.  When the sort-compaction accelerator applies (real
-        # dims, no sketch states to re-key, domain past the scatter cutover)
-        # name it "sparse" so the engine tries compaction first; an explicit
-        # user "segment" stays raw scatter (ADVICE r1).
-        from ..ops.groupby import SCATTER_CUTOVER
-        from ..models import aggregations as A
+    # Three kernel classes, chosen by modelled cost (all constants
+    # calibratable on the live backend — plan/calibrate.py):
+    #   dense   one-hot matmul: cost scales with ceil(G/128) lane tiles (MXU-
+    #           shaped; the winner on TPU for small/medium domains)
+    #   segment raw scatter: flat per-row cost (serializes on TPU, cheap on
+    #           CPU) + per-group dense-state cost
+    #   sparse  sort-compaction: flat-but-sort-heavy per-row cost, no dense
+    #           state — the high-cardinality path where it applies (real
+    #           dims, no sketch state to re-key)
+    from ..models import aggregations as A
+    from ..ops.groupby import SCATTER_CUTOVER
 
-        aggs = getattr(q, "aggregations", ())
-        has_sketch = any(
-            isinstance(
-                a.aggregator if isinstance(a, A.FilteredAgg) else a,
-                (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch),
+    dense_cost = (
+        rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
+        if num_groups <= cfg.dense_max_groups
+        else float("inf")
+    )
+    scatter_cost = (
+        rows * cfg.cost_per_row_scatter
+        + num_groups * cfg.cost_per_group_state
+    )
+    aggs = getattr(q, "aggregations", ())
+    has_sketch = any(
+        isinstance(
+            a.aggregator if isinstance(a, A.FilteredAgg) else a,
+            (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch),
+        )
+        for a in aggs
+    )
+    sparse_ok = (
+        num_groups > SCATTER_CUTOVER
+        and not has_sketch
+        and bool(getattr(q, "dimensions", ()))
+    )
+    sparse_cost = (
+        rows * cfg.cost_per_row_sparse if sparse_ok else float("inf")
+    )
+    if not cfg.cost_model_enabled:
+        # static fallback: dense inside the domain cap, else compaction
+        if num_groups <= cfg.dense_max_groups:
+            strategy, local_cost = "dense", dense_cost
+        else:
+            strategy, local_cost = (
+                ("sparse", sparse_cost) if sparse_ok else ("segment", scatter_cost)
             )
-            for a in aggs
+    else:
+        strategy, local_cost = min(
+            (("dense", dense_cost), ("segment", scatter_cost),
+             ("sparse", sparse_cost)),
+            key=lambda kv: kv[1],
         )
-        sparse_ok = (
-            num_groups > SCATTER_CUTOVER
-            and not has_sketch
-            and bool(getattr(q, "dimensions", ()))
-        )
-        strategy, local_cost = ("sparse" if sparse_ok else "segment"), scatter_cost
 
     # distributed target: only the dense GroupBy-family path runs SPMD
     # (parallel/distributed.py); scans and the scatter/sparse strategies are

@@ -153,7 +153,8 @@ def test_sparse_overflow_falls_back_to_scatter():
     distinct = len(df.groupby(["a", "b"]))
     assert distinct > SPARSE_SLOTS
 
-    eng = Engine()
+    # explicit 'sparse': auto only self-upgrades on TPU backends now
+    eng = Engine(strategy="sparse")
     q = _query()
     q = GroupByQuery(
         datasource="hc2",
