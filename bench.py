@@ -174,7 +174,14 @@ def bench_tpch_q1(scale: float):
         filter=Bound("l_shipdate", upper=str(int(cutoff)), ordering="numeric"),
     )
 
-    eng = Engine()
+    from spark_druid_olap_tpu.config import SessionConfig
+    from spark_druid_olap_tpu.plan.cost import choose_kernel_strategy
+
+    eng = Engine(
+        strategy=choose_kernel_strategy(
+            n_rows, 8, SessionConfig.load_calibrated()
+        )
+    )
     out = eng.execute(q, ds)  # warmup: compile + device transfer
     assert len(out) == 6, out
     p50 = _timed(lambda: eng.execute(q, ds), reps=5, warmup=0)
@@ -299,7 +306,16 @@ def bench_timeseries(n_chunks: int):
         intervals=(datagen.event_stream_interval(),),
     )
     ds = datagen.event_stream_schema()
-    ex = StreamExecutor()
+    # pin the kernel class from calibrated constants (hourly buckets ~= the
+    # span in hours; a direct Engine has no planner to route for it)
+    from spark_druid_olap_tpu.config import SessionConfig
+    from spark_druid_olap_tpu.exec.engine import Engine
+    from spark_druid_olap_tpu.plan.cost import choose_kernel_strategy
+
+    strat = choose_kernel_strategy(
+        chunk, datagen.EVENT_SPAN_HOURS, SessionConfig.load_calibrated()
+    )
+    ex = StreamExecutor(engine=Engine(strategy=strat))
     # warmup / compile on one chunk
     ex.execute(q, ds, (datagen.gen_event_chunk(0, chunk) for _ in range(1)), chunk)
     t0 = time.perf_counter()

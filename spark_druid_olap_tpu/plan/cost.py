@@ -76,6 +76,33 @@ def _g_tiles(num_groups: int) -> int:
     return max(1, -(-num_groups // 128))
 
 
+def _kernel_costs(
+    rows: int, num_groups: int, cfg: SessionConfig, sparse_ok: bool
+) -> Tuple[Tuple[str, float], ...]:
+    """(strategy, modelled us) for each kernel class (inf = inapplicable)."""
+    dense = (
+        rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
+        if num_groups <= cfg.dense_max_groups
+        else float("inf")
+    )
+    scatter = (
+        rows * cfg.cost_per_row_scatter + num_groups * cfg.cost_per_group_state
+    )
+    sparse = rows * cfg.cost_per_row_sparse if sparse_ok else float("inf")
+    return (("dense", dense), ("segment", scatter), ("sparse", sparse))
+
+
+def choose_kernel_strategy(
+    rows: int, num_groups: int, cfg: SessionConfig, sparse_ok: bool = False
+) -> str:
+    """Min-cost kernel class for a (rows, groups) shape — the strategy an
+    Engine constructed outside the planner (streaming, direct execution)
+    should be pinned to when calibrated constants are available."""
+    return min(
+        _kernel_costs(rows, num_groups, cfg, sparse_ok), key=lambda kv: kv[1]
+    )[0]
+
+
 def choose_physical(
     q: Q.QuerySpec,
     ds: DataSource,
@@ -99,15 +126,6 @@ def choose_physical(
     from ..models import aggregations as A
     from ..ops.groupby import SCATTER_CUTOVER
 
-    dense_cost = (
-        rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
-        if num_groups <= cfg.dense_max_groups
-        else float("inf")
-    )
-    scatter_cost = (
-        rows * cfg.cost_per_row_scatter
-        + num_groups * cfg.cost_per_group_state
-    )
     aggs = getattr(q, "aggregations", ())
     has_sketch = any(
         isinstance(
@@ -121,23 +139,16 @@ def choose_physical(
         and not has_sketch
         and bool(getattr(q, "dimensions", ()))
     )
-    sparse_cost = (
-        rows * cfg.cost_per_row_sparse if sparse_ok else float("inf")
-    )
+    costs = dict(_kernel_costs(rows, num_groups, cfg, sparse_ok))
     if not cfg.cost_model_enabled:
         # static fallback: dense inside the domain cap, else compaction
         if num_groups <= cfg.dense_max_groups:
-            strategy, local_cost = "dense", dense_cost
+            strategy = "dense"
         else:
-            strategy, local_cost = (
-                ("sparse", sparse_cost) if sparse_ok else ("segment", scatter_cost)
-            )
+            strategy = "sparse" if sparse_ok else "segment"
+        local_cost = costs[strategy]
     else:
-        strategy, local_cost = min(
-            (("dense", dense_cost), ("segment", scatter_cost),
-             ("sparse", sparse_cost)),
-            key=lambda kv: kv[1],
-        )
+        strategy, local_cost = min(costs.items(), key=lambda kv: kv[1])
 
     # distributed target: only the dense GroupBy-family path runs SPMD
     # (parallel/distributed.py); scans and the scatter/sparse strategies are

@@ -64,6 +64,9 @@ FLAT_DIMS = list(DIM_ATTRS)
 FLAT_METRICS = [
     "lo_quantity", "lo_extendedprice", "lo_discount", "lo_revenue",
     "lo_supplycost",
+    # FK retained on the flat fact for approx-distinct workloads
+    # (BASELINE configs #3/#5: HLL/theta over lo_custkey)
+    "lo_custkey",
 ]
 
 STAR_SCHEMA = StarSchemaInfo(
