@@ -231,27 +231,20 @@ def bench_tpch_q1(scale: float):
 
 
 def bench_topn_hll(scale: float):
-    from spark_druid_olap_tpu.models.aggregations import DoubleSum, HyperUnique
-    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
-    from spark_druid_olap_tpu.models.query import TopNQuery
     from spark_druid_olap_tpu.workloads import ssb
 
     ctx = _calibrated_ctx()
     tables = ssb.gen_tables(scale=scale)
     ssb.register(ctx, tables=tables)
-    ds = ctx.catalog.get("lineorder")
-    n_rows = ds.num_rows
-    q = TopNQuery(
-        datasource="lineorder",
-        dimension=DimensionSpec("c_city"),
-        metric="revenue",
-        threshold=100,
-        aggregations=(
-            DoubleSum("revenue", "lo_revenue"),
-            HyperUnique("uniq_custs", "lo_custkey"),
-        ),
+    n_rows = ctx.catalog.get("lineorder").num_rows
+    # full SQL path: the planner's TopN rewrite + HLL mapping + calibrated
+    # kernel routing (a direct engine call would bypass the cost model)
+    sql = (
+        "SELECT c_city, sum(lo_revenue) AS revenue, "
+        "approx_count_distinct(lo_custkey) AS uniq_custs "
+        "FROM lineorder GROUP BY c_city ORDER BY revenue DESC LIMIT 100"
     )
-    t_tpu = _timed(lambda: ctx.engine.execute(q, ds))
+    t_tpu = _timed(lambda: ctx.sql(sql))
 
     f = ssb.flat_frame(tables)
 
