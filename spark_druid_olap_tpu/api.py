@@ -46,6 +46,13 @@ class TPUOlapContext:
         self.engine = Engine()
         self._dist_engine = None
         self._last_engine_metrics = None  # metrics of the engine that last ran
+        # SQL-text -> Rewrite cache (the reference re-plans every Catalyst
+        # round; locally a repeated dashboard query should pay parse+plan
+        # once).  Keyed on catalog version + config so any re-registration
+        # or session-flag change invalidates.
+        from .utils.lru import CountBudgetCache
+
+        self._plan_cache = CountBudgetCache(256)
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -129,6 +136,7 @@ class TPUOlapContext:
         """Reference's clear-metadata-cache command + HBM residency drop."""
         self.catalog.clear()
         self.engine.clear_cache()
+        self._plan_cache.clear()
         if self._dist_engine is not None:
             self._dist_engine.clear_cache()
 
@@ -178,12 +186,26 @@ class TPUOlapContext:
 
     # -- execution -----------------------------------------------------------
 
+    def _plan_cache_key(self, sql_text: str):
+        import jax
+
+        return (
+            sql_text,
+            self.catalog.version,
+            repr(self.config),
+            len(jax.devices()),
+        )
+
     def sql(self, sql_text: str):
         from .sql.commands import parse_command, run_command
 
         cmd = parse_command(sql_text)
         if cmd is not None:
             return run_command(self, cmd)
+        key = self._plan_cache_key(sql_text)
+        cached = self._plan_cache.get(key)
+        if cached is not None:
+            return self.execute_rewrite(cached)
         lp, explain, out_names = parse_sql(sql_text)
         planner = self._planner()
         if explain:
@@ -191,6 +213,7 @@ class TPUOlapContext:
 
             return pd.DataFrame({"plan": planner.explain(lp).split("\n")})
         rw = planner.plan(lp)
+        self._plan_cache[key] = rw
         return self.execute_rewrite(rw)
 
     def execute_rewrite(self, rw: Rewrite):

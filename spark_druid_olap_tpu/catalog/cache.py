@@ -24,12 +24,16 @@ class MetadataCache:
         self._lock = threading.Lock()
         self._tables: Dict[str, DataSource] = {}
         self._stars: Dict[str, StarSchemaInfo] = {}
+        # monotonically bumped on every mutation; plan caches key on it so a
+        # re-registered table invalidates cached rewrites
+        self.version = 0
 
     def put(self, ds: DataSource, star: Optional[StarSchemaInfo] = None):
         with self._lock:
             self._tables[ds.name] = ds
             if star is not None:
                 self._stars[ds.name] = star
+            self.version += 1
 
     def get(self, name: str) -> Optional[DataSource]:
         with self._lock:
@@ -51,9 +55,11 @@ class MetadataCache:
         with self._lock:
             self._tables.pop(name, None)
             self._stars.pop(name, None)
+            self.version += 1
 
     def clear(self):
         """The reference's clear-metadata-cache command analog."""
         with self._lock:
             self._tables.clear()
             self._stars.clear()
+            self.version += 1

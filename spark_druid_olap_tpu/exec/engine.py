@@ -19,7 +19,7 @@ distributed, and streaming executors and re-exported here.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +58,14 @@ from .finalize import (  # noqa: F401
     finalize_topn,
 )
 
+# Above this many in-scope segments a query stops unrolling them into one
+# fused program (compile time grows linearly with the unroll) and falls back
+# to the per-segment dispatch loop.  Below it, the whole query is ONE device
+# dispatch + ONE host fetch — the difference between ~4 and ~N+2 round trips,
+# which dominates warm latency when the TPU sits behind a network tunnel.
+MULTI_SEGMENT_UNROLL_MAX = 32
+
+
 class Engine:
     """Executes query specs on the local device set.
 
@@ -92,6 +100,11 @@ class Engine:
         # in the row pipeline is a separate device dispatch — ruinous when the
         # TPU sits behind a network tunnel (hundreds of ms of pure latency).
         self._query_fn_cache = CountBudgetCache(program_cache_entries)
+        # (query-json, datasource) -> GroupByLowering.  Lowering is host work
+        # that also stages device constants (dictionary remaps, bucket tables,
+        # filter literal sets); rebuilding it per execution pays one blocking
+        # H2D transfer per constant — the warm-path killer over a tunnel.
+        self._lowering_cache = CountBudgetCache(program_cache_entries)
 
     # -- segment residency ---------------------------------------------------
 
@@ -111,13 +124,11 @@ class Engine:
 
         for n in names:
             key = (seg.uid, n)
-            if key not in self._device_cache:
-                put(key, seg.column(n))
-            cols[n] = self._device_cache[key]
+            arr = self._device_cache.get(key)
+            cols[n] = arr if arr is not None else put(key, seg.column(n))
         key = (seg.uid, "__valid")
-        if key not in self._device_cache:
-            put(key, seg.valid)
-        cols["__valid"] = self._device_cache[key]
+        arr = self._device_cache.get(key)
+        cols["__valid"] = arr if arr is not None else put(key, seg.valid)
         return cols
 
     def bytes_resident(self) -> int:
@@ -125,8 +136,51 @@ class Engine:
         return self._device_cache.bytes_used
 
     def clear_cache(self):
-        """Analog of the reference's metadata/cache clear command."""
+        """Analog of the reference's metadata/cache clear command.  Drops the
+        program cache too: compiled programs close over their lowering's
+        staged device constants, so leaving them would pin the HBM this is
+        documented to release."""
         self._device_cache.clear()
+        self._lowering_cache.clear()
+        self._query_fn_cache.clear()
+
+    def _segment_batches(self, segs, names):
+        """Split in-scope segments into dispatch batches: each batch becomes
+        ONE fused program call.  Bounded by MULTI_SEGMENT_UNROLL_MAX (compile
+        time grows with the unroll) and by the device-cache byte budget (a
+        batch pins every member's columns on device simultaneously, so an
+        unbounded batch would defeat the residency budget)."""
+        budget = self._device_cache.budget_bytes
+        batch: List[Segment] = []
+        batch_bytes = 0
+        for seg in segs:
+            est = int(seg.valid.nbytes) + sum(
+                int(seg.column(n).nbytes) for n in names
+            )
+            if batch and (
+                len(batch) >= MULTI_SEGMENT_UNROLL_MAX
+                or batch_bytes + est > budget
+            ):
+                yield batch
+                batch, batch_bytes = [], 0
+            batch.append(seg)
+            batch_bytes += est
+        if batch:
+            yield batch
+
+    def _lowering_for(self, q: Q.GroupByQuery, ds: DataSource):
+        key = _query_key(q, ds)
+        lowering = self._lowering_cache.get(key)
+        if lowering is None:
+            lowering = lower_groupby(q, ds)
+            self._lowering_cache[key] = lowering
+        return lowering
+
+    def _cols_for_segment(self, seg: Segment, ds: DataSource, names):
+        cols = self._device_cols(seg, names)
+        if ds.time_column and ds.time_column in cols:
+            cols["__time"] = cols[ds.time_column]
+        return cols
 
     # -- entry points --------------------------------------------------------
 
@@ -169,7 +223,7 @@ class Engine:
 
         Returns (dims, la, G, sums[G, Ms], mins, maxs, sketch_states)."""
         if lowering is None:
-            lowering = lower_groupby(q, ds)
+            lowering = self._lowering_for(q, ds)
         dims, la, G = lowering.dims, lowering.la, lowering.num_groups
         need = lowering.columns
 
@@ -180,13 +234,16 @@ class Engine:
             # empty time range is a valid query: zero-row result, not an error
             sums, mins, maxs, sketch_states = empty_partials(la, G)
             return dims, la, G, sums, mins, maxs, sketch_states
+        # segments fuse into batched programs (partial agg + cross-segment
+        # merge inside): the common case is ONE dispatch + ONE fetch per
+        # query; oversized scopes merge across a few batch dispatches
         seg_fn = self._segment_program(q, ds, lowering)
-        for seg in segs:
-            cols = self._device_cols(seg, need)
-            if ds.time_column and ds.time_column in cols:
-                cols["__time"] = cols[ds.time_column]
+        for batch in self._segment_batches(segs, need):
+            cols_list = [
+                self._cols_for_segment(seg, ds, need) for seg in batch
+            ]
             (s, mn, mx, sk), seg_fn = self._call_segment_program(
-                q, ds, lowering, seg_fn, cols
+                q, ds, lowering, seg_fn, cols_list
             )
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
@@ -194,10 +251,10 @@ class Engine:
             _merge_sketch_states(la, sketch_states, sk)
         return dims, la, G, sums, mins, maxs, sketch_states
 
-    def _call_segment_program(self, q, ds, lowering, seg_fn, cols):
-        """Run one segment program with the Pallas compile-failure fallback.
-        Returns (result, seg_fn) — seg_fn may be a rebuilt XLA-dense program
-        after a Mosaic failure."""
+    def _call_segment_program(self, q, ds, lowering, seg_fn, cols_list):
+        """Run one segment program (over a list of per-segment column dicts)
+        with the Pallas compile-failure fallback.  Returns (result, seg_fn) —
+        seg_fn may be a rebuilt XLA-dense program after a Mosaic failure."""
         import time as _time
 
         try:
@@ -210,7 +267,7 @@ class Engine:
                 and self._m.compile_ms == 0
                 else None
             )
-            result = seg_fn(cols)
+            result = seg_fn(cols_list)
             if t0 is not None:
                 self._m.compile_ms = (_time.perf_counter() - t0) * 1e3
             return result, seg_fn
@@ -230,10 +287,10 @@ class Engine:
                 raise
             self._pallas_broken = True
             for k in [k for k in self._query_fn_cache if k[2] == "pallas"]:
-                del self._query_fn_cache[k]
+                self._query_fn_cache.pop(k)
             seg_fn = self._segment_program(q, ds, lowering)
             try:
-                return seg_fn(cols), seg_fn
+                return seg_fn(cols_list), seg_fn
             except Exception:
                 self._pallas_broken = False
                 raise
@@ -274,23 +331,24 @@ class Engine:
     ) -> Callable:
         """One fused, cached XLA program per query: row pipeline (virtual
         columns, filter mask, group ids) + partial aggregation + sketch
-        partials in a single dispatch.  The analog of Druid compiling a query
-        into one engine pass per segment."""
+        partials for EVERY in-scope segment, merged in-program — a single
+        dispatch.  The analog of Druid compiling a query into one engine pass,
+        with the broker's cross-segment merge folded in."""
         la, G = lowering.la, lowering.num_groups
         strategy = self._resolve_strategy(G)
         # _query_key includes schema_signature: a re-ingested datasource
         # (new dict cardinalities => new G) must not reuse a stale program
         key = _query_key(q, ds) + (strategy,)
-        if key in self._query_fn_cache:
+        cached = self._query_fn_cache.get(key)
+        if cached is not None:
             if self._m is not None:
                 self._m.program_cache_hit = True
-            return self._query_fn_cache[key]
+            return cached
 
         from ..ops import hll as hll_ops
         from ..ops import theta as theta_ops
 
-        @jax.jit
-        def seg_fn(cols):
+        def one_segment(cols):
             cols = lowering.add_virtual(dict(cols))  # sketches read virtuals
             gid, mask, sv, mmv, mmm = lowering.row_arrays(cols)
             s, mn, mx = partial_aggregate(
@@ -309,6 +367,18 @@ class Engine:
                         agg, cols, gid, mask, G
                     )
             return s, mn, mx, sk
+
+        @jax.jit
+        def seg_fn(cols_list):
+            sums = mins = maxs = None
+            sketch_states: Dict[str, Any] = {}
+            for cols in cols_list:
+                s, mn, mx, sk = one_segment(cols)
+                sums = s if sums is None else sums + s
+                mins = mn if mins is None else jnp.minimum(mins, mn)
+                maxs = mx if maxs is None else jnp.maximum(maxs, mx)
+                _merge_sketch_states(la, sketch_states, sk)
+            return sums, mins, maxs, sketch_states
 
         self._query_fn_cache[key] = seg_fn
         return seg_fn
@@ -358,13 +428,15 @@ class Engine:
             else "segment"
         )
         key = _query_key(q, ds) + (f"sparse:{inner}",)
-        if key in self._query_fn_cache:
+        cached = self._query_fn_cache.get(key)
+        if cached is not None:
             if self._m is not None:
                 self._m.program_cache_hit = True
-            return self._query_fn_cache[key]
+            return cached
 
-        @jax.jit
-        def seg_fn(cols):
+        from ..ops.sparse_groupby import merge_sparse_states
+
+        def one_segment(cols):
             gid, mask, sv, mmv, mmm = lowering.row_arrays(dict(cols))
             return sparse_partial_aggregate(
                 gid, mask, sv, mmv, mmm,
@@ -373,6 +445,20 @@ class Engine:
                 num_max=len(la.max_names),
                 inner_strategy=inner,
             )
+
+        @jax.jit
+        def seg_fn(cols_list):
+            state = None
+            for cols in cols_list:
+                st = one_segment(cols)
+                state = (
+                    st
+                    if state is None
+                    else merge_sparse_states(
+                        state, st, num_groups=lowering.num_groups
+                    )
+                )
+            return state
 
         self._query_fn_cache[key] = seg_fn
         return seg_fn
@@ -392,11 +478,12 @@ class Engine:
         def run():
             seg_fn = self._sparse_program(q, ds, lowering)
             state = None
-            for seg in segs:
-                cols = self._device_cols(seg, lowering.columns)
-                if ds.time_column and ds.time_column in cols:
-                    cols["__time"] = cols[ds.time_column]
-                st = seg_fn(cols)
+            for batch in self._segment_batches(segs, lowering.columns):
+                cols_list = [
+                    self._cols_for_segment(seg, ds, lowering.columns)
+                    for seg in batch
+                ]
+                st = seg_fn(cols_list)
                 state = (
                     st
                     if state is None
@@ -413,7 +500,7 @@ class Engine:
                 for k in self._query_fn_cache
                 if k[:2] == base and str(k[2]).startswith("sparse")
             ]:
-                del self._query_fn_cache[k]
+                self._query_fn_cache.pop(k)
 
         from ..ops.pallas_groupby import pallas_available
 
@@ -453,7 +540,7 @@ class Engine:
 
         t_total = _time.perf_counter()
         q = groupby_with_time_granularity(q)
-        lowering = lower_groupby(q, ds)
+        lowering = self._lowering_for(q, ds)
         segs = self._segments_in_scope(q, ds)
         m = self._m = QueryMetrics(
             query_type="groupBy",

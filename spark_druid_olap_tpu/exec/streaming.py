@@ -169,7 +169,7 @@ class StreamExecutor:
                 s, mn, mx, sk = dist_run(dev_cols)
             else:
                 (s, mn, mx, sk), seg_fn = eng._call_segment_program(
-                    q, ds, lowering, seg_fn, dev_cols
+                    q, ds, lowering, seg_fn, [dev_cols]
                 )
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)

@@ -107,8 +107,9 @@ class DistributedEngine:
 
         def build(name: str, fill) -> jax.Array:
             key = (ds.name, name, nd, seg_sig)
-            if key in self._shard_cache:
-                return self._shard_cache[key]
+            hit = self._shard_cache.get(key)
+            if hit is not None:
+                return hit
             parts = [np.asarray(s.column(name)) for s in segs]
             host = np.concatenate(parts) if parts else np.zeros(0)
             if len(host) < padded:
@@ -124,7 +125,8 @@ class DistributedEngine:
             fill = -1 if n in ds.dicts else 0
             cols[n] = build(n, fill)
         vkey = (ds.name, "__valid", nd, seg_sig)
-        if vkey not in self._shard_cache:
+        valid = self._shard_cache.get(vkey)
+        if valid is None:
             parts = [s.valid for s in segs]
             host = (
                 np.concatenate(parts) if parts else np.zeros(0, dtype=bool)
@@ -133,8 +135,9 @@ class DistributedEngine:
                 host = np.concatenate(
                     [host, np.zeros(padded - len(host), dtype=bool)]
                 )
-            self._shard_cache[vkey] = jax.device_put(host, sharding)
-        cols["__valid"] = self._shard_cache[vkey]
+            valid = jax.device_put(host, sharding)
+            self._shard_cache[vkey] = valid
+        cols["__valid"] = valid
         if ds.time_column and ds.time_column in cols:
             cols["__time"] = cols[ds.time_column]
         return cols, padded

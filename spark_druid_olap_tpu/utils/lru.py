@@ -13,12 +13,18 @@ OOMs HBM with no eviction).  Two policies:
   (each entry pins a traced executable).
 
 Both are dict-shaped (getitem/setitem/contains/del/iteration) so call sites
-read like the plain dicts they replace.  Not thread-safe by themselves; the
-engine serializes access per instance.
+read like the plain dicts they replace.  Operations are individually
+thread-safe (an RLock guards the OrderedDict) because the HTTP server runs
+queries from handler threads; hot paths must use the atomic `get`/`pop`
+(check-then-`[]` from separate calls can race an eviction into KeyError).
+Cross-operation atomicity (get-then-insert) is NOT provided — the caches
+hold idempotent values (compiled programs, device columns keyed by content),
+so a racing double-insert is waste, not corruption.
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any, Iterator
 
@@ -28,43 +34,70 @@ class ByteBudgetCache:
         self.budget_bytes = int(budget_bytes)
         self._od: "OrderedDict[Any, Any]" = OrderedDict()
         self._bytes = 0
+        self._lock = threading.RLock()
 
     @property
     def bytes_used(self) -> int:
         return self._bytes
 
     def __contains__(self, key) -> bool:
-        return key in self._od
+        with self._lock:
+            return key in self._od
 
     def __getitem__(self, key):
-        v = self._od[key]
-        self._od.move_to_end(key)
-        return v
+        with self._lock:
+            v = self._od[key]
+            self._od.move_to_end(key)
+            return v
 
     def __setitem__(self, key, arr):
-        if key in self._od:
-            self._bytes -= int(self._od[key].nbytes)
-            del self._od[key]
-        self._od[key] = arr
-        self._bytes += int(arr.nbytes)
-        self._evict()
+        with self._lock:
+            if key in self._od:
+                self._bytes -= int(self._od[key].nbytes)
+                del self._od[key]
+            self._od[key] = arr
+            self._bytes += int(arr.nbytes)
+            self._evict()
 
     def __delitem__(self, key):
-        self._bytes -= int(self._od[key].nbytes)
-        del self._od[key]
+        with self._lock:
+            self._bytes -= int(self._od[key].nbytes)
+            del self._od[key]
+
+    def get(self, key, default=None):
+        """Atomic hit-or-default (check-then-[] from another thread can race
+        an eviction; this cannot)."""
+        with self._lock:
+            if key not in self._od:
+                return default
+            v = self._od[key]
+            self._od.move_to_end(key)
+            return v
+
+    def pop(self, key, default=None):
+        with self._lock:
+            if key not in self._od:
+                return default
+            v = self._od[key]
+            self._bytes -= int(v.nbytes)
+            del self._od[key]
+            return v
 
     def __iter__(self) -> Iterator:
-        return iter(list(self._od))
+        with self._lock:
+            return iter(list(self._od))
 
     def __len__(self) -> int:
         return len(self._od)
 
     def values(self):
-        return self._od.values()
+        with self._lock:
+            return list(self._od.values())
 
     def clear(self):
-        self._od.clear()
-        self._bytes = 0
+        with self._lock:
+            self._od.clear()
+            self._bytes = 0
 
     def _evict(self):
         # never evict the just-inserted entry: a single over-budget column
@@ -78,33 +111,59 @@ class CountBudgetCache:
     def __init__(self, budget_entries: int):
         self.budget_entries = int(budget_entries)
         self._od: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.RLock()
 
     def __contains__(self, key) -> bool:
-        return key in self._od
+        with self._lock:
+            return key in self._od
 
     def __getitem__(self, key):
-        v = self._od[key]
-        self._od.move_to_end(key)
-        return v
+        with self._lock:
+            v = self._od[key]
+            self._od.move_to_end(key)
+            return v
 
     def __setitem__(self, key, v):
-        if key in self._od:
-            del self._od[key]
-        self._od[key] = v
-        while len(self._od) > self.budget_entries:
-            self._od.popitem(last=False)
+        with self._lock:
+            if key in self._od:
+                del self._od[key]
+            self._od[key] = v
+            while len(self._od) > self.budget_entries:
+                self._od.popitem(last=False)
 
     def __delitem__(self, key):
-        del self._od[key]
+        with self._lock:
+            del self._od[key]
+
+    def get(self, key, default=None):
+        """Atomic hit-or-default (check-then-[] from another thread can race
+        an eviction; this cannot)."""
+        with self._lock:
+            if key not in self._od:
+                return default
+            v = self._od[key]
+            self._od.move_to_end(key)
+            return v
+
+    def pop(self, key, default=None):
+        with self._lock:
+            if key not in self._od:
+                return default
+            v = self._od[key]
+            del self._od[key]
+            return v
 
     def __iter__(self) -> Iterator:
-        return iter(list(self._od))
+        with self._lock:
+            return iter(list(self._od))
 
     def __len__(self) -> int:
         return len(self._od)
 
     def values(self):
-        return self._od.values()
+        with self._lock:
+            return list(self._od.values())
 
     def clear(self):
-        self._od.clear()
+        with self._lock:
+            self._od.clear()
