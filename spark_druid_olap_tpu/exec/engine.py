@@ -57,6 +57,9 @@ from .finalize import (  # noqa: F401
     finalize_timeseries,
     finalize_topn,
 )
+from ..utils.log import get_logger
+
+log = get_logger("exec.engine")
 
 # Above this many in-scope segments a query stops unrolling them into one
 # fused program (compile time grows linearly with the unroll) and falls back
@@ -534,6 +537,43 @@ class Engine:
         )
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
+        """GroupBy with one idempotent re-dispatch on transient device
+        failure — the analog of Spark retrying a DruidRDD partition
+        (SURVEY.md §5 failure-detection row: queries are read-only, so a
+        retry is always safe).  Static errors (RewriteError / ValueError,
+        and NotImplementedError — a RuntimeError subclass) propagate
+        immediately."""
+        # normalize ONCE so the retry evicts under the same cache identity
+        # the execution cached under (granularity adds a __time dimension)
+        q = groupby_with_time_granularity(q)
+        try:
+            return self._execute_groupby_once(q, ds)
+        except NotImplementedError:
+            raise
+        except RuntimeError as err:
+            log.warning(
+                "transient device failure (%s: %s); evicting cached state "
+                "and re-dispatching once",
+                type(err).__name__,
+                err,
+            )
+            self._evict_query_state(q, ds)
+            return self._execute_groupby_once(q, ds)
+
+    def _evict_query_state(self, q: Q.GroupByQuery, ds: DataSource):
+        """Drop everything a failed dispatch may have poisoned: this query's
+        compiled programs and lowering (staged device constants) plus the
+        datasource's resident columns (buffers may be orphaned if the
+        backend restarted)."""
+        base = _query_key(q, ds)
+        for k in [k for k in self._query_fn_cache if k[:2] == base]:
+            self._query_fn_cache.pop(k)
+        self._lowering_cache.pop(base)
+        uids = {seg.uid for seg in ds.segments}
+        for k in [k for k in self._device_cache if k[0] in uids]:
+            self._device_cache.pop(k)
+
+    def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
         import time as _time
 
         from .metrics import QueryMetrics
@@ -561,6 +601,11 @@ class Engine:
                         return out
                     self._sparse_disabled.add(qkey)
                     m.strategy = self._resolve_strategy(lowering.num_groups)
+                    log.warning(
+                        "sparse path declined (overflow or compile failure); "
+                        "query pinned to %s strategy",
+                        m.strategy,
+                    )
             t0 = _time.perf_counter()
             dims, la, G, sums, mins, maxs, sketch_states = (
                 self._partials_for_query(q, ds, lowering=lowering)
@@ -589,6 +634,7 @@ class Engine:
             m.bytes_resident = self.bytes_resident()
             self.last_metrics = m
             self._m = None
+            log.info("%s", m.describe())
 
     # -- timeseries: a groupby whose only dimension is the time bucket -------
 

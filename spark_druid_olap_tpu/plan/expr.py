@@ -568,9 +568,60 @@ def compile_expr(
             )
         f, field = compile_expr(e.operand, dicts, raw_strings=raw_strings), e.field
         return lambda cols: _time_extract(jnp.asarray(f(cols)), field)
-    if isinstance(e, (LikeExpr, StrFunc)):
+    if isinstance(e, LikeExpr):
+        if raw_strings:
+            import re as _re
+
+            from ..ops.filters import _like_to_regex
+
+            rx = _re.compile(_like_to_regex(e.pattern))
+            f = compile_expr(e.operand, dicts, raw_strings=True)
+            neg = e.negated
+
+            def like_host(cols, f=f, rx=rx, neg=neg):
+                vals = np.asarray(f(cols), dtype=object)
+                m = np.array(
+                    [v is not None and bool(rx.search(str(v))) for v in vals],
+                    dtype=bool,
+                )
+                return ~m if neg else m
+
+            return like_host
+        if isinstance(e.operand, Col) and _is_string_dict(
+            dicts, e.operand.name
+        ):
+            # Same translation the filter layer does (ops/filters.py Regex/
+            # Like row): run the pattern over the dictionary once at compile
+            # time; the device sees an int32 code-set membership test.
+            import re as _re
+
+            from ..ops.filters import _like_to_regex
+
+            rx = _re.compile(_like_to_regex(e.pattern))
+            d = dicts[e.operand.name]
+            codes = np.array(
+                [i for i, v in enumerate(d.values) if rx.search(str(v))],
+                dtype=np.int32,
+            )
+            name, neg = e.operand.name, e.negated
+            if len(codes) == 0:
+                if neg:  # NOT LIKE matching nothing = all non-null rows
+                    return lambda cols: cols[name] >= 0
+                return lambda cols: jnp.zeros(
+                    jnp.shape(cols[name]), jnp.bool_
+                )
+            if neg:  # SQL: NULL NOT LIKE p is NULL -> excluded
+                return lambda cols: (cols[name] >= 0) & ~jnp.isin(
+                    cols[name], codes
+                )
+            return lambda cols: jnp.isin(cols[name], codes)
         raise ValueError(
-            f"{type(e).__name__} is dictionary-evaluated (filter / GROUP BY "
+            "LIKE over a non-dictionary operand cannot compile to a device "
+            "row expression (dictionary dimensions translate to code sets)"
+        )
+    if isinstance(e, StrFunc):
+        raise ValueError(
+            "StrFunc is dictionary-evaluated (filter / GROUP BY "
             "position only); it cannot compile to a device row expression"
         )
     if isinstance(e, AggRef):

@@ -22,6 +22,9 @@ from ..models import query as Q
 from . import expr as E
 from . import logical as L
 from .builder import QueryBuilder
+from ..utils.log import get_logger
+
+log = get_logger("plan.planner")
 from .cost import PhysicalPlan, choose_physical
 from .transforms import (
     RewriteError,
@@ -259,6 +262,11 @@ class Planner:
 
         q = b.build()
         phys = choose_physical(q, ds, G_kernel, self.cfg, self.n_devices)
+        log.debug(
+            "rewrite: %s over %s -> %s strategy=%s distributed=%s groups=%d",
+            type(q).__name__, table, phys, phys.strategy, phys.distributed,
+            G_kernel,
+        )
         return Rewrite(
             datasource=table,
             builder=b,

@@ -709,13 +709,18 @@ class Analyzer:
                     return ie
         return ge
 
-    def _lift_aggs(self, e: E.Expr, hint: str) -> E.Expr:
-        """Replace AggCall subtrees with AggRefs, accumulating agg_exprs."""
+    def _lift_aggs(self, e: E.Expr, hint: str, _top: bool = True) -> E.Expr:
+        """Replace AggCall subtrees with AggRefs, accumulating agg_exprs.
+
+        The hint names an aggregate only when it IS the whole item (`_top`);
+        aggregates nested inside an expression get hidden `__aggN` names —
+        two distinct aggregates under one alias (q14's numerator/denominator
+        sums) must not collide on the output name."""
         if isinstance(e, AggCall):
             key = str(e) + (f" FILTER {e.filter}" if e.filter else "")
             if key in self.agg_by_key:
                 return E.AggRef(self.agg_by_key[key])
-            if isinstance(e, AggCall) and _is_simple_output(e, hint):
+            if _top and _is_simple_output(e, hint):
                 name = hint
             else:
                 name = f"__agg{len(self.agg_exprs)}"
@@ -733,9 +738,11 @@ class Analyzer:
         for f in dataclasses.fields(e):  # type: ignore[arg-type]
             v = getattr(e, f.name)
             if isinstance(v, E.Expr):
-                kw[f.name] = self._lift_aggs(v, hint)
+                kw[f.name] = self._lift_aggs(v, hint, _top=False)
             elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
-                kw[f.name] = tuple(self._lift_aggs(x, hint) for x in v)
+                kw[f.name] = tuple(
+                    self._lift_aggs(x, hint, _top=False) for x in v
+                )
             else:
                 kw[f.name] = v
         return type(e)(**kw)

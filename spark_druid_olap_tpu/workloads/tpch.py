@@ -315,6 +315,42 @@ QUERIES: Dict[str, str] = {
         GROUP BY l_shipmode
         ORDER BY l_shipmode
     """,
+    # Q7-class: volume shipping between two nations — OR-of-ANDs across two
+    # dimension branches + EXTRACT over the time column as a grouping dim
+    "q7": f"""
+        SELECT s_nation, c_nation,
+               EXTRACT(YEAR FROM l_shipdate) AS l_year,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM lineitem {_J_ORD} {_J_CUST} {_J_SUPP}
+        WHERE ((s_nation = 'FRANCE' AND c_nation = 'GERMANY')
+            OR (s_nation = 'GERMANY' AND c_nation = 'FRANCE'))
+          AND l_shipdate >= '1995-01-01' AND l_shipdate <= '1996-12-31'
+        GROUP BY s_nation, c_nation, EXTRACT(YEAR FROM l_shipdate)
+        ORDER BY s_nation, c_nation, l_year
+    """,
+    # Q14-class: promo revenue — LIKE inside CASE, ratio of two aggregates
+    # as a post-aggregation (constants adapted to this generator's p_type
+    # domain: 'MEDIUM%' plays the role of 'PROMO%')
+    "q14": f"""
+        SELECT 100 * sum(CASE WHEN p_type LIKE 'MEDIUM%'
+                              THEN l_extendedprice * (1 - l_discount)
+                              ELSE 0 END)
+                 / sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+        FROM lineitem {_J_PART}
+        WHERE l_shipdate >= '1995-09-01' AND l_shipdate < '1995-10-01'
+    """,
+    # Q19-class: discounted revenue — disjunction of conjunct blocks mixing
+    # string dims and numeric metric bounds
+    "q19": f"""
+        SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM lineitem {_J_PART}
+        WHERE (p_brand = 'Brand#12' AND l_quantity >= 1 AND l_quantity <= 11
+               AND l_shipmode IN ('AIR', 'REG AIR'))
+           OR (p_brand = 'Brand#23' AND l_quantity >= 10 AND l_quantity <= 20
+               AND l_shipmode IN ('AIR', 'REG AIR'))
+           OR (p_brand = 'Brand#34' AND l_quantity >= 20 AND l_quantity <= 30
+               AND l_shipmode IN ('AIR', 'REG AIR'))
+    """,
     # Q8 via EXTRACT(YEAR FROM o_orderdate) — no pre-materialized year
     # column needed (dictionary-backed EXTRACT dimension)
     "q8_extract": f"""
@@ -461,6 +497,53 @@ def oracle(f, name: str):
             .agg(high_line_count=("high", "sum"), low_line_count=("low", "sum"))
         )
         return out.sort_values("l_shipmode").reset_index(drop=True)
+    if name == "q7":
+        m = (
+            (
+                ((f.s_nation == "FRANCE") & (f.c_nation == "GERMANY"))
+                | ((f.s_nation == "GERMANY") & (f.c_nation == "FRANCE"))
+            )
+            & (f.l_shipdate >= _ms("1995-01-01"))
+            & (f.l_shipdate <= _ms("1996-12-31"))
+        )
+        g = f[m]
+        l_year = (
+            np.asarray(g.l_shipdate, dtype="datetime64[ms]")
+            .astype("datetime64[Y]")
+            .astype(int)
+            + 1970
+        )
+        out = (
+            g.assign(l_year=l_year, revenue=rev[m])
+            .groupby(["s_nation", "c_nation", "l_year"], as_index=False)[
+                "revenue"
+            ]
+            .sum()
+        )
+        return out.sort_values(
+            ["s_nation", "c_nation", "l_year"]
+        ).reset_index(drop=True)
+    if name == "q14":
+        m = (f.l_shipdate >= _ms("1995-09-01")) & (
+            f.l_shipdate < _ms("1995-10-01")
+        )
+        g = f[m]
+        grev = rev[m]
+        promo = np.where(
+            g.p_type.str.startswith("MEDIUM"), grev, 0.0
+        ).sum()
+        return float(100.0 * promo / grev.sum())
+    if name == "q19":
+        block = lambda brand, lo, hi: (
+            (f.p_brand == brand)
+            & (f.l_quantity >= lo)
+            & (f.l_quantity <= hi)
+            & f.l_shipmode.isin(["AIR", "REG AIR"])
+        )
+        m = block("Brand#12", 1, 11) | block("Brand#23", 10, 20) | block(
+            "Brand#34", 20, 30
+        )
+        return float(rev[m].sum())
     if name == "q8":
         m = (
             (f.c_region == "AMERICA")

@@ -126,6 +126,41 @@ def test_q8_extract_year_parity(ctx_tables, frame):
     np.testing.assert_allclose(got["total_volume"], want["total_volume"], rtol=2e-5)
 
 
+def test_q7_parity(ctx_tables, frame):
+    """OR-of-ANDs across two dimension branches + EXTRACT over the fact's
+    own time column as a grouping dimension."""
+    ctx, _ = ctx_tables
+    got = ctx.sql(tpch.QUERIES["q7"])
+    want = tpch.oracle(frame, "q7")
+    keys = ["s_nation", "c_nation", "l_year"]
+    got = got.sort_values(keys).reset_index(drop=True)
+    want = want.sort_values(keys).reset_index(drop=True)
+    assert len(got) == len(want)
+    for k in ("s_nation", "c_nation"):
+        assert list(got[k]) == list(want[k])
+    np.testing.assert_array_equal(
+        np.asarray(got["l_year"], dtype=np.int64),
+        np.asarray(want["l_year"], dtype=np.int64),
+    )
+    np.testing.assert_allclose(got["revenue"], want["revenue"], rtol=2e-5)
+
+
+def test_q14_parity(ctx_tables, frame):
+    """LIKE inside CASE + ratio of two aggregates as a post-aggregation."""
+    ctx, _ = ctx_tables
+    got = ctx.sql(tpch.QUERIES["q14"])
+    want = tpch.oracle(frame, "q14")
+    np.testing.assert_allclose(float(got["promo_revenue"][0]), want, rtol=2e-5)
+
+
+def test_q19_parity(ctx_tables, frame):
+    """Disjunction of conjunct blocks mixing string dims and metric bounds."""
+    ctx, _ = ctx_tables
+    got = ctx.sql(tpch.QUERIES["q19"])
+    want = tpch.oracle(frame, "q19")
+    np.testing.assert_allclose(float(got["revenue"][0]), want, rtol=2e-5)
+
+
 def test_q3_uses_sparse_path(ctx_tables):
     """l_orderkey grouping has a huge domain — confirm the sparse
     accelerator actually answered it (not the scatter fallback)."""
