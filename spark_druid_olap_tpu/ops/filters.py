@@ -86,6 +86,18 @@ def _like_to_regex(pattern: str) -> str:
     return "^" + "".join(out) + "$"
 
 
+def like_match_codes(d, pattern: str, is_regex: bool = False) -> np.ndarray:
+    """int32 codes of the dictionary values matching a LIKE (or anchored
+    regex) pattern — the one dictionary->code-set translation shared by the
+    filter layer and expression compilation (plan/expr.py), so LIKE
+    semantics cannot drift between WHERE and CASE positions."""
+    rx = re.compile(pattern if is_regex else _like_to_regex(pattern))
+    return np.array(
+        [i for i, v in enumerate(d.values) if rx.search(str(v))],
+        dtype=np.int32,
+    )
+
+
 def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
     """Returns fn(cols) -> bool[R].  `cols` maps column name -> device array
     (dimension codes, metric values, and "__time")."""
@@ -230,16 +242,8 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
 
     if isinstance(f, (F.Regex, F.LikeFilter)):
         dim = f.dimension
-        pat = (
-            f.pattern
-            if isinstance(f, F.Regex)
-            else _like_to_regex(f.pattern)
-        )
-        rx = re.compile(pat)
-        d = ds.dicts[dim]
-        codes = np.array(
-            [i for i, v in enumerate(d.values) if rx.search(str(v))],
-            dtype=np.int32,
+        codes = like_match_codes(
+            ds.dicts[dim], f.pattern, is_regex=isinstance(f, F.Regex)
         )
         if len(codes) == 0:
             return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)

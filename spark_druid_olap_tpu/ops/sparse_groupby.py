@@ -29,7 +29,7 @@ JVM); this is the TPU-native replacement for that engine interior.
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,49 @@ import jax.numpy as jnp
 from .groupby import partial_aggregate
 
 SPARSE_SLOTS = 4096
+
+# Row capacity of the filter-compaction stage: selective queries (the normal
+# OLAP case that reaches the sparse path — think city-level predicates over a
+# nation) compact surviving rows into this many slots BEFORE the sort, so the
+# bitonic sort network runs over 128K rows instead of the full segment.  A
+# multiple of 1024 (ROW_PAD) so the inner one-hot blocks divide evenly.
+ROW_CAPACITY = 1 << 17
+
+
+def compact_rows(
+    gid: jnp.ndarray,
+    mask: jnp.ndarray,
+    sum_values: jnp.ndarray,
+    minmax_values: jnp.ndarray,
+    minmax_masks: jnp.ndarray,
+    capacity: int,
+):
+    """Pack rows where mask is True into `capacity` slots (stable order).
+
+    TPU-idiomatic: one cumsum + one vectorized binary search + gathers — no
+    R-sized scatter, no sort.  Slot i holds the i-th surviving row (the first
+    position whose running count reaches i+1).  Slots past the survivor count
+    duplicate an arbitrary row with their mask cleared, so downstream
+    aggregation ignores them.  Returns (*compacted arrays, row_overflow) —
+    row_overflow set when survivors exceed capacity (the caller must rerun
+    without compaction; compacted state would silently drop rows)."""
+    R = gid.shape[0]
+    c = jnp.cumsum(mask.astype(jnp.int32))
+    n = c[-1]
+    row_overflow = n > capacity
+    idx = jnp.searchsorted(
+        c, jnp.arange(1, capacity + 1, dtype=jnp.int32), side="left"
+    )
+    idx = jnp.minimum(idx, R - 1)
+    new_mask = jnp.arange(capacity, dtype=jnp.int32) < n
+    return (
+        gid[idx],
+        new_mask,
+        sum_values[idx],
+        minmax_values[idx],
+        minmax_masks[idx],
+        row_overflow,
+    )
 
 
 def sparse_partial_aggregate(
@@ -51,13 +94,28 @@ def sparse_partial_aggregate(
     num_max: int,
     slots: int = SPARSE_SLOTS,
     inner_strategy: str = "auto",
+    row_capacity: Optional[int] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Compact gids to slots, aggregate dense over slots.
 
+    With `row_capacity`, surviving rows are first packed through
+    `compact_rows` so the sort network covers `row_capacity` rows instead of
+    R (the selective-filter fast path); `row_overflow` in the result tells
+    the engine the capacity was exceeded and the state is unusable.
+
     Returns {"gids": i32[slots] (-1 = empty/trash), "sums": f32[slots, Ms],
-    "mins": f32[slots, Mn], "maxs": f32[slots, Mx], "overflow": bool[]}.
+    "mins": f32[slots, Mn], "maxs": f32[slots, Mx], "overflow": bool[],
+    "row_overflow": bool[]}.
     """
     G = num_groups
+    row_overflow = jnp.zeros((), jnp.bool_)
+    if row_capacity is not None and row_capacity < gid.shape[0]:
+        gid, mask, sum_values, minmax_values, minmax_masks, row_overflow = (
+            compact_rows(
+                gid, mask, sum_values, minmax_values, minmax_masks,
+                row_capacity,
+            )
+        )
     R = gid.shape[0]
     n_state = slots + 1  # + 1 so the masked-row trash run never eats a slot
     g = jnp.where(mask, gid, jnp.int32(G))  # trash value for masked rows
@@ -99,6 +157,7 @@ def sparse_partial_aggregate(
         "mins": mins,
         "maxs": maxs,
         "overflow": overflow,
+        "row_overflow": row_overflow,
     }
 
 
@@ -149,4 +208,5 @@ def merge_sparse_states(
         "mins": mins,
         "maxs": maxs,
         "overflow": overflow,
+        "row_overflow": a["row_overflow"] | b["row_overflow"],
     }

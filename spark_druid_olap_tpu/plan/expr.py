@@ -584,25 +584,23 @@ def compile_expr(
                     [v is not None and bool(rx.search(str(v))) for v in vals],
                     dtype=bool,
                 )
-                return ~m if neg else m
+                if not neg:
+                    return m
+                # SQL three-valued: NULL NOT LIKE p is NULL -> excluded,
+                # matching the device path below
+                nn = np.array([v is not None for v in vals], dtype=bool)
+                return nn & ~m
 
             return like_host
         if isinstance(e.operand, Col) and _is_string_dict(
             dicts, e.operand.name
         ):
-            # Same translation the filter layer does (ops/filters.py Regex/
-            # Like row): run the pattern over the dictionary once at compile
-            # time; the device sees an int32 code-set membership test.
-            import re as _re
+            # shared dictionary->code-set translation (ops/filters.py):
+            # pattern runs over the dictionary once at compile time; the
+            # device sees an int32 code-set membership test
+            from ..ops.filters import like_match_codes
 
-            from ..ops.filters import _like_to_regex
-
-            rx = _re.compile(_like_to_regex(e.pattern))
-            d = dicts[e.operand.name]
-            codes = np.array(
-                [i for i, v in enumerate(d.values) if rx.search(str(v))],
-                dtype=np.int32,
-            )
+            codes = like_match_codes(dicts[e.operand.name], e.pattern)
             name, neg = e.operand.name, e.negated
             if len(codes) == 0:
                 if neg:  # NOT LIKE matching nothing = all non-null rows

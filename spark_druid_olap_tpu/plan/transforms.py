@@ -201,7 +201,15 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
             return F.Bound(name, lower=sval, upper=sval, ordering="numeric")
         if op == "!=":
             if is_string_dim:
-                return F.Not(F.Selector(name, sval))
+                # SQL three-valued: NULL <> 'x' is UNKNOWN -> excluded; a
+                # bare two-valued Not would keep null rows (matches the
+                # expression layer's `!=` policy, plan/expr.py)
+                return F.And(
+                    (
+                        F.Not(F.Selector(name, sval)),
+                        F.Not(F.Selector(name, None)),
+                    )
+                )
             return F.Not(F.Bound(name, lower=sval, upper=sval, ordering="numeric"))
         if op in ("<", "<="):
             return F.Bound(name, upper=sval, upper_strict=(op == "<"),
@@ -217,7 +225,13 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
     if isinstance(e, E.LikeExpr):
         if isinstance(e.operand, E.Col):
             f: F.Filter = F.LikeFilter(e.operand.name, e.pattern)
-            return F.Not(f) if e.negated else f
+            if not e.negated:
+                return f
+            # SQL: NULL NOT LIKE p is UNKNOWN -> excluded (same policy as
+            # the expression layer's device compile, plan/expr.py)
+            return F.And(
+                (F.Not(f), F.Not(F.Selector(e.operand.name, None)))
+            )
         return None
     if isinstance(e, E.BoolOp):
         if e.op == "not":

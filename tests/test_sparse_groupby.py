@@ -234,3 +234,112 @@ def test_sparse_empty_result():
     q = _query(filter=InFilter("a", (99999,)))
     got = Engine().execute(q, ds)
     assert len(got) == 0
+
+
+# ---------------------------------------------------------------------------
+# Filter-compaction fast path (compact_rows tier)
+# ---------------------------------------------------------------------------
+
+
+def test_compact_rows_parity():
+    """Compacted sparse aggregation == full sparse aggregation when the
+    survivors fit the row capacity."""
+    import jax.numpy as jnp
+
+    from spark_druid_olap_tpu.ops.sparse_groupby import (
+        sparse_partial_aggregate,
+    )
+
+    rng = np.random.default_rng(21)
+    R, G = 32_768, 1 << 20
+    gid = jnp.asarray(rng.integers(0, G, size=R).astype(np.int32))
+    mask = jnp.asarray(rng.random(R) < 0.02)  # ~650 survivors
+    sv = jnp.asarray(rng.random((R, 2)).astype(np.float32))
+    mmv = jnp.asarray(rng.random((R, 1)).astype(np.float32))
+    mmm = jnp.ones((R, 1), jnp.bool_)
+    full = sparse_partial_aggregate(
+        gid, mask, sv, mmv, mmm, num_groups=G, num_min=1, num_max=0
+    )
+    comp = sparse_partial_aggregate(
+        gid, mask, sv, mmv, mmm, num_groups=G, num_min=1, num_max=0,
+        row_capacity=2048,
+    )
+    assert not bool(comp["row_overflow"])
+    assert not bool(comp["overflow"])
+    # same populated slots, same partials (order within the sort is by gid,
+    # identical in both)
+    fsel = np.asarray(full["gids"]) >= 0
+    csel = np.asarray(comp["gids"]) >= 0
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(full["gids"])[fsel]),
+        np.sort(np.asarray(comp["gids"])[csel]),
+    )
+    fo = np.argsort(np.asarray(full["gids"])[fsel])
+    co = np.argsort(np.asarray(comp["gids"])[csel])
+    np.testing.assert_allclose(
+        np.asarray(full["sums"])[fsel][fo],
+        np.asarray(comp["sums"])[csel][co],
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(full["mins"])[fsel][fo],
+        np.asarray(comp["mins"])[csel][co],
+        rtol=1e-6,
+    )
+
+
+def test_compact_rows_overflow_flag():
+    import jax.numpy as jnp
+
+    from spark_druid_olap_tpu.ops.sparse_groupby import (
+        sparse_partial_aggregate,
+    )
+
+    R = 8_192
+    gid = jnp.zeros(R, jnp.int32)
+    mask = jnp.ones(R, jnp.bool_)  # every row survives > capacity
+    sv = jnp.ones((R, 1), jnp.float32)
+    mmv = jnp.zeros((R, 0), jnp.float32)
+    mmm = jnp.zeros((R, 0), jnp.bool_)
+    out = sparse_partial_aggregate(
+        gid, mask, sv, mmv, mmm, num_groups=1 << 16, num_min=0, num_max=0,
+        row_capacity=1024,
+    )
+    assert bool(out["row_overflow"])
+
+
+def test_engine_row_overflow_reruns_full_sort(monkeypatch):
+    """Survivors exceed the compaction capacity: the engine must rerun the
+    full-segment sort tier and still return exact results."""
+    import spark_druid_olap_tpu.ops.sparse_groupby as sg
+
+    monkeypatch.setattr(sg, "ROW_CAPACITY", 1024)
+    ds, cols = _make_ds()  # 60k rows over 3 segments
+    keep = list(range(0, 150))  # ~half the rows survive >> 1024
+    q = _query(filter=InFilter("a", tuple(keep)))
+    eng = Engine()
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], keep)
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+
+
+def test_engine_compacted_tier_parity(monkeypatch):
+    """Survivors fit the (shrunken) capacity: the compacted tier answers and
+    matches the oracle."""
+    import spark_druid_olap_tpu.ops.sparse_groupby as sg
+
+    monkeypatch.setattr(sg, "ROW_CAPACITY", 8192)
+    ds, cols = _make_ds()
+    keep = list(range(0, 20))  # ~4k survivors < 8192
+    q = _query(filter=InFilter("a", tuple(keep)))
+    eng = Engine()
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], keep)
+    assert int(mask.sum()) < 8192
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    np.testing.assert_allclose(got["lo"], want["lo"], rtol=1e-6)
+    np.testing.assert_allclose(got["hi"], want["hi"], rtol=1e-6)

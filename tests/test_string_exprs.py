@@ -218,3 +218,44 @@ def test_in_with_dates_over_numeric_column(ctx):
     ]
     want = int(df.d.isin(days).sum())
     assert int(got["n"][0]) == want
+
+
+def _null_ctx():
+    """Datasource with NULL dimension values (pandas None -> code -1)."""
+    c = sd.TPUOlapContext()
+    vals = np.array(["AA", "AB", "BB", None, "AA", None, "BB", "AB"], dtype=object)
+    v = np.arange(8, dtype=np.float32) + 1
+    c.register_table(
+        "nt",
+        {"s": vals, "v": v},
+        dimensions=["s"],
+        metrics=["v"],
+    )
+    return c, vals, v
+
+
+def test_not_equal_excludes_nulls_in_where():
+    """SQL: NULL <> 'AA' is UNKNOWN -> row excluded (not kept)."""
+    c, vals, v = _null_ctx()
+    got = c.sql("SELECT sum(v) AS s FROM nt WHERE s <> 'AA'")
+    want = float(v[[1, 2, 6, 7]].sum())  # AB, BB, BB, AB — not the Nones
+    np.testing.assert_allclose(float(got["s"][0]), want, rtol=1e-6)
+
+
+def test_not_like_excludes_nulls_in_where():
+    c, vals, v = _null_ctx()
+    got = c.sql("SELECT sum(v) AS s FROM nt WHERE s NOT LIKE 'A%'")
+    want = float(v[[2, 6]].sum())  # the two BBs only
+    np.testing.assert_allclose(float(got["s"][0]), want, rtol=1e-6)
+
+
+def test_like_and_not_like_in_case_position():
+    """Device expression compile: LIKE/NOT LIKE inside CASE match the WHERE
+    policy (NULL excluded under negation)."""
+    c, vals, v = _null_ctx()
+    got = c.sql(
+        "SELECT sum(CASE WHEN s LIKE 'A%' THEN v ELSE 0 END) AS a, "
+        "sum(CASE WHEN s NOT LIKE 'A%' THEN v ELSE 0 END) AS b FROM nt"
+    )
+    np.testing.assert_allclose(float(got["a"][0]), float(v[[0, 1, 4, 7]].sum()), rtol=1e-6)
+    np.testing.assert_allclose(float(got["b"][0]), float(v[[2, 6]].sum()), rtol=1e-6)
