@@ -219,6 +219,8 @@ class TPUOlapContext:
     def execute_rewrite(self, rw: Rewrite):
         import pandas as pd
 
+        if rw.exact_distinct is not None:
+            return self._execute_exact_distinct(rw.exact_distinct)
         ds = self.catalog.get(rw.datasource)
         if ds is None:
             raise RewriteError(f"unknown table {rw.datasource!r}")
@@ -241,6 +243,61 @@ class TPUOlapContext:
             extra = [c for c in df.columns if c not in cols and c == "__grouping_id"]
             df = df[cols + extra]
         return df
+
+    def _execute_exact_distinct(self, spec):
+        """Two-phase exact COUNT(DISTINCT): run the inner rewrite (grouped by
+        dims + distinct columns on device), then re-aggregate on host —
+        the reference's pushHLLTODruid=false shape, where Spark finished the
+        distinct exactly after the Druid scan."""
+        import pandas as pd
+
+        inner = self.execute_rewrite(spec.inner)
+        agg_kwargs = {
+            name: pd.NamedAgg(column=name, aggfunc=op)
+            for name, op in spec.outer_ops
+        }
+        for out, col in spec.distinct_outs:
+            # pandas nunique skips None/NaN — SQL COUNT(DISTINCT) semantics
+            agg_kwargs[out] = pd.NamedAgg(column=col, aggfunc="nunique")
+        if spec.dim_names:
+            df = (
+                inner.groupby(list(spec.dim_names), as_index=False, dropna=False)
+                .agg(**agg_kwargs)
+            )
+        else:
+            df = pd.DataFrame(
+                {
+                    name: [getattr(inner[a.column], a.aggfunc)()]
+                    for name, a in agg_kwargs.items()
+                }
+            )
+        for c in spec.count_like:
+            if c in df:
+                df[c] = df[c].astype(np.int64)
+        for out, _ in spec.distinct_outs:
+            df[out] = df[out].astype(np.int64)
+        for name, s, c in spec.avg_div:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                df[name] = np.where(
+                    df[c] != 0, df[s] / np.where(df[c] == 0, 1, df[c]), np.nan
+                )
+        for name, e in spec.post_exprs:
+            df[name] = _eval_host(e, df)
+        if spec.having is not None:
+            mask = np.asarray(_eval_host(spec.having, df), dtype=bool)
+            df = df[mask].reset_index(drop=True)
+        if spec.sort_keys:
+            df = df.sort_values(
+                [c for c, _ in spec.sort_keys],
+                ascending=[a for _, a in spec.sort_keys],
+                kind="stable",
+            )
+        if spec.offset:
+            df = df.iloc[spec.offset:]
+        if spec.limit is not None:
+            df = df.head(spec.limit)
+        cols = [c for c in spec.output_columns if c in df.columns]
+        return df[cols].reset_index(drop=True)
 
     def _execute_grouping_sets(self, rw: Rewrite, ds, engine):
         """CUBE/ROLLUP/GROUPING SETS: one kernel pass per set, absent

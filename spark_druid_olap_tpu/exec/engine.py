@@ -68,6 +68,11 @@ log = get_logger("exec.engine")
 # which dominates warm latency when the TPU sits behind a network tunnel.
 MULTI_SEGMENT_UNROLL_MAX = 32
 
+# Consecutive sparse-path exception fallbacks before a query is pinned off
+# the accelerator (transient blips recover; deterministic failures stop
+# re-paying doomed trace+compiles).
+_SPARSE_ERROR_PIN_AFTER = 2
+
 
 class Engine:
     """Executes query specs on the local device set.
@@ -91,10 +96,13 @@ class Engine:
         self._pallas_broken = False  # set on first Mosaic-compile failure
         # queries pinned off the sparse accelerator because compaction
         # deterministically overflowed SPARSE_SLOTS distinct groups.
-        # Exception fallbacks do NOT pin (a transient device blip must not
-        # demote a query to the scatter path for the engine's lifetime);
-        # a repeatedly-failing program is bounded by _pallas_broken.
+        # Exception fallbacks do NOT pin immediately (a transient device
+        # blip must not demote a query for the engine's lifetime) but are
+        # counted per query: repeated failures pin after
+        # _SPARSE_ERROR_PIN_AFTER so a deterministically-broken sparse
+        # lowering stops re-paying doomed trace+compiles every execution.
         self._sparse_disabled: set = set()
+        self._sparse_error_counts: Dict = {}
         # queries whose survivors overflowed the row-compaction capacity:
         # deterministic for a given (query, data), so repeats skip straight
         # to the full-segment sort tier
@@ -639,16 +647,25 @@ class Engine:
                     )
                     if out is not None:
                         m.device_ms = (_time.perf_counter() - t0) * 1e3
+                        self._sparse_error_counts.pop(qkey, None)
                         return out
+                    pinned = False
                     if reason == "overflow":
                         # deterministic: more distinct groups than slots
                         self._sparse_disabled.add(qkey)
+                        pinned = True
+                    else:
+                        n = self._sparse_error_counts.get(qkey, 0) + 1
+                        self._sparse_error_counts[qkey] = n
+                        if n >= _SPARSE_ERROR_PIN_AFTER:
+                            self._sparse_disabled.add(qkey)
+                            pinned = True
                     m.strategy = self._resolve_strategy(lowering.num_groups)
                     log.warning(
                         "sparse path declined (%s); falling back to %s%s",
                         reason,
                         m.strategy,
-                        " (pinned)" if reason == "overflow" else "",
+                        " (pinned)" if pinned else "",
                     )
             t0 = _time.perf_counter()
             dims, la, G, sums, mins, maxs, sketch_states = (

@@ -39,6 +39,30 @@ from .transforms import (
 
 
 @dataclasses.dataclass
+class ExactDistinctOuter:
+    """Host re-aggregation spec for exact COUNT(DISTINCT) two-phase plans
+    (count_distinct_mode="exact" — the reference's pushHLLTODruid=false:
+    keep the distinct exact by finishing it engine-side instead of pushing a
+    sketch).  The inner rewrite groups by (dims..., distinct cols...); the
+    outer pass re-aggregates on host: re-aggregable aggs fold with
+    `outer_ops`, distinct outputs count unique non-null values, AVG is
+    recomputed from its sum/count parts."""
+
+    inner: "Rewrite"
+    dim_names: Tuple[str, ...]  # outer grouping columns
+    distinct_outs: Tuple[Tuple[str, str], ...]  # (output name, inner column)
+    outer_ops: Tuple[Tuple[str, str], ...]  # (column, "sum"|"min"|"max")
+    count_like: Tuple[str, ...]  # columns cast back to int64 after the fold
+    avg_div: Tuple[Tuple[str, str, str], ...]  # (name, sum col, count col)
+    post_exprs: Tuple[Tuple[str, E.Expr], ...]
+    having: Optional[E.Expr]
+    sort_keys: Tuple[Tuple[str, bool], ...]  # (column, ascending)
+    limit: Optional[int]
+    offset: int
+    output_columns: Tuple[str, ...]
+
+
+@dataclasses.dataclass
 class Rewrite:
     """The planner's output: query spec + everything the execution layer
     needs to finalize results (the DruidStrategy 'projection fixup' analog)."""
@@ -53,6 +77,7 @@ class Rewrite:
     host_post_exprs: Tuple[Tuple[str, E.Expr], ...]
     grouping_sets: Tuple[Tuple[int, ...], ...]
     is_scan: bool = False
+    exact_distinct: Optional[ExactDistinctOuter] = None
 
     def to_json(self) -> str:
         return json.dumps(self.query.to_druid(), indent=2, default=str)
@@ -143,6 +168,12 @@ class Planner:
         having_cond: Optional[E.Expr],
         top_projections,
     ) -> Rewrite:
+        if self.cfg.count_distinct_mode == "exact" and any(
+            ae.fn == "count_distinct" for ae in agg.agg_exprs
+        ):
+            return self._plan_exact_distinct(
+                agg, limit, offset, sort_keys, having_cond, top_projections
+            )
         table, env, filters = self._collapse_below(agg.child)
         ds = self._ds(table)
         b = QueryBuilder(datasource=table)
@@ -277,6 +308,147 @@ class Planner:
             residual_having=residual_having,
             host_post_exprs=tuple(host_posts),
             grouping_sets=tuple(agg.grouping_sets),
+        )
+
+    # -- exact COUNT(DISTINCT): two-phase plan -------------------------------
+
+    def _plan_exact_distinct(
+        self,
+        agg: L.Aggregate,
+        limit: Optional[int],
+        offset: int,
+        sort_keys: List[L.SortKey],
+        having_cond: Optional[E.Expr],
+        top_projections,
+    ) -> Rewrite:
+        """count_distinct_mode="exact": rewrite COUNT(DISTINCT x) by adding x
+        to the inner grouping and finishing on host (pandas re-aggregation).
+        Every other aggregate must be re-aggregable (sum/count -> sum,
+        min/max -> min/max, avg -> recomputed from sum/count parts); approx
+        sketches cannot be folded exactly and are rejected in this mode."""
+        if agg.grouping_sets:
+            raise RewriteError(
+                "exact COUNT(DISTINCT) with CUBE/ROLLUP unsupported "
+                "(set count_distinct_mode='approx')"
+            )
+        distinct_outs: List[Tuple[str, str]] = []
+        inner_aggs: List[L.AggExpr] = []
+        outer_ops: List[Tuple[str, str]] = []
+        count_like: List[str] = []
+        avg_div: List[Tuple[str, str, str]] = []
+        extra_dims: Dict[str, E.Expr] = {}
+        for ae in agg.agg_exprs:
+            if ae.distinct and ae.fn in ("sum", "avg"):
+                raise RewriteError(
+                    f"{ae.fn.upper()}(DISTINCT) cannot re-aggregate exactly"
+                )
+            if ae.fn == "count_distinct":
+                if not isinstance(ae.arg, E.Col):
+                    raise RewriteError(
+                        "exact COUNT(DISTINCT) over expressions unsupported"
+                    )
+                if ae.filter is not None:
+                    raise RewriteError(
+                        "exact COUNT(DISTINCT) with FILTER unsupported"
+                    )
+                extra_dims.setdefault(ae.arg.name, ae.arg)
+                distinct_outs.append((ae.name, ae.arg.name))
+            elif ae.fn == "approx_count_distinct":
+                raise RewriteError(
+                    "cannot mix exact COUNT(DISTINCT) with approx sketches "
+                    "in one query (sketch states do not re-aggregate "
+                    "exactly); use count_distinct_mode='approx'"
+                )
+            elif ae.fn == "avg":
+                # NOT the "__sum"/"__cnt" suffixes: the inner planner's
+                # default projection drops those as AVG-rewrite helpers
+                s, c = f"__ed_{ae.name}_sum", f"__ed_{ae.name}_cnt"
+                inner_aggs.append(
+                    L.AggExpr(s, "sum", ae.arg, False, ae.filter)
+                )
+                inner_aggs.append(
+                    L.AggExpr(c, "count", None, False, ae.filter)
+                )
+                outer_ops += [(s, "sum"), (c, "sum")]
+                count_like.append(c)
+                avg_div.append((ae.name, s, c))
+            elif ae.fn in ("sum", "count"):
+                inner_aggs.append(ae)
+                outer_ops.append((ae.name, "sum"))
+                if ae.fn == "count":
+                    count_like.append(ae.name)
+            elif ae.fn in ("min", "max"):
+                inner_aggs.append(ae)
+                outer_ops.append((ae.name, ae.fn))
+            else:
+                raise RewriteError(
+                    f"aggregate {ae.fn!r} cannot re-aggregate exactly "
+                    "alongside exact COUNT(DISTINCT)"
+                )
+
+        inner = L.Aggregate(
+            agg.group_exprs
+            + tuple((f"__dist_{n}", e) for n, e in extra_dims.items()),
+            tuple(inner_aggs),
+            agg.child,
+        )
+        inner_rw = self._plan_aggregate(inner, None, 0, [], None, None)
+        distinct_outs = [
+            (name, f"__dist_{col}") for name, col in distinct_outs
+        ]
+
+        dim_names = tuple(n for n, _ in agg.group_exprs)
+        known = (
+            set(dim_names)
+            | {n for n, _ in outer_ops}
+            | {n for n, _ in distinct_outs}
+            | {n for n, _, _ in avg_div}
+        )
+        post_exprs: List[Tuple[str, E.Expr]] = []
+        output_columns: List[str] = []
+        out_exprs = (
+            top_projections if top_projections is not None else agg.post_exprs
+        )
+        if out_exprs:
+            for name, pe in out_exprs:
+                if isinstance(pe, (E.Col, E.AggRef)) and pe.name in known:
+                    output_columns.append(pe.name)
+                    continue
+                post_exprs.append((name, pe))
+                output_columns.append(name)
+        else:
+            # declaration order, matching the approx path's default (column
+            # order must not depend on count_distinct_mode)
+            output_columns = list(dim_names) + [
+                ae.name for ae in agg.agg_exprs
+            ]
+
+        skeys: List[Tuple[str, bool]] = []
+        for sk in sort_keys:
+            if isinstance(sk.expr, (E.Col, E.AggRef)):
+                skeys.append((sk.expr.name, sk.ascending))
+            else:
+                raise RewriteError(
+                    "exact COUNT(DISTINCT) supports ORDER BY on named "
+                    "columns only"
+                )
+
+        return dataclasses.replace(
+            inner_rw,
+            exact_distinct=ExactDistinctOuter(
+                inner=inner_rw,
+                dim_names=dim_names,
+                distinct_outs=tuple(distinct_outs),
+                outer_ops=tuple(outer_ops),
+                count_like=tuple(count_like),
+                avg_div=tuple(avg_div),
+                post_exprs=tuple(post_exprs),
+                having=having_cond,
+                sort_keys=tuple(skeys),
+                limit=limit,
+                offset=offset,
+                output_columns=tuple(output_columns),
+            ),
         )
 
     # -- scan path -----------------------------------------------------------

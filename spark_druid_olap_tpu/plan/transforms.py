@@ -364,6 +364,14 @@ def translate_aggregate(
             b,
         )
 
+    if agg.distinct and fn in ("sum", "avg"):
+        # MIN/MAX(DISTINCT) == MIN/MAX and passes through; SUM/AVG(DISTINCT)
+        # would silently double-count duplicates — refuse, never wrong data
+        raise RewriteError(
+            f"{fn.upper()}(DISTINCT) is not pushable (duplicates cannot be "
+            "eliminated in partial aggregation)"
+        )
+
     if fn == "avg":
         sum_name, cnt_name = f"{name}__sum", f"{name}__cnt"
         aggs, _, b = translate_aggregate(

@@ -57,6 +57,7 @@ class SelectStmt:
     limit: Optional[int]
     offset: int
     explain: bool = False
+    distinct: bool = False
 
 
 @dataclasses.dataclass
@@ -133,6 +134,7 @@ class Parser:
 
     def select(self) -> SelectStmt:
         self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
         items: List[Tuple[Optional[str], E.Expr]] = []
         while True:
             if self.accept_op("*"):
@@ -205,7 +207,7 @@ class Parser:
             offset = int(self.next().value)
         return SelectStmt(
             items, table, where, group_by, group_mode, grouping_sets,
-            having, order_by, limit, offset,
+            having, order_by, limit, offset, distinct=distinct,
         )
 
     def _expr_list(self) -> List[E.Expr]:
@@ -584,6 +586,28 @@ class Analyzer:
             or any(_contains_agg(e) for _, e in stmt.items)
             or (stmt.having is not None)
         )
+        if stmt.distinct:
+            if has_agg:
+                # grouped output rows are already distinct per group in the
+                # overwhelmingly common case; deduplicating aggregate values
+                # across groups is out of scope (the reference fell back to
+                # Spark for it too)
+                raise ParseError(
+                    "SELECT DISTINCT with GROUP BY / aggregates unsupported"
+                )
+            # SELECT DISTINCT a, b FROM t == SELECT a, b FROM t GROUP BY a, b
+            # (the reference's planner saw the same rewrite from Catalyst)
+            if any(
+                isinstance(e, E.Col) and e.name == "*" for _, e in stmt.items
+            ):
+                raise ParseError("SELECT DISTINCT * unsupported")
+            stmt = dataclasses.replace(
+                stmt,
+                distinct=False,
+                group_by=[e for _, e in stmt.items],
+            )
+            self.stmt = stmt
+            has_agg = True
         if not has_agg:
             exprs = []
             for alias, e in stmt.items:
