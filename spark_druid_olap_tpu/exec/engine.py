@@ -213,6 +213,10 @@ class Engine:
             return self._execute_scan(q, ds)
         if isinstance(q, Q.SearchQuery):
             return self._execute_search(q, ds)
+        if isinstance(q, Q.TimeBoundaryQuery):
+            return self._execute_time_boundary(q, ds)
+        if isinstance(q, Q.SegmentMetadataQuery):
+            return self._execute_segment_metadata(q, ds)
         raise NotImplementedError(type(q).__name__)
 
     # -- groupby -------------------------------------------------------------
@@ -376,11 +380,18 @@ class Engine:
             )
             sk = {}
             for agg in la.sketch_aggs:
+                # per-agg FILTER mask (SQL `agg(...) FILTER (WHERE ...)`)
+                # composes with the row mask — sketches must honor it the
+                # same way sum/min/max columns do
+                mfn = la.mask_fns.get(agg.name)
+                amask = mask & mfn(cols) if mfn is not None else mask
                 if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
-                    sk[agg.name] = hll_ops.partial_hll(agg, cols, gid, mask, G)
+                    sk[agg.name] = hll_ops.partial_hll(
+                        agg, cols, gid, amask, G
+                    )
                 else:
                     sk[agg.name] = theta_ops.partial_theta(
-                        agg, cols, gid, mask, G
+                        agg, cols, gid, amask, G
                     )
             return s, mn, mx, sk
 
@@ -766,6 +777,65 @@ class Engine:
             pd.concat(frames, ignore_index=True)
             if frames
             else pd.DataFrame(columns=list(q.columns))
+        )
+
+    def _execute_time_boundary(self, q: Q.TimeBoundaryQuery, ds: DataSource):
+        """Druid `timeBoundary` — answered from segment metadata (the
+        reference learned these bounds from the coordinator, SURVEY.md §3.1);
+        no kernel dispatch."""
+        import pandas as pd
+
+        iv = ds.interval()
+        if iv is None:
+            return pd.DataFrame(columns=["minTime", "maxTime"])
+        lo, hi = iv
+        row = {}
+        if q.bound in (None, "minTime"):
+            row["minTime"] = np.datetime64(int(lo), "ms")
+        if q.bound in (None, "maxTime"):
+            row["maxTime"] = np.datetime64(int(hi), "ms")
+        return pd.DataFrame([row])
+
+    def _execute_segment_metadata(
+        self, q: Q.SegmentMetadataQuery, ds: DataSource
+    ):
+        """Druid `segmentMetadata` — the catalog rendered per segment (the
+        query the reference's metadata cache bootstraps from)."""
+        import pandas as pd
+
+        from ..models.filters import _ms_to_iso
+
+        # schema is datasource-level: one columns dict shared by all segments
+        cols = {
+            c.name: {
+                "type": c.kind,
+                "dtype": c.dtype,
+                "cardinality": c.cardinality,
+            }
+            for c in ds.columns
+        }
+        rows = []
+        for seg in self._segments_in_scope(q, ds):
+            rows.append(
+                {
+                    "id": seg.segment_id,
+                    "intervals": (
+                        [
+                            "%s/%s"
+                            % (
+                                _ms_to_iso(int(seg.interval[0])),
+                                _ms_to_iso(int(seg.interval[1])),
+                            )
+                        ]
+                        if seg.interval is not None
+                        else []
+                    ),
+                    "numRows": seg.num_rows,
+                    "columns": cols,
+                }
+            )
+        return pd.DataFrame(
+            rows, columns=["id", "intervals", "numRows", "columns"]
         )
 
     def _execute_search(self, q: Q.SearchQuery, ds: DataSource):

@@ -119,6 +119,24 @@ def eval_post_agg(
                 "in the same query)"
             )
         return theta_estimate(states[p.field_name])
+    if isinstance(p, A.ThetaSketchSetOp):
+        from ..ops.theta import set_op_estimate
+
+        bad = [
+            f
+            for f in p.field_names
+            if states is None
+            or f not in states
+            # theta KMV states are uint32 hash arrays; an HLL register
+            # array here would silently produce a garbage estimate
+            or np.asarray(states[f]).dtype != np.uint32
+        ]
+        if bad:
+            raise KeyError(
+                f"thetaSketchSetOp over {bad}: fields must name "
+                "thetaSketch aggregations in the same query"
+            )
+        return set_op_estimate(p.fn, [states[f] for f in p.field_names])
     raise NotImplementedError(f"post-aggregation {type(p).__name__}")
 
 

@@ -18,7 +18,7 @@ Each aggregator here is also the *merge contract* for the distributed engine:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from .filters import Filter
 
@@ -299,4 +299,31 @@ class ThetaSketchEstimate(PostAggregation):
             "type": "thetaSketchEstimate",
             "name": self.name,
             "field": {"type": "fieldAccess", "fieldName": self.field_name},
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ThetaSketchSetOp(PostAggregation):
+    """Estimate of a set operation over theta sketch states (Druid's
+    `thetaSketchSetOp` wrapped in `thetaSketchEstimate`): UNION / INTERSECT /
+    NOT over the named thetaSketch aggregations in the same query.  Evaluated
+    from raw per-group KMV states at finalize (ops/theta.py set_op_estimate)."""
+
+    name: str
+    fn: str  # "UNION" | "INTERSECT" | "NOT"
+    field_names: Tuple[str, ...]
+
+    def to_druid(self):
+        return {
+            "type": "thetaSketchEstimate",
+            "name": self.name,
+            "field": {
+                "type": "thetaSketchSetOp",
+                "name": f"{self.name}__setop",
+                "func": self.fn,
+                "fields": [
+                    {"type": "fieldAccess", "fieldName": f}
+                    for f in self.field_names
+                ],
+            },
         }

@@ -291,3 +291,42 @@ class SearchQuery(QuerySpec):
             "intervals": _ivs(self.intervals),
             "limit": self.limit,
         }
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeBoundaryQuery(QuerySpec):
+    """Druid `timeBoundary`: min/max event time of a datasource.  The
+    reference's metadata path issues these to size intervals; locally it is
+    answered from segment metadata (no kernel dispatch)."""
+
+    datasource: str
+    bound: Optional[str] = None  # None -> both | "minTime" | "maxTime"
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "queryType": "timeBoundary",
+            "dataSource": self.datasource,
+        }
+        if self.bound:
+            d["bound"] = self.bound
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentMetadataQuery(QuerySpec):
+    """Druid `segmentMetadata`: per-segment column analysis (types,
+    cardinalities, row counts).  The reference's DruidMetadataCache boots
+    from exactly this query (SURVEY.md §3.1); locally the catalog IS that
+    metadata, so this renders it in Druid's wire shape."""
+
+    datasource: str
+    intervals: Tuple[Tuple[int, int], ...] = ()
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "queryType": "segmentMetadata",
+            "dataSource": self.datasource,
+        }
+        if self.intervals:
+            d["intervals"] = _ivs(self.intervals)
+        return d

@@ -100,6 +100,14 @@ def post_agg_from_druid(d: Dict[str, Any]) -> A.PostAggregation:
         return A.HyperUniqueCardinality(d.get("name", d["fieldName"]), d["fieldName"])
     if t == "thetaSketchEstimate":
         f = d.get("field", {})
+        if f.get("type") == "thetaSketchSetOp":
+            fn = f.get("func", f.get("fn"))
+            fields = tuple(x["fieldName"] for x in f.get("fields", ()))
+            if fn not in ("UNION", "INTERSECT", "NOT"):
+                raise WireError(f"thetaSketchSetOp func {fn!r}")
+            if not fields:
+                raise WireError("thetaSketchSetOp requires fields")
+            return A.ThetaSketchSetOp(d["name"], fn, fields)
         return A.ThetaSketchEstimate(d["name"], f.get("fieldName", d.get("fieldName")))
     raise WireError(f"unsupported postAggregation type {t!r}")
 
@@ -267,5 +275,12 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
             filter=filt,
             intervals=ivs,
             limit=d.get("limit", 1000),
+        )
+    if qt == "timeBoundary":
+        return Q.TimeBoundaryQuery(datasource=ds, bound=d.get("bound"))
+    if qt == "segmentMetadata":
+        return Q.SegmentMetadataQuery(
+            datasource=ds,
+            intervals=intervals_from_druid(d.get("intervals", [])),
         )
     raise WireError(f"unsupported queryType {qt!r}")

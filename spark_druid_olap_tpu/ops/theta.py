@@ -102,3 +102,41 @@ def estimate(state: np.ndarray) -> np.ndarray:
     with np.errstate(divide="ignore", invalid="ignore"):
         est = np.where(full, (k - 1) / np.maximum(frac, 1e-12), count)
     return est
+
+
+def set_op_estimate(fn: str, states) -> np.ndarray:
+    """Estimate |A ∪ B|, |A ∩ B|, or |A \\ B...| per group from KMV states.
+
+    Standard KMV set semantics: clip every sketch to the smallest common
+    threshold theta (the inclusion probability both samples share), apply the
+    set operation on the retained hash samples, scale by 1/theta.  Host-side
+    numpy over result rows (G is result-sized here, not kernel-sized)."""
+    states = [np.asarray(s) for s in states]
+    if len(states) == 0:
+        raise ValueError("set_op_estimate needs at least one state")
+    sent = np.uint32(0xFFFFFFFF)
+
+    def theta_of(s):
+        k = s.shape[-1]
+        count = (s != sent).sum(axis=-1)
+        kth = s[..., -1].astype(np.float64)
+        return np.where(count >= k, (kth + 1.0) / 2.0**32, 1.0)
+
+    th = np.minimum.reduce([theta_of(s) for s in states])
+    G = states[0].shape[0]
+    out = np.zeros(G, dtype=np.float64)
+    for g in range(G):
+        limit = th[g] * 2.0**32
+        sets = [
+            {int(h) for h in s[g] if h != sent and h < limit} for s in states
+        ]
+        if fn == "UNION":
+            acc = set.union(*sets)
+        elif fn == "INTERSECT":
+            acc = set.intersection(*sets)
+        elif fn == "NOT":
+            acc = sets[0].difference(*sets[1:])
+        else:
+            raise ValueError(f"theta set op {fn!r}")
+        out[g] = len(acc) / max(th[g], 1e-12)
+    return out

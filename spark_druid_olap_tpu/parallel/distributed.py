@@ -197,11 +197,15 @@ class DistributedEngine:
                 maxs = lax.pmax(maxs, DATA_AXIS)
             sk_out = {}
             for agg in sketches:
+                # per-agg FILTER mask composes with the row mask (same
+                # contract as the local engine's sketch partials)
+                mfn = la.mask_fns.get(agg.name)
+                amask = mask & mfn(cols) if mfn is not None else mask
                 if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
-                    st = hll_ops.partial_hll(agg, cols, gid_l, mask, Gl)
+                    st = hll_ops.partial_hll(agg, cols, gid_l, amask, Gl)
                     sk_out[agg.name] = lax.pmax(st, DATA_AXIS)
                 else:
-                    st = theta_ops.partial_theta(agg, cols, gid_l, mask, Gl)
+                    st = theta_ops.partial_theta(agg, cols, gid_l, amask, Gl)
                     gathered = lax.all_gather(st, DATA_AXIS)  # [nd, Gl, K]
                     acc = gathered[0]
                     for i in range(1, gathered.shape[0]):

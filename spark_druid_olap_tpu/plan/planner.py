@@ -392,7 +392,14 @@ class Planner:
             tuple(inner_aggs),
             agg.child,
         )
-        inner_rw = self._plan_aggregate(inner, None, 0, [], None, None)
+        try:
+            inner_rw = self._plan_aggregate(inner, None, 0, [], None, None)
+        except RewriteError as e:
+            raise RewriteError(
+                "exact COUNT(DISTINCT) plans its argument as an inner "
+                f"grouping dimension, which failed: {e} (metric-typed "
+                "arguments need count_distinct_mode='approx')"
+            ) from e
         distinct_outs = [
             (name, f"__dist_{col}") for name, col in distinct_outs
         ]

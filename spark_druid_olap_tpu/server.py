@@ -37,6 +37,10 @@ from .models.wire import WireError, query_from_druid
 
 
 def _jsonable(v: Any):
+    import datetime
+
+    import pandas as pd
+
     if isinstance(v, (np.integer,)):
         return int(v)
     if isinstance(v, (np.floating,)):
@@ -44,12 +48,22 @@ def _jsonable(v: Any):
         return None if np.isnan(f) else f
     if isinstance(v, np.datetime64):
         return _ms_to_iso(int(v.astype("datetime64[ms]").astype(np.int64)))
+    if isinstance(v, (pd.Timestamp, datetime.datetime)):
+        # Druid wire format is ISO-8601 with the Z designator, not
+        # str(Timestamp)'s "YYYY-MM-DD HH:MM:SS"
+        return _ms_to_iso(
+            int(np.datetime64(v.replace(tzinfo=None), "ms").astype(np.int64))
+        )
     if isinstance(v, np.bool_):
         return bool(v)
     if isinstance(v, float) and np.isnan(v):
         return None
     if v is None or isinstance(v, (str, int, float, bool)):
         return v
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
     return str(v)
 
 
@@ -91,6 +105,14 @@ def druid_result_shape(q: Q.QuerySpec, df) -> Any:
         ]
     if isinstance(q, Q.SearchQuery):
         return [{"timestamp": _result_timestamp(q), "result": _rows(df)}]
+    if isinstance(q, Q.TimeBoundaryQuery):
+        if df.empty:
+            return []
+        rec = _rows(df)[0]
+        ts = rec.get("minTime", rec.get("maxTime"))
+        return [{"timestamp": ts, "result": rec}]
+    if isinstance(q, Q.SegmentMetadataQuery):
+        return _rows(df)
     return _rows(df)
 
 

@@ -194,3 +194,84 @@ def test_status_metrics_after_query(served):
     st = _get(srv, "/status")
     assert st["last_query_metrics"] is not None
     assert st["last_query_metrics"]["rows_scanned"] == 10_000
+
+
+def test_time_boundary_query(served):
+    ctx, srv, frame = served
+    code, out = _post(srv, "/druid/v2", {"queryType": "timeBoundary", "dataSource": "ev"})
+    assert code == 200 and len(out) == 1
+    res = out[0]["result"]
+    assert "minTime" in res and "maxTime" in res
+    assert res["minTime"].startswith("2021-01-01")
+    # bound=maxTime returns only the max
+    code, out = _post(
+        srv, "/druid/v2",
+        {"queryType": "timeBoundary", "dataSource": "ev", "bound": "maxTime"},
+    )
+    assert code == 200
+    assert "maxTime" in out[0]["result"] and "minTime" not in out[0]["result"]
+
+
+def test_segment_metadata_query(served):
+    ctx, srv, frame = served
+    code, out = _post(
+        srv, "/druid/v2", {"queryType": "segmentMetadata", "dataSource": "ev"}
+    )
+    assert code == 200
+    assert len(out) == len(ctx.catalog.get("ev").segments)
+    seg = out[0]
+    assert seg["numRows"] > 0
+    assert seg["columns"]["city"]["type"] == "dimension"
+    assert seg["columns"]["city"]["cardinality"] == 4
+    assert seg["columns"]["v"]["type"] == "metric"
+    assert seg["intervals"] and "/" in seg["intervals"][0]
+
+
+def test_theta_set_op_post_agg(served):
+    """UNION/INTERSECT/NOT estimates over two theta sketches, checked against
+    exact set algebra on the generated data."""
+    ctx, srv, frame = served
+    ds = ctx.catalog.get("ev")
+    seg = ds.segments[0]
+    k = np.asarray(seg.metrics["k"])[seg.valid]
+    city_codes = np.asarray(seg.dims["city"])[seg.valid]
+    city_vals = np.asarray(ds.dicts["city"].decode(city_codes), dtype=object)
+    ny = set(k[city_vals == "NY"].tolist())
+    sf = set(k[city_vals == "SF"].tolist())
+    q = {
+        "queryType": "groupBy",
+        "dataSource": "ev",
+        "dimensions": [],
+        "granularity": "all",
+        "intervals": ["2020-01-01T00:00:00.000Z/2022-01-01T00:00:00.000Z"],
+        "aggregations": [
+            {"type": "filtered",
+             "filter": {"type": "selector", "dimension": "city", "value": "NY"},
+             "aggregator": {"type": "thetaSketch", "name": "ny_k", "fieldName": "k", "size": 4096}},
+            {"type": "filtered",
+             "filter": {"type": "selector", "dimension": "city", "value": "SF"},
+             "aggregator": {"type": "thetaSketch", "name": "sf_k", "fieldName": "k", "size": 4096}},
+        ],
+        "postAggregations": [
+            {"type": "thetaSketchEstimate", "name": "union_k",
+             "field": {"type": "thetaSketchSetOp", "name": "u", "func": "UNION",
+                        "fields": [{"type": "fieldAccess", "fieldName": "ny_k"},
+                                   {"type": "fieldAccess", "fieldName": "sf_k"}]}},
+            {"type": "thetaSketchEstimate", "name": "inter_k",
+             "field": {"type": "thetaSketchSetOp", "name": "i", "func": "INTERSECT",
+                        "fields": [{"type": "fieldAccess", "fieldName": "ny_k"},
+                                   {"type": "fieldAccess", "fieldName": "sf_k"}]}},
+            {"type": "thetaSketchEstimate", "name": "not_k",
+             "field": {"type": "thetaSketchSetOp", "name": "n", "func": "NOT",
+                        "fields": [{"type": "fieldAccess", "fieldName": "ny_k"},
+                                   {"type": "fieldAccess", "fieldName": "sf_k"}]}},
+        ],
+    }
+    code, out = _post(srv, "/druid/v2", q)
+    assert code == 200, out
+    ev = out[0]["event"]
+    # 500-value domain, K=4096 slots: sketches are exact below K (bar 32-bit
+    # hash collisions, negligible at this size)
+    assert abs(ev["union_k"] - len(ny | sf)) <= 2
+    assert abs(ev["inter_k"] - len(ny & sf)) <= 2
+    assert abs(ev["not_k"] - len(ny - sf)) <= 2
