@@ -185,12 +185,9 @@ class Engine:
             yield batch
 
     def _lowering_for(self, q: Q.GroupByQuery, ds: DataSource):
-        key = _query_key(q, ds)
-        lowering = self._lowering_cache.get(key)
-        if lowering is None:
-            lowering = lower_groupby(q, ds)
-            self._lowering_cache[key] = lowering
-        return lowering
+        from .lowering import cached_lowering
+
+        return cached_lowering(self._lowering_cache, q, ds)
 
     def _cols_for_segment(self, seg: Segment, ds: DataSource, names):
         cols = self._device_cols(seg, names)

@@ -444,6 +444,18 @@ def topn_to_groupby(q: Q.TopNQuery) -> Q.GroupByQuery:
     )
 
 
+def cached_lowering(cache, q: Q.GroupByQuery, ds: DataSource) -> "GroupByLowering":
+    """Shared lowering-cache lookup (local + distributed engines): lowering
+    stages device constants, so rebuilding it per execution pays one blocking
+    H2D transfer per constant."""
+    key = _query_key(q, ds)
+    lowering = cache.get(key)
+    if lowering is None:
+        lowering = lower_groupby(q, ds)
+        cache[key] = lowering
+    return lowering
+
+
 def lower_groupby(q: Q.GroupByQuery, ds: DataSource) -> GroupByLowering:
     dims = _resolve_dims(q.dimensions, ds, q.intervals)
     la = _lower_aggs(q.aggregations, ds)

@@ -83,6 +83,15 @@ class DistributedEngine:
         # compiled SPMD program cache (query shape x schema x local rows);
         # without it every execute() re-traces and re-compiles the shard_map
         self._spmd_cache = CountBudgetCache(program_cache_entries)
+        # lowering cache: rebuilding a lowering stages device constants
+        # (dictionary remaps, bucket tables) — one blocking H2D per constant
+        # on every execution without it (same as exec/engine.py)
+        self._lowering_cache = CountBudgetCache(program_cache_entries)
+
+    def _lowering_for(self, q: Q.GroupByQuery, ds: DataSource):
+        from ..exec.lowering import cached_lowering
+
+        return cached_lowering(self._lowering_cache, q, ds)
 
     # -- host-side row-shard assembly ---------------------------------------
 
@@ -144,6 +153,8 @@ class DistributedEngine:
 
     def clear_cache(self):
         self._shard_cache.clear()
+        self._lowering_cache.clear()
+        self._spmd_cache.clear()
 
     # -- SPMD program --------------------------------------------------------
 
@@ -154,11 +165,9 @@ class DistributedEngine:
         Cached on (query shape, schema signature, local rows, mesh shape):
         jit's compilation cache is keyed on callable identity, so rebuilding
         the closure per query would recompile every time."""
-        import json as _json
+        from ..exec.lowering import _query_key
 
-        cache_key = (
-            _json.dumps(lowering.query.to_druid(), sort_keys=True, default=str),
-            schema_signature(ds),
+        cache_key = _query_key(lowering.query, ds) + (
             local_rows,
             tuple(sorted(self.mesh.shape.items())),
         )
@@ -240,6 +249,35 @@ class DistributedEngine:
             df = self.execute(topn_to_groupby(q), ds)
             return finalize_topn(df, q)
         assert isinstance(q, Q.GroupByQuery), type(q)
+        # idempotent re-dispatch on transient device failure, mirroring
+        # exec/engine.py (queries are read-only; SURVEY.md §5 failure row)
+        q = groupby_with_time_granularity(q)
+        try:
+            return self._execute_groupby_once(q, ds)
+        except NotImplementedError:
+            raise
+        except RuntimeError as err:
+            from ..utils.log import get_logger
+
+            get_logger("parallel.distributed").warning(
+                "transient device failure (%s: %s); evicting shards and "
+                "re-dispatching once",
+                type(err).__name__,
+                err,
+            )
+            from ..exec.lowering import _query_key
+
+            qkey = _query_key(q, ds)
+            self._lowering_cache.pop(qkey)
+            # spmd keys are _query_key + (local_rows, mesh): evict only this
+            # query's programs, not every cached query's compile
+            for k in [k for k in self._spmd_cache if k[:2] == qkey]:
+                self._spmd_cache.pop(k)
+            for k in [k for k in self._shard_cache if k[0] == ds.name]:
+                self._shard_cache.pop(k)
+            return self._execute_groupby_once(q, ds)
+
+    def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
         import time as _time
 
         from ..config import SessionConfig
@@ -247,9 +285,8 @@ class DistributedEngine:
         from ..plan.cost import groupby_state_bytes
 
         t_total = _time.perf_counter()
-        q = groupby_with_time_granularity(q)
 
-        lowering = lower_groupby(q, ds)
+        lowering = self._lowering_for(q, ds)
         m = QueryMetrics(
             query_type="groupBy",
             strategy="dense",

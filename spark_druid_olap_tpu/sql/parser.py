@@ -434,10 +434,18 @@ class Parser:
 
     def _case(self) -> E.Expr:
         self.expect_kw("case")
+        # simple form: CASE operand WHEN value THEN ... (desugars to the
+        # searched form with operand == value conditions)
+        operand: Optional[E.Expr] = None
+        t = self.peek()
+        if not (t.kind == "KW" and t.value.lower() in ("when", "else", "end")):
+            operand = self.expr()
         whens: List[Tuple[E.Expr, E.Expr]] = []
         otherwise: E.Expr = E.Literal(None)
         while self.accept_kw("when"):
             c = self.expr()
+            if operand is not None:
+                c = E.Comparison("==", operand, c)
             self.expect_kw("then")
             v = self.expr()
             whens.append((c, v))

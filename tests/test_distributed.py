@@ -108,3 +108,33 @@ def test_dp8_topn(dist8, ssb_ds):
     want = Engine().execute(q, ssb_ds)
     assert list(got.c_city) == list(want.c_city)
     np.testing.assert_allclose(got.rev, want.rev, rtol=1e-5)
+
+
+def test_distributed_transient_retry(lineitem_ds):
+    """A transient RuntimeError in the SPMD path evicts shards/programs and
+    re-dispatches once (mirror of the local engine's retry)."""
+    dist = DistributedEngine(mesh=make_mesh(n_data=8))
+    q = _q1()
+    # make the SPMD program fail exactly once via the builder
+    calls = {"n": 0}
+    orig = DistributedEngine._spmd_fn
+
+    def flaky(self, lowering, local_rows, ds, col_keys):
+        fn = orig(self, lowering, local_rows, ds, col_keys)
+        if calls["n"] == 0:
+            def poisoned(cols):
+                calls["n"] += 1
+                raise RuntimeError("injected transient SPMD failure")
+
+            return poisoned
+        return fn
+
+    dist._spmd_fn = flaky.__get__(dist)
+    got = dist.execute(q, lineitem_ds)
+    want = Engine().execute(q, lineitem_ds)
+    assert calls["n"] == 1  # poisoned program ran exactly once
+    key = [d.name for d in q.dimensions]
+    got = got.sort_values(key).reset_index(drop=True)
+    want = want.sort_values(key).reset_index(drop=True)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["sum_qty"], want["sum_qty"], rtol=1e-5)

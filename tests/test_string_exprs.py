@@ -259,3 +259,22 @@ def test_like_and_not_like_in_case_position():
     )
     np.testing.assert_allclose(float(got["a"][0]), float(v[[0, 1, 4, 7]].sum()), rtol=1e-6)
     np.testing.assert_allclose(float(got["b"][0]), float(v[[2, 6]].sum()), rtol=1e-6)
+
+
+def test_simple_case_form():
+    """CASE operand WHEN value THEN ... desugars to searched form with
+    operand == value (including string dims via code translation)."""
+    c, vals, v = _null_ctx()
+    got = c.sql(
+        "SELECT sum(CASE s WHEN 'AA' THEN v WHEN 'BB' THEN 0 - v ELSE 0 END) AS x FROM nt"
+    )
+    want = float(v[[0, 4]].sum() - v[[2, 6]].sum())
+    np.testing.assert_allclose(float(got["x"][0]), want, rtol=1e-6)
+
+
+def test_nullif_rejected():
+    """NULL-producing expressions have no device value representation yet;
+    NULLIF must be a loud unknown-function error, never silent wrong data."""
+    c, vals, v = _null_ctx()
+    with pytest.raises(Exception, match="(?i)nullif"):
+        c.sql("SELECT sum(NULLIF(v, 1)) AS x FROM nt")
