@@ -53,6 +53,11 @@ class TPUOlapContext:
         from .utils.lru import CountBudgetCache
 
         self._plan_cache = CountBudgetCache(256)
+        # result-level cache (Druid broker result cache analog): identical
+        # (query, schema) pairs skip execution entirely
+        self._result_cache = CountBudgetCache(
+            max(self.config.result_cache_entries, 1)
+        )
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -137,6 +142,7 @@ class TPUOlapContext:
         self.catalog.clear()
         self.engine.clear_cache()
         self._plan_cache.clear()
+        self._result_cache.clear()
         if self._dist_engine is not None:
             self._dist_engine.clear_cache()
 
@@ -176,9 +182,13 @@ class TPUOlapContext:
 
     def explain_analyze(self, sql_text: str):
         """EXPLAIN ANALYZE analog: run the query, return (DataFrame,
-        explain text + measured QueryMetrics)."""
-        df = self.sql(sql_text)
-        text = self.explain(sql_text)
+        explain text + measured QueryMetrics).  Bypasses the result cache —
+        the metrics must describe THIS execution, not a cache lookup."""
+        lp, _, _ = parse_sql(sql_text)
+        planner = self._planner()
+        rw = planner.plan(lp)
+        df = self.execute_rewrite(rw, use_result_cache=False)
+        text = planner.explain(lp)
         m = self.last_metrics
         if m is not None:
             text += "\n\n== Execution Metrics ==\n" + m.describe()
@@ -216,7 +226,7 @@ class TPUOlapContext:
         self._plan_cache[key] = rw
         return self.execute_rewrite(rw)
 
-    def execute_rewrite(self, rw: Rewrite):
+    def execute_rewrite(self, rw: Rewrite, use_result_cache: bool = True):
         import pandas as pd
 
         if rw.exact_distinct is not None:
@@ -224,8 +234,25 @@ class TPUOlapContext:
         ds = self.catalog.get(rw.datasource)
         if ds is None:
             raise RewriteError(f"unknown table {rw.datasource!r}")
-        engine = self._engine_for(rw)
 
+        rkey = None
+        if use_result_cache and self.config.result_cache_entries > 0:
+            from .exec.lowering import schema_signature
+
+            rkey = (
+                rw.to_json(),
+                schema_signature(ds),
+                repr(rw.output_columns),
+                repr(rw.grouping_sets),
+                repr(rw.host_post_exprs),
+                repr(rw.residual_having),
+                repr(self.config),
+            )
+            hit = self._result_cache.get(rkey)
+            if hit is not None:
+                return hit.copy()
+
+        engine = self._engine_for(rw)
         if rw.grouping_sets and isinstance(rw.query, Q.GroupByQuery):
             df = self._execute_grouping_sets(rw, ds, engine)
         else:
@@ -242,6 +269,8 @@ class TPUOlapContext:
             cols = [c for c in rw.output_columns if c in df.columns]
             extra = [c for c in df.columns if c not in cols and c == "__grouping_id"]
             df = df[cols + extra]
+        if rkey is not None:
+            self._result_cache[rkey] = df.copy()
         return df
 
     def _execute_exact_distinct(self, spec):

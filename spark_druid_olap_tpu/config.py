@@ -68,6 +68,12 @@ class SessionConfig:
     mesh_data_axis: Optional[int] = None
     mesh_groups_axis: int = 1
 
+    # result-level cache (the Druid broker's result cache analog: repeated
+    # dashboard queries skip execution entirely).  Entries key on query JSON
+    # + datasource schema signature, so re-ingestion can never serve stale
+    # rows.  0 disables.
+    result_cache_entries: int = 64
+
     @classmethod
     def load_calibrated(cls, path: Optional[str] = None) -> "SessionConfig":
         """SessionConfig with measured cost constants, when a calibration

@@ -169,7 +169,7 @@ class Planner:
         top_projections,
     ) -> Rewrite:
         if self.cfg.count_distinct_mode == "exact" and any(
-            ae.fn == "count_distinct" for ae in agg.agg_exprs
+            _is_count_distinct(ae) for ae in agg.agg_exprs
         ):
             return self._plan_exact_distinct(
                 agg, limit, offset, sort_keys, having_cond, top_projections
@@ -342,7 +342,7 @@ class Planner:
                 raise RewriteError(
                     f"{ae.fn.upper()}(DISTINCT) cannot re-aggregate exactly"
                 )
-            if ae.fn == "count_distinct":
+            if _is_count_distinct(ae):
                 if not isinstance(ae.arg, E.Col):
                     raise RewriteError(
                         "exact COUNT(DISTINCT) over expressions unsupported"
@@ -587,3 +587,9 @@ def _contains_aggregate(n: L.LogicalPlan) -> bool:
 
 def _is_avg_helper(name: str, post_names) -> bool:
     return name.endswith("__sum") or name.endswith("__cnt")
+
+
+def _is_count_distinct(ae: L.AggExpr) -> bool:
+    """Both liftings of COUNT(DISTINCT x): the SQL parser produces
+    fn="count_distinct"; the builder API produces fn="count" + distinct."""
+    return ae.fn == "count_distinct" or (ae.fn == "count" and ae.distinct)

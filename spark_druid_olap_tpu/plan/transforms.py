@@ -351,11 +351,15 @@ def translate_aggregate(
     ):
         if not isinstance(arg, E.Col):
             raise RewriteError("COUNT(DISTINCT) over expressions unsupported")
-        if cfg.count_distinct_mode == "error" and fn == "count":
-            raise RewriteError("exact COUNT(DISTINCT) disabled by config")
+        if (
+            cfg.count_distinct_mode == "error"
+            and fn != "approx_count_distinct"
+        ):
+            # explicit approx_count_distinct() is always allowed; bare
+            # COUNT(DISTINCT) honors the mode (the SQL parser lifts it to
+            # fn="count_distinct", the builder API to fn="count"+distinct)
+            raise RewriteError("COUNT(DISTINCT) disabled by config")
         sketch = cfg.approx_count_distinct_sketch
-        if fn == "approx_count_distinct":
-            sketch = cfg.approx_count_distinct_sketch
         if sketch == "theta":
             return [wrap(A.ThetaSketch(name, arg.name, size=cfg.theta_size))], [], b
         return (

@@ -1,17 +1,29 @@
 """Non-SELECT SQL commands (the reference's parser-extension commands).
 
-Reference parity: SURVEY.md §2 "SQL commands / parser extras" row `[U]` —
-beyond `EXPLAIN DRUID REWRITE` the reference registers a clear-metadata-cache
-command and small DDL helpers.  Here: `CLEAR CACHE`, `DROP TABLE [IF EXISTS]
-t`, and `SHOW TABLES`, dispatched by `TPUOlapContext.sql` before the SELECT
-parser runs.
+Reference parity: SURVEY.md §2 "SQL commands / parser extras" row `[U]` and
+the L6 surface (§1): the reference's registration DDL is
+`CREATE TEMPORARY TABLE t USING org.sparklinedata.druid OPTIONS (...)` plus a
+clear-metadata-cache command and session flags via SQLConf.  Here:
+
+    CREATE [TEMPORARY] TABLE t USING <fmt> OPTIONS (path '...', timeColumn
+        'ts', dimensions 'a,b', metrics 'x', starSchema '<json>',
+        columnMapping '<json>', rowsPerSegment '4194304')
+    DROP TABLE [IF EXISTS] t
+    SHOW TABLES
+    DESCRIBE t | SHOW COLUMNS FROM t
+    SET key = value        -- SessionConfig flags (SQLConf analog)
+    SET                    -- show all flags
+    CLEAR CACHE
+
+Dispatched by `TPUOlapContext.sql` before the SELECT parser runs.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import re
-from typing import Optional
+from typing import Dict, Optional
 
 _CLEAR = re.compile(r"^\s*clear\s+cache\s*;?\s*$", re.IGNORECASE)
 _DROP = re.compile(
@@ -19,13 +31,69 @@ _DROP = re.compile(
     re.IGNORECASE,
 )
 _SHOW = re.compile(r"^\s*show\s+tables\s*;?\s*$", re.IGNORECASE)
+_DESC = re.compile(
+    r"^\s*(describe|desc)\s+(?P<name>[A-Za-z_]\w*)\s*;?\s*$", re.IGNORECASE
+)
+_SHOWCOLS = re.compile(
+    r"^\s*show\s+columns\s+from\s+(?P<name>[A-Za-z_]\w*)\s*;?\s*$",
+    re.IGNORECASE,
+)
+_SET = re.compile(
+    r"^\s*set\s+(?P<key>[A-Za-z_]\w*)\s*=\s*(?P<val>.+?)\s*;?\s*$",
+    re.IGNORECASE,
+)
+_SET_SHOW = re.compile(r"^\s*set\s*;?\s*$", re.IGNORECASE)
+_CREATE = re.compile(
+    r"^\s*create\s+(temporary\s+)?table\s+(?P<name>[A-Za-z_]\w*)\s+"
+    r"using\s+(?P<fmt>[\w.]+)\s+options\s*\((?P<opts>.*)\)\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+# one OPTIONS entry: key 'value' or key "value"
+_OPT_ENTRY = re.compile(
+    r"^\s*([A-Za-z_]\w*)\s+(?:'((?:[^']|'')*)'|\"([^\"]*)\")\s*$"
+)
+
+
+def _split_options(text: str):
+    """Split an OPTIONS(...) body on commas outside quotes; every chunk must
+    match `key 'value'` — malformed entries are rejected, never dropped."""
+    chunks, buf, q = [], [], None
+    for ch in text:
+        if q:
+            buf.append(ch)
+            if ch == q:
+                q = None
+        elif ch in ("'", '"'):
+            q = ch
+            buf.append(ch)
+        elif ch == ",":
+            chunks.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf and "".join(buf).strip():
+        chunks.append("".join(buf))
+    out = {}
+    for c in chunks:
+        m = _OPT_ENTRY.match(c)
+        if not m:
+            raise ValueError(
+                f"malformed OPTIONS entry {c.strip()!r}: expected key 'value'"
+            )
+        k, a, b = m.group(1), m.group(2), m.group(3)
+        out[k] = (a if a is not None else b).replace("''", "'")
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
 class Command:
-    kind: str  # "clear_cache" | "drop_table" | "show_tables"
+    kind: str
     table: Optional[str] = None
     if_exists: bool = False
+    key: Optional[str] = None
+    value: Optional[str] = None
+    options: Optional[Dict[str, str]] = None
+    fmt: Optional[str] = None
 
 
 def parse_command(sql: str) -> Optional[Command]:
@@ -38,7 +106,46 @@ def parse_command(sql: str) -> Optional[Command]:
         )
     if _SHOW.match(sql):
         return Command("show_tables")
+    m = _DESC.match(sql) or _SHOWCOLS.match(sql)
+    if m:
+        return Command("describe", table=m.group("name"))
+    if _SET_SHOW.match(sql):
+        return Command("set_show")
+    m = _SET.match(sql)
+    if m:
+        return Command("set", key=m.group("key"), value=m.group("val"))
+    m = _CREATE.match(sql)
+    if m:
+        opts = _split_options(m.group("opts"))
+        return Command(
+            "create_table",
+            table=m.group("name"),
+            options=opts,
+            fmt=m.group("fmt").lower(),
+        )
     return None
+
+
+def _coerce_flag(cfg, key: str, raw: str):
+    fields = {f.name: f for f in dataclasses.fields(cfg)}
+    if key not in fields:
+        raise KeyError(
+            f"unknown session flag {key!r}; flags: {sorted(fields)}"
+        )
+    raw = raw.strip().strip("'\"")
+    # coerce by the declared field type, not the current value: Optional
+    # fields default to None, and `isinstance(None, int)` would fall through
+    # to storing a raw string
+    ann = str(fields[key].type)
+    if raw.lower() in ("none", "null"):
+        return None
+    if "bool" in ann:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if "int" in ann:
+        return int(raw)
+    if "float" in ann:
+        return float(raw)
+    return raw
 
 
 def run_command(ctx, cmd: Command):
@@ -54,4 +161,68 @@ def run_command(ctx, cmd: Command):
         return pd.DataFrame({"status": [f"dropped {cmd.table}"]})
     if cmd.kind == "show_tables":
         return pd.DataFrame({"table": sorted(ctx.catalog.tables())})
+    if cmd.kind == "describe":
+        ds = ctx.catalog.get(cmd.table)
+        if ds is None:
+            raise KeyError(f"table {cmd.table!r} does not exist")
+        return pd.DataFrame(
+            {
+                "column": [c.name for c in ds.columns],
+                "kind": [c.kind for c in ds.columns],
+                "dtype": [c.dtype for c in ds.columns],
+                "cardinality": [c.cardinality for c in ds.columns],
+            }
+        )
+    if cmd.kind == "set_show":
+        items = sorted(dataclasses.asdict(ctx.config).items())
+        return pd.DataFrame(
+            {"key": [k for k, _ in items], "value": [str(v) for _, v in items]}
+        )
+    if cmd.kind == "set":
+        val = _coerce_flag(ctx.config, cmd.key, cmd.value)
+        setattr(ctx.config, cmd.key, val)
+        if cmd.key == "result_cache_entries":
+            # the cache object was sized at construction; resize live
+            ctx._result_cache.budget_entries = max(int(val), 1)
+        return pd.DataFrame({"status": [f"set {cmd.key}={val}"]})
+    if cmd.kind == "create_table":
+        if cmd.fmt not in ("csv", "parquet", "tpu_olap"):
+            raise ValueError(
+                f"CREATE TABLE USING {cmd.fmt!r}: supported providers are "
+                "'csv', 'parquet', 'tpu_olap'"
+            )
+        opts = dict(cmd.options or {})
+        path = opts.pop("path", None)
+        if path is None:
+            raise ValueError("CREATE TABLE ... OPTIONS requires path '...'")
+        if cmd.fmt in ("csv", "parquet") and not path.lower().endswith(
+            "." + cmd.fmt
+        ):
+            raise ValueError(
+                f"USING {cmd.fmt} but path {path!r} has a different "
+                "extension (use USING tpu_olap to ingest by extension)"
+            )
+        kwargs = {}
+        if "timeColumn" in opts:
+            kwargs["time_column"] = opts.pop("timeColumn")
+        if "dimensions" in opts:
+            kwargs["dimensions"] = [
+                s.strip() for s in opts.pop("dimensions").split(",") if s.strip()
+            ]
+        if "metrics" in opts:
+            kwargs["metrics"] = [
+                s.strip() for s in opts.pop("metrics").split(",") if s.strip()
+            ]
+        if "starSchema" in opts:
+            kwargs["star_schema"] = json.loads(opts.pop("starSchema"))
+        if "columnMapping" in opts:
+            kwargs["column_mapping"] = json.loads(opts.pop("columnMapping"))
+        if "rowsPerSegment" in opts:
+            kwargs["rows_per_segment"] = int(opts.pop("rowsPerSegment"))
+        if opts:
+            raise ValueError(f"unknown CREATE TABLE options: {sorted(opts)}")
+        ds = ctx.register_table(cmd.table, path, **kwargs)
+        return pd.DataFrame(
+            {"status": [f"created {cmd.table} ({ds.num_rows} rows)"]}
+        )
     raise ValueError(cmd.kind)

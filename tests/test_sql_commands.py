@@ -48,3 +48,112 @@ def test_clear_cache(ctx):
 def test_select_still_works_after_command_dispatch(ctx):
     out = ctx.sql("SELECT count(*) AS n FROM b")
     assert int(out["n"][0]) == 1000
+
+
+def test_describe(ctx):
+    df = ctx.sql("DESCRIBE a")
+    assert list(df["column"]) == ["d", "v"]
+    assert list(df["kind"]) == ["dimension", "metric"]
+    df2 = ctx.sql("SHOW COLUMNS FROM a")
+    assert list(df2["column"]) == list(df["column"])
+    with pytest.raises(KeyError):
+        ctx.sql("DESCRIBE nope")
+
+
+def test_set_flag(ctx):
+    out = ctx.sql("SET count_distinct_mode = 'exact'")
+    assert "exact" in out["status"][0]
+    assert ctx.config.count_distinct_mode == "exact"
+    ctx.sql("SET prefer_distributed = false")
+    assert ctx.config.prefer_distributed is False
+    ctx.sql("SET hll_precision = 12")
+    assert ctx.config.hll_precision == 12
+    with pytest.raises(KeyError):
+        ctx.sql("SET not_a_flag = 1")
+    # bare SET lists every flag
+    allf = ctx.sql("SET")
+    assert "count_distinct_mode" in list(allf["key"])
+
+
+def test_set_invalidates_plan_cache(ctx):
+    """Flipping a flag must change planning for already-seen SQL."""
+    sql = "SELECT count(DISTINCT d) AS n FROM a"
+    ctx.sql("SET count_distinct_mode = 'approx'")
+    ctx.sql(sql)  # populate plan cache under approx
+    ctx.sql("SET count_distinct_mode = 'error'")
+    with pytest.raises(Exception):
+        ctx.sql(sql)
+
+
+def test_create_table_using_options(ctx, tmp_path):
+    import pandas as pd
+
+    p = tmp_path / "t.csv"
+    pd.DataFrame(
+        {
+            "city": ["NY", "SF", "NY", "LA"],
+            "ts": pd.to_datetime(
+                ["2021-01-01", "2021-01-02", "2021-01-03", "2021-01-04"]
+            ),
+            "v": [1.0, 2.0, 3.0, 4.0],
+        }
+    ).to_csv(p, index=False)
+    out = ctx.sql(
+        f"CREATE TABLE ev USING csv OPTIONS (path '{p}', timeColumn 'ts', "
+        "dimensions 'city', metrics 'v', rowsPerSegment '1024')"
+    )
+    assert "created ev" in out["status"][0]
+    df = ctx.sql("SELECT city, sum(v) AS s FROM ev GROUP BY city ORDER BY city")
+    assert list(df["city"]) == ["LA", "NY", "SF"]
+    assert list(df["s"]) == [4.0, 4.0, 2.0]
+    with pytest.raises(ValueError):
+        ctx.sql("CREATE TABLE x USING csv OPTIONS (nope 'y')")
+
+
+def test_result_cache_hits_and_invalidates(ctx):
+    sql = "SELECT d, sum(v) AS s FROM a GROUP BY d ORDER BY d"
+    r1 = ctx.sql(sql)
+    r2 = ctx.sql(sql)  # served from the result cache
+    assert r1.equals(r2)
+    # mutating the returned frame must not poison the cache (copies)
+    r2["s"] = 0.0
+    r3 = ctx.sql(sql)
+    assert r3.equals(r1)
+    # re-registration (new schema signature) invalidates
+    rng = np.random.default_rng(1)
+    ctx.register_table(
+        "a",
+        {
+            "d": rng.integers(0, 4, 500).astype(np.int64),
+            "v": np.ones(500, np.float32),
+        },
+        dimensions=["d"],
+        metrics=["v"],
+    )
+    r4 = ctx.sql(sql)
+    assert float(r4["s"].sum()) == 500.0
+
+
+def test_set_optional_int_coerces(ctx):
+    ctx.sql("SET mesh_data_axis = 4")
+    assert ctx.config.mesh_data_axis == 4  # int, not the string '4'
+    ctx.sql("SET mesh_data_axis = none")
+    assert ctx.config.mesh_data_axis is None
+
+
+def test_create_table_rejects_malformed_options(ctx):
+    with pytest.raises(ValueError, match="malformed OPTIONS"):
+        ctx.sql("CREATE TABLE x USING csv OPTIONS (path '/a.csv', rowsPerSegment 1024)")
+    with pytest.raises(ValueError, match="supported providers"):
+        ctx.sql("CREATE TABLE x USING orc OPTIONS (path '/a.orc')")
+    with pytest.raises(ValueError, match="different\\s+extension"):
+        ctx.sql("CREATE TABLE x USING parquet OPTIONS (path '/a.csv')")
+
+
+def test_explain_analyze_bypasses_result_cache(ctx):
+    sql = "SELECT d, count(*) AS n FROM b GROUP BY d"
+    ctx.sql(sql)  # populate result cache
+    df, report = ctx.explain_analyze(sql)
+    assert "Execution Metrics" in report
+    m = ctx.last_metrics
+    assert m is not None and m.query_type == "groupBy"
