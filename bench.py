@@ -49,11 +49,17 @@ def _calibrated_ctx():
     """Context with measured cost constants (plan/calibrate.py): the planner
     then picks kernel strategy + mesh from numbers measured on THIS backend
     (e.g. on CPU the scatter kernel beats the MXU-shaped one-hot by ~200x,
-    and the calibrated model routes accordingly)."""
+    and the calibrated model routes accordingly).
+
+    The result-level cache is DISABLED: the benchmark measures engine
+    execution, and repeated reps would otherwise be served from the cache
+    (the Druid-benchmark useCache=false convention)."""
     import spark_druid_olap_tpu as sd
     from spark_druid_olap_tpu.config import SessionConfig
 
-    return sd.TPUOlapContext(SessionConfig.load_calibrated())
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0
+    return sd.TPUOlapContext(cfg)
 
 
 def _ensure_calibration():

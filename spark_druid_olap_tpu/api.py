@@ -134,6 +134,25 @@ class TPUOlapContext:
         self.catalog.put(ds, star_schema)
         return ds
 
+    def save_table(self, name: str, directory: str) -> str:
+        """Persist a registered datasource (encoded segments + dictionaries
+        + star schema) to a directory; `load_table` or `CREATE TABLE ...
+        USING tpu_olap OPTIONS (path '<dir>')` restores it without
+        re-ingest/re-encode (the Druid-index-as-persistence analog)."""
+        from .catalog.persist import save_datasource
+
+        ds = self.catalog.get(name)
+        if ds is None:
+            raise KeyError(f"table {name!r} does not exist")
+        return save_datasource(ds, directory, self.catalog.star_schema(name))
+
+    def load_table(self, directory: str, name: Optional[str] = None):
+        from .catalog.persist import load_datasource
+
+        ds, star = load_datasource(directory, name=name)
+        self.catalog.put(ds, star)
+        return ds
+
     def drop_table(self, name: str):
         self.catalog.drop(name)
 
@@ -230,7 +249,9 @@ class TPUOlapContext:
         import pandas as pd
 
         if rw.exact_distinct is not None:
-            return self._execute_exact_distinct(rw.exact_distinct)
+            return self._execute_exact_distinct(
+                rw.exact_distinct, use_result_cache=use_result_cache
+            )
         ds = self.catalog.get(rw.datasource)
         if ds is None:
             raise RewriteError(f"unknown table {rw.datasource!r}")
@@ -273,14 +294,16 @@ class TPUOlapContext:
             self._result_cache[rkey] = df.copy()
         return df
 
-    def _execute_exact_distinct(self, spec):
+    def _execute_exact_distinct(self, spec, use_result_cache: bool = True):
         """Two-phase exact COUNT(DISTINCT): run the inner rewrite (grouped by
         dims + distinct columns on device), then re-aggregate on host —
         the reference's pushHLLTODruid=false shape, where Spark finished the
         distinct exactly after the Druid scan."""
         import pandas as pd
 
-        inner = self.execute_rewrite(spec.inner)
+        inner = self.execute_rewrite(
+            spec.inner, use_result_cache=use_result_cache
+        )
         agg_kwargs = {
             name: pd.NamedAgg(column=name, aggfunc=op)
             for name, op in spec.outer_ops

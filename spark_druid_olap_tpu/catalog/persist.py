@@ -1,0 +1,147 @@
+"""Datasource persistence: save/load encoded segments to a directory.
+
+Reference parity: Druid's index IS its persistence — the reference never
+re-ingests because the segments live on historical disks (SURVEY.md §5
+checkpoint row: "the state is the Druid index itself").  The local analog:
+a registered datasource (dictionary-encoded columns + padding + dictionaries
++ star schema) round-trips to disk, so a session restart skips re-ingest and
+re-encode entirely.
+
+Layout of `<dir>/`:
+    meta.json             name, schema, time column, dictionaries, star JSON
+    segment_<i>.npz       per-segment arrays: dims/metrics/time/valid
+
+Dictionary values serialize into meta.json (string domains) or as int lists
+(numeric-rank domains).  Arrays are written padded exactly as registered, so
+a loaded datasource has byte-identical segments — compiled-program cache keys
+(schema_signature) differ only by the fresh segment uids, which is correct:
+device residency must not alias across loads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .segment import ColumnMeta, DataSource, DimensionDict, Segment
+from .star import StarSchemaInfo
+
+_FORMAT_VERSION = 1
+
+
+def save_datasource(
+    ds: DataSource, directory: str, star: Optional[StarSchemaInfo] = None
+) -> str:
+    os.makedirs(directory, exist_ok=True)
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "name": ds.name,
+        "time_column": ds.time_column,
+        "columns": [
+            {
+                "name": c.name,
+                "kind": c.kind,
+                "dtype": c.dtype,
+                "cardinality": c.cardinality,
+            }
+            for c in ds.columns
+        ],
+        "dicts": {
+            name: {
+                "numeric": d.numeric_values is not None,
+                "values": [
+                    int(v) if isinstance(v, (int, np.integer)) else str(v)
+                    for v in d.values
+                ],
+            }
+            for name, d in ds.dicts.items()
+        },
+        "segments": [
+            {
+                "segment_id": s.segment_id,
+                "num_rows": s.num_rows,
+                "interval": list(s.interval) if s.interval else None,
+                "time_name": s.time_name,
+            }
+            for s in ds.segments
+        ],
+        "star_schema": star.to_json() if star is not None else None,
+    }
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    for i, seg in enumerate(ds.segments):
+        arrays = {f"dim__{k}": np.asarray(v) for k, v in seg.dims.items()}
+        arrays.update(
+            {f"met__{k}": np.asarray(v) for k, v in seg.metrics.items()}
+        )
+        arrays["valid"] = np.asarray(seg.valid)
+        if seg.time is not None:
+            arrays["time"] = np.asarray(seg.time)
+        np.savez(os.path.join(directory, f"segment_{i:06d}.npz"), **arrays)
+    return directory
+
+
+def load_datasource(
+    directory: str, name: Optional[str] = None
+) -> Tuple[DataSource, Optional[StarSchemaInfo]]:
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported datasource format {meta.get('format_version')!r}"
+        )
+    dicts = {
+        dim: DimensionDict(
+            values=tuple(
+                int(v) if spec["numeric"] else str(v)
+                for v in spec["values"]
+            )
+        )
+        for dim, spec in meta["dicts"].items()
+    }
+    columns = tuple(
+        ColumnMeta(c["name"], c["kind"], c["dtype"], c["cardinality"])
+        for c in meta["columns"]
+    )
+    segments = []
+    for i, sm in enumerate(meta["segments"]):
+        with np.load(os.path.join(directory, f"segment_{i:06d}.npz")) as z:
+            dims = {
+                k[len("dim__"):]: z[k] for k in z.files if k.startswith("dim__")
+            }
+            metrics = {
+                k[len("met__"):]: z[k] for k in z.files if k.startswith("met__")
+            }
+            valid = z["valid"]
+            time = z["time"] if "time" in z.files else None
+        from .segment import _SEGMENT_UIDS
+
+        segments.append(
+            Segment(
+                segment_id=sm["segment_id"],
+                num_rows=int(sm["num_rows"]),
+                dims=dims,
+                metrics=metrics,
+                time=time,
+                valid=valid,
+                interval=tuple(sm["interval"]) if sm["interval"] else None,
+                time_name=sm.get("time_name"),
+                uid=next(_SEGMENT_UIDS),
+            )
+        )
+    ds = DataSource(
+        name=name or meta["name"],
+        columns=columns,
+        dicts=dicts,
+        segments=tuple(segments),
+        time_column=meta["time_column"],
+    )
+    star = (
+        StarSchemaInfo.from_json(meta["star_schema"])
+        if meta.get("star_schema")
+        else None
+    )
+    return ds, star

@@ -138,6 +138,10 @@ def _coerce_flag(cfg, key: str, raw: str):
     # to storing a raw string
     ann = str(fields[key].type)
     if raw.lower() in ("none", "null"):
+        if "Optional" not in ann and "None" not in ann:
+            raise ValueError(
+                f"session flag {key!r} ({ann}) does not accept none"
+            )
         return None
     if "bool" in ann:
         return raw.lower() in ("1", "true", "yes", "on")
@@ -182,8 +186,18 @@ def run_command(ctx, cmd: Command):
         val = _coerce_flag(ctx.config, cmd.key, cmd.value)
         setattr(ctx.config, cmd.key, val)
         if cmd.key == "result_cache_entries":
-            # the cache object was sized at construction; resize live
-            ctx._result_cache.budget_entries = max(int(val), 1)
+            # the cache object was sized at construction; resize live, and
+            # release held results when shrinking/disabling (eviction only
+            # happens on insert, which a 0 budget would never see again)
+            n = int(val)
+            ctx._result_cache.budget_entries = max(n, 1)
+            if n <= 0:
+                ctx._result_cache.clear()
+            else:
+                while len(ctx._result_cache) > n:
+                    for k in ctx._result_cache:
+                        ctx._result_cache.pop(k)
+                        break
         return pd.DataFrame({"status": [f"set {cmd.key}={val}"]})
     if cmd.kind == "create_table":
         if cmd.fmt not in ("csv", "parquet", "tpu_olap"):
@@ -201,6 +215,20 @@ def run_command(ctx, cmd: Command):
             raise ValueError(
                 f"USING {cmd.fmt} but path {path!r} has a different "
                 "extension (use USING tpu_olap to ingest by extension)"
+            )
+        import os
+
+        if cmd.fmt == "tpu_olap" and os.path.isdir(path):
+            # a saved-datasource directory (catalog/persist.py): restore
+            # encoded segments directly, no re-ingest
+            if opts:
+                raise ValueError(
+                    "saved-datasource load takes no options besides path; "
+                    f"got {sorted(opts)}"
+                )
+            ds = ctx.load_table(path, name=cmd.table)
+            return pd.DataFrame(
+                {"status": [f"loaded {cmd.table} ({ds.num_rows} rows)"]}
             )
         kwargs = {}
         if "timeColumn" in opts:

@@ -157,3 +157,17 @@ def test_explain_analyze_bypasses_result_cache(ctx):
     assert "Execution Metrics" in report
     m = ctx.last_metrics
     assert m is not None and m.query_type == "groupBy"
+
+
+def test_set_none_only_for_optional(ctx):
+    with pytest.raises(ValueError, match="does not accept none"):
+        ctx.sql("SET result_cache_entries = none")
+    assert isinstance(ctx.config.result_cache_entries, int)
+
+
+def test_set_result_cache_zero_releases_entries(ctx):
+    ctx.sql("SET result_cache_entries = 64")
+    ctx.sql("SELECT d, sum(v) AS s FROM a GROUP BY d")
+    assert len(ctx._result_cache) >= 1
+    ctx.sql("SET result_cache_entries = 0")
+    assert len(ctx._result_cache) == 0
