@@ -150,6 +150,10 @@ class TPUOlapContext:
         from .catalog.persist import load_datasource
 
         ds, star = load_datasource(directory, name=name)
+        # drop first: put() only overwrites the star when one is provided,
+        # and a star-less load over an existing starred table must not keep
+        # the stale star (it describes different data)
+        self.catalog.drop(ds.name)
         self.catalog.put(ds, star)
         return ds
 

@@ -35,6 +35,10 @@ _FORMAT_VERSION = 1
 def save_datasource(
     ds: DataSource, directory: str, star: Optional[StarSchemaInfo] = None
 ) -> str:
+    """Write segments first and meta.json last (tmp+rename): meta is the
+    commit point, so an interrupted save can never pair new dictionaries
+    with old rank-coded arrays (silently wrong decodes).  Stale segment
+    files beyond the new count are removed."""
     os.makedirs(directory, exist_ok=True)
     meta = {
         "format_version": _FORMAT_VERSION,
@@ -70,8 +74,6 @@ def save_datasource(
         ],
         "star_schema": star.to_json() if star is not None else None,
     }
-    with open(os.path.join(directory, "meta.json"), "w") as f:
-        json.dump(meta, f)
     for i, seg in enumerate(ds.segments):
         arrays = {f"dim__{k}": np.asarray(v) for k, v in seg.dims.items()}
         arrays.update(
@@ -81,6 +83,18 @@ def save_datasource(
         if seg.time is not None:
             arrays["time"] = np.asarray(seg.time)
         np.savez(os.path.join(directory, f"segment_{i:06d}.npz"), **arrays)
+    for f in os.listdir(directory):  # stale segments from a larger old save
+        if f.startswith("segment_") and f.endswith(".npz"):
+            try:
+                idx = int(f[len("segment_"):-len(".npz")])
+            except ValueError:
+                continue
+            if idx >= len(ds.segments):
+                os.remove(os.path.join(directory, f))
+    tmp = os.path.join(directory, "meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(directory, "meta.json"))
     return directory
 
 
@@ -144,4 +158,11 @@ def load_datasource(
         if meta.get("star_schema")
         else None
     )
+    if star is not None and ds.name != meta["name"]:
+        # loading under a new name: the star's fact reference must follow,
+        # or the collapse check (catalog/star.py fact_table != fact) would
+        # silently reject every star join against the renamed table
+        import dataclasses
+
+        star = dataclasses.replace(star, fact_table=ds.name)
     return ds, star

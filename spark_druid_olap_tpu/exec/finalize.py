@@ -95,7 +95,15 @@ def eval_post_agg(
                 acc = acc * v
             elif p.fn in ("/", "quotient"):
                 with np.errstate(divide="ignore", invalid="ignore"):
-                    acc = np.where(v != 0, acc / np.where(v == 0, 1, v), 0.0)
+                    # x/0 -> 0 is Druid arithmetic-post-agg behavior; but a
+                    # NULL numerator stays NULL (the AVG rewrite over a
+                    # zero-row group divides NaN sum by 0 count and must
+                    # yield SQL NULL, not 0)
+                    acc = np.where(
+                        v != 0,
+                        acc / np.where(v == 0, 1, v),
+                        np.where(np.isnan(acc), np.nan, 0.0),
+                    )
             else:
                 raise ValueError(f"arithmetic fn {p.fn!r}")
         return acc

@@ -186,18 +186,9 @@ def run_command(ctx, cmd: Command):
         val = _coerce_flag(ctx.config, cmd.key, cmd.value)
         setattr(ctx.config, cmd.key, val)
         if cmd.key == "result_cache_entries":
-            # the cache object was sized at construction; resize live, and
-            # release held results when shrinking/disabling (eviction only
-            # happens on insert, which a 0 budget would never see again)
-            n = int(val)
-            ctx._result_cache.budget_entries = max(n, 1)
-            if n <= 0:
-                ctx._result_cache.clear()
-            else:
-                while len(ctx._result_cache) > n:
-                    for k in ctx._result_cache:
-                        ctx._result_cache.pop(k)
-                        break
+            # the cache object was sized at construction; resize live
+            # (evicts down, releasing held results when shrinking/disabling)
+            ctx._result_cache.resize(int(val))
         return pd.DataFrame({"status": [f"set {cmd.key}={val}"]})
     if cmd.kind == "create_table":
         if cmd.fmt not in ("csv", "parquet", "tpu_olap"):

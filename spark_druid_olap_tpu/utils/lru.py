@@ -164,6 +164,14 @@ class CountBudgetCache:
         with self._lock:
             return list(self._od.values())
 
+    def resize(self, budget_entries: int):
+        """Set a new budget and evict LRU entries down to it (a 0 budget
+        keeps nothing)."""
+        with self._lock:
+            self.budget_entries = max(int(budget_entries), 0)
+            while len(self._od) > self.budget_entries:
+                self._od.popitem(last=False)
+
     def clear(self):
         with self._lock:
             self._od.clear()

@@ -73,3 +73,74 @@ def test_dictionary_content_preserved(saved):
     for k in a.dicts:
         assert a.dicts[k].values == b.dicts[k].values
         assert a.dicts[k].content_key == b.dicts[k].content_key
+
+
+def test_load_under_new_name_keeps_star_working(saved):
+    """Loading under a different name must retarget star.fact_table, or the
+    collapse silently never fires for the renamed table."""
+    ctx, tables, d = saved
+    fresh = sd.TPUOlapContext()
+    fresh.load_table(d, name="lo_renamed")
+    star = fresh.catalog.star_schema("lo_renamed")
+    assert star is not None and star.fact_table == "lo_renamed"
+    for t in ("dwdate", "customer", "supplier", "part"):
+        fresh.register_table(
+            t,
+            {k: np.asarray(v) for k, v in tables[t].items()},
+            time_column="d_datekey" if t == "dwdate" else None,
+        )
+    sql = ssb.QUERIES["q2_1"].replace("FROM lineorder", "FROM lo_renamed")
+    rw = fresh.plan_sql(sql)
+    assert rw.datasource == "lo_renamed"  # star collapse fired
+
+
+def test_load_starless_drops_stale_star(saved, tmp_path):
+    """A star-less save loaded over an existing starred name must not keep
+    the stale star schema."""
+    ctx, tables, d = saved
+    fresh = sd.TPUOlapContext()
+    # register a starred 'lineorder', then overwrite from a star-less save
+    fresh.load_table(d)  # starred
+    assert fresh.catalog.star_schema("lineorder") is not None
+    plain = sd.TPUOlapContext()
+    rng = np.random.default_rng(0)
+    plain.register_table(
+        "lineorder",
+        {"x": rng.integers(0, 3, 2048).astype(np.int64),
+         "v": np.ones(2048, np.float32)},
+        dimensions=["x"], metrics=["v"],
+    )
+    d2 = str(tmp_path / "plain")
+    plain.save_table("lineorder", d2)
+    fresh.load_table(d2)
+    assert fresh.catalog.star_schema("lineorder") is None
+
+
+def test_resave_shrinks_segment_files(saved, tmp_path):
+    """Re-saving a smaller datasource removes stale segment files."""
+    import os
+
+    ctx, tables, d = saved
+    big = sd.TPUOlapContext()
+    rng = np.random.default_rng(1)
+    big.register_table(
+        "t",
+        {"x": rng.integers(0, 3, 8192).astype(np.int64),
+         "v": np.ones(8192, np.float32)},
+        dimensions=["x"], metrics=["v"], rows_per_segment=1024,
+    )
+    d3 = str(tmp_path / "re")
+    big.save_table("t", d3)
+    n_big = len([f for f in os.listdir(d3) if f.endswith(".npz")])
+    assert n_big == 8
+    small = sd.TPUOlapContext()
+    small.register_table(
+        "t",
+        {"x": np.zeros(1024, np.int64), "v": np.ones(1024, np.float32)},
+        dimensions=["x"], metrics=["v"], rows_per_segment=1024,
+    )
+    small.save_table("t", d3)
+    assert len([f for f in os.listdir(d3) if f.endswith(".npz")]) == 1
+    check = sd.TPUOlapContext()
+    check.load_table(d3)
+    assert int(check.sql("SELECT count(*) AS n FROM t")["n"][0]) == 1024
