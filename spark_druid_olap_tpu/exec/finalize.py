@@ -25,6 +25,7 @@ def finalize_timeseries(df, q: Q.TimeseriesQuery, ds: DataSource):
     """Shared Timeseries finalization: empty-bucket zero-fill + ordering."""
     import pandas as pd
 
+    tcol = q.output_name
     if not q.skip_empty_buckets:
         iv = q.intervals[0] if q.intervals else ds.interval()
         if iv is not None:
@@ -34,8 +35,8 @@ def finalize_timeseries(df, q: Q.TimeseriesQuery, ds: DataSource):
                 "datetime64[ms]"
             )
             df = (
-                df.set_index("timestamp")
-                .reindex(pd.Index(all_buckets, name="timestamp"))
+                df.set_index(tcol)
+                .reindex(pd.Index(all_buckets, name=tcol))
                 .reset_index()
             )
             for a in q.aggregations:
@@ -44,7 +45,7 @@ def finalize_timeseries(df, q: Q.TimeseriesQuery, ds: DataSource):
                     if df[a.name].dtype.kind in ("i", "u"):
                         filled = filled.astype(np.int64)
                     df[a.name] = filled
-    df = df.sort_values("timestamp", ascending=not q.descending)
+    df = df.sort_values(tcol, ascending=not q.descending)
     return df.reset_index(drop=True)
 
 

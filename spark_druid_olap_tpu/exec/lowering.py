@@ -419,7 +419,9 @@ def timeseries_to_groupby(q: Q.TimeseriesQuery) -> Q.GroupByQuery:
     return Q.GroupByQuery(
         datasource=q.datasource,
         dimensions=(
-            DimensionSpec("__time", "timestamp", granularity=q.granularity),
+            DimensionSpec(
+                "__time", q.output_name, granularity=q.granularity
+            ),
         ),
         aggregations=q.aggregations,
         post_aggregations=q.post_aggregations,

@@ -221,6 +221,9 @@ class TimeseriesQuery(QuerySpec):
     virtual_columns: Tuple[VirtualColumn, ...] = ()
     descending: bool = False
     skip_empty_buckets: bool = True
+    # result column for the bucket timestamp: "timestamp" is Druid's wire
+    # name; SQL carries the user's alias (SELECT date_trunc(...) AS mo)
+    output_name: str = "timestamp"
 
     def to_druid(self):
         d: Dict[str, Any] = {
@@ -239,6 +242,11 @@ class TimeseriesQuery(QuerySpec):
             d["filter"] = self.filter.to_druid()
         if self.skip_empty_buckets:
             d["context"] = {"skipEmptyBuckets": True}
+        if self.output_name != "timestamp":
+            # not Druid wire vocabulary, but the serialized form is also the
+            # program/result cache identity — two queries differing only in
+            # the SQL alias must not collide
+            d.setdefault("context", {})["outputName"] = self.output_name
         return d
 
 

@@ -254,6 +254,9 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
             skip_empty_buckets=bool(
                 (d.get("context") or {}).get("skipEmptyBuckets", False)
             ),
+            output_name=(d.get("context") or {}).get(
+                "outputName", "timestamp"
+            ),
         )
     if qt == "scan":
         filt, ivs, vcols, _, _ = _common(d)

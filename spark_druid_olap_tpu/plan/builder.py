@@ -74,6 +74,10 @@ class QueryBuilder:
             and self.dimensions[0].extraction is None
             and self.topn_threshold is None
             and not self.grouping_sets
+            # TimeseriesQuery has no limit/sort/having surface — emitting it
+            # anyway would silently drop them (fuzz seed 31); stay GroupBy
+            and self.limit_spec is None
+            and self.having is None
         )
 
     @property
@@ -98,6 +102,7 @@ class QueryBuilder:
                 filter=self.filter,
                 intervals=self.intervals,
                 virtual_columns=self.virtual_columns,
+                output_name=self.dimensions[0].name,
             )
         if self.is_topn:
             return Q.TopNQuery(

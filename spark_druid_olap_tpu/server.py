@@ -89,8 +89,12 @@ def druid_result_shape(q: Q.QuerySpec, df) -> Any:
             out.append({"version": "v1", "timestamp": t, "event": rec})
         return out
     if isinstance(q, Q.TimeseriesQuery):
+        # wire shape always says "timestamp" whatever the SQL alias was
         return [
-            {"timestamp": rec.pop("timestamp", _result_timestamp(q)), "result": rec}
+            {
+                "timestamp": rec.pop(q.output_name, _result_timestamp(q)),
+                "result": rec,
+            }
             for rec in _rows(df)
         ]
     if isinstance(q, Q.TopNQuery):

@@ -135,18 +135,35 @@ _AGGS = [
 ]
 
 
+_MS_MONTH_ORACLE = lambda ts: (
+    np.asarray(ts, dtype="datetime64[ms]").astype("datetime64[M]")
+    .astype("datetime64[ms]").astype(np.int64)
+)
+
+
 def _gen_case(df, seed):
-    """One seeded random case: (sql text, dims, picks, preds) — the single
-    generator shared by the oracle test and the cross-executor test so both
-    always fuzz the same query family."""
+    """One seeded random case: (sql text, dims, picks, preds, having, order)
+    — the single generator shared by the oracle test and the cross-executor
+    test so both always fuzz the same query family.
+
+    `dims` entries are (sql expr, output name, pandas key fn); `having` is
+    the min count(*) threshold (int) or None; `order` is (agg index, limit)
+    for ORDER BY <agg> DESC LIMIT — compared as sorted value arrays since
+    ties make the exact row set ambiguous."""
     rng = np.random.default_rng(seed)
-    dims = list(
-        rng.choice(
-            np.array(["flag", "mode", "city", "yr"], dtype=object),
-            size=rng.integers(0, 3),
-            replace=False,
-        )
-    )
+    dim_pool = [
+        ("flag", "flag", lambda d: d["flag"]),
+        ("mode", "mode", lambda d: d["mode"]),
+        ("city", "city", lambda d: d["city"]),
+        ("yr", "yr", lambda d: d["yr"]),
+        (
+            "date_trunc('month', ts)",
+            "mo",
+            lambda d: _MS_MONTH_ORACLE(d["ts"]),
+        ),
+    ]
+    k = int(rng.integers(0, 3))
+    dims = [dim_pool[i] for i in rng.choice(len(dim_pool), size=k, replace=False)]
     n_aggs = int(rng.integers(1, 4))
     picks = [
         _AGGS[i]
@@ -155,49 +172,90 @@ def _gen_case(df, seed):
     n_preds = int(rng.integers(0, 3))
     preds = [_rand_predicate(rng, df) for _ in range(n_preds)]
 
-    sel = list(dims) + [
+    sel = [f"{e} AS {name}" for e, name, _ in dims] + [
         f"{sql} AS a{i}" for i, (sql, _, _) in enumerate(picks)
     ]
     q = "SELECT " + ", ".join(sel) + " FROM f"
     if preds:
         q += " WHERE " + " AND ".join(p for p, _ in preds)
     if dims:
-        q += " GROUP BY " + ", ".join(dims)
-    return q, dims, picks, preds
+        q += " GROUP BY " + ", ".join(e for e, _, _ in dims)
+    having = None
+    if dims and rng.random() < 0.3:
+        t = int(rng.integers(1, 40))
+        q += f" HAVING count(*) >= {t}"
+        having = t
+    order = None
+    if dims and rng.random() < 0.3:
+        ai = int(rng.integers(0, len(picks)))
+        lim = int(rng.integers(1, 12))
+        q += f" ORDER BY a{ai} DESC LIMIT {lim}"
+        order = (ai, lim)
+    return q, dims, picks, preds, having, order
 
 
-def _run_case(ctx, df, seed):
-    q, dims, picks, preds = _gen_case(df, seed)
-    got = ctx.sql(q)
-
+def _oracle_frame(df, dims, picks, preds, having):
     mask = pd.Series(True, index=df.index)
     for _, fn in preds:
         mask &= fn(df)
     sub = df[mask]
+    names = [n for _, n, _ in dims]
+    agg_names = [f"a{i}" for i in range(len(picks))]
     if dims:
+        keyed = sub.assign(**{n: kf(sub) for _, n, kf in dims})
         want_rows = []
-        for key, g in sub.groupby(dims, dropna=False, sort=False):
+        for key, g in keyed.groupby(names, dropna=False, sort=False):
             key = key if isinstance(key, tuple) else (key,)
-            row = dict(zip(dims, key))
+            if having is not None and len(g) < having:
+                continue
+            row = dict(zip(names, key))
             for i, (_, ofn, _) in enumerate(picks):
                 row[f"a{i}"] = ofn(g)
             want_rows.append(row)
-        want = pd.DataFrame(want_rows, columns=dims + [f"a{i}" for i in range(len(picks))])
-    else:
-        want = pd.DataFrame(
-            [{f"a{i}": ofn(sub) for i, (_, ofn, _) in enumerate(picks)}]
+        return pd.DataFrame(want_rows, columns=names + agg_names)
+    return pd.DataFrame(
+        [{f"a{i}": ofn(sub) for i, (_, ofn, _) in enumerate(picks)}]
+    )
+
+
+def _run_case(ctx, df, seed):
+    q, dims, picks, preds, having, order = _gen_case(df, seed)
+    got = ctx.sql(q)
+    want = _oracle_frame(df, dims, picks, preds, having)
+    names = [n for _, n, _ in dims]
+
+    if order is not None:
+        # ORDER BY <agg> DESC LIMIT k: ties make the exact row set ambiguous
+        # — compare the sorted top-k value arrays of the ranked aggregate
+        ai, lim = order
+        w = np.sort(np.asarray(want[f"a{ai}"], np.float64))[::-1][:lim]
+        g = np.sort(np.asarray(got[f"a{ai}"], np.float64))[::-1]
+        assert len(got) == len(w), (seed, q, len(got), len(w))
+        np.testing.assert_allclose(
+            g, w, rtol=3e-5, atol=1e-6, equal_nan=True,
+            err_msg=f"seed={seed} {q}",
         )
+        return
 
     assert len(got) == len(want), (seed, q, len(got), len(want))
     if not len(want):
         return
     # align rows on a sentinel-filled dim key
-    if dims:
+    if names:
         SENT = "\x00null"
-        gk = got[dims].astype(object).where(got[dims].notna(), SENT)
-        wk = want[dims].astype(object).where(want[dims].notna(), SENT)
-        got = got.assign(__k=list(map(tuple, gk.values))).sort_values("__k")
-        want = want.assign(__k=list(map(tuple, wk.values))).sort_values("__k")
+        gk = got[names].astype(object).where(got[names].notna(), SENT)
+        wk = want[names].astype(object).where(want[names].notna(), SENT)
+        # timestamp dims decode as datetime64; normalize to int64 ms
+        def _kt(v):
+            if isinstance(v, (np.datetime64, pd.Timestamp)):
+                return int(np.datetime64(v, "ms").astype(np.int64))
+            return v
+        got = got.assign(
+            __k=[tuple(_kt(x) for x in t) for t in gk.values]
+        ).sort_values("__k")
+        want = want.assign(
+            __k=[tuple(_kt(x) for x in t) for t in wk.values]
+        ).sort_values("__k")
         assert list(got["__k"]) == list(want["__k"]), (seed, q)
     for i, (_, _, kind) in enumerate(picks):
         g = np.asarray(got[f"a{i}"], dtype=np.float64)
@@ -232,9 +290,10 @@ def test_avg_over_zero_rows_is_null(world):
 
 def _plan_query(ctx, df, seed):
     """Plan one generated case; returns (Rewrite, sql text).  The executable
-    spec is rw.query (a GroupByQuery, or a TimeseriesQuery when no dims are
-    drawn and the planner picks the tighter shape)."""
-    q, _, _, _ = _gen_case(df, seed)
+    spec is rw.query — a GroupByQuery, or a TimeseriesQuery when exactly the
+    date_trunc time bucket is drawn as the single dim with no HAVING/ORDER
+    (builder.is_timeseries)."""
+    q = _gen_case(df, seed)[0]
     return ctx.plan_sql(q), q
 
 
