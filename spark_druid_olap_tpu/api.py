@@ -134,6 +134,12 @@ class TPUOlapContext:
         self.catalog.put(ds, star_schema)
         return ds
 
+    def register_lookup(self, name: str, mapping: Mapping[str, str]):
+        """Register a query-time lookup table (Druid lookup extraction):
+        `LOOKUP(dim, 'name')` in GROUP BY maps dimension values through it
+        host-side (a dictionary rewrite — never per-row string work)."""
+        self.catalog.put_lookup(name, dict(mapping))
+
     def save_table(self, name: str, directory: str) -> str:
         """Persist a registered datasource (encoded segments + dictionaries
         + star schema) to a directory; `load_table` or `CREATE TABLE ...

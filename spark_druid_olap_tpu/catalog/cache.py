@@ -24,9 +24,24 @@ class MetadataCache:
         self._lock = threading.Lock()
         self._tables: Dict[str, DataSource] = {}
         self._stars: Dict[str, StarSchemaInfo] = {}
+        # query-time lookup tables (Druid lookup extraction): name -> map
+        self._lookups: Dict[str, dict] = {}
         # monotonically bumped on every mutation; plan caches key on it so a
         # re-registered table invalidates cached rewrites
         self.version = 0
+
+    def put_lookup(self, name: str, mapping: dict):
+        with self._lock:
+            self._lookups[name] = dict(mapping)
+            self.version += 1
+
+    def lookup(self, name: str):
+        with self._lock:
+            return self._lookups.get(name)
+
+    def lookups(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._lookups)
 
     def put(self, ds: DataSource, star: Optional[StarSchemaInfo] = None):
         with self._lock:

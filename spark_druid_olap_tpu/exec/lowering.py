@@ -68,10 +68,18 @@ def _resolve_dims(
             extracted = spec.extraction.apply_to_dict(
                 [v if isinstance(v, str) else str(v) for v in d.values]
             )
-            new_vals = sorted(set(extracted))
+            # extraction fns may emit None (lookup with no retain/replace):
+            # those values fold into the null slot
+            new_vals = sorted({v for v in extracted if v is not None})
             index = {v: i for i, v in enumerate(new_vals)}
-            remap = np.array([index[v] for v in extracted], dtype=np.int32)
             card = len(new_vals) + 1  # + null slot
+            remap = np.array(
+                [
+                    index[v] if v is not None else card - 1
+                    for v in extracted
+                ],
+                dtype=np.int32,
+            )
             remap_dev = jnp.asarray(remap)
             name = spec.dimension
 

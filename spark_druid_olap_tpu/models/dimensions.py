@@ -177,3 +177,36 @@ class DimensionSpec:
             "outputName": self.name,
             "extractionFn": self.extraction.to_druid(),
         }
+
+
+@dataclasses.dataclass(frozen=True)
+class LookupExtraction(ExtractionFn):
+    """Druid `lookup` extraction: map dimension values through a registered
+    key->value table at query time (`LOOKUP(dim, 'name')` in SQL).  The map
+    travels as a tuple of pairs so the spec stays frozen/hashable; semantics
+    follow Druid's map lookup: unmapped values pass through unchanged when
+    `retain_missing`, else become `replace_missing` (None -> null group)."""
+
+    name: str
+    mapping: Tuple[Tuple[str, str], ...]
+    retain_missing: bool = True
+    replace_missing: Optional[str] = None
+
+    def to_druid(self):
+        d: Dict[str, Any] = {
+            "type": "lookup",
+            "lookup": {"type": "map", "map": dict(self.mapping)},
+        }
+        if self.retain_missing:
+            d["retainMissingValue"] = True
+        elif self.replace_missing is not None:
+            d["replaceMissingValueWith"] = self.replace_missing
+        return d
+
+    def apply_to_dict(self, values):
+        m = dict(self.mapping)
+        if self.retain_missing:
+            return [m.get(v, v) for v in values]
+        # Druid: without retain/replace, unmapped values become null (None
+        # here folds into the dimension's null group)
+        return [m.get(v, self.replace_missing) for v in values]

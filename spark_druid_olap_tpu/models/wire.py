@@ -122,6 +122,18 @@ def _extraction_from_druid(d: Dict[str, Any]):
         return CaseExtraction(upper=False)
     if t == "regex":
         return RegexExtraction(d["expr"], d.get("index", 1))
+    if t == "lookup":
+        from .dimensions import LookupExtraction
+
+        lk = d.get("lookup", {})
+        if lk.get("type") != "map":
+            raise WireError(f"unsupported lookup type {lk.get('type')!r}")
+        return LookupExtraction(
+            d.get("name", "wire"),
+            tuple(sorted((str(k), str(v)) for k, v in (lk.get("map") or {}).items())),
+            retain_missing=bool(d.get("retainMissingValue", False)),
+            replace_missing=d.get("replaceMissingValueWith"),
+        )
     if t == "timeFormat":
         fmt = d.get("format", "%Y")
         # field-shaped formats decode to the int-valued EXTRACT dimension
@@ -151,7 +163,15 @@ def _iso_ms(s: str) -> int:
     return int(np.datetime64(s.rstrip("Z"), "ms").astype(np.int64))
 
 
+_ETERNITY = "0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"
+
+
 def intervals_from_druid(ivs: List[str]) -> Tuple[Tuple[int, int], ...]:
+    # the eternity interval is the wire form of "no constraint" (Druid
+    # requires an intervals field; our specs use () — a round-trip must not
+    # turn it into a real time filter, which would demand a time column)
+    if list(ivs or ()) == [_ETERNITY]:
+        return ()
     out = []
     for iv in ivs or ():
         a, b = iv.split("/")

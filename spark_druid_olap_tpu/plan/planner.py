@@ -185,8 +185,15 @@ class Planner:
         # AggregateTransform: grouping exprs
         dims = []
         dim_names = []
+        lookups = (
+            self.catalog.lookups()
+            if hasattr(self.catalog, "lookups")
+            else None
+        )
         for name, ge in agg.group_exprs:
-            spec, b = translate_group_expr(name, substitute(ge, env), ds, b)
+            spec, b = translate_group_expr(
+                name, substitute(ge, env), ds, b, lookups=lookups
+            )
             dims.append(spec)
             dim_names.append(spec.name)
         b = b.with_(dimensions=tuple(dims))

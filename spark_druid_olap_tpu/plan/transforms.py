@@ -260,9 +260,15 @@ def _validate_columns(e: E.Expr, ds: DataSource):
 
 
 def translate_group_expr(
-    name: str, e: E.Expr, ds: DataSource, b: QueryBuilder
+    name: str,
+    e: E.Expr,
+    ds: DataSource,
+    b: QueryBuilder,
+    lookups=None,
 ) -> Tuple[DimensionSpec, QueryBuilder]:
-    """Grouping expression -> DimensionSpec (+ builder extension)."""
+    """Grouping expression -> DimensionSpec (+ builder extension).
+    `lookups` maps registered lookup-table names to dicts (the Druid lookup
+    extraction, LOOKUP(dim, 'name'))."""
     if isinstance(e, E.Col):
         if e.name in ds.dicts:
             return DimensionSpec(e.name, name), b
@@ -318,6 +324,24 @@ def translate_group_expr(
             return (
                 DimensionSpec(dim, name,
                               extraction=CaseExtraction(upper=(e.fn == "upper"))),
+                b,
+            )
+        if e.fn == "lookup":
+            from ..models.dimensions import LookupExtraction
+
+            lname = str(e.args[0])
+            table = (lookups or {}).get(lname)
+            if table is None:
+                raise RewriteError(f"unknown lookup table {lname!r}")
+            return (
+                DimensionSpec(
+                    dim,
+                    name,
+                    extraction=LookupExtraction(
+                        lname,
+                        tuple(sorted((str(k), str(v)) for k, v in table.items())),
+                    ),
+                ),
                 b,
             )
         raise RewriteError(f"string function {e.fn!r} in GROUP BY")

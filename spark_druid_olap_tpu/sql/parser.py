@@ -507,6 +507,16 @@ class Parser:
             arg = self.expr()
             self.expect_op(")")
             return E.StrFunc(fn, arg)
+        if fn == "lookup":
+            arg = self.expr()
+            self.expect_op(",")
+            lname = self.expr()
+            self.expect_op(")")
+            if not isinstance(lname, E.Literal) or not isinstance(
+                lname.value, str
+            ):
+                raise ParseError("LOOKUP name must be a string literal")
+            return E.StrFunc("lookup", arg, (lname.value,))
         if fn in ("year", "month", "day", "hour", "minute"):
             arg = self.expr()
             self.expect_op(")")

@@ -1,0 +1,128 @@
+"""Query-time lookup tables (Druid lookup extraction, SURVEY.md §2
+ExtractionFunctionSpec family): LOOKUP(dim, 'name') maps dimension values
+through a registered table as a host-side dictionary rewrite."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.plan.planner import RewriteError
+
+NATION_TO_REGION = {
+    "FRANCE": "EUROPE", "GERMANY": "EUROPE",
+    "CHINA": "ASIA", "JAPAN": "ASIA",
+    "BRAZIL": "AMERICA",
+}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    rng = np.random.default_rng(4)
+    n = 20_000
+    nations = np.array(sorted(NATION_TO_REGION) + ["ATLANTIS"], dtype=object)
+    c.register_table(
+        "t",
+        {
+            "nation": rng.choice(nations, n),
+            "v": rng.random(n).astype(np.float32),
+        },
+        dimensions=["nation"],
+        metrics=["v"],
+    )
+    c.register_lookup("n2r", NATION_TO_REGION)
+    return c
+
+
+def _frame(c):
+    ds = c.catalog.get("t")
+    seg = ds.segments[0]
+    nation = ds.dicts["nation"].decode(
+        np.asarray(seg.dims["nation"])[seg.valid]
+    )
+    v = np.asarray(seg.metrics["v"], np.float64)[seg.valid]
+    return pd.DataFrame({"nation": nation, "v": v})
+
+
+def test_lookup_group_by_parity(ctx):
+    got = ctx.sql(
+        "SELECT LOOKUP(nation, 'n2r') AS region, sum(v) AS s, count(*) AS n "
+        "FROM t GROUP BY LOOKUP(nation, 'n2r') ORDER BY region"
+    )
+    df = _frame(ctx)
+    # retainMissingValue semantics: unmapped ATLANTIS passes through
+    df["region"] = df.nation.map(lambda x: NATION_TO_REGION.get(x, x))
+    want = (
+        df.groupby("region", as_index=False)
+        .agg(s=("v", "sum"), n=("v", "count"))
+        .sort_values("region")
+        .reset_index(drop=True)
+    )
+    assert list(got["region"]) == list(want["region"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+
+
+def test_unknown_lookup_raises(ctx):
+    with pytest.raises(RewriteError, match="unknown lookup"):
+        ctx.plan_sql(
+            "SELECT LOOKUP(nation, 'nope') AS r, count(*) AS n "
+            "FROM t GROUP BY LOOKUP(nation, 'nope')"
+        )
+
+
+def test_lookup_registration_invalidates_plan_cache(ctx):
+    sql = (
+        "SELECT LOOKUP(nation, 'n2r') AS region, count(*) AS n "
+        "FROM t GROUP BY LOOKUP(nation, 'n2r')"
+    )
+    before = ctx.sql(sql)
+    # remap everything to one bucket; the catalog version bump must
+    # invalidate the cached plan (the extraction bakes the map in)
+    ctx.register_lookup("n2r", {k: "X" for k in NATION_TO_REGION})
+    after = ctx.sql(sql)
+    assert set(after["region"]) == {"X", "ATLANTIS"}
+    assert len(before) > len(after)
+    # restore for other tests
+    ctx.register_lookup("n2r", NATION_TO_REGION)
+
+
+def test_lookup_wire_roundtrip(ctx):
+    from spark_druid_olap_tpu.models.wire import query_from_druid
+
+    rw = ctx.plan_sql(
+        "SELECT LOOKUP(nation, 'n2r') AS region, sum(v) AS s "
+        "FROM t GROUP BY LOOKUP(nation, 'n2r')"
+    )
+    q2 = query_from_druid(rw.query.to_druid())
+    df = ctx.engine.execute(q2, ctx.catalog.get("t"))
+    assert "region" in df.columns and len(df) > 0
+
+
+def test_lookup_unmapped_to_null_without_retain(ctx):
+    """Druid semantics: no retain/replace -> unmapped values become the null
+    group."""
+    from spark_druid_olap_tpu.models.aggregations import Count
+    from spark_druid_olap_tpu.models.dimensions import (
+        DimensionSpec,
+        LookupExtraction,
+    )
+    from spark_druid_olap_tpu.models.query import GroupByQuery
+
+    ex = LookupExtraction(
+        "n2r",
+        tuple(sorted(NATION_TO_REGION.items())),
+        retain_missing=False,
+    )
+    q = GroupByQuery(
+        datasource="t",
+        dimensions=(DimensionSpec("nation", "region", extraction=ex),),
+        aggregations=(Count("n"),),
+    )
+    df = ctx.engine.execute(q, ctx.catalog.get("t"))
+    assert df["region"].isna().any()  # ATLANTIS rows fold into the null group
+    assert "ATLANTIS" not in set(df["region"].dropna())
+    want_null = int((_frame(ctx).nation == "ATLANTIS").sum())
+    got_null = int(df[df["region"].isna()]["n"].iloc[0])
+    assert got_null == want_null
