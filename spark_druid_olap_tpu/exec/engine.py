@@ -103,10 +103,12 @@ class Engine:
         # lowering stops re-paying doomed trace+compiles every execution.
         self._sparse_disabled: set = set()
         self._sparse_error_counts: Dict = {}
-        # queries whose survivors overflowed the row-compaction capacity:
-        # deterministic for a given (query, data), so repeats skip straight
-        # to the full-segment sort tier
-        self._sparse_row_overflow: set = set()
+        # queries whose survivors overflowed the base row-compaction
+        # capacity: the kernel reports the exact survivor count, the engine
+        # picks the smallest adequate ROW_CAPACITY_LADDER rung (None = full
+        # sort) — deterministic for a given (query, data), so repeats go
+        # straight to the remembered rung
+        self._sparse_row_capacity: Dict = {}
         # LRU residency cache under a byte budget (VERDICT r1 weak #7: the
         # unbounded caches OOMed HBM over long sessions).  4 GiB default
         # leaves headroom on a 16 GiB v5e chip for kernel workspace.
@@ -502,7 +504,7 @@ class Engine:
         caller pins the query off this path) or "error" (sparse program
         failed even after the Pallas-inner retry: fall back this execution
         only; correctness never depends on this path)."""
-        from ..ops.sparse_groupby import ROW_CAPACITY, merge_sparse_states
+        from ..ops.sparse_groupby import merge_sparse_states
 
         segs = self._segments_in_scope(q, ds)
         G = lowering.num_groups
@@ -545,20 +547,35 @@ class Engine:
         qkey = _query_key(q, ds)
 
         def run_tiered():
-            # tier 1: filter-compacted sort (128K-row sort network); tier 2
-            # on row overflow: full-R sort.  Row overflow is deterministic
-            # per (query, data), so it is remembered and repeats skip
-            # straight to tier 2.  Slot overflow falls out below.
-            compact = selective and qkey not in self._sparse_row_overflow
-            host = run(row_capacity=ROW_CAPACITY if compact else None)
-            if compact and bool(host["row_overflow"]):
-                self._sparse_row_overflow.add(qkey)
-                log.info(
-                    "sparse row compaction overflowed %d rows; rerunning "
-                    "with the full-segment sort (remembered for repeats)",
-                    ROW_CAPACITY,
+            # tier 1: filter-compacted sort (128K-row sort network by
+            # default).  On row overflow the kernel's exact survivor count
+            # picks the smallest adequate ROW_CAPACITY_LADDER rung (full-R
+            # sort only past the top rung) — sort cost grows ~linearly with
+            # capacity, so q3_1-class queries (180K survivors of 6M rows)
+            # stay 3-4x off the full sort.  The rung is deterministic per
+            # (query, data) and remembered.  Slot overflow falls out below.
+            from ..ops import sparse_groupby as _sg
+
+            cap = (
+                self._sparse_row_capacity.get(qkey, _sg.ROW_CAPACITY)
+                if selective
+                else None
+            )
+            host = run(row_capacity=cap)
+            if cap is not None and bool(host["row_overflow"]):
+                n = int(host["n_rows"])
+                new_cap = next(
+                    (c for c in _sg.ROW_CAPACITY_LADDER if c >= n and c > cap),
+                    None,
                 )
-                host = run(row_capacity=None)
+                self._sparse_row_capacity[qkey] = new_cap
+                log.info(
+                    "sparse row compaction overflowed %d of capacity %d; "
+                    "rerunning at %s (remembered for repeats)",
+                    n, cap,
+                    "full-segment sort" if new_cap is None else new_cap,
+                )
+                host = run(row_capacity=new_cap)
             return host
 
         try:

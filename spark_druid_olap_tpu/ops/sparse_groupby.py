@@ -45,6 +45,13 @@ SPARSE_SLOTS = 4096
 # multiple of 1024 (ROW_PAD) so the inner one-hot blocks divide evenly.
 ROW_CAPACITY = 1 << 17
 
+# When the 128K tier overflows, the kernel's exact survivor count (`n_rows`)
+# picks the smallest adequate rung instead of falling all the way back to the
+# full-segment sort: sort cost grows roughly linearly with capacity (measured
+# on v5e: SSB q3_1 at 256K = 235 ms vs full-6M = 860 ms), so one rung of
+# headroom is worth compiling a second program for.
+ROW_CAPACITY_LADDER = (1 << 17, 1 << 18, 1 << 19, 1 << 20, 1 << 21)
+
 
 def compact_rows(
     gid: jnp.ndarray,
@@ -60,9 +67,11 @@ def compact_rows(
     R-sized scatter, no sort.  Slot i holds the i-th surviving row (the first
     position whose running count reaches i+1).  Slots past the survivor count
     duplicate an arbitrary row with their mask cleared, so downstream
-    aggregation ignores them.  Returns (*compacted arrays, row_overflow) —
-    row_overflow set when survivors exceed capacity (the caller must rerun
-    without compaction; compacted state would silently drop rows)."""
+    aggregation ignores them.  Returns (*compacted arrays, row_overflow, n)
+    — row_overflow set when survivors exceed capacity (the caller must rerun
+    at a bigger capacity; compacted state would silently drop rows), and n
+    is the exact survivor count so the engine can pick that capacity from
+    ROW_CAPACITY_LADDER without guessing."""
     R = gid.shape[0]
     c = jnp.cumsum(mask.astype(jnp.int32))
     n = c[-1]
@@ -79,6 +88,7 @@ def compact_rows(
         minmax_values[idx],
         minmax_masks[idx],
         row_overflow,
+        n,
     )
 
 
@@ -105,17 +115,20 @@ def sparse_partial_aggregate(
 
     Returns {"gids": i32[slots] (-1 = empty/trash), "sums": f32[slots, Ms],
     "mins": f32[slots, Mn], "maxs": f32[slots, Mx], "overflow": bool[],
-    "row_overflow": bool[]}.
+    "row_overflow": bool[], "n_rows": i32[] exact survivor count}.
     """
     G = num_groups
     row_overflow = jnp.zeros((), jnp.bool_)
     if row_capacity is not None and row_capacity < gid.shape[0]:
-        gid, mask, sum_values, minmax_values, minmax_masks, row_overflow = (
-            compact_rows(
-                gid, mask, sum_values, minmax_values, minmax_masks,
-                row_capacity,
-            )
+        (
+            gid, mask, sum_values, minmax_values, minmax_masks,
+            row_overflow, n_rows,
+        ) = compact_rows(
+            gid, mask, sum_values, minmax_values, minmax_masks,
+            row_capacity,
         )
+    else:
+        n_rows = jnp.sum(mask.astype(jnp.int32))
     R = gid.shape[0]
     n_state = slots + 1  # + 1 so the masked-row trash run never eats a slot
     g = jnp.where(mask, gid, jnp.int32(G))  # trash value for masked rows
@@ -158,6 +171,7 @@ def sparse_partial_aggregate(
         "maxs": maxs,
         "overflow": overflow,
         "row_overflow": row_overflow,
+        "n_rows": n_rows,
     }
 
 
@@ -209,4 +223,7 @@ def merge_sparse_states(
         "maxs": maxs,
         "overflow": overflow,
         "row_overflow": a["row_overflow"] | b["row_overflow"],
+        # max, not sum: capacity is per-segment, so the rung the engine picks
+        # must cover the worst single segment
+        "n_rows": jnp.maximum(a["n_rows"], b["n_rows"]),
     }

@@ -325,6 +325,51 @@ def test_engine_row_overflow_reruns_full_sort(monkeypatch):
     np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
 
 
+def test_engine_ladder_picks_intermediate_rung(monkeypatch):
+    """Survivors overflow the base capacity but fit a ladder rung: the
+    engine must pick that rung (not the full sort), remember it, and stay
+    exact."""
+    import spark_druid_olap_tpu.ops.sparse_groupby as sg
+
+    monkeypatch.setattr(sg, "ROW_CAPACITY", 1024)
+    monkeypatch.setattr(sg, "ROW_CAPACITY_LADDER", (1024, 4096, 16384))
+    ds, cols = _make_ds()  # 60k rows over 3 segments (20k rows each)
+    keep = list(range(0, 30))  # ~6k survivors: >1024, fits 4096-per-segment
+    q = _query(filter=InFilter("a", tuple(keep)))
+    mask = np.isin(cols["a"], keep)
+    assert 1024 < int(mask.sum()) // 3 < 4096
+    eng = Engine(strategy="sparse")
+    got = _norm(eng.execute(q, ds))
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    # the rung was remembered for this (query, data)
+    (cap,) = eng._sparse_row_capacity.values()
+    assert cap == 4096
+    # repeat goes straight to the remembered rung and stays exact
+    got2 = _norm(eng.execute(q, ds))
+    np.testing.assert_array_equal(got2["n"], want["n"])
+
+
+def test_engine_ladder_exhausted_falls_back_to_full_sort(monkeypatch):
+    """Survivors past the top rung: full-segment sort, still exact."""
+    import spark_druid_olap_tpu.ops.sparse_groupby as sg
+
+    monkeypatch.setattr(sg, "ROW_CAPACITY", 1024)
+    monkeypatch.setattr(sg, "ROW_CAPACITY_LADDER", (1024, 2048))
+    ds, cols = _make_ds()
+    keep = list(range(0, 150))  # ~half the rows survive >> 2048 per segment
+    q = _query(filter=InFilter("a", tuple(keep)))
+    eng = Engine(strategy="sparse")
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], keep)
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    (cap,) = eng._sparse_row_capacity.values()
+    assert cap is None
+
+
 def test_engine_compacted_tier_parity(monkeypatch):
     """Survivors fit the (shrunken) capacity: the compacted tier answers and
     matches the oracle."""
