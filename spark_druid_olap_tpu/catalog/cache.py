@@ -39,10 +39,6 @@ class MetadataCache:
         with self._lock:
             return self._lookups.get(name)
 
-    def lookups(self) -> Dict[str, dict]:
-        with self._lock:
-            return dict(self._lookups)
-
     def put(self, ds: DataSource, star: Optional[StarSchemaInfo] = None):
         with self._lock:
             self._tables[ds.name] = ds

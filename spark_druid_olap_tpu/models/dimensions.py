@@ -184,17 +184,42 @@ class LookupExtraction(ExtractionFn):
     """Druid `lookup` extraction: map dimension values through a registered
     key->value table at query time (`LOOKUP(dim, 'name')` in SQL).  The map
     travels as a tuple of pairs so the spec stays frozen/hashable; semantics
-    follow Druid's map lookup: unmapped values pass through unchanged when
-    `retain_missing`, else become `replace_missing` (None -> null group)."""
+    follow Druid's map lookup: unmapped values become `replace_missing`
+    (None -> null group, the Druid default) unless `retain_missing`, which
+    passes them through unchanged."""
 
     name: str
     mapping: Tuple[Tuple[str, str], ...]
-    retain_missing: bool = True
+    retain_missing: bool = False
     replace_missing: Optional[str] = None
+
+    @classmethod
+    def from_mapping(
+        cls,
+        name: str,
+        mapping,
+        retain_missing: bool = False,
+        replace_missing: Optional[str] = None,
+    ) -> "LookupExtraction":
+        """Canonical constructor from a dict-like mapping: the sorted-pairs
+        normalization lives HERE so every construction path (SQL planning,
+        wire decode) produces specs that hash/compare equal for the same
+        logical lookup."""
+        return cls(
+            name,
+            tuple(sorted((str(k), str(v)) for k, v in dict(mapping).items())),
+            retain_missing=retain_missing,
+            replace_missing=replace_missing,
+        )
 
     def to_druid(self):
         d: Dict[str, Any] = {
             "type": "lookup",
+            # `name` is not part of Druid's inline-map wire form, but losing
+            # it on a round-trip would make the decoded spec hash differently
+            # from the locally planned one (cache miss); our decoder reads it
+            # back and Druid-side consumers ignore unknown fields
+            "name": self.name,
             "lookup": {"type": "map", "map": dict(self.mapping)},
         }
         if self.retain_missing:

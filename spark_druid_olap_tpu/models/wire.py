@@ -128,9 +128,9 @@ def _extraction_from_druid(d: Dict[str, Any]):
         lk = d.get("lookup", {})
         if lk.get("type") != "map":
             raise WireError(f"unsupported lookup type {lk.get('type')!r}")
-        return LookupExtraction(
+        return LookupExtraction.from_mapping(
             d.get("name", "wire"),
-            tuple(sorted((str(k), str(v)) for k, v in (lk.get("map") or {}).items())),
+            lk.get("map") or {},
             retain_missing=bool(d.get("retainMissingValue", False)),
             replace_missing=d.get("replaceMissingValueWith"),
         )
@@ -163,19 +163,44 @@ def _iso_ms(s: str) -> int:
     return int(np.datetime64(s.rstrip("Z"), "ms").astype(np.int64))
 
 
-_ETERNITY = "0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"
+# Any start at-or-before year 0000 / end at-or-past year 3000 is treated as
+# unbounded — covers our own _ETERNITY spelling, variants without millis,
+# and anything a client means as "everything".
+_ETERNITY_LO = int(np.datetime64("0000-01-01", "ms").astype(np.int64))
+_ETERNITY_HI = int(np.datetime64("3000-01-01", "ms").astype(np.int64))
+# Druid's canonical eternity instants (Long.MIN/MAX_VALUE as millis) have
+# six-digit years np.datetime64 cannot parse; match them by prefix.
+_DRUID_MIN_PREFIX = "-146136543-"
+_DRUID_MAX_PREFIX = "146140482-"
+
+
+def _bound_ms(s: str) -> int:
+    s = s.strip()
+    # Druid's canonical instants parse to values far outside the sentinel
+    # range; genuine far-future/far-past bounds pass through UNCLAMPED so a
+    # real [3500, 3600) interval stays a real interval
+    if s.startswith(_DRUID_MIN_PREFIX):
+        return -(1 << 62)
+    if s.startswith(_DRUID_MAX_PREFIX):
+        return 1 << 62
+    return _iso_ms(s)
 
 
 def intervals_from_druid(ivs: List[str]) -> Tuple[Tuple[int, int], ...]:
-    # the eternity interval is the wire form of "no constraint" (Druid
+    # an eternity interval is the wire form of "no constraint" (Druid
     # requires an intervals field; our specs use () — a round-trip must not
-    # turn it into a real time filter, which would demand a time column)
-    if list(ivs or ()) == [_ETERNITY]:
-        return ()
+    # turn it into a real time filter, which would demand a time column).
+    # Detected by parsed bounds, not string equality: Druid's canonical
+    # spelling, ours, and milliless variants must all decode to ().
     out = []
     for iv in ivs or ():
         a, b = iv.split("/")
-        out.append((_iso_ms(a), _iso_ms(b)))
+        am = _bound_ms(a)
+        bm = _bound_ms(b)
+        if am <= _ETERNITY_LO and bm >= _ETERNITY_HI:
+            # intervals union: eternity subsumes everything
+            return ()
+        out.append((am, bm))
     return tuple(out)
 
 

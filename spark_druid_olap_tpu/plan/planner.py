@@ -185,9 +185,12 @@ class Planner:
         # AggregateTransform: grouping exprs
         dims = []
         dim_names = []
+        # a getter, not a snapshot dict: copying every registered table on
+        # every aggregate plan would cost O(total lookup size) per query
+        # even when no LOOKUP appears
         lookups = (
-            self.catalog.lookups()
-            if hasattr(self.catalog, "lookups")
+            self.catalog.lookup
+            if hasattr(self.catalog, "lookup")
             else None
         )
         for name, ge in agg.group_exprs:

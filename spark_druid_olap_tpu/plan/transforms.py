@@ -267,8 +267,10 @@ def translate_group_expr(
     lookups=None,
 ) -> Tuple[DimensionSpec, QueryBuilder]:
     """Grouping expression -> DimensionSpec (+ builder extension).
-    `lookups` maps registered lookup-table names to dicts (the Druid lookup
-    extraction, LOOKUP(dim, 'name'))."""
+    `lookups` is a callable name -> mapping-dict-or-None (the Druid lookup
+    extraction, LOOKUP(dim, 'name')); a callable rather than a dict so
+    planning a query with no LOOKUP never pays for copying registered
+    tables."""
     if isinstance(e, E.Col):
         if e.name in ds.dicts:
             return DimensionSpec(e.name, name), b
@@ -330,16 +332,19 @@ def translate_group_expr(
             from ..models.dimensions import LookupExtraction
 
             lname = str(e.args[0])
-            table = (lookups or {}).get(lname)
+            table = lookups(lname) if lookups is not None else None
             if table is None:
                 raise RewriteError(f"unknown lookup table {lname!r}")
+            # Druid SQL: LOOKUP(expr, name[, replaceMissingValueWith]) — an
+            # unmapped key becomes NULL (the null group) unless the optional
+            # third argument replaces it
+            replace = str(e.args[1]) if len(e.args) > 1 else None
             return (
                 DimensionSpec(
                     dim,
                     name,
-                    extraction=LookupExtraction(
-                        lname,
-                        tuple(sorted((str(k), str(v)) for k, v in table.items())),
+                    extraction=LookupExtraction.from_mapping(
+                        lname, table, replace_missing=replace
                     ),
                 ),
                 b,

@@ -508,15 +508,29 @@ class Parser:
             self.expect_op(")")
             return E.StrFunc(fn, arg)
         if fn == "lookup":
+            # LOOKUP(expr, 'name'[, 'replaceMissingValueWith'])
             arg = self.expr()
             self.expect_op(",")
             lname = self.expr()
+            replace = None
+            if self.accept_op(","):
+                replace = self.expr()
             self.expect_op(")")
             if not isinstance(lname, E.Literal) or not isinstance(
                 lname.value, str
             ):
                 raise ParseError("LOOKUP name must be a string literal")
-            return E.StrFunc("lookup", arg, (lname.value,))
+            args = (lname.value,)
+            if replace is not None:
+                if not isinstance(replace, E.Literal) or not isinstance(
+                    replace.value, str
+                ):
+                    raise ParseError(
+                        "LOOKUP replaceMissingValueWith must be a string "
+                        "literal"
+                    )
+                args = args + (replace.value,)
+            return E.StrFunc("lookup", arg, args)
         if fn in ("year", "month", "day", "hour", "minute"):
             arg = self.expr()
             self.expect_op(")")

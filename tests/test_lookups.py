@@ -51,17 +51,35 @@ def test_lookup_group_by_parity(ctx):
         "FROM t GROUP BY LOOKUP(nation, 'n2r') ORDER BY region"
     )
     df = _frame(ctx)
-    # retainMissingValue semantics: unmapped ATLANTIS passes through
-    df["region"] = df.nation.map(lambda x: NATION_TO_REGION.get(x, x))
+    # Druid SQL semantics: unmapped ATLANTIS becomes the NULL group
+    df["region"] = df.nation.map(NATION_TO_REGION)
     want = (
-        df.groupby("region", as_index=False)
+        df.groupby("region", as_index=False, dropna=False)
         .agg(s=("v", "sum"), n=("v", "count"))
         .sort_values("region")
         .reset_index(drop=True)
     )
-    assert list(got["region"]) == list(want["region"])
-    np.testing.assert_array_equal(got["n"], want["n"])
-    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    got_nonnull = got[got["region"].notna()].reset_index(drop=True)
+    want_nonnull = want[want["region"].notna()].reset_index(drop=True)
+    assert list(got_nonnull["region"]) == list(want_nonnull["region"])
+    np.testing.assert_array_equal(got_nonnull["n"], want_nonnull["n"])
+    np.testing.assert_allclose(got_nonnull["s"], want_nonnull["s"], rtol=2e-5)
+    # the ATLANTIS rows land in the null group, not a pass-through group
+    assert "ATLANTIS" not in set(got["region"].dropna())
+    got_null = int(got[got["region"].isna()]["n"].iloc[0])
+    assert got_null == int((_frame(ctx).nation == "ATLANTIS").sum())
+
+
+def test_lookup_replace_missing_third_arg(ctx):
+    """LOOKUP(expr, name, 'replacement'): Druid SQL's third argument."""
+    got = ctx.sql(
+        "SELECT LOOKUP(nation, 'n2r', 'UNKNOWN') AS region, count(*) AS n "
+        "FROM t GROUP BY LOOKUP(nation, 'n2r', 'UNKNOWN') ORDER BY region"
+    )
+    assert "UNKNOWN" in set(got["region"])
+    assert not got["region"].isna().any()
+    want_unknown = int((_frame(ctx).nation == "ATLANTIS").sum())
+    assert int(got[got["region"] == "UNKNOWN"]["n"].iloc[0]) == want_unknown
 
 
 def test_unknown_lookup_raises(ctx):
@@ -82,7 +100,8 @@ def test_lookup_registration_invalidates_plan_cache(ctx):
     # invalidate the cached plan (the extraction bakes the map in)
     ctx.register_lookup("n2r", {k: "X" for k in NATION_TO_REGION})
     after = ctx.sql(sql)
-    assert set(after["region"]) == {"X", "ATLANTIS"}
+    assert set(after["region"].dropna()) == {"X"}
+    assert after["region"].isna().any()  # ATLANTIS -> null group
     assert len(before) > len(after)
     # restore for other tests
     ctx.register_lookup("n2r", NATION_TO_REGION)
@@ -96,6 +115,9 @@ def test_lookup_wire_roundtrip(ctx):
         "FROM t GROUP BY LOOKUP(nation, 'n2r')"
     )
     q2 = query_from_druid(rw.query.to_druid())
+    # the decoded spec must equal the planned one (same lookup name, same
+    # normalized mapping) so engine caches treat them as the same query
+    assert q2 == rw.query
     df = ctx.engine.execute(q2, ctx.catalog.get("t"))
     assert "region" in df.columns and len(df) > 0
 

@@ -275,3 +275,38 @@ def test_theta_set_op_post_agg(served):
     assert abs(ev["union_k"] - len(ny | sf)) <= 2
     assert abs(ev["inter_k"] - len(ny & sf)) <= 2
     assert abs(ev["not_k"] - len(ny - sf)) <= 2
+
+
+def test_eternity_interval_spellings_decode_to_no_constraint():
+    """Eternity must be detected by parsed bounds, not string equality: a
+    real Druid client sends the canonical Long.MIN/MAX spelling (six-digit
+    years), others send milliless variants — none may turn into a real time
+    filter (which would demand a time column) or crash the ISO parser."""
+    from spark_druid_olap_tpu.models.wire import intervals_from_druid
+
+    for iv in (
+        "0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z",  # our spelling
+        "0000-01-01T00:00:00Z/3000-01-01T00:00:00Z",  # no millis
+        "-146136543-09-08T08:23:32.096Z/146140482-04-24T15:36:27.903Z",
+    ):
+        assert intervals_from_druid([iv]) == (), iv
+    # a real interval still decodes to real bounds
+    (got,) = intervals_from_druid(["2024-01-01T00:00:00Z/2024-02-01T00:00:00Z"])
+    import numpy as np
+
+    assert got[0] == int(np.datetime64("2024-01-01", "ms").astype(np.int64))
+    assert got[1] == int(np.datetime64("2024-02-01", "ms").astype(np.int64))
+
+
+def test_far_future_interval_stays_a_real_interval():
+    """A genuine interval at/past the year-3000 sentinel must keep its real
+    bounds (only true eternity decodes to no-constraint)."""
+    import numpy as np
+
+    from spark_druid_olap_tpu.models.wire import intervals_from_druid
+
+    (got,) = intervals_from_druid(["3500-01-01T00:00:00Z/3600-01-01T00:00:00Z"])
+    assert got[0] == int(np.datetime64("3500-01-01", "ms").astype(np.int64))
+    assert got[1] == int(np.datetime64("3600-01-01", "ms").astype(np.int64))
+    (got2,) = intervals_from_druid(["2999-06-01T00:00:00Z/3500-01-01T00:00:00Z"])
+    assert got2[1] == int(np.datetime64("3500-01-01", "ms").astype(np.int64))
