@@ -372,10 +372,22 @@ class TPUOlapContext:
         all_dims = q.dimensions
         frames = []
         k = len(all_dims)
-        for s in rw.grouping_sets:
-            dims = tuple(all_dims[i] for i in s)
-            sub = dataclasses.replace(q, dimensions=dims, subtotals=())
-            f = engine.execute(sub, ds)
+        subs = [
+            dataclasses.replace(
+                q,
+                dimensions=tuple(all_dims[i] for i in s),
+                subtotals=(),
+            )
+            for s in rw.grouping_sets
+        ]
+        # dispatch every set's device program before fetching any result:
+        # N sequential executions behind a network-tunneled TPU pay N full
+        # round trips; the batch path overlaps them
+        if hasattr(engine, "execute_groupby_batch"):
+            results = engine.execute_groupby_batch(subs, ds)
+        else:
+            results = [engine.execute(sub, ds) for sub in subs]
+        for s, f in zip(rw.grouping_sets, results):
             gid = 0
             present = set(s)
             for i in range(k):

@@ -494,16 +494,21 @@ class Engine:
         self._query_fn_cache[key] = seg_fn
         return seg_fn
 
-    def _execute_groupby_sparse(
+    def _dispatch_groupby_sparse(
         self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
     ):
-        """Sparse execution attempt over the (non-empty) segment scope.
+        """Sparse execution attempt over the (non-empty) segment scope,
+        split into an eager dispatch phase and a deferred fetch so N queries
+        (a grouping-set expansion) can overlap their device round trips.
 
-        Returns (df, reason): df is None when declining, with reason
+        Dispatches the tier-1 program asynchronously and returns
+        `resolve() -> (df, reason)`: df is None when declining, with reason
         "overflow" (deterministic — more distinct groups than slots: the
         caller pins the query off this path) or "error" (sparse program
         failed even after the Pallas-inner retry: fall back this execution
-        only; correctness never depends on this path)."""
+        only; correctness never depends on this path).  A trace/compile
+        failure at dispatch time is carried into resolve() and handled by
+        the same downgrade path as an execution failure."""
         from ..ops.sparse_groupby import merge_sparse_states
 
         segs = self._segments_in_scope(q, ds)
@@ -513,7 +518,7 @@ class Engine:
         # segment would overflow the capacity by construction.
         selective = q.filter is not None or bool(q.intervals)
 
-        def run(row_capacity=None):
+        def dispatch(row_capacity=None):
             seg_fn = self._sparse_program(
                 q, ds, lowering, row_capacity=row_capacity
             )
@@ -529,7 +534,7 @@ class Engine:
                     if state is None
                     else merge_sparse_states(state, st, num_groups=G)
                 )
-            return jax.device_get(state)
+            return state
 
         def evict():
             # only THIS query's sparse programs — other queries' compiled
@@ -542,71 +547,95 @@ class Engine:
             ]:
                 self._query_fn_cache.pop(k)
 
-        from ..ops.pallas_groupby import pallas_available
-
         qkey = _query_key(q, ds)
+        from ..ops import sparse_groupby as _sg
 
-        def run_tiered():
-            # tier 1: filter-compacted sort (128K-row sort network by
-            # default).  On row overflow the kernel's exact survivor count
-            # picks the smallest adequate ROW_CAPACITY_LADDER rung (full-R
-            # sort only past the top rung) — sort cost grows ~linearly with
-            # capacity, so q3_1-class queries (180K survivors of 6M rows)
-            # stay 3-4x off the full sort.  The rung is deterministic per
-            # (query, data) and remembered.  Slot overflow falls out below.
-            from ..ops import sparse_groupby as _sg
+        # tier 1: filter-compacted sort (128K-row sort network by default,
+        # or the rung remembered from a previous overflow on this query)
+        cap = (
+            self._sparse_row_capacity.get(qkey, _sg.ROW_CAPACITY)
+            if selective
+            else None
+        )
 
-            cap = (
-                self._sparse_row_capacity.get(qkey, _sg.ROW_CAPACITY)
-                if selective
-                else None
-            )
-            host = run(row_capacity=cap)
-            if cap is not None and bool(host["row_overflow"]):
+        def fetch_tiered(state, row_capacity):
+            # On row overflow the kernel's exact survivor count picks the
+            # smallest adequate ROW_CAPACITY_LADDER rung (full-R sort only
+            # past the top rung) — sort cost grows ~linearly with capacity,
+            # so q3_1-class queries (180K survivors of 6M rows) stay 3-4x
+            # off the full sort.  The rung is deterministic per (query,
+            # data) and remembered.  Slot overflow falls out in resolve().
+            host = jax.device_get(state)
+            if row_capacity is not None and bool(host["row_overflow"]):
                 n = int(host["n_rows"])
                 new_cap = next(
-                    (c for c in _sg.ROW_CAPACITY_LADDER if c >= n and c > cap),
+                    (
+                        c
+                        for c in _sg.ROW_CAPACITY_LADDER
+                        if c >= n and c > row_capacity
+                    ),
                     None,
                 )
                 self._sparse_row_capacity[qkey] = new_cap
                 log.info(
                     "sparse row compaction overflowed %d of capacity %d; "
                     "rerunning at %s (remembered for repeats)",
-                    n, cap,
+                    n, row_capacity,
                     "full-segment sort" if new_cap is None else new_cap,
                 )
-                host = run(row_capacity=new_cap)
+                host = jax.device_get(dispatch(row_capacity=new_cap))
             return host
 
+        # phase 1: dispatch (async — no fetch).  Exceptions are deferred
+        # into resolve() so batch callers see the same decline protocol as
+        # execution failures.
+        state = dispatch_exc = None
         try:
-            host = run_tiered()
-        except Exception:
-            evict()
-            # mirror _call_segment_program: a Mosaic failure of the Pallas
-            # inner kernel downgrades to the scatter inner, not to the
-            # whole-query scatter path
-            if self._pallas_broken or not pallas_available():
-                return None, "error"
-            self._pallas_broken = True
+            state = dispatch(row_capacity=cap)
+        except Exception as exc:  # noqa: BLE001 — re-raised in resolve
+            dispatch_exc = exc
+
+        def resolve():
+            from ..ops.pallas_groupby import pallas_available
+
             try:
-                host = run_tiered()
+                if dispatch_exc is not None:
+                    raise dispatch_exc
+                host = fetch_tiered(state, cap)
             except Exception:
-                self._pallas_broken = False
                 evict()
-                return None, "error"
-        if bool(host["overflow"]):
-            return None, "overflow"
-        df = finalize_groupby(
-            q,
-            lowering.dims,
-            lowering.la,
-            np.asarray(host["sums"]),
-            np.asarray(host["mins"]),
-            np.asarray(host["maxs"]),
-            {},
-            slot_gids=np.asarray(host["gids"]),
-        )
-        return df, "ok"
+                # mirror _call_segment_program: a Mosaic failure of the
+                # Pallas inner kernel downgrades to the scatter inner, not
+                # to the whole-query scatter path
+                if self._pallas_broken or not pallas_available():
+                    return None, "error"
+                self._pallas_broken = True
+                try:
+                    # the failed attempt may already have learned the right
+                    # row-capacity rung; retry there, not at the stale cap
+                    retry_cap = self._sparse_row_capacity.get(qkey, cap)
+                    host = fetch_tiered(
+                        dispatch(row_capacity=retry_cap), retry_cap
+                    )
+                except Exception:
+                    self._pallas_broken = False
+                    evict()
+                    return None, "error"
+            if bool(host["overflow"]):
+                return None, "overflow"
+            df = finalize_groupby(
+                q,
+                lowering.dims,
+                lowering.la,
+                np.asarray(host["sums"]),
+                np.asarray(host["mins"]),
+                np.asarray(host["maxs"]),
+                {},
+                slot_gids=np.asarray(host["gids"]),
+            )
+            return df, "ok"
+
+        return resolve
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
         """GroupBy with one idempotent re-dispatch on transient device
@@ -646,6 +675,58 @@ class Engine:
             self._device_cache.pop(k)
 
     def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
+        return self._dispatch_groupby_once(q, ds)()
+
+    def execute_groupby_batch(self, queries, ds: DataSource):
+        """Execute N GroupBy queries with overlapped device round trips:
+        dispatch every query's program first (async), then resolve in
+        order, so the fetch latency of query i hides the compute of i+1..N.
+        This is what a grouping-set (CUBE/ROLLUP) expansion calls — behind
+        a network-tunneled TPU, N sequential executions would pay N full
+        round trips.  Per-query transient failures fall back to the normal
+        retrying execution path, serially (rare; correctness first)."""
+        resolves = []
+        for q in queries:
+            try:
+                resolves.append(self._dispatch_groupby_once(q, ds))
+            except NotImplementedError:
+                raise
+            except RuntimeError as err:
+                log.warning(
+                    "batch dispatch failed (%s: %s); query will run on the "
+                    "serial path", type(err).__name__, err,
+                )
+                self._evict_query_state(
+                    groupby_with_time_granularity(q), ds
+                )
+                resolves.append(None)
+        out = []
+        for q, resolve in zip(queries, resolves):
+            if resolve is None:
+                out.append(self._execute_groupby(q, ds))
+                continue
+            try:
+                out.append(resolve())
+            except NotImplementedError:
+                raise
+            except RuntimeError as err:
+                log.warning(
+                    "transient device failure in batch resolve (%s: %s); "
+                    "evicting cached state and re-dispatching once",
+                    type(err).__name__, err,
+                )
+                self._evict_query_state(
+                    groupby_with_time_granularity(q), ds
+                )
+                out.append(self._execute_groupby(q, ds))
+        return out
+
+    def _dispatch_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
+        """Phase 1 of one GroupBy execution: build/launch the device
+        programs (async dispatch, no fetch) and return `resolve() -> df`,
+        which fetches, finalizes, and publishes metrics.  The synchronous
+        path is `self._dispatch_groupby_once(q, ds)()`; batch callers
+        dispatch all queries before resolving any."""
         import time as _time
 
         from .metrics import QueryMetrics
@@ -654,6 +735,7 @@ class Engine:
         q = groupby_with_time_granularity(q)
         lowering = self._lowering_for(q, ds)
         segs = self._segments_in_scope(q, ds)
+        qkey = _query_key(q, ds)
         m = self._m = QueryMetrics(
             query_type="groupBy",
             strategy=self._resolve_strategy(lowering.num_groups),
@@ -661,17 +743,60 @@ class Engine:
             segments=len(segs),
             num_groups=lowering.num_groups,
         )
+
+        # In batch mode resolve() runs long after dispatch, with other
+        # queries' fetch+finalize in between — timings anchored at dispatch
+        # would absorb all of it.  So: phase 1 records its own elapsed time,
+        # and resolve() measures from its own entry (for the synchronous
+        # path resolve starts immediately after dispatch, so the split is
+        # equivalent to the old dispatch-anchored measurement).
+        dispatch_ms = 0.0
+        t_resolve = None
+
+        def finish():
+            now = _time.perf_counter()
+            if t_resolve is not None:
+                m.total_ms = dispatch_ms + (now - t_resolve) * 1e3
+            else:  # phase-1 failure: resolve never started
+                m.total_ms = (now - t_total) * 1e3
+            m.bytes_resident = self.bytes_resident()
+            self.last_metrics = m
+            self._m = None
+            log.info("%s", m.describe())
+
+        sparse_resolve = None
+        dense_state = None
         try:
-            if self._sparse_eligible(lowering) and segs:
-                qkey = _query_key(q, ds)
-                if qkey not in self._sparse_disabled:
-                    m.strategy = "sparse"
-                    t0 = _time.perf_counter()
-                    out, reason = self._execute_groupby_sparse(
-                        q, ds, lowering
-                    )
+            if (
+                self._sparse_eligible(lowering)
+                and segs
+                and qkey not in self._sparse_disabled
+            ):
+                m.strategy = "sparse"
+                sparse_resolve = self._dispatch_groupby_sparse(
+                    q, ds, lowering
+                )
+            else:
+                dense_state = self._partials_for_query(
+                    q, ds, lowering=lowering
+                )
+        except BaseException:
+            finish()
+            raise
+        dispatch_ms = (_time.perf_counter() - t_total) * 1e3
+
+        def resolve():
+            nonlocal dense_state, t_resolve
+            self._m = m
+            t_resolve = _time.perf_counter()
+            try:
+                if sparse_resolve is not None:
+                    out, reason = sparse_resolve()
                     if out is not None:
-                        m.device_ms = (_time.perf_counter() - t0) * 1e3
+                        m.device_ms = (
+                            (_time.perf_counter() - t_resolve) * 1e3
+                            + dispatch_ms
+                        )
                         self._sparse_error_counts.pop(qkey, None)
                         return out
                     pinned = False
@@ -692,35 +817,42 @@ class Engine:
                         m.strategy,
                         " (pinned)" if pinned else "",
                     )
-            t0 = _time.perf_counter()
-            dims, la, G, sums, mins, maxs, sketch_states = (
-                self._partials_for_query(q, ds, lowering=lowering)
-            )
-            # ONE device_get for everything: each separate host fetch of a
-            # device buffer pays a full round trip (dozens of ms when the TPU
-            # sits behind a network tunnel); a single pytree fetch pays one.
-            sums, mins, maxs, sketch_states = jax.device_get(
-                (sums, mins, maxs, sketch_states)
-            )
-            m.device_ms = (
-                (_time.perf_counter() - t0) * 1e3
-                - m.h2d_ms
-                - m.compile_ms
-            )
-            t0 = _time.perf_counter()
-            out = finalize_groupby(
-                q, dims, la,
-                np.asarray(sums), np.asarray(mins), np.asarray(maxs),
-                {k: np.asarray(v) for k, v in sketch_states.items()},
-            )
-            m.finalize_ms = (_time.perf_counter() - t0) * 1e3
-            return out
-        finally:
-            m.total_ms = (_time.perf_counter() - t_total) * 1e3
-            m.bytes_resident = self.bytes_resident()
-            self.last_metrics = m
-            self._m = None
-            log.info("%s", m.describe())
+                    # serial fallback dispatch (rare): sparse declined, so
+                    # the dense program launches now
+                    dense_state = self._partials_for_query(
+                        q, ds, lowering=lowering
+                    )
+                t_fetch = _time.perf_counter()
+                dims, la, G, sums, mins, maxs, sketch_states = dense_state
+                # ONE device_get for everything: each separate host fetch
+                # of a device buffer pays a full round trip (dozens of ms
+                # when the TPU sits behind a network tunnel); a single
+                # pytree fetch pays one.
+                sums, mins, maxs, sketch_states = jax.device_get(
+                    (sums, mins, maxs, sketch_states)
+                )
+                # h2d/compile happened during phase 1, so the dispatch share
+                # (minus those) plus this query's own fetch wait is the
+                # device time; overlap hidden behind other queries' resolves
+                # is deliberately NOT attributed here
+                m.device_ms = (
+                    (_time.perf_counter() - t_fetch) * 1e3
+                    + dispatch_ms
+                    - m.h2d_ms
+                    - m.compile_ms
+                )
+                t0 = _time.perf_counter()
+                out = finalize_groupby(
+                    q, dims, la,
+                    np.asarray(sums), np.asarray(mins), np.asarray(maxs),
+                    {k: np.asarray(v) for k, v in sketch_states.items()},
+                )
+                m.finalize_ms = (_time.perf_counter() - t0) * 1e3
+                return out
+            finally:
+                finish()
+
+        return resolve
 
     # -- timeseries: a groupby whose only dimension is the time bucket -------
 
