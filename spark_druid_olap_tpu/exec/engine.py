@@ -588,7 +588,13 @@ class Engine:
 
         # phase 1: dispatch (async — no fetch).  Exceptions are deferred
         # into resolve() so batch callers see the same decline protocol as
-        # execution failures.
+        # execution failures.  Record which inner kernel THIS dispatch used:
+        # in batch mode an earlier query's resolve may flip _pallas_broken
+        # between our dispatch and our resolve, and the downgrade retry must
+        # key on what we actually ran, not the current flag.
+        from ..ops.pallas_groupby import pallas_available
+
+        used_pallas_inner = not self._pallas_broken and pallas_available()
         state = dispatch_exc = None
         try:
             state = dispatch(row_capacity=cap)
@@ -596,19 +602,21 @@ class Engine:
             dispatch_exc = exc
 
         def resolve():
-            from ..ops.pallas_groupby import pallas_available
-
+            nonlocal state
             try:
                 if dispatch_exc is not None:
                     raise dispatch_exc
                 host = fetch_tiered(state, cap)
+                state = None  # free the device partials promptly
             except Exception:
+                state = None
                 evict()
                 # mirror _call_segment_program: a Mosaic failure of the
                 # Pallas inner kernel downgrades to the scatter inner, not
                 # to the whole-query scatter path
-                if self._pallas_broken or not pallas_available():
+                if not used_pallas_inner or not pallas_available():
                     return None, "error"
+                we_broke_it = not self._pallas_broken
                 self._pallas_broken = True
                 try:
                     # the failed attempt may already have learned the right
@@ -618,7 +626,10 @@ class Engine:
                         dispatch(row_capacity=retry_cap), retry_cap
                     )
                 except Exception:
-                    self._pallas_broken = False
+                    # only unflag if WE set the flag — an earlier query may
+                    # have legitimately discovered the broken kernel
+                    if we_broke_it:
+                        self._pallas_broken = False
                     evict()
                     return None, "error"
             if bool(host["overflow"]):
@@ -701,7 +712,8 @@ class Engine:
                 )
                 resolves.append(None)
         out = []
-        for q, resolve in zip(queries, resolves):
+        for i, (q, resolve) in enumerate(zip(queries, resolves)):
+            resolves[i] = None  # release the closure (and its device state)
             if resolve is None:
                 out.append(self._execute_groupby(q, ds))
                 continue
@@ -784,6 +796,11 @@ class Engine:
             finish()
             raise
         dispatch_ms = (_time.perf_counter() - t_total) * 1e3
+        # h2d/compile recorded so far belong to the phase-1 dispatch window;
+        # anything recorded later (the sparse-declined dense fallback inside
+        # resolve) is outside both timing windows and must not be subtracted
+        phase1_h2d_ms = m.h2d_ms
+        phase1_compile_ms = m.compile_ms
 
         def resolve():
             nonlocal dense_state, t_resolve
@@ -824,6 +841,7 @@ class Engine:
                     )
                 t_fetch = _time.perf_counter()
                 dims, la, G, sums, mins, maxs, sketch_states = dense_state
+                dense_state = None  # free the device partials promptly
                 # ONE device_get for everything: each separate host fetch
                 # of a device buffer pays a full round trip (dozens of ms
                 # when the TPU sits behind a network tunnel); a single
@@ -831,15 +849,16 @@ class Engine:
                 sums, mins, maxs, sketch_states = jax.device_get(
                     (sums, mins, maxs, sketch_states)
                 )
-                # h2d/compile happened during phase 1, so the dispatch share
-                # (minus those) plus this query's own fetch wait is the
-                # device time; overlap hidden behind other queries' resolves
-                # is deliberately NOT attributed here
-                m.device_ms = (
+                # the phase-1 dispatch share (minus its h2d/compile) plus
+                # this query's own fetch wait is the device time; overlap
+                # hidden behind other queries' resolves is deliberately NOT
+                # attributed here
+                m.device_ms = max(
+                    0.0,
                     (_time.perf_counter() - t_fetch) * 1e3
                     + dispatch_ms
-                    - m.h2d_ms
-                    - m.compile_ms
+                    - phase1_h2d_ms
+                    - phase1_compile_ms,
                 )
                 t0 = _time.perf_counter()
                 out = finalize_groupby(

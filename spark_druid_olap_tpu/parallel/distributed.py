@@ -55,6 +55,7 @@ from ..ops import hll as hll_ops
 from ..ops import theta as theta_ops
 from ..ops.groupby import choose_block_rows, dense_partial_aggregate
 from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh
+from .multihost import put_sharded
 
 
 class DistributedEngine:
@@ -125,7 +126,7 @@ class DistributedEngine:
                 host = np.concatenate(
                     [host, np.full(padded - len(host), fill, dtype=host.dtype)]
                 )
-            arr = jax.device_put(host, sharding)
+            arr = put_sharded(host, sharding)
             self._shard_cache[key] = arr
             return arr
 
@@ -144,7 +145,7 @@ class DistributedEngine:
                 host = np.concatenate(
                     [host, np.zeros(padded - len(host), dtype=bool)]
                 )
-            valid = jax.device_put(host, sharding)
+            valid = put_sharded(host, sharding)
             self._shard_cache[vkey] = valid
         cols["__valid"] = valid
         if ds.time_column and ds.time_column in cols:

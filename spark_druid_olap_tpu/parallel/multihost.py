@@ -1,0 +1,145 @@
+"""Multi-host (multi-slice / DCN) support for the distributed engine.
+
+Reference parity: the reference's communication backend is Apache HttpClient
+to Druid nodes plus ZooKeeper/Curator discovery (SURVEY.md §2 communication
+row, §5 distributed-backend row `[U]`).  The TPU-native replacement has two
+halves:
+
+* **discovery / rendezvous** — `jax.distributed.initialize`: on Cloud TPU
+  pods the coordinator and process ids come from the environment, on other
+  fleets they are passed explicitly.  This replaces CuratorConnection: after
+  it returns, `jax.devices()` spans every host's chips and the runtime owns
+  membership (no ZK znodes to watch).
+* **data placement** — inside one process `jax.device_put(host, sharding)`
+  is enough; across processes each host only holds ITS rows (its
+  "historical" segments), so global arrays are assembled with
+  `jax.make_array_from_process_local_data` — each process contributes its
+  addressable shards and XLA's collectives (ICI within a slice, DCN between
+  slices) do the rest at execution time.
+
+The collectives in `parallel/distributed.py` (`psum`/`pmin`/`pmax`/
+`all_gather`) are mesh-topology-agnostic: on a multi-slice mesh built by
+`hybrid_mesh()` the data axis maps to DCN (cheap per-device partials, one
+small merged state crosses slices) and the groups axis to ICI, matching the
+bandwidth hierarchy the way SURVEY.md §5 prescribes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.log import get_logger
+
+log = get_logger("parallel.multihost")
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join (or form) the multi-host JAX runtime.  The CuratorConnection
+    analog: after this, discovery is done — `jax.devices()` is global.
+
+    Safe to call unconditionally: single-process sessions (everything in
+    this repo's tests, and any laptop use) return False without touching
+    the runtime; repeated calls are no-ops.  Returns True when a
+    multi-process runtime is (already) up."""
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        _initialized = True
+        return True
+    if coordinator_address is None and num_processes is None:
+        # no explicit rendezvous and no pod metadata in the environment:
+        # stay single-process rather than hanging on a coordinator that
+        # will never answer
+        import os
+
+        if not any(
+            k in os.environ
+            for k in ("COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID")
+        ):
+            return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+        log.info(
+            "joined distributed runtime: process %d/%d, %d global devices",
+            jax.process_index(), jax.process_count(), jax.device_count(),
+        )
+        return True
+    except RuntimeError as err:  # already initialized by the launcher
+        if "already initialized" in str(err):
+            _initialized = True
+            return True
+        raise
+
+
+def hybrid_mesh(n_groups: int = 1):
+    """A (data, groups) mesh laid out for the DCN x ICI hierarchy.
+
+    Multi-slice: the data axis spans slices over DCN (each slice aggregates
+    its own rows; only the [G, M] partial state crosses DCN once per query
+    — the broker-merge shape), the groups axis stays inside a slice on ICI.
+    Single-slice / single-host: identical to `mesh.make_mesh`."""
+    from jax.sharding import Mesh
+
+    from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh
+
+    if jax.process_count() <= 1:
+        return make_mesh(n_groups=n_groups)
+    from jax.experimental import mesh_utils
+
+    n_dev = jax.device_count()
+    devs = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(n_dev // jax.process_count() // n_groups, n_groups),
+        dcn_mesh_shape=(jax.process_count(), 1),
+        process_is_granule=True,
+    )
+    return Mesh(devs, (DATA_AXIS, GROUPS_AXIS))
+
+
+def put_sharded(host: np.ndarray, sharding) -> jax.Array:
+    """Place a host array laid out GLOBALLY under `sharding`, multi-host
+    aware.
+
+    Single-process: plain `jax.device_put` (the fast path every test and
+    single-chip session takes).  Multi-process: every process knows the
+    global row layout (the catalog is deterministic), but only materializes
+    and transfers the shards its own devices address —
+    `make_array_from_callback` slices `host` per-device, so no host pays
+    H2D for another slice's rows (the DruidRDD
+    one-partition-per-historical analog)."""
+    if jax.process_count() <= 1:
+        return jax.device_put(host, sharding)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def local_segments(segments) -> list:
+    """This process's slice of a datasource's segments (round-robin by
+    process index) — which rows each "historical" owns.  Deterministic so
+    every process agrees on the global layout without coordination."""
+    pc, pi = jax.process_count(), jax.process_index()
+    if pc <= 1:
+        return list(segments)
+    return [s for i, s in enumerate(segments) if i % pc == pi]
+
+
+def process_info() -> Dict[str, int]:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": jax.device_count(),
+    }
