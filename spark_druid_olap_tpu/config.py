@@ -33,6 +33,9 @@ class SessionConfig:
     # COUNT(DISTINCT x) handling: "approx" rewrites to a sketch (Druid
     # default); "exact" uses the exact distinct path; "error" rejects.
     count_distinct_mode: str = "approx"
+    # APPROX_QUANTILE sample size K (quantilesDoublesSketch k analog):
+    # rank error ~ O(sqrt(p(1-p)/K)), ~±1.5% at the median for 1024
+    quantiles_k: int = 1024
 
     # cost model (reference: DruidQueryCostModel constants via SQLConf).
     # Units are MICROSECONDS so the constants are physically measurable:

@@ -388,6 +388,12 @@ class Engine:
                     sk[agg.name] = hll_ops.partial_hll(
                         agg, cols, gid, amask, G
                     )
+                elif isinstance(agg, A.QuantilesSketch):
+                    from ..ops import quantiles as quantiles_ops
+
+                    sk[agg.name] = quantiles_ops.partial_quantiles(
+                        agg, cols, gid, amask, G
+                    )
                 else:
                     sk[agg.name] = theta_ops.partial_theta(
                         agg, cols, gid, amask, G

@@ -118,6 +118,16 @@ def eval_post_agg(
                 "aggregation in the same query)"
             )
         return hll_estimate(states[p.field_name])
+    if isinstance(p, A.QuantileFromSketch):
+        from ..ops.quantiles import estimate as quantile_estimate
+
+        if states is None or p.field_name not in states:
+            raise KeyError(
+                f"quantilesDoublesSketchToQuantile over {p.field_name!r}: "
+                "no raw quantiles state available (field must name a "
+                "quantilesDoublesSketch aggregation in the same query)"
+            )
+        return quantile_estimate(states[p.field_name], p.fraction)
     if isinstance(p, A.ThetaSketchEstimate):
         from ..ops.theta import estimate as theta_estimate
 
@@ -192,6 +202,10 @@ def _merge_sketch_states(
             acc[agg.name] = st
         elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
             acc[agg.name] = jnp.maximum(prev, st)
+        elif isinstance(agg, A.QuantilesSketch):
+            from ..ops import quantiles as quantiles_ops
+
+            acc[agg.name] = quantiles_ops.merge_states(prev, st, agg.size)
         else:
             acc[agg.name] = theta_ops.merge_states(prev, st, agg.size)
 
@@ -276,6 +290,14 @@ def finalize_groupby(
         raw_states[agg.name] = st
         if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
             table[agg.name] = np.rint(hll_ops.estimate(st)).astype(np.int64)
+        elif isinstance(agg, A.QuantilesSketch):
+            from ..ops import quantiles as quantiles_ops
+
+            # Druid finalizes a quantiles sketch to its N; the state
+            # carries the exact per-group row count in its trailing
+            # counter row, so this is exact at any scale.  Quantile values
+            # come from the QuantileFromSketch post-agg over the raw state
+            table[agg.name] = quantiles_ops.count(st).astype(np.int64)
         else:
             table[agg.name] = np.rint(theta_ops.estimate(st)).astype(np.int64)
 

@@ -266,7 +266,10 @@ def _lower_aggs(
             la.value_fns[name] = lambda cols, fn=fn, dicts=dicts: jnp.asarray(
                 fn(DecodedView(cols, dicts))
             ).astype(jnp.float32)
-        elif isinstance(agg, (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch)):
+        elif isinstance(
+            agg,
+            (A.HyperUnique, A.CardinalityAgg, A.ThetaSketch, A.QuantilesSketch),
+        ):
             la.sketch_aggs.append(agg)
             la.long_valued[name] = True
         else:
@@ -526,6 +529,17 @@ def empty_partials(la: LoweredAggs, G: int):
         if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
             sketch_states[agg.name] = jnp.zeros(
                 (G, 1 << agg.precision), jnp.int32
+            )
+        elif isinstance(agg, A.QuantilesSketch):
+            from ..ops.quantiles import SENTINEL_P
+
+            # [G, K+1, 2]: K empty sample slots + the zero N-counter row
+            pr = jnp.full((G, agg.size), SENTINEL_P, jnp.int32)
+            vb = jnp.zeros((G, agg.size), jnp.int32)
+            sample = jnp.stack([pr, vb], axis=-1)
+            extra = jnp.zeros((G, 1, 2), jnp.int32)
+            sketch_states[agg.name] = jnp.concatenate(
+                [sample, extra], axis=1
             )
         else:
             from ..ops.theta import SENTINEL

@@ -75,6 +75,8 @@ def agg_from_druid(d: Dict[str, Any]) -> A.Aggregation:
         )
     if t == "thetaSketch":
         return A.ThetaSketch(d["name"], d["fieldName"], d.get("size", 4096))
+    if t == "quantilesDoublesSketch":
+        return A.QuantilesSketch(d["name"], d["fieldName"], d.get("k", 1024))
     if t == "filtered":
         return A.FilteredAgg(
             filter_from_druid(d["filter"]), agg_from_druid(d["aggregator"])
@@ -109,6 +111,11 @@ def post_agg_from_druid(d: Dict[str, Any]) -> A.PostAggregation:
                 raise WireError("thetaSketchSetOp requires fields")
             return A.ThetaSketchSetOp(d["name"], fn, fields)
         return A.ThetaSketchEstimate(d["name"], f.get("fieldName", d.get("fieldName")))
+    if t == "quantilesDoublesSketchToQuantile":
+        f = d.get("field", {})
+        return A.QuantileFromSketch(
+            d["name"], f.get("fieldName", d.get("fieldName")), d["fraction"]
+        )
     raise WireError(f"unsupported postAggregation type {t!r}")
 
 

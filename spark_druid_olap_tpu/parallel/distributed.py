@@ -52,6 +52,7 @@ from ..exec.engine import (
 from ..models import aggregations as A
 from ..models import query as Q
 from ..ops import hll as hll_ops
+from ..ops import quantiles as quantiles_ops
 from ..ops import theta as theta_ops
 from ..ops.groupby import choose_block_rows, dense_partial_aggregate
 from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh
@@ -214,6 +215,17 @@ class DistributedEngine:
                 if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
                     st = hll_ops.partial_hll(agg, cols, gid_l, amask, Gl)
                     sk_out[agg.name] = lax.pmax(st, DATA_AXIS)
+                elif isinstance(agg, A.QuantilesSketch):
+                    st = quantiles_ops.partial_quantiles(
+                        agg, cols, gid_l, amask, Gl
+                    )
+                    gathered = lax.all_gather(st, DATA_AXIS)  # [nd, Gl, K, 2]
+                    acc = gathered[0]
+                    for i in range(1, gathered.shape[0]):
+                        acc = quantiles_ops.merge_states(
+                            acc, gathered[i], agg.size
+                        )
+                    sk_out[agg.name] = acc
                 else:
                     st = theta_ops.partial_theta(agg, cols, gid_l, amask, Gl)
                     gathered = lax.all_gather(st, DATA_AXIS)  # [nd, Gl, K]

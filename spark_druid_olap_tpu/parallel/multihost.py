@@ -49,10 +49,14 @@ def initialize(
     Safe to call unconditionally: single-process sessions (everything in
     this repo's tests, and any laptop use) return False without touching
     the runtime; repeated calls are no-ops.  Returns True when a
-    multi-process runtime is (already) up."""
+    multi-process runtime is (already) up.
+
+    MUST run before any other JAX call — `jax.distributed.initialize`
+    refuses once the XLA backend exists, so this function deliberately
+    avoids `jax.process_count()`/`jax.devices()` until after the
+    rendezvous."""
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
         return True
     if coordinator_address is None and num_processes is None:
         # no explicit rendezvous and no pod metadata in the environment:
@@ -77,8 +81,13 @@ def initialize(
             jax.process_index(), jax.process_count(), jax.device_count(),
         )
         return True
-    except RuntimeError as err:  # already initialized by the launcher
-        if "already initialized" in str(err):
+    except RuntimeError as err:
+        # tolerate a launcher that already initialized the distributed
+        # runtime; surface "backend already initialized" (caller ran JAX
+        # ops before rendezvous) — that one is a real ordering bug
+        if "already initialized" in str(err).lower() and "backend" not in str(
+            err
+        ).lower():
             _initialized = True
             return True
         raise

@@ -71,16 +71,18 @@ class AggExpr:
 
     name: str
     fn: str  # sum | count | min | max | avg | count_distinct |
-    #          approx_count_distinct | hll | theta
+    #          approx_count_distinct | hll | theta | approx_quantile
     arg: Optional[Expr]  # None for count(*)
     distinct: bool = False
     filter: Optional[Expr] = None
+    args: tuple = ()  # extra literal args (approx_quantile: fraction[, k])
 
     def __str__(self):
         inner = "*" if self.arg is None else str(self.arg)
+        extra = "".join(f", {a}" for a in self.args)
         d = "DISTINCT " if self.distinct else ""
         f = f" FILTER ({self.filter})" if self.filter is not None else ""
-        return f"{self.fn}({d}{inner}){f}"
+        return f"{self.fn}({d}{inner}{extra}){f}"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -211,6 +211,7 @@ class Planner:
                 substitute(ae.arg, env) if ae.arg is not None else None,
                 ae.distinct,
                 substitute(ae.filter, env) if ae.filter is not None else None,
+                ae.args,
             )
             a_list, p_list, b = translate_aggregate(ae2, ds, b, self.cfg)
             aggs.extend(a_list)

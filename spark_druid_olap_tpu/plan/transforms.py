@@ -397,6 +397,40 @@ def translate_aggregate(
             b,
         )
 
+    if fn == "approx_quantile":
+        # APPROX_QUANTILE(col, fraction[, k]) -> hidden quantiles sketch +
+        # quantile-extracting post-agg (the Druid SQL APPROX_QUANTILE_DS
+        # lowering: quantilesDoublesSketch + ...ToQuantile)
+        if not isinstance(arg, E.Col):
+            raise RewriteError("APPROX_QUANTILE over expressions unsupported")
+        try:
+            meta = ds.meta(arg.name)
+        except KeyError:
+            raise RewriteError(f"unknown column {arg.name!r}")
+        if arg.name in ds.dicts:
+            # dimension columns hold dictionary CODES on device; a quantile
+            # over codes is not a quantile over values — reject rather than
+            # silently answer the wrong question
+            raise RewriteError(
+                "APPROX_QUANTILE requires a numeric metric column"
+            )
+        if not agg.args:
+            raise RewriteError("APPROX_QUANTILE requires a fraction")
+        frac = float(agg.args[0])
+        if not 0.0 <= frac <= 1.0:
+            raise RewriteError("APPROX_QUANTILE fraction must be in [0, 1]")
+        k = int(agg.args[1]) if len(agg.args) > 1 else cfg.quantiles_k
+        if k < 1:
+            # k=0 would build a zero-width sample and return NaN for every
+            # group — a silent wrong answer, not an error
+            raise RewriteError("APPROX_QUANTILE k must be >= 1")
+        sk_name = f"{name}__qsk"
+        return (
+            [wrap(A.QuantilesSketch(sk_name, arg.name, size=k))],
+            [A.QuantileFromSketch(name, sk_name, frac)],
+            b,
+        )
+
     if agg.distinct and fn in ("sum", "avg"):
         # MIN/MAX(DISTINCT) == MIN/MAX and passes through; SUM/AVG(DISTINCT)
         # would silently double-count duplicates — refuse, never wrong data

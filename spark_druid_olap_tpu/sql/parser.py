@@ -38,10 +38,14 @@ class AggCall(E.Expr):
     arg: Optional[E.Expr]
     distinct: bool = False
     filter: Optional[E.Expr] = None
+    args: tuple = ()  # extra literal args (APPROX_QUANTILE's fraction, k)
 
     def __str__(self):
+        # feeds the analyzer's dedup key: every distinguishing field must
+        # appear, or two different aggregates collapse into one AggRef
         inner = "*" if self.arg is None else str(self.arg)
-        return f"{self.fn}({'DISTINCT ' if self.distinct else ''}{inner})"
+        extra = "".join(f", {a}" for a in self.args)
+        return f"{self.fn}({'DISTINCT ' if self.distinct else ''}{inner}{extra})"
 
 
 @dataclasses.dataclass
@@ -457,7 +461,41 @@ class Parser:
             out = E.IfExpr(c, v, out)
         return out
 
+    def _filter_clause(self) -> Optional[E.Expr]:
+        """Optional SQL `FILTER (WHERE <cond>)` after an aggregate call."""
+        if not self.accept_kw("filter"):
+            return None
+        self.expect_op("(")
+        self.expect_kw("where")
+        cond = self.expr()
+        self.expect_op(")")
+        return cond
+
     def _call(self, fn: str) -> E.Expr:
+        if fn in ("approx_quantile", "approx_quantile_ds"):
+            # APPROX_QUANTILE[_DS](expr, fraction[, k]) — Druid SQL's
+            # DataSketches quantile aggregate
+            arg = self.expr()
+            self.expect_op(",")
+            frac = self.expr()
+            if not isinstance(frac, E.Literal) or not isinstance(
+                frac.value, (int, float)
+            ):
+                raise ParseError(
+                    "APPROX_QUANTILE fraction must be a numeric literal"
+                )
+            extra = (float(frac.value),)
+            if self.accept_op(","):
+                k = self.expr()
+                if not isinstance(k, E.Literal) or not isinstance(
+                    k.value, int
+                ):
+                    raise ParseError("APPROX_QUANTILE k must be an integer")
+                extra = extra + (int(k.value),)
+            self.expect_op(")")
+            return AggCall(
+                "approx_quantile", arg, False, self._filter_clause(), extra
+            )
         if fn in AGG_FNS or fn == "count":
             distinct = bool(self.accept_kw("distinct"))
             if self.accept_op("*"):
@@ -470,13 +508,7 @@ class Parser:
                 self.expect_op(")")
             else:
                 self.expect_op(")")
-            filt = None
-            if self.accept_kw("filter"):
-                self.expect_op("(")
-                self.expect_kw("where")
-                filt = self.expr()
-                self.expect_op(")")
-            return AggCall(fn, arg, distinct, filt)
+            return AggCall(fn, arg, distinct, self._filter_clause())
         if fn == "date_trunc":
             gran = self.expr()
             self.expect_op(",")
@@ -784,7 +816,7 @@ class Analyzer:
             if fn == "count" and e.distinct:
                 fn = "count_distinct"
             self.agg_exprs.append(
-                L.AggExpr(name, fn, e.arg, e.distinct, e.filter)
+                L.AggExpr(name, fn, e.arg, e.distinct, e.filter, e.args)
             )
             self.agg_by_key[key] = name
             return E.AggRef(name)
