@@ -285,7 +285,9 @@ class TPUOlapContext:
 
         engine = self._engine_for(rw)
         if rw.grouping_sets and isinstance(rw.query, Q.GroupByQuery):
-            df = self._execute_grouping_sets(rw, ds, engine)
+            df = execute_grouping_sets(
+                rw.query, rw.grouping_sets, ds, engine
+            )
         else:
             df = engine.execute(rw.query, ds)
         self._last_engine_metrics = getattr(engine, "last_metrics", None)
@@ -361,46 +363,6 @@ class TPUOlapContext:
         cols = [c for c in spec.output_columns if c in df.columns]
         return df[cols].reset_index(drop=True)
 
-    def _execute_grouping_sets(self, rw: Rewrite, ds, engine):
-        """CUBE/ROLLUP/GROUPING SETS: one kernel pass per set, absent
-        dimensions emitted as nulls, plus a __grouping_id bitmask (SQL
-        GROUPING_ID semantics: bit i set => dim i aggregated away)."""
-        import pandas as pd
-
-        q = rw.query
-        assert isinstance(q, Q.GroupByQuery)
-        all_dims = q.dimensions
-        frames = []
-        k = len(all_dims)
-        subs = [
-            dataclasses.replace(
-                q,
-                dimensions=tuple(all_dims[i] for i in s),
-                subtotals=(),
-            )
-            for s in rw.grouping_sets
-        ]
-        # dispatch every set's device program before fetching any result:
-        # N sequential executions behind a network-tunneled TPU pay N full
-        # round trips; the batch path overlaps them
-        if hasattr(engine, "execute_groupby_batch"):
-            results = engine.execute_groupby_batch(subs, ds)
-        else:
-            results = [engine.execute(sub, ds) for sub in subs]
-        for s, f in zip(rw.grouping_sets, results):
-            gid = 0
-            present = set(s)
-            for i in range(k):
-                if i not in present:
-                    gid |= 1 << (k - 1 - i)
-                    f[all_dims[i].name] = None
-            f["__grouping_id"] = gid
-            frames.append(f)
-        df = pd.concat(frames, ignore_index=True)
-        order = [d.name for d in all_dims]
-        rest = [c for c in df.columns if c not in order]
-        return df[order + rest]
-
     def _engine_for(self, rw: Rewrite):
         phys = rw.physical
         if phys.distributed and phys.mesh_shape is not None:
@@ -437,6 +399,66 @@ def _eval_host(e: E.Expr, df) -> np.ndarray:
     # string comparisons use plain numpy elementwise semantics
     fn = compile_expr(_aggref_to_col(e), raw_strings=True)
     return np.asarray(fn(cols))
+
+
+def execute_grouping_sets(q: Q.GroupByQuery, grouping_sets, ds, engine):
+    """CUBE/ROLLUP/GROUPING SETS: one kernel pass per set, absent
+    dimensions emitted as nulls, plus a __grouping_id bitmask (SQL
+    GROUPING_ID semantics: bit i set => dim i aggregated away).
+
+    Shared by the SQL path (rw.grouping_sets) and the serving path (a wire
+    groupBy's subtotalsSpec, server.py) — the two must not drift."""
+    import pandas as pd
+
+    all_dims = q.dimensions
+    frames = []
+    k = len(all_dims)
+    # the limit/order spec applies to the COMBINED result, not per set —
+    # and a per-set sort would crash on sets that drop the orderBy dimension
+    subs = [
+        dataclasses.replace(
+            q,
+            dimensions=tuple(all_dims[i] for i in s),
+            subtotals=(),
+            limit_spec=None,
+        )
+        for s in grouping_sets
+    ]
+    # dispatch every set's device program before fetching any result:
+    # N sequential executions behind a network-tunneled TPU pay N full
+    # round trips; the batch path overlaps them
+    if hasattr(engine, "execute_groupby_batch"):
+        results = engine.execute_groupby_batch(subs, ds)
+    else:
+        results = [engine.execute(sub, ds) for sub in subs]
+    for s, f in zip(grouping_sets, results):
+        gid = 0
+        present = set(s)
+        for i in range(k):
+            if i not in present:
+                gid |= 1 << (k - 1 - i)
+                f[all_dims[i].name] = None
+        f["__grouping_id"] = gid
+        frames.append(f)
+    df = pd.concat(frames, ignore_index=True)
+    order = [d.name for d in all_dims]
+    rest = [c for c in df.columns if c not in order]
+    df = df[order + rest]
+    if q.limit_spec is not None:
+        ls = q.limit_spec
+        if ls.columns:
+            df = df.sort_values(
+                [c.dimension for c in ls.columns],
+                ascending=[c.direction == "ascending" for c in ls.columns],
+                kind="stable",
+                na_position="last",  # aggregated-away dims sort after values
+            )
+        if ls.offset:
+            df = df.iloc[ls.offset:]
+        if ls.limit is not None:
+            df = df.head(ls.limit)
+        df = df.reset_index(drop=True)
+    return df
 
 
 def _aggref_to_col(e: E.Expr) -> E.Expr:

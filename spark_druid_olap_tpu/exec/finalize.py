@@ -180,6 +180,8 @@ def _eval_having(h: Q.Having, table: Mapping[str, np.ndarray]) -> np.ndarray:
         for s in h.specs[1:]:
             m |= _eval_having(s, table)
         return m
+    if isinstance(h, Q.HavingNot):
+        return ~_eval_having(h.spec, table)
     raise NotImplementedError(type(h).__name__)
 
 

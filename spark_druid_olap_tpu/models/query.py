@@ -117,6 +117,18 @@ class HavingOr(Having):
         return {"type": "or", "havingSpecs": [s.to_druid() for s in self.specs]}
 
 
+@dataclasses.dataclass(frozen=True)
+class HavingNot(Having):
+    """Druid `not` havingSpec — needed to decode wire queries whose NOT
+    wraps a compound spec (our own serializer only emits NOT around
+    compares, which fold into >=/<=/!=)."""
+
+    spec: Having
+
+    def to_druid(self):
+        return {"type": "not", "havingSpec": self.spec.to_druid()}
+
+
 def _ivs(intervals):
     return [f"{_ms_to_iso(a)}/{_ms_to_iso(b)}" for a, b in intervals] or [
         "0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"

@@ -238,6 +238,26 @@ def _common(d):
     return filt, ivs, vcols, aggs, posts
 
 
+def having_from_druid(d: Dict[str, Any]) -> Q.Having:
+    """Druid havingSpec -> model.  A having the engine can't honor must be
+    a WireError, never a silent drop (it filters result rows)."""
+    t = d.get("type")
+    ops = {"greaterThan": ">", "lessThan": "<", "equalTo": "=="}
+    if t in ops:
+        return Q.HavingCompare(d["aggregation"], ops[t], d["value"])
+    if t == "and":
+        return Q.HavingAnd(
+            tuple(having_from_druid(s) for s in d["havingSpecs"])
+        )
+    if t == "or":
+        return Q.HavingOr(
+            tuple(having_from_druid(s) for s in d["havingSpecs"])
+        )
+    if t == "not":
+        return Q.HavingNot(having_from_druid(d["havingSpec"]))
+    raise WireError(f"unsupported havingSpec type {t!r}")
+
+
 def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
     qt = d.get("queryType")
     ds = d.get("dataSource")
@@ -260,16 +280,33 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
                 ),
                 spec.get("offset", 0),
             )
+        subtotals = ()
+        if d.get("subtotalsSpec"):
+            # name lists -> dimension-index tuples (the model's form)
+            by_name = {spec.name: i for i, spec in enumerate(dims)}
+            try:
+                subtotals = tuple(
+                    tuple(by_name[n] for n in names)
+                    for names in d["subtotalsSpec"]
+                )
+            except KeyError as err:
+                raise WireError(
+                    f"subtotalsSpec names unknown dimension {err}"
+                )
         return Q.GroupByQuery(
             datasource=ds,
             dimensions=dims,
             aggregations=aggs,
             post_aggregations=posts,
             filter=filt,
+            having=(
+                having_from_druid(d["having"]) if d.get("having") else None
+            ),
             limit_spec=ls,
             intervals=ivs,
             granularity=granularity_from_druid(d.get("granularity", "all")),
             virtual_columns=vcols,
+            subtotals=subtotals,
         )
     if qt == "topN":
         filt, ivs, vcols, aggs, posts = _common(d)

@@ -98,7 +98,8 @@ def _bottom_k_pairs(
 def partial_quantiles(
     agg, cols: Mapping[str, jnp.ndarray], gid, mask, num_groups: int
 ) -> jnp.ndarray:
-    """Per-group sample state int32[G, K, 2] for one segment/shard."""
+    """Per-group sample state int32[G, K+1, 2] for one segment/shard (rows
+    [0, K) sample, row K the exact N counter)."""
     val = jnp.asarray(cols[agg.field_name]).astype(jnp.float32)
     R = val.shape[0]
     # priority must be independent of the value's magnitude but distinct

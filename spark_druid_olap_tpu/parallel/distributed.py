@@ -219,7 +219,7 @@ class DistributedEngine:
                     st = quantiles_ops.partial_quantiles(
                         agg, cols, gid_l, amask, Gl
                     )
-                    gathered = lax.all_gather(st, DATA_AXIS)  # [nd, Gl, K, 2]
+                    gathered = lax.all_gather(st, DATA_AXIS)  # [nd,Gl,K+1,2]
                     acc = gathered[0]
                     for i in range(1, gathered.shape[0]):
                         acc = quantiles_ops.merge_states(

@@ -58,15 +58,28 @@ def initialize(
     global _initialized
     if _initialized:
         return True
+    # a launcher may have formed the runtime before us; is_initialized()
+    # inspects the distributed client without initializing the XLA backend
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        _initialized = True
+        return True
     if coordinator_address is None and num_processes is None:
-        # no explicit rendezvous and no pod metadata in the environment:
-        # stay single-process rather than hanging on a coordinator that
-        # will never answer
+        # no explicit rendezvous and no cluster metadata in the
+        # environment: stay single-process rather than hanging on a
+        # coordinator that will never answer.  The markers cover Cloud TPU
+        # pods plus the cluster launchers jax auto-detects (SLURM / OMPI).
         import os
 
         if not any(
             k in os.environ
-            for k in ("COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID")
+            for k in (
+                "COORDINATOR_ADDRESS",
+                "JAX_COORDINATOR_ADDRESS",
+                "CLOUD_TPU_TASK_ID",
+                "TPU_WORKER_ID",
+                "SLURM_JOB_ID",
+                "OMPI_COMM_WORLD_SIZE",
+            )
         ):
             return False
     try:

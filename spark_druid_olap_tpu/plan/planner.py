@@ -216,6 +216,10 @@ class Planner:
             a_list, p_list, b = translate_aggregate(ae2, ds, b, self.cfg)
             aggs.extend(a_list)
             posts.extend(p_list)
+        # identical hidden aggregations collapse (frozen dataclasses hash):
+        # N APPROX_QUANTILE fractions over one column emit N copies of the
+        # same content-named sketch — compute it once
+        aggs = list(dict.fromkeys(aggs))
         b = b.with_(
             aggregations=tuple(aggs), post_aggregations=tuple(posts)
         )

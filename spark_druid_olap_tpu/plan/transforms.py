@@ -424,7 +424,15 @@ def translate_aggregate(
             # k=0 would build a zero-width sample and return NaN for every
             # group — a silent wrong answer, not an error
             raise RewriteError("APPROX_QUANTILE k must be >= 1")
-        sk_name = f"{name}__qsk"
+        # content-keyed sketch name: N fractions over the same (column, k)
+        # share ONE sketch (the planner dedupes identical aggregations), as
+        # Druid SQL does — a per-output name would triple device state and
+        # per-row sort work for a p10/p50/p90 query.  A FILTER clause makes
+        # the sketch query-output-specific again.
+        if agg.filter is None:
+            sk_name = f"__qsk_{arg.name}_{k}"
+        else:
+            sk_name = f"{name}__qsk"
         return (
             [wrap(A.QuantilesSketch(sk_name, arg.name, size=k))],
             [A.QuantileFromSketch(name, sk_name, frac)],

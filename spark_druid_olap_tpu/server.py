@@ -24,6 +24,7 @@ TPUOlapContext.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -209,7 +210,19 @@ class _Handler(BaseHTTPRequestHandler):
         ds = self.ctx.catalog.get(q.datasource)
         if ds is None:
             return self._error(400, f"unknown dataSource {q.datasource!r}")
-        df = self.ctx.engine.execute(q, ds)
+        if isinstance(q, Q.GroupByQuery) and q.subtotals:
+            # wire subtotalsSpec: same grouping-set expansion the SQL path
+            # uses — the engine alone would silently run only the full set
+            from .api import execute_grouping_sets
+
+            df = execute_grouping_sets(
+                dataclasses.replace(q, subtotals=()), q.subtotals, ds,
+                self.ctx.engine,
+            )
+            # internal bitmask column; real Druid events don't carry it
+            df = df.drop(columns=["__grouping_id"])
+        else:
+            df = self.ctx.engine.execute(q, ds)
         self._send(200, druid_result_shape(q, df))
 
     def _sql_query(self, body: dict):

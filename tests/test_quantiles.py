@@ -186,6 +186,31 @@ def test_two_fractions_in_one_query_stay_distinct():
     assert 5 < p10 < 15 and 85 < p90 < 95
 
 
+def test_multiple_fractions_share_one_sketch():
+    """p10/p50/p90 over one column must plan ONE sketch aggregation (three
+    QuantileFromSketch post-aggs), not three identical sketches."""
+    ctx = sd.TPUOlapContext()
+    ctx.register_table(
+        "t", {"v": np.arange(100, dtype=np.float32)},
+        dimensions=[], metrics=["v"],
+    )
+    rw = ctx.plan_sql(
+        "SELECT APPROX_QUANTILE(v, 0.1) AS p10, "
+        "APPROX_QUANTILE(v, 0.5) AS p50, "
+        "APPROX_QUANTILE(v, 0.9) AS p90 FROM t"
+    )
+    sketches = [
+        a for a in rw.query.aggregations if isinstance(a, QuantilesSketch)
+    ]
+    assert len(sketches) == 1
+    assert len(rw.query.post_aggregations) == 3
+    got = ctx.sql(
+        "SELECT APPROX_QUANTILE(v, 0.1) AS p10, "
+        "APPROX_QUANTILE(v, 0.9) AS p90 FROM t"
+    )
+    assert float(got["p10"].iloc[0]) < float(got["p90"].iloc[0])
+
+
 def test_sketch_column_reports_true_n():
     """The finalized sketch column is the exact aggregated row count N even
     when n >> K (the state carries an explicit counter)."""

@@ -310,3 +310,85 @@ def test_far_future_interval_stays_a_real_interval():
     assert got[1] == int(np.datetime64("3600-01-01", "ms").astype(np.int64))
     (got2,) = intervals_from_druid(["2999-06-01T00:00:00Z/3500-01-01T00:00:00Z"])
     assert got2[1] == int(np.datetime64("3500-01-01", "ms").astype(np.int64))
+
+
+def test_native_groupby_having_honored(served):
+    """A wire groupBy's havingSpec must filter result rows (not be silently
+    dropped)."""
+    ctx, srv, df = served
+    body = {
+        "queryType": "groupBy",
+        "dataSource": "ev",
+        "dimensions": ["city"],
+        "aggregations": [{"type": "count", "name": "n"}],
+        "granularity": "all",
+        "intervals": ["0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"],
+    }
+    status, rows = _post(srv, "/druid/v2", body)
+    assert status == 200 and len(rows) == 4
+    counts = sorted(r["event"]["n"] for r in rows)
+    threshold = counts[1]  # cut between the 2nd and 3rd city
+    body["having"] = {
+        "type": "greaterThan", "aggregation": "n", "value": threshold,
+    }
+    status, rows2 = _post(srv, "/druid/v2", body)
+    assert status == 200
+    assert 0 < len(rows2) < 4
+    assert all(r["event"]["n"] > threshold for r in rows2)
+    # NOT wrapping a compound spec (our serializer never emits this shape;
+    # a Druid client can)
+    body["having"] = {
+        "type": "not",
+        "havingSpec": {
+            "type": "or",
+            "havingSpecs": [
+                {"type": "greaterThan", "aggregation": "n", "value": threshold},
+                {"type": "lessThan", "aggregation": "n", "value": 1},
+            ],
+        },
+    }
+    status, rows3 = _post(srv, "/druid/v2", body)
+    assert status == 200
+    assert all(1 <= r["event"]["n"] <= threshold for r in rows3)
+    assert len(rows2) + len(rows3) == 4
+
+
+def test_native_groupby_subtotals_spec(served):
+    """A wire groupBy's subtotalsSpec expands into grouping sets (the SQL
+    CUBE path), not just the full grouping."""
+    ctx, srv, df = served
+    body = {
+        "queryType": "groupBy",
+        "dataSource": "ev",
+        "dimensions": ["city"],
+        "aggregations": [{"type": "count", "name": "n"}],
+        "granularity": "all",
+        "intervals": ["0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"],
+        "subtotalsSpec": [["city"], []],
+    }
+    status, rows = _post(srv, "/druid/v2", body)
+    assert status == 200
+    assert len(rows) == 5  # 4 cities + 1 grand total
+    totals = [r["event"] for r in rows if r["event"]["city"] is None]
+    assert len(totals) == 1
+    per_city = [r["event"]["n"] for r in rows if r["event"]["city"] is not None]
+    assert totals[0]["n"] == sum(per_city)
+    # no internal bookkeeping columns leak onto the wire
+    assert all("__grouping_id" not in r["event"] for r in rows)
+    # a limitSpec orderBy applies to the COMBINED result (and must not
+    # crash the sets that aggregate the orderBy dimension away)
+    body["limitSpec"] = {
+        "type": "default",
+        "columns": [{"dimension": "n", "direction": "descending"}],
+        "limit": 3,
+    }
+    status, rows_l = _post(srv, "/druid/v2", body)
+    assert status == 200 and len(rows_l) == 3
+    ns = [r["event"]["n"] for r in rows_l]
+    assert ns == sorted(ns, reverse=True)
+    assert rows_l[0]["event"]["city"] is None  # grand total tops the sort
+    del body["limitSpec"]
+    # unknown dimension name in subtotalsSpec is a 400, not a silent drop
+    body["subtotalsSpec"] = [["nope"]]
+    status, err = _post(srv, "/druid/v2", body)
+    assert status == 400
