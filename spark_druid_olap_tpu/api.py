@@ -292,6 +292,15 @@ class TPUOlapContext:
             df = engine.execute(rw.query, ds)
         self._last_engine_metrics = getattr(engine, "last_metrics", None)
 
+        # FD grouping pruning: decode the hidden max-over-codes carriers
+        # back into the pruned columns BEFORE residuals/projection, so
+        # downstream expressions see the restored values
+        for out_name, hidden, dim_col in rw.fd_restores:
+            raw = np.asarray(df[hidden], dtype=np.float64)
+            codes = np.where(np.isnan(raw), -1, raw).astype(np.int64)
+            df[out_name] = ds.dicts[dim_col].decode(codes)
+            df = df.drop(columns=[hidden])
+
         # host-side residuals (the DruidStrategy projection-fixup analog)
         for name, e in rw.host_post_exprs:
             df[name] = _eval_host(e, df)
@@ -445,19 +454,9 @@ def execute_grouping_sets(q: Q.GroupByQuery, grouping_sets, ds, engine):
     rest = [c for c in df.columns if c not in order]
     df = df[order + rest]
     if q.limit_spec is not None:
-        ls = q.limit_spec
-        if ls.columns:
-            df = df.sort_values(
-                [c.dimension for c in ls.columns],
-                ascending=[c.direction == "ascending" for c in ls.columns],
-                kind="stable",
-                na_position="last",  # aggregated-away dims sort after values
-            )
-        if ls.offset:
-            df = df.iloc[ls.offset:]
-        if ls.limit is not None:
-            df = df.head(ls.limit)
-        df = df.reset_index(drop=True)
+        from .exec.finalize import apply_limit_spec
+
+        df = apply_limit_spec(df, q.limit_spec).reset_index(drop=True)
     return df
 
 

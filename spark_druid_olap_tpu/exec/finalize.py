@@ -318,18 +318,27 @@ def finalize_groupby(
     # one query per set and concatenating — see plan/transforms.py.
 
     if q.limit_spec is not None:
-        ls = q.limit_spec
-        if ls.columns:
-            df = df.sort_values(
-                [c.dimension for c in ls.columns],
-                ascending=[c.direction == "ascending" for c in ls.columns],
-                kind="stable",
-            )
-        if ls.offset:
-            df = df.iloc[ls.offset :]
-        if ls.limit is not None:
-            df = df.head(ls.limit)
+        df = apply_limit_spec(df, q.limit_spec)
     return df.reset_index(drop=True)
+
+
+def apply_limit_spec(df, ls):
+    """Sort/offset/limit per a LimitSpec — the ONE implementation, shared
+    by groupBy finalization and grouping-set combination (api.py); null
+    keys (grouping-set rows that aggregate a sort dimension away) order
+    last."""
+    if ls.columns:
+        df = df.sort_values(
+            [c.dimension for c in ls.columns],
+            ascending=[c.direction == "ascending" for c in ls.columns],
+            kind="stable",
+            na_position="last",
+        )
+    if ls.offset:
+        df = df.iloc[ls.offset:]
+    if ls.limit is not None:
+        df = df.head(ls.limit)
+    return df
 
 
 # ---------------------------------------------------------------------------

@@ -252,6 +252,18 @@ def _lower_aggs(
             la.long_valued[name] = isinstance(agg, A.LongMax)
             la.value_fns[name] = _field_value_fn(field, ds)
             _add_null_skip(la, name, field, ds)
+        elif isinstance(agg, A.DimCodeMax):
+            # FD grouping pruning: max over raw dictionary codes (all rows
+            # of a group share one code by the declared FD); decoded back
+            # to the value at the API layer.  Codes < 2^24 represent
+            # exactly in f32; null rows carry -1 and never win the max
+            # unless the whole group is null (-1 decodes back to null)
+            field = agg.field_name
+            la.max_names.append(name)
+            la.long_valued[name] = True
+            la.value_fns[name] = lambda cols, f=field: jnp.asarray(
+                cols[f]
+            ).astype(jnp.float32)
         elif isinstance(agg, A.ExpressionAgg):
             fn = compile_expr(agg.expression, ds.dicts)
             target = {

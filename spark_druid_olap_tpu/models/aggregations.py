@@ -275,6 +275,29 @@ class Arithmetic(PostAggregation):
 
 
 @dataclasses.dataclass(frozen=True)
+class DimCodeMax(Aggregation):
+    """max over a dimension's dictionary CODES — the carrier for
+    functional-dependency grouping pruning.  When the planner drops a
+    grouped column whose value is determined by another grouped column
+    (declared FunctionalDependency, SURVEY.md §2 star-schema row), every
+    row of a group shares one code for the pruned column, so max(code)
+    recovers it; the API layer decodes code -> value host-side.  Internal
+    wire extension type "dimCodeMax" (not part of Druid's dialect)."""
+
+    name: str
+    field_name: str
+
+    def to_druid(self):
+        return {
+            "type": "dimCodeMax",
+            "name": self.name,
+            "fieldName": self.field_name,
+        }
+
+    merge_op = "pmax"
+
+
+@dataclasses.dataclass(frozen=True)
 class QuantilesSketch(Aggregation):
     """Approximate-quantile sketch (Druid `quantilesDoublesSketch` analog).
 

@@ -77,6 +77,8 @@ def agg_from_druid(d: Dict[str, Any]) -> A.Aggregation:
         return A.ThetaSketch(d["name"], d["fieldName"], d.get("size", 4096))
     if t == "quantilesDoublesSketch":
         return A.QuantilesSketch(d["name"], d["fieldName"], d.get("k", 1024))
+    if t == "dimCodeMax":  # internal FD-pruning carrier (not Druid dialect)
+        return A.DimCodeMax(d["name"], d["fieldName"])
     if t == "filtered":
         return A.FilteredAgg(
             filter_from_druid(d["filter"]), agg_from_druid(d["aggregator"])

@@ -94,6 +94,15 @@ def initialize(
             jax.process_index(), jax.process_count(), jax.device_count(),
         )
         return True
+    except ValueError as err:
+        # auto-detection found a cluster marker but not enough of the env
+        # to form a rendezvous (e.g. SLURM_JOB_ID inside an interactive
+        # salloc shell with no srun task vars): stay single-process — the
+        # contract is "safe to call unconditionally"
+        if coordinator_address is None and num_processes is None:
+            log.info("cluster env not resolvable (%s); staying single-process", err)
+            return False
+        raise
     except RuntimeError as err:
         # tolerate a launcher that already initialized the distributed
         # runtime; surface "backend already initialized" (caller ran JAX

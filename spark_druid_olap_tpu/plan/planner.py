@@ -78,6 +78,9 @@ class Rewrite:
     grouping_sets: Tuple[Tuple[int, ...], ...]
     is_scan: bool = False
     exact_distinct: Optional[ExactDistinctOuter] = None
+    # FD grouping pruning: (output column, hidden dimCodeMax agg, source
+    # dimension) triples the API decodes back after execution
+    fd_restores: Tuple[Tuple[str, str, str], ...] = ()
 
     def to_json(self) -> str:
         return json.dumps(self.query.to_druid(), indent=2, default=str)
@@ -283,6 +286,76 @@ class Planner:
             if hasattr(self.catalog, "star_schema")
             else None
         )
+
+        # FD grouping pruning (the reference's FunctionalDependency put to
+        # work): a grouped column determined by another grouped column is
+        # dropped from the kernel grouping — every row of a group shares
+        # one value for it, so a hidden max-over-codes aggregation carries
+        # it and the API decodes it back.  TPC-H q10's GROUP BY
+        # c_custkey, c_name, c_acctbal, ... would otherwise build a group
+        # domain that is the PRODUCT of those cardinalities.
+        fd_restores: List[Tuple[str, str, str]] = []
+        if star is not None and not agg.grouping_sets and len(dims) > 1:
+            limit_cols = {
+                c.dimension for c in (b.limit_spec.columns if b.limit_spec else ())
+            }
+            deps_by_col = {}
+            for fd in star.functional_dependencies:
+                if fd.dependent != fd.determinant:
+                    deps_by_col.setdefault(fd.dependent, set()).add(
+                        fd.determinant
+                    )
+            kept = []
+            pruned = []
+            plain = {
+                d.dimension
+                for d in dims
+                if (d.extraction is None and d.granularity is None
+                    and d.dimension in ds.dicts)
+            }
+            pruned_names: set = set()
+            for d in dims:
+                # greedy in declaration order; the pruned-so-far check
+                # keeps one member of any FD cycle (a->b, b->a) and
+                # guarantees every pruned column's determinant chain
+                # bottoms out in a KEPT dimension
+                prunable = (
+                    d.extraction is None
+                    and d.granularity is None
+                    and d.dimension in ds.dicts
+                    # the code-max carrier rides f32: codes >= 2^24 would
+                    # round and decode to an ADJACENT dictionary entry
+                    and ds.dicts[d.dimension].cardinality < (1 << 24)
+                    and d.name not in limit_cols
+                    and any(
+                        det in plain
+                        and det != d.dimension
+                        and det not in pruned_names
+                        for det in deps_by_col.get(d.dimension, ())
+                    )
+                )
+                if prunable:
+                    pruned.append(d)
+                    pruned_names.add(d.dimension)
+                else:
+                    kept.append(d)
+            if pruned:
+                from ..models import aggregations as A
+
+                for d in pruned:
+                    hidden = f"__fd_{d.name}"
+                    aggs.append(A.DimCodeMax(hidden, d.dimension))
+                    fd_restores.append((d.name, hidden, d.dimension))
+                dims = kept
+                b = b.with_(
+                    dimensions=tuple(dims), aggregations=tuple(aggs)
+                )
+                log.debug(
+                    "FD pruning: %s carried by hidden code aggs; kernel "
+                    "dims now %s",
+                    [r[0] for r in fd_restores],
+                    [d.name for d in dims],
+                )
         fd_dependents = set()
         if star is not None:
             grouped = {d.dimension for d in dims}
@@ -323,6 +396,7 @@ class Planner:
             residual_having=residual_having,
             host_post_exprs=tuple(host_posts),
             grouping_sets=tuple(agg.grouping_sets),
+            fd_restores=tuple(fd_restores),
         )
 
     # -- exact COUNT(DISTINCT): two-phase plan -------------------------------

@@ -53,6 +53,8 @@ DIM_ATTRS = {
     "o_orderpriority": "orders",
     "o_orderdate": "orders",  # numeric-dict dimension: ~2.4k distinct days
     "o_orderdate_year": "orders",
+    "c_custkey": "orders",
+    "c_name": "orders",
     "c_mktsegment": "orders",  # customer attrs ride the orders row (snowflake)
     "c_nation": "orders",
     "c_region": "orders",
@@ -81,6 +83,9 @@ STAR_SCHEMA = StarSchemaInfo(
         StarRelationInfo("part", (("l_partkey", "p_partkey"),)),
     ),
     functional_dependencies=(
+        FunctionalDependency("customer", "c_custkey", "c_name"),
+        FunctionalDependency("customer", "c_custkey", "c_nation"),
+        FunctionalDependency("customer", "c_custkey", "c_mktsegment"),
         FunctionalDependency("customer", "c_nation", "c_region"),
         FunctionalDependency("supplier", "s_nation", "s_region"),
         FunctionalDependency("orders", "o_orderkey", "o_orderpriority"),
@@ -106,6 +111,9 @@ def gen_tables(scale: float = 0.01, seed: int = 13) -> Dict[str, Dict[str, np.nd
     c_region, c_nation = _geo(n_c, rng)
     customer = {
         "c_custkey": np.arange(n_c, dtype=np.int64),
+        "c_name": np.array(
+            [f"Customer#{k:09d}" for k in range(n_c)], dtype=object
+        ),
         "c_mktsegment": rng.choice(np.array(SEGMENTS, dtype=object), n_c),
         "c_nation": c_nation,
         "c_region": c_region,
@@ -212,6 +220,8 @@ def flat_columns(tables):
         .astype(int) + 1970
     )
     add("o_orderdate_year", year.astype(np.int64), okey)
+    add("c_custkey", c["c_custkey"], ckey)
+    add("c_name", c["c_name"], ckey)
     add("c_mktsegment", c["c_mktsegment"], ckey)
     add("c_nation", c["c_nation"], ckey)
     add("c_region", c["c_region"], ckey)
@@ -280,6 +290,20 @@ QUERIES: Dict[str, str] = {
         GROUP BY l_orderkey
         ORDER BY revenue DESC
         LIMIT 10
+    """,
+    # Q10-class: returned-item reporting — GROUP BY customer attributes;
+    # exercises FD grouping pruning (c_custkey determines c_name/c_nation:
+    # the kernel groups by c_custkey alone, pruned columns ride hidden
+    # code aggregations)
+    "q10": f"""
+        SELECT c_custkey, c_name, c_nation,
+               sum(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM lineitem {_J_ORD} {_J_CUST}
+        WHERE o_orderdate >= '1993-10-01' AND o_orderdate < '1994-01-01'
+          AND l_returnflag = 'R'
+        GROUP BY c_custkey, c_name, c_nation
+        ORDER BY revenue DESC
+        LIMIT 20
     """,
     # Q5-class: local supplier volume — both dim branches constrained to one
     # region, grouped by supplier nation
@@ -407,6 +431,8 @@ def flat_frame(tables):
             "o_orderdate": o["o_orderdate"][okey],
             "o_orderdate_year": year[okey],
             "o_orderpriority": o["o_orderpriority"][okey],
+            "c_custkey": c["c_custkey"][ckey],
+            "c_name": c["c_name"][ckey],
             "c_mktsegment": c["c_mktsegment"][ckey],
             "c_nation": c["c_nation"][ckey],
             "c_region": c["c_region"][ckey],
@@ -462,6 +488,21 @@ def oracle(f, name: str):
             .groupby("l_orderkey", as_index=False)["revenue"].sum()
         )
         return g.sort_values("revenue", ascending=False).head(10).reset_index(
+            drop=True
+        )
+    if name == "q10":
+        m = (
+            (f.o_orderdate >= _ms("1993-10-01"))
+            & (f.o_orderdate < _ms("1994-01-01"))
+            & (f.l_returnflag == "R")
+        )
+        g = (
+            f[m].assign(revenue=rev[m])
+            .groupby(["c_custkey", "c_name", "c_nation"], as_index=False)[
+                "revenue"
+            ].sum()
+        )
+        return g.sort_values("revenue", ascending=False).head(20).reset_index(
             drop=True
         )
     if name == "q5":
