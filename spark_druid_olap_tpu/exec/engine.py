@@ -52,6 +52,7 @@ from .lowering import (  # noqa: F401
 from .finalize import (  # noqa: F401
     _eval_having,
     _merge_sketch_states,
+    apply_limit_spec,
     eval_post_agg,
     finalize_groupby,
     finalize_timeseries,
@@ -901,7 +902,18 @@ class Engine:
             v.name: _decoded_expr_fn(v.expression, ds)
             for v in q.virtual_columns
         }
-        need = [c for c in q.columns if c not in vcol_fns and c != "__time"]
+        order_cols = [c.dimension for c in q.order_by]
+        if "__time" in order_cols and not ds.time_column:
+            # legacy wire `order` implies time ordering; a timeless table
+            # cannot honor it — clean error, not a KeyError from the fetch
+            raise ValueError(
+                f"scan ordering by __time: datasource {ds.name!r} has no "
+                "time column"
+            )
+        fetch_list = list(
+            dict.fromkeys(list(q.columns) + order_cols)
+        )
+        need = [c for c in fetch_list if c not in vcol_fns and c != "__time"]
         if q.filter is not None:
             need += [c for c in _filter_columns(q.filter) if c != "__time"]
         for v in q.virtual_columns:
@@ -910,7 +922,14 @@ class Engine:
             need.append(ds.time_column)
         need = dict.fromkeys(need)
         frames = []
-        remaining = q.limit
+        # early per-segment truncation only when no ordering (an ordered
+        # scan must see every surviving row before sorting); with an offset
+        # the first `offset` rows still have to be produced before skipping
+        remaining = (
+            None
+            if q.order_by
+            else (q.limit + q.offset if q.limit is not None else None)
+        )
         for seg in self._segments_in_scope(q, ds):
             cols = self._device_cols(seg, need)
             if ds.time_column and ds.time_column in cols:
@@ -928,11 +947,11 @@ class Engine:
                 mask = mask & filter_fn(cols)
             # one round trip for the mask + all projected columns
             fetched = jax.device_get(
-                {"__mask": mask, **{c: cols[c] for c in q.columns}}
+                {"__mask": mask, **{c: cols[c] for c in fetch_list}}
             )
             keep = fetched.pop("__mask")
             data = {}
-            for c in q.columns:
+            for c in fetch_list:
                 arr = fetched[c][keep]
                 if c in ds.dicts:
                     arr = ds.dicts[c].decode(arr)
@@ -941,14 +960,25 @@ class Engine:
             if remaining is not None:
                 f = f.head(remaining)
                 remaining -= len(f)
+            elif q.order_by and q.limit is not None:
+                # ordered + limited: only each segment's top-(limit+offset)
+                # can appear in the global result — truncate before concat
+                # so a small LIMIT never materializes the whole table
+                f = apply_limit_spec(
+                    f, Q.LimitSpec(q.limit + q.offset, q.order_by, 0)
+                )
             frames.append(f)
             if remaining is not None and remaining <= 0:
                 break
-        return (
+        out = (
             pd.concat(frames, ignore_index=True)
             if frames
-            else pd.DataFrame(columns=list(q.columns))
+            else pd.DataFrame(columns=fetch_list)
         )
+        out = apply_limit_spec(
+            out, Q.LimitSpec(q.limit, q.order_by, q.offset)
+        )
+        return out[list(q.columns)].reset_index(drop=True)
 
     def _execute_time_boundary(self, q: Q.TimeBoundaryQuery, ds: DataSource):
         """Druid `timeBoundary` — answered from segment metadata (the

@@ -273,6 +273,11 @@ class ScanQuery(QuerySpec):
     intervals: Tuple[Tuple[int, int], ...] = ()
     limit: Optional[int] = None
     virtual_columns: Tuple[VirtualColumn, ...] = ()
+    # Druid scan `orderBy` (column-value ordering) + result offset; an
+    # ordering the engine cannot honor must be a planner error, never a
+    # silent drop — unsorted rows under LIMIT are wrong rows
+    order_by: Tuple["OrderByColumnSpec", ...] = ()
+    offset: int = 0
 
     def to_druid(self):
         d: Dict[str, Any] = {
@@ -287,6 +292,13 @@ class ScanQuery(QuerySpec):
             d["filter"] = self.filter.to_druid()
         if self.limit is not None:
             d["limit"] = self.limit
+        if self.order_by:
+            d["orderBy"] = [
+                {"columnName": c.dimension, "order": c.direction}
+                for c in self.order_by
+            ]
+        if self.offset:
+            d["offset"] = self.offset
         return d
 
 

@@ -351,6 +351,15 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
         )
     if qt == "scan":
         filt, ivs, vcols, _, _ = _common(d)
+        order_by = tuple(
+            Q.OrderByColumnSpec(
+                o["columnName"], o.get("order", "ascending")
+            )
+            for o in (d.get("orderBy") or ())
+        )
+        # legacy scan `order` field: time ordering
+        if not order_by and d.get("order") in ("ascending", "descending"):
+            order_by = (Q.OrderByColumnSpec("__time", d["order"]),)
         return Q.ScanQuery(
             datasource=ds,
             columns=tuple(d.get("columns", ())),
@@ -358,6 +367,8 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
             intervals=ivs,
             limit=d.get("limit"),
             virtual_columns=vcols,
+            order_by=order_by,
+            offset=d.get("offset", 0),
         )
     if qt == "search":
         filt, ivs, _, _, _ = _common(d)

@@ -585,6 +585,23 @@ class Planner:
                     columns.append(name)
         else:
             columns = [c.name for c in ds.columns]
+        # ORDER BY on a row scan must be honored or rejected — silently
+        # returning unsorted rows under LIMIT is wrong data
+        order_by = []
+        known = set(columns) | {c.name for c in ds.columns}
+        for sk in sort_keys or ():
+            e = substitute(sk.expr, env)
+            if not isinstance(e, E.Col) or e.name not in known:
+                raise RewriteError(
+                    f"cannot ORDER BY {sk.expr} on a non-aggregate scan "
+                    "(only projected or physical columns)"
+                )
+            order_by.append(
+                Q.OrderByColumnSpec(
+                    e.name,
+                    "ascending" if sk.ascending else "descending",
+                )
+            )
         q = Q.ScanQuery(
             datasource=node.table,
             columns=tuple(columns),
@@ -592,6 +609,8 @@ class Planner:
             intervals=b.intervals,
             limit=limit,
             virtual_columns=tuple(vcols),
+            order_by=tuple(order_by),
+            offset=offset or 0,
         )
         phys = choose_physical(q, ds, 1, self.cfg, self.n_devices)
         return Rewrite(

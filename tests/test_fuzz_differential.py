@@ -25,14 +25,26 @@ FLAGS = ["A", "N", "R"]
 
 @pytest.fixture(scope="module")
 def world():
+    from spark_druid_olap_tpu.catalog.star import (
+        FunctionalDependency,
+        StarSchemaInfo,
+    )
+
     rng = np.random.default_rng(2026)
     city = rng.choice(np.array(CITIES, dtype=object), N)
     # sprinkle nulls into one dim
     city[rng.random(N) < 0.01] = None
+    # nation is FUNCTIONALLY DETERMINED by city (declared below): queries
+    # grouping by both exercise FD grouping pruning under the fuzz oracle
+    nation = np.array(
+        [None if c is None else f"nation{int(c[4:]) % 25:02d}" for c in city],
+        dtype=object,
+    )
     data = {
         "flag": rng.choice(np.array(FLAGS, dtype=object), N),
         "mode": rng.choice(np.array(MODES, dtype=object), N),
         "city": city,
+        "nation": nation,
         "yr": (1992 + rng.integers(0, 7, N)).astype(np.int64),
         "price": (rng.random(N) * 1000).astype(np.float32),
         "qty": rng.integers(1, 50, N).astype(np.float32),
@@ -45,16 +57,24 @@ def world():
     ctx.register_table(
         "f",
         data,
-        dimensions=["flag", "mode", "city", "yr"],
+        dimensions=["flag", "mode", "city", "nation", "yr"],
         metrics=["price", "qty"],
         time_column="ts",
         rows_per_segment=16_384,  # multiple segments -> fused merge
+        star_schema=StarSchemaInfo(
+            fact_table="f",
+            relations=(),
+            functional_dependencies=(
+                FunctionalDependency("f", "city", "nation"),
+            ),
+        ),
     )
     df = pd.DataFrame(
         {
             "flag": data["flag"],
             "mode": data["mode"],
             "city": city,
+            "nation": nation,
             "yr": data["yr"],
             "price": np.asarray(data["price"], np.float64),
             "qty": np.asarray(data["qty"], np.float64),
@@ -155,6 +175,7 @@ def _gen_case(df, seed):
         ("flag", "flag", lambda d: d["flag"]),
         ("mode", "mode", lambda d: d["mode"]),
         ("city", "city", lambda d: d["city"]),
+        ("nation", "nation", lambda d: d["nation"]),  # FD: city -> nation
         ("yr", "yr", lambda d: d["yr"]),
         (
             "date_trunc('month', ts)",
@@ -162,8 +183,19 @@ def _gen_case(df, seed):
             lambda d: _MS_MONTH_ORACLE(d["ts"]),
         ),
     ]
-    k = int(rng.integers(0, 3))
+    k = int(rng.integers(0, 4))
     dims = [dim_pool[i] for i in rng.choice(len(dim_pool), size=k, replace=False)]
+    # stay under the planner's max_result_cardinality guard (the guard
+    # itself is separately tested); conservative per-dim cardinality caps
+    caps = {"flag": 4, "mode": 7, "city": 213, "nation": 27, "yr": 8,
+            "mo": 4096}  # planner estimates unbounded month-trunc at 4096
+    while dims:
+        prod = 1
+        for _, name, _ in dims:
+            prod *= caps[name]
+        if prod <= 4_000_000:
+            break
+        dims = dims[:-1]
     n_aggs = int(rng.integers(1, 4))
     picks = [
         _AGGS[i]
@@ -362,3 +394,41 @@ def test_fuzz_cross_executor_parity(world, executors, seed):
         else:
             np.testing.assert_array_equal(x, y, err_msg=f"dist seed={seed} {sql}")
             np.testing.assert_array_equal(x, z, err_msg=f"stream seed={seed} {sql}")
+
+
+def test_fd_pruned_grouping_matches_oracle(world):
+    """Deterministic FD-pruning differential (fuzz seeds hit the city+nation
+    pair only by chance): grouping by determinant + dependent, with filters,
+    HAVING, and the null city group, must match pandas exactly."""
+    ctx, df = world
+    sql = (
+        "SELECT city, nation, count(*) AS n, sum(price) AS s FROM f "
+        "WHERE mode <> 'AIR' GROUP BY city, nation HAVING count(*) >= 2"
+    )
+    rw = ctx.plan_sql(sql)
+    assert {r[0] for r in rw.fd_restores} == {"nation"}
+    got = (
+        ctx.sql(sql)
+        .sort_values("city", na_position="last")
+        .reset_index(drop=True)
+    )
+    m = df["mode"] != "AIR"
+    want = (
+        df[m]
+        .groupby(["city", "nation"], as_index=False, dropna=False)
+        .agg(n=("price", "count"), s=("price", "sum"))
+    )
+    want = (
+        want[want.n >= 2]
+        .sort_values("city", na_position="last")
+        .reset_index(drop=True)
+    )
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(
+        got["city"].fillna("<null>"), want["city"].fillna("<null>")
+    )
+    np.testing.assert_array_equal(
+        got["nation"].fillna("<null>"), want["nation"].fillna("<null>")
+    )
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"].astype(float), want["s"], rtol=2e-5)
