@@ -910,6 +910,17 @@ class Engine:
                 f"scan ordering by __time: datasource {ds.name!r} has no "
                 "time column"
             )
+        sortable = (
+            set(q.columns)
+            | {c.name for c in ds.columns}
+            | set(vcol_fns)
+            | {"__time"}
+        )
+        for c in order_cols:
+            # wire queries arrive unplanned — validate here so a bad
+            # orderBy is a clean 400, not a KeyError mid-fetch
+            if c not in sortable:
+                raise ValueError(f"scan orderBy unknown column {c!r}")
         fetch_list = list(
             dict.fromkeys(list(q.columns) + order_cols)
         )

@@ -80,6 +80,38 @@ class StreamExecutor:
         self.prefetch = prefetch
         self.mesh = mesh  # jax.sharding.Mesh -> multichip streaming
         self.stats = StreamStats()
+        # compiled chunk-reconstruction programs keyed on (time_col,
+        # chunk_rows): jit caches on callable identity, so rebuilding the
+        # closure per stream would re-trace/compile every execution (the
+        # same convention as DistributedEngine._spmd_fn)
+        self._prep_cache: Dict = {}
+
+    def _prep_fn(self, time_col, chunk_rows: int):
+        key = (time_col, chunk_rows)
+        fn = self._prep_cache.get(key)
+        if fn is not None:
+            return fn
+
+        @jax.jit
+        def prep(dev, base, nrows):
+            """Device-side chunk reconstruction: int64 time from int32
+            offsets + base, validity mask from the row count.  One tiny
+            extra async dispatch per chunk; the H2D savings dominate."""
+            cols = dict(dev)
+            off = cols.pop("__time_off", None)
+            if off is not None:
+                t = base + off.astype(jnp.int64)
+                cols[time_col] = t
+                cols["__time"] = t
+            elif time_col and time_col in cols:
+                cols["__time"] = cols[time_col]
+            cols["__valid"] = (
+                jnp.arange(chunk_rows, dtype=jnp.int32) < nrows
+            )
+            return cols
+
+        self._prep_cache[key] = prep
+        return prep
 
     # -- public entry points -------------------------------------------------
 
@@ -211,7 +243,23 @@ class StreamExecutor:
                 a = a.astype(np.int32, copy=False)
                 fill = NULL_ID
             elif ds.time_column and n == ds.time_column:
+                # H2D narrowing: the stream is the H2D-bound path (BASELINE
+                # config #4), and a chunk's time span virtually always fits
+                # int32 ms (~24 days) — ship base + offsets, reconstruct
+                # int64 on device.  Halves the widest column's bytes.
                 a = a.astype(np.int64, copy=False)
+                base = int(a[:rows].min()) if rows else 0
+                span = int(a[:rows].max()) - base if rows else 0
+                if span < (1 << 31):
+                    off = (a - base).astype(np.int32)
+                    if rows < chunk_rows:
+                        off = np.concatenate(
+                            [off[:rows],
+                             np.zeros(chunk_rows - rows, np.int32)]
+                        )
+                    out["__time_off"] = off
+                    out["__time_base"] = np.int64(base)
+                    continue
                 fill = 0
             elif a.dtype.kind in ("i", "u", "b"):
                 a = a.astype(np.int32, copy=False)
@@ -223,10 +271,9 @@ class StreamExecutor:
                 pad = np.full(chunk_rows - rows, fill, dtype=a.dtype)
                 a = np.concatenate([a, pad])
             out[n] = a
-        valid = np.zeros(chunk_rows, dtype=bool)
-        valid[:rows] = True
-        out["__valid"] = valid
-        out["__rows"] = rows  # host bookkeeping, stripped before device_put
+        # validity travels as the scalar row count (1 byte/row saved); the
+        # device rebuilds the mask with one iota compare
+        out["__rows"] = rows
         return out
 
     def _prefetched_device_chunks(
@@ -266,6 +313,8 @@ class StreamExecutor:
 
             sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
+        prep = self._prep_fn(ds.time_column, chunk_rows)
+
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         try:
@@ -276,13 +325,12 @@ class StreamExecutor:
                 if isinstance(item, BaseException):
                     raise item
                 rows = item.pop("__rows")
+                base = item.pop("__time_base", np.int64(0))
                 dev = {
                     k: jax.device_put(v, sharding) for k, v in item.items()
                 }
-                if ds.time_column and ds.time_column in dev:
-                    dev["__time"] = dev[ds.time_column]
                 self.stats.rows += int(rows)
-                yield dev
+                yield prep(dev, base, np.int32(rows))
         finally:
             cancelled.set()
             while True:  # unblock a producer stuck on a full queue

@@ -351,6 +351,9 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
         )
     if qt == "scan":
         filt, ivs, vcols, _, _ = _common(d)
+        for o in d.get("orderBy") or ():
+            if "columnName" not in o:
+                raise WireError("scan orderBy entry missing columnName")
         order_by = tuple(
             Q.OrderByColumnSpec(
                 o["columnName"], o.get("order", "ascending")

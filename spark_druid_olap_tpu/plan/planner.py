@@ -590,15 +590,22 @@ class Planner:
         order_by = []
         known = set(columns) | {c.name for c in ds.columns}
         for sk in sort_keys or ():
-            e = substitute(sk.expr, env)
-            if not isinstance(e, E.Col) or e.name not in known:
-                raise RewriteError(
-                    f"cannot ORDER BY {sk.expr} on a non-aggregate scan "
-                    "(only projected or physical columns)"
-                )
+            # a SELECT alias of a computed projection is sortable as-is
+            # (the engine evaluates virtual columns before sorting) —
+            # check the raw name BEFORE substitution expands the alias
+            if isinstance(sk.expr, E.Col) and sk.expr.name in set(columns):
+                name = sk.expr.name
+            else:
+                e = substitute(sk.expr, env)
+                if not isinstance(e, E.Col) or e.name not in known:
+                    raise RewriteError(
+                        f"cannot ORDER BY {sk.expr} on a non-aggregate "
+                        "scan (only projected or physical columns)"
+                    )
+                name = e.name
             order_by.append(
                 Q.OrderByColumnSpec(
-                    e.name,
+                    name,
                     "ascending" if sk.ascending else "descending",
                 )
             )

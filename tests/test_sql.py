@@ -326,3 +326,33 @@ def test_scan_wire_order_roundtrip(ctx):
     legacy["order"] = "descending"
     q3 = query_from_druid(legacy)
     assert q3.order_by[0].dimension == "__time"
+
+
+def test_scan_order_by_computed_alias(ctx, lineitem_cols):
+    """ORDER BY a SELECT alias of a computed projection sorts on the
+    evaluated virtual column."""
+    got = ctx.sql(
+        "SELECT l_extendedprice * 2 AS p FROM lineitem "
+        "ORDER BY p DESC LIMIT 4"
+    )
+    v = list(got["p"])
+    assert v == sorted(v, reverse=True)
+    import numpy as np
+
+    top = np.sort(
+        np.asarray(lineitem_cols["l_extendedprice"], np.float64) * 2
+    )[-4:][::-1]
+    np.testing.assert_allclose(np.asarray(v, np.float64), top, rtol=1e-6)
+
+
+def test_scan_wire_bad_order_column_is_clean_error(ctx):
+    from spark_druid_olap_tpu.models.wire import query_from_druid
+
+    rw = ctx.plan_sql("SELECT l_returnflag FROM lineitem LIMIT 3")
+    body = dict(rw.query.to_druid())
+    body["orderBy"] = [{"columnName": "nope"}]
+    q = query_from_druid(body)
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown column"):
+        ctx.engine.execute(q, ctx.catalog.get("lineitem"))
