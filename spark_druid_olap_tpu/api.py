@@ -37,6 +37,9 @@ from .plan import expr as E
 from .plan import logical as L
 from .plan.planner import Planner, Rewrite, RewriteError
 from .sql.parser import parse_sql
+from .utils.log import get_logger
+
+log = get_logger("api")
 
 
 class TPUOlapContext:
@@ -251,7 +254,23 @@ class TPUOlapContext:
             import pandas as pd
 
             return pd.DataFrame({"plan": planner.explain(lp).split("\n")})
-        rw = planner.plan(lp)
+        try:
+            rw = planner.plan(lp)
+        except RewriteError as err:
+            from .plan.transforms import RewritePolicyError
+
+            if isinstance(err, RewritePolicyError):
+                raise  # explicit policy/validation rejection — no fallback
+            if not self.config.fallback_execution:
+                raise
+            # the reference's vanilla-Spark fallback: a failed rewrite runs
+            # the logical plan host-side instead of erroring
+            from .exec.fallback import execute_fallback
+
+            log.warning(
+                "rewrite failed (%s); executing on the host fallback", err
+            )
+            return execute_fallback(lp, self.catalog)
         self._plan_cache[key] = rw
         return self.execute_rewrite(rw)
 

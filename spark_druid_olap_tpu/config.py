@@ -36,6 +36,12 @@ class SessionConfig:
     # APPROX_QUANTILE sample size K (quantilesDoublesSketch k analog):
     # rank error ~ O(sqrt(p(1-p)/K)), ~±1.5% at the median for 1024
     quantiles_k: int = 1024
+    # When the planner cannot rewrite a query (unconforming join, an
+    # expression no transform covers), interpret the logical plan over
+    # decoded host frames instead of erroring — the reference's vanilla-
+    # Spark fallback (SURVEY.md §3.2).  False surfaces RewriteError
+    # (useful for asserting pushdown coverage).
+    fallback_execution: bool = True
 
     # cost model (reference: DruidQueryCostModel constants via SQLConf).
     # Units are MICROSECONDS so the constants are physically measurable:

@@ -28,6 +28,7 @@ log = get_logger("plan.planner")
 from .cost import PhysicalPlan, choose_physical
 from .transforms import (
     RewriteError,
+    RewritePolicyError,
     apply_sort_limit,
     substitute,
     translate_aggregate,
@@ -374,7 +375,7 @@ class Planner:
             if d.dimension not in fd_dependents:
                 G_result *= card
         if G_result > self.cfg.max_result_cardinality:
-            raise RewriteError(
+            raise RewritePolicyError(
                 f"estimated result cardinality {G_result} exceeds "
                 f"max_result_cardinality={self.cfg.max_result_cardinality}"
             )
@@ -483,6 +484,11 @@ class Planner:
         )
         try:
             inner_rw = self._plan_aggregate(inner, None, 0, [], None, None)
+        except RewritePolicyError:
+            # preserve the subtype: a policy rejection (e.g. the inner
+            # grouping exceeds the cardinality guard) must not be laundered
+            # into a plain RewriteError the host fallback would swallow
+            raise
         except RewriteError as e:
             raise RewriteError(
                 "exact COUNT(DISTINCT) plans its argument as an inner "

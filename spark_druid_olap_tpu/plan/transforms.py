@@ -45,6 +45,13 @@ class RewriteError(Exception):
     """A transform could not translate this plan (candidate dropped)."""
 
 
+class RewritePolicyError(RewriteError):
+    """The plan was rejected by explicit policy or argument validation —
+    NOT a coverage gap.  The host fallback executor must not swallow these:
+    a user who set count_distinct_mode='error' (or exceeded the result-
+    cardinality guard, or passed invalid arguments) asked for an error."""
+
+
 # ---------------------------------------------------------------------------
 # Expression utilities
 # ---------------------------------------------------------------------------
@@ -334,7 +341,7 @@ def translate_group_expr(
             lname = str(e.args[0])
             table = lookups(lname) if lookups is not None else None
             if table is None:
-                raise RewriteError(f"unknown lookup table {lname!r}")
+                raise RewritePolicyError(f"unknown lookup table {lname!r}")
             # Druid SQL: LOOKUP(expr, name[, replaceMissingValueWith]) — an
             # unmapped key becomes NULL (the null group) unless the optional
             # third argument replaces it
@@ -387,7 +394,7 @@ def translate_aggregate(
             # explicit approx_count_distinct() is always allowed; bare
             # COUNT(DISTINCT) honors the mode (the SQL parser lifts it to
             # fn="count_distinct", the builder API to fn="count"+distinct)
-            raise RewriteError("COUNT(DISTINCT) disabled by config")
+            raise RewritePolicyError("COUNT(DISTINCT) disabled by config")
         sketch = cfg.approx_count_distinct_sketch
         if sketch == "theta":
             return [wrap(A.ThetaSketch(name, arg.name, size=cfg.theta_size))], [], b
@@ -411,19 +418,19 @@ def translate_aggregate(
             # dimension columns hold dictionary CODES on device; a quantile
             # over codes is not a quantile over values — reject rather than
             # silently answer the wrong question
-            raise RewriteError(
+            raise RewritePolicyError(
                 "APPROX_QUANTILE requires a numeric metric column"
             )
         if not agg.args:
-            raise RewriteError("APPROX_QUANTILE requires a fraction")
+            raise RewritePolicyError("APPROX_QUANTILE requires a fraction")
         frac = float(agg.args[0])
         if not 0.0 <= frac <= 1.0:
-            raise RewriteError("APPROX_QUANTILE fraction must be in [0, 1]")
+            raise RewritePolicyError("APPROX_QUANTILE fraction must be in [0, 1]")
         k = int(agg.args[1]) if len(agg.args) > 1 else cfg.quantiles_k
         if k < 1:
             # k=0 would build a zero-width sample and return NaN for every
             # group — a silent wrong answer, not an error
-            raise RewriteError("APPROX_QUANTILE k must be >= 1")
+            raise RewritePolicyError("APPROX_QUANTILE k must be >= 1")
         # content-keyed sketch name: N fractions over the same (column, k)
         # share ONE sketch (the planner dedupes identical aggregations), as
         # Druid SQL does — a per-output name would triple device state and

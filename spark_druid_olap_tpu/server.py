@@ -201,6 +201,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
         except KeyError as e:
             return self._error(400, f"missing field: {e}")
+        except ValueError as e:
+            # validation of a decoded query (unknown orderBy column,
+            # __time ordering on a timeless table): client error
+            return self._error(400, str(e))
         except Exception as e:  # surface engine errors as 500 JSON
             return self._error(500, f"{type(e).__name__}: {e}")
         return self._error(404, f"no route {path!r}")

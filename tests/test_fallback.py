@@ -1,0 +1,149 @@
+"""Host fallback execution (exec/fallback.py): queries the planner cannot
+rewrite run over decoded pandas frames instead of erroring — the
+reference's vanilla-Spark fallback (SURVEY.md §3.2)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.plan.planner import RewriteError
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    rng = np.random.default_rng(7)
+    n = 5_000
+    c.register_table(
+        "fact",
+        {
+            "k": rng.integers(0, 50, n),
+            "mode": rng.choice(np.array(["A", "B", "C"], dtype=object), n),
+            "v": (rng.random(n) * 100).astype(np.float32),
+        },
+        dimensions=["k", "mode"],
+        metrics=["v"],
+    )
+    # a plain lookup-ish table with NO declared star relation: joins
+    # against it cannot star-collapse
+    c.register_table(
+        "other",
+        {
+            "ok": np.arange(50, dtype=np.int64),
+            "label": np.array(
+                [f"label{i % 7}" for i in range(50)], dtype=object
+            ),
+        },
+    )
+    return c
+
+
+def _fact_frame(c):
+    ds = c.catalog.get("fact")
+    k = np.concatenate(
+        [
+            np.asarray(ds.dicts["k"].decode(np.asarray(s.dims["k"])[s.valid]))
+            for s in ds.segments
+        ]
+    )
+    mode = np.concatenate(
+        [
+            np.asarray(
+                ds.dicts["mode"].decode(np.asarray(s.dims["mode"])[s.valid])
+            )
+            for s in ds.segments
+        ]
+    )
+    v = np.concatenate(
+        [np.asarray(s.metrics["v"], np.float64)[s.valid] for s in ds.segments]
+    )
+    return pd.DataFrame({"k": k.astype(np.int64), "mode": mode, "v": v})
+
+
+def test_unconforming_join_falls_back(ctx):
+    """Join against an undeclared table: rewrite fails, fallback answers."""
+    with pytest.raises(RewriteError):
+        ctx.plan_sql(
+            "SELECT label, sum(v) AS s FROM fact "
+            "JOIN other ON k = ok GROUP BY label"
+        )
+    got = ctx.sql(
+        "SELECT label, sum(v) AS s, count(*) AS n FROM fact "
+        "JOIN other ON k = ok GROUP BY label ORDER BY label"
+    )
+    f = _fact_frame(ctx)
+    other = pd.DataFrame(
+        {
+            "ok": np.arange(50, dtype=np.int64),
+            "label": [f"label{i % 7}" for i in range(50)],
+        }
+    )
+    want = (
+        f.merge(other, left_on="k", right_on="ok")
+        .groupby("label", as_index=False)
+        .agg(s=("v", "sum"), n=("v", "count"))
+        .sort_values("label")
+        .reset_index(drop=True)
+    )
+    assert list(got["label"]) == list(want["label"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(
+        got["s"].astype(float), want["s"], rtol=1e-6
+    )
+
+
+def test_fallback_disabled_surfaces_error():
+    cfg = SessionConfig()
+    cfg.fallback_execution = False
+    c = sd.TPUOlapContext(config=cfg)
+    c.register_table(
+        "a", {"x": np.arange(10, dtype=np.int64)}, dimensions=["x"]
+    )
+    c.register_table(
+        "b", {"y": np.arange(10, dtype=np.int64)}, dimensions=["y"]
+    )
+    with pytest.raises(RewriteError):
+        c.sql("SELECT x, count(*) AS n FROM a JOIN b ON x = y GROUP BY x")
+
+
+def test_fallback_filters_order_limit(ctx):
+    got = ctx.sql(
+        "SELECT label, max(v) AS m FROM fact JOIN other ON k = ok "
+        "WHERE mode = 'A' AND v > 10 GROUP BY label "
+        "HAVING count(*) >= 5 ORDER BY m DESC LIMIT 3"
+    )
+    f = _fact_frame(ctx)
+    other = pd.DataFrame(
+        {
+            "ok": np.arange(50, dtype=np.int64),
+            "label": [f"label{i % 7}" for i in range(50)],
+        }
+    )
+    j = f.merge(other, left_on="k", right_on="ok")
+    j = j[(j["mode"] == "A") & (j["v"] > 10)]
+    g = j.groupby("label").agg(m=("v", "max"), n=("v", "count"))
+    want = (
+        g[g.n >= 5]["m"].sort_values(ascending=False).head(3)
+    )
+    np.testing.assert_allclose(
+        got["m"].astype(float), want.values, rtol=1e-6
+    )
+
+
+def test_fallback_exact_distinct_and_avg(ctx):
+    got = ctx.sql(
+        "SELECT mode, count(DISTINCT k) AS dk, avg(v) AS av FROM fact "
+        "JOIN other ON k = ok GROUP BY mode ORDER BY mode"
+    )
+    f = _fact_frame(ctx)
+    want = (
+        f[f.k < 50]
+        .groupby("mode", as_index=False)
+        .agg(dk=("k", "nunique"), av=("v", "mean"))
+        .sort_values("mode")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(got["dk"], want["dk"])
+    np.testing.assert_allclose(got["av"].astype(float), want["av"], rtol=1e-6)
