@@ -131,7 +131,7 @@ def load_datasource(
             }
             valid = z["valid"]
             time = z["time"] if "time" in z.files else None
-        from .segment import _SEGMENT_UIDS
+        from .segment import _SEGMENT_UIDS, compute_segment_stats
 
         segments.append(
             Segment(
@@ -144,6 +144,9 @@ def load_datasource(
                 interval=tuple(sm["interval"]) if sm["interval"] else None,
                 time_name=sm.get("time_name"),
                 uid=next(_SEGMENT_UIDS),
+                # zone maps recompute at load (one min/max pass — cheaper
+                # than versioning them into the on-disk format)
+                stats=compute_segment_stats(dims, metrics, valid),
             )
         )
     ds = DataSource(

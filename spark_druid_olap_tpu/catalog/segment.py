@@ -186,6 +186,11 @@ class Segment:
     interval: Optional[Tuple[int, int]] = None  # [min_ms, max_ms] of time col
     time_name: Optional[str] = None  # source column name of the time column
     uid: int = 0  # process-unique identity (see _SEGMENT_UIDS)
+    # zone maps (SURVEY.md §2 metadata row: per-segment "stats"): column ->
+    # (min, max) over REAL rows — dimension columns in CODE space (nulls
+    # excluded), metrics in value space.  Lets the engine prune segments a
+    # filter provably cannot match, the way the time interval already does.
+    stats: Optional[Mapping[str, Tuple[float, float]]] = None
 
     @property
     def num_rows_padded(self) -> int:
@@ -266,6 +271,26 @@ def schema_datasource(
         segments=(),
         time_column=time_col,
     )
+
+
+def compute_segment_stats(
+    dims: Mapping[str, np.ndarray],
+    metrics: Mapping[str, np.ndarray],
+    valid: np.ndarray,
+) -> Dict[str, Tuple[float, float]]:
+    """Per-column (min, max) zone maps over real rows; dimension columns in
+    code space with nulls (code < 0) excluded."""
+    out: Dict[str, Tuple[float, float]] = {}
+    for d, codes in dims.items():
+        c = np.asarray(codes)[valid]
+        c = c[c >= 0]
+        if len(c):
+            out[d] = (float(c.min()), float(c.max()))
+    for m, vals in metrics.items():
+        v = np.asarray(vals)[valid]
+        if len(v):
+            out[m] = (float(v.min()), float(v.max()))
+    return out
 
 
 def build_datasource(
@@ -365,6 +390,7 @@ def build_datasource(
                 interval=interval,
                 time_name=time_col,
                 uid=next(_SEGMENT_UIDS),
+                stats=compute_segment_stats(dims, mets, valid),
             )
         )
 

@@ -62,6 +62,78 @@ from ..utils.log import get_logger
 
 log = get_logger("exec.engine")
 
+
+def _prune_by_stats(segs, filt, ds: DataSource):
+    """Zone-map pruning on a CONSERVATIVE filter subset: top-level AND
+    conjuncts that are Selector/In over dictionary columns (matched in code
+    space — dictionaries are datasource-global, so codes compare across
+    segments) or numeric Bounds over metric columns.  Everything else
+    (OR, NOT, expressions, string bounds) is left to the row kernel —
+    pruning may only ever REMOVE provably-empty segments."""
+    from ..models import filters as F
+
+    conjuncts = (
+        list(filt.fields) if isinstance(filt, F.And) else [filt]
+    )
+
+    def excluded(seg, c) -> bool:
+        st = seg.stats or {}
+        if isinstance(c, F.Selector):
+            if c.value is None or c.dimension not in ds.dicts:
+                return False  # null stats aren't tracked
+            code = ds.dicts[c.dimension].code_of(c.value)
+            if code is None:
+                return True  # value absent from the whole datasource
+            b = st.get(c.dimension)
+            return b is not None and not (b[0] <= code <= b[1])
+        if isinstance(c, F.InFilter):
+            if c.dimension not in ds.dicts:
+                return False
+            if any(v is None for v in c.values):
+                return False  # null membership isn't in the stats
+            codes = [
+                x
+                for x in (
+                    ds.dicts[c.dimension].code_of(v) for v in c.values
+                )
+                if x is not None
+            ]
+            if not codes:
+                return True  # none of the values exist in the datasource
+            b = st.get(c.dimension)
+            return b is not None and not any(
+                b[0] <= x <= b[1] for x in codes
+            )
+        if isinstance(c, F.Bound) and c.ordering == "numeric":
+            if c.dimension in ds.dicts:
+                return False  # numeric-dict code-space bounds: kernel's job
+            b = st.get(c.dimension)
+            if b is None:
+                return False
+            try:
+                if c.lower is not None:
+                    lo = float(c.lower)
+                    if b[1] < lo or (c.lower_strict and b[1] <= lo):
+                        return True
+                if c.upper is not None:
+                    hi = float(c.upper)
+                    if b[0] > hi or (c.upper_strict and b[0] >= hi):
+                        return True
+            except ValueError:
+                return False
+            return False
+        return False
+
+    out = [
+        s for s in segs if not any(excluded(s, c) for c in conjuncts)
+    ]
+    if len(out) < len(segs):
+        log.info(
+            "zone maps pruned %d of %d segments", len(segs) - len(out),
+            len(segs),
+        )
+    return out
+
 # Above this many in-scope segments a query stops unrolling them into one
 # fused program (compile time grows linearly with the unroll) and falls back
 # to the per-segment dispatch loop.  Below it, the whole query is ONE device
@@ -222,19 +294,26 @@ class Engine:
     # -- groupby -------------------------------------------------------------
 
     def _segments_in_scope(self, q, ds: DataSource) -> List[Segment]:
-        """Segment pruning by interval — the analog of the reference narrowing
-        the Druid query interval from time predicates (§3.2)."""
-        if not q.intervals:
-            return list(ds.segments)
-        out = []
-        for s in ds.segments:
-            if s.interval is None:
-                out.append(s)
-                continue
-            lo, hi = s.interval
-            if any(a <= hi and lo < b for a, b in q.intervals):
-                out.append(s)
-        return out
+        """Segment pruning: by time interval (the analog of the reference
+        narrowing the Druid query interval from time predicates, §3.2) and
+        by per-segment zone maps (SURVEY.md §2 metadata "stats" row) —
+        a top-level filter conjunct whose values provably fall outside a
+        segment's [min, max] excludes that segment without a dispatch."""
+        segs = list(ds.segments)
+        if q.intervals:
+            out = []
+            for s in segs:
+                if s.interval is None:
+                    out.append(s)
+                    continue
+                lo, hi = s.interval
+                if any(a <= hi and lo < b for a, b in q.intervals):
+                    out.append(s)
+            segs = out
+        filt = getattr(q, "filter", None)
+        if filt is not None and segs:
+            segs = _prune_by_stats(segs, filt, ds)
+        return segs
 
     def _partials_for_query(
         self, q: Q.GroupByQuery, ds: DataSource, lowering=None
@@ -906,7 +985,7 @@ class Engine:
         if "__time" in order_cols and not ds.time_column:
             # legacy wire `order` implies time ordering; a timeless table
             # cannot honor it — clean error, not a KeyError from the fetch
-            raise ValueError(
+            raise Q.QueryValidationError(
                 f"scan ordering by __time: datasource {ds.name!r} has no "
                 "time column"
             )
@@ -920,7 +999,9 @@ class Engine:
             # wire queries arrive unplanned — validate here so a bad
             # orderBy is a clean 400, not a KeyError mid-fetch
             if c not in sortable:
-                raise ValueError(f"scan orderBy unknown column {c!r}")
+                raise Q.QueryValidationError(
+                    f"scan orderBy unknown column {c!r}"
+                )
         fetch_list = list(
             dict.fromkeys(list(q.columns) + order_cols)
         )

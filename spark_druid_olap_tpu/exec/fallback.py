@@ -39,11 +39,15 @@ from ..utils.log import get_logger
 log = get_logger("exec.fallback")
 
 
-def decoded_frame(ds: DataSource) -> pd.DataFrame:
-    """All real rows of a datasource as a pandas frame: dimensions decoded
-    to values, metrics as float64, time as int64 ms."""
+def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
+    """Real rows of a datasource as a pandas frame: dimensions decoded to
+    values, metrics as float64, time as int64 ms.  `columns` restricts the
+    decode to the names a plan actually references (decoding a wide
+    table's every column would dominate fallback latency)."""
     out: Dict[str, np.ndarray] = {}
     for c in ds.columns:
+        if columns is not None and c.name not in columns:
+            continue
         parts = []
         for seg in ds.segments:
             arr = np.asarray(seg.column(c.name))[seg.valid]
@@ -56,6 +60,43 @@ def decoded_frame(ds: DataSource) -> pd.DataFrame:
             np.concatenate(parts) if parts else np.array([], dtype=object)
         )
     return pd.DataFrame(out)
+
+
+def _plan_columns(lp: L.LogicalPlan) -> set:
+    """Every column name any expression in the plan references (a superset
+    per table — good enough to bound the decode)."""
+    cols: set = set()
+
+    def from_expr(e):
+        if isinstance(e, Expr):
+            cols.update(e.columns())
+
+    if isinstance(lp, L.Filter):
+        from_expr(lp.condition)
+    elif isinstance(lp, L.Project):
+        for _, e in lp.exprs:
+            from_expr(e)
+    elif isinstance(lp, L.Join):
+        cols.update(lp.left_keys)
+        cols.update(lp.right_keys)
+    elif isinstance(lp, L.Aggregate):
+        for _, e in lp.group_exprs:
+            from_expr(e)
+        for ae in lp.agg_exprs:
+            if ae.arg is not None:
+                from_expr(ae.arg)
+            if ae.filter is not None:
+                from_expr(ae.filter)
+        for _, e in lp.post_exprs:
+            from_expr(e)
+    elif isinstance(lp, L.Having):
+        from_expr(lp.condition)
+    elif isinstance(lp, L.Sort):
+        for k in lp.keys:
+            from_expr(k.expr)
+    for child in lp.children():
+        cols |= _plan_columns(child)
+    return cols
 
 
 def _eval(e: Expr, df: pd.DataFrame) -> np.ndarray:
@@ -94,8 +135,10 @@ def _agg_one(ae: L.AggExpr, df: pd.DataFrame):
         vals = vals.drop_duplicates()
     if not len(vals):
         return np.nan  # SQL: aggregate over zero rows is NULL
+    if fn == "sum":
+        # min_count=1: SUM over all-NULL rows is NULL, not pandas' 0
+        return vals.sum(min_count=1)
     return {
-        "sum": vals.sum,
         "min": vals.min,
         "max": vals.max,
         "avg": vals.mean,
@@ -146,13 +189,29 @@ def _aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
             frames.append(f)
         out = pd.concat(frames, ignore_index=True)
         order = [n for n, _ in node.group_exprs]
-        return out[order + [c for c in out.columns if c not in order]]
-    out = one_set(range(len(node.group_exprs)))
-    # post-aggregate projections (exprs over agg outputs)
+        out = out[order + [c for c in out.columns if c not in order]]
+    else:
+        out = one_set(range(len(node.group_exprs)))
+    # post-aggregate projections (exprs over agg outputs) — applied to the
+    # plain and grouping-set shapes alike
     for name, pe in node.post_exprs:
         if isinstance(pe, E.Col) and pe.name in out.columns:
+            if name != pe.name:
+                out[name] = out[pe.name]  # SELECT alias of a group column
             continue
         out[name] = _eval(_refs_to_cols(pe), out)
+    if node.post_exprs:
+        # project to the SELECT list (drops hidden __aggN helpers the
+        # analyzer lifted out of HAVING/ORDER BY) — but keep those helpers
+        # visible to enclosing Having/Sort nodes by appending them last
+        sel = [n for n, _ in node.post_exprs]
+        hidden = [
+            c
+            for c in out.columns
+            if c not in sel
+            and (c.startswith("__agg") or c == "__grouping_id")
+        ]
+        out = out[sel + hidden]
     return out
 
 
@@ -172,27 +231,35 @@ def _refs_to_cols(e: Expr) -> Expr:
     return dataclasses.replace(e, **kw) if kw else e
 
 
-def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
+def execute_fallback(
+    lp: L.LogicalPlan, catalog, _needed=None
+) -> pd.DataFrame:
     """Interpret a logical plan over decoded host frames."""
+    if _needed is None:
+        _needed = _plan_columns(lp)
+        # an empty reference set (e.g. bare count(*)) still needs one
+        # column to carry the row count
+        if not _needed:
+            _needed = None
     if isinstance(lp, L.Scan):
         ds = catalog.get(lp.table)
         if ds is None:
             raise KeyError(f"unknown table {lp.table!r}")
-        return decoded_frame(ds)
+        return decoded_frame(ds, columns=_needed)
     if isinstance(lp, L.Filter):
-        df = execute_fallback(lp.child, catalog)
+        df = execute_fallback(lp.child, catalog, _needed)
         if not len(df):
             return df
         return df[np.asarray(_eval(lp.condition, df), dtype=bool)]
     if isinstance(lp, L.Project):
-        df = execute_fallback(lp.child, catalog)
+        df = execute_fallback(lp.child, catalog, _needed)
         return pd.DataFrame(
             {name: _eval(e, df) for name, e in lp.exprs},
             index=df.index,
         )
     if isinstance(lp, L.Join):
-        left = execute_fallback(lp.left, catalog)
-        right = execute_fallback(lp.right, catalog)
+        left = execute_fallback(lp.left, catalog, _needed)
+        right = execute_fallback(lp.right, catalog, _needed)
         return left.merge(
             right,
             left_on=list(lp.left_keys),
@@ -200,14 +267,14 @@ def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
             how=lp.how,
         )
     if isinstance(lp, L.Aggregate):
-        return _aggregate(lp, execute_fallback(lp.child, catalog))
+        return _aggregate(lp, execute_fallback(lp.child, catalog, _needed))
     if isinstance(lp, L.Having):
-        df = execute_fallback(lp.child, catalog)
+        df = execute_fallback(lp.child, catalog, _needed)
         if not len(df):
             return df
         return df[np.asarray(_eval(_refs_to_cols(lp.condition), df), bool)]
     if isinstance(lp, L.Sort):
-        df = execute_fallback(lp.child, catalog)
+        df = execute_fallback(lp.child, catalog, _needed)
         if not len(df):
             return df
         tmp = []
@@ -223,7 +290,7 @@ def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
         )
         return df.drop(columns=tmp)
     if isinstance(lp, L.Limit):
-        df = execute_fallback(lp.child, catalog)
+        df = execute_fallback(lp.child, catalog, _needed)
         return df.iloc[lp.offset : lp.offset + lp.n]
     raise NotImplementedError(
         f"fallback execution for {type(lp).__name__}"

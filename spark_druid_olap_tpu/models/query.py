@@ -69,6 +69,12 @@ class LimitSpec:
         return d
 
 
+class QueryValidationError(ValueError):
+    """A decoded query names something the datasource cannot satisfy
+    (unknown orderBy column, time ordering on a timeless table) — a CLIENT
+    error (HTTP 400), distinct from internal ValueErrors (500)."""
+
+
 class Having:
     def to_druid(self) -> Dict[str, Any]:
         raise NotImplementedError

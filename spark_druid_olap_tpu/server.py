@@ -201,9 +201,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
         except KeyError as e:
             return self._error(400, f"missing field: {e}")
-        except ValueError as e:
+        except Q.QueryValidationError as e:
             # validation of a decoded query (unknown orderBy column,
-            # __time ordering on a timeless table): client error
+            # __time ordering on a timeless table): client error.  Plain
+            # ValueError stays a 500 — internal invariants are not the
+            # client's fault
             return self._error(400, str(e))
         except Exception as e:  # surface engine errors as 500 JSON
             return self._error(500, f"{type(e).__name__}: {e}")

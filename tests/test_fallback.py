@@ -147,3 +147,44 @@ def test_fallback_exact_distinct_and_avg(ctx):
     )
     np.testing.assert_array_equal(got["dk"], want["dk"])
     np.testing.assert_allclose(got["av"].astype(float), want["av"], rtol=1e-6)
+
+
+def test_fallback_grouping_sets_with_post_exprs(ctx):
+    """ROLLUP through the fallback must apply SELECT expressions over
+    aggregates and not leak internal helper columns."""
+    got = ctx.sql(
+        "SELECT label, sum(v) + 1 AS s1 FROM fact JOIN other ON k = ok "
+        "GROUP BY ROLLUP (label)"
+    )
+    assert "s1" in got.columns
+    assert not any(c.startswith("__") for c in got.columns)
+    # the rollup grand-total row is present (label NULL)
+    assert got["label"].isna().sum() == 1
+
+
+def test_fallback_hidden_having_helper_not_leaked(ctx):
+    got = ctx.sql(
+        "SELECT label, max(v) AS m FROM fact JOIN other ON k = ok "
+        "GROUP BY label HAVING count(*) >= 1"
+    )
+    assert list(got.columns) == ["label", "m"]
+
+
+def test_fallback_sum_distinct_and_all_null():
+    import spark_druid_olap_tpu as sd
+
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "s",
+        {"g": np.array([0, 0, 1, 1]), "v": np.array([2.0, 2.0, 3.0, 4.0], np.float32)},
+        dimensions=["g"],
+        metrics=["v"],
+    )
+    c.register_table(
+        "d", {"dk": np.array([0, 1])}, dimensions=["dk"]
+    )
+    got = c.sql(
+        "SELECT g, sum(DISTINCT v) AS sd FROM s JOIN d ON g = dk "
+        "GROUP BY g ORDER BY g"
+    )
+    assert list(got["sd"]) == [2.0, 7.0]

@@ -1,0 +1,109 @@
+"""Per-segment zone maps (SURVEY.md §2 metadata "stats"): filters that
+provably cannot match a segment prune it before dispatch — and pruning must
+never change results."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+
+
+@pytest.fixture(scope="module")
+def clustered():
+    """Data CLUSTERED by key: segment i holds keys [i*25, (i+1)*25) — the
+    layout where zone maps bite (time-sorted/partitioned ingest)."""
+    n, segs = 40_000, 4
+    keys = np.sort(np.random.default_rng(5).integers(0, 100, n))
+    vals = np.random.default_rng(6).random(n).astype(np.float32) * 100
+    cities = np.array([f"c{k:03d}" for k in keys], dtype=object)
+    ctx = sd.TPUOlapContext()
+    ctx.register_table(
+        "cl",
+        {"city": cities, "k": keys, "v": vals},
+        dimensions=["city", "k"],
+        metrics=["v"],
+        rows_per_segment=n // segs,
+    )
+    df = pd.DataFrame(
+        {"city": cities, "k": keys.astype(np.int64),
+         "v": vals.astype(np.float64)}
+    )
+    return ctx, df
+
+
+def test_selector_prunes_to_one_segment(clustered):
+    ctx, df = clustered
+    ds = ctx.catalog.get("cl")
+    target = "c010"  # lives only in the first quarter of the keys
+    eng = ctx.engine
+    segs = eng._segments_in_scope(
+        ctx.plan_sql(
+            f"SELECT count(*) AS n FROM cl WHERE city = '{target}'"
+        ).query,
+        ds,
+    )
+    assert len(segs) < len(ds.segments)
+    got = ctx.sql(f"SELECT count(*) AS n FROM cl WHERE city = '{target}'")
+    assert int(got["n"].iloc[0]) == int((df.city == target).sum())
+
+
+def test_absent_value_prunes_everything(clustered):
+    ctx, df = clustered
+    got = ctx.sql("SELECT count(*) AS n FROM cl WHERE city = 'nope'")
+    assert int(got["n"].iloc[0]) == 0
+    got2 = ctx.sql(
+        "SELECT count(*) AS n FROM cl WHERE city IN ('nope', 'nada')"
+    )
+    assert int(got2["n"].iloc[0]) == 0
+
+
+def test_numeric_bound_prunes_and_stays_exact(clustered):
+    ctx, df = clustered
+    # v is uniform across segments -> no pruning from v; k is clustered
+    for sql, mask in [
+        ("SELECT sum(v) AS s, count(*) AS n FROM cl WHERE v > 150",
+         df.v > 150),  # beyond global max: zero rows
+        ("SELECT sum(v) AS s, count(*) AS n FROM cl WHERE v <= 50",
+         df.v <= 50),
+    ]:
+        got = ctx.sql(sql)
+        want_n = int(mask.sum())
+        assert int(got["n"].iloc[0]) == want_n
+        if want_n:
+            np.testing.assert_allclose(
+                float(got["s"].iloc[0]), df.v[mask].sum(), rtol=2e-5
+            )
+
+
+def test_in_filter_parity_under_pruning(clustered):
+    ctx, df = clustered
+    vals = ["c005", "c050", "c095"]  # spans three different segments
+    frag = ", ".join(f"'{v}'" for v in vals)
+    got = ctx.sql(
+        f"SELECT city, count(*) AS n FROM cl WHERE city IN ({frag}) "
+        "GROUP BY city ORDER BY city"
+    )
+    want = (
+        df[df.city.isin(vals)]
+        .groupby("city", as_index=False)
+        .size()
+        .rename(columns={"size": "n"})
+        .sort_values("city")
+    )
+    assert list(got["city"]) == list(want["city"])
+    np.testing.assert_array_equal(got["n"].values, want["n"].values)
+
+
+def test_stats_survive_persistence(tmp_path, clustered):
+    ctx, df = clustered
+    from spark_druid_olap_tpu.catalog.persist import (
+        load_datasource,
+        save_datasource,
+    )
+
+    d = save_datasource(ctx.catalog.get("cl"), str(tmp_path / "cl"))
+    ds2, _ = load_datasource(d)
+    assert all(s.stats for s in ds2.segments)
+    s0 = ds2.segments[0]
+    assert s0.stats["k"][0] == 0.0  # first segment holds the smallest keys
