@@ -270,15 +270,7 @@ class TPUOlapContext:
             log.warning(
                 "rewrite failed (%s); executing on the host fallback", err
             )
-            df = execute_fallback(lp, self.catalog)
-            # analyzer-internal columns (HAVING/ORDER BY helpers, the
-            # grouping-set bitmask) must not leak into user results
-            internal = [
-                c
-                for c in df.columns
-                if c.startswith("__agg") or c == "__grouping_id"
-            ]
-            return df.drop(columns=internal).reset_index(drop=True)
+            return execute_fallback(lp, self.catalog)
         self._plan_cache[key] = rw
         return self.execute_rewrite(rw)
 

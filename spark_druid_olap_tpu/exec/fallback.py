@@ -193,25 +193,15 @@ def _aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
     else:
         out = one_set(range(len(node.group_exprs)))
     # post-aggregate projections (exprs over agg outputs) — applied to the
-    # plain and grouping-set shapes alike
+    # plain and grouping-set shapes alike.  NO projection here: enclosing
+    # Sort/Having nodes may reference group columns or hidden helpers; the
+    # user-facing SELECT projection happens once at the fallback root.
     for name, pe in node.post_exprs:
         if isinstance(pe, E.Col) and pe.name in out.columns:
             if name != pe.name:
                 out[name] = out[pe.name]  # SELECT alias of a group column
             continue
         out[name] = _eval(_refs_to_cols(pe), out)
-    if node.post_exprs:
-        # project to the SELECT list (drops hidden __aggN helpers the
-        # analyzer lifted out of HAVING/ORDER BY) — but keep those helpers
-        # visible to enclosing Having/Sort nodes by appending them last
-        sel = [n for n, _ in node.post_exprs]
-        hidden = [
-            c
-            for c in out.columns
-            if c not in sel
-            and (c.startswith("__agg") or c == "__grouping_id")
-        ]
-        out = out[sel + hidden]
     return out
 
 
@@ -231,35 +221,77 @@ def _refs_to_cols(e: Expr) -> Expr:
     return dataclasses.replace(e, **kw) if kw else e
 
 
-def execute_fallback(
+def _needs_all_columns(lp: L.LogicalPlan, under_project: bool = False) -> bool:
+    """True when some Scan reaches the root without a Project/Aggregate
+    above it (SELECT *): its table's every column is part of the result, so
+    decode pruning must not apply."""
+    if isinstance(lp, L.Scan):
+        return not under_project
+    up = under_project or isinstance(lp, (L.Project, L.Aggregate))
+    return any(_needs_all_columns(c, up) for c in lp.children())
+
+
+def _select_list(lp: L.LogicalPlan):
+    """The user-facing output column list: the outermost Project's names,
+    or the outermost Aggregate's SELECT items (post_exprs) when present.
+    None = no explicit list (SELECT *): return everything non-internal."""
+    if isinstance(lp, (L.Limit, L.Sort, L.Having)):
+        return _select_list(lp.children()[0])
+    if isinstance(lp, L.Project):
+        return [n for n, _ in lp.exprs]
+    if isinstance(lp, L.Aggregate):
+        if lp.post_exprs:
+            return [n for n, _ in lp.post_exprs]
+        return [n for n, _ in lp.group_exprs] + [
+            ae.name
+            for ae in lp.agg_exprs
+            if not ae.name.startswith("__agg")
+        ]
+    return None
+
+
+def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
+    """Interpret a logical plan over decoded host frames, projecting the
+    result to the plan's SELECT list at the end (enclosing Sort/Having see
+    every intermediate column; the user does not)."""
+    needed = None if _needs_all_columns(lp) else (_plan_columns(lp) or None)
+    df = _exec(lp, catalog, needed)
+    sel = _select_list(lp)
+    if sel is not None:
+        df = df[[c for c in sel if c in df.columns]]
+    else:
+        internal = [
+            c
+            for c in df.columns
+            if c.startswith("__agg") or c == "__grouping_id"
+        ]
+        df = df.drop(columns=internal)
+    return df.reset_index(drop=True)
+
+
+def _exec(
     lp: L.LogicalPlan, catalog, _needed=None
 ) -> pd.DataFrame:
     """Interpret a logical plan over decoded host frames."""
-    if _needed is None:
-        _needed = _plan_columns(lp)
-        # an empty reference set (e.g. bare count(*)) still needs one
-        # column to carry the row count
-        if not _needed:
-            _needed = None
     if isinstance(lp, L.Scan):
         ds = catalog.get(lp.table)
         if ds is None:
             raise KeyError(f"unknown table {lp.table!r}")
         return decoded_frame(ds, columns=_needed)
     if isinstance(lp, L.Filter):
-        df = execute_fallback(lp.child, catalog, _needed)
+        df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
         return df[np.asarray(_eval(lp.condition, df), dtype=bool)]
     if isinstance(lp, L.Project):
-        df = execute_fallback(lp.child, catalog, _needed)
+        df = _exec(lp.child, catalog, _needed)
         return pd.DataFrame(
             {name: _eval(e, df) for name, e in lp.exprs},
             index=df.index,
         )
     if isinstance(lp, L.Join):
-        left = execute_fallback(lp.left, catalog, _needed)
-        right = execute_fallback(lp.right, catalog, _needed)
+        left = _exec(lp.left, catalog, _needed)
+        right = _exec(lp.right, catalog, _needed)
         return left.merge(
             right,
             left_on=list(lp.left_keys),
@@ -267,14 +299,14 @@ def execute_fallback(
             how=lp.how,
         )
     if isinstance(lp, L.Aggregate):
-        return _aggregate(lp, execute_fallback(lp.child, catalog, _needed))
+        return _aggregate(lp, _exec(lp.child, catalog, _needed))
     if isinstance(lp, L.Having):
-        df = execute_fallback(lp.child, catalog, _needed)
+        df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
         return df[np.asarray(_eval(_refs_to_cols(lp.condition), df), bool)]
     if isinstance(lp, L.Sort):
-        df = execute_fallback(lp.child, catalog, _needed)
+        df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
         tmp = []
@@ -290,7 +322,7 @@ def execute_fallback(
         )
         return df.drop(columns=tmp)
     if isinstance(lp, L.Limit):
-        df = execute_fallback(lp.child, catalog, _needed)
+        df = _exec(lp.child, catalog, _needed)
         return df.iloc[lp.offset : lp.offset + lp.n]
     raise NotImplementedError(
         f"fallback execution for {type(lp).__name__}"

@@ -212,9 +212,19 @@ class TopNQuery(QuerySpec):
             "dataSource": self.datasource,
             "granularity": self.granularity,
             "dimension": self.dimension.to_druid(),
-            "metric": self.metric
-            if self.descending
-            else {"type": "inverted", "metric": self.metric},
+            # ranking by the dimension's own value serializes as Druid's
+            # dimension metric spec; aggregate metrics as plain/inverted
+            "metric": (
+                {
+                    "type": "dimension",
+                    "ordering": "descending" if self.descending
+                    else "lexicographic",
+                }
+                if self.metric == self.dimension.name
+                else self.metric
+                if self.descending
+                else {"type": "inverted", "metric": self.metric}
+            ),
             "threshold": self.threshold,
             "aggregations": [a.to_druid() for a in self.aggregations],
             "intervals": _ivs(self.intervals),

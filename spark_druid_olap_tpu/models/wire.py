@@ -312,15 +312,32 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
         )
     if qt == "topN":
         filt, ivs, vcols, aggs, posts = _common(d)
+        dim = dimension_from_druid(d["dimension"])
         metric = d["metric"]
         descending = True
         if isinstance(metric, dict):
-            if metric.get("type") == "inverted":
+            t = metric.get("type")
+            if t == "inverted":
                 descending = False
-            metric = metric.get("metric")
+                metric = metric.get("metric")
+            elif t in ("dimension", "lexicographic"):
+                # dimension-ordered topN: rank by the dimension's own value
+                # — finalize sorts the decoded dimension column directly.
+                # alphaNumeric/numeric orderings rank c2 before c10; a
+                # lexicographic sort would silently return the wrong top-K,
+                # so they are rejected, not coerced
+                ordering = metric.get("ordering", "lexicographic")
+                if ordering not in ("lexicographic", "descending"):
+                    raise WireError(
+                        f"unsupported topN dimension ordering {ordering!r}"
+                    )
+                descending = ordering == "descending"
+                metric = dim.name
+            else:
+                raise WireError(f"unsupported topN metric spec {t!r}")
         return Q.TopNQuery(
             datasource=ds,
-            dimension=dimension_from_druid(d["dimension"]),
+            dimension=dim,
             metric=metric,
             threshold=d["threshold"],
             aggregations=aggs,

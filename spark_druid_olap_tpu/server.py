@@ -212,7 +212,13 @@ class _Handler(BaseHTTPRequestHandler):
         return self._error(404, f"no route {path!r}")
 
     def _native_query(self, body: dict):
-        q = query_from_druid(body)
+        try:
+            q = query_from_druid(body)
+        except ValueError as e:
+            # decode-time ValueErrors (unsupported filter type, malformed
+            # interval timestamps) are malformed CLIENT input — 400, same
+            # as WireError; execution-time ValueErrors stay 500
+            raise WireError(str(e)) from e
         ds = self.ctx.catalog.get(q.datasource)
         if ds is None:
             return self._error(400, f"unknown dataSource {q.datasource!r}")

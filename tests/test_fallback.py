@@ -188,3 +188,26 @@ def test_fallback_sum_distinct_and_all_null():
         "GROUP BY g ORDER BY g"
     )
     assert list(got["sd"]) == [2.0, 7.0]
+
+
+def test_fallback_select_star_keeps_all_columns(ctx):
+    """SELECT * has no Project node: decode pruning must not drop
+    unreferenced columns (review-confirmed regression)."""
+    got = ctx.sql(
+        "SELECT * FROM fact JOIN other ON k = ok WHERE label = 'label1' "
+        "LIMIT 5"
+    )
+    assert {"k", "mode", "v", "ok", "label"} <= set(got.columns)
+    assert len(got) == 5
+    assert (got["label"] == "label1").all()
+
+
+def test_fallback_order_by_unselected_group_column(ctx):
+    """Sort/Having over a group column that is NOT in the SELECT list must
+    work (the projection happens at the root, after them)."""
+    got = ctx.sql(
+        "SELECT sum(v) AS s FROM fact JOIN other ON k = ok "
+        "GROUP BY label ORDER BY label"
+    )
+    assert list(got.columns) == ["s"]
+    assert len(got) == 7  # one row per label, ordered by the hidden label

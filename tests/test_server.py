@@ -392,3 +392,33 @@ def test_native_groupby_subtotals_spec(served):
     body["subtotalsSpec"] = [["nope"]]
     status, err = _post(srv, "/druid/v2", body)
     assert status == 400
+
+
+def test_topn_dimension_metric(served):
+    """Druid's dimension-ordered topN (lexicographic ranking by the
+    dimension value itself) must be honored, both orderings."""
+    ctx, srv, df = served
+    body = {
+        "queryType": "topN",
+        "dataSource": "ev",
+        "dimension": "city",
+        "metric": {"type": "dimension", "ordering": "lexicographic"},
+        "threshold": 3,
+        "aggregations": [{"type": "count", "name": "n"}],
+        "granularity": "all",
+        "intervals": ["0000-01-01T00:00:00.000Z/3000-01-01T00:00:00.000Z"],
+    }
+    status, out = _post(srv, "/druid/v2", body)
+    assert status == 200
+    rows = out[0]["result"]
+    cities = [r["city"] for r in rows]
+    assert cities == sorted(set(df["city"]))[:3]
+    body["metric"] = {"type": "dimension", "ordering": "descending"}
+    status, out2 = _post(srv, "/druid/v2", body)
+    assert status == 200
+    cities2 = [r["city"] for r in out2[0]["result"]]
+    assert cities2 == sorted(set(df["city"]), reverse=True)[:3]
+    # an unsupported metric spec type is a clean 400
+    body["metric"] = {"type": "nope"}
+    status, err = _post(srv, "/druid/v2", body)
+    assert status == 400
