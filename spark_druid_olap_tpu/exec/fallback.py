@@ -117,9 +117,13 @@ def _agg_one(ae: L.AggExpr, df: pd.DataFrame):
         if ae.arg is not None
         else np.ones(len(df))
     )
-    if fn in ("count_distinct", "approx_count_distinct") or (
-        fn == "count" and ae.distinct
-    ):
+    if fn in (
+        "count_distinct",
+        "approx_count_distinct",
+        "approx_count_distinct_ds_theta",
+        "approx_count_distinct_ds_hll",
+    ) or (fn == "count" and ae.distinct):
+        # all distinct variants evaluate EXACTLY here (host pandas)
         return pd.Series(arg).nunique(dropna=True)
     if fn == "count":
         return int(pd.Series(arg).notna().sum())
@@ -258,7 +262,14 @@ def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
     df = _exec(lp, catalog, needed)
     sel = _select_list(lp)
     if sel is not None:
-        df = df[[c for c in sel if c in df.columns]]
+        missing = [c for c in sel if c not in df.columns]
+        if missing:
+            # a SELECT column no node materialized is a planner/interpreter
+            # bug — fail loudly rather than return a narrower result
+            raise KeyError(
+                f"fallback result is missing SELECT columns {missing}"
+            )
+        df = df[list(sel)]
     else:
         internal = [
             c

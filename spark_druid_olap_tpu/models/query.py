@@ -206,25 +206,32 @@ class TopNQuery(QuerySpec):
     virtual_columns: Tuple[VirtualColumn, ...] = ()
     descending: bool = True
 
+    def _metric_to_druid(self):
+        """Druid wire metric spec.  Ranking by the dimension's own value is
+        recognized by name — but only when no aggregation/post-agg claims
+        that name (an aggregate deliberately named like the dimension must
+        stay a numeric metric spec).  Descending dimension order uses
+        Druid's inverted-wrapped lexicographic form; ascending aggregates
+        the inverted wrapper."""
+        agg_names = {a.name for a in self.aggregations} | {
+            p.name for p in self.post_aggregations
+        }
+        if self.metric == self.dimension.name and self.metric not in agg_names:
+            dim_spec = {"type": "dimension", "ordering": "lexicographic"}
+            if self.descending:
+                return {"type": "inverted", "metric": dim_spec}
+            return dim_spec
+        if self.descending:
+            return self.metric
+        return {"type": "inverted", "metric": self.metric}
+
     def to_druid(self):
         d: Dict[str, Any] = {
             "queryType": "topN",
             "dataSource": self.datasource,
             "granularity": self.granularity,
             "dimension": self.dimension.to_druid(),
-            # ranking by the dimension's own value serializes as Druid's
-            # dimension metric spec; aggregate metrics as plain/inverted
-            "metric": (
-                {
-                    "type": "dimension",
-                    "ordering": "descending" if self.descending
-                    else "lexicographic",
-                }
-                if self.metric == self.dimension.name
-                else self.metric
-                if self.descending
-                else {"type": "inverted", "metric": self.metric}
-            ),
+            "metric": self._metric_to_druid(),
             "threshold": self.threshold,
             "aggregations": [a.to_druid() for a in self.aggregations],
             "intervals": _ivs(self.intervals),

@@ -320,18 +320,34 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
             if t == "inverted":
                 descending = False
                 metric = metric.get("metric")
+                if isinstance(metric, dict):
+                    # Druid encodes descending dimension order as inverted-
+                    # wrapped lexicographic
+                    if metric.get("type") not in ("dimension", "lexicographic"):
+                        raise WireError(
+                            "unsupported inverted topN metric "
+                            f"{metric.get('type')!r}"
+                        )
+                    ordering = metric.get("ordering", "lexicographic")
+                    if ordering != "lexicographic":
+                        raise WireError(
+                            f"unsupported topN dimension ordering {ordering!r}"
+                        )
+                    descending = True
+                    metric = dim.name
             elif t in ("dimension", "lexicographic"):
-                # dimension-ordered topN: rank by the dimension's own value
-                # — finalize sorts the decoded dimension column directly.
-                # alphaNumeric/numeric orderings rank c2 before c10; a
-                # lexicographic sort would silently return the wrong top-K,
-                # so they are rejected, not coerced
+                # dimension-ordered topN: rank ASCENDING by the dimension's
+                # own value (Druid expresses descending as inverted-wrapped
+                # lexicographic, handled above).  alphaNumeric/numeric
+                # orderings rank c2 before c10; a lexicographic sort would
+                # silently return the wrong top-K, so they are rejected,
+                # not coerced
                 ordering = metric.get("ordering", "lexicographic")
-                if ordering not in ("lexicographic", "descending"):
+                if ordering != "lexicographic":
                     raise WireError(
                         f"unsupported topN dimension ordering {ordering!r}"
                     )
-                descending = ordering == "descending"
+                descending = False
                 metric = dim.name
             else:
                 raise WireError(f"unsupported topN metric spec {t!r}")

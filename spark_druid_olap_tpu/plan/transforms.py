@@ -382,19 +382,40 @@ def translate_aggregate(
     if fn == "count" and not agg.distinct:
         return [wrap(A.Count(name))], [], b
 
-    if fn in ("count_distinct", "approx_count_distinct") or (
-        fn == "count" and agg.distinct
-    ):
+    if fn in (
+        "count_distinct",
+        "approx_count_distinct",
+        "approx_count_distinct_ds_theta",
+        "approx_count_distinct_ds_hll",
+    ) or (fn == "count" and agg.distinct):
         if not isinstance(arg, E.Col):
             raise RewriteError("COUNT(DISTINCT) over expressions unsupported")
-        if (
-            cfg.count_distinct_mode == "error"
-            and fn != "approx_count_distinct"
+        if cfg.count_distinct_mode == "error" and fn in (
+            "count_distinct",
+            "count",
         ):
-            # explicit approx_count_distinct() is always allowed; bare
+            # explicit approx_count_distinct*() is always allowed; bare
             # COUNT(DISTINCT) honors the mode (the SQL parser lifts it to
             # fn="count_distinct", the builder API to fn="count"+distinct)
             raise RewritePolicyError("COUNT(DISTINCT) disabled by config")
+        # Druid SQL's DataSketches variants pin the sketch family and take
+        # an optional size/precision argument
+        if fn == "approx_count_distinct_ds_theta":
+            k = int(agg.args[0]) if agg.args else cfg.theta_size
+            if k < 1:
+                raise RewritePolicyError("theta sketch size must be >= 1")
+            return [wrap(A.ThetaSketch(name, arg.name, size=k))], [], b
+        if fn == "approx_count_distinct_ds_hll":
+            p = int(agg.args[0]) if agg.args else cfg.hll_precision
+            if not 4 <= p <= 18:
+                raise RewritePolicyError(
+                    "HLL precision must be in [4, 18]"
+                )
+            return (
+                [wrap(A.HyperUnique(name, arg.name, precision=p))],
+                [],
+                b,
+            )
         sketch = cfg.approx_count_distinct_sketch
         if sketch == "theta":
             return [wrap(A.ThetaSketch(name, arg.name, size=cfg.theta_size))], [], b

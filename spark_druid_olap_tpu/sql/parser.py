@@ -472,6 +472,24 @@ class Parser:
         return cond
 
     def _call(self, fn: str) -> E.Expr:
+        if fn in (
+            "approx_count_distinct_ds_theta",
+            "approx_count_distinct_ds_hll",
+        ):
+            # APPROX_COUNT_DISTINCT_DS_THETA(expr[, k]) /
+            # APPROX_COUNT_DISTINCT_DS_HLL(expr[, lgK]) — Druid SQL's
+            # DataSketches variants with an explicit size argument
+            arg = self.expr()
+            extra = ()
+            if self.accept_op(","):
+                k = self.expr()
+                if not isinstance(k, E.Literal) or not isinstance(
+                    k.value, int
+                ):
+                    raise ParseError(f"{fn.upper()} size must be an integer")
+                extra = (int(k.value),)
+            self.expect_op(")")
+            return AggCall(fn, arg, False, self._filter_clause(), extra)
         if fn in ("approx_quantile", "approx_quantile_ds"):
             # APPROX_QUANTILE[_DS](expr, fraction[, k]) — Druid SQL's
             # DataSketches quantile aggregate

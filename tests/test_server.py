@@ -413,7 +413,11 @@ def test_topn_dimension_metric(served):
     rows = out[0]["result"]
     cities = [r["city"] for r in rows]
     assert cities == sorted(set(df["city"]))[:3]
-    body["metric"] = {"type": "dimension", "ordering": "descending"}
+    # descending dimension order is Druid's inverted-wrapped form
+    body["metric"] = {
+        "type": "inverted",
+        "metric": {"type": "dimension", "ordering": "lexicographic"},
+    }
     status, out2 = _post(srv, "/druid/v2", body)
     assert status == 200
     cities2 = [r["city"] for r in out2[0]["result"]]

@@ -162,3 +162,49 @@ def test_filtered_sketch_honors_filter():
         # and the unfiltered truth is far away, so the filter really applied
         full = pd.DataFrame({"g": g, "v": v}).groupby("g").v.nunique()
         assert abs(float(got["th"][i]) - float(full[i])) / float(full[i]) > 0.1
+
+
+def test_ds_variant_aggregates_pin_sketch_family():
+    """APPROX_COUNT_DISTINCT_DS_THETA/HLL pin the sketch family and accept
+    a size argument, regardless of the session default."""
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.models.aggregations import (
+        HyperUnique,
+        ThetaSketch,
+    )
+
+    ctx = sd.TPUOlapContext()
+    rng = np.random.default_rng(2)
+    n = 30_000
+    ctx.register_table(
+        "t",
+        {"u": rng.integers(0, 5_000, n).astype(np.int64)},
+        dimensions=["u"],
+    )
+    rw = ctx.plan_sql(
+        "SELECT APPROX_COUNT_DISTINCT_DS_THETA(u, 2048) AS d FROM t"
+    )
+    (a,) = rw.query.aggregations
+    assert isinstance(a, ThetaSketch) and a.size == 2048
+    rw2 = ctx.plan_sql(
+        "SELECT APPROX_COUNT_DISTINCT_DS_HLL(u, 12) AS d FROM t"
+    )
+    (a2,) = rw2.query.aggregations
+    assert isinstance(a2, HyperUnique) and a2.precision == 12
+    # both estimate within a few percent of the true distinct count
+    seg = ctx.catalog.get("t").segments[0]
+    codes = np.asarray(seg.dims["u"])[seg.valid]
+    true = len(np.unique(codes[codes >= 0]))
+    for sql in (
+        "SELECT APPROX_COUNT_DISTINCT_DS_THETA(u, 2048) AS d FROM t",
+        "SELECT APPROX_COUNT_DISTINCT_DS_HLL(u, 12) AS d FROM t",
+    ):
+        est = int(ctx.sql(sql)["d"].iloc[0])
+        assert abs(est - true) / true < 0.1
+    # the variants stay allowed under count_distinct_mode='error'
+    ctx.sql("SET count_distinct_mode = 'error'")
+    assert int(
+        ctx.sql("SELECT APPROX_COUNT_DISTINCT_DS_THETA(u) AS d FROM t")[
+            "d"
+        ].iloc[0]
+    ) > 4000
