@@ -75,10 +75,15 @@ class TPUOlapContext:
         column_mapping: Optional[Mapping[str, str]] = None,
         rows_per_segment: int = 1 << 22,
         dicts: Optional[Mapping] = None,
+        sort_by: Sequence[str] = (),
     ) -> DataSource:
         """Register a datasource from a pandas DataFrame, a dict of numpy
         columns, or a parquet/csv path (catalog/ingest.py).  `dicts` supplies
-        pre-built dimension dictionaries for already-encoded columns."""
+        pre-built dimension dictionaries for already-encoded columns.
+
+        `sort_by` orders rows by the named columns before segmenting (the
+        Druid secondary-partitioning analog): filters on those columns then
+        prune whole segments via zone maps instead of masking rows."""
         from .catalog.ingest import to_columns_encoded
 
         cols, native_dicts = to_columns_encoded(source)
@@ -123,6 +128,16 @@ class TPUOlapContext:
                 dimensions, metrics = dims, mets
         if not dimensions and not metrics:
             dimensions, metrics = _infer_schema(cols, time_column)
+        if sort_by:
+            missing = [c for c in sort_by if c not in cols]
+            if missing:
+                raise ValueError(f"sort_by names unknown columns {missing}")
+            # stable lexsort (last key primary); encoded dims sort by code,
+            # which is value order (dictionaries are sorted)
+            order = np.lexsort(
+                tuple(np.asarray(cols[c]) for c in reversed(sort_by))
+            )
+            cols = {k: np.asarray(v)[order] for k, v in cols.items()}
         ds = build_datasource(
             name,
             cols,

@@ -105,10 +105,26 @@ def _prune_by_stats(segs, filt, ds: DataSource):
                 b[0] <= x <= b[1] for x in codes
             )
         if isinstance(c, F.Bound) and c.ordering == "numeric":
-            if c.dimension in ds.dicts:
-                return False  # numeric-dict code-space bounds: kernel's job
             b = st.get(c.dimension)
             if b is None:
+                return False
+            if c.dimension in ds.dicts:
+                # numeric dictionary: translate to code space with the SAME
+                # helper the kernel compile uses (ops/filters.py), then
+                # compare against the code-space zone map
+                nv = ds.dicts[c.dimension].numeric_values
+                if nv is None:
+                    return False
+                from ..ops.filters import numeric_dict_code_bounds
+
+                cb = numeric_dict_code_bounds(c, np.asarray(nv))
+                if cb is None:
+                    return False
+                lo_code, hi_code = cb
+                if lo_code is not None and b[1] < lo_code:
+                    return True
+                if hi_code is not None and b[0] > hi_code:
+                    return True
                 return False
             try:
                 if c.lower is not None:

@@ -39,6 +39,30 @@ def _bound_literal(v) -> float | None:
         return float(v)
     return coerce_str_literal(str(v))
 
+def numeric_dict_code_bounds(f, nv: np.ndarray):
+    """Code-space [lo, hi] (either side possibly None) for a numeric Bound
+    over a SORTED numeric dictionary, or None when numeric ordering cannot
+    apply (explicit lexicographic, or a non-numeric literal).  Shared by
+    the kernel compile (`bound_numdict`) and zone-map segment pruning
+    (exec/engine.py) — one translation, so the two can never drift."""
+    if f.ordering == "lexicographic":
+        return None
+    lo_f = _bound_literal(f.lower)
+    hi_f = _bound_literal(f.upper)
+    if (f.lower is not None and lo_f is None) or (
+        f.upper is not None and hi_f is None
+    ):
+        return None
+    lo_code = hi_code = None
+    if lo_f is not None:
+        side = "right" if f.lower_strict else "left"
+        lo_code = int(np.searchsorted(nv, lo_f, side=side))
+    if hi_f is not None:
+        side = "left" if f.upper_strict else "right"
+        hi_code = int(np.searchsorted(nv, hi_f, side=side)) - 1
+    return lo_code, hi_code
+
+
 MaskFn = Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]
 
 
@@ -138,23 +162,9 @@ def compile_filter(f: F.Filter, ds: DataSource) -> MaskFn:
             # codes are the numeric rank, so value order == code order).
             # Honors an explicit lexicographic ordering, and falls back to
             # lexicographic when a bound literal isn't numeric.
-            use_numeric = f.ordering != "lexicographic"
-            lo_f = hi_f = None
-            if use_numeric:
-                lo_f = _bound_literal(f.lower)
-                hi_f = _bound_literal(f.upper)
-                if (f.lower is not None and lo_f is None) or (
-                    f.upper is not None and hi_f is None
-                ):
-                    use_numeric = False
-            if use_numeric:
-                lo_code = hi_code = None
-                if lo_f is not None:
-                    side = "right" if f.lower_strict else "left"
-                    lo_code = int(np.searchsorted(nv, lo_f, side=side))
-                if hi_f is not None:
-                    side = "left" if f.upper_strict else "right"
-                    hi_code = int(np.searchsorted(nv, hi_f, side=side)) - 1
+            cb = numeric_dict_code_bounds(f, np.asarray(nv))
+            if cb is not None:
+                lo_code, hi_code = cb
 
                 def bound_numdict(cols, lo=lo_code, hi=hi_code, dim=dim):
                     c = cols[dim]

@@ -238,6 +238,12 @@ def run_command(ctx, cmd: Command):
             kwargs["column_mapping"] = json.loads(opts.pop("columnMapping"))
         if "rowsPerSegment" in opts:
             kwargs["rows_per_segment"] = int(opts.pop("rowsPerSegment"))
+        if "sortBy" in opts:
+            # secondary partitioning: rows sorted by these columns before
+            # segmenting, so zone maps prune filtered segments
+            kwargs["sort_by"] = [
+                s.strip() for s in opts.pop("sortBy").split(",") if s.strip()
+            ]
         if opts:
             raise ValueError(f"unknown CREATE TABLE options: {sorted(opts)}")
         ds = ctx.register_table(cmd.table, path, **kwargs)

@@ -107,3 +107,46 @@ def test_stats_survive_persistence(tmp_path, clustered):
     assert all(s.stats for s in ds2.segments)
     s0 = ds2.segments[0]
     assert s0.stats["k"][0] == 0.0  # first segment holds the smallest keys
+
+
+def test_sort_by_ingest_enables_pruning():
+    """register_table(sort_by=...): unsorted input gets clustered at ingest
+    so zone maps prune — and results are identical to the unsorted table."""
+    rng = np.random.default_rng(11)
+    n = 20_000
+    key = rng.integers(0, 100, n)  # UNSORTED
+    val = rng.random(n).astype(np.float32)
+    plain = sd.TPUOlapContext()
+    plain.register_table(
+        "u", {"k": key, "v": val}, dimensions=["k"], metrics=["v"],
+        rows_per_segment=n // 4,
+    )
+    sorted_ctx = sd.TPUOlapContext()
+    sorted_ctx.register_table(
+        "u", {"k": key, "v": val}, dimensions=["k"], metrics=["v"],
+        rows_per_segment=n // 4, sort_by=["k"],
+    )
+    sql = "SELECT count(*) AS n, sum(v) AS s FROM u WHERE k = 7"
+    a = plain.sql(sql)
+    b = sorted_ctx.sql(sql)
+    assert int(a["n"].iloc[0]) == int(b["n"].iloc[0])
+    np.testing.assert_allclose(
+        float(a["s"].iloc[0]), float(b["s"].iloc[0]), rtol=2e-5
+    )
+    # the sorted table's scope collapses to a single segment
+    ds = sorted_ctx.catalog.get("u")
+    rw = sorted_ctx.plan_sql(sql)
+    assert len(sorted_ctx.engine._segments_in_scope(rw.query, ds)) == 1
+    assert len(
+        plain.engine._segments_in_scope(
+            plain.plan_sql(sql).query, plain.catalog.get("u")
+        )
+    ) == 4
+
+
+def test_sort_by_unknown_column_rejected():
+    ctx = sd.TPUOlapContext()
+    with pytest.raises(ValueError, match="unknown columns"):
+        ctx.register_table(
+            "x", {"a": np.arange(10)}, dimensions=["a"], sort_by=["nope"]
+        )
