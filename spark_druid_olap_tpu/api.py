@@ -132,11 +132,26 @@ class TPUOlapContext:
             missing = [c for c in sort_by if c not in cols]
             if missing:
                 raise ValueError(f"sort_by names unknown columns {missing}")
+
+            def sort_keys(c):
+                # null-safe keys: object columns with None cannot lexsort
+                # directly; nulls order LAST (flag more significant than
+                # value, so it follows the value key in the lexsort tuple)
+                a = np.asarray(cols[c])
+                if a.dtype.kind == "O":
+                    nulls = np.array([v is None for v in a])
+                    vals = np.array(
+                        [("" if v is None else str(v)) for v in a]
+                    )
+                    return [vals, nulls]
+                return [a]
+
             # stable lexsort (last key primary); encoded dims sort by code,
             # which is value order (dictionaries are sorted)
-            order = np.lexsort(
-                tuple(np.asarray(cols[c]) for c in reversed(sort_by))
-            )
+            keys: list = []
+            for c in reversed(sort_by):
+                keys.extend(sort_keys(c))
+            order = np.lexsort(tuple(keys))
             cols = {k: np.asarray(v)[order] for k, v in cols.items()}
         ds = build_datasource(
             name,

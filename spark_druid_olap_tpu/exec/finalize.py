@@ -118,6 +118,12 @@ def eval_post_agg(
                 "aggregation in the same query)"
             )
         return hll_estimate(states[p.field_name])
+    if isinstance(p, A.ExpressionPost):
+        from ..plan.expr import compile_expr
+
+        fn = compile_expr(p.expression, raw_strings=True)
+        cols = {k: np.asarray(v) for k, v in table.items()}
+        return np.asarray(fn(cols))
     if isinstance(p, A.QuantileFromSketch):
         from ..ops.quantiles import estimate as quantile_estimate
 

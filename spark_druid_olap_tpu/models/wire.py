@@ -38,11 +38,22 @@ class WireError(ValueError):
 
 
 def _expr(source: str):
-    from ..sql.parser import ParseError, Parser
+    from ..sql.parser import Parser
 
     try:
-        return Parser(source).expr()
-    except ParseError as e:
+        p = Parser(source)
+        e = p.expr()
+        if p.peek().kind != "EOF":
+            # a half-parsed expression ("s * 2 bogus") must be rejected,
+            # not silently truncated to the parseable prefix
+            raise WireError(
+                f"expression {source!r} has trailing input at "
+                f"{p.peek().value!r}"
+            )
+        return e
+    except WireError:
+        raise
+    except Exception as e:  # Parse/Lex errors: malformed CLIENT input
         raise WireError(
             f"expression {source!r} does not re-parse under the SQL "
             f"expression grammar: {e}"
@@ -113,6 +124,8 @@ def post_agg_from_druid(d: Dict[str, Any]) -> A.PostAggregation:
                 raise WireError("thetaSketchSetOp requires fields")
             return A.ThetaSketchSetOp(d["name"], fn, fields)
         return A.ThetaSketchEstimate(d["name"], f.get("fieldName", d.get("fieldName")))
+    if t == "expression":
+        return A.ExpressionPost(d["name"], _expr(d["expression"]))
     if t == "quantilesDoublesSketchToQuantile":
         f = d.get("field", {})
         return A.QuantileFromSketch(

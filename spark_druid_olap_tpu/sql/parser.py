@@ -589,6 +589,17 @@ class Parser:
             arg = self.expr()
             self.expect_op(")")
             return E.UnaryOp(fn, arg)
+        if fn == "if":
+            # if(cond, then, else) — Druid's native expression form AND the
+            # spelling str(IfExpr) serializes to, so expression post-aggs /
+            # virtual columns containing CASE round-trip through the wire
+            cond = self.expr()
+            self.expect_op(",")
+            then = self.expr()
+            self.expect_op(",")
+            otherwise = self.expr()
+            self.expect_op(")")
+            return E.IfExpr(cond, then, otherwise)
         if fn == "coalesce":
             args = self._expr_list()
             self.expect_op(")")
