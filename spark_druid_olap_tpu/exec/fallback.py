@@ -107,9 +107,22 @@ def _eval(e: Expr, df: pd.DataFrame) -> np.ndarray:
 
 def _agg_one(ae: L.AggExpr, df: pd.DataFrame):
     """One aggregate over (a filtered view of) one group's rows."""
-    if ae.filter is not None:
-        df = df[np.asarray(_eval(ae.filter, df), dtype=bool)]
     fn = ae.fn.lower()
+    if ae.filter is not None:
+        pre_n = len(df)
+        df = df[np.asarray(_eval(ae.filter, df), dtype=bool)]
+        if pre_n and not len(df):
+            # Druid's filtered aggregator over a NON-empty group whose
+            # filter matches nothing: additive aggregates are 0, AVG's
+            # 0/0 division is 0, extrema/quantiles are NULL — the device
+            # engine's convention, pinned by the fuzz oracle
+            if fn in ("sum", "avg"):
+                return 0.0
+            if fn.startswith("count") or fn.startswith(
+                "approx_count_distinct"
+            ):
+                return 0
+            return np.nan
     if fn == "count" and ae.arg is None and not ae.distinct:
         return len(df)
     arg = (

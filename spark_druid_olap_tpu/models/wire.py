@@ -38,7 +38,8 @@ class WireError(ValueError):
 
 
 def _expr(source: str):
-    from ..sql.parser import Parser
+    from ..sql.lexer import LexError
+    from ..sql.parser import ParseError, Parser
 
     try:
         p = Parser(source)
@@ -53,7 +54,8 @@ def _expr(source: str):
         return e
     except WireError:
         raise
-    except Exception as e:  # Parse/Lex errors: malformed CLIENT input
+    except (ParseError, LexError) as e:  # malformed CLIENT input -> 400;
+        # anything else is an internal parser bug and stays a 500
         raise WireError(
             f"expression {source!r} does not re-parse under the SQL "
             f"expression grammar: {e}"

@@ -122,6 +122,11 @@ class Literal(Expr):
     value: Any
 
     def __str__(self):
+        # str() output must RE-PARSE under the SQL expression grammar (the
+        # wire form of expression post-aggs / virtual columns): repr(None)
+        # would read back as a column named "None"
+        if self.value is None:
+            return "null"
         return repr(self.value)
 
 
@@ -413,6 +418,42 @@ def _compile_comparison(e: "Comparison", dicts, raw_strings: bool = False):
     lf = compile_expr(e.left, dicts, raw_strings=raw_strings)
     rf = compile_expr(e.right, dicts, raw_strings=raw_strings)
     op = _CMP[e.op]
+    if raw_strings:
+        # host mode: a string literal compared against a NUMERIC column
+        # (int64 time ms vs an ISO date string is the common case) coerces
+        # at evaluation time — the device path does this in the filter
+        # translation, which the fallback executor bypasses
+        str_lit = None
+        if isinstance(e.right, Literal) and isinstance(e.right.value, str):
+            str_lit, of, flip = e.right.value, lf, False
+        elif isinstance(e.left, Literal) and isinstance(e.left.value, str):
+            str_lit, of, flip = e.left.value, rf, True
+
+        if str_lit is not None:
+            num = coerce_str_literal(str_lit)
+
+            def cmp_mixed(cols, of=of, num=num, flip=flip):
+                x = np.asarray(of(cols))
+                if x.dtype.kind in ("i", "u", "f") and num is not None:
+                    a, b = (num, x) if flip else (x, num)
+                    return op(a, b)
+                if x.dtype.kind == "O":
+                    # SQL three-valued logic: NULL <op> literal is not a
+                    # match; also keeps numpy from comparing None/NaN
+                    # against str
+                    valid = np.array(
+                        [isinstance(v, str) for v in x], dtype=bool
+                    )
+                    res = np.zeros(x.shape, dtype=bool)
+                    if valid.any():
+                        vx = x[valid].astype(str)
+                        a, b = (str_lit, vx) if flip else (vx, str_lit)
+                        res[valid] = op(a, b)
+                    return res
+                a, b = (str_lit, x) if flip else (x, str_lit)
+                return op(a, b)
+
+            return cmp_mixed
     return lambda cols: op(lf(cols), rf(cols))
 
 
@@ -556,6 +597,22 @@ def compile_expr(
     if isinstance(e, TimeBucket):
         f, p = compile_expr(e.operand, dicts, raw_strings=raw_strings), e.period_ms
         if p is None:
+            if raw_strings:
+                # host mode (fallback executor): calendar truncation is
+                # exact via numpy month arithmetic — handles month/quarter/
+                # year AND ISO calendar periods (P3M, P1Y), same helper the
+                # device bucket tables use
+                from ..utils.granularity import _iso_calendar_months
+
+                k = _iso_calendar_months(e.granularity)
+
+                def cal_trunc(cols, f=f, k=k):
+                    t = np.asarray(f(cols)).astype("datetime64[ms]")
+                    months = t.astype("datetime64[M]").astype(np.int64)
+                    b = ((months // k) * k).astype("datetime64[M]")
+                    return b.astype("datetime64[ms]").astype(np.int64)
+
+                return cal_trunc
             raise ValueError(
                 f"calendar granularity {e.granularity!r} has no fixed period; "
                 "only legal in GROUP BY position (dimension bucketing)"

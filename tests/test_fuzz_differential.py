@@ -432,3 +432,56 @@ def test_fd_pruned_grouping_matches_oracle(world):
     )
     np.testing.assert_array_equal(got["n"], want["n"])
     np.testing.assert_allclose(got["s"].astype(float), want["s"], rtol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def fallback_world(world):
+    """The SAME data registered into a context whose planner is disabled:
+    every query runs on the host fallback executor."""
+    ctx, df = world
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    cfg = SessionConfig()
+    cfg.enable_rewrites = False  # force RewriteError -> fallback
+
+    def _objcol(s):
+        # pandas may surface nulls as NaN floats; dictionary build needs
+        # uniform None
+        return np.array(
+            [
+                None
+                if v is None or (isinstance(v, float) and np.isnan(v))
+                else v
+                for v in s
+            ],
+            dtype=object,
+        )
+
+    ctx2 = sd.TPUOlapContext(config=cfg)
+    # rebuild from decoded rows so both contexts hold identical data
+    data = {
+        "flag": _objcol(df["flag"].values),
+        "mode": _objcol(df["mode"].values),
+        "city": _objcol(df["city"].values),
+        "nation": _objcol(df["nation"].values),
+        "yr": df["yr"].values,
+        "price": df["price"].values.astype(np.float32),
+        "qty": df["qty"].values.astype(np.float32),
+        "ts": df["ts"].values,
+    }
+    ctx2.register_table(
+        "f", data,
+        dimensions=["flag", "mode", "city", "nation", "yr"],
+        metrics=["price", "qty"], time_column="ts",
+        rows_per_segment=16_384,
+    )
+    return ctx2, df
+
+
+@pytest.mark.parametrize("seed", [1, 2, 5, 8, 13, 21, 27, 33])
+def test_fuzz_fallback_matches_oracle(fallback_world, seed):
+    """The host fallback executor, fed the same random SQL the device path
+    gets, must match the pandas oracle — a differential net over the
+    fallback's filters/aggregates/having/order semantics."""
+    ctx2, df = fallback_world
+    _run_case(ctx2, df, seed)

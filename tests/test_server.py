@@ -515,6 +515,20 @@ def test_sort_by_with_nulls():
         dimensions=["c"],
         metrics=["v"],
         sort_by=["c"],
+        rows_per_segment=2,
     )
-    got = c.sql("SELECT c, count(*) AS n FROM ns GROUP BY c ORDER BY c")
-    assert int(got["n"].sum()) == 5
+    # grouping is intact after the null-safe sort
+    got = c.sql("SELECT c, count(*) AS n, sum(v) AS s FROM ns GROUP BY c")
+    by = {row["c"]: row for _, row in got.iterrows()}
+    assert by["a"]["n"] == 1 and by["a"]["s"] == 2.0
+    assert by["b"]["n"] == 2 and by["b"]["s"] == 0.0 + 3.0
+    null_row = got[got["c"].isna()].iloc[0]
+    assert null_row["n"] == 2 and null_row["s"] == 1.0 + 4.0
+    # nulls-last contract: the physical row order is a, b, b, null, null
+    ds = c.catalog.get("ns")
+    codes = np.concatenate(
+        [np.asarray(s.dims["c"])[s.valid] for s in ds.segments]
+    )
+    nulls = codes < 0
+    assert not nulls[:3].any() and nulls[3:].all()
+    assert list(codes[:3]) == sorted(codes[:3])
