@@ -244,6 +244,10 @@ def _needs_all_columns(lp: L.LogicalPlan, under_project: bool = False) -> bool:
     decode pruning must not apply."""
     if isinstance(lp, L.Scan):
         return not under_project
+    if isinstance(lp, L.SubqueryScan):
+        # the inner plan decides its own needs (its _exec call passes
+        # _needed=None anyway)
+        return _needs_all_columns(lp.child, under_project)
     up = under_project or isinstance(lp, (L.Project, L.Aggregate))
     return any(_needs_all_columns(c, up) for c in lp.children())
 
@@ -322,6 +326,20 @@ def _exec(
             right_on=list(lp.right_keys),
             how=lp.how,
         )
+    if isinstance(lp, L.SubqueryScan):
+        # scope boundary: the derived table exports exactly its SELECT
+        # list; outer references to anything else must fail, not fall
+        # through to base-table columns
+        df = _exec(lp.child, catalog, None)
+        if lp.columns is not None:
+            missing = [c for c in lp.columns if c not in df.columns]
+            if missing:
+                raise KeyError(
+                    f"derived table {lp.alias or '(subquery)'} does not "
+                    f"produce columns {missing}"
+                )
+            df = df[list(lp.columns)]
+        return df
     if isinstance(lp, L.Aggregate):
         return _aggregate(lp, _exec(lp.child, catalog, _needed))
     if isinstance(lp, L.Having):

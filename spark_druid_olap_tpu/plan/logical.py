@@ -151,6 +151,26 @@ class Limit(LogicalPlan):
 
 
 @dataclasses.dataclass(frozen=True)
+class SubqueryScan(LogicalPlan):
+    """A derived table's scope boundary: the outer query may reference ONLY
+    `columns` (the subquery's SELECT list; None when it is SELECT *).  The
+    planner never rewrites through it — without the boundary the planner's
+    Project-collapsing walk would silently resolve renamed-away names
+    against the base table."""
+
+    child: LogicalPlan
+    columns: Optional[Tuple[str, ...]]
+    alias: str = ""
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        cols = "*" if self.columns is None else ", ".join(self.columns)
+        return f"SubqueryScan({self.alias}: [{cols}])"
+
+
+@dataclasses.dataclass(frozen=True)
 class Join(LogicalPlan):
     """Equi-join; the star-schema collapse (JoinTransform analog) eliminates
     these when they conform to the declared star schema."""

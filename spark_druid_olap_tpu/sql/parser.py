@@ -51,7 +51,7 @@ class AggCall(E.Expr):
 @dataclasses.dataclass
 class SelectStmt:
     items: List[Tuple[Optional[str], E.Expr]]  # (alias, expr)
-    table: Any  # str | JoinClause
+    table: Any  # str | JoinClause | Subquery
     where: Optional[E.Expr]
     group_by: List[E.Expr]
     group_mode: str  # "plain" | "cube" | "rollup" | "sets"
@@ -65,8 +65,19 @@ class SelectStmt:
 
 
 @dataclasses.dataclass
+class Subquery:
+    """A derived table: FROM (SELECT ...) alias.  The planner cannot push
+    nested queries down (the reference fell back to Spark for them too), so
+    these execute on the host fallback interpreter — but they parse and
+    plan like any other relation."""
+
+    stmt: "SelectStmt"
+    alias: str
+
+
+@dataclasses.dataclass
 class JoinClause:
-    left: Any  # str | JoinClause
+    left: Any  # str | JoinClause (Subquery is rejected in join position)
     right: str
     right_alias: Optional[str]
     on: List[Tuple[str, str]]  # (left col, right col) qualified names
@@ -221,6 +232,22 @@ class Parser:
         return out
 
     def table_ref(self):
+        if self.accept_op("("):
+            # derived table: FROM (SELECT ...) [AS] alias
+            inner = self.select()
+            self.expect_op(")")
+            has_as = self.accept_kw("as")
+            if not has_as and self.peek().kind != "IDENT":
+                # without this, a missing alias would swallow the next
+                # clause keyword (WHERE/ORDER) as the alias
+                raise ParseError("derived table requires an alias")
+            alias = self.expect_ident()
+            self.aliases[alias] = alias
+            if self.peek().kind == "KW" and self.peek().value.lower() in (
+                "join", "inner", "left"
+            ):
+                raise ParseError("JOIN over a derived table unsupported")
+            return Subquery(inner, alias)
         name = self.expect_ident()
         alias = None
         t = self.peek()
@@ -798,6 +825,26 @@ class Analyzer:
     def _from_clause(self, t) -> L.LogicalPlan:
         if isinstance(t, str):
             return L.Scan(t)
+        if isinstance(t, Subquery):
+            # the derived table's plan becomes the outer query's leaf,
+            # wrapped in a SubqueryScan scope boundary: the outer may only
+            # reference the subquery's SELECT-list names (the planner's
+            # Project-collapsing walk would otherwise resolve renamed-away
+            # names against the base table — silent wrong data)
+            inner = Analyzer(t.stmt, dict(self.aliases))
+            names: List[str] = []
+            star = False
+            for alias, e in t.stmt.items:
+                if isinstance(e, E.Col) and e.name == "*":
+                    star = True
+                    break
+                es = _strip_qualifiers(e, self.aliases)
+                names.append(alias or _auto_name(es))
+            return L.SubqueryScan(
+                inner.to_logical(),
+                None if star else tuple(names),
+                t.alias,
+            )
         assert isinstance(t, JoinClause)
         left = self._from_clause(t.left)
         lk, rk = [], []

@@ -211,3 +211,62 @@ def test_fallback_order_by_unselected_group_column(ctx):
     )
     assert list(got.columns) == ["s"]
     assert len(got) == 7  # one row per label, ordered by the hidden label
+
+
+def test_derived_table_aggregate_over_aggregate(ctx):
+    """FROM (SELECT ...) alias: nested aggregation runs on the fallback and
+    matches pandas."""
+    got = ctx.sql(
+        "SELECT avg(s) AS mean_s, count(*) AS groups FROM "
+        "(SELECT k, sum(v) AS s FROM fact GROUP BY k) sub"
+    )
+    f = _fact_frame(ctx)
+    inner = f.groupby("k")["v"].sum()
+    np.testing.assert_allclose(
+        float(got["mean_s"].iloc[0]), inner.mean(), rtol=1e-6
+    )
+    assert int(got["groups"].iloc[0]) == len(inner)
+
+
+def test_derived_table_filter_sort_limit(ctx):
+    f = _fact_frame(ctx)
+    sums = f.groupby("k")["v"].sum()
+    cut = float(sums.median())  # excludes roughly half the groups
+    got = ctx.sql(
+        "SELECT k, s FROM (SELECT k, sum(v) AS s FROM fact GROUP BY k) x "
+        f"WHERE s > {cut} ORDER BY s DESC LIMIT 5"
+    )
+    want = sums[sums > cut].sort_values(ascending=False).head(5)
+    np.testing.assert_allclose(
+        got["s"].astype(float).values, want.values, rtol=1e-6
+    )
+    assert list(got.columns) == ["k", "s"]
+
+
+def test_derived_table_join_rejected(ctx):
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="derived table"):
+        ctx.sql(
+            "SELECT * FROM (SELECT k FROM fact) x JOIN other ON k = ok"
+        )
+
+
+def test_derived_table_is_a_scope_boundary(ctx):
+    """The outer query may only reference the subquery's SELECT list —
+    renamed-away or unexported base columns must error, not silently
+    resolve against the base table."""
+    with pytest.raises(Exception, match="does not produce|v"):
+        ctx.sql("SELECT v FROM (SELECT k FROM fact) x")
+    with pytest.raises(Exception, match="does not produce|k"):
+        ctx.sql("SELECT k FROM (SELECT k AS j FROM fact) x")
+    # the renamed column IS visible under its new name
+    got = ctx.sql("SELECT j FROM (SELECT k AS j FROM fact) x LIMIT 3")
+    assert list(got.columns) == ["j"]
+
+
+def test_derived_table_missing_alias_is_clear_error(ctx):
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="requires an alias"):
+        ctx.sql("SELECT k FROM (SELECT k FROM fact) WHERE k > 5")
