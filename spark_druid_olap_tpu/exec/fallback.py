@@ -258,6 +258,10 @@ def _select_list(lp: L.LogicalPlan):
     None = no explicit list (SELECT *): return everything non-internal."""
     if isinstance(lp, (L.Limit, L.Sort, L.Having)):
         return _select_list(lp.children()[0])
+    if isinstance(lp, L.Union):
+        # branch frames are already projected/aligned to the first
+        # branch's names
+        return _select_list(lp.branches[0])
     if isinstance(lp, L.Project):
         return [n for n, _ in lp.exprs]
     if isinstance(lp, L.Aggregate):
@@ -271,12 +275,9 @@ def _select_list(lp: L.LogicalPlan):
     return None
 
 
-def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
-    """Interpret a logical plan over decoded host frames, projecting the
-    result to the plan's SELECT list at the end (enclosing Sort/Having see
-    every intermediate column; the user does not)."""
-    needed = None if _needs_all_columns(lp) else (_plan_columns(lp) or None)
-    df = _exec(lp, catalog, needed)
+def _project_root(df: pd.DataFrame, lp: L.LogicalPlan) -> pd.DataFrame:
+    """Project an interpreted frame to the plan's SELECT list (enclosing
+    Sort/Having saw every intermediate column; the consumer does not)."""
     sel = _select_list(lp)
     if sel is not None:
         missing = [c for c in sel if c not in df.columns]
@@ -294,7 +295,15 @@ def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
             if c.startswith("__agg") or c == "__grouping_id"
         ]
         df = df.drop(columns=internal)
-    return df.reset_index(drop=True)
+    return df
+
+
+def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
+    """Interpret a logical plan over decoded host frames, projecting the
+    result to the plan's SELECT list at the end."""
+    needed = None if _needs_all_columns(lp) else (_plan_columns(lp) or None)
+    df = _exec(lp, catalog, needed)
+    return _project_root(df, lp).reset_index(drop=True)
 
 
 def _exec(
@@ -326,11 +335,46 @@ def _exec(
             right_on=list(lp.right_keys),
             how=lp.how,
         )
+    if isinstance(lp, L.Union):
+        # each branch projects to ITS select list first (an aggregate
+        # branch's frame carries group/helper columns that would wreck
+        # positional alignment), then aligns to the first branch's names;
+        # decode pruning is computed per branch
+        frames = [
+            _project_root(
+                _exec(
+                    b,
+                    catalog,
+                    None
+                    if _needs_all_columns(b)
+                    else (_plan_columns(b) or None),
+                ),
+                b,
+            )
+            for b in lp.branches
+        ]
+        first = frames[0].columns
+        aligned = [frames[0]]
+        for f in frames[1:]:
+            if len(f.columns) != len(first):
+                raise ValueError(
+                    "UNION ALL branch produced "
+                    f"{len(f.columns)} columns, expected {len(first)}"
+                )
+            aligned.append(f.set_axis(list(first), axis=1))
+        return pd.concat(aligned, ignore_index=True)
     if isinstance(lp, L.SubqueryScan):
         # scope boundary: the derived table exports exactly its SELECT
         # list; outer references to anything else must fail, not fall
-        # through to base-table columns
-        df = _exec(lp.child, catalog, None)
+        # through to base-table columns.  The inner plan's decode pruning
+        # is computed from the inner plan alone
+        df = _exec(
+            lp.child,
+            catalog,
+            None
+            if _needs_all_columns(lp.child)
+            else (_plan_columns(lp.child) or None),
+        )
         if lp.columns is not None:
             missing = [c for c in lp.columns if c not in df.columns]
             if missing:

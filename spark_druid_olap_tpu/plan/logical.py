@@ -151,6 +151,21 @@ class Limit(LogicalPlan):
 
 
 @dataclasses.dataclass(frozen=True)
+class Union(LogicalPlan):
+    """UNION ALL: branches aligned by position, column names from the
+    first branch.  Not pushable (the reference fell back to Spark); the
+    host fallback concatenates branch frames."""
+
+    branches: Tuple[LogicalPlan, ...]
+
+    def children(self):
+        return self.branches
+
+    def _label(self):
+        return f"Union(all, {len(self.branches)} branches)"
+
+
+@dataclasses.dataclass(frozen=True)
 class SubqueryScan(LogicalPlan):
     """A derived table's scope boundary: the outer query may reference ONLY
     `columns` (the subquery's SELECT list; None when it is SELECT *).  The

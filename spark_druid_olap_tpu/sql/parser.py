@@ -65,6 +65,18 @@ class SelectStmt:
 
 
 @dataclasses.dataclass
+class UnionStmt:
+    """UNION ALL chain; order/limit hoisted from the last branch apply to
+    the combined result (column names come from the first branch)."""
+
+    branches: List[SelectStmt]
+    order_by: List[Tuple[E.Expr, bool]]
+    limit: Optional[int]
+    offset: int
+    explain: bool = False
+
+
+@dataclasses.dataclass
 class Subquery:
     """A derived table: FROM (SELECT ...) alias.  The planner cannot push
     nested queries down (the reference fell back to Spark for them too), so
@@ -134,18 +146,52 @@ class Parser:
 
     # -- statement -----------------------------------------------------------
 
-    def parse(self) -> SelectStmt:
+    def parse(self):
         explain = False
         if self.accept_kw("explain"):
             self.accept_kw("rewrite")  # EXPLAIN [REWRITE]
             explain = True
         stmt = self.select()
         stmt.explain = explain
+        branches = [stmt]
+        while self.accept_kw("union"):
+            self.expect_kw("all")  # bag-semantics UNION ALL only
+            branches.append(self.select())
         if self.accept_op(";"):
             pass
         if self.peek().kind != "EOF":
             raise ParseError(f"trailing input at {self.peek().value!r}")
-        return stmt
+        if len(branches) == 1:
+            return stmt
+        # the trailing ORDER BY / LIMIT the last branch parsed belong to
+        # the whole union (SQL forbids them before UNION)
+        last = branches[-1]
+        out = UnionStmt(
+            branches=branches,
+            order_by=last.order_by,
+            limit=last.limit,
+            offset=last.offset,
+            explain=explain,
+        )
+        last.order_by, last.limit, last.offset = [], None, 0
+        for b in branches[:-1]:
+            # standard SQL forbids these before UNION; applying them
+            # per-branch would silently change row counts
+            if b.order_by or b.limit is not None or b.offset:
+                raise ParseError(
+                    "ORDER BY/LIMIT/OFFSET is only valid after the last "
+                    "UNION ALL branch"
+                )
+        for b in branches:
+            if len(b.items) != len(branches[0].items):
+                raise ParseError(
+                    "UNION ALL branches have different column counts"
+                )
+            if any(
+                isinstance(e, E.Col) and e.name == "*" for _, e in b.items
+            ):
+                raise ParseError("SELECT * in UNION ALL unsupported")
+        return out
 
     def select(self) -> SelectStmt:
         self.expect_kw("select")
@@ -832,17 +878,10 @@ class Analyzer:
             # Project-collapsing walk would otherwise resolve renamed-away
             # names against the base table — silent wrong data)
             inner = Analyzer(t.stmt, dict(self.aliases))
-            names: List[str] = []
-            star = False
-            for alias, e in t.stmt.items:
-                if isinstance(e, E.Col) and e.name == "*":
-                    star = True
-                    break
-                es = _strip_qualifiers(e, self.aliases)
-                names.append(alias or _auto_name(es))
+            names = _stmt_out_names(t.stmt, self.aliases)  # [] = SELECT *
             return L.SubqueryScan(
                 inner.to_logical(),
-                None if star else tuple(names),
+                tuple(names) if names else None,
                 t.alias,
             )
         assert isinstance(t, JoinClause)
@@ -959,17 +998,58 @@ def _auto_name(e: E.Expr) -> str:
     return f"expr_{s}" if s else "expr"
 
 
+def _stmt_out_names(stmt: SelectStmt, aliases) -> List[str]:
+    out_names: List[str] = []
+    for alias, e in stmt.items:
+        if isinstance(e, E.Col) and e.name == "*":
+            return []
+        es = _strip_qualifiers(e, aliases)
+        out_names.append(alias or _auto_name(es))
+    return out_names
+
+
 def parse_sql(sql: str) -> Tuple[L.LogicalPlan, bool, List[str]]:
     """Returns (logical plan, explain?, SELECT-order output names)."""
     p = Parser(sql)
     stmt = p.parse()
+    if isinstance(stmt, UnionStmt):
+        plans = tuple(
+            Analyzer(b, dict(p.aliases)).to_logical() for b in stmt.branches
+        )
+        plan: L.LogicalPlan = L.Union(plans)
+        first = stmt.branches[0]
+        if stmt.order_by:
+            # mirror Analyzer._order_limit's resolution: ordinals bind to
+            # the first branch's SELECT items; aggregates have no grouping
+            # context after UNION ALL and are rejected, not crashed on
+            keys = []
+            for e, asc in stmt.order_by:
+                es = _strip_qualifiers(e, p.aliases)
+                if _contains_agg(es):
+                    raise ParseError(
+                        "ORDER BY after UNION ALL must reference output "
+                        "columns, not aggregates"
+                    )
+                if isinstance(es, E.Literal) and isinstance(es.value, int):
+                    idx = es.value - 1
+                    if not 0 <= idx < len(first.items):
+                        raise ParseError(
+                            f"ORDER BY ordinal {es.value} out of range"
+                        )
+                    alias, ie = first.items[idx]
+                    es = E.Col(
+                        alias
+                        or _auto_name(_strip_qualifiers(ie, p.aliases))
+                    )
+                keys.append(L.SortKey(es, asc))
+            plan = L.Sort(tuple(keys), plan)
+        if stmt.limit is not None or stmt.offset:
+            plan = L.Limit(
+                stmt.limit if stmt.limit is not None else (1 << 62),
+                plan,
+                stmt.offset,
+            )
+        return plan, stmt.explain, _stmt_out_names(first, p.aliases)
     analyzer = Analyzer(stmt, p.aliases)
     plan = analyzer.to_logical()
-    out_names: List[str] = []
-    for alias, e in stmt.items:
-        if isinstance(e, E.Col) and e.name == "*":
-            out_names = []
-            break
-        es = _strip_qualifiers(e, p.aliases)
-        out_names.append(alias or _auto_name(es))
-    return plan, stmt.explain, out_names
+    return plan, stmt.explain, _stmt_out_names(stmt, p.aliases)

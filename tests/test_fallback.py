@@ -270,3 +270,72 @@ def test_derived_table_missing_alias_is_clear_error(ctx):
 
     with pytest.raises(ParseError, match="requires an alias"):
         ctx.sql("SELECT k FROM (SELECT k FROM fact) WHERE k > 5")
+
+
+def test_union_all(ctx):
+    """UNION ALL concatenates branch results (positional alignment, names
+    from the first branch), with trailing ORDER BY/LIMIT applying to the
+    combined result."""
+    got = ctx.sql(
+        "SELECT mode AS m, sum(v) AS s FROM fact GROUP BY mode "
+        "UNION ALL "
+        "SELECT label, max(v) FROM fact JOIN other ON k = ok GROUP BY label "
+        "ORDER BY s DESC LIMIT 4"
+    )
+    assert list(got.columns) == ["m", "s"]
+    assert len(got) == 4
+    v = list(got["s"].astype(float))
+    assert v == sorted(v, reverse=True)
+    f = _fact_frame(ctx)
+    other = pd.DataFrame(
+        {
+            "ok": np.arange(50, dtype=np.int64),
+            "label": [f"label{i % 7}" for i in range(50)],
+        }
+    )
+    branch1 = f.groupby("mode")["v"].sum()
+    branch2 = (
+        f.merge(other, left_on="k", right_on="ok").groupby("label")["v"].max()
+    )
+    want = sorted(
+        list(branch1.values) + list(branch2.values), reverse=True
+    )[:4]
+    np.testing.assert_allclose(v, want, rtol=1e-6)
+
+
+def test_union_all_arity_mismatch(ctx):
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="column counts"):
+        ctx.sql(
+            "SELECT k, v FROM fact UNION ALL SELECT k FROM fact"
+        )
+
+
+def test_union_all_offset_and_ordinal(ctx):
+    # OFFSET without LIMIT is honored after a union
+    total = ctx.sql(
+        "SELECT k FROM fact UNION ALL SELECT k FROM fact"
+    )
+    skipped = ctx.sql(
+        "SELECT k FROM fact UNION ALL SELECT k FROM fact OFFSET 100"
+    )
+    assert len(skipped) == len(total) - 100
+    # ordinal ORDER BY binds to the first branch's select list
+    got = ctx.sql(
+        "SELECT mode AS m, sum(v) AS s FROM fact GROUP BY mode "
+        "UNION ALL SELECT mode, min(v) FROM fact GROUP BY mode "
+        "ORDER BY 2 DESC LIMIT 3"
+    )
+    v = list(got["s"].astype(float))
+    assert v == sorted(v, reverse=True) and len(got) == 3
+
+
+def test_union_all_branch_order_rejected(ctx):
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="last UNION ALL branch"):
+        ctx.sql(
+            "SELECT k FROM fact ORDER BY k LIMIT 2 "
+            "UNION ALL SELECT k FROM fact"
+        )
