@@ -275,6 +275,121 @@ def _select_list(lp: L.LogicalPlan):
     return None
 
 
+def _run_in_subquery(sub, catalog):
+    """Execute an InSubquery's inner statement: (values, has_null)."""
+    from ..sql.parser import Analyzer
+
+    inner_lp = Analyzer(sub.stmt, dict(sub.aliases or ())).to_logical()
+    inner = execute_fallback(inner_lp, catalog)
+    if inner.shape[1] != 1:
+        raise ValueError("IN subquery must produce exactly one column")
+    col = inner.iloc[:, 0]
+    return tuple(pd.unique(col.dropna())), bool(col.isna().any())
+
+
+def _resolve_subqueries(e, catalog, under_not: bool = False):
+    """Replace InSubquery nodes with concrete InExpr value sets.
+
+    Three-valued semantics when the inner result contains NULL: `x IN S`
+    behaves as membership in S minus NULL (non-members are UNKNOWN ->
+    excluded, same as FALSE); the direct `NOT (x IN S)` form matches
+    NOTHING (every row is FALSE or UNKNOWN) and becomes the row-shaped
+    always-false `x IN ()`.  Other negation nestings over a null-producing
+    subquery are rejected rather than silently mis-evaluated."""
+    import dataclasses as _dc
+
+    from ..plan.expr import BoolOp, Expr, InExpr, InSubquery
+
+    if (
+        isinstance(e, BoolOp)
+        and e.op == "not"
+        and len(e.operands) == 1
+        and isinstance(e.operands[0], InSubquery)
+    ):
+        sub = e.operands[0]
+        vals, has_null = _run_in_subquery(sub, catalog)
+        operand = _resolve_subqueries(sub.operand, catalog, under_not)
+        if has_null:
+            if under_not:
+                # NOT(NOT IN) over NULLs: the always-false rewrite would
+                # invert to always-true — refuse rather than be wrong
+                raise ValueError(
+                    "negation over NOT IN with a NULL-producing subquery "
+                    "is unsupported (three-valued semantics)"
+                )
+            return InExpr(operand, ())  # NOT IN over NULLs matches nothing
+        return BoolOp("not", (InExpr(operand, vals),))
+    if isinstance(e, InSubquery):
+        vals, has_null = _run_in_subquery(e, catalog)
+        if has_null and under_not:
+            raise ValueError(
+                "NOT IN over a subquery producing NULLs is only supported "
+                "as a direct NOT IN (three-valued semantics)"
+            )
+        operand = _resolve_subqueries(e.operand, catalog, under_not)
+        return InExpr(operand, vals)
+    if not isinstance(e, Expr):
+        return e
+    is_not = isinstance(e, BoolOp) and e.op == "not"
+    kw = {}
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expr):
+            kw[f.name] = _resolve_subqueries(
+                v, catalog, under_not or is_not
+            )
+        elif isinstance(v, tuple) and v and isinstance(v[0], Expr):
+            kw[f.name] = tuple(
+                _resolve_subqueries(x, catalog, under_not or is_not)
+                for x in v
+            )
+    return _dc.replace(e, **kw) if kw else e
+
+
+def _resolve_plan_subqueries(lp: L.LogicalPlan, catalog) -> L.LogicalPlan:
+    """Resolve IN-subqueries in every expression the plan holds."""
+    import dataclasses as _dc
+
+    def rx(e):
+        return _resolve_subqueries(e, catalog) if e is not None else None
+
+    if isinstance(lp, L.Filter):
+        return L.Filter(rx(lp.condition), _resolve_plan_subqueries(lp.child, catalog))
+    if isinstance(lp, L.Having):
+        return L.Having(rx(lp.condition), _resolve_plan_subqueries(lp.child, catalog))
+    if isinstance(lp, L.Project):
+        return L.Project(
+            tuple((n, rx(e)) for n, e in lp.exprs),
+            _resolve_plan_subqueries(lp.child, catalog),
+        )
+    if isinstance(lp, L.Aggregate):
+        return _dc.replace(
+            lp,
+            group_exprs=tuple((n, rx(e)) for n, e in lp.group_exprs),
+            agg_exprs=tuple(
+                _dc.replace(ae, arg=rx(ae.arg), filter=rx(ae.filter))
+                for ae in lp.agg_exprs
+            ),
+            post_exprs=tuple((n, rx(e)) for n, e in lp.post_exprs),
+            child=_resolve_plan_subqueries(lp.child, catalog),
+        )
+    if isinstance(lp, (L.Sort, L.Limit, L.SubqueryScan)):
+        return _dc.replace(
+            lp, child=_resolve_plan_subqueries(lp.child, catalog)
+        )
+    if isinstance(lp, L.Union):
+        return L.Union(
+            tuple(_resolve_plan_subqueries(b, catalog) for b in lp.branches)
+        )
+    if isinstance(lp, L.Join):
+        return _dc.replace(
+            lp,
+            left=_resolve_plan_subqueries(lp.left, catalog),
+            right=_resolve_plan_subqueries(lp.right, catalog),
+        )
+    return lp
+
+
 def _project_root(df: pd.DataFrame, lp: L.LogicalPlan) -> pd.DataFrame:
     """Project an interpreted frame to the plan's SELECT list (enclosing
     Sort/Having saw every intermediate column; the consumer does not)."""
@@ -301,6 +416,7 @@ def _project_root(df: pd.DataFrame, lp: L.LogicalPlan) -> pd.DataFrame:
 def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
     """Interpret a logical plan over decoded host frames, projecting the
     result to the plan's SELECT list at the end."""
+    lp = _resolve_plan_subqueries(lp, catalog)
     needed = None if _needs_all_columns(lp) else (_plan_columns(lp) or None)
     df = _exec(lp, catalog, needed)
     return _project_root(df, lp).reset_index(drop=True)

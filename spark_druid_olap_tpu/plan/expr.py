@@ -180,6 +180,26 @@ class InExpr(Expr):
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
+class InSubquery(Expr):
+    """`x IN (SELECT c FROM ...)` — a semi-join.  The device planner
+    rejects it at plan time (RewriteError -> host fallback); the fallback
+    resolves the inner statement to a value set before evaluation, with
+    three-valued NOT IN semantics when the set contains NULLs.  `stmt` is
+    a sql.parser.SelectStmt (typed Any to keep plan/ independent of the
+    SQL layer)."""
+
+    operand: Expr
+    stmt: Any
+    aliases: Any = None  # alias->table mapping captured at parse time
+
+    def columns(self):
+        return self.operand.columns()
+
+    def __str__(self):
+        return f"({self.operand} IN (<subquery>))"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
 class IfExpr(Expr):
     cond: Expr
     then: Expr
@@ -409,6 +429,27 @@ def _compile_comparison(e: "Comparison", dicts, raw_strings: bool = False):
         cmp32 = f32_adjusted_compare(op_name, float(lit_val))
 
         def cmp_fn(cols, of=of, op_name=op_name, lit_val=lit_val, cmp32=cmp32):
+            if raw_strings:
+                # host mode: decoded dimension columns are object dtype
+                # (python ints with None for null); SQL three-valued logic
+                # — null/non-numeric never matches a numeric comparison
+                xo = np.asarray(of(cols))
+                if xo.dtype.kind == "O":
+                    valid = np.array(
+                        [
+                            isinstance(v, (int, float))
+                            and not isinstance(v, bool)
+                            and not (isinstance(v, float) and np.isnan(v))
+                            for v in xo
+                        ],
+                        dtype=bool,
+                    )
+                    res = np.zeros(xo.shape, dtype=bool)
+                    if valid.any():
+                        res[valid] = _CMP[op_name](
+                            xo[valid].astype(np.float64), lit_val
+                        )
+                    return res
             x = jnp.asarray(of(cols))
             if x.dtype == jnp.float32:
                 return cmp32(x)
@@ -583,6 +624,19 @@ def compile_expr(
             vals = vals.astype(np.int64) if (vals == vals.astype(np.int64)).all() else vals
             return lambda cols: jnp.isin(jnp.asarray(cols[name]), vals)
         f = compile_expr(e.operand, dicts, raw_strings=raw_strings)
+        if raw_strings:
+            # host mode: decoded columns may be object dtype (numeric
+            # dictionaries decode to python ints; nulls are None) — plain
+            # numpy membership, no JAX array coercion
+            values = list(e.values)
+
+            def host_in(cols, f=f, values=values):
+                x = np.asarray(f(cols))
+                if x.dtype.kind == "O":
+                    return np.isin(x, np.asarray(values, dtype=object))
+                return np.isin(x, np.asarray(values))
+
+            return host_in
         vals = np.asarray(e.values)
         return lambda cols: jnp.isin(f(cols), vals)
     if isinstance(e, IfExpr):

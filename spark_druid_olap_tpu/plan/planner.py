@@ -99,6 +99,12 @@ class Planner:
     def plan(self, lp: L.LogicalPlan) -> Rewrite:
         if not self.cfg.enable_rewrites:
             raise RewriteError("rewrites disabled by config")
+        if _plan_contains_in_subquery(lp):
+            # semi-joins cannot lower to the row kernel in ANY position
+            # (WHERE, HAVING, SELECT expressions, agg FILTERs); reject at
+            # PLAN time so the host fallback executes the whole query —
+            # a residual would only fail later, mid-execution
+            raise RewriteError("IN (SELECT ...) requires host execution")
         limit: Optional[int] = None
         offset = 0
         sort_keys: List[L.SortKey] = []
@@ -698,6 +704,35 @@ def _estimate_dim_cardinality(d, ds: DataSource) -> int:
         if iv is not None and p:
             return max(1, int((iv[1] - iv[0]) // p) + 2)
     return 4096
+
+
+def _plan_contains_in_subquery(lp: L.LogicalPlan) -> bool:
+    """Any InSubquery in any expression position of the plan tree."""
+    from .transforms import _contains_in_subquery
+
+    def exprs_of(node):
+        if isinstance(node, (L.Filter, L.Having)):
+            yield node.condition
+        elif isinstance(node, L.Project):
+            for _, e in node.exprs:
+                yield e
+        elif isinstance(node, L.Aggregate):
+            for _, e in node.group_exprs:
+                yield e
+            for ae in node.agg_exprs:
+                if ae.arg is not None:
+                    yield ae.arg
+                if ae.filter is not None:
+                    yield ae.filter
+            for _, e in node.post_exprs:
+                yield e
+        elif isinstance(node, L.Sort):
+            for k in node.keys:
+                yield k.expr
+
+    if any(_contains_in_subquery(e) for e in exprs_of(lp)):
+        return True
+    return any(_plan_contains_in_subquery(c) for c in lp.children())
 
 
 def _contains_aggregate(n: L.LogicalPlan) -> bool:

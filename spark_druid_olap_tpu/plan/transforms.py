@@ -129,6 +129,20 @@ def translate_filter(
     return b
 
 
+def _contains_in_subquery(e: E.Expr) -> bool:
+    if isinstance(e, E.InSubquery):
+        return True
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr) and _contains_in_subquery(v):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, E.Expr) and _contains_in_subquery(x) for x in v
+        ):
+            return True
+    return False
+
+
 def _conjuncts(e: E.Expr) -> List[E.Expr]:
     if isinstance(e, E.BoolOp) and e.op == "and":
         out: List[E.Expr] = []

@@ -383,6 +383,18 @@ class Parser:
             return E.BoolOp("not", (e,)) if negated else e
         if self.accept_kw("in"):
             self.expect_op("(")
+            if (
+                self.peek().kind == "KW"
+                and self.peek().value.lower() == "select"
+            ):
+                inner = self.select()
+                self.expect_op(")")
+                if len(inner.items) != 1:
+                    raise ParseError(
+                        "IN subquery must select exactly one column"
+                    )
+                e: E.Expr = E.InSubquery(left, inner, tuple(sorted(self.aliases.items())))
+                return E.BoolOp("not", (e,)) if negated else e
             vals = []
             while True:
                 v = self._primary()
