@@ -298,7 +298,14 @@ def _resolve_subqueries(e, catalog, under_not: bool = False):
     subquery are rejected rather than silently mis-evaluated."""
     import dataclasses as _dc
 
-    from ..plan.expr import BoolOp, Expr, InExpr, InSubquery
+    from ..plan.expr import (
+        BoolOp,
+        Comparison,
+        Expr,
+        InExpr,
+        InSubquery,
+        Literal,
+    )
 
     if (
         isinstance(e, BoolOp)
@@ -318,7 +325,14 @@ def _resolve_subqueries(e, catalog, under_not: bool = False):
                     "is unsupported (three-valued semantics)"
                 )
             return InExpr(operand, ())  # NOT IN over NULLs matches nothing
-        return BoolOp("not", (InExpr(operand, vals),))
+        # a NULL operand is UNKNOWN for NOT IN too — guard it out (the
+        # bare NOT would flip the null rows' False to True)
+        not_null = BoolOp(
+            "not", (Comparison("==", operand, Literal(None)),)
+        )
+        return BoolOp(
+            "and", (BoolOp("not", (InExpr(operand, vals),)), not_null)
+        )
     if isinstance(e, InSubquery):
         vals, has_null = _run_in_subquery(e, catalog)
         if has_null and under_not:
@@ -328,6 +342,31 @@ def _resolve_subqueries(e, catalog, under_not: bool = False):
             )
         operand = _resolve_subqueries(e.operand, catalog, under_not)
         return InExpr(operand, vals)
+    if isinstance(e, E.ScalarSubquery):
+        from ..sql.parser import Analyzer
+
+        inner_lp = Analyzer(e.stmt, dict(e.aliases or ())).to_logical()
+        inner = execute_fallback(inner_lp, catalog)
+        if inner.shape[1] != 1:
+            raise ValueError(
+                "scalar subquery must produce exactly one column"
+            )
+        if len(inner) > 1:
+            raise ValueError(
+                f"scalar subquery produced {len(inner)} rows"
+            )
+        from ..plan.expr import Literal
+
+        if not len(inner):
+            return Literal(None)  # zero rows -> SQL NULL
+        v = inner.iloc[0, 0]
+        if isinstance(v, float) and np.isnan(v):
+            return Literal(None)
+        if isinstance(v, (np.integer,)):
+            v = int(v)
+        elif isinstance(v, (np.floating,)):
+            v = float(v)
+        return Literal(v)
     if not isinstance(e, Expr):
         return e
     is_not = isinstance(e, BoolOp) and e.op == "not"
@@ -373,7 +412,14 @@ def _resolve_plan_subqueries(lp: L.LogicalPlan, catalog) -> L.LogicalPlan:
             post_exprs=tuple((n, rx(e)) for n, e in lp.post_exprs),
             child=_resolve_plan_subqueries(lp.child, catalog),
         )
-    if isinstance(lp, (L.Sort, L.Limit, L.SubqueryScan)):
+    if isinstance(lp, L.Sort):
+        return L.Sort(
+            tuple(
+                _dc.replace(k, expr=rx(k.expr)) for k in lp.keys
+            ),
+            _resolve_plan_subqueries(lp.child, catalog),
+        )
+    if isinstance(lp, (L.Limit, L.SubqueryScan)):
         return _dc.replace(
             lp, child=_resolve_plan_subqueries(lp.child, catalog)
         )

@@ -200,6 +200,22 @@ class InSubquery(Expr):
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
+class ScalarSubquery(Expr):
+    """`(SELECT agg FROM ...)` in expression position — resolved to a
+    Literal by the host fallback (one column; one row or zero rows ->
+    NULL).  `stmt` is a sql.parser.SelectStmt."""
+
+    stmt: Any
+    aliases: Any = None
+
+    def columns(self):
+        return ()
+
+    def __str__(self):
+        return "(<scalar subquery>)"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
 class IfExpr(Expr):
     cond: Expr
     then: Expr
@@ -412,6 +428,24 @@ def _compile_comparison(e: "Comparison", dicts, raw_strings: bool = False):
 
     def _num_lit(v):
         return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    # SQL: an ordering comparison with NULL is UNKNOWN -> matches nothing
+    # (a NULL literal arrives from e.g. a zero-row scalar subquery).
+    # Equality stays: `== Literal(None)` is the IS NULL encoding.
+    if e.op in (">", ">=", "<", "<=") and any(
+        isinstance(s, Literal) and s.value is None for s in (e.left, e.right)
+    ):
+        other = e.right if (
+            isinstance(e.left, Literal) and e.left.value is None
+        ) else e.left
+        of = compile_expr(other, dicts, raw_strings=raw_strings)
+
+        def never(cols, of=of):
+            if raw_strings:
+                return np.zeros(np.shape(np.asarray(of(cols))), dtype=bool)
+            return jnp.zeros(jnp.shape(jnp.asarray(of(cols))), jnp.bool_)
+
+        return never
 
     lit_side = None
     if isinstance(e.right, Literal) and _num_lit(e.right.value):

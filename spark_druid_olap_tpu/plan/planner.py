@@ -99,12 +99,12 @@ class Planner:
     def plan(self, lp: L.LogicalPlan) -> Rewrite:
         if not self.cfg.enable_rewrites:
             raise RewriteError("rewrites disabled by config")
-        if _plan_contains_in_subquery(lp):
+        if _plan_contains_subquery(lp):
             # semi-joins cannot lower to the row kernel in ANY position
             # (WHERE, HAVING, SELECT expressions, agg FILTERs); reject at
             # PLAN time so the host fallback executes the whole query —
             # a residual would only fail later, mid-execution
-            raise RewriteError("IN (SELECT ...) requires host execution")
+            raise RewriteError("subqueries require host fallback execution")
         limit: Optional[int] = None
         offset = 0
         sort_keys: List[L.SortKey] = []
@@ -706,9 +706,9 @@ def _estimate_dim_cardinality(d, ds: DataSource) -> int:
     return 4096
 
 
-def _plan_contains_in_subquery(lp: L.LogicalPlan) -> bool:
-    """Any InSubquery in any expression position of the plan tree."""
-    from .transforms import _contains_in_subquery
+def _plan_contains_subquery(lp: L.LogicalPlan) -> bool:
+    """Any IN/scalar subquery in any expression position of the plan tree."""
+    from .transforms import _contains_subquery
 
     def exprs_of(node):
         if isinstance(node, (L.Filter, L.Having)):
@@ -730,9 +730,9 @@ def _plan_contains_in_subquery(lp: L.LogicalPlan) -> bool:
             for k in node.keys:
                 yield k.expr
 
-    if any(_contains_in_subquery(e) for e in exprs_of(lp)):
+    if any(_contains_subquery(e) for e in exprs_of(lp)):
         return True
-    return any(_plan_contains_in_subquery(c) for c in lp.children())
+    return any(_plan_contains_subquery(c) for c in lp.children())
 
 
 def _contains_aggregate(n: L.LogicalPlan) -> bool:

@@ -129,15 +129,15 @@ def translate_filter(
     return b
 
 
-def _contains_in_subquery(e: E.Expr) -> bool:
-    if isinstance(e, E.InSubquery):
+def _contains_subquery(e: E.Expr) -> bool:
+    if isinstance(e, (E.InSubquery, E.ScalarSubquery)):
         return True
     for f in dataclasses.fields(e):
         v = getattr(e, f.name)
-        if isinstance(v, E.Expr) and _contains_in_subquery(v):
+        if isinstance(v, E.Expr) and _contains_subquery(v):
             return True
         if isinstance(v, tuple) and any(
-            isinstance(x, E.Expr) and _contains_in_subquery(x) for x in v
+            isinstance(x, E.Expr) and _contains_subquery(x) for x in v
         ):
             return True
     return False

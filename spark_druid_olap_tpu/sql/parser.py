@@ -85,6 +85,7 @@ class Subquery:
 
     stmt: "SelectStmt"
     alias: str
+    aliases: tuple = ()  # inner-visible alias->table items (parse time)
 
 
 @dataclasses.dataclass
@@ -277,10 +278,37 @@ class Parser:
             out.append(self.expr())
         return out
 
+    def _parse_subselect(self):
+        """Parse a nested (SELECT ...) with alias isolation: the inner
+        FROM's aliases must not leak into or clobber the outer scope, and
+        qualified references to OUTER tables inside the inner statement
+        (correlation) are rejected rather than silently resolved against
+        the wrong table.  Returns (stmt, inner-visible alias items)."""
+        saved = dict(self.aliases)
+        inner = self.select()
+        after = dict(self.aliases)
+        self.aliases = saved
+        inner_vis = {k: v for k, v in after.items() if saved.get(k) != v}
+        for _, e in list(inner.items) + [
+            (None, x) for x in inner.group_by
+        ] + [(None, x) for x, _ in inner.order_by] + [
+            (None, x)
+            for x in (inner.where, inner.having)
+            if x is not None
+        ]:
+            for c in e.columns():
+                if "." in c:
+                    q = c.split(".", 1)[0]
+                    if q not in inner_vis and q in saved:
+                        raise ParseError(
+                            "correlated subqueries are unsupported"
+                        )
+        return inner, tuple(sorted(inner_vis.items()))
+
     def table_ref(self):
         if self.accept_op("("):
             # derived table: FROM (SELECT ...) [AS] alias
-            inner = self.select()
+            inner, inner_vis = self._parse_subselect()
             self.expect_op(")")
             has_as = self.accept_kw("as")
             if not has_as and self.peek().kind != "IDENT":
@@ -293,7 +321,7 @@ class Parser:
                 "join", "inner", "left"
             ):
                 raise ParseError("JOIN over a derived table unsupported")
-            return Subquery(inner, alias)
+            return Subquery(inner, alias, inner_vis)
         name = self.expect_ident()
         alias = None
         t = self.peek()
@@ -387,13 +415,13 @@ class Parser:
                 self.peek().kind == "KW"
                 and self.peek().value.lower() == "select"
             ):
-                inner = self.select()
+                inner, inner_vis = self._parse_subselect()
                 self.expect_op(")")
                 if len(inner.items) != 1:
                     raise ParseError(
                         "IN subquery must select exactly one column"
                     )
-                e: E.Expr = E.InSubquery(left, inner, tuple(sorted(self.aliases.items())))
+                e: E.Expr = E.InSubquery(left, inner, inner_vis)
                 return E.BoolOp("not", (e,)) if negated else e
             vals = []
             while True:
@@ -516,6 +544,19 @@ class Parser:
                 return E.Col(f"{name}.{col}")
             return E.Col(name)
         if self.accept_op("("):
+            if (
+                self.peek().kind == "KW"
+                and self.peek().value.lower() == "select"
+            ):
+                # scalar subquery: (SELECT max(v) FROM t ...) — resolved to
+                # a literal by the host fallback executor
+                inner, inner_vis = self._parse_subselect()
+                self.expect_op(")")
+                if len(inner.items) != 1:
+                    raise ParseError(
+                        "scalar subquery must select exactly one column"
+                    )
+                return E.ScalarSubquery(inner, inner_vis)
             e = self.expr()
             self.expect_op(")")
             return e
@@ -889,7 +930,7 @@ class Analyzer:
             # reference the subquery's SELECT-list names (the planner's
             # Project-collapsing walk would otherwise resolve renamed-away
             # names against the base table — silent wrong data)
-            inner = Analyzer(t.stmt, dict(self.aliases))
+            inner = Analyzer(t.stmt, dict(t.aliases))
             names = _stmt_out_names(t.stmt, self.aliases)  # [] = SELECT *
             return L.SubqueryScan(
                 inner.to_logical(),

@@ -416,3 +416,71 @@ def test_in_subquery_edge_cases():
             "SELECT count(*) AS n FROM f3 "
             "WHERE NOT (k NOT IN (SELECT j FROM nn))"
         )
+
+
+def test_scalar_subquery(ctx):
+    """(SELECT agg FROM ...) in a predicate resolves to a literal."""
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE v > (SELECT avg(v) FROM fact)"
+    )
+    f = _fact_frame(ctx)
+    assert int(got["n"].iloc[0]) == int((f.v > f.v.mean()).sum())
+    # in SELECT position too
+    got2 = ctx.sql(
+        "SELECT max(v) - (SELECT avg(v) FROM fact) AS spread FROM fact"
+    )
+    np.testing.assert_allclose(
+        float(got2["spread"].iloc[0]), f.v.max() - f.v.mean(), rtol=1e-6
+    )
+    # multi-row scalar subquery is a clear error
+    with pytest.raises(Exception, match="rows"):
+        ctx.sql(
+            "SELECT count(*) AS n FROM fact "
+            "WHERE v > (SELECT v FROM fact)"
+        )
+
+
+def test_not_in_subquery_null_operand_excluded():
+    """A NULL operand row is UNKNOWN for NOT IN — excluded, not included."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "fo",
+        {"k": np.array([1, 2, None], dtype=object)},
+        dimensions=["k"],
+    )
+    c.register_table(
+        "so", {"j": np.array([1], dtype=np.int64)}, dimensions=["j"]
+    )
+    got = c.sql(
+        "SELECT count(*) AS n FROM fo WHERE k NOT IN (SELECT j FROM so)"
+    )
+    assert int(got["n"].iloc[0]) == 1  # only k=2; NULL row excluded
+
+
+def test_scalar_subquery_zero_rows_matches_nothing(ctx):
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE v > (SELECT max(v) FROM fact WHERE v > 1e9)"
+    )
+    assert int(got["n"].iloc[0]) == 0
+
+
+def test_correlated_subquery_rejected(ctx):
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="correlated"):
+        ctx.sql(
+            "SELECT count(*) AS n FROM fact f "
+            "WHERE k IN (SELECT ok FROM other WHERE f.v > 10)"
+        )
+
+
+def test_inner_alias_collision_does_not_leak(ctx):
+    """An inner FROM alias colliding with an outer alias must not corrupt
+    outer resolution."""
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact f JOIN other o ON k = ok "
+        "WHERE f.k IN (SELECT ok FROM other f)"
+    )
+    assert int(got["n"].iloc[0]) > 0
