@@ -40,6 +40,7 @@ from ..catalog.segment import ROW_PAD, DataSource
 from ..models.dimensions import DimensionSpec
 from ..exec.engine import (
     GroupByLowering,
+    _prune_by_stats,
     finalize_groupby,
     finalize_timeseries,
     finalize_topn,
@@ -98,8 +99,8 @@ class DistributedEngine:
     # -- host-side row-shard assembly ---------------------------------------
 
     def _global_columns(
-        self, ds: DataSource, names, intervals
-    ) -> Tuple[Dict[str, jax.Array], int]:
+        self, ds: DataSource, names, intervals, filt=None
+    ):
         nd = self.mesh.shape[DATA_AXIS]
         segs = list(ds.segments)
         if intervals:
@@ -110,6 +111,12 @@ class DistributedEngine:
                 or any(a <= s.interval[1] and s.interval[0] < b
                        for a, b in intervals)
             ]
+        if filt is not None and segs:
+            # zone-map pruning, same conservative rules as the local
+            # engine.  NOTE: each distinct pruned set keys its own shard
+            # layout and SPMD compile (the precedent interval pruning set);
+            # the byte-budget LRU bounds residency if filters churn
+            segs = _prune_by_stats(segs, filt, ds)
         total = sum(s.num_rows_padded for s in segs)
         chunk = nd * ROW_PAD
         padded = -(-max(total, 1) // chunk) * chunk
@@ -151,7 +158,7 @@ class DistributedEngine:
         cols["__valid"] = valid
         if ds.time_column and ds.time_column in cols:
             cols["__time"] = cols[ds.time_column]
-        return cols, padded
+        return cols, padded, segs
 
     def clear_cache(self):
         self._shard_cache.clear()
@@ -312,7 +319,12 @@ class DistributedEngine:
         t0 = _time.perf_counter()
         known = len(self._shard_cache)
         before_bytes = self._shard_cache.bytes_used
-        cols, padded = self._global_columns(ds, lowering.columns, q.intervals)
+        cols, padded, scope = self._global_columns(
+            ds, lowering.columns, q.intervals, q.filter
+        )
+        # post-prune counts, matching the local engine's metrics semantics
+        m.rows_scanned = sum(sg.num_rows for sg in scope)
+        m.segments = len(scope)
         if len(self._shard_cache) > known:  # new shards were placed
             m.h2d_ms = (_time.perf_counter() - t0) * 1e3
             m.h2d_bytes = max(
