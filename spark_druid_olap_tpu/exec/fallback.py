@@ -99,6 +99,15 @@ def _plan_columns(lp: L.LogicalPlan) -> set:
     return cols
 
 
+def _apply_mask(df: pd.DataFrame, mask) -> pd.DataFrame:
+    """Row-select with scalar-safety: a constant predicate (e.g. resolved
+    EXISTS) keeps or drops everything."""
+    m = np.asarray(mask)
+    if m.ndim == 0:
+        return df if bool(m) else df.iloc[0:0]
+    return df[m.astype(bool)]
+
+
 def _eval(e: Expr, df: pd.DataFrame) -> np.ndarray:
     fn = compile_expr(e, raw_strings=True)
     cols = {c: np.asarray(df[c]) for c in df.columns}
@@ -342,6 +351,13 @@ def _resolve_subqueries(e, catalog, under_not: bool = False):
             )
         operand = _resolve_subqueries(e.operand, catalog, under_not)
         return InExpr(operand, vals)
+    if isinstance(e, E.ExistsSubquery):
+        from ..sql.parser import Analyzer
+
+        inner_lp = Analyzer(e.stmt, dict(e.aliases or ())).to_logical()
+        inner = execute_fallback(inner_lp, catalog)
+        # constant truth value; Filter/Having handle scalar masks
+        return Literal(bool(len(inner)))
     if isinstance(e, E.ScalarSubquery):
         from ..sql.parser import Analyzer
 
@@ -481,7 +497,7 @@ def _exec(
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
-        return df[np.asarray(_eval(lp.condition, df), dtype=bool)]
+        return _apply_mask(df, _eval(lp.condition, df))
     if isinstance(lp, L.Project):
         df = _exec(lp.child, catalog, _needed)
         return pd.DataFrame(
@@ -552,7 +568,7 @@ def _exec(
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
-        return df[np.asarray(_eval(_refs_to_cols(lp.condition), df), bool)]
+        return _apply_mask(df, _eval(_refs_to_cols(lp.condition), df))
     if isinstance(lp, L.Sort):
         df = _exec(lp.child, catalog, _needed)
         if not len(df):

@@ -200,6 +200,21 @@ class InSubquery(Expr):
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
+class ExistsSubquery(Expr):
+    """Uncorrelated `EXISTS (SELECT ...)` — resolved by the host fallback
+    to a constant truth value (inner row count > 0)."""
+
+    stmt: Any
+    aliases: Any = None
+
+    def columns(self):
+        return ()
+
+    def __str__(self):
+        return "EXISTS(<subquery>)"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
 class ScalarSubquery(Expr):
     """`(SELECT agg FROM ...)` in expression position — resolved to a
     Literal by the host fallback (one column; one row or zero rows ->

@@ -130,7 +130,7 @@ def translate_filter(
 
 
 def _contains_subquery(e: E.Expr) -> bool:
-    if isinstance(e, (E.InSubquery, E.ScalarSubquery)):
+    if isinstance(e, (E.InSubquery, E.ScalarSubquery, E.ExistsSubquery)):
         return True
     for f in dataclasses.fields(e):
         v = getattr(e, f.name)

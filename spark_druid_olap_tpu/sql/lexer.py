@@ -26,7 +26,7 @@ KEYWORDS = {
     "null", "asc", "desc", "distinct", "join", "inner", "left", "on",
     "cube", "rollup", "grouping", "sets", "date", "timestamp", "interval",
     "case", "when", "then", "else", "end", "cast", "extract", "filter",
-    "explain", "rewrite", "union", "all", "true", "false",
+    "explain", "rewrite", "union", "all", "true", "false", "exists",
 }
 
 _TWO_CHAR = {"<=", ">=", "<>", "!=", "=="}

@@ -385,6 +385,13 @@ class Parser:
     def _not(self) -> E.Expr:
         if self.accept_kw("not"):
             return E.BoolOp("not", (self._not(),))
+        if self.accept_kw("exists"):
+            # uncorrelated EXISTS (SELECT ...): the fallback resolves it to
+            # a constant row-count check (correlation rejected at parse)
+            self.expect_op("(")
+            inner, inner_vis = self._parse_subselect()
+            self.expect_op(")")
+            return E.ExistsSubquery(inner, inner_vis)
         return self._cmp()
 
     def _cmp(self) -> E.Expr:

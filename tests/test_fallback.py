@@ -484,3 +484,28 @@ def test_inner_alias_collision_does_not_leak(ctx):
         "WHERE f.k IN (SELECT ok FROM other f)"
     )
     assert int(got["n"].iloc[0]) > 0
+
+
+def test_exists_subquery(ctx):
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE EXISTS (SELECT ok FROM other WHERE label = 'label0')"
+    )
+    f = _fact_frame(ctx)
+    assert int(got["n"].iloc[0]) == len(f)
+    got2 = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE NOT EXISTS (SELECT ok FROM other WHERE label = 'nope')"
+    )
+    assert int(got2["n"].iloc[0]) == len(f)
+    got3 = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE EXISTS (SELECT ok FROM other WHERE label = 'nope')"
+    )
+    assert int(got3["n"].iloc[0]) == 0
+    # EXISTS composes with row predicates
+    got4 = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE mode = 'A' AND EXISTS (SELECT ok FROM other)"
+    )
+    assert int(got4["n"].iloc[0]) == int((f["mode"] == "A").sum())
