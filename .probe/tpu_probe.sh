@@ -1,0 +1,14 @@
+#!/bin/bash
+# Persistent TPU probe: retry until the tunnel answers, then exit 0.
+LOG=/root/repo/.probe/probe.log
+for i in $(seq 1 500); do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 90 python -c "import jax; d=jax.devices()[0]; print(d.platform, d)" 2>&1 | tail -1)
+  if echo "$out" | grep -qi "tpu"; then
+    echo "$ts attempt=$i SUCCESS: $out" >> "$LOG"
+    exit 0
+  fi
+  echo "$ts attempt=$i fail: ${out:0:200}" >> "$LOG"
+  sleep 240
+done
+exit 1

@@ -501,7 +501,7 @@ def _child(env, timeout):
 
 def _probe_backend(timeout):
     """Ask a child interpreter (inheriting this env, TPU hook and all) what
-    backend JAX lands on.  Returns the platform string or None."""
+    backend JAX lands on.  Returns (platform_or_None, error_string)."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
@@ -511,11 +511,40 @@ def _probe_backend(timeout):
             timeout=timeout,
         )
     except subprocess.TimeoutExpired:
-        return None
+        return None, "probe timeout after %ss" % timeout
     if proc.returncode != 0:
-        return None
+        return None, "probe rc=%d stderr: %s" % (
+            proc.returncode,
+            proc.stderr[-300:],
+        )
     out = proc.stdout.strip().splitlines()
-    return out[-1] if out else None
+    if not out:
+        return None, "probe produced no output"
+    return out[-1], ""
+
+
+def _probe_with_retry(probe_s, window_s, interval_s):
+    """Retry the backend probe across a window: the accelerator tunnel
+    flakes, and a single end-of-round probe lost rounds 1 AND 2 to it.
+    Every attempt is logged (timestamp + error) so the bench JSON shows
+    exactly what was tried.  Returns (platform_or_None, attempts)."""
+    import datetime
+
+    attempts = []
+    deadline = time.monotonic() + window_s
+    while True:
+        ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        )
+        platform, err = _probe_backend(probe_s)
+        attempts.append(
+            {"t": ts, "platform": platform, "error": err[:300]}
+        )
+        if platform is not None and platform != "cpu":
+            return platform, attempts
+        if time.monotonic() + interval_s >= deadline:
+            return platform, attempts
+        time.sleep(interval_s)
 
 
 def main():
@@ -527,8 +556,13 @@ def main():
     mode, _, _ = _parse_args(sys.argv[1:])
     probe_s = int(os.environ.get("SD_BENCH_PROBE_TIMEOUT_S", "120"))
     run_s = int(os.environ.get("SD_BENCH_TIMEOUT_S", "1500"))
+    # total window spent retrying a down tunnel before settling for CPU
+    window_s = int(os.environ.get("SD_BENCH_PROBE_WINDOW_S", "900"))
+    interval_s = int(os.environ.get("SD_BENCH_PROBE_INTERVAL_S", "60"))
 
-    platform = _probe_backend(probe_s)
+    platform, probe_attempts = _probe_with_retry(
+        probe_s, window_s, interval_s
+    )
     result, err = None, None
     degraded = False
     if platform is not None and platform != "cpu":
@@ -545,6 +579,13 @@ def main():
     if result is not None:
         result["degraded"] = degraded
         result["device"] = result.get("detail", {}).get("device", platform or "cpu")
+        if degraded:
+            # a degraded number must not read like a healthy one: the
+            # metric name itself carries the backend it was measured on
+            dev = str(result["device"]).lower()
+            dev = "cpu" if "cpu" in dev else dev.replace(" ", "_")
+            result["metric"] = "%s_%s_degraded" % (result["metric"], dev)
+        result.setdefault("detail", {})["probe_attempts"] = probe_attempts
         print(json.dumps(result))
     else:
         # Last resort: still one parseable JSON line, never a bare traceback.
@@ -557,7 +598,10 @@ def main():
                     "vs_baseline": 0.0,
                     "degraded": True,
                     "device": platform or "unavailable",
-                    "detail": {"error": (err or "unknown")[:2000]},
+                    "detail": {
+                        "error": (err or "unknown")[:2000],
+                        "probe_attempts": probe_attempts,
+                    },
                 }
             )
         )

@@ -144,6 +144,11 @@ class TPUOlapContext:
                         [("" if v is None else str(v)) for v in a]
                     )
                     return [vals, nulls]
+                if c in (dicts or {}) and a.dtype.kind in "iu":
+                    # pre-encoded dimension codes: null codes are negative
+                    # and would cluster FIRST on a raw sort — add the same
+                    # nulls-last flag key the object path uses
+                    return [a, a < 0]
                 return [a]
 
             # stable lexsort (last key primary); encoded dims sort by code,
@@ -230,17 +235,15 @@ class TPUOlapContext:
         """QueryMetrics of the most recent execution (exec/metrics.py) —
         rows/sec, H2D bytes streamed, compile/device/collective/finalize
         phase times — from whichever engine ran it."""
+        # _last_engine_metrics is stamped on every completed execution —
+        # engine runs (execute_rewrite) AND host-fallback runs — so it is
+        # the authoritative "most recent"; the engine objects are only a
+        # fallback for direct engine.execute() use outside the context
+        if self._last_engine_metrics is not None:
+            return self._last_engine_metrics
         dm = self._dist_engine.last_metrics if self._dist_engine else None
         em = self.engine.last_metrics
-        if dm is None:
-            return em
-        if em is None:
-            return dm
-        # whichever ran last (engines stamp at completion; compare by
-        # object recency via a monotonic counter would be overkill — the
-        # distributed engine only runs when the planner chose it, so prefer
-        # the one matching the last rewrite if known; default local)
-        return self._last_engine_metrics or em
+        return em if dm is None else (dm if em is None else em)
 
     def explain_analyze(self, sql_text: str):
         """EXPLAIN ANALYZE analog: run the query, return (DataFrame,
@@ -248,7 +251,15 @@ class TPUOlapContext:
         the metrics must describe THIS execution, not a cache lookup."""
         lp, _, _ = parse_sql(sql_text)
         planner = self._planner()
-        rw = planner.plan(lp)
+        try:
+            rw = planner.plan(lp)
+        except RewriteError as err:
+            df = self._run_fallback(lp, err)
+            text = f"== Host Fallback ==\nrewrite failed: {err}"
+            m = self.last_metrics
+            if m is not None:
+                text += "\n\n== Execution Metrics ==\n" + m.describe()
+            return df, text
         df = self.execute_rewrite(rw, use_result_cache=False)
         text = planner.explain(lp)
         m = self.last_metrics
@@ -287,22 +298,43 @@ class TPUOlapContext:
         try:
             rw = planner.plan(lp)
         except RewriteError as err:
-            from .plan.transforms import RewritePolicyError
-
-            if isinstance(err, RewritePolicyError):
-                raise  # explicit policy/validation rejection — no fallback
-            if not self.config.fallback_execution:
-                raise
-            # the reference's vanilla-Spark fallback: a failed rewrite runs
-            # the logical plan host-side instead of erroring
-            from .exec.fallback import execute_fallback
-
-            log.warning(
-                "rewrite failed (%s); executing on the host fallback", err
-            )
-            return execute_fallback(lp, self.catalog)
+            return self._run_fallback(lp, err)
         self._plan_cache[key] = rw
         return self.execute_rewrite(rw)
+
+    def _run_fallback(self, lp, err):
+        """The reference's vanilla-Spark fallback: a failed rewrite runs
+        the logical plan host-side instead of erroring — observably
+        (QueryMetrics.executor = "fallback") and size-guarded
+        (SessionConfig.fallback_max_rows).  Policy rejections and a
+        disabled fallback re-raise the original RewriteError — the gate
+        lives HERE so every caller (sql, explain_analyze) agrees."""
+        import time as _time
+
+        from .exec.fallback import execute_fallback, plan_input_rows
+        from .exec.metrics import QueryMetrics
+        from .plan.transforms import RewritePolicyError
+
+        if isinstance(err, RewritePolicyError):
+            raise err  # explicit policy/validation rejection — no fallback
+        if not self.config.fallback_execution:
+            raise err
+
+        log.warning(
+            "rewrite failed (%s); executing on the host fallback", err
+        )
+        t0 = _time.perf_counter()
+        df = execute_fallback(
+            lp, self.catalog, max_rows=self.config.fallback_max_rows
+        )
+        self._last_engine_metrics = QueryMetrics(
+            query_type="fallback",
+            strategy="host-pandas",
+            executor="fallback",
+            rows_scanned=plan_input_rows(lp, self.catalog),
+            total_ms=(_time.perf_counter() - t0) * 1e3,
+        )
+        return df
 
     def execute_rewrite(self, rw: Rewrite, use_result_cache: bool = True):
         import pandas as pd
@@ -330,6 +362,16 @@ class TPUOlapContext:
             )
             hit = self._result_cache.get(rkey)
             if hit is not None:
+                # restamp: last_metrics must describe THIS query, not
+                # whatever ran before (a prior fallback would otherwise
+                # leave executor="fallback" pinned on a cached device hit)
+                from .exec.metrics import QueryMetrics
+
+                self._last_engine_metrics = QueryMetrics(
+                    query_type=type(rw.query).__name__,
+                    strategy="result-cache",
+                    executor="device",
+                )
                 return hit.copy()
 
         engine = self._engine_for(rw)

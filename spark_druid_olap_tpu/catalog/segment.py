@@ -401,3 +401,84 @@ def build_datasource(
         segments=tuple(segments),
         time_column=time_col,
     )
+
+
+def build_datasource_streamed(
+    name: str,
+    chunks,
+    dimension_cols: Sequence[str],
+    metric_cols: Sequence[str],
+    time_col: Optional[str] = None,
+    rows_per_segment: int = 1 << 22,
+    dicts: Optional[Mapping[str, DimensionDict]] = None,
+) -> DataSource:
+    """Build a DataSource from an ITERATOR of column-mapping chunks without
+    ever materializing the whole table host-side: peak host memory is one
+    chunk (plus a sub-segment remainder buffer) on top of the encoded
+    segments.  This is the large-scale-factor ingest path (BASELINE.md ★
+    SSB SF100 would need ~600M denormalized rows — a single flat host frame
+    does not survive that; a stream of encoded segments does).
+
+    Every dimension column must either arrive pre-encoded (integer codes)
+    or have a caller-supplied dictionary in `dicts`: the code space must be
+    GLOBAL across chunks, and a per-chunk dictionary build would produce
+    inconsistent codes."""
+    dicts = dict(dicts) if dicts else {}
+    for d in dimension_cols:
+        if d not in dicts:
+            raise ValueError(
+                f"streamed ingest needs a global dictionary for dimension "
+                f"{d!r}: per-chunk dictionaries would not share a code "
+                "space (pass dicts= or pre-encode the column)"
+            )
+    segments: List[Segment] = []
+    metas = None
+    buf: Optional[Dict[str, np.ndarray]] = None
+
+    def emit(cols: Dict[str, np.ndarray], last: bool) -> None:
+        nonlocal buf, metas
+        if buf is not None:
+            cols = {
+                k: np.concatenate([buf[k], np.asarray(v)])
+                for k, v in cols.items()
+            }
+            buf = None
+        n = len(next(iter(cols.values())))
+        cut = n if last else (n // rows_per_segment) * rows_per_segment
+        if cut < n:
+            buf = {k: v[cut:] for k, v in cols.items()}
+            cols = {k: v[:cut] for k, v in cols.items()}
+        if cut == 0:
+            return
+        part = build_datasource(
+            name,
+            cols,
+            dimension_cols,
+            metric_cols,
+            time_col,
+            rows_per_segment,
+            dicts,
+        )
+        if metas is None:
+            metas = part.columns
+        for s in part.segments:
+            segments.append(
+                dataclasses.replace(
+                    s, segment_id=f"{name}_{len(segments):06d}"
+                )
+            )
+
+    for chunk in chunks:
+        emit(dict(chunk), last=False)
+    if buf is not None:
+        tail, buf = buf, None
+        emit(tail, last=True)
+    if metas is None:
+        raise ValueError("streamed ingest produced no rows")
+    return DataSource(
+        name=name,
+        columns=metas,
+        dicts=dicts,
+        segments=tuple(segments),
+        time_column=time_col,
+    )

@@ -42,6 +42,12 @@ class SessionConfig:
     # Spark fallback (SURVEY.md §3.2).  False surfaces RewriteError
     # (useful for asserting pushdown coverage).
     fallback_execution: bool = True
+    # ceiling on the SUMMED base-table rows a host-fallback query may touch:
+    # the fallback is single-threaded pandas with full materialization, and
+    # silently grinding through an arbitrarily large input is worse than a
+    # clear error telling the user they left the accelerated path.
+    # 0 disables the guard.
+    fallback_max_rows: int = 50_000_000
 
     # cost model (reference: DruidQueryCostModel constants via SQLConf).
     # Units are MICROSECONDS so the constants are physically measurable:

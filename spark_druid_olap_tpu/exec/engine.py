@@ -63,13 +63,18 @@ from ..utils.log import get_logger
 log = get_logger("exec.engine")
 
 
-def _prune_by_stats(segs, filt, ds: DataSource):
+def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
     """Zone-map pruning on a CONSERVATIVE filter subset: top-level AND
     conjuncts that are Selector/In over dictionary columns (matched in code
     space — dictionaries are datasource-global, so codes compare across
     segments) or numeric Bounds over metric columns.  Everything else
     (OR, NOT, expressions, string bounds) is left to the row kernel —
-    pruning may only ever REMOVE provably-empty segments."""
+    pruning may only ever REMOVE provably-empty segments.
+
+    `vcol_names`: virtual-column names defined by the query.  A filter on
+    a virtual column that SHADOWS a physical column evaluates against the
+    virtual values at execution, so pruning it against the physical
+    column's stats would silently drop live segments — skip those."""
     from ..models import filters as F
 
     conjuncts = (
@@ -77,6 +82,8 @@ def _prune_by_stats(segs, filt, ds: DataSource):
     )
 
     def excluded(seg, c) -> bool:
+        if getattr(c, "dimension", None) in vcol_names:
+            return False
         st = seg.stats or {}
         if isinstance(c, F.Selector):
             if c.value is None or c.dimension not in ds.dicts:
@@ -328,7 +335,10 @@ class Engine:
             segs = out
         filt = getattr(q, "filter", None)
         if filt is not None and segs:
-            segs = _prune_by_stats(segs, filt, ds)
+            vcols = frozenset(
+                v.name for v in getattr(q, "virtual_columns", ()) or ()
+            )
+            segs = _prune_by_stats(segs, filt, ds, vcols)
         return segs
 
     def _partials_for_query(

@@ -114,12 +114,112 @@ def _eval(e: Expr, df: pd.DataFrame) -> np.ndarray:
     return np.asarray(fn(cols))
 
 
+class _SubqNull(E.Literal):
+    """A NULL that arrived as a VALUE (empty or NULL-valued scalar
+    subquery), as opposed to the parser's `== Literal(None)` IS-NULL
+    encoding (sql/parser.py): comparing anything against it is UNKNOWN,
+    not an IS NULL test."""
+
+
+def _is_null_lit(s) -> bool:
+    return isinstance(s, E.Literal) and (
+        s.value is None
+        or (isinstance(s.value, float) and np.isnan(s.value))
+    )
+
+
+def _null_rows(e: Expr, df: pd.DataFrame) -> np.ndarray:
+    """Per-row SQL-NULL mask of a VALUE expression over a decoded frame
+    (decoded dims hold None, metrics hold NaN — pd.isna covers both)."""
+    n = len(df)
+    if isinstance(e, E.Literal):
+        return np.full(n, _is_null_lit(e), dtype=bool)
+    v = np.asarray(_eval(e, df))
+    if v.ndim == 0:
+        return np.full(n, bool(pd.isna(v[()])), dtype=bool)
+    return np.asarray(pd.isna(v))
+
+
+def _coerce_bool(v, n: int) -> np.ndarray:
+    v = np.asarray(v)
+    if v.ndim == 0:
+        return np.full(n, bool(v), dtype=bool)
+    return v.astype(bool)
+
+
+def _eval3(e: Expr, df: pd.DataFrame):
+    """Kleene three-valued evaluation of a boolean expression: returns
+    (true_mask, unknown_mask).  A filter keeps only TRUE rows; the
+    two-valued NULL->False coalescing `_eval` does is indistinguishable
+    from that in positive positions but wrong under negation (SQL:
+    NOT UNKNOWN = UNKNOWN, while NOT False = True) — the round-2 advisor
+    demonstrated wrong answers on exactly those shapes."""
+    n = len(df)
+    F = np.zeros(n, dtype=bool)
+
+    if isinstance(e, E.BoolOp):
+        parts = [_eval3(x, df) for x in e.operands]
+        if e.op == "not":
+            t, u = parts[0]
+            return ~t & ~u, u
+        ts = [p[0] for p in parts]
+        fs = [~p[0] & ~p[1] for p in parts]
+        if e.op == "and":
+            t = np.logical_and.reduce(ts)
+            f = np.logical_or.reduce(fs)
+        else:
+            t = np.logical_or.reduce(ts)
+            f = np.logical_and.reduce(fs)
+        return t, ~t & ~f
+    if isinstance(e, E.Comparison):
+        lnull, rnull = _is_null_lit(e.left), _is_null_lit(e.right)
+        if lnull or rnull:
+            value_null = isinstance(e.left, _SubqNull) or isinstance(
+                e.right, _SubqNull
+            )
+            if e.op in ("==", "!=") and not value_null:
+                # the parser's IS [NOT] NULL encoding — two-valued
+                other = e.right if lnull else e.left
+                isn = _null_rows(other, df)
+                return (isn if e.op == "==" else ~isn), F
+            # a genuine NULL comparison value: UNKNOWN for every row
+            # (even rows whose operand is itself NULL)
+            return F, ~F
+        u = _null_rows(e.left, df) | _null_rows(e.right, df)
+        return _coerce_bool(_eval(e, df), n) & ~u, u
+    if isinstance(e, E.InExpr):
+        if not e.values:
+            return F, F  # x IN () is FALSE for every x, even NULL x
+        u = _null_rows(e.operand, df)
+        return _coerce_bool(_eval(e, df), n) & ~u, u
+    if isinstance(e, E.LikeExpr):
+        # covers NOT LIKE too: a NULL operand is UNKNOWN either way
+        u = _null_rows(e.operand, df)
+        return _coerce_bool(_eval(e, df), n) & ~u, u
+    if isinstance(e, E.Literal):
+        if _is_null_lit(e):
+            return F, ~F
+        return np.full(n, bool(e.value), dtype=bool), F
+    # generic boolean-valued expression (CASE, cast, ...): a NULL result
+    # is UNKNOWN, everything else coerces
+    v = np.asarray(_eval(e, df))
+    if v.ndim == 0:
+        return np.full(n, bool(v), dtype=bool), F
+    u = np.asarray(pd.isna(v))
+    return np.where(u, False, v).astype(bool), u
+
+
+def _filter_mask(cond: Expr, df: pd.DataFrame) -> np.ndarray:
+    t, _ = _eval3(cond, df)
+    return t
+
+
 def _agg_one(ae: L.AggExpr, df: pd.DataFrame):
     """One aggregate over (a filtered view of) one group's rows."""
     fn = ae.fn.lower()
     if ae.filter is not None:
         pre_n = len(df)
-        df = df[np.asarray(_eval(ae.filter, df), dtype=bool)]
+        df = df[_filter_mask(ae.filter, df)]
         if pre_n and not len(df):
             # Druid's filtered aggregator over a NON-empty group whose
             # filter matches nothing: additive aggregates are 0, AVG's
@@ -296,61 +396,41 @@ def _run_in_subquery(sub, catalog):
     return tuple(pd.unique(col.dropna())), bool(col.isna().any())
 
 
-def _resolve_subqueries(e, catalog, under_not: bool = False):
-    """Replace InSubquery nodes with concrete InExpr value sets.
+def _resolve_subqueries(e, catalog, bool_ctx: bool = False):
+    """Replace subquery nodes with concrete values.
 
-    Three-valued semantics when the inner result contains NULL: `x IN S`
-    behaves as membership in S minus NULL (non-members are UNKNOWN ->
-    excluded, same as FALSE); the direct `NOT (x IN S)` form matches
-    NOTHING (every row is FALSE or UNKNOWN) and becomes the row-shaped
-    always-false `x IN ()`.  Other negation nestings over a null-producing
-    subquery are rejected rather than silently mis-evaluated."""
+    Three-valued semantics are preserved STRUCTURALLY and left to the
+    Kleene evaluator (`_eval3`): an IN-subquery whose result set contained
+    NULL becomes `(x IN S) OR NULL` — TRUE for members, UNKNOWN for
+    everything else, which is exactly SQL's `x IN (S + {NULL})` in every
+    context including arbitrary negation nesting (the round-2 rejection
+    special-cases are gone).  NULL-valued scalar subqueries become
+    `_SubqNull` so comparisons against them stay UNKNOWN instead of
+    colliding with the parser's `== Literal(None)` IS-NULL encoding.
+
+    `bool_ctx` is True only along the BOOLEAN SKELETON of a Filter/Having
+    condition (BoolOp chains down to boolean leaves) — the positions the
+    Kleene evaluator owns.  In VALUE positions (SELECT list, aggregate
+    arguments, comparison operands) the `OR NULL` form would reach the
+    two-valued compiler and crash, so those keep the plain InExpr
+    approximation (UNKNOWN coalesces to FALSE) the round-2 resolver had."""
     import dataclasses as _dc
 
     from ..plan.expr import (
         BoolOp,
-        Comparison,
         Expr,
         InExpr,
         InSubquery,
         Literal,
     )
 
-    if (
-        isinstance(e, BoolOp)
-        and e.op == "not"
-        and len(e.operands) == 1
-        and isinstance(e.operands[0], InSubquery)
-    ):
-        sub = e.operands[0]
-        vals, has_null = _run_in_subquery(sub, catalog)
-        operand = _resolve_subqueries(sub.operand, catalog, under_not)
-        if has_null:
-            if under_not:
-                # NOT(NOT IN) over NULLs: the always-false rewrite would
-                # invert to always-true — refuse rather than be wrong
-                raise ValueError(
-                    "negation over NOT IN with a NULL-producing subquery "
-                    "is unsupported (three-valued semantics)"
-                )
-            return InExpr(operand, ())  # NOT IN over NULLs matches nothing
-        # a NULL operand is UNKNOWN for NOT IN too — guard it out (the
-        # bare NOT would flip the null rows' False to True)
-        not_null = BoolOp(
-            "not", (Comparison("==", operand, Literal(None)),)
-        )
-        return BoolOp(
-            "and", (BoolOp("not", (InExpr(operand, vals),)), not_null)
-        )
     if isinstance(e, InSubquery):
         vals, has_null = _run_in_subquery(e, catalog)
-        if has_null and under_not:
-            raise ValueError(
-                "NOT IN over a subquery producing NULLs is only supported "
-                "as a direct NOT IN (three-valued semantics)"
-            )
-        operand = _resolve_subqueries(e.operand, catalog, under_not)
-        return InExpr(operand, vals)
+        operand = _resolve_subqueries(e.operand, catalog)
+        base = InExpr(operand, vals)
+        if has_null and bool_ctx:
+            return BoolOp("or", (base, _SubqNull(None)))
+        return base
     if isinstance(e, E.ExistsSubquery):
         from ..sql.parser import Analyzer
 
@@ -371,13 +451,11 @@ def _resolve_subqueries(e, catalog, under_not: bool = False):
             raise ValueError(
                 f"scalar subquery produced {len(inner)} rows"
             )
-        from ..plan.expr import Literal
-
         if not len(inner):
-            return Literal(None)  # zero rows -> SQL NULL
+            return _SubqNull(None)  # zero rows -> SQL NULL value
         v = inner.iloc[0, 0]
-        if isinstance(v, float) and np.isnan(v):
-            return Literal(None)
+        if pd.isna(v):
+            return _SubqNull(None)
         if isinstance(v, (np.integer,)):
             v = int(v)
         elif isinstance(v, (np.floating,)):
@@ -385,18 +463,17 @@ def _resolve_subqueries(e, catalog, under_not: bool = False):
         return Literal(v)
     if not isinstance(e, Expr):
         return e
-    is_not = isinstance(e, BoolOp) and e.op == "not"
+    # bool_ctx survives only through BoolOp (the skeleton _eval3 walks);
+    # any other node's operands are value positions
+    child_ctx = bool_ctx and isinstance(e, BoolOp)
     kw = {}
     for f in _dc.fields(e):
         v = getattr(e, f.name)
         if isinstance(v, Expr):
-            kw[f.name] = _resolve_subqueries(
-                v, catalog, under_not or is_not
-            )
+            kw[f.name] = _resolve_subqueries(v, catalog, child_ctx)
         elif isinstance(v, tuple) and v and isinstance(v[0], Expr):
             kw[f.name] = tuple(
-                _resolve_subqueries(x, catalog, under_not or is_not)
-                for x in v
+                _resolve_subqueries(x, catalog, child_ctx) for x in v
             )
     return _dc.replace(e, **kw) if kw else e
 
@@ -408,10 +485,26 @@ def _resolve_plan_subqueries(lp: L.LogicalPlan, catalog) -> L.LogicalPlan:
     def rx(e):
         return _resolve_subqueries(e, catalog) if e is not None else None
 
+    def rx_bool(e):
+        # Filter/Having conditions and aggregate FILTER clauses are owned
+        # by the Kleene evaluator — subqueries there may resolve to the
+        # three-valued `(x IN S) OR NULL` form
+        return (
+            _resolve_subqueries(e, catalog, bool_ctx=True)
+            if e is not None
+            else None
+        )
+
     if isinstance(lp, L.Filter):
-        return L.Filter(rx(lp.condition), _resolve_plan_subqueries(lp.child, catalog))
+        return L.Filter(
+            rx_bool(lp.condition),
+            _resolve_plan_subqueries(lp.child, catalog),
+        )
     if isinstance(lp, L.Having):
-        return L.Having(rx(lp.condition), _resolve_plan_subqueries(lp.child, catalog))
+        return L.Having(
+            rx_bool(lp.condition),
+            _resolve_plan_subqueries(lp.child, catalog),
+        )
     if isinstance(lp, L.Project):
         return L.Project(
             tuple((n, rx(e)) for n, e in lp.exprs),
@@ -422,7 +515,7 @@ def _resolve_plan_subqueries(lp: L.LogicalPlan, catalog) -> L.LogicalPlan:
             lp,
             group_exprs=tuple((n, rx(e)) for n, e in lp.group_exprs),
             agg_exprs=tuple(
-                _dc.replace(ae, arg=rx(ae.arg), filter=rx(ae.filter))
+                _dc.replace(ae, arg=rx(ae.arg), filter=rx_bool(ae.filter))
                 for ae in lp.agg_exprs
             ),
             post_exprs=tuple((n, rx(e)) for n, e in lp.post_exprs),
@@ -475,13 +568,84 @@ def _project_root(df: pd.DataFrame, lp: L.LogicalPlan) -> pd.DataFrame:
     return df
 
 
-def execute_fallback(lp: L.LogicalPlan, catalog) -> pd.DataFrame:
+def plan_tables(lp: L.LogicalPlan) -> set:
+    """Every base table a plan scans (recursively, incl. derived tables
+    and union branches; subqueries inside expressions are resolved later
+    and guarded by their own execute_fallback pass)."""
+    import dataclasses as _dc
+
+    out: set = set()
+    if isinstance(lp, L.Scan):
+        out.add(lp.table)
+        return out
+    for f in _dc.fields(lp):
+        v = getattr(lp, f.name)
+        if isinstance(v, L.LogicalPlan):
+            out |= plan_tables(v)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, L.LogicalPlan):
+                    out |= plan_tables(x)
+    return out
+
+
+def plan_input_rows(lp: L.LogicalPlan, catalog) -> int:
+    """Summed base-table row count a fallback execution would decode —
+    the input-size guard's measure (single-threaded pandas; the ceiling
+    keeps a mis-routed petabyte query from silently grinding)."""
+    total = 0
+    for t in plan_tables(lp):
+        ds = catalog.get(t)
+        if ds is not None:
+            total += ds.num_rows
+    return total
+
+
+class FallbackSizeError(ValueError):
+    """Fallback input exceeds SessionConfig.fallback_max_rows."""
+
+
+# The active size ceiling, inherited by NESTED execute_fallback calls (IN /
+# EXISTS / scalar subqueries execute their inner statements through the same
+# entry point): a guard that only covered the outer plan's tables would be
+# bypassed by `... WHERE k IN (SELECT x FROM huge)`.
+import contextvars
+
+_guard_max_rows = contextvars.ContextVar("fallback_guard_max_rows", default=0)
+
+
+def execute_fallback(
+    lp: L.LogicalPlan, catalog, max_rows: int = 0
+) -> pd.DataFrame:
     """Interpret a logical plan over decoded host frames, projecting the
-    result to the plan's SELECT list at the end."""
-    lp = _resolve_plan_subqueries(lp, catalog)
-    needed = None if _needs_all_columns(lp) else (_plan_columns(lp) or None)
-    df = _exec(lp, catalog, needed)
-    return _project_root(df, lp).reset_index(drop=True)
+    result to the plan's SELECT list at the end.
+
+    `max_rows` > 0 guards the input size: the fallback is single-threaded
+    host pandas, and a clear refusal beats an unbounded grind.  Nested
+    subquery executions inherit the caller's ceiling."""
+    limit = max_rows or _guard_max_rows.get()
+    if limit:
+        rows_in = plan_input_rows(lp, catalog)
+        if rows_in > limit:
+            raise FallbackSizeError(
+                f"host-fallback input is {rows_in:,} rows across "
+                f"{sorted(plan_tables(lp))}, above the "
+                f"fallback_max_rows ceiling of {limit:,}.  This query "
+                "could not be rewritten to the accelerated engine; either "
+                "restructure it (conforming star join / supported "
+                "predicates), or raise the ceiling with "
+                "SET fallback_max_rows."
+            )
+    token = _guard_max_rows.set(limit)
+    try:
+        lp = _resolve_plan_subqueries(lp, catalog)
+        needed = (
+            None if _needs_all_columns(lp) else (_plan_columns(lp) or None)
+        )
+        df = _exec(lp, catalog, needed)
+        return _project_root(df, lp).reset_index(drop=True)
+    finally:
+        _guard_max_rows.reset(token)
 
 
 def _exec(
@@ -497,7 +661,7 @@ def _exec(
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
-        return _apply_mask(df, _eval(lp.condition, df))
+        return _apply_mask(df, _filter_mask(lp.condition, df))
     if isinstance(lp, L.Project):
         df = _exec(lp.child, catalog, _needed)
         return pd.DataFrame(
@@ -568,7 +732,7 @@ def _exec(
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
-        return _apply_mask(df, _eval(_refs_to_cols(lp.condition), df))
+        return _apply_mask(df, _filter_mask(_refs_to_cols(lp.condition), df))
     if isinstance(lp, L.Sort):
         df = _exec(lp.child, catalog, _needed)
         if not len(df):

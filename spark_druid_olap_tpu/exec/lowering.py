@@ -341,11 +341,31 @@ class GroupByLowering:
     columns: List[str]
     filter_fn: Optional[Callable]
     vcol_fns: Dict[str, Callable]
+    # vcol names that are ALSO read by a vcol expression (physical shadow)
+    shadowed_inputs: frozenset = frozenset()
 
     def add_virtual(self, cols: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-        for name, fn in self.vcol_fns.items():
-            if name not in cols:
-                cols[name] = jnp.asarray(fn(cols))
+        """Compute virtual columns from the PHYSICAL inputs.  Idempotent:
+        a virtual column that shadows a physical column it reads saves the
+        physical values under __phys__<name>, so a second application (the
+        engine calls this once for sketches and once in row_arrays)
+        recomputes from the same inputs instead of compounding."""
+        if not self.vcol_fns:
+            return cols
+        inputs = dict(cols)
+        for name, fn in self.vcol_fns.items():  # declaration order
+            phys = "__phys__" + name
+            if phys in cols:
+                inputs[name] = cols[phys]
+            elif name in cols and name in self.shadowed_inputs:
+                cols[phys] = cols[name]
+            out = jnp.asarray(fn(inputs))
+            cols[name] = out
+            if name not in self.shadowed_inputs:
+                # chained vcols: a LATER vcol may read this output; a
+                # shadowed name keeps exposing its physical values to
+                # vcol expressions instead
+                inputs[name] = out
         return cols
 
     def row_mask(self, cols) -> jnp.ndarray:
@@ -496,8 +516,33 @@ def lower_groupby(q: Q.GroupByQuery, ds: DataSource) -> GroupByLowering:
     vcol_fns = {
         v.name: _decoded_expr_fn(v.expression, ds) for v in q.virtual_columns
     }
+    # Shadowing a VALUE-SPACE (metric/numeric) column is supported: every
+    # consumer reads plain values.  Shadowing a dictionary-encoded
+    # dimension is REFUSED: filters/aggs/dims on dictionary names compile
+    # into code space, and a value-space virtual array under that name
+    # would be silently mis-evaluated (refuse rather than be wrong).
+    for v in q.virtual_columns:
+        if v.name in ds.dicts:
+            raise ValueError(
+                f"virtual column {v.name!r} shadows dictionary-encoded "
+                f"dimension {v.name!r} of {ds.name!r}: filters and "
+                "groupings on dictionary dimensions evaluate in code "
+                "space, so the shadow cannot be honored soundly.  Name "
+                "the virtual column differently."
+            )
+    vcol_inputs = {
+        c for v in q.virtual_columns for c in v.expression.columns()
+    }
+    phys_names = {c.name for c in ds.columns}
     return GroupByLowering(
-        q, dims, la, G, _needed_columns(q, ds, dims), filter_fn, vcol_fns
+        q,
+        dims,
+        la,
+        G,
+        _needed_columns(q, ds, dims),
+        filter_fn,
+        vcol_fns,
+        shadowed_inputs=frozenset(vcol_fns) & vcol_inputs & phys_names,
     )
 
 
@@ -520,7 +565,21 @@ def _needed_columns(q, ds: DataSource, dims) -> List[str]:
     for v in q.virtual_columns:
         names.extend(v.expression.columns())
     virt = {v.name for v in q.virtual_columns}
-    need = [n for n in dict.fromkeys(names) if n not in virt and n != "__time"]
+    # A name produced by a virtual column is not fetched — UNLESS it is a
+    # SHADOW: a physical column that a vcol expression also reads (the vcol
+    # computes from the physical values, every other consumer reads the
+    # virtual ones).  A vcol name read only by ANOTHER vcol (chained
+    # virtual columns) is not physical and must not be fetched.
+    phys = {c.name for c in ds.columns}
+    vcol_inputs = {
+        c for v in q.virtual_columns for c in v.expression.columns()
+    }
+    shadows = virt & vcol_inputs & phys
+    need = [
+        n
+        for n in dict.fromkeys(names)
+        if (n not in virt or n in shadows) and n != "__time"
+    ]
     if ds.time_column and (
         any(d.spec.dimension == "__time" or d.spec.granularity for d in dims)
         or q.intervals

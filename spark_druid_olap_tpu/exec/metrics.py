@@ -37,6 +37,10 @@ from typing import Optional
 class QueryMetrics:
     query_type: str = ""
     strategy: str = ""
+    # which executor answered: "device" (local/distributed engine) or
+    # "fallback" (host pandas interpreter, exec/fallback.py) — a user must
+    # be able to SEE that a query left the accelerated path
+    executor: str = "device"
     distributed: bool = False
     mesh_shape: Optional[tuple] = None
     rows_scanned: int = 0
@@ -72,6 +76,7 @@ class QueryMetrics:
         )
         return (
             f"QueryMetrics[{self.query_type} strategy={self.strategy} "
+            f"executor={self.executor} "
             f"target={tgt} rows={self.rows_scanned} segments={self.segments} "
             f"groups={self.num_groups} total={self.total_ms:.2f}ms "
             f"(h2d={self.h2d_ms:.2f}ms/{self.h2d_bytes}B "

@@ -48,10 +48,13 @@ _LANE = 128
 # this constant bounds G for the dense strategy overall.
 DENSE_MAX_GROUPS = 1 << 17
 
-# Measured dense-vs-scatter crossover on TPU v5e (8.4M rows, 3 sums + min +
-# max): one-hot ~60 Mrows/s at G=2160 vs scatter ~35; at G=8192 one-hot drops
-# to ~28 while scatter holds ~34.  Matches the cost-model formula
-# (G/128 <= 4 * scatter_cost_per_row) cutover.
+# ESTIMATED dense-vs-scatter crossover for a v5e-class chip (no committed
+# TPU artifact backs this yet — see BENCH_r*.json history; rounds 1-2 never
+# reached the hardware).  The estimate follows the cost-model formula
+# (G/128 <= 4 * scatter_cost_per_row); `plan/calibrate.py` replaces it with
+# a measured value the first time it runs on the real backend, and the
+# calibrated crossover is what the planner actually uses
+# (SessionConfig.load_calibrated).
 SCATTER_CUTOVER = 4096
 
 

@@ -120,7 +120,9 @@ def pallas_partial_aggregate(
     Returns (sums[G, Ms], mins[G, Mn], maxs[G, Mx]); empty groups are 0 /
     +inf / -inf exactly like the XLA path.
 
-    Block tuning (measured on v5e): every extra group tile re-reads the whole
+    Block tuning (ESTIMATED for a v5e-class VMEM budget; not yet validated
+    on hardware — rounds 1-2 never reached the TPU, see BENCH_r*.json):
+    every extra group tile re-reads the whole
     row stream, so the group-block default spans all groups up to 4096 (one
     tile); the row block shrinks to 512 when the group block is wide so the
     (BR, BG) match tile stays within VMEM."""

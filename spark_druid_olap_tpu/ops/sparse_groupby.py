@@ -47,9 +47,10 @@ ROW_CAPACITY = 1 << 17
 
 # When the 128K tier overflows, the kernel's exact survivor count (`n_rows`)
 # picks the smallest adequate rung instead of falling all the way back to the
-# full-segment sort: sort cost grows roughly linearly with capacity (measured
-# on v5e: SSB q3_1 at 256K = 235 ms vs full-6M = 860 ms), so one rung of
-# headroom is worth compiling a second program for.
+# full-segment sort: sort cost grows roughly linearly with capacity (an
+# ESTIMATE from the O(n log n) sort bound — no committed TPU artifact backs
+# a measured number yet), so one rung of headroom is worth compiling a
+# second program for.
 ROW_CAPACITY_LADDER = (1 << 17, 1 << 18, 1 << 19, 1 << 20, 1 << 21)
 
 

@@ -99,7 +99,8 @@ class DistributedEngine:
     # -- host-side row-shard assembly ---------------------------------------
 
     def _global_columns(
-        self, ds: DataSource, names, intervals, filt=None
+        self, ds: DataSource, names, intervals, filt=None,
+        vcol_names=frozenset(),
     ):
         nd = self.mesh.shape[DATA_AXIS]
         segs = list(ds.segments)
@@ -116,7 +117,7 @@ class DistributedEngine:
             # engine.  NOTE: each distinct pruned set keys its own shard
             # layout and SPMD compile (the precedent interval pruning set);
             # the byte-budget LRU bounds residency if filters churn
-            segs = _prune_by_stats(segs, filt, ds)
+            segs = _prune_by_stats(segs, filt, ds, vcol_names)
         total = sum(s.num_rows_padded for s in segs)
         chunk = nd * ROW_PAD
         padded = -(-max(total, 1) // chunk) * chunk
@@ -320,7 +321,10 @@ class DistributedEngine:
         known = len(self._shard_cache)
         before_bytes = self._shard_cache.bytes_used
         cols, padded, scope = self._global_columns(
-            ds, lowering.columns, q.intervals, q.filter
+            ds, lowering.columns, q.intervals, q.filter,
+            frozenset(
+                v.name for v in getattr(q, "virtual_columns", ()) or ()
+            ),
         )
         # post-prune counts, matching the local engine's metrics semantics
         m.rows_scanned = sum(sg.num_rows for sg in scope)

@@ -410,12 +410,15 @@ def test_in_subquery_edge_cases():
         "HAVING k IN (SELECT j FROM nn) ORDER BY k"
     )
     assert list(got2["k"].astype(int)) == [1, 3]
-    # double negation over a NULL-producing NOT IN is refused, not wrong
-    with pytest.raises(Exception, match="three-valued|unsupported"):
-        c.sql(
-            "SELECT count(*) AS n FROM f3 "
-            "WHERE NOT (k NOT IN (SELECT j FROM nn))"
-        )
+    # double negation over a NULL-producing NOT IN: Kleene evaluation
+    # (round 2 refused this shape; round 3's _eval3 computes it).
+    # k NOT IN {1,3,NULL}: members FALSE, everything else UNKNOWN;
+    # NOT of that is TRUE only for the members 1 and 3.
+    got3 = c.sql(
+        "SELECT count(*) AS n FROM f3 "
+        "WHERE NOT (k NOT IN (SELECT j FROM nn))"
+    )
+    assert int(got3["n"].iloc[0]) == 2
 
 
 def test_scalar_subquery(ctx):
@@ -509,3 +512,155 @@ def test_exists_subquery(ctx):
         "WHERE mode = 'A' AND EXISTS (SELECT ok FROM other)"
     )
     assert int(got4["n"].iloc[0]) == int((f["mode"] == "A").sum())
+
+
+def test_kleene_not_over_in_and_comparison():
+    """Round-2 advisor case 1: NOT (k IN (subq) AND k > 0) with a NULL
+    operand row.  Two-valued NULL->False coalescing counts the NULL row
+    (NOT(False AND False) = True); Kleene says UNKNOWN -> excluded."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "kf",
+        {"k": np.array([1, 5, None], dtype=object)},
+        dimensions=["k"],
+    )
+    c.register_table(
+        "ks", {"j": np.array([1], dtype=np.int64)}, dimensions=["j"]
+    )
+    got = c.sql(
+        "SELECT count(*) AS n FROM kf "
+        "WHERE NOT (k IN (SELECT j FROM ks) AND k > 0)"
+    )
+    # k=1: IN TRUE, >0 TRUE -> NOT(TRUE) = FALSE
+    # k=5: IN FALSE -> AND FALSE -> NOT = TRUE
+    # k=NULL: UNKNOWN AND UNKNOWN = UNKNOWN -> NOT = UNKNOWN -> excluded
+    assert int(got["n"].iloc[0]) == 1
+
+
+def test_kleene_not_over_null_scalar_subquery(ctx):
+    """Round-2 advisor case 2: NOT (v > (SELECT ... -> NULL)) must match
+    NOTHING (NOT UNKNOWN = UNKNOWN), not everything."""
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE NOT (v > (SELECT max(v) FROM fact WHERE v > 1e9))"
+    )
+    assert int(got["n"].iloc[0]) == 0
+
+
+def test_null_scalar_subquery_equality_is_unknown(ctx):
+    """`v = (SELECT NULL)` is UNKNOWN everywhere — it must NOT collide
+    with the parser's `== Literal(None)` IS-NULL encoding and return the
+    null rows."""
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact "
+        "WHERE v = (SELECT max(v) FROM fact WHERE v > 1e9)"
+    )
+    assert int(got["n"].iloc[0]) == 0
+
+
+def test_fallback_reports_executor_in_metrics(ctx):
+    """VERDICT r2 #7: a star-violating join must be VISIBLE as a fallback
+    execution — QueryMetrics.executor, explain_analyze, not silence."""
+    ctx.sql(
+        "SELECT label, sum(v) AS s FROM fact JOIN other ON k = ok "
+        "GROUP BY label"
+    )
+    m = ctx.last_metrics
+    assert m is not None and m.executor == "fallback"
+    assert m.rows_scanned == 5_000 + 50  # fact + other
+    assert m.total_ms > 0
+    # a subsequent DEVICE query flips the flag back
+    ctx.sql("SELECT k, sum(v) AS s FROM fact GROUP BY k")
+    assert ctx.last_metrics.executor == "device"
+    # explain_analyze on a fallback query surfaces it too
+    df, text = ctx.explain_analyze(
+        "SELECT label, sum(v) AS s FROM fact JOIN other ON k = ok "
+        "GROUP BY label"
+    )
+    assert "Host Fallback" in text and "executor=fallback" in text
+    assert len(df) == 7
+
+
+def test_fallback_size_guard():
+    from spark_druid_olap_tpu.exec.fallback import FallbackSizeError
+
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "big",
+        {"x": np.arange(1000, dtype=np.int64)},
+        dimensions=["x"],
+    )
+    c.register_table(
+        "lk", {"y": np.arange(10, dtype=np.int64)}, dimensions=["y"]
+    )
+    c.sql("SET fallback_max_rows = 100")
+    with pytest.raises(FallbackSizeError, match="ceiling"):
+        c.sql(
+            "SELECT x, count(*) AS n FROM big JOIN lk ON x = y GROUP BY x"
+        )
+    # raising the ceiling un-blocks it
+    c.sql("SET fallback_max_rows = 0")
+    got = c.sql(
+        "SELECT count(*) AS n FROM big JOIN lk ON x = y"
+    )
+    assert int(got["n"].iloc[0]) == 10
+
+
+def test_fallback_size_guard_covers_subqueries():
+    """Review finding: the ceiling must apply to subquery INNER plans too
+    (`tiny WHERE k IN (SELECT x FROM huge)` must not grind huge)."""
+    from spark_druid_olap_tpu.exec.fallback import FallbackSizeError
+
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "tiny", {"k": np.arange(5, dtype=np.int64)}, dimensions=["k"]
+    )
+    c.register_table(
+        "huge", {"x": np.arange(1000, dtype=np.int64)}, dimensions=["x"]
+    )
+    c.sql("SET fallback_max_rows = 100")
+    with pytest.raises(FallbackSizeError, match="ceiling"):
+        c.sql(
+            "SELECT count(*) AS n FROM tiny "
+            "WHERE k IN (SELECT x FROM huge)"
+        )
+
+
+def test_result_cache_hit_restamps_metrics():
+    """Review finding: a result-cache hit after a fallback run must not
+    report executor='fallback' for the cached device query."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "rc",
+        {"g": np.array([0, 1, 0, 1]), "v": np.arange(4, dtype=np.float32)},
+        dimensions=["g"],
+        metrics=["v"],
+    )
+    c.register_table(
+        "rl", {"y": np.arange(2, dtype=np.int64)}, dimensions=["y"]
+    )
+    c.sql("SELECT g, sum(v) AS s FROM rc GROUP BY g")  # cached
+    c.sql("SELECT count(*) AS n FROM rc JOIN rl ON g = y")  # fallback
+    assert c.last_metrics.executor == "fallback"
+    c.sql("SELECT g, sum(v) AS s FROM rc GROUP BY g")  # cache hit
+    m = c.last_metrics
+    assert m.executor == "device" and m.strategy == "result-cache"
+
+
+def test_in_subquery_with_nulls_in_select_position():
+    """Review finding: the 3VL `OR NULL` rewrite must not leak into VALUE
+    positions (SELECT list) where the two-valued compiler evaluates it —
+    there the round-2 FALSE-coalescing approximation is kept."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "sv",
+        {"k": np.arange(5, dtype=np.int64)},
+        dimensions=["k"],
+    )
+    c.register_table(
+        "sn", {"j": np.array([1, None, 3], dtype=object)}, dimensions=["j"]
+    )
+    got = c.sql(
+        "SELECT k, k IN (SELECT j FROM sn) AS b FROM sv ORDER BY k"
+    )
+    assert [bool(x) for x in got["b"]] == [False, True, False, True, False]

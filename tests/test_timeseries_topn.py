@@ -136,3 +136,37 @@ def test_groupby_granularity_year(ssb_ds, ssb_cols):
     want = want.sort_values(["y", "r"]).reset_index(drop=True)
     assert len(got) == len(want)
     np.testing.assert_array_equal(got.n, want.n)
+
+
+def test_chained_virtual_columns():
+    """Review finding: a virtual column reading ANOTHER virtual column
+    (declaration order) must lower without fetching the intermediate name
+    from segments."""
+    import numpy as np
+
+    from spark_druid_olap_tpu.catalog.segment import build_datasource
+    from spark_druid_olap_tpu.exec.engine import Engine
+    from spark_druid_olap_tpu.models.aggregations import DoubleSum
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.query import GroupByQuery, VirtualColumn
+    from spark_druid_olap_tpu.plan.expr import Literal, col
+
+    g = np.array([0, 1, 0, 1, 0], dtype=np.int64)
+    v = np.array([1.0, 2.0, 3.0, 4.0, 5.0], dtype=np.float32)
+    ds = build_datasource(
+        "cv", {"g": g, "v": v}, dimension_cols=["g"], metric_cols=["v"]
+    )
+    q = GroupByQuery(
+        datasource="cv",
+        dimensions=(DimensionSpec("g"),),
+        aggregations=(DoubleSum("s", "b"),),
+        virtual_columns=(
+            VirtualColumn("a", col("v") * Literal(2.0)),
+            VirtualColumn("b", col("a") + Literal(1.0)),
+        ),
+    )
+    got = Engine().execute(q, ds)
+    by = {int(r["g"]): float(r["s"]) for _, r in got.iterrows()}
+    # b = 2v + 1 per row
+    assert by[0] == (2 * 1.0 + 1) + (2 * 3.0 + 1) + (2 * 5.0 + 1)
+    assert by[1] == (2 * 2.0 + 1) + (2 * 4.0 + 1)

@@ -185,3 +185,154 @@ def test_distributed_zone_map_pruning():
     assert eng.last_metrics.segments == 1
     assert eng.last_metrics.rows_scanned == ds.segments[0].num_rows
     assert eng.last_metrics.rows_scanned < ds.num_rows
+
+
+@pytest.fixture(scope="module")
+def metric_clustered():
+    """A table whose METRIC m is clustered across segments (m rises with
+    row order), so numeric-bound zone maps prune — the canvas for the
+    virtual-column shadowing cases (metric shadowing is value-space and
+    therefore supported end to end)."""
+    n, segs = 40_000, 4
+    m = np.sort(
+        np.random.default_rng(9).integers(0, 100, n)
+    ).astype(np.float32)
+    cities = np.array([f"g{int(x) // 20}" for x in m], dtype=object)
+    v = np.random.default_rng(10).random(n).astype(np.float32)
+    ctx = sd.TPUOlapContext()
+    ctx.register_table(
+        "mcl",
+        {"city": cities, "m": m, "v": v},
+        dimensions=["city"],
+        metrics=["m", "v"],
+        rows_per_segment=n // segs,
+    )
+    df = pd.DataFrame(
+        {"city": cities, "m": m.astype(np.float64),
+         "v": v.astype(np.float64)}
+    )
+    return ctx, df
+
+
+def _shadow_query():
+    from spark_druid_olap_tpu.models.aggregations import DoubleSum
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.filters import Bound
+    from spark_druid_olap_tpu.models.query import GroupByQuery, VirtualColumn
+    from spark_druid_olap_tpu.plan.expr import Literal, col
+
+    # "m" is redefined as 100 - m: the filter m < 10 selects the HIGH
+    # physical values, which live in the LAST segment
+    return GroupByQuery(
+        datasource="mcl",
+        dimensions=(DimensionSpec("city"),),
+        aggregations=(DoubleSum("s", "v"),),
+        virtual_columns=(
+            VirtualColumn("m", Literal(100.0) - col("m")),
+        ),
+        filter=Bound("m", upper="10", ordering="numeric"),
+    )
+
+
+def test_virtual_column_shadow_disables_pruning(metric_clustered):
+    """A virtual column SHADOWING a physical metric: the filter evaluates
+    against the virtual values at execution, so pruning it against the
+    physical column's zone map would silently drop live segments
+    (round-2 advisor finding) — and the whole query must run correctly."""
+    import dataclasses
+
+    from spark_druid_olap_tpu.models.filters import Bound
+
+    ctx, df = metric_clustered
+    ds = ctx.catalog.get("mcl")
+    eng = ctx.engine
+    q = _shadow_query()
+    segs = eng._segments_in_scope(q, ds)
+    assert len(segs) == len(ds.segments)  # no pruning on shadowed name
+    # the same bound WITHOUT the virtual column does prune
+    q2 = dataclasses.replace(q, virtual_columns=())
+    assert len(eng._segments_in_scope(q2, ds)) < len(ds.segments)
+    # end-to-end: correct rows (virtual m < 10 means physical m > 90)
+    got = eng.execute(q, ds)
+    w = df[100.0 - df.m <= 10].groupby("city")["v"].sum()
+    got_by = {r["city"]: float(r["s"]) for _, r in got.iterrows()}
+    assert set(got_by) == set(w.index)
+    for city, s in w.items():
+        np.testing.assert_allclose(got_by[city], s, rtol=2e-5)
+
+
+def test_vcol_shadowing_dict_dimension_rejected(clustered):
+    """Shadowing a DICTIONARY-ENCODED dimension cannot be honored soundly
+    (filters/groupings compile into code space) — clear refusal, not a
+    wrong answer."""
+    from spark_druid_olap_tpu.models.aggregations import DoubleSum
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.filters import Bound
+    from spark_druid_olap_tpu.models.query import GroupByQuery, VirtualColumn
+    from spark_druid_olap_tpu.plan.expr import Literal, col
+
+    ctx, _ = clustered
+    ds = ctx.catalog.get("cl")
+    q = GroupByQuery(
+        datasource="cl",
+        dimensions=(DimensionSpec("city"),),
+        aggregations=(DoubleSum("s", "v"),),
+        virtual_columns=(
+            VirtualColumn("k", Literal(100) - col("k"), dtype="long"),
+        ),
+        filter=Bound("k", upper="10", ordering="numeric"),
+    )
+    with pytest.raises(ValueError, match="shadow"):
+        ctx.engine.execute(q, ds)
+
+
+def test_sort_by_encoded_dims_nulls_last():
+    """sort_by over PRE-ENCODED dimension codes (caller-supplied dicts):
+    null codes are negative and must still cluster LAST (round-2 advisor
+    finding — raw code order put them first)."""
+    from spark_druid_olap_tpu.catalog.segment import DimensionDict
+
+    c = sd.TPUOlapContext()
+    codes = np.array([1, -1, 0, 1, -1], dtype=np.int32)
+    c.register_table(
+        "enc",
+        {"c": codes, "v": np.arange(5, dtype=np.float32)},
+        dimensions=["c"],
+        metrics=["v"],
+        dicts={"c": DimensionDict(values=("a", "b"))},
+        sort_by=["c"],
+        rows_per_segment=2,
+    )
+    ds = c.catalog.get("enc")
+    phys = np.concatenate(
+        [np.asarray(s.dims["c"])[s.valid] for s in ds.segments]
+    )
+    nulls = phys < 0
+    assert not nulls[:3].any() and nulls[3:].all()
+    assert list(phys[:3]) == sorted(phys[:3])
+
+
+def test_distributed_vcol_shadow_disables_pruning(metric_clustered):
+    """Review finding: the mesh path must apply the same virtual-column
+    shadow rule as the local engine — a shadowed filter name must not
+    prune against physical stats."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from spark_druid_olap_tpu.parallel.distributed import DistributedEngine
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+
+    ctx, df = metric_clustered
+    ds = ctx.catalog.get("mcl")
+    q = _shadow_query()
+    eng = DistributedEngine(mesh=make_mesh(n_data=8))
+    got = eng.execute(q, ds)
+    # filter selects virtual m < 10 i.e. physical m > 90 — the LAST
+    # segment's rows.  With wrong pruning those segments vanish -> empty.
+    w = df[100.0 - df.m <= 10].groupby("city")["v"].sum()
+    assert eng.last_metrics.segments == len(ds.segments)  # nothing pruned
+    got_by = {r["city"]: float(r["s"]) for _, r in got.iterrows()}
+    assert set(got_by) == set(w.index)
+    for city, s in w.items():
+        np.testing.assert_allclose(got_by[city], s, rtol=2e-5)
