@@ -172,6 +172,15 @@ class TPUOlapContext:
         self.catalog.put(ds, star_schema)
         return ds
 
+    def register_datasource(self, ds: DataSource, star_schema=None):
+        """Register an ALREADY-BUILT DataSource (streamed/chunked ingest via
+        catalog.segment.build_datasource_streamed, or one loaded from
+        catalog.persist) under its own name."""
+        if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
+            star_schema = StarSchemaInfo.from_json(star_schema)
+        self.catalog.put(ds, star_schema)
+        return ds
+
     def register_lookup(self, name: str, mapping: Mapping[str, str]):
         """Register a query-time lookup table (Druid lookup extraction):
         `LOOKUP(dim, 'name')` in GROUP BY maps dimension values through it

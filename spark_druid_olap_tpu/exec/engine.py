@@ -59,8 +59,25 @@ from .finalize import (  # noqa: F401
     finalize_topn,
 )
 from ..utils.log import get_logger
+from .sparse_exec import SparseExecMixin
 
 log = get_logger("exec.engine")
+
+
+def _bytes_scanned(segs, columns) -> int:
+    """Bytes of segment data a query's kernel reads: needed columns plus
+    the validity mask (and time when fetched) over REAL rows — the
+    roofline numerator (QueryMetrics.bytes_scanned)."""
+    total = 0
+    for s in segs:
+        row_bytes = 1  # valid mask
+        for n in columns:
+            try:
+                row_bytes += s.column(n).dtype.itemsize
+            except KeyError:
+                pass  # virtual columns are computed, not read
+        total += row_bytes * s.num_rows
+    return total
 
 
 def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
@@ -170,7 +187,7 @@ MULTI_SEGMENT_UNROLL_MAX = 32
 _SPARSE_ERROR_PIN_AFTER = 2
 
 
-class Engine:
+class Engine(SparseExecMixin):
     """Executes query specs on the local device set.
 
     `strategy` mirrors the reference's cost-model execution choice
@@ -521,245 +538,6 @@ class Engine:
         self._query_fn_cache[key] = seg_fn
         return seg_fn
 
-    # -- sparse (sort-compaction) path for high-cardinality domains ----------
-
-    def _sparse_eligible(self, lowering: "GroupByLowering") -> bool:
-        """Sparse applies when the scatter path would otherwise run: huge
-        combined domain, plain (non-sketch) aggregates, and real dimensions.
-        Sketch states are [G, registers] dense — compaction would have to
-        re-key them too; at high G those queries stay on scatter."""
-        from ..ops.groupby import SCATTER_CUTOVER
-
-        # explicit strategy='segment' is the raw-scatter escape hatch and is
-        # honored as such (ADVICE r1: the sparse accelerator must not hijack
-        # an explicitly requested kernel).  The cost model emits 'sparse'
-        # when compaction should run; 'auto'/'dense' only self-upgrade on a
-        # TPU backend — measured on CPU, raw scatter beats sort-compaction
-        # at every domain size, so auto-sparse there is a pure loss.
-        from ..ops.pallas_groupby import pallas_available
-
-        auto_upgrade = (
-            self.strategy in ("auto", "dense")
-            and pallas_available()
-            and not self._pallas_broken
-        )
-        return (
-            lowering.num_groups > SCATTER_CUTOVER
-            and not lowering.la.sketch_aggs
-            and bool(lowering.dims)
-            and (auto_upgrade or self.strategy == "sparse")
-        )
-
-    def _sparse_program(
-        self,
-        q: Q.GroupByQuery,
-        ds: DataSource,
-        lowering: "GroupByLowering",
-        row_capacity: Optional[int] = None,
-    ) -> Callable:
-        from ..ops.pallas_groupby import pallas_available
-        from ..ops.sparse_groupby import sparse_partial_aggregate
-
-        la = lowering.la
-        # inner kernel over the compacted slots: the Pallas one-hot on TPU;
-        # scatter on CPU backends (4096-slot one-hot matmuls starve a CPU,
-        # and at `slots` segments CPU scatter is cheap)
-        inner = (
-            "pallas"
-            if not self._pallas_broken and pallas_available()
-            else "segment"
-        )
-        key = _query_key(q, ds) + (f"sparse:{inner}:{row_capacity}",)
-        cached = self._query_fn_cache.get(key)
-        if cached is not None:
-            if self._m is not None:
-                self._m.program_cache_hit = True
-            return cached
-
-        from ..ops.sparse_groupby import merge_sparse_states
-
-        def one_segment(cols):
-            gid, mask, sv, mmv, mmm = lowering.row_arrays(dict(cols))
-            return sparse_partial_aggregate(
-                gid, mask, sv, mmv, mmm,
-                num_groups=lowering.num_groups,
-                num_min=len(la.min_names),
-                num_max=len(la.max_names),
-                inner_strategy=inner,
-                row_capacity=row_capacity,
-            )
-
-        @jax.jit
-        def seg_fn(cols_list):
-            state = None
-            for cols in cols_list:
-                st = one_segment(cols)
-                state = (
-                    st
-                    if state is None
-                    else merge_sparse_states(
-                        state, st, num_groups=lowering.num_groups
-                    )
-                )
-            return state
-
-        self._query_fn_cache[key] = seg_fn
-        return seg_fn
-
-    def _dispatch_groupby_sparse(
-        self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
-    ):
-        """Sparse execution attempt over the (non-empty) segment scope,
-        split into an eager dispatch phase and a deferred fetch so N queries
-        (a grouping-set expansion) can overlap their device round trips.
-
-        Dispatches the tier-1 program asynchronously and returns
-        `resolve() -> (df, reason)`: df is None when declining, with reason
-        "overflow" (deterministic — more distinct groups than slots: the
-        caller pins the query off this path) or "error" (sparse program
-        failed even after the Pallas-inner retry: fall back this execution
-        only; correctness never depends on this path).  A trace/compile
-        failure at dispatch time is carried into resolve() and handled by
-        the same downgrade path as an execution failure."""
-        from ..ops.sparse_groupby import merge_sparse_states
-
-        segs = self._segments_in_scope(q, ds)
-        G = lowering.num_groups
-        # The selective-filter fast path only makes sense when rows can
-        # actually be masked out (a filter or time intervals); an unfiltered
-        # segment would overflow the capacity by construction.
-        selective = q.filter is not None or bool(q.intervals)
-
-        def dispatch(row_capacity=None):
-            seg_fn = self._sparse_program(
-                q, ds, lowering, row_capacity=row_capacity
-            )
-            state = None
-            for batch in self._segment_batches(segs, lowering.columns):
-                cols_list = [
-                    self._cols_for_segment(seg, ds, lowering.columns)
-                    for seg in batch
-                ]
-                st = seg_fn(cols_list)
-                state = (
-                    st
-                    if state is None
-                    else merge_sparse_states(state, st, num_groups=G)
-                )
-            return state
-
-        def evict():
-            # only THIS query's sparse programs — other queries' compiled
-            # sparse programs are fine and expensive to rebuild
-            base = _query_key(q, ds)
-            for k in [
-                k
-                for k in self._query_fn_cache
-                if k[:2] == base and str(k[2]).startswith("sparse")
-            ]:
-                self._query_fn_cache.pop(k)
-
-        qkey = _query_key(q, ds)
-        from ..ops import sparse_groupby as _sg
-
-        # tier 1: filter-compacted sort (128K-row sort network by default,
-        # or the rung remembered from a previous overflow on this query)
-        cap = (
-            self._sparse_row_capacity.get(qkey, _sg.ROW_CAPACITY)
-            if selective
-            else None
-        )
-
-        def fetch_tiered(state, row_capacity):
-            # On row overflow the kernel's exact survivor count picks the
-            # smallest adequate ROW_CAPACITY_LADDER rung (full-R sort only
-            # past the top rung) — sort cost grows ~linearly with capacity,
-            # so q3_1-class queries (180K survivors of 6M rows) stay 3-4x
-            # off the full sort.  The rung is deterministic per (query,
-            # data) and remembered.  Slot overflow falls out in resolve().
-            host = jax.device_get(state)
-            if row_capacity is not None and bool(host["row_overflow"]):
-                n = int(host["n_rows"])
-                new_cap = next(
-                    (
-                        c
-                        for c in _sg.ROW_CAPACITY_LADDER
-                        if c >= n and c > row_capacity
-                    ),
-                    None,
-                )
-                self._sparse_row_capacity[qkey] = new_cap
-                log.info(
-                    "sparse row compaction overflowed %d of capacity %d; "
-                    "rerunning at %s (remembered for repeats)",
-                    n, row_capacity,
-                    "full-segment sort" if new_cap is None else new_cap,
-                )
-                host = jax.device_get(dispatch(row_capacity=new_cap))
-            return host
-
-        # phase 1: dispatch (async — no fetch).  Exceptions are deferred
-        # into resolve() so batch callers see the same decline protocol as
-        # execution failures.  Record which inner kernel THIS dispatch used:
-        # in batch mode an earlier query's resolve may flip _pallas_broken
-        # between our dispatch and our resolve, and the downgrade retry must
-        # key on what we actually ran, not the current flag.
-        from ..ops.pallas_groupby import pallas_available
-
-        used_pallas_inner = not self._pallas_broken and pallas_available()
-        state = dispatch_exc = None
-        try:
-            state = dispatch(row_capacity=cap)
-        except Exception as exc:  # noqa: BLE001 — re-raised in resolve
-            dispatch_exc = exc
-
-        def resolve():
-            nonlocal state
-            try:
-                if dispatch_exc is not None:
-                    raise dispatch_exc
-                host = fetch_tiered(state, cap)
-                state = None  # free the device partials promptly
-            except Exception:
-                state = None
-                evict()
-                # mirror _call_segment_program: a Mosaic failure of the
-                # Pallas inner kernel downgrades to the scatter inner, not
-                # to the whole-query scatter path
-                if not used_pallas_inner or not pallas_available():
-                    return None, "error"
-                we_broke_it = not self._pallas_broken
-                self._pallas_broken = True
-                try:
-                    # the failed attempt may already have learned the right
-                    # row-capacity rung; retry there, not at the stale cap
-                    retry_cap = self._sparse_row_capacity.get(qkey, cap)
-                    host = fetch_tiered(
-                        dispatch(row_capacity=retry_cap), retry_cap
-                    )
-                except Exception:
-                    # only unflag if WE set the flag — an earlier query may
-                    # have legitimately discovered the broken kernel
-                    if we_broke_it:
-                        self._pallas_broken = False
-                    evict()
-                    return None, "error"
-            if bool(host["overflow"]):
-                return None, "overflow"
-            df = finalize_groupby(
-                q,
-                lowering.dims,
-                lowering.la,
-                np.asarray(host["sums"]),
-                np.asarray(host["mins"]),
-                np.asarray(host["maxs"]),
-                {},
-                slot_gids=np.asarray(host["gids"]),
-            )
-            return df, "ok"
-
-        return resolve
-
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
         """GroupBy with one idempotent re-dispatch on transient device
         failure — the analog of Spark retrying a DruidRDD partition
@@ -864,6 +642,7 @@ class Engine:
             query_type="groupBy",
             strategy=self._resolve_strategy(lowering.num_groups),
             rows_scanned=sum(s.num_rows for s in segs),
+            bytes_scanned=_bytes_scanned(segs, lowering.columns),
             segments=len(segs),
             num_groups=lowering.num_groups,
         )
@@ -1158,18 +937,72 @@ class Engine:
         )
 
     def _execute_search(self, q: Q.SearchQuery, ds: DataSource):
+        """Dimension-value search: candidate values come from the (host)
+        dictionaries, but the Druid wire contract includes a per-value
+        `count` of MATCHING ROWS — so rows in scope (intervals, zone maps,
+        filter) are counted per code, and zero-count values are omitted,
+        exactly like Druid's broker response."""
         import pandas as pd
 
-        rows = []
+        # candidate codes come from the host dictionaries FIRST: a needle
+        # matching nothing (or nothing beyond earlier dimensions' limit)
+        # must not pay a row scan
         needle = q.query.lower()
-        for dim in q.dimensions:
+        matching = {
+            dim: [
+                code
+                for code, v in enumerate(ds.dicts[dim].values)
+                if needle in str(v).lower()
+            ]
+            for dim in q.dimensions
+        }
+        live_dims = [d for d in q.dimensions if matching[d]]
+        if not live_dims:
+            return pd.DataFrame(columns=["dimension", "value", "count"])
+        segs = self._segments_in_scope(q, ds)
+        fmask_fn = (
+            compile_filter(q.filter, ds) if q.filter is not None else None
+        )
+        counts = {
+            dim: np.zeros(ds.dicts[dim].cardinality, np.int64)
+            for dim in live_dims
+        }
+        for seg in segs:
+            base = np.asarray(seg.valid)
+            if q.intervals and seg.time is not None:
+                t = np.asarray(seg.time)
+                im = np.zeros(base.shape, bool)
+                for a, b in q.intervals:
+                    im |= (t >= a) & (t < b)
+                base = base & im
+            if fmask_fn is not None:
+                cols = {
+                    n: jnp.asarray(seg.column(n))
+                    for n in _filter_columns(q.filter)
+                }
+                base = base & np.asarray(fmask_fn(cols))
+            for dim in live_dims:
+                sel = np.asarray(seg.dims[dim])[base]
+                sel = sel[sel >= 0]
+                counts[dim] += np.bincount(
+                    sel, minlength=len(counts[dim])
+                )
+        rows = []
+        for dim in live_dims:
             if len(rows) >= q.limit:
                 break
-            for v in ds.dicts[dim].values:
-                if needle in str(v).lower():
-                    rows.append({"dimension": dim, "value": v})
+            d = ds.dicts[dim]
+            for code in matching[dim]:
+                if counts[dim][code] > 0:
+                    rows.append(
+                        {
+                            "dimension": dim,
+                            "value": d.values[code],
+                            "count": int(counts[dim][code]),
+                        }
+                    )
                     if len(rows) >= q.limit:
                         break
-        return pd.DataFrame(rows, columns=["dimension", "value"])
+        return pd.DataFrame(rows, columns=["dimension", "value", "count"])
 
 

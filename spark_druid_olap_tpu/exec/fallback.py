@@ -128,13 +128,28 @@ def _is_null_lit(s) -> bool:
     )
 
 
-def _null_rows(e: Expr, df: pd.DataFrame) -> np.ndarray:
+def _eval_memo(e: Expr, df: pd.DataFrame, memo) -> np.ndarray:
+    """_eval with per-filter memoization: the Kleene evaluator reads each
+    comparison operand once for its value and once for its null mask, and
+    Expr nodes are frozen/hashable — share the evaluation."""
+    if memo is None:
+        return _eval(e, df)
+    try:
+        v = memo.get(e)
+    except TypeError:  # unhashable literal payload
+        return _eval(e, df)
+    if v is None:
+        v = memo[e] = _eval(e, df)
+    return v
+
+
+def _null_rows(e: Expr, df: pd.DataFrame, memo=None) -> np.ndarray:
     """Per-row SQL-NULL mask of a VALUE expression over a decoded frame
     (decoded dims hold None, metrics hold NaN — pd.isna covers both)."""
     n = len(df)
     if isinstance(e, E.Literal):
         return np.full(n, _is_null_lit(e), dtype=bool)
-    v = np.asarray(_eval(e, df))
+    v = np.asarray(_eval_memo(e, df, memo))
     if v.ndim == 0:
         return np.full(n, bool(pd.isna(v[()])), dtype=bool)
     return np.asarray(pd.isna(v))
@@ -147,7 +162,7 @@ def _coerce_bool(v, n: int) -> np.ndarray:
     return v.astype(bool)
 
 
-def _eval3(e: Expr, df: pd.DataFrame):
+def _eval3(e: Expr, df: pd.DataFrame, memo=None):
     """Kleene three-valued evaluation of a boolean expression: returns
     (true_mask, unknown_mask).  A filter keeps only TRUE rows; the
     two-valued NULL->False coalescing `_eval` does is indistinguishable
@@ -158,7 +173,7 @@ def _eval3(e: Expr, df: pd.DataFrame):
     F = np.zeros(n, dtype=bool)
 
     if isinstance(e, E.BoolOp):
-        parts = [_eval3(x, df) for x in e.operands]
+        parts = [_eval3(x, df, memo) for x in e.operands]
         if e.op == "not":
             t, u = parts[0]
             return ~t & ~u, u
@@ -180,21 +195,34 @@ def _eval3(e: Expr, df: pd.DataFrame):
             if e.op in ("==", "!=") and not value_null:
                 # the parser's IS [NOT] NULL encoding — two-valued
                 other = e.right if lnull else e.left
-                isn = _null_rows(other, df)
+                isn = _null_rows(other, df, memo)
                 return (isn if e.op == "==" else ~isn), F
             # a genuine NULL comparison value: UNKNOWN for every row
             # (even rows whose operand is itself NULL)
             return F, ~F
-        u = _null_rows(e.left, df) | _null_rows(e.right, df)
+        u = _null_rows(e.left, df, memo) | _null_rows(e.right, df, memo)
         return _coerce_bool(_eval(e, df), n) & ~u, u
     if isinstance(e, E.InExpr):
         if not e.values:
             return F, F  # x IN () is FALSE for every x, even NULL x
-        u = _null_rows(e.operand, df)
-        return _coerce_bool(_eval(e, df), n) & ~u, u
+        vals = tuple(v for v in e.values if v is not None)
+        u_op = _null_rows(e.operand, df, memo)
+        if len(vals) != len(e.values):
+            # a literal NULL in the list: `x IN (..., NULL)` is TRUE for
+            # members and UNKNOWN for EVERYTHING else (same shape as the
+            # NULL-producing subquery rewrite) — even when the stripped
+            # list is empty (`x IN (NULL)` matches nothing, unknowably)
+            t = (
+                _coerce_bool(_eval(E.InExpr(e.operand, vals), df), n)
+                & ~u_op
+                if vals
+                else F
+            )
+            return t, ~t
+        return _coerce_bool(_eval(e, df), n) & ~u_op, u_op
     if isinstance(e, E.LikeExpr):
         # covers NOT LIKE too: a NULL operand is UNKNOWN either way
-        u = _null_rows(e.operand, df)
+        u = _null_rows(e.operand, df, memo)
         return _coerce_bool(_eval(e, df), n) & ~u, u
     if isinstance(e, E.Literal):
         if _is_null_lit(e):
@@ -210,7 +238,7 @@ def _eval3(e: Expr, df: pd.DataFrame):
 
 
 def _filter_mask(cond: Expr, df: pd.DataFrame) -> np.ndarray:
-    t, _ = _eval3(cond, df)
+    t, _ = _eval3(cond, df, memo={})
     return t
 
 
@@ -424,6 +452,12 @@ def _resolve_subqueries(e, catalog, bool_ctx: bool = False):
         Literal,
     )
 
+    if isinstance(
+        e, (InSubquery, E.ExistsSubquery, E.ScalarSubquery)
+    ) and getattr(e, "outer_refs", None):
+        # CORRELATED: cannot resolve to a constant — left in place for
+        # _materialize_correlated to evaluate per outer binding
+        return e
     if isinstance(e, InSubquery):
         vals, has_null = _run_in_subquery(e, catalog)
         operand = _resolve_subqueries(e.operand, catalog)
@@ -476,6 +510,156 @@ def _resolve_subqueries(e, catalog, bool_ctx: bool = False):
                 _resolve_subqueries(x, catalog, child_ctx) for x in v
             )
     return _dc.replace(e, **kw) if kw else e
+
+
+def _substitute_outer(stmt, binding):
+    """A correlated subquery's statement with its outer references bound:
+    every `Col(alias.col)` in `binding` becomes a Literal — or `_SubqNull`
+    for a NULL binding, so comparisons against it stay UNKNOWN (an inner
+    `WHERE s.k = <null binding>` matches nothing, per SQL)."""
+    import dataclasses as _dc
+
+    from ..plan.expr import Col, Expr, Literal
+
+    def conv(v):
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            return _SubqNull(None)
+        if isinstance(v, np.integer):
+            v = int(v)
+        elif isinstance(v, np.floating):
+            v = float(v)
+        elif isinstance(v, np.str_):
+            v = str(v)
+        return Literal(v)
+
+    from ..plan.expr import map_expr
+
+    def sub_e(e):
+        return map_expr(
+            e,
+            lambda x: conv(binding[x.name])
+            if isinstance(x, Col) and x.name in binding
+            else x,
+        )
+
+    return _dc.replace(
+        stmt,
+        items=[(n, sub_e(e)) for n, e in stmt.items],
+        where=sub_e(stmt.where) if stmt.where is not None else None,
+        having=sub_e(stmt.having) if stmt.having is not None else None,
+        group_by=[sub_e(e) for e in stmt.group_by],
+        order_by=[(sub_e(e), a) for e, a in stmt.order_by],
+    )
+
+
+def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
+    """Evaluate a correlated subquery for every row of the outer frame —
+    once per DISTINCT binding of its outer references (decorrelation by
+    grouping), joined back positionally.
+
+    Column contents by node type:
+    * InSubquery     -> object True / False / None (None = UNKNOWN — the
+      Kleene evaluator owns three-valued logic from there)
+    * ExistsSubquery -> bool
+    * ScalarSubquery -> the scalar per row (None -> NULL); all-numeric
+      results downcast to float64 so comparisons run vectorized
+    """
+    from ..sql.parser import Analyzer
+
+    refs = list(sub.outer_refs)
+    bare = [q.split(".", 1)[1] for q in refs]
+    missing = [b for b in bare if b not in df.columns]
+    if missing:
+        raise KeyError(
+            f"correlated subquery references outer columns {missing} "
+            "not present in the outer frame"
+        )
+    out = np.empty(len(df), dtype=object)
+    if isinstance(sub, E.InSubquery):
+        op_vals = np.asarray(_eval(sub.operand, df))
+        op_null = np.asarray(pd.isna(op_vals))
+    # .indices maps each distinct binding to POSITIONAL row indices
+    grouped = df.groupby(bare, dropna=False).indices
+    for key, ilocs in grouped.items():
+        tup = key if isinstance(key, tuple) else (key,)
+        binding = {
+            q: (None if pd.isna(v) else v) for q, v in zip(refs, tup)
+        }
+        stmt2 = _substitute_outer(sub.stmt, binding)
+        inner_lp = Analyzer(stmt2, dict(sub.aliases or ())).to_logical()
+        inner = execute_fallback(inner_lp, catalog)
+        if isinstance(sub, E.ExistsSubquery):
+            out[ilocs] = bool(len(inner))
+        elif isinstance(sub, E.ScalarSubquery):
+            if inner.shape[1] != 1:
+                raise ValueError(
+                    "scalar subquery must produce exactly one column"
+                )
+            if len(inner) > 1:
+                raise ValueError(
+                    f"scalar subquery produced {len(inner)} rows"
+                )
+            v = inner.iloc[0, 0] if len(inner) else None
+            if v is not None and pd.isna(v):
+                v = None
+            out[ilocs] = v
+        else:  # InSubquery
+            if inner.shape[1] != 1:
+                raise ValueError(
+                    "IN subquery must produce exactly one column"
+                )
+            col = inner.iloc[:, 0]
+            vals = set(pd.unique(col.dropna()))
+            has_null = bool(col.isna().any())
+            for i in ilocs:
+                if not op_null[i] and op_vals[i] in vals:
+                    out[i] = True
+                elif not vals and not has_null:
+                    out[i] = False  # IN over an EMPTY set: FALSE, even NULL
+                elif has_null or op_null[i]:
+                    out[i] = None  # UNKNOWN
+                else:
+                    out[i] = False
+    ser = pd.Series(out, index=df.index)
+    if isinstance(sub, E.ScalarSubquery):
+        if all(
+            v is None or isinstance(v, (int, float, np.number))
+            for v in out
+        ):
+            return ser.astype(np.float64)  # None -> NaN (NULL semantics)
+    return ser
+
+
+def _materialize_correlated(e, df: pd.DataFrame, catalog):
+    """Replace every CORRELATED subquery node in an expression with a
+    `Col` over a temp per-row column (see _correlated_column); returns
+    (expression, frame-with-temp-columns).  After this, the ordinary
+    two- and three-valued evaluators need no subquery knowledge."""
+    import itertools
+
+    from ..plan.expr import map_expr
+
+    if not isinstance(e, Expr):
+        return e, df
+    added = {}
+    counter = itertools.count()
+
+    def repl(x):
+        if isinstance(
+            x, (E.InSubquery, E.ExistsSubquery, E.ScalarSubquery)
+        ) and getattr(x, "outer_refs", None):
+            name = f"__csq{next(counter)}"
+            added[name] = _correlated_column(x, df, catalog)
+            return E.Col(name)
+        return x
+
+    e2 = map_expr(e, repl)
+    if not added:
+        return e, df
+    df2 = df.copy(deep=False)
+    for k, v in added.items():
+        df2[k] = v
+    return e2, df2
 
 
 def _resolve_plan_subqueries(lp: L.LogicalPlan, catalog) -> L.LogicalPlan:
@@ -661,11 +845,17 @@ def _exec(
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
-        return _apply_mask(df, _filter_mask(lp.condition, df))
+        cond, dfx = _materialize_correlated(lp.condition, df, catalog)
+        return _apply_mask(df, _filter_mask(cond, dfx))
     if isinstance(lp, L.Project):
         df = _exec(lp.child, catalog, _needed)
+
+        def proj(e):
+            e2, dfx = _materialize_correlated(e, df, catalog)
+            return _eval(e2, dfx)
+
         return pd.DataFrame(
-            {name: _eval(e, df) for name, e in lp.exprs},
+            {name: proj(e) for name, e in lp.exprs},
             index=df.index,
         )
     if isinstance(lp, L.Join):
@@ -727,12 +917,35 @@ def _exec(
             df = df[list(lp.columns)]
         return df
     if isinstance(lp, L.Aggregate):
-        return _aggregate(lp, _exec(lp.child, catalog, _needed))
+        df = _exec(lp.child, catalog, _needed)
+        # correlated subqueries inside aggregate args / FILTER clauses /
+        # group expressions bind per PRE-AGGREGATION row: materialize them
+        # against the child frame before grouping
+        import dataclasses as _dc
+
+        def mat(e):
+            nonlocal df
+            if e is None:
+                return None
+            e2, df = _materialize_correlated(e, df, catalog)
+            return e2
+
+        new_groups = tuple((n, mat(e)) for n, e in lp.group_exprs)
+        new_aggs = tuple(
+            _dc.replace(ae, arg=mat(ae.arg), filter=mat(ae.filter))
+            for ae in lp.agg_exprs
+        )
+        if (new_groups, new_aggs) != (lp.group_exprs, lp.agg_exprs):
+            lp = _dc.replace(lp, group_exprs=new_groups, agg_exprs=new_aggs)
+        return _aggregate(lp, df)
     if isinstance(lp, L.Having):
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
             return df
-        return _apply_mask(df, _filter_mask(_refs_to_cols(lp.condition), df))
+        cond, dfx = _materialize_correlated(
+            _refs_to_cols(lp.condition), df, catalog
+        )
+        return _apply_mask(df, _filter_mask(cond, dfx))
     if isinstance(lp, L.Sort):
         df = _exec(lp.child, catalog, _needed)
         if not len(df):
@@ -740,7 +953,10 @@ def _exec(
         tmp = []
         for i, k in enumerate(lp.keys):
             c = f"__sort{i}"
-            df = df.assign(**{c: _eval(_refs_to_cols(k.expr), df)})
+            ke, dfx = _materialize_correlated(
+                _refs_to_cols(k.expr), df, catalog
+            )
+            df = df.assign(**{c: _eval(ke, dfx)})
             tmp.append(c)
         df = df.sort_values(
             tmp,

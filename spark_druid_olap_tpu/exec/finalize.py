@@ -31,9 +31,11 @@ def finalize_timeseries(df, q: Q.TimeseriesQuery, ds: DataSource):
         if iv is not None:
             lo = min(a for a, _ in q.intervals) if q.intervals else iv[0]
             hi = max(b for _, b in q.intervals) if q.intervals else iv[1]
-            all_buckets = bucket_starts(lo, hi, q.granularity).astype(
-                "datetime64[ms]"
-            )
+            # interval ends are EXCLUSIVE: a bucket starting exactly at
+            # `hi` is outside the query (Druid emits no zero bucket there)
+            all_buckets = bucket_starts(
+                lo, max(lo, hi - 1), q.granularity
+            ).astype("datetime64[ms]")
             df = (
                 df.set_index(tcol)
                 .reindex(pd.Index(all_buckets, name=tcol))

@@ -353,12 +353,16 @@ class GroupByLowering:
         if not self.vcol_fns:
             return cols
         inputs = dict(cols)
-        for name, fn in self.vcol_fns.items():  # declaration order
+        # restore/save ALL physical shadows before any compute: a vcol
+        # declared before a later-declared shadow still reads the
+        # physical values on a second application
+        for name in self.shadowed_inputs:
             phys = "__phys__" + name
             if phys in cols:
                 inputs[name] = cols[phys]
-            elif name in cols and name in self.shadowed_inputs:
+            elif name in cols:
                 cols[phys] = cols[name]
+        for name, fn in self.vcol_fns.items():  # declaration order
             out = jnp.asarray(fn(inputs))
             cols[name] = out
             if name not in self.shadowed_inputs:

@@ -44,6 +44,12 @@ class QueryMetrics:
     distributed: bool = False
     mesh_shape: Optional[tuple] = None
     rows_scanned: int = 0
+    # bytes of segment data the query's kernel actually reads (needed
+    # columns x rows, incl. validity/time) — the roofline numerator:
+    # bytes_scanned / total_s vs the backend's measured streaming
+    # bandwidth (plan/calibrate.py `stream_bytes_per_s`) says how close
+    # the scan is to the memory-bound ceiling
+    bytes_scanned: int = 0
     segments: int = 0
     num_groups: int = 0
     h2d_bytes: int = 0
@@ -62,9 +68,16 @@ class QueryMetrics:
             return 0.0
         return self.rows_scanned / (self.total_ms / 1e3)
 
+    @property
+    def scan_bytes_per_sec(self) -> float:
+        if self.total_ms <= 0:
+            return 0.0
+        return self.bytes_scanned / (self.total_ms / 1e3)
+
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["rows_per_sec"] = round(self.rows_per_sec)
+        d["scan_bytes_per_sec"] = round(self.scan_bytes_per_sec)
         for k, v in list(d.items()):
             if isinstance(v, float):
                 d[k] = round(v, 3)

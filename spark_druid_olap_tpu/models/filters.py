@@ -47,13 +47,23 @@ class Selector(Filter):
 
 @dataclasses.dataclass(frozen=True)
 class InFilter(Filter):
-    """dimension IN (values) (Druid `in`)."""
+    """dimension IN (values) (Druid `in`).
+
+    `null_in_values` records that the ORIGINAL list contained a literal
+    NULL (stripped from `values`): a positive match set is unchanged, but
+    under Kleene evaluation every NON-member row is then UNKNOWN rather
+    than FALSE — which is what makes `NOT (x IN (..., NULL))` match
+    nothing at any negation depth (SQL three-valued semantics)."""
 
     dimension: str
     values: Tuple[str, ...]
+    null_in_values: bool = False
 
     def to_druid(self):
-        return {"type": "in", "dimension": self.dimension, "values": list(self.values)}
+        vals = list(self.values)
+        if self.null_in_values:
+            vals = vals + [None]
+        return {"type": "in", "dimension": self.dimension, "values": vals}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,7 +199,12 @@ def filter_from_druid(d: Dict[str, Any]) -> Filter:
     if t == "selector":
         return Selector(d["dimension"], d.get("value"))
     if t == "in":
-        return InFilter(d["dimension"], tuple(d["values"]))
+        vals = d["values"]
+        return InFilter(
+            d["dimension"],
+            tuple(v for v in vals if v is not None),
+            null_in_values=any(v is None for v in vals),
+        )
     if t == "bound":
         return Bound(
             d["dimension"],

@@ -301,6 +301,9 @@ class ScanQuery(QuerySpec):
     # silent drop — unsorted rows under LIMIT are wrong rows
     order_by: Tuple["OrderByColumnSpec", ...] = ()
     offset: int = 0
+    # Druid scan resultFormat: "list" (events as dicts) or "compactedList"
+    # (events as positional value arrays) — a WIRE-shape concern only
+    result_format: str = "list"
 
     def to_druid(self):
         d: Dict[str, Any] = {
@@ -309,6 +312,8 @@ class ScanQuery(QuerySpec):
             "columns": list(self.columns),
             "intervals": _ivs(self.intervals),
         }
+        if self.result_format != "list":
+            d["resultFormat"] = self.result_format
         if self.virtual_columns:
             d["virtualColumns"] = [v.to_druid() for v in self.virtual_columns]
         if self.filter is not None:

@@ -420,6 +420,7 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
             virtual_columns=vcols,
             order_by=order_by,
             offset=d.get("offset", 0),
+            result_format=d.get("resultFormat", "list"),
         )
     if qt == "search":
         filt, ivs, _, _, _ = _common(d)

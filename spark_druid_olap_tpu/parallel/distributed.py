@@ -327,7 +327,10 @@ class DistributedEngine:
             ),
         )
         # post-prune counts, matching the local engine's metrics semantics
+        from ..exec.engine import _bytes_scanned
+
         m.rows_scanned = sum(sg.num_rows for sg in scope)
+        m.bytes_scanned = _bytes_scanned(scope, lowering.columns)
         m.segments = len(scope)
         if len(self._shard_cache) > known:  # new shards were placed
             m.h2d_ms = (_time.perf_counter() - t0) * 1e3

@@ -118,10 +118,24 @@ def calibrate(
     except Exception:
         cost_per_row_sparse = None  # declined (overflow etc.): keep default
 
+    # measured streaming bandwidth: one read pass over a 64 MiB f32 array
+    # (a reduction — the memory-bound shape every scan kernel bottoms out
+    # at).  This is the ROOFLINE DENOMINATOR for
+    # QueryMetrics.bytes_scanned/s; "achieved", not a datasheet number.
+    big = jnp.asarray(rng.random(1 << 24).astype(np.float32))
+
+    @jax.jit
+    def stream(x):
+        return jnp.sum(x)
+
+    t_bw = _timeit(lambda: jax.block_until_ready(stream(big)))
+    stream_bytes_per_s = big.size * 4 / max(t_bw, 1e-9)
+
     out = {
         "cost_per_row_dense": cost_per_row_dense,
         "cost_per_row_scatter": cost_per_row_scatter,
         "cost_per_group_state": cost_per_group_state,
+        "stream_bytes_per_s": stream_bytes_per_s,
         "rows": rows,
         "groups": groups,
         "device": str(jax.devices()[0]),

@@ -92,9 +92,34 @@ class Expr:
         return tuple(dict.fromkeys(out))
 
 
+def map_expr(e, fn):
+    """Bottom-up structural map over an expression tree: children are
+    mapped first, the node is rebuilt, then `fn` transforms the result.
+    THE one place that knows how Expr dataclasses hold children (direct
+    Expr fields and tuples of Exprs) — rewriters use this instead of
+    hand-rolling the dataclass walk (review finding: three divergent
+    copies risk silently skipping nodes as the Expr vocabulary grows).
+    Opaque fields (e.g. a subquery's `stmt`) are not descended."""
+    if not isinstance(e, Expr):
+        return e
+    kw = {}
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expr):
+            kw[f.name] = map_expr(v, fn)
+        elif isinstance(v, tuple) and v and isinstance(v[0], Expr):
+            kw[f.name] = tuple(map_expr(x, fn) for x in v)
+    return fn(dataclasses.replace(e, **kw) if kw else e)
+
+
 def _collect_cols(e: Expr, out: list):
     if isinstance(e, Col):
         out.append(e.name)
+    refs = getattr(e, "outer_refs", None)
+    if refs:
+        # a correlated subquery reads OUTER columns: the outer plan must
+        # decode (and the planner must see) their bare names
+        out.extend(_outer_bare(refs))
     for f in dataclasses.fields(e):  # type: ignore[arg-type]
         v = getattr(e, f.name)
         if isinstance(v, Expr):
@@ -179,6 +204,12 @@ class InExpr(Expr):
         return f"({self.operand} in {self.values})"
 
 
+def _outer_bare(outer_refs) -> tuple:
+    """Bare outer column names a correlated subquery reads (its outer refs
+    are QUALIFIED, `alias.col`; the host frames carry bare names)."""
+    return tuple(q.split(".", 1)[1] for q in (outer_refs or ()))
+
+
 @dataclasses.dataclass(frozen=True, eq=True)
 class InSubquery(Expr):
     """`x IN (SELECT c FROM ...)` — a semi-join.  The device planner
@@ -186,14 +217,16 @@ class InSubquery(Expr):
     resolves the inner statement to a value set before evaluation, with
     three-valued NOT IN semantics when the set contains NULLs.  `stmt` is
     a sql.parser.SelectStmt (typed Any to keep plan/ independent of the
-    SQL layer)."""
+    SQL layer).  `outer_refs` (qualified outer columns) marks CORRELATION:
+    the fallback then evaluates per distinct outer binding."""
 
     operand: Expr
     stmt: Any
     aliases: Any = None  # alias->table mapping captured at parse time
+    outer_refs: Any = None  # tuple of "alias.col" correlation references
 
     def columns(self):
-        return self.operand.columns()
+        return tuple(self.operand.columns()) + _outer_bare(self.outer_refs)
 
     def __str__(self):
         return f"({self.operand} IN (<subquery>))"
@@ -201,14 +234,16 @@ class InSubquery(Expr):
 
 @dataclasses.dataclass(frozen=True, eq=True)
 class ExistsSubquery(Expr):
-    """Uncorrelated `EXISTS (SELECT ...)` — resolved by the host fallback
-    to a constant truth value (inner row count > 0)."""
+    """`EXISTS (SELECT ...)` — resolved by the host fallback to a truth
+    value: constant when uncorrelated, per distinct outer binding when
+    `outer_refs` is set."""
 
     stmt: Any
     aliases: Any = None
+    outer_refs: Any = None
 
     def columns(self):
-        return ()
+        return _outer_bare(self.outer_refs)
 
     def __str__(self):
         return "EXISTS(<subquery>)"
@@ -216,15 +251,16 @@ class ExistsSubquery(Expr):
 
 @dataclasses.dataclass(frozen=True, eq=True)
 class ScalarSubquery(Expr):
-    """`(SELECT agg FROM ...)` in expression position — resolved to a
-    Literal by the host fallback (one column; one row or zero rows ->
-    NULL).  `stmt` is a sql.parser.SelectStmt."""
+    """`(SELECT agg FROM ...)` in expression position — resolved by the
+    host fallback to a Literal (uncorrelated) or a per-row value column
+    (correlated; one column; zero rows -> NULL)."""
 
     stmt: Any
     aliases: Any = None
+    outer_refs: Any = None
 
     def columns(self):
-        return ()
+        return _outer_bare(self.outer_refs)
 
     def __str__(self):
         return "(<scalar subquery>)"

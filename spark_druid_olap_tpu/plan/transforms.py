@@ -205,6 +205,15 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
         name, val = l.name, r.value
         is_dim = name in ds.dicts
         is_string_dim = is_dim and ds.dicts[name].numeric_values is None
+        if val is None:
+            # the parser's IS [NOT] NULL encoding — valid for ANY column
+            # kind (numeric dictionaries included: round-3 fix, the old
+            # path stringified None into a dead lexicographic bound)
+            if op == "==":
+                return F.Selector(name, None)
+            if op == "!=":
+                return F.Not(F.Selector(name, None))
+            return None  # ordering vs NULL: residual (matches nothing)
         if isinstance(val, str) and not is_string_dim:
             # string literal against a numeric column/dictionary: coerce
             # (numeric string or ISO date -> epoch ms) so the Bound compiles
@@ -241,7 +250,14 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
         return None
     if isinstance(e, E.InExpr):
         if isinstance(e.operand, E.Col):
-            return F.InFilter(e.operand.name, tuple(str(v) for v in e.values))
+            # a literal NULL in the list never matches positively (x = NULL
+            # is UNKNOWN); the flag keeps Kleene evaluation exact under
+            # ANY negation depth (ops/filters.py _leaf_unknown)
+            return F.InFilter(
+                e.operand.name,
+                tuple(str(v) for v in e.values if v is not None),
+                null_in_values=any(v is None for v in e.values),
+            )
         return None
     if isinstance(e, E.LikeExpr):
         if isinstance(e.operand, E.Col):

@@ -101,11 +101,20 @@ def druid_result_shape(q: Q.QuerySpec, df) -> Any:
     if isinstance(q, Q.TopNQuery):
         return [{"timestamp": _result_timestamp(q), "result": _rows(df)}]
     if isinstance(q, Q.ScanQuery):
+        if q.result_format == "compactedList":
+            # Druid compactedList: events are POSITIONAL value arrays
+            # aligned with "columns", not keyed objects
+            events = [
+                [_jsonable(v) for v in row]
+                for row in df.itertuples(index=False)
+            ]
+        else:
+            events = _rows(df)
         return [
             {
                 "segmentId": q.datasource,
                 "columns": list(df.columns),
-                "events": _rows(df),
+                "events": events,
             }
         ]
     if isinstance(q, Q.SearchQuery):

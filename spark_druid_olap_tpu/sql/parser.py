@@ -102,6 +102,13 @@ class Parser:
         self.toks = tokenize(sql)
         self.i = 0
         self.aliases: Dict[str, str] = {}  # alias -> table
+        # alias names registered by the CURRENT select's FROM clause —
+        # needed for correlation scoping: an alias that exists in both the
+        # inner and an outer scope resolves INNER (SQL: innermost wins),
+        # which a dict-diff against the outer scope cannot see when the
+        # two registrations are identical (review-confirmed wrong-answer)
+        self._scopes: List[set] = []
+        self._last_scope: set = set()
 
     # -- token helpers -------------------------------------------------------
 
@@ -195,6 +202,13 @@ class Parser:
         return out
 
     def select(self) -> SelectStmt:
+        self._scopes.append(set())
+        try:
+            return self._select_body()
+        finally:
+            self._last_scope = self._scopes.pop()
+
+    def _select_body(self) -> SelectStmt:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         items: List[Tuple[Optional[str], E.Expr]] = []
@@ -267,9 +281,61 @@ class Parser:
             limit = int(self.next().value)
         if self.accept_kw("offset"):
             offset = int(self.next().value)
-        return SelectStmt(
-            items, table, where, group_by, group_mode, grouping_sets,
-            having, order_by, limit, offset, distinct=distinct,
+        return self._bind_correlation(
+            SelectStmt(
+                items, table, where, group_by, group_mode, grouping_sets,
+                having, order_by, limit, offset, distinct=distinct,
+            )
+        )
+
+    def _bind_correlation(self, stmt: SelectStmt) -> SelectStmt:
+        """Post-parse correlation marking.  SELECT items parse BEFORE the
+        FROM clause registers aliases, so a subquery in the select list
+        cannot know at its own parse time which qualifiers are outer —
+        re-scan every expression-position subquery node now that this
+        statement's full alias scope (self.aliases: this FROM plus any
+        enclosing scopes mid-parse) is known."""
+        import dataclasses as _dc
+
+        visible = dict(self.aliases)
+
+        def refs_of(node) -> tuple:
+            inner_vis = dict(node.aliases or ())
+            found = set(node.outer_refs or ())
+            exprs = [e for _, e in node.stmt.items]
+            exprs += node.stmt.group_by
+            exprs += [e for e, _ in node.stmt.order_by]
+            exprs += [
+                x for x in (node.stmt.where, node.stmt.having)
+                if x is not None
+            ]
+            for e in exprs:
+                for c in e.columns():
+                    if "." in c:
+                        q = c.split(".", 1)[0]
+                        if q not in inner_vis and q in visible:
+                            found.add(c)
+            return tuple(sorted(found))
+
+        def _mark(e):
+            if isinstance(
+                e, (E.InSubquery, E.ExistsSubquery, E.ScalarSubquery)
+            ):
+                refs = refs_of(e)
+                if refs != tuple(e.outer_refs or ()):
+                    return _dc.replace(e, outer_refs=refs or None)
+            return e
+
+        def fix(e):
+            return E.map_expr(e, _mark)
+
+        return _dc.replace(
+            stmt,
+            items=[(n, fix(e)) for n, e in stmt.items],
+            where=fix(stmt.where) if stmt.where is not None else None,
+            having=fix(stmt.having) if stmt.having is not None else None,
+            group_by=[fix(e) for e in stmt.group_by],
+            order_by=[(fix(e), a) for e, a in stmt.order_by],
         )
 
     def _expr_list(self) -> List[E.Expr]:
@@ -280,15 +346,22 @@ class Parser:
 
     def _parse_subselect(self):
         """Parse a nested (SELECT ...) with alias isolation: the inner
-        FROM's aliases must not leak into or clobber the outer scope, and
-        qualified references to OUTER tables inside the inner statement
-        (correlation) are rejected rather than silently resolved against
-        the wrong table.  Returns (stmt, inner-visible alias items)."""
+        FROM's aliases must not leak into or clobber the outer scope.
+        QUALIFIED references to OUTER tables inside the inner statement
+        are correlation — collected and returned so the subquery node can
+        carry them (the host fallback evaluates correlated subqueries per
+        distinct outer binding); unqualified names still resolve inner
+        only.  Returns (stmt, inner-visible alias items, outer_refs)."""
         saved = dict(self.aliases)
         inner = self.select()
         after = dict(self.aliases)
         self.aliases = saved
-        inner_vis = {k: v for k, v in after.items() if saved.get(k) != v}
+        # the inner statement's OWN aliases (from its FROM clause, via the
+        # scope stack): a name registered by BOTH scopes resolves INNER —
+        # a dict diff would miss identical registrations (same table, same
+        # alias) and misread a self-reference as correlation
+        inner_vis = {k: after[k] for k in self._last_scope if k in after}
+        outer_refs = set()
         for _, e in list(inner.items) + [
             (None, x) for x in inner.group_by
         ] + [(None, x) for x, _ in inner.order_by] + [
@@ -300,15 +373,21 @@ class Parser:
                 if "." in c:
                     q = c.split(".", 1)[0]
                     if q not in inner_vis and q in saved:
-                        raise ParseError(
-                            "correlated subqueries are unsupported"
-                        )
-        return inner, tuple(sorted(inner_vis.items()))
+                        outer_refs.add(c)
+        return inner, tuple(sorted(inner_vis.items())), tuple(
+            sorted(outer_refs)
+        )
 
     def table_ref(self):
         if self.accept_op("("):
-            # derived table: FROM (SELECT ...) [AS] alias
-            inner, inner_vis = self._parse_subselect()
+            # derived table: FROM (SELECT ...) [AS] alias — correlation is
+            # not valid SQL here (that would be LATERAL)
+            inner, inner_vis, outer_refs = self._parse_subselect()
+            if outer_refs:
+                raise ParseError(
+                    "derived tables cannot reference outer aliases "
+                    f"({', '.join(outer_refs)}): LATERAL is unsupported"
+                )
             self.expect_op(")")
             has_as = self.accept_kw("as")
             if not has_as and self.peek().kind != "IDENT":
@@ -317,6 +396,8 @@ class Parser:
                 raise ParseError("derived table requires an alias")
             alias = self.expect_ident()
             self.aliases[alias] = alias
+            if self._scopes:
+                self._scopes[-1].add(alias)
             if self.peek().kind == "KW" and self.peek().value.lower() in (
                 "join", "inner", "left"
             ):
@@ -328,6 +409,8 @@ class Parser:
         if t.kind == "IDENT":
             alias = self.expect_ident()
         self.aliases[alias or name] = name
+        if self._scopes:
+            self._scopes[-1].add(alias or name)
         node: Any = name
         while True:
             how = None
@@ -346,6 +429,8 @@ class Parser:
             if self.peek().kind == "IDENT":
                 ralias = self.expect_ident()
             self.aliases[ralias or rname] = rname
+            if self._scopes:
+                self._scopes[-1].add(ralias or rname)
             self.expect_kw("on")
             on: List[Tuple[str, str]] = []
             while True:
@@ -386,12 +471,14 @@ class Parser:
         if self.accept_kw("not"):
             return E.BoolOp("not", (self._not(),))
         if self.accept_kw("exists"):
-            # uncorrelated EXISTS (SELECT ...): the fallback resolves it to
-            # a constant row-count check (correlation rejected at parse)
+            # EXISTS (SELECT ...): the fallback resolves it to a constant
+            # row-count check, or per outer binding when correlated
             self.expect_op("(")
-            inner, inner_vis = self._parse_subselect()
+            inner, inner_vis, outer_refs = self._parse_subselect()
             self.expect_op(")")
-            return E.ExistsSubquery(inner, inner_vis)
+            return E.ExistsSubquery(
+                inner, inner_vis, outer_refs=outer_refs or None
+            )
         return self._cmp()
 
     def _cmp(self) -> E.Expr:
@@ -422,13 +509,15 @@ class Parser:
                 self.peek().kind == "KW"
                 and self.peek().value.lower() == "select"
             ):
-                inner, inner_vis = self._parse_subselect()
+                inner, inner_vis, outer_refs = self._parse_subselect()
                 self.expect_op(")")
                 if len(inner.items) != 1:
                     raise ParseError(
                         "IN subquery must select exactly one column"
                     )
-                e: E.Expr = E.InSubquery(left, inner, inner_vis)
+                e: E.Expr = E.InSubquery(
+                    left, inner, inner_vis, outer_refs=outer_refs or None
+                )
                 return E.BoolOp("not", (e,)) if negated else e
             vals = []
             while True:
@@ -556,14 +645,17 @@ class Parser:
                 and self.peek().value.lower() == "select"
             ):
                 # scalar subquery: (SELECT max(v) FROM t ...) — resolved to
-                # a literal by the host fallback executor
-                inner, inner_vis = self._parse_subselect()
+                # a literal (or a per-outer-binding column when correlated)
+                # by the host fallback executor
+                inner, inner_vis, outer_refs = self._parse_subselect()
                 self.expect_op(")")
                 if len(inner.items) != 1:
                     raise ParseError(
                         "scalar subquery must select exactly one column"
                     )
-                return E.ScalarSubquery(inner, inner_vis)
+                return E.ScalarSubquery(
+                    inner, inner_vis, outer_refs=outer_refs or None
+                )
             e = self.expr()
             self.expect_op(")")
             return e

@@ -105,9 +105,25 @@ def _geo(n: int, rng) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 
 def gen_tables(scale: float = 0.01, seed: int = 7) -> Dict[str, Dict[str, np.ndarray]]:
     """Normalized SSB star at ~SF `scale` (SF1: 6M lineorder rows).  Keys are
-    dense 0..n-1 so the pre-join is a direct gather."""
-    rng = np.random.default_rng(seed)
+    dense 0..n-1 so the pre-join is a direct gather.
 
+    Materializes the WHOLE fact host-side — use at test scales.  Large
+    scale factors go through `flat_chunks`/`register_streamed`, which
+    generate and encode the fact chunk-by-chunk."""
+    rng = np.random.default_rng(seed)
+    out = gen_dim_tables(scale, rng)
+    n_c = len(out["customer"]["c_custkey"])
+    n_s = len(out["supplier"]["s_suppkey"])
+    n_p = len(out["part"]["p_partkey"])
+    out["lineorder"] = _gen_fact(
+        int(6_000_000 * scale), rng, out["dwdate"]["d_datekey"], n_c, n_s, n_p
+    )
+    return out
+
+
+def gen_dim_tables(scale: float, rng) -> Dict[str, Dict[str, np.ndarray]]:
+    """The four SSB dimension tables (small at any scale factor; SF100
+    customer is 3M rows — the fact is what needs streaming)."""
     # dwdate: one row per calendar day 1992-01-01 .. 1998-12-31
     d0 = np.datetime64("1992-01-01")
     days = np.arange(d0, np.datetime64("1999-01-01"), dtype="datetime64[D]")
@@ -153,14 +169,19 @@ def gen_tables(scale: float = 0.01, seed: int = 7) -> Dict[str, Dict[str, np.nda
         "p_category": np.asarray(category, dtype=object),
         "p_brand1": np.asarray(brand, dtype=object),
     }
+    return {
+        "dwdate": dwdate, "customer": customer,
+        "supplier": supplier, "part": part,
+    }
 
-    n = int(6_000_000 * scale)
-    date_idx = rng.integers(0, len(days), size=n)
+
+def _gen_fact(n: int, rng, datekeys, n_c: int, n_s: int, n_p: int):
+    date_idx = rng.integers(0, len(datekeys), size=n)
     quantity = rng.integers(1, 51, size=n).astype(np.float32)
     extendedprice = rng.random(n).astype(np.float32) * 55_450 + 90
     discount = rng.integers(0, 11, size=n).astype(np.float32)
-    lineorder = {
-        "lo_orderdate": dwdate["d_datekey"][date_idx],
+    return {
+        "lo_orderdate": np.asarray(datekeys)[date_idx],
         "lo_custkey": rng.integers(0, n_c, size=n).astype(np.int64),
         "lo_suppkey": rng.integers(0, n_s, size=n).astype(np.int64),
         "lo_partkey": rng.integers(0, n_p, size=n).astype(np.int64),
@@ -170,18 +191,53 @@ def gen_tables(scale: float = 0.01, seed: int = 7) -> Dict[str, Dict[str, np.nda
         "lo_revenue": extendedprice * (1 - discount / 100),
         "lo_supplycost": extendedprice * 0.6,
     }
-    return {
-        "lineorder": lineorder, "dwdate": dwdate, "customer": customer,
-        "supplier": supplier, "part": part,
-    }
+
+
+def _fk_row_index(lo, fk_col: str, table: str, dwdate) -> np.ndarray:
+    fk = lo[fk_col]
+    if table == "dwdate":
+        base = int(dwdate["d_datekey"][0])
+        return ((fk - base) // _MS_DAY).astype(np.int64)
+    return fk.astype(np.int64)  # dense 0..n-1 keys
 
 
 def _dim_row_index(tables, fk_col: str, table: str) -> np.ndarray:
-    fk = tables["lineorder"][fk_col]
-    if table == "dwdate":
-        base = int(tables["dwdate"]["d_datekey"][0])
-        return ((fk - base) // _MS_DAY).astype(np.int64)
-    return fk.astype(np.int64)  # dense 0..n-1 keys
+    return _fk_row_index(
+        tables["lineorder"], fk_col, table, tables["dwdate"]
+    )
+
+
+def _attr_dicts(tables) -> Dict[str, Tuple[DimensionDict, np.ndarray]]:
+    """Per flat attribute: (dictionary, encoded dim-table codes) — built on
+    the SMALL dimension tables once; fact rows gather through the FK."""
+    out: Dict[str, Tuple[DimensionDict, np.ndarray]] = {}
+    for attr, (table, _) in DIM_ATTRS.items():
+        vals = tables[table][attr]
+        if vals.dtype.kind in ("U", "S", "O"):
+            d = DimensionDict.build(list(vals))
+            dim_codes = d.encode(list(vals))
+        else:
+            uniq = np.unique(vals.astype(np.int64))
+            d = DimensionDict(values=tuple(int(v) for v in uniq))
+            dim_codes = d.encode_numeric(vals)
+        out[attr] = (d, dim_codes)
+    return out
+
+
+def _flat_chunk(lo, tables, attr_dicts) -> Dict[str, np.ndarray]:
+    """One chunk of fact rows -> flat encoded columns (gathers only)."""
+    cols: Dict[str, np.ndarray] = {
+        "lo_orderdate": lo["lo_orderdate"],
+        **{m: lo[m] for m in FLAT_METRICS},
+    }
+    idx_cache: Dict[str, np.ndarray] = {}
+    for attr, (table, fk_col) in DIM_ATTRS.items():
+        if table not in idx_cache:
+            idx_cache[table] = _fk_row_index(
+                lo, fk_col, table, tables["dwdate"]
+            )
+        cols[attr] = attr_dicts[attr][1][idx_cache[table]]
+    return cols
 
 
 def flat_columns(tables) -> Tuple[Dict[str, np.ndarray], Dict[str, DimensionDict]]:
@@ -192,28 +248,67 @@ def flat_columns(tables) -> Tuple[Dict[str, np.ndarray], Dict[str, DimensionDict
     6M strings.  Returns (columns, dicts) for build_datasource; string-dict
     columns arrive pre-encoded (see the build_datasource caller contract).
     """
-    lo = tables["lineorder"]
-    cols: Dict[str, np.ndarray] = {
-        "lo_orderdate": lo["lo_orderdate"],
-        **{m: lo[m] for m in FLAT_METRICS},
-    }
-    dicts: Dict[str, DimensionDict] = {}
-    row_idx_cache: Dict[str, np.ndarray] = {}
-    for attr, (table, fk_col) in DIM_ATTRS.items():
-        vals = tables[table][attr]
-        if table not in row_idx_cache:
-            row_idx_cache[table] = _dim_row_index(tables, fk_col, table)
-        idx = row_idx_cache[table]
-        if vals.dtype.kind in ("U", "S", "O"):
-            d = DimensionDict.build(list(vals))
-            dim_codes = d.encode(list(vals))
-        else:
-            uniq = np.unique(vals.astype(np.int64))
-            d = DimensionDict(values=tuple(int(v) for v in uniq))
-            dim_codes = d.encode_numeric(vals)
-        dicts[attr] = d
-        cols[attr] = dim_codes[idx]
-    return cols, dicts
+    ad = _attr_dicts(tables)
+    cols = _flat_chunk(tables["lineorder"], tables, ad)
+    return cols, {attr: d for attr, (d, _) in ad.items()}
+
+
+def fact_chunks(scale: float, seed: int, chunk_rows: int, tables):
+    """Generator of lineorder chunks at SF `scale` without ever holding the
+    full fact: chunk i draws from its own deterministic stream
+    default_rng((seed, SSB_FACT_STREAM, i)), so any chunk is reproducible
+    independently (the chunked ORACLE regenerates the same rows)."""
+    n_c = len(tables["customer"]["c_custkey"])
+    n_s = len(tables["supplier"]["s_suppkey"])
+    n_p = len(tables["part"]["p_partkey"])
+    datekeys = tables["dwdate"]["d_datekey"]
+    n = int(6_000_000 * scale)
+    ci = 0
+    for start in range(0, n, chunk_rows):
+        rows = min(chunk_rows, n - start)
+        rng = np.random.default_rng((seed, _FACT_STREAM, ci))
+        yield _gen_fact(rows, rng, datekeys, n_c, n_s, n_p)
+        ci += 1
+
+
+_FACT_STREAM = 90_001  # spawn-key tag separating fact chunks from dim draws
+
+
+def flat_chunks(scale: float, seed: int, chunk_rows: int):
+    """The large-SF ingest pipeline: (dim_tables, dicts, iterator of flat
+    encoded column chunks).  Peak host memory is one chunk."""
+    tables = gen_dim_tables(scale, np.random.default_rng(seed))
+    ad = _attr_dicts(tables)
+    dicts = {attr: d for attr, (d, _) in ad.items()}
+
+    def chunks():
+        for lo in fact_chunks(scale, seed, chunk_rows, tables):
+            yield _flat_chunk(lo, tables, ad)
+
+    return tables, dicts, chunks()
+
+
+def register_streamed(ctx, scale: float, seed: int = 7,
+                      rows_per_segment: int = 1 << 22,
+                      chunk_rows: int = 1 << 22):
+    """Register the SSB star at a LARGE scale factor: the fact is
+    generated, encoded, and segmented chunk-by-chunk
+    (catalog.segment.build_datasource_streamed), never materialized whole.
+    Returns the dimension tables (for oracle use)."""
+    from ..catalog.segment import build_datasource_streamed
+
+    tables, dicts, chunks = flat_chunks(scale, seed, chunk_rows)
+    ds = build_datasource_streamed(
+        "lineorder", chunks,
+        dimension_cols=FLAT_DIMS, metric_cols=FLAT_METRICS,
+        time_col="lo_orderdate",
+        rows_per_segment=rows_per_segment, dicts=dicts,
+    )
+    ctx.register_datasource(ds, star_schema=STAR_SCHEMA)
+    ctx.register_table("dwdate", tables["dwdate"], time_column="d_datekey")
+    for t in ("customer", "supplier", "part"):
+        ctx.register_table(t, tables[t])
+    return tables
 
 
 def register(ctx, scale: float = 0.01, seed: int = 7,
@@ -349,12 +444,11 @@ QUERIES: Dict[str, str] = {
 # ---------------------------------------------------------------------------
 
 
-def flat_frame(tables):
-    """Decoded flat pandas DataFrame for oracle computation (string attrs
-    materialized — use at test scales only)."""
+def flat_frame_chunk(tables, lo):
+    """Decoded flat pandas frame for ONE fact chunk (the chunked-oracle
+    unit; string attrs materialize only chunk-wide)."""
     import pandas as pd
 
-    lo = tables["lineorder"]
     data = {
         "lo_orderdate": lo["lo_orderdate"],
         **{m: np.asarray(lo[m], dtype=np.float64) for m in FLAT_METRICS},
@@ -362,9 +456,31 @@ def flat_frame(tables):
     idx_cache: Dict[str, np.ndarray] = {}
     for attr, (table, fk_col) in DIM_ATTRS.items():
         if table not in idx_cache:
-            idx_cache[table] = _dim_row_index(tables, fk_col, table)
+            idx_cache[table] = _fk_row_index(
+                lo, fk_col, table, tables["dwdate"]
+            )
         data[attr] = np.asarray(tables[table][attr])[idx_cache[table]]
     return pd.DataFrame(data)
+
+
+def flat_frame(tables):
+    """Decoded flat pandas DataFrame for oracle computation (string attrs
+    materialized — use at test scales only)."""
+    return flat_frame_chunk(tables, tables["lineorder"])
+
+
+def merge_oracle_parts(parts):
+    """Merge per-chunk `oracle` results into the full-table result.  Sound
+    because every SSB aggregate is a SUM (scalar or grouped): partials
+    concatenate and re-sum by the group columns."""
+    import pandas as pd
+
+    if isinstance(parts[0], float):
+        return float(sum(parts))
+    df = pd.concat(parts, ignore_index=True)
+    vcol = df.columns[-1]  # oracle puts the measure last
+    g = [c for c in df.columns if c != vcol]
+    return df.groupby(g, as_index=False)[vcol].sum()
 
 
 def oracle(f, name: str):

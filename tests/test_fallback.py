@@ -469,14 +469,18 @@ def test_scalar_subquery_zero_rows_matches_nothing(ctx):
     assert int(got["n"].iloc[0]) == 0
 
 
-def test_correlated_subquery_rejected(ctx):
-    from spark_druid_olap_tpu.sql.parser import ParseError
-
-    with pytest.raises(ParseError, match="correlated"):
-        ctx.sql(
-            "SELECT count(*) AS n FROM fact f "
-            "WHERE k IN (SELECT ok FROM other WHERE f.v > 10)"
-        )
+def test_correlated_in_subquery(ctx):
+    """Round 2 rejected correlation at parse; round 3 executes it per
+    distinct outer binding (VERDICT r2 #6)."""
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM fact f "
+        "WHERE k IN (SELECT ok FROM other WHERE f.v > 10)"
+    )
+    f = _fact_frame(ctx)
+    # binding v: subquery returns ALL ok values when v > 10, else none;
+    # k < 50 always -> rows with v > 10 qualify
+    want = int(((f.v > 10) & (f.k < 50)).sum())
+    assert int(got["n"].iloc[0]) == want
 
 
 def test_inner_alias_collision_does_not_leak(ctx):
@@ -664,3 +668,202 @@ def test_in_subquery_with_nulls_in_select_position():
         "SELECT k, k IN (SELECT j FROM sn) AS b FROM sv ORDER BY k"
     )
     assert [bool(x) for x in got["b"]] == [False, True, False, True, False]
+
+
+def test_not_in_literal_null_list():
+    """Review finding: a literal NULL in an IN list — `k NOT IN (1, NULL)`
+    matches NOTHING (non-members are UNKNOWN), and `k IN (NULL)` too."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "ln",
+        {"k": np.arange(5, dtype=np.int64)},
+        dimensions=["k"],
+    )
+    c.register_table(
+        "lj", {"y": np.arange(5, dtype=np.int64)}, dimensions=["y"]
+    )
+    # route through fallback via the join
+    got = c.sql(
+        "SELECT count(*) AS n FROM ln JOIN lj ON k = y "
+        "WHERE k NOT IN (1, NULL)"
+    )
+    assert int(got["n"].iloc[0]) == 0
+    got2 = c.sql(
+        "SELECT count(*) AS n FROM ln JOIN lj ON k = y "
+        "WHERE k IN (1, NULL)"
+    )
+    assert int(got2["n"].iloc[0]) == 1  # only the member
+
+
+# --------------------------------------------------------------------------
+# Correlated subqueries (VERDICT r2 #6): evaluated per distinct outer
+# binding; every case is checked against a pandas oracle on the same data.
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corr():
+    c = sd.TPUOlapContext()
+    rng = np.random.default_rng(11)
+    n = 800
+    c.register_table(
+        "orders",
+        {
+            "o_key": np.arange(n, dtype=np.int64),
+            "o_cust": rng.integers(0, 40, n),
+            "o_amt": (rng.random(n) * 100).astype(np.float32),
+        },
+        dimensions=["o_key", "o_cust"],
+        metrics=["o_amt"],
+    )
+    m = 40
+    c.register_table(
+        "cust",
+        {
+            "c_key": np.arange(m, dtype=np.int64),
+            "c_tier": rng.choice(
+                np.array(["gold", "silver", None], dtype=object), m
+            ),
+        },
+        dimensions=["c_key", "c_tier"],
+    )
+    odf = pd.DataFrame(
+        {
+            "o_key": np.arange(n),
+            "o_cust": np.asarray(
+                c.catalog.get("orders").dicts["o_cust"].decode(
+                    np.concatenate(
+                        [
+                            np.asarray(s.dims["o_cust"])[s.valid]
+                            for s in c.catalog.get("orders").segments
+                        ]
+                    )
+                )
+            ).astype(np.int64),
+            "o_amt": np.concatenate(
+                [
+                    np.asarray(s.metrics["o_amt"], np.float64)[s.valid]
+                    for s in c.catalog.get("orders").segments
+                ]
+            ),
+        }
+    )
+    cdf = pd.DataFrame(
+        {
+            "c_key": np.arange(m),
+            "c_tier": [
+                c.catalog.get("cust").dicts["c_tier"].decode(
+                    np.asarray(s.dims["c_tier"])
+                )[i]
+                for s in c.catalog.get("cust").segments
+                for i in range(s.num_rows)
+            ],
+        }
+    )
+    return c, odf, cdf
+
+
+def test_correlated_exists(corr):
+    c, odf, cdf = corr
+    got = c.sql(
+        "SELECT count(*) AS n FROM cust c WHERE EXISTS "
+        "(SELECT o_key FROM orders WHERE o_cust = c.c_key AND o_amt > 95)"
+    )
+    hot = set(odf[odf.o_amt > 95].o_cust)
+    want = int(cdf.c_key.isin(hot).sum())
+    assert int(got["n"].iloc[0]) == want
+    # NOT EXISTS is the Kleene complement (EXISTS is never UNKNOWN)
+    got2 = c.sql(
+        "SELECT count(*) AS n FROM cust c WHERE NOT EXISTS "
+        "(SELECT o_key FROM orders WHERE o_cust = c.c_key AND o_amt > 95)"
+    )
+    assert int(got2["n"].iloc[0]) == len(cdf) - want
+
+
+def test_correlated_scalar_in_where(corr):
+    c, odf, cdf = corr
+    got = c.sql(
+        "SELECT count(*) AS n FROM orders o WHERE o_amt > "
+        "(SELECT avg(o_amt) FROM orders WHERE o_cust = o.o_cust)"
+    )
+    means = odf.groupby("o_cust").o_amt.transform("mean")
+    want = int((odf.o_amt > means).sum())
+    assert int(got["n"].iloc[0]) == want
+
+
+def test_correlated_scalar_in_select(corr):
+    c, odf, cdf = corr
+    got = c.sql(
+        "SELECT c_key, (SELECT count(*) FROM orders "
+        "WHERE o_cust = c.c_key) AS cnt FROM cust c ORDER BY c_key"
+    )
+    counts = odf.groupby("o_cust").size()
+    for _, r in got.iterrows():
+        want = int(counts.get(int(r["c_key"]), 0))
+        assert int(r["cnt"]) == want
+
+
+def test_correlated_in_with_null_binding(corr):
+    """A NULL outer binding makes the inner equality UNKNOWN -> the
+    subquery returns no rows for that binding."""
+    c, odf, cdf = corr
+    got = c.sql(
+        "SELECT count(*) AS n FROM cust c WHERE EXISTS "
+        "(SELECT c_key FROM cust WHERE c_tier = c.c_tier)"
+    )
+    # rows with NULL c_tier: inner `c_tier = NULL` matches nothing
+    want = int(cdf.c_tier.notna().sum())
+    assert int(got["n"].iloc[0]) == want
+
+
+def test_correlated_scalar_null_result_under_not(corr):
+    """Empty per-binding scalar -> NULL -> comparisons UNKNOWN, also under
+    NOT (ties the correlation machinery into the Kleene evaluator)."""
+    c, odf, cdf = corr
+    got = c.sql(
+        "SELECT count(*) AS n FROM cust c WHERE NOT (1 < "
+        "(SELECT max(o_amt) FROM orders "
+        "WHERE o_cust = c.c_key AND o_amt > 1000))"
+    )
+    assert int(got["n"].iloc[0]) == 0  # every binding yields NULL
+
+
+def test_two_level_correlation_errors_clearly(corr):
+    """Correlation that crosses TWO subquery levels is unsupported — it
+    must error (unknown column in the innermost scope), never silently
+    mis-bind."""
+    c, _, _ = corr
+    with pytest.raises(Exception):
+        c.sql(
+            "SELECT count(*) AS n FROM cust c WHERE EXISTS "
+            "(SELECT o_key FROM orders WHERE o_cust IN "
+            "(SELECT o_cust FROM orders WHERE o_amt > c.c_key))"
+        )
+
+
+def test_self_reference_is_not_correlation():
+    """Review finding: a subquery's qualified reference to its OWN table
+    (same name registered in BOTH scopes) resolves INNER — it must not be
+    misread as correlation."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "t",
+        {"a": np.array([5, 5], dtype=np.int64),
+         "b": np.array([9, 1], dtype=np.int64)},
+        dimensions=["a", "b"],
+    )
+    c.register_table(
+        "u", {"x": np.array([5], dtype=np.int64)}, dimensions=["x"]
+    )
+    got = c.sql(
+        "SELECT count(*) AS n FROM t "
+        "WHERE a IN (SELECT a FROM t WHERE t.b = 1)"
+    )
+    # inner set = {5}; BOTH outer rows match (b plays no outer role)
+    assert int(got["n"].iloc[0]) == 2
+    # sanity: genuine correlation with the same shape still works
+    got2 = c.sql(
+        "SELECT count(*) AS n FROM t o "
+        "WHERE EXISTS (SELECT x FROM u WHERE x = o.a AND o.b = 1)"
+    )
+    assert int(got2["n"].iloc[0]) == 1

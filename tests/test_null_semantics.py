@@ -1,0 +1,57 @@
+"""SQL three-valued NULL semantics on the DEVICE path (ops/filters.py
+compile_filter3 + plan/transforms translation): every `NOT`-shaped
+predicate over a NULL-holding dimension must EXCLUDE the NULL rows
+(NOT UNKNOWN = UNKNOWN), and IS NULL works on every column kind.
+Round-3 fix: the 2-valued compile counted NULL rows under any Not."""
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "t",
+        {
+            "k": np.array([1, 2, None], dtype=object),   # numeric dict
+            "s": np.array(["a", "b", None], dtype=object),  # string dict
+            "v": np.arange(3, dtype=np.float32),
+        },
+        dimensions=["k", "s"],
+        metrics=["v"],
+    )
+    return c
+
+
+CASES = [
+    # positives: nulls never match
+    ("k < 3", 2), ("k <= 2", 2), ("k > 0", 2), ("k = 1", 1),
+    # negations over numeric-dict dims
+    ("k <> 1", 1), ("NOT (k > 1)", 1), ("NOT (k < 2)", 1),
+    ("NOT (k = 1)", 1), ("k NOT IN (1)", 1),
+    # negations over string dims
+    ("s <> 'a'", 1), ("NOT (s = 'a')", 1), ("NOT (s > 'a')", 1),
+    ("s NOT IN ('a')", 1),
+    # compound Kleene
+    ("NOT (k IN (1) AND k > 0)", 1),
+    ("NOT (s = 'a' OR k = 2)", 0),
+    ("s = 'a' OR NOT (k = 1)", 2),
+    # literal NULL in IN lists — at ANY negation depth (the InFilter
+    # null_in_values flag keeps the Kleene leaf exact)
+    ("k IN (1, NULL)", 1), ("k NOT IN (1, NULL)", 0),
+    ("NOT (s = 'a' AND k IN (1, NULL))", 1),
+    ("NOT (NOT (k IN (1, NULL)))", 1),
+    # IS NULL on every dimension kind (numeric dict was dead pre-round-3)
+    ("k IS NULL", 1), ("k IS NOT NULL", 2), ("NOT (k IS NULL)", 2),
+    ("s IS NULL", 1), ("s IS NOT NULL", 2),
+]
+
+
+@pytest.mark.parametrize("cond,want", CASES)
+def test_device_kleene(ctx, cond, want):
+    got = ctx.sql(f"SELECT count(*) AS n FROM t WHERE {cond}")
+    assert int(got["n"].iloc[0]) == want, cond
+    assert ctx.last_metrics.executor == "device"
