@@ -94,13 +94,28 @@ def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
     column's stats would silently drop live segments — skip those."""
     from ..models import filters as F
 
-    conjuncts = (
-        list(filt.fields) if isinstance(filt, F.And) else [filt]
-    )
+    def _conjuncts(f):
+        # the planner builds Ands pairwise (And(And(a, b), c)): flatten
+        # recursively or buried conjuncts never get a pruning look
+        if isinstance(f, F.And):
+            out = []
+            for x in f.fields:
+                out.extend(_conjuncts(x))
+            return out
+        return [f]
+
+    conjuncts = _conjuncts(filt)
 
     def excluded(seg, c) -> bool:
         if getattr(c, "dimension", None) in vcol_names:
             return False
+        if isinstance(c, F.Or):
+            # a disjunction can only match if SOME disjunct can
+            return bool(c.fields) and all(
+                excluded(seg, x) for x in c.fields
+            )
+        if isinstance(c, F.And):
+            return any(excluded(seg, x) for x in c.fields)
         st = seg.stats or {}
         if isinstance(c, F.Selector):
             if c.value is None or c.dimension not in ds.dicts:

@@ -586,6 +586,11 @@ def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
             q: (None if pd.isna(v) else v) for q, v in zip(refs, tup)
         }
         stmt2 = _substitute_outer(sub.stmt, binding)
+        if isinstance(sub, E.ExistsSubquery):
+            # existence only needs the first row
+            import dataclasses as _dc
+
+            stmt2 = _dc.replace(stmt2, limit=1, offset=0)
         inner_lp = Analyzer(stmt2, dict(sub.aliases or ())).to_logical()
         inner = execute_fallback(inner_lp, catalog)
         if isinstance(sub, E.ExistsSubquery):
@@ -622,12 +627,22 @@ def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
                     out[i] = False
     ser = pd.Series(out, index=df.index)
     if isinstance(sub, E.ScalarSubquery):
-        if all(
-            v is None or isinstance(v, (int, float, np.number))
-            for v in out
-        ):
-            return ser.astype(np.float64)  # None -> NaN (NULL semantics)
+        nn = [v for v in out if v is not None]
+        if nn and all(isinstance(v, (int, float, np.number)) for v in nn):
+            # float64 vectorizes comparisons and None -> NaN carries NULL
+            # semantics — but only when it is EXACT: int64 values at or
+            # above 2^53 would round and silently match wrong rows
+            if all(
+                isinstance(v, (float, np.floating)) or abs(int(v)) < (1 << 53)
+                for v in nn
+            ):
+                return ser.astype(np.float64)
     return ser
+
+
+import itertools
+
+_CSQ_IDS = itertools.count()  # temp-column namespace for correlated values
 
 
 def _materialize_correlated(e, df: pd.DataFrame, catalog):
@@ -635,20 +650,21 @@ def _materialize_correlated(e, df: pd.DataFrame, catalog):
     `Col` over a temp per-row column (see _correlated_column); returns
     (expression, frame-with-temp-columns).  After this, the ordinary
     two- and three-valued evaluators need no subquery knowledge."""
-    import itertools
-
     from ..plan.expr import map_expr
 
     if not isinstance(e, Expr):
         return e, df
     added = {}
-    counter = itertools.count()
 
     def repl(x):
         if isinstance(
             x, (E.InSubquery, E.ExistsSubquery, E.ScalarSubquery)
         ) and getattr(x, "outer_refs", None):
-            name = f"__csq{next(counter)}"
+            # PROCESS-UNIQUE temp name: callers (the Aggregate branch)
+            # materialize several expressions into ONE accumulated frame,
+            # and a per-call counter would collide and silently alias two
+            # different subqueries (review-confirmed wrong answer)
+            name = f"__csq{next(_CSQ_IDS)}"
             added[name] = _correlated_column(x, df, catalog)
             return E.Col(name)
         return x

@@ -234,15 +234,9 @@ def _leaf_true(f: F.Filter, ds: DataSource) -> MaskFn:
                 return lambda cols: jnp.zeros(cols[dim].shape, jnp.bool_)
             return lambda cols: cols[dim] == jnp.int32(code)
         if f.value is None:
-            # IS NULL on a non-dictionary column: NaN is the null
-            # representation for float metrics; int/time have none
-            def isnull_num(cols, dim=dim):
-                c = cols[dim]
-                if c.dtype in (jnp.float32, jnp.float64):
-                    return jnp.isnan(c)
-                return jnp.zeros(c.shape, jnp.bool_)
-
-            return isnull_num
+            # IS NULL on a non-dictionary column — same null
+            # representation the unknown masks use
+            return _null_mask_fn(dim, ds)
         # numeric column equality
         v = float(f.value)  # type: ignore[arg-type]
         return lambda cols: cols[dim] == v

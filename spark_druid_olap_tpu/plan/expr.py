@@ -229,7 +229,11 @@ class InSubquery(Expr):
         return tuple(self.operand.columns()) + _outer_bare(self.outer_refs)
 
     def __str__(self):
-        return f"({self.operand} IN (<subquery>))"
+        # the id disambiguates DIFFERENT subqueries under the analyzer's
+        # string-keyed aggregate dedup (two distinct correlated subqueries
+        # must not collapse into one aggregate); subqueries never travel
+        # on the wire, so re-parseability does not apply
+        return f"({self.operand} IN (<subquery#{id(self.stmt):x}>))"
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
@@ -246,7 +250,7 @@ class ExistsSubquery(Expr):
         return _outer_bare(self.outer_refs)
 
     def __str__(self):
-        return "EXISTS(<subquery>)"
+        return f"EXISTS(<subquery#{id(self.stmt):x}>)"
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
@@ -263,7 +267,7 @@ class ScalarSubquery(Expr):
         return _outer_bare(self.outer_refs)
 
     def __str__(self):
-        return "(<scalar subquery>)"
+        return f"(<scalar subquery#{id(self.stmt):x}>)"
 
 
 @dataclasses.dataclass(frozen=True, eq=True)

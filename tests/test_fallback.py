@@ -867,3 +867,23 @@ def test_self_reference_is_not_correlation():
         "WHERE EXISTS (SELECT x FROM u WHERE x = o.a AND o.b = 1)"
     )
     assert int(got2["n"].iloc[0]) == 1
+
+
+def test_two_correlated_subqueries_in_one_aggregate(corr):
+    """Review finding: temp-column names must be unique across the several
+    expressions an Aggregate materializes — two correlated subqueries in
+    different aggregate args must not alias each other."""
+    c, odf, cdf = corr
+    got = c.sql(
+        "SELECT o_cust, "
+        "sum((SELECT max(o_amt) FROM orders WHERE o_cust = o.o_cust)) AS a, "
+        "sum((SELECT min(o_amt) FROM orders WHERE o_cust = o.o_cust)) AS b "
+        "FROM orders o GROUP BY o_cust ORDER BY o_cust"
+    )
+    g = odf.groupby("o_cust").o_amt
+    mx, mn, cnt = g.max(), g.min(), g.size()
+    for _, r in got.iterrows():
+        k = int(r["o_cust"])
+        np.testing.assert_allclose(float(r["a"]), mx[k] * cnt[k], rtol=1e-6)
+        np.testing.assert_allclose(float(r["b"]), mn[k] * cnt[k], rtol=1e-6)
+    assert (got["a"] > got["b"]).any()

@@ -336,3 +336,25 @@ def test_distributed_vcol_shadow_disables_pruning(metric_clustered):
     assert set(got_by) == set(w.index)
     for city, s in w.items():
         np.testing.assert_allclose(got_by[city], s, rtol=2e-5)
+
+
+def test_nested_and_or_conjuncts_prune(clustered):
+    """The planner builds Ands PAIRWISE and year-style disjunctions as
+    Or(Bound, Bound): both shapes must still prune (round-3 fix — the SSB
+    q1/q4 latency class depends on it)."""
+    ctx, df = clustered
+    ds = ctx.catalog.get("cl")
+    eng = ctx.engine
+    # nested And: (k = 7 AND v > 0) AND v < 1000 — k=7 lives in segment 0
+    rw = ctx.plan_sql(
+        "SELECT count(*) AS n FROM cl WHERE k = 7 AND v > 0 AND v < 1000"
+    )
+    assert len(eng._segments_in_scope(rw.query, ds)) == 1
+    # Or of bounds on the clustered key: only the segments holding 7 or 80
+    rw2 = ctx.plan_sql(
+        "SELECT count(*) AS n FROM cl WHERE k = 7 OR k = 80"
+    )
+    segs2 = eng._segments_in_scope(rw2.query, ds)
+    assert len(segs2) == 2
+    got = ctx.sql("SELECT count(*) AS n FROM cl WHERE k = 7 OR k = 80")
+    assert int(got["n"].iloc[0]) == int(((df.k == 7) | (df.k == 80)).sum())
