@@ -586,8 +586,13 @@ def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
             q: (None if pd.isna(v) else v) for q, v in zip(refs, tup)
         }
         stmt2 = _substitute_outer(sub.stmt, binding)
-        if isinstance(sub, E.ExistsSubquery):
-            # existence only needs the first row
+        if (
+            isinstance(sub, E.ExistsSubquery)
+            and stmt2.limit is None
+            and not stmt2.offset
+        ):
+            # existence only needs the first row — but a USER-written
+            # LIMIT/OFFSET changes which rows exist and must be honored
             import dataclasses as _dc
 
             stmt2 = _dc.replace(stmt2, limit=1, offset=0)
@@ -628,7 +633,11 @@ def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
     ser = pd.Series(out, index=df.index)
     if isinstance(sub, E.ScalarSubquery):
         nn = [v for v in out if v is not None]
-        if nn and all(isinstance(v, (int, float, np.number)) for v in nn):
+        if not nn:
+            # every binding yielded NULL: float64 NaN keeps ordering
+            # comparisons well-defined (object None would raise)
+            return ser.astype(np.float64)
+        if all(isinstance(v, (int, float, np.number)) for v in nn):
             # float64 vectorizes comparisons and None -> NaN carries NULL
             # semantics — but only when it is EXACT: int64 values at or
             # above 2^53 would round and silently match wrong rows

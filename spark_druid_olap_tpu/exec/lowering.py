@@ -140,6 +140,28 @@ def _resolve_time_dim(
     card = len(starts)
     starts_dev = jnp.asarray(starts)
 
+    from ..utils.granularity import granularity_period_ms
+
+    period = granularity_period_ms(gran) if gran.lower() != "all" else None
+
+    def bucket_idx(t, first=int(starts[0]), period=period,
+                   starts_dev=starts_dev, card=card):
+        if period is not None:
+            # FIXED-period granularity (minute/hour/day/week): plain
+            # integer arithmetic — one fused op instead of searchsorted's
+            # log-N scan passes (~135 ms per 2M-row chunk on CPU).
+            # Out-of-range rows clip into the edge buckets; the interval
+            # row-mask already excludes them.
+            return jnp.clip((t - first) // period, 0, card - 1).astype(
+                jnp.int32
+            )
+        # calendar granularities (month/quarter/year): boundaries are
+        # irregular — searchsorted over the host-computed starts
+        return (
+            jnp.searchsorted(starts_dev, t, side="right").astype(jnp.int32)
+            - 1
+        )
+
     if spec.extraction is not None:
         # EXTRACT-style dims: many buckets fold to one extracted value
         # (e.g. MONTH over 3 years: 36 buckets -> 12 groups).  Host-side
@@ -151,9 +173,8 @@ def _resolve_time_dim(
             np.array([index[v] for v in extracted], dtype=np.int32)
         )
 
-        def codes_fn(cols, starts_dev=starts_dev, remap_dev=remap_dev):
-            t = cols["__time"]
-            b = jnp.searchsorted(starts_dev, t, side="right").astype(jnp.int32) - 1
+        def codes_fn(cols, remap_dev=remap_dev):
+            b = bucket_idx(cols["__time"])
             return remap_dev[jnp.clip(b, 0, remap_dev.shape[0] - 1)]
 
         vals_arr = np.asarray(new_vals, dtype=object)
@@ -163,13 +184,8 @@ def _resolve_time_dim(
 
         return ResolvedDim(spec, len(new_vals), codes_fn, decode)
 
-    def codes_fn(cols, starts_dev=starts_dev):
-        t = cols["__time"]
-        # bucket index via searchsorted over boundaries (log #buckets passes;
-        # handles calendar granularities month/quarter/year exactly)
-        return (
-            jnp.searchsorted(starts_dev, t, side="right").astype(jnp.int32) - 1
-        )
+    def codes_fn(cols):
+        return bucket_idx(cols["__time"])
 
     starts_np = np.asarray(starts)
 

@@ -235,3 +235,32 @@ class LookupExtraction(ExtractionFn):
         # Druid: without retain/replace, unmapped values become null (None
         # here folds into the dimension's null group)
         return [m.get(v, self.replace_missing) for v in values]
+
+
+@dataclasses.dataclass(frozen=True)
+class FormatExtraction(ExtractionFn):
+    """CONCAT with one dimension operand: literal prefix/suffix around the
+    value — Druid's `stringFormat` extraction; a pure dictionary rewrite."""
+
+    prefix: str = ""
+    suffix: str = ""
+
+    def to_druid(self):
+        # literal '%' must be escaped for Java's String.format
+        pre = self.prefix.replace("%", "%%")
+        suf = self.suffix.replace("%", "%%")
+        return {"type": "stringFormat", "format": f"{pre}%s{suf}"}
+
+    def apply_to_dict(self, values):
+        return [f"{self.prefix}{v}{self.suffix}" for v in values]
+
+
+@dataclasses.dataclass(frozen=True)
+class StrlenExtraction(ExtractionFn):
+    """LENGTH over a dimension (Druid `strlen`), as integer lengths."""
+
+    def to_druid(self):
+        return {"type": "strlen"}
+
+    def apply_to_dict(self, values):
+        return [len(v) for v in values]

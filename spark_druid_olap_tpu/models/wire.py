@@ -158,6 +158,24 @@ def _extraction_from_druid(d: Dict[str, Any]):
             retain_missing=bool(d.get("retainMissingValue", False)),
             replace_missing=d.get("replaceMissingValueWith"),
         )
+    if t == "stringFormat":
+        from .dimensions import FormatExtraction
+
+        fmt = d.get("format", "%s")
+        # protect escaped %% before locating the single %s conversion
+        guarded = fmt.replace("%%", "\x00")
+        if guarded.count("%s") != 1:
+            raise WireError(
+                f"stringFormat must contain exactly one %s: {fmt!r}"
+            )
+        pre, suf = (
+            p.replace("\x00", "%") for p in guarded.split("%s", 1)
+        )
+        return FormatExtraction(pre, suf)
+    if t == "strlen":
+        from .dimensions import StrlenExtraction
+
+        return StrlenExtraction()
     if t == "timeFormat":
         fmt = d.get("format", "%Y")
         # field-shaped formats decode to the int-valued EXTRACT dimension

@@ -92,6 +92,26 @@ class Expr:
         return tuple(dict.fromkeys(out))
 
 
+def apply_strfunc(fn: str, args: tuple, s: str):
+    """One string-function application over a non-null string — shared by
+    the host compile (fallback rows) and the planner's dictionary-space
+    filter rewrite (ONE implementation, so the two can never drift)."""
+    if fn == "upper":
+        return s.upper()
+    if fn == "lower":
+        return s.lower()
+    if fn == "substr":
+        start = int(args[0]) - 1  # SQL is 1-based
+        if len(args) > 1:
+            return s[start : start + int(args[1])]
+        return s[start:]
+    if fn == "concat":
+        return f"{args[0]}{s}{args[1]}"
+    if fn == "length":
+        return len(s)
+    raise ValueError(f"unsupported string fn {fn!r}")
+
+
 def map_expr(e, fn):
     """Bottom-up structural map over an expression tree: children are
     mapped first, the node is rebuilt, then `fn` transforms the result.
@@ -502,6 +522,31 @@ def _compile_comparison(e: "Comparison", dicts, raw_strings: bool = False):
 
         return never
 
+    if (
+        raw_strings
+        and e.op in ("==", "!=")
+        and any(
+            isinstance(s, Literal) and s.value is None
+            for s in (e.left, e.right)
+        )
+    ):
+        # IS [NOT] NULL over HOST frames: nulls appear as None (object
+        # columns) or NaN (decoded metrics) — a plain object `!=` would
+        # see NaN != None as True and mis-route COALESCE branches
+        other = e.right if (
+            isinstance(e.left, Literal) and e.left.value is None
+        ) else e.left
+        of = compile_expr(other, dicts, raw_strings=True)
+        eq = e.op == "=="
+
+        def isnull_host(cols, of=of, eq=eq):
+            import pandas as pd
+
+            isn = np.asarray(pd.isna(np.asarray(of(cols))))
+            return isn if eq else ~isn
+
+        return isnull_host
+
     lit_side = None
     if isinstance(e.right, Literal) and _num_lit(e.right.value):
         lit_side, lit_val, other = "right", e.right.value, e.left
@@ -732,6 +777,16 @@ def compile_expr(
         cf = compile_expr(e.cond, dicts, raw_strings=raw_strings)
         tf = compile_expr(e.then, dicts, raw_strings=raw_strings)
         of = compile_expr(e.otherwise, dicts, raw_strings=raw_strings)
+        if raw_strings:
+            # host mode: branches may be OBJECT arrays (decoded strings /
+            # None) that jnp.where cannot interpret
+            def host_if(cols, cf=cf, tf=tf, of=of):
+                c = np.asarray(cf(cols)).astype(bool)
+                t, o = np.asarray(tf(cols)), np.asarray(of(cols))
+                t, o, _ = np.broadcast_arrays(t, o, c)
+                return np.where(c, t, o)
+
+            return host_if
         return lambda cols: jnp.where(cf(cols), tf(cols), of(cols))
     if isinstance(e, Cast):
         f = compile_expr(e.operand, dicts, raw_strings=raw_strings)
@@ -818,6 +873,25 @@ def compile_expr(
             "row expression (dictionary dimensions translate to code sets)"
         )
     if isinstance(e, StrFunc):
+        if raw_strings and e.fn != "lookup":
+            # host (fallback) mode evaluates string functions directly on
+            # the decoded object column — nulls stay NULL
+            f = compile_expr(e.operand, dicts, raw_strings=True)
+
+            def str_host(cols, f=f, fn=e.fn, a=e.args):
+                import pandas as pd
+
+                def ap(v):
+                    if pd.isna(v):
+                        return None
+                    return apply_strfunc(
+                        fn, a, v if isinstance(v, str) else str(v)
+                    )
+
+                x = np.asarray(f(cols))
+                return np.array([ap(v) for v in x], dtype=object)
+
+            return str_host
         raise ValueError(
             "StrFunc is dictionary-evaluated (filter / GROUP BY "
             "position only); it cannot compile to a device row expression"

@@ -125,8 +125,20 @@ def translate_filter(
             continue
         # residual: compile later on the row path (JS-codegen analog)
         _validate_columns(conj, ds)
+        _reject_null_valued(conj)
         b = b.add_filter(F.ExpressionFilter(conj))
     return b
+
+
+def _reject_null_valued(e: E.Expr) -> None:
+    """NULL-producing VALUE expressions (NULLIF / CASE ... THEN NULL) have
+    no device representation: refuse at plan time so the query routes to
+    the host fallback (which has exact NULL semantics) instead of crashing
+    inside the device compile."""
+    if _has_null_literal(e):
+        raise RewriteError(
+            f"expression {e} produces NULL values; host fallback required"
+        )
 
 
 def _contains_subquery(e: E.Expr) -> bool:
@@ -200,6 +212,42 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
             l, r = r, l
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
                   "==": "==", "!=": "!="}[op]
+        if (
+            isinstance(l, E.StrFunc)
+            and isinstance(l.operand, E.Col)
+            and l.operand.name in ds.dicts
+            and isinstance(r, E.Literal)
+            and l.fn != "lookup"
+            and r.value is not None
+        ):
+            # comparison over a string function of a dimension: apply the
+            # fn to each DICTIONARY value once, keep matching values — the
+            # Druid extraction-filter analog (O(dictionary), no row work);
+            # null rows never match (InFilter is code-space membership)
+            import operator as _op
+
+            from ..plan.expr import apply_strfunc
+
+            cmp = {"==": _op.eq, "!=": _op.ne, "<": _op.lt,
+                   "<=": _op.le, ">": _op.gt, ">=": _op.ge}[op]
+            d = ds.dicts[l.operand.name]
+            lit = r.value
+            matched = []
+            for v in d.values:
+                res = apply_strfunc(
+                    l.fn, l.args, v if isinstance(v, str) else str(v)
+                )
+                if isinstance(res, int) and isinstance(
+                    lit, (int, float)
+                ) and not isinstance(lit, bool):
+                    ok = cmp(res, lit)
+                elif isinstance(res, str) and isinstance(lit, str):
+                    ok = cmp(res, lit)
+                else:
+                    ok = False
+                if ok:
+                    matched.append(str(v))
+            return F.InFilter(l.operand.name, tuple(matched))
         if not (isinstance(l, E.Col) and isinstance(r, E.Literal)):
             return None
         name, val = l.name, r.value
@@ -365,6 +413,24 @@ def translate_group_expr(
                               extraction=CaseExtraction(upper=(e.fn == "upper"))),
                 b,
             )
+        if e.fn == "concat":
+            from ..models.dimensions import FormatExtraction
+
+            prefix, suffix = (e.args + ("", ""))[:2]
+            return (
+                DimensionSpec(
+                    dim, name,
+                    extraction=FormatExtraction(str(prefix), str(suffix)),
+                ),
+                b,
+            )
+        if e.fn == "length":
+            from ..models.dimensions import StrlenExtraction
+
+            return (
+                DimensionSpec(dim, name, extraction=StrlenExtraction()),
+                b,
+            )
         if e.fn == "lookup":
             from ..models.dimensions import LookupExtraction
 
@@ -390,6 +456,34 @@ def translate_group_expr(
     raise RewriteError(f"cannot group by expression {e}")
 
 
+def _has_null_literal(e) -> bool:
+    """Does a VALUE expression contain a NULL literal (e.g. NULLIF's
+    desugared CASE arm)?  Excludes the `== Literal(None)` IS-NULL
+    comparison encoding, which is boolean and device-safe."""
+    found = False
+
+    def look(x):
+        nonlocal found
+        if isinstance(x, E.Literal) and x.value is None:
+            found = True
+        return x
+
+    def strip_isnull(x):
+        if (
+            isinstance(x, E.Comparison)
+            and x.op in ("==", "!=")
+            and any(
+                isinstance(s, E.Literal) and s.value is None
+                for s in (x.left, x.right)
+            )
+        ):
+            return E.Literal(True)  # boolean, not a NULL value
+        return x
+
+    E.map_expr(E.map_expr(e, strip_isnull), look)
+    return found
+
+
 def translate_aggregate(
     agg: AggExpr, ds: DataSource, b: QueryBuilder, cfg: SessionConfig
 ) -> Tuple[List[A.Aggregation], List[A.PostAggregation], QueryBuilder]:
@@ -400,6 +494,7 @@ def translate_aggregate(
         spec = _as_filter_spec(agg.filter, ds)
         if spec is None:
             _validate_columns(agg.filter, ds)
+            _reject_null_valued(agg.filter)
             spec = F.ExpressionFilter(agg.filter)
         extra_filter = spec
 
@@ -545,6 +640,14 @@ def translate_aggregate(
             return [wrap(cls(name, arg.name))], [], b
         # expression argument -> ExpressionAgg (fused virtual column)
         _validate_columns(arg, ds)
+        if _has_null_literal(arg):
+            # NULL-producing row expressions (NULLIF / CASE ... THEN NULL)
+            # have no device value representation — the host fallback
+            # computes them with exact NULL-skipping aggregate semantics
+            raise RewriteError(
+                f"aggregate argument {arg} produces NULL values; "
+                "host fallback required"
+            )
         base = {"sum": "doubleSum", "min": "doubleMin", "max": "doubleMax"}[fn]
         return [wrap(A.ExpressionAgg(name, arg, base=base))], [], b
 

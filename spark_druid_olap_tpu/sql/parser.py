@@ -782,6 +782,41 @@ class Parser:
             arg = self.expr()
             self.expect_op(")")
             return E.StrFunc(fn, arg)
+        if fn == "length":
+            arg = self.expr()
+            self.expect_op(")")
+            return E.StrFunc("length", arg)
+        if fn == "nullif":
+            a = self.expr()
+            self.expect_op(",")
+            b = self.expr()
+            self.expect_op(")")
+            # NULLIF(a, b) == CASE WHEN a = b THEN NULL ELSE a END
+            return E.IfExpr(
+                E.Comparison("==", a, b), E.Literal(None), a
+            )
+        if fn == "concat":
+            args = [self.expr()]
+            while self.accept_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            cols = [a for a in args if not isinstance(a, E.Literal)]
+            lits = [a for a in args if isinstance(a, E.Literal)]
+            if any(
+                not isinstance(a.value, str) for a in lits
+            ):
+                raise ParseError("CONCAT literal arguments must be strings")
+            if not cols:
+                return E.Literal("".join(a.value for a in lits))
+            if len(cols) != 1:
+                raise ParseError(
+                    "CONCAT supports one column operand plus string "
+                    "literals (the dictionary-rewrite form)"
+                )
+            i = args.index(cols[0])
+            prefix = "".join(a.value for a in args[:i])
+            suffix = "".join(a.value for a in args[i + 1:])
+            return E.StrFunc("concat", cols[0], (prefix, suffix))
         if fn == "lookup":
             # LOOKUP(expr, 'name'[, 'replaceMissingValueWith'])
             arg = self.expr()

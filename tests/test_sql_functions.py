@@ -1,0 +1,180 @@
+"""SQL scalar-function surface (round 3 additions): COALESCE / NULLIF /
+CONCAT / LENGTH — device dictionary rewrites where possible (extraction
+fns, the reference's jscodegen analog per SURVEY.md §2 L0), host fallback
+elsewhere, NULL semantics exact in both."""
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "t",
+        {
+            "s": np.array(["ab", None, "cde", "ab"], dtype=object),
+            "k": np.array([1, 2, None, 1], dtype=object),
+            "v": np.arange(4, dtype=np.float32),
+        },
+        dimensions=["s", "k"],
+        metrics=["v"],
+    )
+    return c
+
+
+def test_concat_group_by_device(ctx):
+    got = ctx.sql(
+        "SELECT CONCAT('x_', s, '!') AS cs, count(*) AS n FROM t "
+        "GROUP BY CONCAT('x_', s, '!') ORDER BY cs"
+    )
+    assert ctx.last_metrics.executor == "device"
+    by = {
+        (None if not isinstance(r["cs"], str) else r["cs"]): int(r["n"])
+        for _, r in got.iterrows()
+    }
+    assert by == {"x_ab!": 2, "x_cde!": 1, None: 1}
+
+
+def test_length_group_by_device(ctx):
+    got = ctx.sql(
+        "SELECT LENGTH(s) AS l, sum(v) AS sv FROM t "
+        "GROUP BY LENGTH(s) ORDER BY l"
+    )
+    assert ctx.last_metrics.executor == "device"
+    rows = {
+        (None if r["l"] is None or r["l"] != r["l"] else int(r["l"])):
+        float(r["sv"])
+        for _, r in got.iterrows()
+    }
+    assert rows[2] == 0.0 + 3.0 and rows[3] == 2.0 and rows[None] == 1.0
+
+
+@pytest.mark.parametrize(
+    "cond,want",
+    [
+        ("LENGTH(s) = 2", 2),
+        ("LENGTH(s) <> 2", 1),          # NULL row is UNKNOWN -> excluded
+        ("UPPER(s) = 'AB'", 2),
+        ("LOWER(s) >= 'c'", 1),
+        ("SUBSTR(s, 1, 1) = 'c'", 1),
+        ("CONCAT(s, '!') = 'ab!'", 2),
+        ("NOT (LENGTH(s) = 2)", 1),     # Kleene over the rewrite
+    ],
+)
+def test_strfunc_filters_device(ctx, cond, want):
+    got = ctx.sql(f"SELECT count(*) AS n FROM t WHERE {cond}")
+    assert int(got["n"].iloc[0]) == want, cond
+    assert ctx.last_metrics.executor == "device"
+
+
+def test_coalesce_group_by(ctx):
+    got = ctx.sql(
+        "SELECT COALESCE(s, 'zz') AS cs, count(*) AS n FROM t "
+        "GROUP BY COALESCE(s, 'zz') ORDER BY cs"
+    )
+    by = {r["cs"]: int(r["n"]) for _, r in got.iterrows()}
+    assert by == {"ab": 2, "cde": 1, "zz": 1}
+    got2 = ctx.sql(
+        "SELECT COALESCE(k, 0) AS ck, count(*) AS n FROM t "
+        "GROUP BY COALESCE(k, 0) ORDER BY ck"
+    )
+    assert [int(x) for x in got2["ck"]] == [0, 1, 2]
+    assert [int(x) for x in got2["n"]] == [1, 2, 1]
+
+
+def test_nullif(ctx):
+    got = ctx.sql(
+        "SELECT NULLIF(s, 'ab') AS ns, count(*) AS n FROM t "
+        "GROUP BY NULLIF(s, 'ab')"
+    )
+    by = {
+        (r["ns"] if isinstance(r["ns"], str) else None): int(r["n"])
+        for _, r in got.iterrows()
+    }
+    assert by == {None: 3, "cde": 1}  # both 'ab' rows + the NULL row
+
+
+def test_concat_wire_round_trip(ctx):
+    from spark_druid_olap_tpu.models.dimensions import (
+        DimensionSpec,
+        FormatExtraction,
+        StrlenExtraction,
+    )
+    from spark_druid_olap_tpu.models.wire import dimension_from_druid
+
+    d = DimensionSpec("s", "cs", extraction=FormatExtraction("x_", "!"))
+    assert dimension_from_druid(d.to_druid()) == d
+    d2 = DimensionSpec("s", "l", extraction=StrlenExtraction())
+    assert dimension_from_druid(d2.to_druid()) == d2
+
+
+def test_concat_multiple_columns_rejected(ctx):
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="one column"):
+        ctx.sql("SELECT CONCAT(s, s) AS x FROM t")
+
+
+def test_nullif_in_where_routes_to_fallback(ctx):
+    """NULL-producing expressions in FILTER position refuse the device
+    compile cleanly and run on the fallback (review finding: the
+    ExpressionFilter path crashed on jnp.where(cond, None, x))."""
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM t WHERE NULLIF(k, 1) = 2"
+    )
+    assert ctx.last_metrics.executor == "fallback"
+    assert int(got["n"].iloc[0]) == 1  # only the k=2 row
+
+
+def test_exists_with_user_limit_honored():
+    """Review finding: correlated EXISTS must not clobber a user-written
+    LIMIT (EXISTS (... LIMIT 0) is FALSE everywhere)."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "a", {"x": np.arange(3, dtype=np.int64)}, dimensions=["x"]
+    )
+    c.register_table(
+        "b", {"y": np.arange(3, dtype=np.int64)}, dimensions=["y"]
+    )
+    got = c.sql(
+        "SELECT count(*) AS n FROM a o WHERE EXISTS "
+        "(SELECT y FROM b WHERE y = o.x LIMIT 0)"
+    )
+    assert int(got["n"].iloc[0]) == 0
+
+
+def test_all_null_correlated_scalar_comparison():
+    """Review finding: every-binding-NULL scalar columns must compare as
+    UNKNOWN (no rows), not raise."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "o", {"k": np.arange(3, dtype=np.int64),
+              "amt": np.arange(3, dtype=np.float32)},
+        dimensions=["k"], metrics=["amt"],
+    )
+    c.register_table(
+        "i", {"j": np.arange(3, dtype=np.int64),
+              "v": np.arange(3, dtype=np.float32)},
+        dimensions=["j"], metrics=["v"],
+    )
+    got = c.sql(
+        "SELECT count(*) AS n FROM o WHERE amt > "
+        "(SELECT max(v) FROM i WHERE j = o.k AND v > 1000)"
+    )
+    assert int(got["n"].iloc[0]) == 0
+
+
+def test_format_extraction_percent_round_trip():
+    from spark_druid_olap_tpu.models.dimensions import (
+        DimensionSpec,
+        FormatExtraction,
+    )
+    from spark_druid_olap_tpu.models.wire import dimension_from_druid
+
+    d = DimensionSpec("s", "x", extraction=FormatExtraction("50% ", "%!"))
+    wire = d.to_druid()
+    assert wire["extractionFn"]["format"] == "50%% %s%%!"
+    assert dimension_from_druid(wire) == d

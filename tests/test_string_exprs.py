@@ -272,9 +272,14 @@ def test_simple_case_form():
     np.testing.assert_allclose(float(got["x"][0]), want, rtol=1e-6)
 
 
-def test_nullif_rejected():
-    """NULL-producing expressions have no device value representation yet;
-    NULLIF must be a loud unknown-function error, never silent wrong data."""
+def test_nullif_aggregate_routes_to_fallback():
+    """NULL-producing expressions have no device value representation; the
+    planner refuses them cleanly and the host fallback computes the exact
+    NULL-skipping aggregate (round 2 rejected NULLIF at parse)."""
     c, vals, v = _null_ctx()
-    with pytest.raises(Exception, match="(?i)nullif"):
-        c.sql("SELECT sum(NULLIF(v, 1)) AS x FROM nt")
+    got = c.sql("SELECT sum(NULLIF(v, 1)) AS x FROM nt")
+    assert c.last_metrics.executor == "fallback"
+    import numpy as np
+
+    w = np.asarray(v, dtype=np.float64)
+    assert float(got["x"].iloc[0]) == float(w[w != 1].sum())
