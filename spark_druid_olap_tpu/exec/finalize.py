@@ -279,6 +279,10 @@ def finalize_groupby(
         else:
             # SQL: SUM over zero rows is NULL; COUNT stays 0
             table[n] = np.where(empty_group, np.nan, v)
+    for n, src in la.aliased.items():
+        # unfiltered COUNT reads the __rows presence counter directly
+        j = la.sum_names.index(src)
+        table[n] = np.rint(sums[sel, j].astype(np.float64)).astype(np.int64)
     def _finalize_extremum(v: np.ndarray, long_valued: bool) -> np.ndarray:
         v = v.astype(np.float64)
         v = np.where(np.isinf(v), np.nan, v)

@@ -218,6 +218,11 @@ class LoweredAggs:
     value_fns: Dict[str, Callable]  # name -> fn(cols) -> f32[R]
     mask_fns: Dict[str, Optional[Callable]]  # name -> extra-mask fn or None
     count_like: set = dataclasses.field(default_factory=set)  # COUNT aggs
+    # agg name -> existing sum column it READS instead of owning one: an
+    # unfiltered COUNT(*) is exactly the hidden __rows presence counter,
+    # and a duplicate all-ones scatter column is pure waste (the scatter
+    # cost scales with the column count)
+    aliased: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def _lower_aggs(
@@ -246,9 +251,12 @@ def _lower_aggs(
         name = agg.name
         la.mask_fns[name] = mask_fn
         if isinstance(agg, A.Count):
-            la.sum_names.append(name)
             la.long_valued[name] = True
             la.count_like.add(name)
+            if mask_fn is None:
+                la.aliased[name] = "__rows"  # reuse the presence counter
+                return
+            la.sum_names.append(name)
             la.value_fns[name] = lambda cols: None  # ones
         elif isinstance(agg, (A.LongSum, A.DoubleSum)):
             field = agg.field_name

@@ -252,7 +252,12 @@ class FormatExtraction(ExtractionFn):
         return {"type": "stringFormat", "format": f"{pre}%s{suf}"}
 
     def apply_to_dict(self, values):
-        return [f"{self.prefix}{v}{self.suffix}" for v in values]
+        from ..plan.expr import apply_strfunc
+
+        return [
+            apply_strfunc("concat", (self.prefix, self.suffix), v)
+            for v in values
+        ]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -263,4 +268,6 @@ class StrlenExtraction(ExtractionFn):
         return {"type": "strlen"}
 
     def apply_to_dict(self, values):
-        return [len(v) for v in values]
+        from ..plan.expr import apply_strfunc
+
+        return [apply_strfunc("length", (), v) for v in values]

@@ -778,14 +778,10 @@ class Parser:
             if length is not None:
                 args = args + (int(length.value),)  # type: ignore[union-attr]
             return E.StrFunc("substr", arg, args)
-        if fn in ("upper", "lower"):
+        if fn in ("upper", "lower", "length"):
             arg = self.expr()
             self.expect_op(")")
             return E.StrFunc(fn, arg)
-        if fn == "length":
-            arg = self.expr()
-            self.expect_op(")")
-            return E.StrFunc("length", arg)
         if fn == "nullif":
             a = self.expr()
             self.expect_op(",")
