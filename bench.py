@@ -702,7 +702,8 @@ def main():
     probe_s = int(os.environ.get("SD_BENCH_PROBE_TIMEOUT_S", "120"))
     run_s = int(os.environ.get("SD_BENCH_TIMEOUT_S", "1500"))
     # total window spent retrying a down tunnel before settling for CPU
-    window_s = int(os.environ.get("SD_BENCH_PROBE_WINDOW_S", "900"))
+    # (bounded so a driver-side timeout still sees the one JSON line)
+    window_s = int(os.environ.get("SD_BENCH_PROBE_WINDOW_S", "600"))
     interval_s = int(os.environ.get("SD_BENCH_PROBE_INTERVAL_S", "60"))
 
     platform, probe_attempts = _probe_with_retry(
