@@ -69,6 +69,18 @@ def world():
             ),
         ),
     )
+    # small auxiliary table for CORRELATED-subquery predicates: tags per
+    # city (some cities absent, so per-binding result sets vary)
+    aux_city = np.array(
+        [c for i, c in enumerate(CITIES) if i % 3 != 0], dtype=object
+    )
+    aux_rng = np.random.default_rng(77)
+    aux_tag = aux_rng.integers(1, 50, len(aux_city)).astype(np.int64)
+    ctx.register_table(
+        "aux",
+        {"city2": aux_city, "tag": aux_tag},
+        dimensions=["city2", "tag"],
+    )
     df = pd.DataFrame(
         {
             "flag": data["flag"],
@@ -80,6 +92,9 @@ def world():
             "qty": np.asarray(data["qty"], np.float64),
             "ts": data["ts"],
         }
+    )
+    df.attrs["aux"] = pd.DataFrame(
+        {"city2": aux_city, "tag": aux_tag}
     )
     return ctx, df
 
@@ -94,8 +109,40 @@ def _rand_predicate(rng, df):
         # predicates over null-free columns are two-valued
         return lambda d, f=mask_fn: (f(d), pd.Series(False, index=d.index))
     kind = rng.choice(
-        ["sel", "in", "neq", "range_str", "num", "date", "like", "or", "not"]
+        ["sel", "in", "neq", "range_str", "num", "date", "like", "or",
+         "not", "corr_exists", "corr_in"],
+        p=[0.11, 0.11, 0.11, 0.11, 0.11, 0.11, 0.08, 0.08, 0.08, 0.05, 0.05],
     )
+    if kind == "corr_exists":
+        k = int(rng.integers(5, 45))
+        aux = df.attrs["aux"]
+        hot = set(aux[aux.tag <= k].city2)
+        return (
+            f"EXISTS (SELECT tag FROM aux WHERE city2 = o.city "
+            f"AND tag <= {k})",
+            # EXISTS is never UNKNOWN; a NULL binding finds no rows
+            lambda d, hot=hot: (
+                d["city"].isin(hot), pd.Series(False, index=d.index)
+            ),
+        )
+    if kind == "corr_in":
+        aux = df.attrs["aux"]
+        by_city = aux.groupby("city2").tag.agg(set).to_dict()
+        return (
+            "qty IN (SELECT tag FROM aux WHERE city2 = o.city)",
+            # qty has no nulls; an absent/NULL city binding yields the
+            # empty set (FALSE, not UNKNOWN)
+            lambda d, by=by_city: (
+                pd.Series(
+                    [
+                        (c in by) and (q in by[c])
+                        for c, q in zip(d["city"], d["qty"])
+                    ],
+                    index=d.index,
+                ),
+                pd.Series(False, index=d.index),
+            ),
+        )
     if kind == "sel":
         v = rng.choice(MODES)
         return f"mode = '{v}'", _2v(lambda d: d["mode"] == v)
@@ -232,7 +279,7 @@ def _gen_case(df, seed):
     sel = [f"{e} AS {name}" for e, name, _ in dims] + [
         f"{sql} AS a{i}" for i, (sql, _, _) in enumerate(picks)
     ]
-    q = "SELECT " + ", ".join(sel) + " FROM f"
+    q = "SELECT " + ", ".join(sel) + " FROM f o"
     if preds:
         q += " WHERE " + " AND ".join(p for p, _ in preds)
     if dims:
@@ -351,6 +398,10 @@ def _plan_query(ctx, df, seed):
     date_trunc time bucket is drawn as the single dim with no HAVING/ORDER
     (builder.is_timeseries)."""
     q = _gen_case(df, seed)[0]
+    if "SELECT tag FROM aux" in q:
+        # a correlated predicate was drawn: the whole statement is
+        # fallback-only, so there is no device plan to cross-execute
+        pytest.skip("seed drew a correlated predicate (fallback-only)")
     return ctx.plan_sql(q), q
 
 
