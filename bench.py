@@ -77,9 +77,13 @@ def _ensure_calibration():
         if _os.path.exists(C.DEFAULT_PATH):
             with open(C.DEFAULT_PATH) as f:
                 cal = _json.load(f)
-            # same device AND current schema (stream_bytes_per_s is the
-            # round-3 roofline key) -> reuse
-            if cal.get("device") == dev and "stream_bytes_per_s" in cal:
+            # same device AND current schema (stream_bytes_per_s and
+            # cost_per_row_compact are round-3 keys) -> reuse
+            if (
+                cal.get("device") == dev
+                and "stream_bytes_per_s" in cal
+                and "cost_per_row_compact" in cal
+            ):
                 return
         C.calibrate(rows=1 << 19)
     except Exception:

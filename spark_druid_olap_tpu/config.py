@@ -63,6 +63,11 @@ class SessionConfig:
     cost_per_row_scatter: float = 0.05
     # us per row for the sort-compaction (sparse) path
     cost_per_row_sparse: float = 5e-3
+    # us per row for the FILTER-COMPACTION pass (mask -> survivor slots):
+    # the linear scan sparse pays over ALL rows before sorting only the
+    # survivors.  Estimate until calibrated; the dense/scatter/compact
+    # ratio is what routes selective high-cardinality queries
+    cost_per_row_compact: float = 2e-3
     # us per group of dense scatter state (alloc + merge traffic)
     cost_per_group_state: float = 2e-5
     # merge-collective throughput, bytes per us (ICI ring allreduce)
@@ -108,11 +113,12 @@ class SessionConfig:
                 "cost_per_row_dense",
                 "cost_per_row_scatter",
                 "cost_per_row_sparse",
+                "cost_per_row_compact",
                 "cost_per_group_state",
                 "collective_bytes_per_us",
                 "cost_dispatch_us",
             ):
-                if k in data and data[k] > 0:
+                if k in data and data[k] is not None and data[k] > 0:
                     setattr(cfg, k, float(data[k]))
         return cfg
 

@@ -77,9 +77,21 @@ def _g_tiles(num_groups: int) -> int:
 
 
 def _kernel_costs(
-    rows: int, num_groups: int, cfg: SessionConfig, sparse_ok: bool
+    rows: int,
+    num_groups: int,
+    cfg: SessionConfig,
+    sparse_ok: bool,
+    selectivity: float = 1.0,
 ) -> Tuple[Tuple[str, float], ...]:
-    """(strategy, modelled us) for each kernel class (inf = inapplicable)."""
+    """(strategy, modelled us) for each kernel class (inf = inapplicable).
+
+    `selectivity` is the estimated surviving-row fraction of the query's
+    filter (estimate_selectivity).  It changes only the SPARSE model:
+    filter compaction pays one linear pass over all rows plus the
+    sort-aggregate over the SURVIVORS — which is how a 1/600-selective
+    GROUP BY over a 400K-group domain (SSB q3-class) beats raw scatter's
+    per-group state cost.  Dense and scatter process every row regardless
+    (the mask does not shrink their work), so they are unchanged."""
     dense = (
         rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
         if num_groups <= cfg.dense_max_groups
@@ -88,8 +100,78 @@ def _kernel_costs(
     scatter = (
         rows * cfg.cost_per_row_scatter + num_groups * cfg.cost_per_group_state
     )
-    sparse = rows * cfg.cost_per_row_sparse if sparse_ok else float("inf")
+    if not sparse_ok:
+        sparse = float("inf")
+    elif selectivity >= 1.0:
+        sparse = rows * cfg.cost_per_row_sparse  # full-row sort, no compact
+    else:
+        from ..ops.sparse_groupby import ROW_CAPACITY
+
+        # tier-1 sorts at least ROW_CAPACITY slots however few survive
+        sorted_rows = min(
+            rows, max(selectivity * rows, float(ROW_CAPACITY))
+        )
+        sparse = rows * cfg.cost_per_row_compact + (
+            sorted_rows * cfg.cost_per_row_sparse
+        )
     return (("dense", dense), ("segment", scatter), ("sparse", sparse))
+
+
+def estimate_selectivity(filt, ds: DataSource) -> float:
+    """Estimated surviving-row fraction of a filter spec — dictionary-based
+    uniformity assumptions, the classic textbook estimator (the reference's
+    DruidQueryCostModel reasoned from segment metadata the same coarse
+    way).  Conservative: anything unmodeled estimates 1.0."""
+    from ..models import filters as F
+
+    if filt is None:
+        return 1.0
+    if isinstance(filt, F.And):
+        s = 1.0
+        for x in filt.fields:
+            s *= estimate_selectivity(x, ds)
+        return s
+    if isinstance(filt, F.Or):
+        s = 0.0
+        for x in filt.fields:
+            s += estimate_selectivity(x, ds)
+        return min(1.0, s)
+    if isinstance(filt, F.Not):
+        return max(0.0, 1.0 - estimate_selectivity(filt.field, ds))
+    if isinstance(filt, F.Selector):
+        d = ds.dicts.get(filt.dimension)
+        if d is None or not d.cardinality:
+            return 1.0
+        if filt.value is not None and d.code_of(filt.value) is None:
+            return 0.0
+        return 1.0 / d.cardinality
+    if isinstance(filt, F.InFilter):
+        d = ds.dicts.get(filt.dimension)
+        if d is None or not d.cardinality:
+            return 1.0
+        hits = sum(1 for v in filt.values if d.code_of(v) is not None)
+        return min(1.0, hits / d.cardinality)
+    if isinstance(filt, F.Bound):
+        d = ds.dicts.get(filt.dimension)
+        if d is not None and d.cardinality:
+            # fraction of the (sorted) code space the bound admits
+            from ..ops.filters import numeric_dict_code_bounds
+
+            nv = d.numeric_values
+            if nv is not None and filt.ordering != "lexicographic":
+                import numpy as np
+
+                cb = numeric_dict_code_bounds(filt, np.asarray(nv))
+                if cb is None:
+                    return 1.0
+                lo, hi = cb
+                lo = 0 if lo is None else max(0, lo)
+                hi = d.cardinality - 1 if hi is None else min(
+                    d.cardinality - 1, hi
+                )
+                return max(0.0, (hi - lo + 1) / d.cardinality)
+        return 1.0 / 3.0  # classic guess for an un-modeled range
+    return 1.0
 
 
 def choose_kernel_strategy(
@@ -139,7 +221,10 @@ def choose_physical(
         and not has_sketch
         and bool(getattr(q, "dimensions", ()))
     )
-    costs = dict(_kernel_costs(rows, num_groups, cfg, sparse_ok))
+    sel = estimate_selectivity(getattr(q, "filter", None), ds)
+    costs = dict(
+        _kernel_costs(rows, num_groups, cfg, sparse_ok, selectivity=sel)
+    )
     if not cfg.cost_model_enabled:
         # static fallback: dense inside the domain cap, else compaction
         if num_groups <= cfg.dense_max_groups:
