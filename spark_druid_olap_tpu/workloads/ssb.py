@@ -175,8 +175,11 @@ def gen_dim_tables(scale: float, rng) -> Dict[str, Dict[str, np.ndarray]]:
     }
 
 
-def _gen_fact(n: int, rng, datekeys, n_c: int, n_s: int, n_p: int):
-    date_idx = rng.integers(0, len(datekeys), size=n)
+def _gen_fact(n: int, rng, datekeys, n_c: int, n_s: int, n_p: int,
+              date_lo: int = 0, date_hi: int | None = None):
+    date_idx = rng.integers(
+        date_lo, len(datekeys) if date_hi is None else date_hi, size=n
+    )
     quantity = rng.integers(1, 51, size=n).astype(np.float32)
     extendedprice = rng.random(n).astype(np.float32) * 55_450 + 90
     discount = rng.integers(0, 11, size=n).astype(np.float32)
@@ -256,18 +259,30 @@ def flat_columns(tables) -> Tuple[Dict[str, np.ndarray], Dict[str, DimensionDict
 def fact_chunks(scale: float, seed: int, chunk_rows: int, tables):
     """Generator of lineorder chunks at SF `scale` without ever holding the
     full fact: chunk i draws from its own deterministic stream
-    default_rng((seed, SSB_FACT_STREAM, i)), so any chunk is reproducible
-    independently (the chunked ORACLE regenerates the same rows)."""
+    default_rng((seed, SSB_FACT_STREAM, i)).  A chunk is reproducible
+    given the SAME (scale, seed, chunk_rows) — the date slice depends on
+    the chunk geometry, so the chunked ORACLE must iterate with the same
+    chunk_rows the ingest used (both bench callers do)."""
     n_c = len(tables["customer"]["c_custkey"])
     n_s = len(tables["supplier"]["s_suppkey"])
     n_p = len(tables["part"]["p_partkey"])
     datekeys = tables["dwdate"]["d_datekey"]
     n = int(6_000_000 * scale)
+    n_days = len(datekeys)
     ci = 0
     for start in range(0, n, chunk_rows):
         rows = min(chunk_rows, n - start)
         rng = np.random.default_rng((seed, _FACT_STREAM, ci))
-        yield _gen_fact(rows, rng, datekeys, n_c, n_s, n_p)
+        # chunk ci covers ITS slice of the date span — events arrive in
+        # time order, exactly how Druid ingests (segments ARE time
+        # partitions): date-derived predicates then prune across the
+        # WHOLE stream, not just within a chunk.  Slices are proportional
+        # to ROW position (not chunk index), so a ragged last chunk gets
+        # a proportionally narrower slice and per-day density stays
+        # uniform over the span.
+        lo = (start * n_days) // n
+        hi = max(lo + 1, ((start + rows) * n_days) // n)
+        yield _gen_fact(rows, rng, datekeys, n_c, n_s, n_p, lo, hi)
         ci += 1
 
 
@@ -294,11 +309,10 @@ def register_streamed(ctx, scale: float, seed: int = 7,
     """Register the SSB star at a LARGE scale factor: the fact is
     generated, encoded, and segmented chunk-by-chunk
     (catalog.segment.build_datasource_streamed), never materialized whole.
-    Each chunk is time-sorted before segmenting (the Druid time-partition
-    analog at stream granularity): a 4M-row chunk split into 512K-row
-    segments gives every segment ~1/8 of the date range, so date-derived
-    predicates prune via zone maps.  Returns the dimension tables (for
-    oracle use)."""
+    Chunks are date-sliced (fact_chunks) and time-sorted before
+    segmenting, so a segment spans roughly 1/(8 x n_chunks) of the date
+    range — date-derived predicates prune via zone maps across the whole
+    stream.  Returns the dimension tables (for oracle use)."""
     from ..catalog.segment import build_datasource_streamed
 
     tables, dicts, raw_chunks = flat_chunks(scale, seed, chunk_rows)
@@ -496,7 +510,13 @@ def merge_oracle_parts(parts):
 
     if isinstance(parts[0], float):
         return float(sum(parts))
-    df = pd.concat(parts, ignore_index=True)
+    # drop EMPTY partials before concat: date-sliced chunks make filtered
+    # queries miss whole chunks, and concat with empties promotes int
+    # group columns to float
+    nonempty = [p for p in parts if len(p)]
+    if not nonempty:
+        return parts[0]
+    df = pd.concat(nonempty, ignore_index=True)
     vcol = df.columns[-1]  # oracle puts the measure last
     g = [c for c in df.columns if c != vcol]
     return df.groupby(g, as_index=False)[vcol].sum()
