@@ -143,12 +143,16 @@ def calibrate(
             )
             # the tier-1 run sorts ROW_CAPACITY slots (not just the 1%
             # survivors) — subtract the CAPACITY's worth of sort cost or
-            # it leaks into the compact constant
+            # it leaks into the compact constant.  FLOOR at the scatter
+            # per-row cost: compaction reads at least as much as a
+            # scatter pass, and an over-subtracted near-zero constant
+            # mis-routes large scans onto the sparse path (observed: SF100
+            # q3-class 8s -> 55s when the floor was 1e-6)
             sorted_rows = min(ROW_CAPACITY, rows)
             cost_per_row_compact = max(
                 (t_compact * 1e6 - sorted_rows * cost_per_row_sparse)
                 / rows,
-                1e-6,
+                cost_per_row_scatter,
             )
         except Exception:
             pass

@@ -107,13 +107,16 @@ def _kernel_costs(
     else:
         from ..ops.sparse_groupby import ROW_CAPACITY
 
-        # tier-1 sorts at least ROW_CAPACITY slots however few survive
+        # tier-1 sorts at least ROW_CAPACITY slots however few survive.
+        # The compact constant is floored at the scatter per-row cost
+        # defensively (see plan/calibrate.py — an over-subtracted
+        # constant from an older calibration file must not flip large
+        # scans onto the sparse path)
+        compact = max(cfg.cost_per_row_compact, cfg.cost_per_row_scatter)
         sorted_rows = min(
             rows, max(selectivity * rows, float(ROW_CAPACITY))
         )
-        sparse = rows * cfg.cost_per_row_compact + (
-            sorted_rows * cfg.cost_per_row_sparse
-        )
+        sparse = rows * compact + sorted_rows * cfg.cost_per_row_sparse
     return (("dense", dense), ("segment", scatter), ("sparse", sparse))
 
 
