@@ -44,7 +44,12 @@ log = get_logger("api")
 
 class TPUOlapContext:
     def __init__(self, config: Optional[SessionConfig] = None):
-        self.config = config or SessionConfig()
+        # Default to measured cost constants (calibration.json when it was
+        # produced on this backend, else the platform profile): the class
+        # defaults are v5e-flavoured and route CPU kernels pathologically
+        # (an uncalibrated CPU session would run a G=8008 GroupBy dense —
+        # ~200x slower than scatter there).
+        self.config = config or SessionConfig.load_calibrated()
         self.catalog = MetadataCache()
         self.engine = Engine()
         self._dist_engine = None

@@ -16,6 +16,31 @@ import dataclasses
 from typing import Optional, Tuple
 
 
+def _log():
+    from .utils.log import get_logger
+
+    return get_logger("config")
+
+
+def _current_platform() -> Optional[str]:
+    """Live backend platform ("cpu"/"tpu"/...), None if jax is unavailable."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return None
+
+
+def _current_device_str() -> Optional[str]:
+    try:
+        import jax
+
+        return str(jax.devices()[0])
+    except Exception:
+        return None
+
+
 @dataclasses.dataclass
 class SessionConfig:
     """Session-wide planner/engine flags (the SQLConf analog)."""
@@ -97,7 +122,12 @@ class SessionConfig:
     @classmethod
     def load_calibrated(cls, path: Optional[str] = None) -> "SessionConfig":
         """SessionConfig with measured cost constants, when a calibration
-        file (plan/calibrate.py) exists; plain defaults otherwise."""
+        file (plan/calibrate.py) exists AND was measured on the current
+        backend device; platform-profile defaults otherwise.
+
+        The stale-device check matters: constants measured on a TPU applied
+        to the CPU backend (or vice versa) route kernels pathologically —
+        the dense/scatter ratio inverts between the two backends."""
         import json
         import os
 
@@ -106,9 +136,32 @@ class SessionConfig:
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "calibration.json",
         )
+        data = None
         if os.path.exists(p):
-            with open(p) as f:
-                data = json.load(f)
+            try:
+                with open(p) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                data = None
+            if not isinstance(data, dict):
+                data = None
+            if data is None:
+                _log().warning(
+                    "ignoring unreadable calibration file %s; using the "
+                    "platform cost profile", p,
+                )
+        if data is not None and data.get("device") not in (
+            None,
+            _current_device_str(),
+        ):
+            _log().warning(
+                "ignoring calibration file %s measured on %s (current "
+                "backend device is %s); using the platform cost profile — "
+                "rerun plan/calibrate.py on this backend",
+                p, data.get("device"), _current_device_str(),
+            )
+            data = None  # measured on a different backend: do not apply
+        if data is not None:
             for k in (
                 "cost_per_row_dense",
                 "cost_per_row_scatter",
@@ -120,7 +173,36 @@ class SessionConfig:
             ):
                 if k in data and data[k] is not None and data[k] > 0:
                     setattr(cfg, k, float(data[k]))
-        return cfg
+            return cfg
+        return cfg.apply_platform_profile()
+
+    def apply_platform_profile(self) -> "SessionConfig":
+        """Overwrite (in place) the v5e-flavoured default cost constants with
+        a profile matching the live backend when that backend is CPU.
+
+        The class defaults model an MXU: dense one-hot nearly free per lane
+        tile, scatter expensive (serialized updates).  XLA:CPU is the
+        opposite — segment_sum streams at memory bandwidth for any G while
+        the one-hot materializes B x G blocks (measured: scatter ~flat
+        450 Mrows/s from G=1 to G=8008; dense 42 Mrows/s at G=8, 7 Mrows/s
+        at G=64).  Without this, a fresh uncalibrated CPU session routes a
+        G=8008 GroupBy to dense: ~65 s instead of ~0.3 s at SF1.  Values
+        are a committed CPU calibration snapshot (plan/calibrate.py on
+        TFRT_CPU; see the round-3 session notes) — a real calibration run
+        still refines them."""
+        if _current_platform() != "cpu":
+            return self
+        self.cost_per_row_dense = 0.58
+        self.cost_per_row_scatter = 0.0012
+        self.cost_per_row_sparse = 0.49
+        self.cost_per_row_compact = 0.0012
+        self.cost_per_group_state = 0.0023
+        # "collective" on a CPU mesh is shared-memory copies and a local
+        # dispatch is function-call cheap — the ICI/RPC-flavoured defaults
+        # would misprice the distributed-vs-local choice
+        self.collective_bytes_per_us = 10_000.0
+        self.cost_dispatch_us = 100.0
+        return self
 
 
 @dataclasses.dataclass

@@ -196,6 +196,24 @@ def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
 # which dominates warm latency when the TPU sits behind a network tunnel.
 MULTI_SEGMENT_UNROLL_MAX = 32
 
+# On the CPU backend the fused mega-program is a double loss: XLA schedules
+# the long unrolled scatter/one-hot chain ~2x slower than the same work as
+# small programs (measured at SF2: 57 -> 111 Mrows/s on a G=504k scatter by
+# capping the unroll at 2), and compile time is brutal (~60 s for a
+# 12-segment unroll of an 8k-group scatter vs ~2 s for the pair program).
+# Local dispatch costs microseconds, so small batches only forgo RPC
+# amortization that CPU never needed; the cross-batch partial merge in
+# _partials_for_query is unchanged.
+CPU_SEGMENT_UNROLL_MAX = 2
+
+
+def _platform_unroll_max() -> int:
+    from ..config import _current_platform
+
+    if _current_platform() == "cpu":
+        return CPU_SEGMENT_UNROLL_MAX
+    return MULTI_SEGMENT_UNROLL_MAX
+
 # Consecutive sparse-path exception fallbacks before a query is pinned off
 # the accelerator (transient blips recover; deterministic failures stop
 # re-paying doomed trace+compiles).
@@ -321,6 +339,7 @@ class Engine(SparseExecMixin):
         batch pins every member's columns on device simultaneously, so an
         unbounded batch would defeat the residency budget)."""
         budget = self._device_cache.budget_bytes
+        unroll_max = _platform_unroll_max()
         batch: List[Segment] = []
         batch_bytes = 0
         for seg in segs:
@@ -328,7 +347,7 @@ class Engine(SparseExecMixin):
                 int(seg.column(n).nbytes) for n in names
             )
             if batch and (
-                len(batch) >= MULTI_SEGMENT_UNROLL_MAX
+                len(batch) >= unroll_max
                 or batch_bytes + est > budget
             ):
                 yield batch

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Persistent TPU-tunnel watch (VERDICT r2 #1: "run bench.py yourself
+# repeatedly during the round, committing any successful TPU JSON").
+#
+# Probes the accelerator backend every INTERVAL seconds, appending one line
+# per attempt to TPU_PROBE_LOG_r3.txt.  The moment a probe lands on a
+# non-CPU platform it runs the full TPU evidence pipeline:
+#   1. bench.py calibrate           -> TPU calibration.json
+#   2. pytest tests/test_pallas_kernel.py on the real backend (Mosaic)
+#   3. bench.py {ssb 1, tpch_q1, topn_hll, timeseries, cube_theta}
+#      each saved as BENCH_tpu_<mode>_r3.json
+# and drops a TPU_SUCCESS sentinel so the interactive session can commit.
+#
+# Run under tmux:  tmux new-session -d -s tpuwatch 'bash tools/tpu_watch.sh'
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_PROBE_LOG_r3.txt
+INTERVAL=${TPU_WATCH_INTERVAL:-240}
+N=$(grep -c 'attempt=' "$LOG" 2>/dev/null || echo 0)
+
+probe() {
+    timeout 90 python -c 'import jax; print(jax.devices()[0].platform)' \
+        2>/tmp/tpu_probe_err.txt
+}
+
+run_pipeline() {
+    local plat="$1"
+    echo "=== TPU pipeline start platform=$plat $(date -u +%FT%TZ)" >> "$LOG"
+    export SD_BENCH_PROBE_WINDOW_S=60 SD_BENCH_PROBE_INTERVAL_S=20
+    timeout 1800 python bench.py calibrate \
+        > BENCH_tpu_calibrate_r3.json 2>/tmp/tpu_cal_err.txt
+    echo "calibrate rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 900 python -m pytest tests/test_pallas_kernel.py -q \
+        > /tmp/tpu_pallas_tests.txt 2>&1
+    echo "pallas tests rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    local mode
+    for mode in "ssb 1" tpch_q1 topn_hll timeseries cube_theta; do
+        local name=${mode// /}
+        timeout 2400 python bench.py $mode \
+            > "BENCH_tpu_${name}_r3.json" 2>"/tmp/tpu_${name}_err.txt"
+        echo "bench $mode rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    done
+    date -u +%FT%TZ > TPU_SUCCESS
+    echo "=== TPU pipeline done $(date -u +%FT%TZ)" >> "$LOG"
+}
+
+while true; do
+    N=$((N + 1))
+    TS=$(date -u +%FT%TZ)
+    P=$(probe)
+    if [ -n "$P" ] && [ "$P" != "cpu" ]; then
+        echo "$TS attempt=$N SUCCESS platform=$P" >> "$LOG"
+        run_pipeline "$P"
+        exit 0
+    fi
+    ERR=$(tail -c 200 /tmp/tpu_probe_err.txt 2>/dev/null | tr '\n' ' ')
+    echo "$TS attempt=$N fail: ${P:-}${ERR}" >> "$LOG"
+    sleep "$INTERVAL"
+done
