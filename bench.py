@@ -63,10 +63,15 @@ def _calibrated_ctx():
 
 
 def _ensure_calibration():
-    """Calibrate once per backend (cheap, ~seconds); reuse the saved file
-    when it was measured on the same device kind."""
+    """Calibrate once per backend (cheap on CPU; compile-dominated and slow
+    over a tunneled TPU — SD_BENCH_SKIP_CALIBRATE=1 skips the measurement so
+    a short hardware window is spent on the bench itself); reuse the saved
+    file when it was measured on the same device kind."""
     import json as _json
     import os as _os
+
+    if _os.environ.get("SD_BENCH_SKIP_CALIBRATE") == "1":
+        return
 
     from spark_druid_olap_tpu.plan import calibrate as C
 
@@ -719,9 +724,12 @@ def main():
         result, err = _child(dict(os.environ), run_s)
         if result is None:
             degraded = True
-    if result is None:
+    if result is None and os.environ.get("SD_BENCH_NO_CPU_FALLBACK") != "1":
         # Backend unavailable/wedged or the accelerated run failed: rerun on
         # a sanitized CPU interpreter so the round still gets a number.
+        # (SD_BENCH_NO_CPU_FALLBACK=1 — set by the TPU watch loop — skips
+        # this rerun: inside a short hardware window a degraded CPU number
+        # is worthless and the rerun burns the window.)
         if platform is None:
             degraded = True
         cpu_result, cpu_err = _child(_cpu_env(), run_s)

@@ -18,8 +18,13 @@ probing".  Run with the DEFAULT environment (the axon PJRT hook on
 PYTHONPATH); the caller owns the timeout."""
 
 import json
+import os
 import sys
 import time
+
+# `python tools/tpu_smoke.py` puts tools/ (not the repo root) on sys.path;
+# the package is not installed, so make the repo root importable explicitly.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:

@@ -17,7 +17,10 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=TPU_PROBE_LOG_r3.txt
 INTERVAL=${TPU_WATCH_INTERVAL:-180}
-N=$(grep -c 'attempt=' "$LOG" 2>/dev/null || echo 0)
+# grep -c prints "0" AND exits 1 on zero matches — an `|| echo 0` fallback
+# would yield the two-line string "0\n0" and break the arithmetic below
+N=$(grep -c 'attempt=' "$LOG" 2>/dev/null)
+N=${N:-0}
 
 ts() { date -u +%FT%TZ; }
 
@@ -52,12 +55,19 @@ reprobe_alive() {
 run_window() {
     echo "=== window open $(ts)" >> "$LOG"
     export SD_BENCH_PROBE_WINDOW_S=30 SD_BENCH_PROBE_INTERVAL_S=15 SD_BENCH_PROBE_TIMEOUT_S=60
+    # Inside a window: never measure cost constants implicitly (compile-
+    # dominated, ~26 min observed on the tunneled chip — it burned window
+    # 94) and never rerun a failed bench on CPU (degraded numbers are
+    # rejected by bench_ok anyway and the rerun burns the window).
+    export SD_BENCH_SKIP_CALIBRATE=1 SD_BENCH_NO_CPU_FALLBACK=1
 
+    # Steps are ordered cheapest-evidence-first and NONE of them gates the
+    # later ones: a single flaky/broken step must not make the rest of the
+    # evidence permanently unreachable.  Only a dead tunnel ends the window.
     if ! smoke_ok; then
         timeout 300 python tools/tpu_smoke.py TPU_SMOKE_r3.json \
             >> /tmp/tpu_smoke_out.txt 2>&1
         echo "smoke rc=$? $(ts)" >> "$LOG"
-        smoke_ok || return
     fi
 
     if ! pallas_ok; then
@@ -66,23 +76,14 @@ run_window() {
             > TPU_PALLAS_TESTS_r3.txt.tmp 2>&1 \
             && mv TPU_PALLAS_TESTS_r3.txt.tmp TPU_PALLAS_TESTS_r3.txt
         echo "pallas tests rc=$? $(ts)" >> "$LOG"
-        pallas_ok || return
-    fi
-
-    if ! bench_ok BENCH_tpu_calibrate_r3.json; then
-        reprobe_alive || return
-        SD_BENCH_TIMEOUT_S=360 timeout 480 python bench.py calibrate \
-            > BENCH_tpu_calibrate_r3.json 2>/tmp/tpu_cal_err.txt
-        echo "calibrate rc=$? $(ts)" >> "$LOG"
-        bench_ok BENCH_tpu_calibrate_r3.json || return
     fi
 
     if ! bench_ok BENCH_tpu_ssb1_r3.json; then
         reprobe_alive || return
-        SD_BENCH_TIMEOUT_S=900 timeout 1000 python bench.py ssb 1 \
-            > BENCH_tpu_ssb1_r3.json 2>/tmp/tpu_ssb1_err.txt
+        SD_BENCH_TIMEOUT_S=1200 timeout 1300 python bench.py ssb 1 \
+            > BENCH_tpu_ssb1_r3.json.tmp 2>/tmp/tpu_ssb1_err.txt \
+            && mv BENCH_tpu_ssb1_r3.json.tmp BENCH_tpu_ssb1_r3.json
         echo "bench ssb 1 rc=$? $(ts)" >> "$LOG"
-        bench_ok BENCH_tpu_ssb1_r3.json || return
     fi
 
     local mode
@@ -90,14 +91,33 @@ run_window() {
         if ! bench_ok "BENCH_tpu_${mode}_r3.json"; then
             reprobe_alive || return
             SD_BENCH_TIMEOUT_S=600 timeout 700 python bench.py "$mode" \
-                > "BENCH_tpu_${mode}_r3.json" 2>"/tmp/tpu_${mode}_err.txt"
+                > "BENCH_tpu_${mode}_r3.json.tmp" 2>"/tmp/tpu_${mode}_err.txt" \
+                && mv "BENCH_tpu_${mode}_r3.json.tmp" "BENCH_tpu_${mode}_r3.json"
             echo "bench $mode rc=$? $(ts)" >> "$LOG"
-            bench_ok "BENCH_tpu_${mode}_r3.json" || return
         fi
     done
 
-    date -u +%FT%TZ > TPU_SUCCESS
-    echo "=== ALL TPU EVIDENCE CAPTURED $(ts)" >> "$LOG"
+    # Cost-constant calibration LAST: it is the most expensive step (~26 min
+    # observed over the tunnel) and the least essential evidence.  Needs a
+    # long stable window; until one appears every shorter window still
+    # captures smoke/pallas/bench evidence above.
+    if ! bench_ok BENCH_tpu_calibrate_r3.json; then
+        reprobe_alive || return
+        SD_BENCH_TIMEOUT_S=1800 timeout 1900 python bench.py calibrate \
+            > BENCH_tpu_calibrate_r3.json.tmp 2>/tmp/tpu_cal_err.txt \
+            && mv BENCH_tpu_calibrate_r3.json.tmp BENCH_tpu_calibrate_r3.json
+        echo "calibrate rc=$? $(ts)" >> "$LOG"
+        # calibration.json is gitignored; preserve TPU constants under a
+        # tracked name the session can commit
+        if bench_ok BENCH_tpu_calibrate_r3.json && [ -s calibration.json ]; then
+            cp calibration.json CALIBRATION_tpu_r3.json
+        fi
+    fi
+
+    if all_done; then
+        date -u +%FT%TZ > TPU_SUCCESS
+        echo "=== ALL TPU EVIDENCE CAPTURED $(ts)" >> "$LOG"
+    fi
 }
 
 all_done() {
@@ -111,6 +131,7 @@ all_done() {
 
 while true; do
     if all_done; then
+        [ -s TPU_SUCCESS ] || date -u +%FT%TZ > TPU_SUCCESS
         echo "=== watch exiting: all evidence captured $(ts)" >> "$LOG"
         exit 0
     fi
