@@ -91,6 +91,13 @@ def _plan_columns(lp: L.LogicalPlan) -> set:
             from_expr(e)
     elif isinstance(lp, L.Having):
         from_expr(lp.condition)
+    elif isinstance(lp, L.Window):
+        for w in lp.wins:
+            for e in (w.arg, w.filter, *w.partition, *w.order_exprs):
+                if e is not None:
+                    from_expr(e)
+        for _, e in lp.out_exprs:
+            from_expr(e)
     elif isinstance(lp, L.Sort):
         for k in lp.keys:
             from_expr(k.expr)
@@ -385,7 +392,9 @@ def _needs_all_columns(lp: L.LogicalPlan, under_project: bool = False) -> bool:
         # the inner plan decides its own needs (its _exec call passes
         # _needed=None anyway)
         return _needs_all_columns(lp.child, under_project)
-    up = under_project or isinstance(lp, (L.Project, L.Aggregate))
+    up = under_project or isinstance(
+        lp, (L.Project, L.Aggregate, L.Window)
+    )
     return any(_needs_all_columns(c, up) for c in lp.children())
 
 
@@ -395,6 +404,8 @@ def _select_list(lp: L.LogicalPlan):
     None = no explicit list (SELECT *): return everything non-internal."""
     if isinstance(lp, (L.Limit, L.Sort, L.Having)):
         return _select_list(lp.children()[0])
+    if isinstance(lp, L.Window):
+        return [n for n, _ in lp.out_exprs]
     if isinstance(lp, L.Union):
         # branch frames are already projected/aligned to the first
         # branch's names
@@ -737,13 +748,32 @@ def _resolve_plan_subqueries(lp: L.LogicalPlan, catalog) -> L.LogicalPlan:
             ),
             _resolve_plan_subqueries(lp.child, catalog),
         )
+    if isinstance(lp, L.Window):
+        return _dc.replace(
+            lp,
+            wins=tuple(
+                _dc.replace(
+                    w,
+                    arg=rx(w.arg),
+                    filter=rx_bool(w.filter),
+                    partition=tuple(rx(p) for p in w.partition),
+                    order_exprs=tuple(rx(o) for o in w.order_exprs),
+                )
+                for w in lp.wins
+            ),
+            out_exprs=tuple((n, rx(e)) for n, e in lp.out_exprs),
+            child=_resolve_plan_subqueries(lp.child, catalog),
+        )
     if isinstance(lp, (L.Limit, L.SubqueryScan)):
         return _dc.replace(
             lp, child=_resolve_plan_subqueries(lp.child, catalog)
         )
     if isinstance(lp, L.Union):
-        return L.Union(
-            tuple(_resolve_plan_subqueries(b, catalog) for b in lp.branches)
+        return _dc.replace(
+            lp,
+            branches=tuple(
+                _resolve_plan_subqueries(b, catalog) for b in lp.branches
+            ),
         )
     if isinstance(lp, L.Join):
         return _dc.replace(
@@ -857,6 +887,285 @@ def execute_fallback(
         _guard_max_rows.reset(token)
 
 
+class _Null:
+    """Sentinel standing in for SQL NULL inside set-operation row keys: set
+    operations (unlike = comparison) treat NULLs as equal, and the decoded
+    frames mix None / NaN / NaT representations that would not hash equal."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<null>"
+
+
+_NULL = _Null()
+
+
+def _row_keys(df: pd.DataFrame) -> list:
+    """Hashable per-row keys with every null representation collapsed to
+    one sentinel.  O(rows) Python — acceptable on the size-guarded
+    fallback path."""
+    arr = df.to_numpy(dtype=object)
+    na = pd.isna(arr)
+    return [
+        tuple(_NULL if na[i, j] else arr[i, j] for j in range(arr.shape[1]))
+        for i in range(arr.shape[0])
+    ]
+
+
+def _setop(op: str, frames: list) -> pd.DataFrame:
+    """SQL set-operation semantics over positionally-aligned frames.
+    Distinct variants keep the first occurrence of each row (from the
+    leftmost frame that has it); ALL variants follow bag algebra
+    (INTERSECT ALL: min multiplicity; EXCEPT ALL: left minus right)."""
+    from collections import Counter
+
+    if op == "union_all":
+        return pd.concat(frames, ignore_index=True)
+    if op == "union":
+        cat = pd.concat(frames, ignore_index=True)
+        keys = _row_keys(cat)
+        seen, keep = set(), []
+        for i, k in enumerate(keys):
+            if k not in seen:
+                seen.add(k)
+                keep.append(i)
+        return cat.iloc[keep].reset_index(drop=True)
+
+    left = frames[0]
+    lkeys = _row_keys(left)
+    rkey_counts = [Counter(_row_keys(f)) for f in frames[1:]]
+    if op in ("intersect", "intersect_all"):
+        # multiplicity budget per key: min across ALL branches (n-ary:
+        # intersect is associative); distinct variant caps it at 1
+        budget: dict = {}
+        for k in set(lkeys):
+            m = min(c[k] for c in rkey_counts)
+            if m:
+                budget[k] = 1 if op == "intersect" else m
+    elif op in ("except", "except_all"):
+        rc = rkey_counts[0]
+        if op == "except":
+            budget = {k: 1 for k in set(lkeys) if rc[k] == 0}
+        else:
+            budget = {}
+            for k, n in Counter(lkeys).items():
+                if n - rc[k] > 0:
+                    budget[k] = n - rc[k]
+    else:
+        raise NotImplementedError(f"set operation {op!r}")
+    # the distinct variants' budget of 1 also dedups left-side duplicates
+    keep = []
+    for i, k in enumerate(lkeys):
+        b = budget.get(k, 0)
+        if b:
+            budget[k] = b - 1
+            keep.append(i)
+    return left.iloc[keep].reset_index(drop=True)
+
+
+def _sort_codes(v: np.ndarray, ascending: bool) -> np.ndarray:
+    """Order-encoding of one sort key as int64 codes with NULLs always
+    LAST (matching the L.Sort node's na_position='last' convention),
+    honoring the ascending flag — lexsort-ready for any value dtype."""
+    codes, uniques = pd.factorize(pd.Series(v), sort=True)
+    k = len(uniques)
+    if not ascending:
+        codes = np.where(codes >= 0, k - 1 - codes, codes)
+    return np.where(codes < 0, k, codes).astype(np.int64)
+
+
+def _window_order(w: L.WindowExpr, df: pd.DataFrame, pid: np.ndarray):
+    """Global evaluation order for a window: partition-major, then the
+    OVER(ORDER BY ...) keys; returns (order, peer_codes) where `order`
+    holds original row positions sorted for evaluation and `peer_codes`
+    is an [n, m] int matrix whose row equality defines peer rows."""
+    n = len(df)
+    if not w.order_exprs:
+        return np.argsort(pid, kind="stable"), None
+    key_arrays = []
+    for oe, asc in zip(w.order_exprs, w.order_asc):
+        v = np.asarray(_eval(_refs_to_cols(oe), df))
+        key_arrays.append(_sort_codes(v, asc))
+    # np.lexsort: LAST key is primary -> (tiebreak, k_m..k_0, pid)
+    order = np.lexsort(
+        tuple([np.arange(n)] + key_arrays[::-1] + [pid])
+    )
+    return order, np.stack(key_arrays, axis=1)
+
+
+def _window_col(w: L.WindowExpr, df: pd.DataFrame) -> np.ndarray:
+    """Evaluate one window function over the frame.  Per-partition
+    Python/numpy — the fallback path is size-guarded, and explicit ROWS
+    frames are O(rows x frame) worst case."""
+    n = len(df)
+    res = np.empty(n, dtype=object)
+    if n == 0:
+        return res
+
+    if w.partition:
+        pcols = [
+            np.asarray(_eval(_refs_to_cols(p), df)) for p in w.partition
+        ]
+        ids: dict = {}
+        pid = np.empty(n, dtype=np.int64)
+        for i in range(n):
+            key = tuple(
+                _NULL if pd.isna(c[i]) else c[i] for c in pcols
+            )
+            pid[i] = ids.setdefault(key, len(ids))
+    else:
+        pid = np.zeros(n, dtype=np.int64)
+
+    order, peer_codes = _window_order(w, df, pid)
+
+    va = (
+        np.asarray(_eval(_refs_to_cols(w.arg), df))
+        if w.arg is not None
+        else None
+    )
+    fm = (
+        np.asarray(_filter_mask(w.filter, df)).astype(bool)
+        if w.filter is not None
+        else None
+    )
+
+    pid_sorted = pid[order]
+    starts = [0] + [
+        i for i in range(1, n) if pid_sorted[i] != pid_sorted[i - 1]
+    ] + [n]
+    for a, b in zip(starts[:-1], starts[1:]):
+        idxs = order[a:b]  # original row positions, evaluation order
+        _window_partition(w, idxs, peer_codes, va, fm, res)
+    return res
+
+
+def _window_partition(w, idxs, peer_codes, va, fm, res):
+    """Fill `res` for one partition (idxs = original row positions in
+    window order)."""
+    m = len(idxs)
+    fn = w.fn
+
+    # peer-group segmentation (rows equal on every ORDER BY key)
+    if peer_codes is not None:
+        pk = peer_codes[idxs]
+        new_peer = np.empty(m, dtype=bool)
+        new_peer[0] = True
+        if m > 1:
+            new_peer[1:] = (pk[1:] != pk[:-1]).any(axis=1)
+        peer_id = np.cumsum(new_peer) - 1  # 0-based dense peer index
+        peer_start = np.maximum.accumulate(
+            np.where(new_peer, np.arange(m), 0)
+        )
+        # end position (inclusive) of each row's peer group
+        peer_end = np.empty(m, dtype=np.int64)
+        last = m - 1
+        for i in range(m - 1, -1, -1):
+            peer_end[i] = last
+            if new_peer[i]:
+                last = i - 1
+    else:
+        peer_id = np.zeros(m, dtype=np.int64)
+        peer_start = np.zeros(m, dtype=np.int64)
+        peer_end = np.full(m, m - 1, dtype=np.int64)
+
+    if fn == "row_number":
+        for i in range(m):
+            res[idxs[i]] = i + 1
+        return
+    if fn == "rank":
+        for i in range(m):
+            res[idxs[i]] = int(peer_start[i]) + 1
+        return
+    if fn == "dense_rank":
+        for i in range(m):
+            res[idxs[i]] = int(peer_id[i]) + 1
+        return
+    if fn == "ntile":
+        k = int(w.args[0])
+        base, rem = divmod(m, k)
+        bucket_of = []
+        for bi in range(k):
+            bucket_of += [bi + 1] * (base + (1 if bi < rem else 0))
+        for i in range(m):
+            res[idxs[i]] = bucket_of[i] if i < len(bucket_of) else k
+        return
+    if fn in ("lag", "lead"):
+        off = int(w.args[0]) if w.args else 1
+        default = w.args[1] if len(w.args) > 1 else None
+        vp = va[idxs]
+        for i in range(m):
+            j = i - off if fn == "lag" else i + off
+            if 0 <= j < m:
+                v = vp[j]
+                res[idxs[i]] = None if pd.isna(v) else v
+            else:
+                res[idxs[i]] = default
+        return
+
+    # frame-based functions: first_value / last_value / sum / count /
+    # avg / min / max
+    def frame_bounds(i):
+        if w.frame is not None:
+            lo, hi = w.frame
+            lo_i = 0 if lo is None else max(0, i + lo)
+            hi_i = m - 1 if hi is None else min(m - 1, i + hi)
+            return lo_i, hi_i
+        if peer_codes is not None:
+            # default frame with ORDER BY: RANGE UNBOUNDED PRECEDING ..
+            # CURRENT ROW — includes the current row's peers
+            return 0, int(peer_end[i])
+        return 0, m - 1
+
+    vp = va[idxs] if va is not None else None
+    fmp = fm[idxs] if fm is not None else None
+
+    if fn in ("first_value", "last_value"):
+        for i in range(m):
+            lo_i, hi_i = frame_bounds(i)
+            if lo_i > hi_i:
+                res[idxs[i]] = None
+                continue
+            v = vp[lo_i] if fn == "first_value" else vp[hi_i]
+            res[idxs[i]] = None if pd.isna(v) else v
+        return
+
+    # aggregate over the frame (filter-aware, NULL-skipping)
+    for i in range(m):
+        lo_i, hi_i = frame_bounds(i)
+        if lo_i > hi_i:
+            res[idxs[i]] = 0 if fn == "count" else None
+            continue
+        sl = slice(lo_i, hi_i + 1)
+        rows = np.ones(hi_i - lo_i + 1, dtype=bool)
+        if fmp is not None:
+            rows &= fmp[sl]
+        if fn == "count" and vp is None:  # count(*)
+            res[idxs[i]] = int(rows.sum())
+            continue
+        vals = vp[sl][rows]
+        ok = ~pd.isna(vals)
+        vals = vals[ok]
+        if fn == "count":
+            res[idxs[i]] = int(len(vals))
+            continue
+        if len(vals) == 0:
+            res[idxs[i]] = None
+            continue
+        if fn == "min":
+            res[idxs[i]] = min(vals)  # min/max work on strings too
+        elif fn == "max":
+            res[idxs[i]] = max(vals)
+        else:
+            fvals = vals.astype(np.float64)
+            if fn == "sum":
+                res[idxs[i]] = float(fvals.sum())
+            elif fn == "avg":
+                res[idxs[i]] = float(fvals.mean())
+            else:
+                raise NotImplementedError(f"window function {fn!r}")
+
+
 def _exec(
     lp: L.LogicalPlan, catalog, _needed=None
 ) -> pd.DataFrame:
@@ -915,11 +1224,11 @@ def _exec(
         for f in frames[1:]:
             if len(f.columns) != len(first):
                 raise ValueError(
-                    "UNION ALL branch produced "
+                    f"{lp.op} branch produced "
                     f"{len(f.columns)} columns, expected {len(first)}"
                 )
             aligned.append(f.set_axis(list(first), axis=1))
-        return pd.concat(aligned, ignore_index=True)
+        return _setop(lp.op, aligned)
     if isinstance(lp, L.SubqueryScan):
         # scope boundary: the derived table exports exactly its SELECT
         # list; outer references to anything else must fail, not fall
@@ -963,6 +1272,25 @@ def _exec(
         if (new_groups, new_aggs) != (lp.group_exprs, lp.agg_exprs):
             lp = _dc.replace(lp, group_exprs=new_groups, agg_exprs=new_aggs)
         return _aggregate(lp, df)
+    if isinstance(lp, L.Window):
+        df = _exec(lp.child, catalog, _needed).copy()
+        for w in lp.wins:
+            df[w.name] = _window_col(w, df)
+        # evaluate every output against the UNMUTATED frame first, then
+        # assign: a SELECT alias shadowing a source column (v+1 AS v) must
+        # not corrupt later items that read the original column
+        new_cols = {}
+        for name, e in lp.out_exprs:
+            if isinstance(e, E.Col) and e.name in df.columns:
+                new_cols[name] = df[e.name]
+                continue
+            e2, dfx = _materialize_correlated(
+                _refs_to_cols(e), df, catalog
+            )
+            new_cols[name] = _eval(e2, dfx)
+        for name, v in new_cols.items():
+            df[name] = v
+        return df
     if isinstance(lp, L.Having):
         df = _exec(lp.child, catalog, _needed)
         if not len(df):

@@ -152,17 +152,70 @@ class Limit(LogicalPlan):
 
 @dataclasses.dataclass(frozen=True)
 class Union(LogicalPlan):
-    """UNION ALL: branches aligned by position, column names from the
-    first branch.  Not pushable (the reference fell back to Spark); the
-    host fallback concatenates branch frames."""
+    """SQL set operation: branches aligned by position, column names from
+    the first branch.  Not pushable (the reference fell back to Spark for
+    every set operation); the host fallback implements the semantics.
+
+    `op` is one of:
+      union_all      bag concatenation
+      union          set union (distinct rows; NULLs compare equal)
+      intersect      set intersection (distinct)
+      intersect_all  bag intersection (per-row multiplicity = min of counts)
+      except         set difference (distinct left rows absent from right)
+      except_all     bag difference (multiplicity = left count - right count)
+
+    union_all / union / intersect / intersect_all are associative and may
+    be n-ary; except / except_all are built strictly binary (left fold)."""
 
     branches: Tuple[LogicalPlan, ...]
+    op: str = "union_all"
 
     def children(self):
         return self.branches
 
     def _label(self):
-        return f"Union(all, {len(self.branches)} branches)"
+        return f"Union({self.op}, {len(self.branches)} branches)"
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowExpr:
+    """One window-function column.  `frame` is a pair of row offsets
+    relative to the current row, inclusive: -N = N PRECEDING, 0 = CURRENT
+    ROW, +N = N FOLLOWING, None = UNBOUNDED on that side.  A frame of
+    None (no explicit frame) means the SQL default: with ORDER BY, RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW (peer rows included); without,
+    the whole partition."""
+
+    name: str
+    fn: str
+    arg: Optional["Expr"]  # None for row_number/rank/dense_rank/count(*)
+    args: tuple = ()  # literal extras: NTILE n, LAG/LEAD offset + default
+    filter: Optional["Expr"] = None  # FILTER (WHERE ...) on window aggs
+    partition: Tuple["Expr", ...] = ()
+    order_exprs: Tuple["Expr", ...] = ()
+    order_asc: Tuple[bool, ...] = ()
+    frame: Optional[Tuple[Optional[int], Optional[int]]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Window(LogicalPlan):
+    """Window-function evaluation over the child's frame (the reference
+    fell back to Spark for every OVER clause; here the host fallback
+    implements the semantics).  `wins` computes one hidden column per
+    window call; `out_exprs` is the full SELECT-order output list — a
+    plain Col(name) passes a child column through, anything else is
+    evaluated over the frame (with window columns visible)."""
+
+    wins: Tuple[WindowExpr, ...]
+    out_exprs: Tuple[Tuple[str, "Expr"], ...]
+    child: LogicalPlan
+
+    def children(self):
+        return (self.child,)
+
+    def _label(self):
+        fns = ", ".join(f"{w.fn}->{w.name}" for w in self.wins)
+        return f"Window([{fns}])"
 
 
 @dataclasses.dataclass(frozen=True)

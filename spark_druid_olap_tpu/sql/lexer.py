@@ -27,6 +27,7 @@ KEYWORDS = {
     "cube", "rollup", "grouping", "sets", "date", "timestamp", "interval",
     "case", "when", "then", "else", "end", "cast", "extract", "filter",
     "explain", "rewrite", "union", "all", "true", "false", "exists",
+    "intersect", "except",
 }
 
 _TWO_CHAR = {"<=", ">=", "<>", "!=", "=="}

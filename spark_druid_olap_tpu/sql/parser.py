@@ -24,6 +24,15 @@ from .lexer import Token, tokenize
 
 AGG_FNS = {"sum", "count", "avg", "min", "max", "approx_count_distinct"}
 
+#: functions that only exist with an OVER clause (ranking / offset family);
+#: aggregate functions become window calls when OVER follows them
+WINDOW_FNS = {
+    "row_number", "rank", "dense_rank", "ntile",
+    "lag", "lead", "first_value", "last_value",
+}
+#: aggregates legal inside OVER (sketches/quantiles are not)
+WINDOW_AGG_FNS = {"sum", "count", "avg", "min", "max"}
+
 
 class ParseError(Exception):
     pass
@@ -48,6 +57,40 @@ class AggCall(E.Expr):
         return f"{self.fn}({'DISTINCT ' if self.distinct else ''}{inner}{extra})"
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowCall(E.Expr):
+    """Parser-level `fn(...) OVER (...)`; the analyzer lifts these into
+    `L.Window` specs and replaces them with hidden-column Col refs.  Field
+    layout mirrors `L.WindowExpr` (flat Expr tuples, so the generic
+    dataclass walkers — _strip_qualifiers, _contains_agg, columns() —
+    traverse the spec without special cases)."""
+
+    fn: str
+    arg: Optional[E.Expr]
+    args: tuple = ()  # literal extras: NTILE n, LAG/LEAD offset + default
+    filter: Optional[E.Expr] = None
+    partition: Tuple[E.Expr, ...] = ()
+    order_exprs: Tuple[E.Expr, ...] = ()
+    order_asc: Tuple[bool, ...] = ()
+    frame: Optional[tuple] = None
+
+    def __str__(self):
+        inner = "*" if self.arg is None else str(self.arg)
+        extra = "".join(f", {a}" for a in self.args)
+        pb = " PARTITION BY " + ", ".join(map(str, self.partition)) if self.partition else ""
+        ob = (
+            " ORDER BY "
+            + ", ".join(
+                f"{e}{'' if a else ' DESC'}"
+                for e, a in zip(self.order_exprs, self.order_asc)
+            )
+            if self.order_exprs
+            else ""
+        )
+        fr = f" ROWS {self.frame}" if self.frame is not None else ""
+        return f"{self.fn}({inner}{extra}) OVER ({pb}{ob}{fr})".strip()
+
+
 @dataclasses.dataclass
 class SelectStmt:
     items: List[Tuple[Optional[str], E.Expr]]  # (alias, expr)
@@ -66,10 +109,15 @@ class SelectStmt:
 
 @dataclasses.dataclass
 class UnionStmt:
-    """UNION ALL chain; order/limit hoisted from the last branch apply to
-    the combined result (column names come from the first branch)."""
+    """Set-operation chain (UNION [ALL] / INTERSECT [ALL] / EXCEPT [ALL]);
+    `ops[i]` connects branches[i] and branches[i+1].  Kept flat at parse
+    time; `parse_sql` folds it into a logical tree with SQL precedence
+    (INTERSECT binds tighter than UNION/EXCEPT, both left-associative).
+    Trailing ORDER BY / LIMIT from the last branch apply to the combined
+    result (column names come from the first branch)."""
 
     branches: List[SelectStmt]
+    ops: List[str]
     order_by: List[Tuple[E.Expr, bool]]
     limit: Optional[int]
     offset: int
@@ -162,8 +210,17 @@ class Parser:
         stmt = self.select()
         stmt.explain = explain
         branches = [stmt]
-        while self.accept_kw("union"):
-            self.expect_kw("all")  # bag-semantics UNION ALL only
+        ops: List[str] = []
+        while True:
+            kw = self.accept_kw("union", "intersect", "except")
+            if kw is None:
+                break
+            if kw == "union":
+                # UNION DISTINCT == plain UNION
+                mod = self.accept_kw("all", "distinct")
+                ops.append("union_all" if mod == "all" else "union")
+            else:
+                ops.append(kw + ("_all" if self.accept_kw("all") else ""))
             branches.append(self.select())
         if self.accept_op(";"):
             pass
@@ -172,10 +229,11 @@ class Parser:
         if len(branches) == 1:
             return stmt
         # the trailing ORDER BY / LIMIT the last branch parsed belong to
-        # the whole union (SQL forbids them before UNION)
+        # the whole set operation (SQL forbids them before UNION et al.)
         last = branches[-1]
         out = UnionStmt(
             branches=branches,
+            ops=ops,
             order_by=last.order_by,
             limit=last.limit,
             offset=last.offset,
@@ -188,17 +246,17 @@ class Parser:
             if b.order_by or b.limit is not None or b.offset:
                 raise ParseError(
                     "ORDER BY/LIMIT/OFFSET is only valid after the last "
-                    "UNION ALL branch"
+                    "set-operation branch"
                 )
         for b in branches:
             if len(b.items) != len(branches[0].items):
                 raise ParseError(
-                    "UNION ALL branches have different column counts"
+                    "set-operation branches have different column counts"
                 )
             if any(
                 isinstance(e, E.Col) and e.name == "*" for _, e in b.items
             ):
-                raise ParseError("SELECT * in UNION ALL unsupported")
+                raise ParseError("SELECT * in a set operation unsupported")
         return out
 
     def select(self) -> SelectStmt:
@@ -634,7 +692,7 @@ class Parser:
         if t.kind == "IDENT" or t.kind == "KW":
             name = self.expect_ident()
             if self.accept_op("("):
-                return self._call(name.lower())
+                return self._maybe_over(self._call(name.lower()))
             if self.accept_op("."):
                 col = self.expect_ident()
                 return E.Col(f"{name}.{col}")
@@ -685,6 +743,134 @@ class Parser:
         for c, v in reversed(whens):
             out = E.IfExpr(c, v, out)
         return out
+
+    # -- window clauses ------------------------------------------------------
+
+    def _accept_word(self, *words: str) -> Optional[str]:
+        """Contextual (non-reserved) word: OVER/PARTITION/ROWS/... match as
+        plain identifiers so they stay usable as column names elsewhere."""
+        t = self.peek()
+        if t.kind in ("IDENT", "KW") and t.value.lower() in words:
+            self.next()
+            return t.value.lower()
+        return None
+
+    def _expect_word(self, word: str):
+        if not self._accept_word(word):
+            raise ParseError(
+                f"expected {word.upper()} at {self.peek().value!r}"
+            )
+
+    def _maybe_over(self, e: E.Expr) -> E.Expr:
+        """Attach an OVER clause to the call that just parsed."""
+        if not (
+            self.peek().kind in ("IDENT", "KW")
+            and self.peek().value.lower() == "over"
+            and self.toks[self.i + 1].kind == "OP"
+            and self.toks[self.i + 1].value == "("
+        ):
+            if isinstance(e, WindowCall):
+                raise ParseError(f"{e.fn.upper()} requires an OVER clause")
+            return e
+        self.next()  # over
+        self.expect_op("(")
+        partition, order_exprs, order_asc, frame = self._over_clause()
+        if isinstance(e, WindowCall):
+            base = e
+        elif isinstance(e, AggCall):
+            if e.distinct:
+                raise ParseError(
+                    "DISTINCT aggregates in an OVER clause are unsupported"
+                )
+            if e.fn not in WINDOW_AGG_FNS:
+                raise ParseError(
+                    f"{e.fn.upper()} cannot be used as a window function"
+                )
+            base = WindowCall(e.fn, e.arg, e.args, filter=e.filter)
+        else:
+            raise ParseError("OVER must follow a function call")
+        if base.fn in ("rank", "dense_rank", "ntile", "lag", "lead"):
+            if not order_exprs:
+                raise ParseError(
+                    f"{base.fn.upper()} requires ORDER BY in its OVER clause"
+                )
+            if frame is not None:
+                raise ParseError(
+                    f"{base.fn.upper()} does not accept a frame clause"
+                )
+        return dataclasses.replace(
+            base,
+            partition=tuple(partition),
+            order_exprs=tuple(order_exprs),
+            order_asc=tuple(order_asc),
+            frame=frame,
+        )
+
+    def _over_clause(self):
+        """Parses the body of OVER ( ... ) up to and including the `)`."""
+        partition: List[E.Expr] = []
+        if self._accept_word("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept_op(","):
+                partition.append(self.expr())
+        order_exprs: List[E.Expr] = []
+        order_asc: List[bool] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                order_exprs.append(self.expr())
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                else:
+                    self.accept_kw("asc")
+                order_asc.append(asc)
+                if not self.accept_op(","):
+                    break
+        frame = None
+        if self._accept_word("range"):
+            raise ParseError("RANGE frames unsupported; use ROWS")
+        if self._accept_word("rows"):
+            if self.accept_kw("between"):
+                lo = self._frame_bound()
+                self.expect_kw("and")
+                hi = self._frame_bound()
+            else:
+                lo = self._frame_bound()
+                hi = 0
+            if lo == "+inf":
+                raise ParseError("frame start cannot be UNBOUNDED FOLLOWING")
+            if hi == "-inf":
+                raise ParseError("frame end cannot be UNBOUNDED PRECEDING")
+            lo_v = None if lo == "-inf" else lo
+            hi_v = None if hi == "+inf" else hi
+            if lo_v is not None and hi_v is not None and lo_v > hi_v:
+                raise ParseError("frame start is after frame end")
+            if not order_exprs:
+                raise ParseError("a ROWS frame requires ORDER BY")
+            frame = (lo_v, hi_v)
+        self.expect_op(")")
+        return partition, order_exprs, order_asc, frame
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING|FOLLOWING / CURRENT ROW / N PRECEDING|FOLLOWING
+        -> "-inf" / "+inf" / 0 / -N / +N (row offsets relative to current)."""
+        if self._accept_word("unbounded"):
+            d = self._accept_word("preceding", "following")
+            if d is None:
+                raise ParseError("expected PRECEDING or FOLLOWING")
+            return "-inf" if d == "preceding" else "+inf"
+        if self._accept_word("current"):
+            self._expect_word("row")
+            return 0
+        e = self._primary()
+        if not isinstance(e, E.Literal) or not isinstance(e.value, int):
+            raise ParseError("frame offset must be an integer literal")
+        d = self._accept_word("preceding", "following")
+        if d is None:
+            raise ParseError("expected PRECEDING or FOLLOWING")
+        return -e.value if d == "preceding" else e.value
 
     def _filter_clause(self) -> Optional[E.Expr]:
         """Optional SQL `FILTER (WHERE <cond>)` after an aggregate call."""
@@ -863,6 +1049,53 @@ class Parser:
             for a in reversed(args[:-1]):
                 out = E.IfExpr(E.Comparison("!=", a, E.Literal(None)), a, out)
             return out
+        if fn in WINDOW_FNS:
+            # the OVER clause itself attaches in _maybe_over
+            if fn in ("row_number", "rank", "dense_rank"):
+                self.expect_op(")")
+                return WindowCall(fn, None)
+            if fn == "ntile":
+                k = self.expr()
+                self.expect_op(")")
+                if not isinstance(k, E.Literal) or not isinstance(
+                    k.value, int
+                ) or k.value < 1:
+                    raise ParseError(
+                        "NTILE requires a positive integer literal"
+                    )
+                return WindowCall(fn, None, (k.value,))
+            if fn in ("lag", "lead"):
+                arg = self.expr()
+                args: tuple = ()
+                if self.accept_op(","):
+                    off = self.expr()
+                    if not isinstance(off, E.Literal) or not isinstance(
+                        off.value, int
+                    ) or off.value < 0:
+                        raise ParseError(
+                            f"{fn.upper()} offset must be a non-negative "
+                            "integer literal"
+                        )
+                    args = (off.value,)
+                    if self.accept_op(","):
+                        d = self.expr()
+                        if (
+                            isinstance(d, E.UnaryOp)
+                            and d.op == "-"
+                            and isinstance(d.operand, E.Literal)
+                        ):
+                            d = E.Literal(-d.operand.value)
+                        if not isinstance(d, E.Literal):
+                            raise ParseError(
+                                f"{fn.upper()} default must be a literal"
+                            )
+                        args = args + (d.value,)
+                self.expect_op(")")
+                return WindowCall(fn, arg, args)
+            # first_value / last_value
+            arg = self.expr()
+            self.expect_op(")")
+            return WindowCall(fn, arg)
         raise ParseError(f"unknown function {fn!r}")
 
 
@@ -883,6 +1116,9 @@ def _find_group(e: E.Expr, group_keys: Sequence[E.Expr]) -> Optional[int]:
 
 
 def _contains_agg(e: E.Expr) -> bool:
+    # NOTE: deliberately descends into WindowCall specs — an AggCall inside
+    # an OVER clause (RANK() OVER (ORDER BY SUM(v))) makes the query an
+    # aggregate query, while the window function itself does not
     if isinstance(e, AggCall):
         return True
     for f in dataclasses.fields(e):  # type: ignore[arg-type]
@@ -891,6 +1127,20 @@ def _contains_agg(e: E.Expr) -> bool:
             return True
         if isinstance(v, tuple) and any(
             isinstance(x, E.Expr) and _contains_agg(x) for x in v
+        ):
+            return True
+    return False
+
+
+def _contains_window(e: E.Expr) -> bool:
+    if isinstance(e, WindowCall):
+        return True
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr) and _contains_window(v):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, E.Expr) and _contains_window(x) for x in v
         ):
             return True
     return False
@@ -923,9 +1173,15 @@ class Analyzer:
         self.aliases = aliases
         self.agg_exprs: List[L.AggExpr] = []
         self.agg_by_key: Dict[str, str] = {}  # str(AggCall) -> assigned name
+        self.win_exprs: List[L.WindowExpr] = []
+        # (output name, group-key expr) pairs — window specs over an
+        # aggregated frame must reference group keys by their OUTPUT names
+        # (GROUP BY g with `g AS grp` yields a frame column `grp`, not `g`)
+        self._win_groups: List[Tuple[str, E.Expr]] = []
 
     def to_logical(self) -> L.LogicalPlan:
         stmt = self.stmt
+        self._check_window_positions(stmt)
         base = self._from_clause(stmt.table)
         if stmt.where is not None:
             base = L.Filter(_strip_qualifiers(stmt.where, self.aliases), base)
@@ -935,6 +1191,11 @@ class Analyzer:
             or any(_contains_agg(e) for _, e in stmt.items)
             or (stmt.having is not None)
         )
+        has_window = any(_contains_window(e) for _, e in stmt.items)
+        if stmt.distinct and has_window:
+            raise ParseError(
+                "SELECT DISTINCT with window functions unsupported"
+            )
         if stmt.distinct:
             if has_agg:
                 # grouped output rows are already distinct per group in the
@@ -958,6 +1219,20 @@ class Analyzer:
             self.stmt = stmt
             has_agg = True
         if not has_agg:
+            if has_window:
+                out_exprs = []
+                for alias, e in stmt.items:
+                    if isinstance(e, E.Col) and e.name == "*":
+                        raise ParseError(
+                            "SELECT * cannot be mixed with window functions"
+                        )
+                    es = _strip_qualifiers(e, self.aliases)
+                    name = alias or _auto_name(es)
+                    out_exprs.append((name, self._lift_windows(es)))
+                plan = L.Window(
+                    tuple(self.win_exprs), tuple(out_exprs), base
+                )
+                return self._order_limit(plan, post_agg=False)
             exprs = []
             for alias, e in stmt.items:
                 if isinstance(e, E.Col) and e.name == "*":
@@ -989,23 +1264,38 @@ class Analyzer:
             group_exprs.append((name or _auto_name(ge_s), ge_s))
             group_keys.append(ge_s)
 
-        # SELECT items -> outputs
+        # SELECT items -> outputs.  Window-containing items skip the
+        # Aggregate's post_exprs entirely: their windows (and any
+        # aggregates inside or around them) are computed in an L.Window
+        # stage ABOVE the Aggregate/Having, referencing the aggregated
+        # frame's group/agg columns.  `out_exprs` preserves SELECT order
+        # for the Window stage when one is needed.
         post_exprs: List[Tuple[str, E.Expr]] = []
+        out_exprs: List[Tuple[str, E.Expr]] = []
+        self._win_groups = list(group_exprs)
         for alias, e in stmt.items:
             es = _strip_qualifiers(e, self.aliases)
+            if _contains_window(es):
+                name = alias or _auto_name(es)
+                lifted = self._lift_windows(es)
+                if _contains_agg(lifted):
+                    lifted = self._lift_aggs(lifted, name, _top=False)
+                out_exprs.append((name, self._sub_group_refs(lifted)))
+                continue
             if _contains_agg(es):
                 name = alias or _auto_name(es)
                 post = self._lift_aggs(es, name)
                 post_exprs.append((name, post))
+                out_exprs.append((name, E.Col(name)))
             else:
                 idx = _find_group(es, group_keys)
                 if idx is None:
                     raise ParseError(
                         f"SELECT item {e} is neither aggregated nor grouped"
                     )
-                post_exprs.append(
-                    (alias or group_exprs[idx][0], E.Col(group_exprs[idx][0]))
-                )
+                name = alias or group_exprs[idx][0]
+                post_exprs.append((name, E.Col(group_exprs[idx][0])))
+                out_exprs.append((name, E.Col(name)))
 
         having_expr = None
         if stmt.having is not None:
@@ -1047,6 +1337,10 @@ class Analyzer:
         )
         if having_expr is not None:
             plan = L.Having(having_expr, plan)
+        if self.win_exprs:
+            # windows see the post-HAVING aggregated frame (SQL evaluation
+            # order: ... HAVING -> window functions -> ORDER BY)
+            plan = L.Window(tuple(self.win_exprs), tuple(out_exprs), plan)
         return self._order_limit(plan, post_agg=True)
 
     # -- helpers -------------------------------------------------------------
@@ -1094,6 +1388,99 @@ class Analyzer:
                 if alias == ge.name and not _contains_agg(ie):
                     return ie
         return ge
+
+    def _sub_group_refs(self, e: E.Expr) -> E.Expr:
+        """Replace subtrees equal to a GROUP BY key with the key's OUTPUT
+        column (no-op outside aggregate queries; aggregates were already
+        lifted to AggRefs before this runs)."""
+        if e is None or not self._win_groups:
+            return e
+        for name, ge in self._win_groups:
+            if e == ge:
+                return E.Col(name)
+        if isinstance(e, (E.Literal, E.Col, E.AggRef)):
+            return e
+        kw = {}
+        for f in dataclasses.fields(e):  # type: ignore[arg-type]
+            v = getattr(e, f.name)
+            if isinstance(v, E.Expr):
+                kw[f.name] = self._sub_group_refs(v)
+            elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+                kw[f.name] = tuple(self._sub_group_refs(x) for x in v)
+            else:
+                kw[f.name] = v
+        return type(e)(**kw)
+
+    def _check_window_positions(self, stmt: SelectStmt):
+        """Window functions are legal only in the SELECT list (SQL: they
+        evaluate after WHERE/GROUP BY/HAVING; ORDER BY must reference the
+        SELECT alias)."""
+        if stmt.where is not None and _contains_window(stmt.where):
+            raise ParseError("window functions are not allowed in WHERE")
+        for ge in stmt.group_by:
+            if _contains_window(ge):
+                raise ParseError("window functions are not allowed in GROUP BY")
+        if stmt.having is not None and _contains_window(stmt.having):
+            raise ParseError("window functions are not allowed in HAVING")
+        for e, _ in stmt.order_by:
+            if _contains_window(e):
+                raise ParseError(
+                    "window functions in ORDER BY: reference the window's "
+                    "SELECT alias instead"
+                )
+
+    def _lift_windows(self, e: E.Expr, _in_agg_arg: bool = False) -> E.Expr:
+        """Replace WindowCall subtrees with hidden-column Col refs,
+        accumulating `win_exprs`.  Aggregates inside a window spec (RANK()
+        OVER (ORDER BY SUM(v))) lift to hidden agg names so the spec
+        evaluates over the aggregated frame."""
+        if isinstance(e, WindowCall):
+            if _in_agg_arg:
+                raise ParseError(
+                    "window functions cannot appear inside aggregate "
+                    "arguments"
+                )
+
+            def inner(x):
+                if x is None:
+                    return None
+                if _contains_window(x):
+                    raise ParseError("nested window functions unsupported")
+                if _contains_agg(x):
+                    x = self._lift_aggs(x, "win", _top=False)
+                return self._sub_group_refs(x)
+
+            spec = L.WindowExpr(
+                name=f"__win{len(self.win_exprs)}",
+                fn=e.fn,
+                arg=inner(e.arg),
+                args=e.args,
+                filter=inner(e.filter),
+                partition=tuple(inner(p) for p in e.partition),
+                order_exprs=tuple(inner(o) for o in e.order_exprs),
+                order_asc=e.order_asc,
+                frame=e.frame,
+            )
+            for w in self.win_exprs:  # dedup identical window calls
+                if dataclasses.replace(w, name=spec.name) == spec:
+                    return E.Col(w.name)
+            self.win_exprs.append(spec)
+            return E.Col(spec.name)
+        if isinstance(e, (E.Literal, E.Col, E.AggRef)):
+            return e
+        in_agg = _in_agg_arg or isinstance(e, AggCall)
+        kw = {}
+        for f in dataclasses.fields(e):  # type: ignore[arg-type]
+            v = getattr(e, f.name)
+            if isinstance(v, E.Expr):
+                kw[f.name] = self._lift_windows(v, in_agg)
+            elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+                kw[f.name] = tuple(
+                    self._lift_windows(x, in_agg) for x in v
+                )
+            else:
+                kw[f.name] = v
+        return type(e)(**kw)
 
     def _lift_aggs(self, e: E.Expr, hint: str, _top: bool = True) -> E.Expr:
         """Replace AggCall subtrees with AggRefs, accumulating agg_exprs.
@@ -1191,15 +1578,51 @@ def _stmt_out_names(stmt: SelectStmt, aliases) -> List[str]:
     return out_names
 
 
+#: set operations that are associative — consecutive same-op branches
+#: flatten into one n-ary Union node (EXCEPT is not associative: it stays
+#: strictly binary under the standard left fold)
+_ASSOCIATIVE_SETOPS = {"union_all", "union", "intersect", "intersect_all"}
+
+
+def _fold_setops(plans, ops) -> L.LogicalPlan:
+    """Fold a flat set-operation chain into a logical tree with SQL
+    precedence: INTERSECT [ALL] binds tighter than UNION/EXCEPT, all
+    left-associative.  `A UNION B INTERSECT C` == `A UNION (B INTERSECT C)`."""
+
+    def join(left: L.LogicalPlan, op: str, right: L.LogicalPlan):
+        if (
+            op in _ASSOCIATIVE_SETOPS
+            and isinstance(left, L.Union)
+            and left.op == op
+        ):
+            return L.Union(left.branches + (right,), op=op)
+        return L.Union((left, right), op=op)
+
+    # pass 1: bind INTERSECT [ALL] runs
+    terms = [plans[0]]
+    term_ops = []
+    for op, p in zip(ops, plans[1:]):
+        if op.startswith("intersect"):
+            terms[-1] = join(terms[-1], op, p)
+        else:
+            term_ops.append(op)
+            terms.append(p)
+    # pass 2: left fold UNION / EXCEPT
+    plan = terms[0]
+    for op, p in zip(term_ops, terms[1:]):
+        plan = join(plan, op, p)
+    return plan
+
+
 def parse_sql(sql: str) -> Tuple[L.LogicalPlan, bool, List[str]]:
     """Returns (logical plan, explain?, SELECT-order output names)."""
     p = Parser(sql)
     stmt = p.parse()
     if isinstance(stmt, UnionStmt):
-        plans = tuple(
+        plans = [
             Analyzer(b, dict(p.aliases)).to_logical() for b in stmt.branches
-        )
-        plan: L.LogicalPlan = L.Union(plans)
+        ]
+        plan = _fold_setops(plans, stmt.ops)
         first = stmt.branches[0]
         if stmt.order_by:
             # mirror Analyzer._order_limit's resolution: ordinals bind to
@@ -1210,8 +1633,8 @@ def parse_sql(sql: str) -> Tuple[L.LogicalPlan, bool, List[str]]:
                 es = _strip_qualifiers(e, p.aliases)
                 if _contains_agg(es):
                     raise ParseError(
-                        "ORDER BY after UNION ALL must reference output "
-                        "columns, not aggregates"
+                        "ORDER BY after a set operation must reference "
+                        "output columns, not aggregates"
                     )
                 if isinstance(es, E.Literal) and isinstance(es.value, int):
                     idx = es.value - 1

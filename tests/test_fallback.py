@@ -334,7 +334,7 @@ def test_union_all_offset_and_ordinal(ctx):
 def test_union_all_branch_order_rejected(ctx):
     from spark_druid_olap_tpu.sql.parser import ParseError
 
-    with pytest.raises(ParseError, match="last UNION ALL branch"):
+    with pytest.raises(ParseError, match="last set-operation branch"):
         ctx.sql(
             "SELECT k FROM fact ORDER BY k LIMIT 2 "
             "UNION ALL SELECT k FROM fact"

@@ -1,0 +1,209 @@
+"""SQL set operations (UNION [ALL|DISTINCT] / INTERSECT [ALL] /
+EXCEPT [ALL]) through the host fallback.
+
+Reference parity: the reference never pushed set operations to Druid —
+they ran as vanilla Spark plans (SURVEY.md §3.2 fallback semantics).  Here
+they parse into an `L.Union(op=...)` tree (INTERSECT binds tighter than
+UNION/EXCEPT, left-associative) and execute on the fallback interpreter
+with SQL semantics: distinct variants dedup with NULLs comparing EQUAL,
+ALL variants follow bag algebra (min / left-minus-right multiplicities).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.sql.parser import ParseError, parse_sql
+from spark_druid_olap_tpu.plan import logical as L
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    # small, hand-written tables so multiplicities are exactly controlled
+    c.register_table(
+        "t1",
+        {
+            "g": np.array(["a", "a", "b", "b", "c", None], dtype=object),
+            "x": np.array([1, 1, 2, 3, 4, 5], dtype=np.int64),
+        },
+        dimensions=["g", "x"],
+    )
+    c.register_table(
+        "t2",
+        {
+            "g": np.array(["a", "b", "c", "c", None], dtype=object),
+            "x": np.array([1, 2, 4, 4, 5], dtype=np.int64),
+        },
+        dimensions=["g", "x"],
+    )
+    return c
+
+
+_N = "·N"  # sortable stand-in for NULL in expected-row comparisons
+
+
+def _rows(df):
+    return sorted(
+        tuple(_N if pd.isna(v) else v for v in r)
+        for r in df.itertuples(index=False)
+    )
+
+
+# t1 bag: (a,1)x2 (b,2) (b,3) (c,4) (NULL,5)
+# t2 bag: (a,1) (b,2) (c,4)x2 (NULL,5)
+
+
+def test_union_distinct_dedups_and_nulls_equal(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 UNION SELECT g, x FROM t2")
+    assert _rows(got) == sorted(
+        [("a", 1), ("b", 2), ("b", 3), ("c", 4), (_N, 5)]
+    )
+
+
+def test_union_distinct_keyword(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 UNION DISTINCT SELECT g, x FROM t2")
+    assert len(got) == 5
+
+
+def test_union_all_multiplicity(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 UNION ALL SELECT g, x FROM t2")
+    assert len(got) == 11
+
+
+def test_intersect_distinct(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 INTERSECT SELECT g, x FROM t2")
+    # NULL row is common to both and NULLs compare equal in set ops
+    assert _rows(got) == sorted([("a", 1), ("b", 2), ("c", 4), (_N, 5)])
+
+
+def test_intersect_all_min_multiplicity(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 INTERSECT ALL SELECT g, x FROM t2")
+    # (a,1): min(2,1)=1; (b,2): 1; (c,4): min(1,2)=1; (NULL,5): 1
+    assert len(got) == 4
+
+
+def test_except_distinct(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 EXCEPT SELECT g, x FROM t2")
+    assert _rows(got) == [("b", 3)]
+
+
+def test_except_all_bag_difference(ctx):
+    got = ctx.sql("SELECT g, x FROM t1 EXCEPT ALL SELECT g, x FROM t2")
+    # (a,1): 2-1=1 copy survives; (b,3): 1-0=1
+    assert _rows(got) == sorted([("a", 1), ("b", 3)])
+
+
+def test_except_all_right_heavy(ctx):
+    got = ctx.sql("SELECT g, x FROM t2 EXCEPT ALL SELECT g, x FROM t1")
+    # (c,4): 2-1=1 copy
+    assert _rows(got) == [("c", 4)]
+
+
+def test_intersect_binds_tighter_than_union(ctx):
+    """A UNION B INTERSECT C == A UNION (B INTERSECT C)."""
+    plan, _, _ = parse_sql(
+        "SELECT g FROM t1 UNION SELECT g FROM t2 INTERSECT SELECT g FROM t1"
+    )
+    assert isinstance(plan, L.Union) and plan.op == "union"
+    assert isinstance(plan.branches[1], L.Union)
+    assert plan.branches[1].op == "intersect"
+    # and left-associativity of same-precedence ops: A EXCEPT B UNION C
+    # == (A EXCEPT B) UNION C
+    plan2, _, _ = parse_sql(
+        "SELECT g FROM t1 EXCEPT SELECT g FROM t2 UNION SELECT g FROM t1"
+    )
+    assert isinstance(plan2, L.Union) and plan2.op == "union"
+    assert isinstance(plan2.branches[0], L.Union)
+    assert plan2.branches[0].op == "except"
+
+
+def test_associative_chain_flattens(ctx):
+    plan, _, _ = parse_sql(
+        "SELECT g FROM t1 UNION ALL SELECT g FROM t2 UNION ALL SELECT g FROM t1"
+    )
+    assert isinstance(plan, L.Union) and plan.op == "union_all"
+    assert len(plan.branches) == 3  # flat n-ary, not nested binary
+
+
+def test_mixed_chain_executes(ctx):
+    got = ctx.sql(
+        "SELECT g, x FROM t1 UNION ALL SELECT g, x FROM t2 "
+        "EXCEPT SELECT g, x FROM t2"
+    )
+    # (t1 ∪all t2) except-distinct t2: distinct keys of the concat not in
+    # t2 = {(b,3)}
+    assert _rows(got) == [("b", 3)]
+
+
+def test_setop_with_aggregates_and_order(ctx):
+    got = ctx.sql(
+        "SELECT g, count(*) AS n FROM t1 GROUP BY g "
+        "INTERSECT SELECT g, count(*) AS n FROM t2 GROUP BY g "
+        "ORDER BY n DESC LIMIT 2"
+    )
+    # t1 counts: a2 b2 c1 NULL1; t2 counts: a1 b1 c2 NULL1 -> common (NULL,1)
+    assert _rows(got) == [(_N, 1)]
+
+
+def test_setop_reports_fallback_executor(ctx):
+    ctx.sql("SELECT x FROM t1 INTERSECT SELECT x FROM t2")
+    assert ctx.last_metrics.executor == "fallback"
+
+
+def test_order_before_setop_rejected(ctx):
+    with pytest.raises(ParseError, match="last set-operation branch"):
+        ctx.sql(
+            "SELECT x FROM t1 ORDER BY x INTERSECT SELECT x FROM t2"
+        )
+
+
+def test_arity_mismatch_rejected(ctx):
+    with pytest.raises(ParseError, match="column counts"):
+        ctx.sql("SELECT g, x FROM t1 EXCEPT SELECT g FROM t2")
+
+
+def test_setop_oracle_differential(ctx):
+    """Randomized differential vs a pandas merge-based oracle over every
+    op, including duplicate and NULL rows."""
+    rng = np.random.default_rng(3)
+    c = sd.TPUOlapContext()
+    frames = {}
+    for name in ("ra", "rb"):
+        g = rng.choice(np.array(["p", "q", None], dtype=object), 60)
+        x = rng.integers(0, 4, 60)
+        c.register_table(
+            name, {"g": g, "x": x}, dimensions=["g", "x"]
+        )
+        frames[name] = pd.DataFrame({"g": g, "x": x.astype(np.int64)})
+
+    def okey(df):
+        return [
+            tuple("·N" if pd.isna(v) else v for v in r)
+            for r in df.itertuples(index=False)
+        ]
+
+    from collections import Counter
+
+    ka, kb = okey(frames["ra"]), okey(frames["rb"])
+    ca, cb = Counter(ka), Counter(kb)
+    oracle = {
+        "UNION ALL": sorted(ka + kb),
+        "UNION": sorted(set(ka) | set(kb)),
+        "INTERSECT": sorted(set(ka) & set(kb)),
+        "INTERSECT ALL": sorted(
+            sum(([k] * min(ca[k], cb[k]) for k in set(ka)), [])
+        ),
+        "EXCEPT": sorted(set(ka) - set(kb)),
+        "EXCEPT ALL": sorted(
+            sum(([k] * (ca[k] - cb[k]) for k in ca if ca[k] > cb[k]), [])
+        ),
+    }
+    for op, want in oracle.items():
+        got = c.sql(f"SELECT g, x FROM ra {op} SELECT g, x FROM rb")
+        keys = sorted(
+            tuple("·N" if pd.isna(v) else v for v in r)
+            for r in got.itertuples(index=False)
+        )
+        assert keys == want, op

@@ -1,0 +1,356 @@
+"""Window functions (OVER clauses) through the host fallback.
+
+Reference parity: the reference never pushed OVER clauses to Druid — every
+window function ran as a vanilla Spark plan (SURVEY.md §3.2 fallback
+semantics).  Here the parser lifts `fn(...) OVER (PARTITION BY ... ORDER
+BY ... [ROWS ...])` into `L.Window` specs; the fallback interpreter
+implements SQL semantics: partition-major evaluation, nulls-last ordering
+(matching the engine's Sort convention), peer-inclusive default frames
+(RANGE UNBOUNDED PRECEDING..CURRENT ROW), bag-exact ROWS frames, and
+NULL-skipping window aggregates.  Windows over aggregated results (RANK
+over SUM, the top-N-per-group idiom) evaluate above GROUP BY/HAVING.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.sql.parser import ParseError
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    rng = np.random.default_rng(11)
+    n = 400
+    g = rng.choice(np.array(["a", "b", "c", None], dtype=object), n)
+    s = rng.choice(np.array(["x", "y"], dtype=object), n)
+    v = np.where(rng.random(n) < 0.1, np.nan, rng.integers(0, 40, n))
+    c.register_table(
+        "w",
+        {"g": g, "s": s, "v": v.astype(np.float64)},
+        dimensions=["g", "s"],
+        metrics=["v"],
+    )
+    c._frame = pd.DataFrame({"g": g, "s": s, "v": v.astype(np.float64)})
+    return c
+
+
+def _ordered(frame, by, asc=True):
+    """Partition-ordered frame matching the engine: nulls last, stable."""
+    return frame.sort_values(
+        by, ascending=asc, kind="stable", na_position="last"
+    )
+
+
+def test_row_number_and_ranks_vs_pandas(ctx):
+    got = ctx.sql(
+        "SELECT g, v, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) AS rn, "
+        "RANK() OVER (PARTITION BY g ORDER BY v) AS rk, "
+        "DENSE_RANK() OVER (PARTITION BY g ORDER BY v) AS dr FROM w"
+    )
+    f = ctx._frame
+    for gval, gdf in f.groupby("g", dropna=False):
+        sub = got[
+            got["g"].isna() if pd.isna(gval) else (got["g"] == gval)
+        ]
+        o = _ordered(gdf, "v")
+        # pandas rank(method=first) == ROW_NUMBER on non-null; our order
+        # puts nulls last, so recompute positions directly
+        pos = {idx: i + 1 for i, idx in enumerate(o.index)}
+        want_rn = [pos[i] for i in sub.index]
+        assert list(sub["rn"]) == want_rn
+        # RANK/DENSE_RANK: ties share; NaN rows form their own peer group
+        key = o["v"].fillna(np.inf)
+        rk, dr, prev = {}, {}, None
+        r = d = 0
+        for i, (idx, kv) in enumerate(key.items()):
+            if prev is None or kv != prev:
+                r = i + 1
+                d += 1
+                prev = kv
+            rk[idx], dr[idx] = r, d
+        assert list(sub["rk"]) == [rk[i] for i in sub.index]
+        assert list(sub["dr"]) == [dr[i] for i in sub.index]
+
+
+def test_partition_total_and_cumulative(ctx):
+    got = ctx.sql(
+        "SELECT g, v, SUM(v) OVER (PARTITION BY g) AS tot, "
+        "SUM(v) OVER (PARTITION BY g ORDER BY v) AS cum, "
+        "COUNT(*) OVER (PARTITION BY g) AS cnt FROM w"
+    )
+    f = ctx._frame
+    for gval, gdf in f.groupby("g", dropna=False):
+        sub = got[
+            got["g"].isna() if pd.isna(gval) else (got["g"] == gval)
+        ]
+        t = gdf["v"].sum()
+        np.testing.assert_allclose(
+            sub["tot"].astype(float), t, rtol=1e-9
+        )
+        assert (sub["cnt"] == len(gdf)).all()
+        # default frame includes peers: cumulative sum at the last peer
+        o = _ordered(gdf, "v")
+        csum = o["v"].fillna(0).cumsum()
+        # peer groups on v (NaNs are peers of each other at the end)
+        kv = o["v"].fillna(np.inf)
+        cum_at = csum.groupby(kv.values).transform("max")
+        want = {idx: cum_at.iloc[i] for i, idx in enumerate(o.index)}
+        np.testing.assert_allclose(
+            sub["cum"].astype(float),
+            [want[i] for i in sub.index],
+            rtol=1e-9,
+        )
+
+
+def test_rows_frame_moving_average(ctx):
+    got = ctx.sql(
+        "SELECT g, v, AVG(v) OVER (PARTITION BY g ORDER BY v "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS ma FROM w"
+    )
+    f = ctx._frame
+    for gval, gdf in f.groupby("g", dropna=False):
+        sub = got[
+            got["g"].isna() if pd.isna(gval) else (got["g"] == gval)
+        ]
+        o = _ordered(gdf, "v")
+        vals = o["v"].to_numpy()
+        want = {}
+        for i, idx in enumerate(o.index):
+            window = vals[max(0, i - 2) : i + 1]
+            window = window[~np.isnan(window)]
+            want[idx] = window.mean() if len(window) else np.nan
+        np.testing.assert_allclose(
+            sub["ma"].astype(float),
+            [want[i] for i in sub.index],
+            rtol=1e-9,
+        )
+
+
+def test_lag_lead_defaults(ctx):
+    got = ctx.sql(
+        "SELECT g, v, LAG(v) OVER (PARTITION BY g ORDER BY v) AS pv, "
+        "LEAD(v, 2, -1.0) OVER (PARTITION BY g ORDER BY v) AS nv FROM w"
+    )
+    f = ctx._frame
+    for gval, gdf in f.groupby("g", dropna=False):
+        sub = got[
+            got["g"].isna() if pd.isna(gval) else (got["g"] == gval)
+        ]
+        o = _ordered(gdf, "v")
+        vals = o["v"].to_numpy()
+        pv, nv = {}, {}
+        for i, idx in enumerate(o.index):
+            pv[idx] = vals[i - 1] if i >= 1 else None
+            nv[idx] = vals[i + 2] if i + 2 < len(vals) else -1.0
+        for idx in sub.index:
+            a, b = sub.loc[idx, "pv"], pv[idx]
+            assert (pd.isna(a) and (b is None or pd.isna(b))) or a == b
+            a, b = sub.loc[idx, "nv"], nv[idx]
+            assert (pd.isna(a) and pd.isna(b)) or a == b
+
+
+def test_ntile_and_first_last(ctx):
+    got = ctx.sql(
+        "SELECT v, NTILE(4) OVER (ORDER BY v) AS q, "
+        "FIRST_VALUE(v) OVER (ORDER BY v) AS fv, "
+        "LAST_VALUE(v) OVER (ORDER BY v ROWS BETWEEN UNBOUNDED "
+        "PRECEDING AND UNBOUNDED FOLLOWING) AS lv FROM w"
+    )
+    n = len(got)
+    base, rem = divmod(n, 4)
+    sizes = [base + (1 if i < rem else 0) for i in range(4)]
+    assert sorted(got["q"].value_counts().reindex([1, 2, 3, 4]).tolist()) \
+        == sorted(sizes)
+    vmin = ctx._frame["v"].min()
+    assert (got["fv"].astype(float) == vmin).all()
+    # global last row in nulls-last order is a NaN v -> last_value is NULL
+    assert got["lv"].isna().all() or (
+        got["lv"].astype(float) == ctx._frame["v"].max()
+    ).all()
+
+
+def test_window_over_aggregates_topn_per_group(ctx):
+    """The classic top-N-per-group: rank groups by their aggregate."""
+    got = ctx.sql(
+        "SELECT g, s, sum(v) AS sv, "
+        "RANK() OVER (PARTITION BY g ORDER BY sum(v) DESC) AS r "
+        "FROM w GROUP BY g, s ORDER BY g, r"
+    )
+    f = ctx._frame
+    want = (
+        f.groupby(["g", "s"], dropna=False)["v"]
+        .sum()
+        .reset_index(name="sv")
+    )
+    want["r"] = want.groupby("g", dropna=False)["sv"].rank(
+        method="min", ascending=False
+    ).astype(int)
+    merged = got.merge(
+        want, on=["g", "s"], suffixes=("", "_want"), how="left"
+    )
+    assert len(merged) == len(got) and not merged["r_want"].isna().any()
+    np.testing.assert_allclose(
+        merged["sv"].astype(float), merged["sv_want"].astype(float),
+        rtol=1e-9,
+    )
+    assert (merged["r"] == merged["r_want"]).all()
+
+
+def test_window_filter_clause(ctx):
+    got = ctx.sql(
+        "SELECT g, COUNT(*) FILTER (WHERE v > 20) OVER (PARTITION BY g) "
+        "AS big FROM w"
+    )
+    f = ctx._frame
+    want = f.assign(big=(f["v"] > 20)).groupby("g", dropna=False)[
+        "big"
+    ].transform("sum")
+    assert list(got["big"].astype(int)) == list(want.astype(int))
+
+
+def test_window_expression_around_call(ctx):
+    got = ctx.sql(
+        "SELECT v, 100 * v / SUM(v) OVER () AS pct FROM w"
+    )
+    tot = ctx._frame["v"].sum()
+    np.testing.assert_allclose(
+        got["pct"].astype(float),
+        100 * ctx._frame["v"] / tot,
+        rtol=1e-9,
+    )
+
+
+def test_window_dedup_identical_specs(ctx):
+    from spark_druid_olap_tpu.sql.parser import parse_sql
+    from spark_druid_olap_tpu.plan import logical as L
+
+    plan, _, _ = parse_sql(
+        "SELECT v - AVG(v) OVER (PARTITION BY g) AS c1, "
+        "AVG(v) OVER (PARTITION BY g) AS c2 FROM w"
+    )
+    win = plan
+    while not isinstance(win, L.Window):
+        win = win.children()[0]
+    assert len(win.wins) == 1  # the identical spec computed once
+
+
+def test_window_rejections(ctx):
+    with pytest.raises(ParseError, match="not allowed in WHERE"):
+        ctx.sql(
+            "SELECT v FROM w WHERE ROW_NUMBER() OVER (ORDER BY v) < 5"
+        )
+    with pytest.raises(ParseError, match="not allowed in HAVING"):
+        ctx.sql(
+            "SELECT g, sum(v) FROM w GROUP BY g "
+            "HAVING RANK() OVER (ORDER BY sum(v)) < 2"
+        )
+    with pytest.raises(ParseError, match="requires an OVER clause"):
+        ctx.sql("SELECT ROW_NUMBER() FROM w")
+    with pytest.raises(ParseError, match="requires ORDER BY"):
+        ctx.sql("SELECT RANK() OVER (PARTITION BY g) FROM w")
+    with pytest.raises(ParseError, match="inside aggregate"):
+        ctx.sql("SELECT sum(ROW_NUMBER() OVER (ORDER BY v)) FROM w")
+    with pytest.raises(ParseError, match="nested window"):
+        ctx.sql(
+            "SELECT RANK() OVER (ORDER BY SUM(v) OVER ()) FROM w"
+        )
+    with pytest.raises(ParseError, match="RANGE frames unsupported"):
+        ctx.sql(
+            "SELECT SUM(v) OVER (ORDER BY v RANGE BETWEEN 1 PRECEDING "
+            "AND CURRENT ROW) FROM w"
+        )
+    with pytest.raises(ParseError, match="DISTINCT aggregates"):
+        ctx.sql("SELECT SUM(DISTINCT v) OVER () FROM w")
+    with pytest.raises(ParseError, match="SELECT alias"):
+        ctx.sql("SELECT v FROM w ORDER BY ROW_NUMBER() OVER (ORDER BY v)")
+
+
+def test_over_stays_usable_as_identifier(ctx):
+    """OVER/PARTITION/ROWS are contextual words, not reserved keywords."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "q",
+        {
+            "over": np.array(["u", "u", "w"], dtype=object),
+            "rows": np.array([1.0, 2.0, 3.0], dtype=np.float64),
+        },
+        dimensions=["over"],
+        metrics=["rows"],
+    )
+    got = c.sql('SELECT over, sum(rows) AS s FROM q GROUP BY over')
+    assert sorted(got["s"].astype(float)) == [3.0, 3.0]
+
+
+def test_window_reports_fallback_executor(ctx):
+    ctx.sql("SELECT v, ROW_NUMBER() OVER (ORDER BY v) AS rn FROM w")
+    assert ctx.last_metrics.executor == "fallback"
+
+
+def test_window_alias_shadowing_source_column(ctx):
+    """A SELECT alias that shadows a source column must not corrupt later
+    items reading the original (review-confirmed wrong-answer)."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "sh", {"v": np.array([1.0, 2.0, 3.0])}, metrics=["v"]
+    )
+    got = c.sql(
+        "SELECT v + 1 AS v, v AS orig, "
+        "ROW_NUMBER() OVER (ORDER BY v) AS rn FROM sh"
+    )
+    assert list(got["v"].astype(float)) == [2.0, 3.0, 4.0]
+    assert list(got["orig"].astype(float)) == [1.0, 2.0, 3.0]
+
+
+def test_window_query_with_scalar_subquery(ctx):
+    """Subqueries elsewhere in the SELECT list coexist with windows
+    (review-confirmed crash)."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "m", {"v": np.array([1.0, 5.0, 3.0])}, metrics=["v"]
+    )
+    c.register_table(
+        "s", {"x": np.array([10.0, 20.0])}, metrics=["x"]
+    )
+    got = c.sql(
+        "SELECT v, (SELECT max(x) FROM s) AS mx, "
+        "ROW_NUMBER() OVER (ORDER BY v) AS rn FROM m"
+    )
+    assert (got["mx"].astype(float) == 20.0).all()
+    assert sorted(got["rn"]) == [1, 2, 3]
+    got2 = c.sql(
+        "SELECT v, ROW_NUMBER() OVER (ORDER BY v) AS rn FROM m "
+        "WHERE v IN (SELECT x / 10 FROM s)"
+    )
+    assert list(got2["v"].astype(float)) == [1.0]
+
+
+def test_window_partition_by_aliased_group_key(ctx):
+    """PARTITION BY g when GROUP BY g is SELECTed as `g AS grp`: the
+    window spec must resolve to the aggregated frame's output name
+    (review-confirmed KeyError)."""
+    got = ctx.sql(
+        "SELECT g AS grp, s, sum(v) AS sv, "
+        "RANK() OVER (PARTITION BY g ORDER BY sum(v) DESC) AS r "
+        "FROM w GROUP BY g, s"
+    )
+    f = ctx._frame
+    want = (
+        f.groupby(["g", "s"], dropna=False)["v"].sum().reset_index(name="sv")
+    )
+    want["r"] = want.groupby("g", dropna=False)["sv"].rank(
+        method="min", ascending=False
+    ).astype(int)
+    merged = got.merge(
+        want, left_on=["grp", "s"], right_on=["g", "s"], how="left"
+    )
+    assert (merged["r_x"] == merged["r_y"]).all()
+    # expression group keys resolve the same way
+    got2 = ctx.sql(
+        "SELECT length(s) AS ls, sum(v) AS sv, "
+        "RANK() OVER (PARTITION BY length(s) ORDER BY sum(v)) AS r "
+        "FROM w GROUP BY length(s)"
+    )
+    assert len(got2) >= 1 and (got2["r"] == 1).all()
