@@ -1130,7 +1130,45 @@ def _window_partition(w, idxs, peer_codes, va, fm, res):
             res[idxs[i]] = None if pd.isna(v) else v
         return
 
-    # aggregate over the frame (filter-aware, NULL-skipping)
+    # aggregate over the frame (filter-aware, NULL-skipping).  Default
+    # frames (whole partition / cumulative-with-peers) take ONE running
+    # pass — the common running-total idiom must be O(rows), not
+    # O(rows^2); only explicit ROWS frames pay per-row slicing.
+    if fn in ("sum", "count", "avg", "min", "max") and w.frame is None:
+        run_sum, run_cnt, n_rows = 0.0, 0, 0
+        run_min = run_max = None
+        pref = [None] * m
+        for i in range(m):
+            if fmp is None or fmp[i]:
+                n_rows += 1
+                if vp is not None:
+                    v = vp[i]
+                    if not pd.isna(v):
+                        run_cnt += 1
+                        if fn in ("sum", "avg"):
+                            run_sum += float(v)
+                        elif fn == "min":
+                            if run_min is None or v < run_min:
+                                run_min = v
+                        elif fn == "max":
+                            if run_max is None or v > run_max:
+                                run_max = v
+            if fn == "count":
+                pref[i] = n_rows if vp is None else run_cnt
+            elif fn == "sum":
+                pref[i] = run_sum if run_cnt else None
+            elif fn == "avg":
+                pref[i] = run_sum / run_cnt if run_cnt else None
+            elif fn == "min":
+                pref[i] = run_min
+            else:
+                pref[i] = run_max
+        # peer_end is m-1 everywhere when there is no ORDER BY, which
+        # makes this the whole-partition aggregate in the same stroke
+        for i in range(m):
+            res[idxs[i]] = pref[int(peer_end[i])]
+        return
+
     for i in range(m):
         lo_i, hi_i = frame_bounds(i)
         if lo_i > hi_i:

@@ -96,6 +96,8 @@ def eval_post_agg(
                 acc = acc - v
             elif p.fn == "*":
                 acc = acc * v
+            elif p.fn == "pow":
+                acc = acc ** v
             elif p.fn in ("/", "quotient"):
                 with np.errstate(divide="ignore", invalid="ignore"):
                     # x/0 -> 0 is Druid arithmetic-post-agg behavior; but a

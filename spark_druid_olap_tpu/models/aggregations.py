@@ -259,7 +259,7 @@ class ConstantPost(PostAggregation):
 
 @dataclasses.dataclass(frozen=True)
 class Arithmetic(PostAggregation):
-    """fn in {+, -, *, /, quotient}; fields are other post-aggs."""
+    """fn in {+, -, *, /, quotient, pow}; fields are other post-aggs."""
 
     name: str
     fn: str

@@ -77,6 +77,50 @@ class CaseExtraction(ExtractionFn):
         return [v.upper() if self.upper else v.lower() for v in values]
 
 
+def _js_str(s: str) -> str:
+    """Escape a Python string into a single-quoted JS string literal body:
+    backslash FIRST, then quote and control characters — a lone backslash
+    must not escape the closing quote of the generated function."""
+    return (
+        s.replace("\\", "\\\\")
+        .replace("'", "\\'")
+        .replace("\n", "\\n")
+        .replace("\r", "\\r")
+        .replace("\t", "\\t")
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class StrFuncExtraction(ExtractionFn):
+    """TRIM/LTRIM/RTRIM/REPLACE over a dimension — pure dictionary
+    rewrites with no native Druid extraction type.  Serialized as Druid's
+    `javascript` extraction (the reference shipped exactly such functions
+    to Druid through its JSCodeGenerator — SURVEY.md §2 JS-codegen row)."""
+
+    fn: str
+    args: tuple = ()
+
+    def to_druid(self):
+        if self.fn == "replace":
+            f, t = _js_str(str(self.args[0])), _js_str(str(self.args[1]))
+            body = f"x.split('{f}').join('{t}')"
+        else:
+            # SQL TRIM strips spaces only; JS trim() strips all whitespace
+            pat = {
+                "trim": "/^ +| +$/g", "ltrim": "/^ +/", "rtrim": "/ +$/"
+            }[self.fn]
+            body = f"x.replace({pat},'')"
+        return {
+            "type": "javascript",
+            "function": f"function(x){{return x==null?null:{body}}}",
+        }
+
+    def apply_to_dict(self, values):
+        from ..plan.expr import apply_strfunc
+
+        return [apply_strfunc(self.fn, self.args, v) for v in values]
+
+
 @dataclasses.dataclass(frozen=True)
 class TimeFormatExtraction(ExtractionFn):
     """Druid `timeFormat` — used when grouping the time column by a calendar

@@ -109,6 +109,16 @@ def apply_strfunc(fn: str, args: tuple, s: str):
         return f"{args[0]}{s}{args[1]}"
     if fn == "length":
         return len(s)
+    # Druid / standard SQL TRIM strips SPACES only (chars=' '), not all
+    # whitespace — a trailing tab survives
+    if fn == "trim":
+        return s.strip(" ")
+    if fn == "ltrim":
+        return s.lstrip(" ")
+    if fn == "rtrim":
+        return s.rstrip(" ")
+    if fn == "replace":
+        return s.replace(str(args[0]), str(args[1]))
     raise ValueError(f"unsupported string fn {fn!r}")
 
 
@@ -177,17 +187,21 @@ class Literal(Expr):
 
 @dataclasses.dataclass(frozen=True, eq=True)
 class BinaryOp(Expr):
-    op: str  # + - * / %
+    op: str  # + - * / % pow
     left: Expr
     right: Expr
 
     def __str__(self):
+        if self.op == "pow":
+            # Druid's native expression spelling — round-trips through the
+            # wire expression grammar (which re-parses as SQL POW(a, b))
+            return f"pow({self.left}, {self.right})"
         return f"({self.left} {self.op} {self.right})"
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
 class UnaryOp(Expr):
-    op: str  # - abs floor ceil sqrt exp ln
+    op: str  # - abs floor ceil sqrt exp ln round
     operand: Expr
 
     def __str__(self):
@@ -388,6 +402,8 @@ _UNARY = {
     "sqrt": jnp.sqrt,
     "exp": jnp.exp,
     "ln": jnp.log,
+    # SQL ROUND is half-away-from-zero; jnp.round is half-to-even
+    "round": lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5),
 }
 
 _BINARY = {
@@ -396,6 +412,7 @@ _BINARY = {
     "*": lambda a, b: a * b,
     "/": lambda a, b: a / b,
     "%": lambda a, b: a % b,
+    "pow": lambda a, b: a ** b,
 }
 
 _CMP = {

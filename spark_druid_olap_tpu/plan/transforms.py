@@ -203,6 +203,19 @@ def _intersect_interval(b: QueryBuilder, iv: Tuple[int, int]) -> QueryBuilder:
     return b.with_(intervals=tuple(out) if out else ((0, 0),))
 
 
+def _strfunc_chain(e: E.Expr):
+    """Unwrap nested StrFuncs down to a base dimension column: returns
+    (column name, [(fn, args)] innermost-first) or None.  LOOKUP is
+    excluded (it has registry semantics, not pure string rewriting)."""
+    fns = []
+    while isinstance(e, E.StrFunc) and e.fn != "lookup":
+        fns.append((e.fn, e.args))
+        e = e.operand
+    if fns and isinstance(e, E.Col):
+        return e.name, fns[::-1]
+    return None
+
+
 def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
     """Dimension predicate -> Druid-style FilterSpec, when directly
     expressible.  Dictionary-order tricks make string bounds sound."""
@@ -212,31 +225,35 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
             l, r = r, l
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
                   "==": "==", "!=": "!="}[op]
+        chain = _strfunc_chain(l)
         if (
-            isinstance(l, E.StrFunc)
-            and isinstance(l.operand, E.Col)
-            and l.operand.name in ds.dicts
+            chain is not None
+            and chain[0] in ds.dicts
             and isinstance(r, E.Literal)
-            and l.fn != "lookup"
             and r.value is not None
         ):
-            # comparison over a string function of a dimension: apply the
-            # fn to each DICTIONARY value once, keep matching values — the
-            # Druid extraction-filter analog (O(dictionary), no row work);
-            # null rows never match (InFilter is code-space membership)
+            # comparison over a (possibly composed) string function of a
+            # dimension: apply the chain to each DICTIONARY value once,
+            # innermost first, keep matching values — the Druid
+            # extraction-filter analog (O(dictionary), no row work); null
+            # rows never match (InFilter is code-space membership)
             import operator as _op
 
             from ..plan.expr import apply_strfunc
 
             cmp = {"==": _op.eq, "!=": _op.ne, "<": _op.lt,
                    "<=": _op.le, ">": _op.gt, ">=": _op.ge}[op]
-            d = ds.dicts[l.operand.name]
+            name, fns = chain
+            d = ds.dicts[name]
             lit = r.value
             matched = []
             for v in d.values:
-                res = apply_strfunc(
-                    l.fn, l.args, v if isinstance(v, str) else str(v)
-                )
+                res = v if isinstance(v, str) else str(v)
+                for fn, args in fns:
+                    if not isinstance(res, str):
+                        res = None  # e.g. UPPER(LENGTH(..)): not a string
+                        break
+                    res = apply_strfunc(fn, args, res)
                 if isinstance(res, int) and isinstance(
                     lit, (int, float)
                 ) and not isinstance(lit, bool):
@@ -247,7 +264,7 @@ def _as_filter_spec(e: E.Expr, ds: DataSource) -> Optional[F.Filter]:
                     ok = False
                 if ok:
                     matched.append(str(v))
-            return F.InFilter(l.operand.name, tuple(matched))
+            return F.InFilter(name, tuple(matched))
         if not (isinstance(l, E.Col) and isinstance(r, E.Literal)):
             return None
         name, val = l.name, r.value
@@ -429,6 +446,15 @@ def translate_group_expr(
 
             return (
                 DimensionSpec(dim, name, extraction=StrlenExtraction()),
+                b,
+            )
+        if e.fn in ("trim", "ltrim", "rtrim", "replace"):
+            from ..models.dimensions import StrFuncExtraction
+
+            return (
+                DimensionSpec(
+                    dim, name, extraction=StrFuncExtraction(e.fn, e.args)
+                ),
                 b,
             )
         if e.fn == "lookup":
@@ -668,7 +694,8 @@ def translate_post_expr(
         return A.FieldAccess(name, e.name)
     if isinstance(e, E.Literal):
         return A.ConstantPost(name, float(e.value))
-    if isinstance(e, E.BinaryOp) and e.op in ("+", "-", "*", "/"):
+    if isinstance(e, E.BinaryOp) and e.op in ("+", "-", "*", "/", "pow"):
+        # Druid arithmetic post-aggregator fn set: + - * / quotient pow
         l = translate_post_expr(f"{name}__l", e.left)
         r = translate_post_expr(f"{name}__r", e.right)
         if l is None or r is None:

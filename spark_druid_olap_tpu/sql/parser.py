@@ -1031,6 +1031,65 @@ class Parser:
             arg = self.expr()
             self.expect_op(")")
             return E.UnaryOp(fn, arg)
+        if fn in ("trim", "ltrim", "rtrim"):
+            arg = self.expr()
+            self.expect_op(")")
+            return E.StrFunc(fn, arg)
+        if fn == "replace":
+            arg = self.expr()
+            self.expect_op(",")
+            frm = self.expr()
+            self.expect_op(",")
+            to = self.expr()
+            self.expect_op(")")
+            if not (
+                isinstance(frm, E.Literal)
+                and isinstance(frm.value, str)
+                and isinstance(to, E.Literal)
+                and isinstance(to.value, str)
+            ):
+                raise ParseError(
+                    "REPLACE search/replacement must be string literals"
+                )
+            return E.StrFunc("replace", arg, (frm.value, to.value))
+        if fn == "round":
+            arg = self.expr()
+            digits = 0
+            if self.accept_op(","):
+                d = self.expr()
+                if (
+                    isinstance(d, E.UnaryOp)
+                    and d.op == "-"
+                    and isinstance(d.operand, E.Literal)
+                ):
+                    d = E.Literal(-d.operand.value)
+                if not isinstance(d, E.Literal) or not isinstance(
+                    d.value, int
+                ):
+                    raise ParseError(
+                        "ROUND digits must be an integer literal"
+                    )
+                digits = d.value
+            self.expect_op(")")
+            if digits == 0:
+                return E.UnaryOp("round", arg)
+            # ROUND(x, d) == ROUND(x * 10^d) / 10^d
+            scale = E.Literal(float(10.0 ** digits))
+            return E.BinaryOp(
+                "/", E.UnaryOp("round", E.BinaryOp("*", arg, scale)), scale
+            )
+        if fn == "mod":
+            a = self.expr()
+            self.expect_op(",")
+            b = self.expr()
+            self.expect_op(")")
+            return E.BinaryOp("%", a, b)
+        if fn in ("power", "pow"):
+            a = self.expr()
+            self.expect_op(",")
+            b = self.expr()
+            self.expect_op(")")
+            return E.BinaryOp("pow", a, b)
         if fn == "if":
             # if(cond, then, else) — Druid's native expression form AND the
             # spelling str(IfExpr) serializes to, so expression post-aggs /
@@ -1339,7 +1398,24 @@ class Analyzer:
             plan = L.Having(having_expr, plan)
         if self.win_exprs:
             # windows see the post-HAVING aggregated frame (SQL evaluation
-            # order: ... HAVING -> window functions -> ORDER BY)
+            # order: ... HAVING -> window functions -> ORDER BY); a spec
+            # referencing an ungrouped, unaggregated source column must be
+            # an analysis error, not a runtime KeyError
+            valid = (
+                {n for n, _ in group_exprs}
+                | {ae.name for ae in self.agg_exprs}
+                | {n for n, _ in post_exprs}
+            )
+            for w in self.win_exprs:
+                for ex in (w.arg, w.filter, *w.partition, *w.order_exprs):
+                    if ex is None:
+                        continue
+                    for cname in ex.columns():
+                        if cname not in valid:
+                            raise ParseError(
+                                f"window reference {cname!r} is neither "
+                                "aggregated nor grouped"
+                            )
             plan = L.Window(tuple(self.win_exprs), tuple(out_exprs), plan)
         return self._order_limit(plan, post_agg=True)
 
@@ -1631,10 +1707,10 @@ def parse_sql(sql: str) -> Tuple[L.LogicalPlan, bool, List[str]]:
             keys = []
             for e, asc in stmt.order_by:
                 es = _strip_qualifiers(e, p.aliases)
-                if _contains_agg(es):
+                if _contains_agg(es) or _contains_window(es):
                     raise ParseError(
                         "ORDER BY after a set operation must reference "
-                        "output columns, not aggregates"
+                        "output columns, not aggregates or window functions"
                     )
                 if isinstance(es, E.Literal) and isinstance(es.value, int):
                     idx = es.value - 1

@@ -178,3 +178,125 @@ def test_format_extraction_percent_round_trip():
     wire = d.to_druid()
     assert wire["extractionFn"]["format"] == "50%% %s%%!"
     assert dimension_from_druid(wire) == d
+
+
+# -- round-3 additions: TRIM/LTRIM/RTRIM/REPLACE, ROUND/MOD/POWER ----------
+
+
+@pytest.fixture(scope="module")
+def fn_ctx():
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "ft",
+        {
+            "s": np.array(
+                ["  pad  ", "pad", " x-y ", None, "a-b-c"], dtype=object
+            ),
+            "v": np.array([1.5, 2.5, -2.5, 3.49, 10.0], dtype=np.float32),
+        },
+        dimensions=["s"],
+        metrics=["v"],
+    )
+    return c
+
+
+def test_trim_group_by_device(fn_ctx):
+    got = fn_ctx.sql(
+        "SELECT TRIM(s) AS ts, count(*) AS n FROM ft GROUP BY TRIM(s)"
+    )
+    assert fn_ctx.last_metrics.executor == "device"
+    by = {
+        (r["ts"] if isinstance(r["ts"], str) else None): int(r["n"])
+        for _, r in got.iterrows()
+    }
+    assert by == {"pad": 2, "x-y": 1, "a-b-c": 1, None: 1}
+
+
+def test_ltrim_rtrim_filters_device(fn_ctx):
+    got = fn_ctx.sql("SELECT count(*) AS n FROM ft WHERE LTRIM(s) = 'pad  '")
+    assert int(got["n"].iloc[0]) == 1
+    got = fn_ctx.sql("SELECT count(*) AS n FROM ft WHERE RTRIM(s) = '  pad'")
+    assert int(got["n"].iloc[0]) == 1
+
+
+def test_replace_group_and_filter(fn_ctx):
+    got = fn_ctx.sql(
+        "SELECT REPLACE(s, '-', '_') AS rs, count(*) AS n FROM ft "
+        "GROUP BY REPLACE(s, '-', '_')"
+    )
+    assert fn_ctx.last_metrics.executor == "device"
+    vals = {r["rs"] for _, r in got.iterrows() if isinstance(r["rs"], str)}
+    assert "a_b_c" in vals and " x_y " in vals
+    got = fn_ctx.sql(
+        "SELECT count(*) AS n FROM ft WHERE REPLACE(s, '-', '') = 'xy'"
+    )
+    assert int(got["n"].iloc[0]) == 0  # ' x-y ' keeps its spaces
+    got = fn_ctx.sql(
+        "SELECT count(*) AS n FROM ft WHERE REPLACE(TRIM(s), '-', '') = 'xy'"
+    )
+    assert int(got["n"].iloc[0]) == 1  # composition over the dictionary
+
+
+def test_strfunc_extraction_wire_shape(fn_ctx):
+    """TRIM serializes as Druid's javascript extraction (the reference's
+    JS-codegen analog)."""
+    import json
+
+    plan = fn_ctx.explain(
+        "SELECT TRIM(s) AS ts, count(*) AS n FROM ft GROUP BY TRIM(s)"
+    )
+    assert '"type": "javascript"' in plan and "x.replace(" in plan
+
+
+def test_round_half_away_from_zero(fn_ctx):
+    got = fn_ctx.sql("SELECT ROUND(v) AS r, count(*) AS n FROM ft GROUP BY ROUND(v)")
+    by = {float(r["r"]): int(r["n"]) for _, r in got.iterrows()}
+    # 1.5 -> 2, 2.5 -> 3 (not banker's 2), -2.5 -> -3, 3.49 -> 3, 10 -> 10
+    assert by == {2.0: 1, 3.0: 2, -3.0: 1, 10.0: 1}
+
+
+def test_round_digits_mod_power(fn_ctx):
+    got = fn_ctx.sql(
+        "SELECT ROUND(sum(v) / 3, 2) AS r, MOD(count(*), 3) AS m, "
+        "POWER(count(*), 2) AS p FROM ft"
+    )
+    total = 1.5 + 2.5 - 2.5 + 3.49 + 10.0
+    assert abs(float(got["r"].iloc[0]) - round(total / 3, 2)) < 1e-6
+    assert int(got["m"].iloc[0]) == 2 and float(got["p"].iloc[0]) == 25.0
+
+
+def test_power_translates_to_arithmetic_post_agg(fn_ctx):
+    """POWER over aggregates pushes down as Druid's arithmetic post-agg
+    (fn=pow), not a host residual."""
+    plan = fn_ctx.explain("SELECT POWER(sum(v), 2) AS p FROM ft")
+    assert '"fn": "pow"' in plan
+    assert "residual projections" not in plan
+
+
+def test_numeric_fns_in_where(fn_ctx):
+    got = fn_ctx.sql("SELECT count(*) AS n FROM ft WHERE ABS(v) = 2.5")
+    assert int(got["n"].iloc[0]) == 2
+    got = fn_ctx.sql("SELECT count(*) AS n FROM ft WHERE MOD(v, 2) = 0")
+    assert int(got["n"].iloc[0]) == 1  # 10.0
+
+
+def test_trim_strips_spaces_only():
+    """Druid/standard SQL TRIM(chars=' '): a tab survives."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "tt",
+        {"s": np.array([" a\t ", "b"], dtype=object)},
+        dimensions=["s"],
+    )
+    got = c.sql("SELECT TRIM(s) AS t, count(*) AS n FROM tt GROUP BY TRIM(s)")
+    vals = {r["t"] for _, r in got.iterrows()}
+    assert "a\t" in vals  # tab kept, spaces stripped
+
+
+def test_replace_js_escaping():
+    from spark_druid_olap_tpu.models.dimensions import StrFuncExtraction
+
+    js = StrFuncExtraction("replace", ("\\", "/")).to_druid()["function"]
+    assert "split('\\\\')" in js  # lone backslash escaped, JS stays valid
+    js2 = StrFuncExtraction("replace", ("a'b\n", "x")).to_druid()["function"]
+    assert "\\'" in js2 and "\\n" in js2 and "\n" not in js2

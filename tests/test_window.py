@@ -354,3 +354,24 @@ def test_window_partition_by_aliased_group_key(ctx):
         "FROM w GROUP BY length(s)"
     )
     assert len(got2) >= 1 and (got2["r"] == 1).all()
+
+
+def test_window_in_setop_order_by_rejected(ctx):
+    with pytest.raises(ParseError, match="output columns"):
+        ctx.sql(
+            "SELECT v FROM w UNION SELECT v FROM w "
+            "ORDER BY ROW_NUMBER() OVER (ORDER BY v)"
+        )
+
+
+def test_window_over_ungrouped_column_rejected(ctx):
+    with pytest.raises(ParseError, match="neither aggregated nor grouped"):
+        ctx.sql(
+            "SELECT g, SUM(v) OVER (PARTITION BY g) AS s FROM w GROUP BY g"
+        )
+    # ...but a window over a SELECT alias of an aggregate is fine
+    got = ctx.sql(
+        "SELECT g, sum(v) AS sv, RANK() OVER (ORDER BY sv) AS r "
+        "FROM w GROUP BY g"
+    )
+    assert len(got) == 4
