@@ -385,6 +385,8 @@ class Engine(SparseExecMixin):
             return self._execute_search(q, ds)
         if isinstance(q, Q.TimeBoundaryQuery):
             return self._execute_time_boundary(q, ds)
+        if isinstance(q, Q.DataSourceMetadataQuery):
+            return self._execute_datasource_metadata(q, ds)
         if isinstance(q, Q.SegmentMetadataQuery):
             return self._execute_segment_metadata(q, ds)
         raise NotImplementedError(type(q).__name__)
@@ -951,6 +953,20 @@ class Engine(SparseExecMixin):
         if q.bound in (None, "maxTime"):
             row["maxTime"] = np.datetime64(int(hi), "ms")
         return pd.DataFrame([row])
+
+    def _execute_datasource_metadata(
+        self, q: "Q.DataSourceMetadataQuery", ds: DataSource
+    ):
+        """Druid `dataSourceMetadata` — newest ingested event time from
+        segment metadata; no kernel dispatch."""
+        import pandas as pd
+
+        iv = ds.interval()
+        if iv is None:
+            return pd.DataFrame(columns=["maxIngestedEventTime"])
+        return pd.DataFrame(
+            [{"maxIngestedEventTime": np.datetime64(int(iv[1]), "ms")}]
+        )
 
     def _execute_segment_metadata(
         self, q: Q.SegmentMetadataQuery, ds: DataSource

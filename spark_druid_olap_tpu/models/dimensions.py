@@ -77,6 +77,25 @@ class CaseExtraction(ExtractionFn):
         return [v.upper() if self.upper else v.lower() for v in values]
 
 
+@dataclasses.dataclass(frozen=True)
+class CascadeExtraction(ExtractionFn):
+    """Druid `cascade` — composed extractions applied left-to-right
+    (innermost string function first)."""
+
+    fns: tuple  # Tuple[ExtractionFn, ...]
+
+    def to_druid(self):
+        return {
+            "type": "cascade",
+            "extractionFns": [f.to_druid() for f in self.fns],
+        }
+
+    def apply_to_dict(self, values):
+        for f in self.fns:
+            values = f.apply_to_dict(values)
+        return values
+
+
 def _js_str(s: str) -> str:
     """Escape a Python string into a single-quoted JS string literal body:
     backslash FIRST, then quote and control characters — a lone backslash

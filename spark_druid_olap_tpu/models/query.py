@@ -354,6 +354,22 @@ class SearchQuery(QuerySpec):
 
 
 @dataclasses.dataclass(frozen=True)
+class DataSourceMetadataQuery(QuerySpec):
+    """Druid `dataSourceMetadata`: the newest ingested event time.  The
+    reference's coordinator client polled this family of endpoints for
+    freshness (SURVEY.md §3.1 metadata path); answered from segment
+    metadata, no kernel dispatch."""
+
+    datasource: str
+
+    def to_druid(self):
+        return {
+            "queryType": "dataSourceMetadata",
+            "dataSource": self.datasource,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
 class TimeBoundaryQuery(QuerySpec):
     """Druid `timeBoundary`: min/max event time of a datasource.  The
     reference's metadata path issues these to size intervals; locally it is

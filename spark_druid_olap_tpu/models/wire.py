@@ -176,6 +176,14 @@ def _extraction_from_druid(d: Dict[str, Any]):
         from .dimensions import StrlenExtraction
 
         return StrlenExtraction()
+    if t == "cascade":
+        from .dimensions import CascadeExtraction
+
+        return CascadeExtraction(
+            tuple(
+                _extraction_from_druid(f) for f in d.get("extractionFns", ())
+            )
+        )
     if t == "timeFormat":
         fmt = d.get("format", "%Y")
         # field-shaped formats decode to the int-valued EXTRACT dimension
@@ -453,6 +461,8 @@ def query_from_druid(d: Dict[str, Any]) -> Q.QuerySpec:
         )
     if qt == "timeBoundary":
         return Q.TimeBoundaryQuery(datasource=ds, bound=d.get("bound"))
+    if qt == "dataSourceMetadata":
+        return Q.DataSourceMetadataQuery(datasource=ds)
     if qt == "segmentMetadata":
         return Q.SegmentMetadataQuery(
             datasource=ds,

@@ -203,6 +203,32 @@ def _intersect_interval(b: QueryBuilder, iv: Tuple[int, int]) -> QueryBuilder:
     return b.with_(intervals=tuple(out) if out else ((0, 0),))
 
 
+def _extraction_for(fn: str, args: tuple):
+    """One string function -> its Druid extraction spec (LOOKUP excluded:
+    it needs the session lookup registry and is handled by its caller)."""
+    from ..models.dimensions import (
+        CaseExtraction,
+        FormatExtraction,
+        StrFuncExtraction,
+        StrlenExtraction,
+    )
+
+    if fn == "substr":
+        start = int(args[0]) - 1  # SQL is 1-based
+        length = int(args[1]) if len(args) > 1 else None
+        return SubstringExtraction(start, length)
+    if fn in ("upper", "lower"):
+        return CaseExtraction(upper=(fn == "upper"))
+    if fn == "concat":
+        prefix, suffix = (tuple(args) + ("", ""))[:2]
+        return FormatExtraction(str(prefix), str(suffix))
+    if fn == "length":
+        return StrlenExtraction()
+    if fn in ("trim", "ltrim", "rtrim", "replace"):
+        return StrFuncExtraction(fn, args)
+    raise RewriteError(f"string function {fn!r} in GROUP BY")
+
+
 def _strfunc_chain(e: E.Expr):
     """Unwrap nested StrFuncs down to a base dimension column: returns
     (column name, [(fn, args)] innermost-first) or None.  LOOKUP is
@@ -411,52 +437,25 @@ def translate_group_expr(
             "numeric-dictionary date dimension"
         )
     if isinstance(e, E.StrFunc):
+        if e.fn != "lookup":
+            # single fns map to their native Druid extraction; COMPOSED
+            # chains (REPLACE(TRIM(s),...)) map to Druid's `cascade`
+            # extraction applied innermost-first over the dictionary
+            chain = _strfunc_chain(e)
+            if chain is None or chain[0] not in ds.dicts:
+                raise RewriteError(f"{e.fn} over non-dimension in GROUP BY")
+            dim, fns = chain
+            exts = tuple(_extraction_for(fn, args) for fn, args in fns)
+            if len(exts) == 1:
+                ext = exts[0]
+            else:
+                from ..models.dimensions import CascadeExtraction
+
+                ext = CascadeExtraction(exts)
+            return DimensionSpec(dim, name, extraction=ext), b
         if not isinstance(e.operand, E.Col) or e.operand.name not in ds.dicts:
             raise RewriteError(f"{e.fn} over non-dimension in GROUP BY")
         dim = e.operand.name
-        if e.fn == "substr":
-            start = int(e.args[0]) - 1  # SQL is 1-based
-            length = int(e.args[1]) if len(e.args) > 1 else None
-            return (
-                DimensionSpec(dim, name,
-                              extraction=SubstringExtraction(start, length)),
-                b,
-            )
-        if e.fn in ("upper", "lower"):
-            from ..models.dimensions import CaseExtraction
-
-            return (
-                DimensionSpec(dim, name,
-                              extraction=CaseExtraction(upper=(e.fn == "upper"))),
-                b,
-            )
-        if e.fn == "concat":
-            from ..models.dimensions import FormatExtraction
-
-            prefix, suffix = (e.args + ("", ""))[:2]
-            return (
-                DimensionSpec(
-                    dim, name,
-                    extraction=FormatExtraction(str(prefix), str(suffix)),
-                ),
-                b,
-            )
-        if e.fn == "length":
-            from ..models.dimensions import StrlenExtraction
-
-            return (
-                DimensionSpec(dim, name, extraction=StrlenExtraction()),
-                b,
-            )
-        if e.fn in ("trim", "ltrim", "rtrim", "replace"):
-            from ..models.dimensions import StrFuncExtraction
-
-            return (
-                DimensionSpec(
-                    dim, name, extraction=StrFuncExtraction(e.fn, e.args)
-                ),
-                b,
-            )
         if e.fn == "lookup":
             from ..models.dimensions import LookupExtraction
 

@@ -125,6 +125,11 @@ def druid_result_shape(q: Q.QuerySpec, df) -> Any:
         rec = _rows(df)[0]
         ts = rec.get("minTime", rec.get("maxTime"))
         return [{"timestamp": ts, "result": rec}]
+    if isinstance(q, Q.DataSourceMetadataQuery):
+        if df.empty:
+            return []
+        rec = _rows(df)[0]
+        return [{"timestamp": rec["maxIngestedEventTime"], "result": rec}]
     if isinstance(q, Q.SegmentMetadataQuery):
         return _rows(df)
     return _rows(df)

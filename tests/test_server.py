@@ -212,6 +212,24 @@ def test_time_boundary_query(served):
     assert "maxTime" in out[0]["result"] and "minTime" not in out[0]["result"]
 
 
+def test_datasource_metadata_query(served):
+    ctx, srv, frame = served
+    code, out = _post(
+        srv, "/druid/v2",
+        {"queryType": "dataSourceMetadata", "dataSource": "ev"},
+    )
+    assert code == 200 and len(out) == 1
+    res = out[0]["result"]
+    assert "maxIngestedEventTime" in res
+    # matches the timeBoundary maxTime (same metadata source)
+    _, tb = _post(
+        srv, "/druid/v2",
+        {"queryType": "timeBoundary", "dataSource": "ev", "bound": "maxTime"},
+    )
+    assert res["maxIngestedEventTime"] == tb[0]["result"]["maxTime"]
+    assert out[0]["timestamp"] == res["maxIngestedEventTime"]
+
+
 def test_segment_metadata_query(served):
     ctx, srv, frame = served
     code, out = _post(

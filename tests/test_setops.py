@@ -207,3 +207,97 @@ def test_setop_oracle_differential(ctx):
             for r in got.itertuples(index=False)
         )
         assert keys == want, op
+
+
+@pytest.mark.parametrize("seed", [2, 9, 17, 29, 41])
+def test_setop_fuzz_differential(seed):
+    """Seeded random branch shapes (predicates, duplicates, NULLs, all six
+    ops, 2-3 branch chains) vs a Counter-based oracle."""
+    from collections import Counter
+
+    rng = np.random.default_rng(seed)
+    c = sd.TPUOlapContext()
+    frames = {}
+    for name in ("fa", "fb", "fc"):
+        n = int(rng.integers(40, 120))
+        g = rng.choice(np.array(["p", "q", "r", None], dtype=object), n)
+        x = rng.integers(0, 5, n).astype(np.int64)
+        c.register_table(name, {"g": g, "x": x}, dimensions=["g", "x"])
+        frames[name] = pd.DataFrame({"g": g, "x": x})
+
+    def keys(df, pred=None):
+        d = df if pred is None else df[pred(df)]
+        return [
+            tuple("·N" if pd.isna(v) else v for v in r)
+            for r in d.itertuples(index=False)
+        ]
+
+    ops = ["UNION ALL", "UNION", "INTERSECT", "INTERSECT ALL",
+           "EXCEPT", "EXCEPT ALL"]
+
+    def apply(op, a, b):
+        ca, cb = Counter(a), Counter(b)
+        if op == "UNION ALL":
+            return a + b
+        if op == "UNION":
+            out = []
+            seen = set()
+            for k in a + b:
+                if k not in seen:
+                    seen.add(k)
+                    out.append(k)
+            return out
+        if op == "INTERSECT":
+            return [k for k in dict.fromkeys(a) if cb[k]]
+        if op == "INTERSECT ALL":
+            out = []
+            used = Counter()
+            for k in a:
+                if used[k] < min(ca[k], cb[k]):
+                    used[k] += 1
+                    out.append(k)
+            return out
+        if op == "EXCEPT":
+            return [k for k in dict.fromkeys(a) if not cb[k]]
+        out = []
+        used = Counter()
+        for k in a:
+            if used[k] < ca[k] - cb[k]:
+                used[k] += 1
+                out.append(k)
+        return out
+
+    for _ in range(6):
+        thr = int(rng.integers(1, 5))
+        b1, b2 = rng.choice(["fa", "fb", "fc"], 2, replace=False)
+        op = ops[int(rng.integers(0, 6))]
+        q = (
+            f"SELECT g, x FROM {b1} WHERE x < {thr} "
+            f"{op} SELECT g, x FROM {b2}"
+        )
+        want = apply(
+            op, keys(frames[b1], lambda d: d["x"] < thr), keys(frames[b2])
+        )
+        got = c.sql(q)
+        gk = [
+            tuple("·N" if pd.isna(v) else v for v in r)
+            for r in got.itertuples(index=False)
+        ]
+        assert sorted(gk) == sorted(want), (q, seed)
+        # three-branch chain with mixed precedence
+        op2 = ops[int(rng.integers(0, 6))]
+        b3 = rng.choice(["fa", "fb", "fc"])
+        q3 = q + f" {op2} SELECT g, x FROM {b3}"
+        a = keys(frames[b1], lambda d: d["x"] < thr)
+        b = keys(frames[b2])
+        cc = keys(frames[b3])
+        if op2.startswith("INTERSECT") and not op.startswith("INTERSECT"):
+            want3 = apply(op, a, apply(op2, b, cc))
+        else:
+            want3 = apply(op2, apply(op, a, b), cc)
+        got3 = c.sql(q3)
+        gk3 = [
+            tuple("·N" if pd.isna(v) else v for v in r)
+            for r in got3.itertuples(index=False)
+        ]
+        assert sorted(gk3) == sorted(want3), (q3, seed)

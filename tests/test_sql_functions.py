@@ -300,3 +300,41 @@ def test_replace_js_escaping():
     assert "split('\\\\')" in js  # lone backslash escaped, JS stays valid
     js2 = StrFuncExtraction("replace", ("a'b\n", "x")).to_druid()["function"]
     assert "\\'" in js2 and "\\n" in js2 and "\n" not in js2
+
+
+def test_composed_strfuncs_group_by_cascade_device(fn_ctx):
+    """REPLACE(TRIM(s), ...) in GROUP BY stays on the device via Druid's
+    cascade extraction (innermost first)."""
+    got = fn_ctx.sql(
+        "SELECT REPLACE(TRIM(s), '-', '_') AS r, count(*) AS n FROM ft "
+        "GROUP BY REPLACE(TRIM(s), '-', '_')"
+    )
+    assert fn_ctx.last_metrics.executor == "device"
+    by = {
+        (r["r"] if isinstance(r["r"], str) else None): int(r["n"])
+        for _, r in got.iterrows()
+    }
+    assert by == {"pad": 2, "x_y": 1, "a_b_c": 1, None: 1}
+    plan = fn_ctx.explain(
+        "SELECT UPPER(TRIM(s)) AS u, count(*) AS n FROM ft "
+        "GROUP BY UPPER(TRIM(s))"
+    )
+    assert '"type": "cascade"' in plan
+
+
+def test_cascade_extraction_wire_round_trip(fn_ctx):
+    from spark_druid_olap_tpu.models.dimensions import (
+        CascadeExtraction,
+        CaseExtraction,
+        DimensionSpec,
+        SubstringExtraction,
+    )
+    from spark_druid_olap_tpu.models.wire import dimension_from_druid
+
+    d = DimensionSpec(
+        "s", "x",
+        extraction=CascadeExtraction(
+            (SubstringExtraction(0, 2), CaseExtraction(upper=True))
+        ),
+    )
+    assert dimension_from_druid(d.to_druid()) == d
