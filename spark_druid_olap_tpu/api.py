@@ -601,66 +601,168 @@ def _infer_schema(cols, time_column):
 
 
 class TableQuery:
-    """Small fluent API over the same planner (groupBy/agg/filter/orderBy),
-    the analog of driving the reference through DataFrames instead of SQL."""
+    """Fluent DataFrame-style API over the same planner — the analog of
+    driving the reference through Spark DataFrames instead of SQL.  Every
+    method returns a NEW TableQuery (immutable chaining, like DataFrames);
+    `collect()` plans, executes on the device, and falls back to the host
+    interpreter exactly like the SQL path when the rewrite fails."""
 
     def __init__(self, ctx: TPUOlapContext, table: str):
         self.ctx = ctx
         self._table = table
         self._filter: Optional[E.Expr] = None
+        self._select: List[Tuple[str, E.Expr]] = []
         self._groups: List[Tuple[str, E.Expr]] = []
         self._aggs: List[L.AggExpr] = []
+        self._having: Optional[E.Expr] = None
         self._sort: List[L.SortKey] = []
         self._limit: Optional[int] = None
+        self._offset: int = 0
+
+    def _copy(self) -> "TableQuery":
+        import copy
+
+        out = TableQuery(self.ctx, self._table)
+        out._filter = self._filter
+        out._select = list(self._select)
+        out._groups = list(self._groups)
+        out._aggs = list(self._aggs)
+        out._having = self._having
+        out._sort = list(self._sort)
+        out._limit = self._limit
+        out._offset = self._offset
+        return out
+
+    @staticmethod
+    def _as_expr(x) -> E.Expr:
+        return E.Col(x) if isinstance(x, str) else x
 
     def filter(self, e: E.Expr) -> "TableQuery":
-        self._filter = e if self._filter is None else E.BoolOp(
-            "and", (self._filter, e)
+        out = self._copy()
+        out._filter = e if out._filter is None else E.BoolOp(
+            "and", (out._filter, e)
         )
-        return self
+        return out
 
-    def group_by(self, *exprs) -> "TableQuery":
+    where = filter  # Spark/SQL spelling
+
+    def select(self, *exprs, **named) -> "TableQuery":
+        """Projection for non-aggregate queries: select("a", "b") or
+        select(rev=E.Col("price") * E.Col("qty"))."""
+        out = self._copy()
         for x in exprs:
-            e = E.Col(x) if isinstance(x, str) else x
-            name = x if isinstance(x, str) else str(e)
-            self._groups.append((name, e))
-        return self
+            e = self._as_expr(x)
+            out._select.append((x if isinstance(x, str) else str(e), e))
+        for name, x in named.items():
+            out._select.append((name, self._as_expr(x)))
+        return out
+
+    def group_by(self, *exprs, **named) -> "TableQuery":
+        out = self._copy()
+        for x in exprs:
+            e = self._as_expr(x)
+            out._groups.append((x if isinstance(x, str) else str(e), e))
+        for name, x in named.items():
+            out._groups.append((name, self._as_expr(x)))
+        return out
 
     def agg(self, **named) -> "TableQuery":
-        """agg(total=("sum", "revenue"), n=("count", None), ...)"""
+        """agg(total=("sum", "revenue"), n=("count", None), ...); the arg
+        may be a column name or an Expr (sum over an expression)."""
+        out = self._copy()
         for name, spec in named.items():
             fn, arg = spec if isinstance(spec, tuple) else (spec, None)
-            arg_e = E.Col(arg) if isinstance(arg, str) else arg
-            self._aggs.append(L.AggExpr(name, fn, arg_e))
-        return self
+            arg_e = self._as_expr(arg) if arg is not None else None
+            out._aggs.append(L.AggExpr(name, fn, arg_e))
+        return out
 
-    def order_by(self, name: str, ascending: bool = True) -> "TableQuery":
-        self._sort.append(L.SortKey(E.Col(name), ascending))
-        return self
+    def having(self, e: E.Expr) -> "TableQuery":
+        """Filter over aggregate outputs: reference agg outputs by their
+        `agg(...)` names via E.AggRef (or E.Col of the output name)."""
+        out = self._copy()
+        out._having = e if out._having is None else E.BoolOp(
+            "and", (out._having, e)
+        )
+        return out
 
-    def limit(self, n: int) -> "TableQuery":
-        self._limit = n
-        return self
+    def order_by(self, key, ascending: bool = True) -> "TableQuery":
+        out = self._copy()
+        out._sort.append(L.SortKey(self._as_expr(key), ascending))
+        return out
+
+    def limit(self, n: int, offset: int = 0) -> "TableQuery":
+        out = self._copy()
+        out._limit = n
+        out._offset = offset
+        return out
 
     def _logical(self) -> L.LogicalPlan:
         base: L.LogicalPlan = L.Scan(self._table)
         if self._filter is not None:
             base = L.Filter(self._filter, base)
-        plan: L.LogicalPlan = L.Aggregate(
-            tuple(self._groups), tuple(self._aggs), base
-        )
+        if self._groups or self._aggs:
+            if self._select:
+                raise ValueError(
+                    "select() is for non-aggregate queries; grouped "
+                    "outputs are named by group_by()/agg()"
+                )
+            post = tuple(
+                (n, E.Col(n)) for n, _ in self._groups
+            ) + tuple((a.name, E.AggRef(a.name)) for a in self._aggs)
+            plan: L.LogicalPlan = L.Aggregate(
+                tuple(self._groups),
+                tuple(self._aggs),
+                base,
+                post_exprs=post,
+            )
+            if self._having is not None:
+                plan = L.Having(_col_to_aggref(self._having, self._aggs), plan)
+        else:
+            if self._having is not None:
+                raise ValueError("having() requires group_by()/agg()")
+            plan = (
+                L.Project(tuple(self._select), base) if self._select else base
+            )
         if self._sort:
-            plan = L.Sort(tuple(self._sort), plan)
+            keys = tuple(
+                L.SortKey(_col_to_aggref(k.expr, self._aggs), k.ascending)
+                for k in self._sort
+            )
+            plan = L.Sort(keys, plan)
         if self._limit is not None:
-            plan = L.Limit(self._limit, plan)
+            plan = L.Limit(self._limit, plan, self._offset)
         return plan
 
     def collect(self):
-        rw = self.ctx._planner().plan(self._logical())
+        lp = self._logical()
+        try:
+            rw = self.ctx._planner().plan(lp)
+        except RewriteError as err:
+            return self.ctx._run_fallback(lp, err)
         return self.ctx.execute_rewrite(rw)
 
     def explain(self) -> str:
         return self.ctx._planner().explain(self._logical())
+
+
+def _col_to_aggref(e: E.Expr, aggs) -> E.Expr:
+    """In HAVING/ORDER BY over a grouped TableQuery, a Col naming an agg
+    output means the aggregate (SQL alias semantics)."""
+    import dataclasses as _dc
+
+    names = {a.name for a in aggs}
+    if isinstance(e, E.Col):
+        return E.AggRef(e.name) if e.name in names else e
+    if isinstance(e, (E.Literal, E.AggRef)):
+        return e
+    kw = {}
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr):
+            kw[f.name] = _col_to_aggref(v, aggs)
+        elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+            kw[f.name] = tuple(_col_to_aggref(x, aggs) for x in v)
+    return _dc.replace(e, **kw) if kw else e
 
 
 # module-level default context (the implicit SQLContext analog)

@@ -78,6 +78,11 @@ class Expr:
     def not_(self):
         return BoolOp("not", (self,))
 
+    # PySpark-style boolean operators for the DataFrame API
+    __and__ = and_
+    __or__ = or_
+    __invert__ = not_
+
     def isin(self, values):
         return InExpr(self, tuple(values))
 

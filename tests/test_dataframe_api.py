@@ -1,0 +1,162 @@
+"""DataFrame-style TableQuery API (api.py) — the analog of driving the
+reference through Spark DataFrames instead of SQL: immutable chaining,
+select/where/group_by/agg/having/order_by/limit, device execution with
+the same host-fallback routing as the SQL path."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.plan.expr import col, lit
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sd.TPUOlapContext()
+    rng = np.random.default_rng(5)
+    n = 10_000
+    c.register_table(
+        "sales",
+        {
+            "region": rng.choice(
+                np.array(["na", "emea", "apac"], dtype=object), n
+            ),
+            "sku": rng.choice(
+                np.array([f"sku{i}" for i in range(40)], dtype=object), n
+            ),
+            "price": (rng.random(n) * 90 + 10).astype(np.float32),
+            "qty": rng.integers(1, 9, n).astype(np.float32),
+        },
+        dimensions=["region", "sku"],
+        metrics=["price", "qty"],
+    )
+    c._frame = pd.DataFrame(
+        {
+            k: np.asarray(v)
+            for k, v in {
+                "region": c.catalog.get("sales").dicts["region"].decode(
+                    np.concatenate(
+                        [
+                            np.asarray(s.dims["region"])[s.valid]
+                            for s in c.catalog.get("sales").segments
+                        ]
+                    )
+                ),
+                "sku": c.catalog.get("sales").dicts["sku"].decode(
+                    np.concatenate(
+                        [
+                            np.asarray(s.dims["sku"])[s.valid]
+                            for s in c.catalog.get("sales").segments
+                        ]
+                    )
+                ),
+                "price": np.concatenate(
+                    [
+                        np.asarray(s.metrics["price"])[s.valid]
+                        for s in c.catalog.get("sales").segments
+                    ]
+                ).astype(np.float64),
+                "qty": np.concatenate(
+                    [
+                        np.asarray(s.metrics["qty"])[s.valid]
+                        for s in c.catalog.get("sales").segments
+                    ]
+                ).astype(np.float64),
+            }.items()
+        }
+    )
+    return c
+
+
+def test_grouped_agg_with_having_and_order(ctx):
+    got = (
+        ctx.table("sales")
+        .where(col("region").eq("na") | col("region").eq("emea"))
+        .group_by("region", "sku")
+        .agg(rev=("sum", col("price") * col("qty")), n=("count", None))
+        .having(col("n") > 50)
+        .order_by("rev", ascending=False)
+        .limit(10)
+        .collect()
+    )
+    f = ctx._frame
+    f = f[f.region.isin(["na", "emea"])].assign(rev=f.price * f.qty)
+    want = (
+        f.groupby(["region", "sku"])
+        .agg(rev=("rev", "sum"), n=("rev", "size"))
+        .reset_index()
+    )
+    want = want[want.n > 50].sort_values("rev", ascending=False).head(10)
+    assert list(got.columns) == ["region", "sku", "rev", "n"]
+    np.testing.assert_allclose(
+        got["rev"].astype(float), want["rev"].values, rtol=2e-5
+    )
+    assert list(got["n"]) == list(want["n"])
+
+
+def test_projection_select(ctx):
+    got = (
+        ctx.table("sales")
+        .where(col("qty") >= 8)
+        .select("region", revenue=col("price") * col("qty"))
+        .limit(5)
+        .collect()
+    )
+    assert list(got.columns) == ["region", "revenue"]
+    assert len(got) == 5
+    f = ctx._frame
+    assert len(
+        ctx.table("sales").where(col("qty") >= 8).select("region").collect()
+    ) == int((f.qty >= 8).sum())
+
+
+def test_chaining_is_immutable(ctx):
+    base = ctx.table("sales").group_by("region").agg(n=("count", None))
+    a = base.having(col("n") > 100)
+    b = base.order_by("n")
+    assert base._having is None and len(base._sort) == 0
+    assert a._having is not None and len(b._sort) == 1
+
+
+def test_offset_and_explain(ctx):
+    q = (
+        ctx.table("sales")
+        .group_by("region")
+        .agg(n=("count", None))
+        .order_by("n", ascending=False)
+    )
+    full = q.collect()
+    skip = q.limit(10, offset=1).collect()
+    assert list(skip["region"]) == list(full["region"][1:])
+    assert "GroupByQuery" in q.explain() or "Aggregate" in q.explain()
+
+
+def test_select_with_groups_rejected(ctx):
+    with pytest.raises(ValueError, match="non-aggregate"):
+        ctx.table("sales").select("region").group_by("region").agg(
+            n=("count", None)
+        )._logical()
+    with pytest.raises(ValueError, match="having"):
+        ctx.table("sales").having(col("n") > 1)._logical()
+
+
+def test_dsl_fallback_routing(ctx):
+    """A plan the rewriter refuses (NULL-producing CASE in filter) runs on
+    the host fallback — same routing as the SQL path."""
+    from spark_druid_olap_tpu.plan import expr as E
+
+    nullif = E.IfExpr(
+        E.Comparison("==", col("qty"), lit(1.0)), E.Literal(None), col("qty")
+    )
+    got = (
+        ctx.table("sales")
+        .where(E.Comparison("==", nullif, lit(2.0)))
+        .group_by("region")
+        .agg(n=("count", None))
+        .collect()
+    )
+    assert ctx.last_metrics.executor == "fallback"
+    f = ctx._frame
+    want = f[f.qty == 2.0].groupby("region").size()
+    assert dict(zip(got["region"], got["n"].astype(int))) == want.to_dict()
