@@ -499,6 +499,12 @@ class TPUOlapContext:
 
     # -- DataFrame-ish builder (the reference's "sourceDataframe" analog) ----
 
+    def sql_arrow(self, sql_text: str):
+        """`sql()` with the result as a `pyarrow.Table` (SURVEY §7 L-api:
+        results as Arrow/pandas).  NULLs in dimension columns become Arrow
+        nulls; NaN metrics stay floating-point NaN (SQL NULL for floats)."""
+        return _to_arrow(self.sql(sql_text))
+
     def table(self, name: str) -> "TableQuery":
         return TableQuery(self, name)
 
@@ -741,8 +747,18 @@ class TableQuery:
             return self.ctx._run_fallback(lp, err)
         return self.ctx.execute_rewrite(rw)
 
+    def collect_arrow(self):
+        """`collect()` as a `pyarrow.Table`."""
+        return _to_arrow(self.collect())
+
     def explain(self) -> str:
         return self.ctx._planner().explain(self._logical())
+
+
+def _to_arrow(df):
+    import pyarrow as pa
+
+    return pa.Table.from_pandas(df, preserve_index=False)
 
 
 def _col_to_aggref(e: E.Expr, aggs) -> E.Expr:

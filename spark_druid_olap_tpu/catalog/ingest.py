@@ -26,6 +26,15 @@ def to_columns(source) -> Dict[str, np.ndarray]:
             return _from_pandas(source)
     except ImportError:  # pragma: no cover
         pass
+    if type(source).__module__.split(".")[0] == "pyarrow":
+        # pyarrow Table / RecordBatch (SURVEY §7 L-api: Arrow in/out);
+        # NaN-as-string-null in the resulting object columns is handled by
+        # the dictionary encoder (catalog.segment._is_null).  Non-tabular
+        # pyarrow values (Array/Scalar) fall through to the TypeError.
+        import pyarrow as pa
+
+        if isinstance(source, (pa.Table, pa.RecordBatch)):
+            return _from_pandas(source.to_pandas())
     if isinstance(source, str):
         if source.endswith(".parquet"):
             import pandas as pd

@@ -118,7 +118,7 @@ class DimensionDict:
 
     def encode(self, col: Sequence[Optional[str]]) -> np.ndarray:
         arr = np.asarray(col, dtype=object)
-        mask = np.array([v is not None for v in arr], dtype=bool)
+        mask = np.array([not _is_null(v) for v in arr], dtype=bool)
         out = np.full(len(arr), NULL_ID, dtype=np.int32)
         if mask.any():
             vals = np.asarray([v for v in arr[mask]], dtype=str)
@@ -139,8 +139,14 @@ class DimensionDict:
 
     @staticmethod
     def build(col: Sequence[Optional[str]]) -> "DimensionDict":
-        uniq = sorted({v for v in col if v is not None})
+        uniq = sorted({v for v in col if not _is_null(v)})
         return DimensionDict(values=tuple(uniq))
+
+
+def _is_null(v) -> bool:
+    """None OR float NaN — Arrow/pandas surface string nulls as NaN floats
+    inside object columns; both must dictionary-encode as NULL."""
+    return v is None or (isinstance(v, float) and v != v)
 
 
 @dataclasses.dataclass(frozen=True)

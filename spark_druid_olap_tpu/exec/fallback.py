@@ -563,10 +563,248 @@ def _substitute_outer(stmt, binding):
     )
 
 
+def _broadcast_rows(vals, n: int) -> np.ndarray:
+    """Per-row value array for an expression result: a CONSTANT operand
+    (e.g. `10 IN (SELECT ...)`) evaluates 0-d and must broadcast."""
+    a = np.asarray(vals)
+    if a.ndim == 0:
+        return np.full(n, a[()], dtype=object)
+    return a
+
+
+def _expr_has_outer(e, refs: set) -> bool:
+    import dataclasses as _dc
+
+    if isinstance(e, E.Col) and e.name in refs:
+        return True
+    if not isinstance(e, Expr):
+        return False
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expr) and _expr_has_outer(v, refs):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, Expr) and _expr_has_outer(x, refs) for x in v
+        ):
+            return True
+    return False
+
+
+def _expr_has_subquery(e) -> bool:
+    import dataclasses as _dc
+
+    if isinstance(e, (E.InSubquery, E.ScalarSubquery, E.ExistsSubquery)):
+        return True
+    if not isinstance(e, Expr):
+        return False
+    for f in _dc.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expr) and _expr_has_subquery(v):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, Expr) and _expr_has_subquery(x) for x in v
+        ):
+            return True
+    return False
+
+
+def _try_decorrelate_fill(sub, df, catalog, refs, out) -> bool:
+    """TRUE single-pass decorrelation for the common shape — every outer
+    reference appears only as a top-level equality conjunct
+    `inner_col = o.outer_col` in the subquery's WHERE: rewrite to ONE
+    grouped/projected execution over the inner table and join the result
+    back by key.  Turns O(distinct bindings x inner scan) into O(inner
+    scan).  Returns False when the shape doesn't qualify (the caller's
+    per-binding loop remains the complete path)."""
+    import dataclasses as _dc
+
+    from ..sql.parser import Analyzer, _contains_agg
+
+    stmt = sub.stmt
+    refset = set(refs)
+    if stmt.limit is not None or stmt.offset or stmt.distinct:
+        return False
+    if stmt.group_by or stmt.grouping_sets or stmt.having is not None:
+        return False
+    # nested subqueries inside the correlated statement: too opaque
+    exprs = [e for _, e in stmt.items]
+    if stmt.where is not None:
+        exprs.append(stmt.where)
+    if any(_expr_has_subquery(e) for e in exprs):
+        return False
+    for _, e in stmt.items:
+        if _expr_has_outer(e, refset):
+            return False
+    for e, _ in stmt.order_by:
+        if _expr_has_outer(e, refset) or _expr_has_subquery(e):
+            return False
+
+    # split WHERE: equality-correlation conjuncts vs residual
+    def conjuncts(e):
+        if isinstance(e, E.BoolOp) and e.op == "and":
+            outl = []
+            for o in e.operands:
+                outl.extend(conjuncts(o))
+            return outl
+        return [e]
+
+    eq_pairs = []  # (inner bare col, outer qualified ref)
+    residual = []
+    used = set()
+    for c in conjuncts(stmt.where) if stmt.where is not None else []:
+        pair = None
+        if isinstance(c, E.Comparison) and c.op == "==":
+            for a, b2 in ((c.left, c.right), (c.right, c.left)):
+                if (
+                    isinstance(a, E.Col)
+                    and a.name in refset
+                    and isinstance(b2, E.Col)
+                    and b2.name not in refset
+                    and "." not in b2.name
+                ):
+                    pair = (b2.name, a.name)
+                    break
+        if pair is not None:
+            if pair not in eq_pairs:  # duplicate conjunct: one key column
+                eq_pairs.append(pair)
+            used.add(pair[1])
+            continue
+        if _expr_has_outer(c, refset):
+            return False  # outer ref outside the equality form
+        residual.append(c)
+    if not eq_pairs or used != refset:
+        return False
+
+    has_agg_item = any(_contains_agg(e) for _, e in stmt.items)
+    if isinstance(sub, (E.ExistsSubquery, E.InSubquery)) and has_agg_item:
+        # an aggregate subquery yields exactly one row regardless of
+        # matches (EXISTS is then always true) — keep the exact loop
+        return False
+    if isinstance(sub, E.ScalarSubquery) and not has_agg_item:
+        return False  # per-binding >1-row detection must stay exact
+
+    res_where = None
+    for c in residual:
+        res_where = c if res_where is None else E.BoolOp("and", (res_where, c))
+
+    key_cols = [ic for ic, _ in eq_pairs]
+    key_names = [f"__dk{i}" for i in range(len(eq_pairs))]
+
+    # outer-side key per row (None anywhere -> can never match)
+    outer_cols = [q.split(".", 1)[1] for _, q in eq_pairs]
+    ocols = [np.asarray(df[c]) for c in outer_cols]
+    onull = np.zeros(len(df), dtype=bool)
+    for c in ocols:
+        onull |= np.asarray(pd.isna(c))
+
+    def okey(i):
+        return tuple(c[i] for c in ocols)
+
+    if isinstance(sub, E.ExistsSubquery):
+        stmt2 = _dc.replace(
+            stmt,
+            items=[(n, E.Col(ic)) for n, ic in zip(key_names, key_cols)],
+            where=res_where,
+            group_by=[E.Col(ic) for ic in key_cols],  # distinct keys
+            order_by=[],
+        )
+        inner = execute_fallback(
+            Analyzer(stmt2, dict(sub.aliases or ())).to_logical(), catalog
+        )
+        kf = inner[key_names]
+        ok = ~kf.isna().any(axis=1)
+        exist = {tuple(r) for r in kf[ok].itertuples(index=False)}
+        for i in range(len(df)):
+            out[i] = (not onull[i]) and okey(i) in exist
+        return True
+
+    if isinstance(sub, E.InSubquery):
+        if len(stmt.items) != 1:
+            return False
+        stmt2 = _dc.replace(
+            stmt,
+            items=[(n, E.Col(ic)) for n, ic in zip(key_names, key_cols)]
+            + [("__dv", stmt.items[0][1])],
+            where=res_where,
+            order_by=[],
+        )
+        inner = execute_fallback(
+            Analyzer(stmt2, dict(sub.aliases or ())).to_logical(), catalog
+        )
+        kf = inner[key_names]
+        ok = ~kf.isna().any(axis=1)
+        vals_by_key: dict = {}
+        null_by_key: dict = {}
+        for k, v in zip(
+            kf[ok].itertuples(index=False), inner["__dv"][ok]
+        ):
+            k = tuple(k)
+            if pd.isna(v):
+                null_by_key[k] = True
+            else:
+                vals_by_key.setdefault(k, set()).add(v)
+        op_vals = _broadcast_rows(_eval(sub.operand, df), len(df))
+        op_null = np.asarray(pd.isna(op_vals))
+        for i in range(len(df)):
+            k = None if onull[i] else okey(i)
+            vals = vals_by_key.get(k, set())
+            has_null = null_by_key.get(k, False)
+            if not op_null[i] and op_vals[i] in vals:
+                out[i] = True
+            elif not vals and not has_null:
+                out[i] = False  # IN over an EMPTY set: FALSE, even NULL
+            elif has_null or op_null[i]:
+                out[i] = None  # UNKNOWN
+            else:
+                out[i] = False
+        return True
+
+    # ScalarSubquery with an aggregate item: group the aggregate by the
+    # correlation keys; absent keys take the aggregate-over-empty value
+    # (COUNT -> 0, SUM/AVG/MIN/MAX -> NULL), measured by executing the
+    # original ungrouped aggregate over zero rows
+    if len(stmt.items) != 1:
+        return False
+    stmt2 = _dc.replace(
+        stmt,
+        items=[(n, E.Col(ic)) for n, ic in zip(key_names, key_cols)]
+        + [("__dv", stmt.items[0][1])],
+        where=res_where,
+        group_by=[E.Col(ic) for ic in key_cols],
+        order_by=[],
+    )
+    inner = execute_fallback(
+        Analyzer(stmt2, dict(sub.aliases or ())).to_logical(), catalog
+    )
+    false_where = E.Literal(False)
+    if res_where is not None:
+        false_where = E.BoolOp("and", (res_where, false_where))
+    stmt_empty = _dc.replace(stmt, where=false_where, order_by=[])
+    empty = execute_fallback(
+        Analyzer(stmt_empty, dict(sub.aliases or ())).to_logical(), catalog
+    )
+    neutral = empty.iloc[0, 0] if len(empty) else None
+    if neutral is not None and pd.isna(neutral):
+        neutral = None
+    kf = inner[key_names]
+    ok = ~kf.isna().any(axis=1)
+    mapping = {}
+    for k, v in zip(kf[ok].itertuples(index=False), inner["__dv"][ok]):
+        mapping[tuple(k)] = None if pd.isna(v) else v
+    for i in range(len(df)):
+        out[i] = (
+            neutral if onull[i] else mapping.get(okey(i), neutral)
+        )
+    return True
+
+
 def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
     """Evaluate a correlated subquery for every row of the outer frame —
     once per DISTINCT binding of its outer references (decorrelation by
-    grouping), joined back positionally.
+    grouping), joined back positionally.  The common equality-correlated
+    shape takes a TRUE single-pass decorrelation first
+    (_try_decorrelate_fill): one grouped execution of the inner table,
+    joined back by key.
 
     Column contents by node type:
     * InSubquery     -> object True / False / None (None = UNKNOWN — the
@@ -586,8 +824,10 @@ def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
             "not present in the outer frame"
         )
     out = np.empty(len(df), dtype=object)
+    if _try_decorrelate_fill(sub, df, catalog, refs, out):
+        return _correlated_series(sub, out, df)
     if isinstance(sub, E.InSubquery):
-        op_vals = np.asarray(_eval(sub.operand, df))
+        op_vals = _broadcast_rows(_eval(sub.operand, df), len(df))
         op_null = np.asarray(pd.isna(op_vals))
     # .indices maps each distinct binding to POSITIONAL row indices
     grouped = df.groupby(bare, dropna=False).indices
@@ -641,6 +881,10 @@ def _correlated_column(sub, df: pd.DataFrame, catalog) -> pd.Series:
                     out[i] = None  # UNKNOWN
                 else:
                     out[i] = False
+    return _correlated_series(sub, out, df)
+
+
+def _correlated_series(sub, out, df) -> pd.Series:
     ser = pd.Series(out, index=df.index)
     if isinstance(sub, E.ScalarSubquery):
         nn = [v for v in out if v is not None]

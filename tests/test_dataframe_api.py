@@ -160,3 +160,26 @@ def test_dsl_fallback_routing(ctx):
     f = ctx._frame
     want = f[f.qty == 2.0].groupby("region").size()
     assert dict(zip(got["region"], got["n"].astype(int))) == want.to_dict()
+
+
+def test_arrow_in_and_out(ctx):
+    """Arrow ingest + Arrow results (SURVEY §7 L-api: Arrow/pandas)."""
+    import pyarrow as pa
+
+    c = sd.TPUOlapContext()
+    t = pa.table(
+        {
+            "g": pa.array(["a", "b", None, "a"]),
+            "v": pa.array([1.0, 2.0, 3.0, 4.0]),
+        }
+    )
+    c.register_table("arr", t, dimensions=["g"], metrics=["v"])
+    out = c.sql_arrow("SELECT g, sum(v) AS s FROM arr GROUP BY g ORDER BY g")
+    assert isinstance(out, pa.Table)
+    d = out.to_pydict()
+    assert d["s"] == [5.0, 2.0, 3.0]  # a, b, NULL group last
+    assert d["g"][:2] == ["a", "b"] and d["g"][2] is None
+    out2 = (
+        c.table("arr").group_by("g").agg(n=("count", None)).collect_arrow()
+    )
+    assert isinstance(out2, pa.Table) and out2.num_rows == 3

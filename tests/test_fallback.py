@@ -887,3 +887,96 @@ def test_two_correlated_subqueries_in_one_aggregate(corr):
         np.testing.assert_allclose(float(r["a"]), mx[k] * cnt[k], rtol=1e-6)
         np.testing.assert_allclose(float(r["b"]), mn[k] * cnt[k], rtol=1e-6)
     assert (got["a"] > got["b"]).any()
+
+
+def test_decorrelation_fast_path_semantics():
+    """Equality-correlated subqueries take the single-pass decorrelation
+    (one grouped inner execution) with semantics identical to the
+    per-binding loop: COUNT over an absent key is 0, SUM is NULL, NULL
+    outer bindings take the aggregate-over-empty value, IN keeps its
+    Kleene UNKNOWN on NULL set elements, and an aggregate-item EXISTS
+    (always one row) stays on the exact loop path."""
+    from spark_druid_olap_tpu.exec import fallback as F
+
+    calls = {"fast": 0, "loop": 0}
+    orig = F._try_decorrelate_fill
+
+    def spy(*a, **k):
+        r = orig(*a, **k)
+        calls["fast" if r else "loop"] += 1
+        return r
+
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "do_",
+        {"k": np.array([1, 2, 3, None], dtype=object),
+         "amt": np.array([5.0, 50.0, 500.0, 5.0])},
+        dimensions=["k"], metrics=["amt"],
+    )
+    c.register_table(
+        "di",
+        {"j": np.array([1, 1, 2, None], dtype=object),
+         "v": np.array([10.0, 20.0, np.nan, 99.0])},
+        dimensions=["j"], metrics=["v"],
+    )
+    F._try_decorrelate_fill = spy
+    try:
+        r = c.sql(
+            "SELECT k, (SELECT count(*) FROM di WHERE j = do_.k) AS n, "
+            "(SELECT sum(v) FROM di WHERE j = do_.k) AS s FROM do_"
+        )
+        assert calls["fast"] == 2, calls
+        assert [int(x) for x in r["n"]] == [2, 1, 0, 0]
+        assert float(r["s"][0]) == 30.0 and pd.isna(r["s"][1])
+        assert pd.isna(r["s"][2]) and pd.isna(r["s"][3])
+
+        r2 = c.sql(
+            "SELECT count(*) AS n FROM do_ WHERE EXISTS "
+            "(SELECT j FROM di WHERE j = do_.k)"
+        )
+        assert int(r2["n"][0]) == 2
+        # aggregate item -> one row always exists -> must stay on the loop
+        r3 = c.sql(
+            "SELECT count(*) AS n FROM do_ WHERE EXISTS "
+            "(SELECT max(v) FROM di WHERE j = do_.k)"
+        )
+        assert int(r3["n"][0]) == 4
+        assert calls["loop"] >= 1
+
+        r4 = c.sql(
+            "SELECT count(*) AS n FROM do_ WHERE amt IN "
+            "(SELECT v FROM di WHERE j = do_.k)"
+        )
+        assert int(r4["n"][0]) == 0
+        r5 = c.sql(
+            "SELECT count(*) AS n FROM do_ WHERE NOT (amt IN "
+            "(SELECT v FROM di WHERE j = do_.k))"
+        )
+        assert int(r5["n"][0]) == 3  # the UNKNOWN row stays excluded
+    finally:
+        F._try_decorrelate_fill = orig
+
+
+def test_decorrelation_edge_shapes():
+    """Review findings: duplicate equality conjuncts collapse to one key;
+    a CONSTANT IN-operand broadcasts instead of crashing."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "eo", {"k": np.array([1, 2], dtype=np.int64)}, dimensions=["k"]
+    )
+    c.register_table(
+        "ei",
+        {"j": np.array([1, 1], dtype=np.int64),
+         "v": np.array([10.0, 20.0])},
+        dimensions=["j"], metrics=["v"],
+    )
+    r = c.sql(
+        "SELECT k, (SELECT count(*) FROM ei WHERE j = eo.k AND j = eo.k) "
+        "AS n FROM eo ORDER BY k"
+    )
+    assert [int(x) for x in r["n"]] == [2, 0]
+    r2 = c.sql(
+        "SELECT count(*) AS n FROM eo WHERE 10.0 IN "
+        "(SELECT v FROM ei WHERE j = eo.k)"
+    )
+    assert int(r2["n"][0]) == 1  # only k=1 has {10,20}
