@@ -667,6 +667,11 @@ class Planner:
                 )
         except RewriteError as e:
             lines += ["== Rewrite FAILED ==", str(e)]
+            if self.cfg.fallback_execution:
+                lines.append(
+                    "(executes on the host fallback interpreter; "
+                    "QueryMetrics.executor will report 'fallback')"
+                )
         return "\n".join(lines)
 
     def _ds(self, table: str) -> DataSource:

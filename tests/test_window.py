@@ -375,3 +375,117 @@ def test_window_over_ungrouped_column_rejected(ctx):
         "FROM w GROUP BY g"
     )
     assert len(got) == 4
+
+
+def _window_oracle(f, fn, partition, order_col, asc, frame, arg="v"):
+    """Independent pandas implementation of one window column (nulls-last
+    ordering, peer-inclusive default frames) for the fuzz differential."""
+    out = pd.Series([None] * len(f), index=f.index, dtype=object)
+    groups = (
+        f.groupby(partition, dropna=False) if partition else [((), f)]
+    )
+    for _, gdf in groups:
+        if order_col:
+            o = gdf.sort_values(
+                order_col, ascending=asc, kind="stable", na_position="last"
+            )
+        else:
+            o = gdf
+        vals = o[arg].to_numpy() if arg else None
+        m = len(o)
+        # peer groups on the order key (nulls are mutual peers at the end)
+        if order_col:
+            kv = o[order_col].fillna(np.inf if asc else -np.inf).to_numpy()
+            peer_end = np.empty(m, dtype=int)
+            i = 0
+            while i < m:
+                j = i
+                while j + 1 < m and kv[j + 1] == kv[i]:
+                    j += 1
+                peer_end[i : j + 1] = j
+                i = j + 1
+        else:
+            peer_end = np.full(m, m - 1)
+        for i, idx in enumerate(o.index):
+            if fn == "row_number":
+                out[idx] = i + 1
+                continue
+            if fn == "rank":
+                s = i
+                while s > 0 and peer_end[s - 1] == peer_end[i]:
+                    s -= 1
+                out[idx] = s + 1
+                continue
+            if frame is not None:
+                lo, hi = frame
+                lo_i = 0 if lo is None else max(0, i + lo)
+                hi_i = m - 1 if hi is None else min(m - 1, i + hi)
+            elif order_col:
+                lo_i, hi_i = 0, int(peer_end[i])
+            else:
+                lo_i, hi_i = 0, m - 1
+            if lo_i > hi_i:
+                out[idx] = 0 if fn == "count" else None
+                continue
+            w = vals[lo_i : hi_i + 1]
+            w = w[~pd.isna(w)]
+            if fn == "count":
+                out[idx] = len(w)
+            elif len(w) == 0:
+                out[idx] = None
+            elif fn == "sum":
+                out[idx] = float(w.sum())
+            elif fn == "min":
+                out[idx] = float(w.min())
+            elif fn == "max":
+                out[idx] = float(w.max())
+    return out
+
+
+@pytest.mark.parametrize("seed", [4, 12, 23, 35, 47, 58])
+def test_fuzz_windows_vs_oracle(ctx, seed):
+    """Seeded random window shapes (fn x partition x order/desc x frame)
+    against the independent oracle above."""
+    rng = np.random.default_rng(seed)
+    f = ctx._frame
+    for _ in range(6):
+        fn = rng.choice(["row_number", "rank", "sum", "count", "min", "max"])
+        partition = list(
+            rng.choice(["g", "s"], size=rng.integers(0, 3), replace=False)
+        )
+        has_order = fn in ("row_number", "rank") or rng.random() < 0.7
+        asc = bool(rng.random() < 0.5)
+        frame = None
+        if fn not in ("row_number", "rank") and has_order and rng.random() < 0.4:
+            lo = -int(rng.integers(0, 4))
+            hi = int(rng.integers(0, 4))
+            frame = (lo, hi)
+        over = []
+        if partition:
+            over.append("PARTITION BY " + ", ".join(partition))
+        if has_order:
+            over.append("ORDER BY v" + ("" if asc else " DESC"))
+        if frame is not None:
+            def b(x, side):
+                if x == 0:
+                    return "CURRENT ROW"
+                return f"{abs(x)} {'PRECEDING' if x < 0 else 'FOLLOWING'}"
+            over.append(
+                f"ROWS BETWEEN {b(frame[0], 0)} AND {b(frame[1], 1)}"
+            )
+        call = (
+            f"{fn}()" if fn in ("row_number", "rank") else f"{fn}(v)"
+        )
+        q = (
+            f"SELECT g, s, v, {call} OVER ({' '.join(over)}) AS w FROM w"
+        )
+        got = ctx.sql(q)
+        want = _window_oracle(
+            f, fn, partition, "v" if has_order else None, asc, frame
+        )
+        for idx in f.index:
+            a, b2 = got["w"].iloc[idx], want.iloc[idx]
+            if pd.isna(a) and (b2 is None or pd.isna(b2)):
+                continue
+            assert not pd.isna(a) and b2 is not None, (q, idx, a, b2)
+            assert abs(float(a) - float(b2)) < 1e-6, (q, idx, a, b2)
