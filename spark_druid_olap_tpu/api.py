@@ -66,6 +66,9 @@ class TPUOlapContext:
         self._result_cache = CountBudgetCache(
             max(self.config.result_cache_entries, 1)
         )
+        # CREATE VIEW registry: view name -> defining SELECT text; the
+        # parser expands references as derived tables
+        self.views: Dict[str, str] = {}
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -235,13 +238,13 @@ class TPUOlapContext:
         return Planner(self.catalog, self.config, n_devices=len(jax.devices()))
 
     def plan_sql(self, sql_text: str) -> Rewrite:
-        lp, _, _ = parse_sql(sql_text)
+        lp, _, _ = parse_sql(sql_text, views=self.views)
         return self._planner().plan(lp)
 
     def explain(self, sql_text: str) -> str:
         """EXPLAIN DRUID REWRITE analog: logical plan -> chosen query spec
         JSON -> physical plan."""
-        lp, _, _ = parse_sql(sql_text)
+        lp, _, _ = parse_sql(sql_text, views=self.views)
         return self._planner().explain(lp)
 
     @property
@@ -263,7 +266,7 @@ class TPUOlapContext:
         """EXPLAIN ANALYZE analog: run the query, return (DataFrame,
         explain text + measured QueryMetrics).  Bypasses the result cache —
         the metrics must describe THIS execution, not a cache lookup."""
-        lp, _, _ = parse_sql(sql_text)
+        lp, _, _ = parse_sql(sql_text, views=self.views)
         planner = self._planner()
         try:
             rw = planner.plan(lp)
@@ -289,6 +292,7 @@ class TPUOlapContext:
         return (
             sql_text,
             self.catalog.version,
+            tuple(sorted(self.views.items())),  # view redefinition invalidates
             repr(self.config),
             len(jax.devices()),
         )
@@ -303,7 +307,7 @@ class TPUOlapContext:
         cached = self._plan_cache.get(key)
         if cached is not None:
             return self.execute_rewrite(cached)
-        lp, explain, out_names = parse_sql(sql_text)
+        lp, explain, out_names = parse_sql(sql_text, views=self.views)
         planner = self._planner()
         if explain:
             import pandas as pd

@@ -49,6 +49,21 @@ _CREATE = re.compile(
     re.IGNORECASE | re.DOTALL,
 )
 # one OPTIONS entry: key 'value' or key "value"
+_CTAS = re.compile(
+    r"^\s*create\s+(temporary\s+)?table\s+(?P<name>[A-Za-z_]\w*)\s+as\s+"
+    r"(?P<sel>select\b.*)$",
+    re.IGNORECASE | re.DOTALL,
+)
+_CREATE_VIEW = re.compile(
+    r"^\s*create\s+(?:or\s+replace\s+)?(?:temporary\s+)?view\s+"
+    r"(?P<name>[A-Za-z_]\w*)\s+as\s+(?P<sel>select\b.*)$",
+    re.IGNORECASE | re.DOTALL,
+)
+_DROP_VIEW = re.compile(
+    r"^\s*drop\s+view\s+(?P<ife>if\s+exists\s+)?(?P<name>[A-Za-z_]\w*)"
+    r"\s*;?\s*$",
+    re.IGNORECASE,
+)
 _OPT_ENTRY = re.compile(
     r"^\s*([A-Za-z_]\w*)\s+(?:'((?:[^']|'')*)'|\"([^\"]*)\")\s*$"
 )
@@ -123,6 +138,19 @@ def parse_command(sql: str) -> Optional[Command]:
             options=opts,
             fmt=m.group("fmt").lower(),
         )
+    m = _CTAS.match(sql)
+    if m:
+        return Command("ctas", table=m.group("name"), value=m.group("sel"))
+    m = _CREATE_VIEW.match(sql)
+    if m:
+        return Command(
+            "create_view", table=m.group("name"), value=m.group("sel")
+        )
+    m = _DROP_VIEW.match(sql)
+    if m:
+        return Command(
+            "drop_view", table=m.group("name"), if_exists=bool(m.group("ife"))
+        )
     return None
 
 
@@ -164,7 +192,14 @@ def run_command(ctx, cmd: Command):
         ctx.drop_table(cmd.table)
         return pd.DataFrame({"status": [f"dropped {cmd.table}"]})
     if cmd.kind == "show_tables":
-        return pd.DataFrame({"table": sorted(ctx.catalog.tables())})
+        tables = sorted(ctx.catalog.tables())
+        views = sorted(ctx.views)
+        return pd.DataFrame(
+            {
+                "table": tables + views,
+                "kind": ["table"] * len(tables) + ["view"] * len(views),
+            }
+        )
     if cmd.kind == "describe":
         ds = ctx.catalog.get(cmd.table)
         if ds is None:
@@ -250,4 +285,34 @@ def run_command(ctx, cmd: Command):
         return pd.DataFrame(
             {"status": [f"created {cmd.table} ({ds.num_rows} rows)"]}
         )
+    if cmd.kind == "ctas":
+        # CREATE TABLE name AS SELECT ...: materialize the result as a new
+        # datasource (the local analog of a Druid ingestion rollup);
+        # dimensions/metrics are inferred from the result dtypes
+        if ctx.catalog.get(cmd.table) is not None:
+            raise ValueError(f"table {cmd.table!r} already exists")
+        df = ctx.sql(cmd.value)
+        ds = ctx.register_table(cmd.table, df)
+        return pd.DataFrame(
+            {"status": [f"created {cmd.table} ({ds.num_rows} rows)"]}
+        )
+    if cmd.kind == "create_view":
+        # validate the definition NOW (parse + plan against the current
+        # catalog) so a broken view fails at CREATE, not first use
+        from .parser import parse_sql
+
+        views = dict(ctx.views)
+        views.pop(cmd.table, None)
+        parse_sql(cmd.value, views=views)
+        ctx.views[cmd.table] = cmd.value.strip()
+        return pd.DataFrame({"status": [f"created view {cmd.table}"]})
+    if cmd.kind == "drop_view":
+        if cmd.table not in ctx.views:
+            if cmd.if_exists:
+                return pd.DataFrame(
+                    {"status": [f"view {cmd.table} did not exist"]}
+                )
+            raise KeyError(f"view {cmd.table!r} does not exist")
+        del ctx.views[cmd.table]
+        return pd.DataFrame({"status": [f"dropped view {cmd.table}"]})
     raise ValueError(cmd.kind)

@@ -146,9 +146,10 @@ class JoinClause:
 
 
 class Parser:
-    def __init__(self, sql: str):
+    def __init__(self, sql: str, views: Optional[Dict[str, str]] = None):
         self.toks = tokenize(sql)
         self.i = 0
+        self.views = views or {}  # view name -> defining SELECT text
         self.aliases: Dict[str, str] = {}  # alias -> table
         # alias names registered by the CURRENT select's FROM clause —
         # needed for correlation scoping: an alias that exists in both the
@@ -466,6 +467,18 @@ class Parser:
         t = self.peek()
         if t.kind == "IDENT":
             alias = self.expect_ident()
+        if name in self.views:
+            # a view reference expands to a derived table of its defining
+            # SELECT (re-parsed with the view itself removed, so chains
+            # of views work and cycles cannot recurse)
+            self.aliases[alias or name] = alias or name
+            if self._scopes:
+                self._scopes[-1].add(alias or name)
+            if self.peek().kind == "KW" and self.peek().value.lower() in (
+                "join", "inner", "left"
+            ):
+                raise ParseError("JOIN over a view unsupported")
+            return self._view_subquery(name, alias)
         self.aliases[alias or name] = name
         if self._scopes:
             self._scopes[-1].add(alias or name)
@@ -483,6 +496,8 @@ class Parser:
             else:
                 break
             rname = self.expect_ident()
+            if rname in self.views:
+                raise ParseError("a view cannot appear in join position")
             ralias = None
             if self.peek().kind == "IDENT":
                 ralias = self.expect_ident()
@@ -500,6 +515,17 @@ class Parser:
                     break
             node = JoinClause(node, rname, ralias, on, how)
         return node
+
+    def _view_subquery(self, name: str, alias: Optional[str]) -> Subquery:
+        inner_views = {k: v for k, v in self.views.items() if k != name}
+        p2 = Parser(self.views[name], views=inner_views)
+        stmt = p2.parse()
+        if not isinstance(stmt, SelectStmt):
+            raise ParseError(
+                f"view {name!r} is a set-operation statement; only plain "
+                "SELECT views are supported"
+            )
+        return Subquery(stmt, alias or name, tuple(p2.aliases.items()))
 
     def _qualified_name(self) -> str:
         a = self.expect_ident()
@@ -1690,9 +1716,12 @@ def _fold_setops(plans, ops) -> L.LogicalPlan:
     return plan
 
 
-def parse_sql(sql: str) -> Tuple[L.LogicalPlan, bool, List[str]]:
-    """Returns (logical plan, explain?, SELECT-order output names)."""
-    p = Parser(sql)
+def parse_sql(
+    sql: str, views: Optional[Dict[str, str]] = None
+) -> Tuple[L.LogicalPlan, bool, List[str]]:
+    """Returns (logical plan, explain?, SELECT-order output names).
+    `views` maps view names to their defining SELECT text (CREATE VIEW)."""
+    p = Parser(sql, views=views)
     stmt = p.parse()
     if isinstance(stmt, UnionStmt):
         plans = [

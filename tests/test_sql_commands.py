@@ -171,3 +171,80 @@ def test_set_result_cache_zero_releases_entries(ctx):
     assert len(ctx._result_cache) >= 1
     ctx.sql("SET result_cache_entries = 0")
     assert len(ctx._result_cache) == 0
+
+
+# -- round-3: CREATE VIEW / DROP VIEW / CREATE TABLE AS SELECT -------------
+
+
+def _view_ctx():
+    import spark_druid_olap_tpu as sd
+
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "vt",
+        {
+            "g": np.array(["a", "a", "b", "c"], dtype=object),
+            "v": np.array([1.0, 2.0, 3.0, 4.0], np.float32),
+        },
+        dimensions=["g"],
+        metrics=["v"],
+    )
+    return c
+
+
+def test_create_view_and_query():
+    c = _view_ctx()
+    c.sql("CREATE VIEW big AS SELECT g, sum(v) AS s FROM vt GROUP BY g")
+    got = c.sql("SELECT count(*) AS n FROM big WHERE s > 3")
+    assert int(got["n"].iloc[0]) == 1  # sums a=3, b=3, c=4 -> only c
+    # aggregate OVER the view (nested aggregation through a derived table)
+    got2 = c.sql("SELECT max(s) AS m FROM big")
+    assert float(got2["m"].iloc[0]) == 4.0
+    tables = c.sql("SHOW TABLES")
+    assert ("big", "view") in list(zip(tables["table"], tables["kind"]))
+
+
+def test_view_over_view_and_redefinition_invalidates():
+    c = _view_ctx()
+    c.sql("CREATE VIEW v1 AS SELECT g, sum(v) AS s FROM vt GROUP BY g")
+    c.sql("CREATE VIEW v2 AS SELECT s FROM v1 WHERE s >= 3")
+    assert len(c.sql("SELECT s FROM v2")) == 3
+    # OR REPLACE changes v1; v2 must see the new definition (plan cache
+    # keys on the view registry)
+    c.sql(
+        "CREATE OR REPLACE VIEW v1 AS "
+        "SELECT g, sum(v) AS s FROM vt WHERE g <> 'c' GROUP BY g"
+    )
+    assert len(c.sql("SELECT s FROM v2")) == 2  # a(3), b(3) remain >= 3
+
+
+def test_view_validation_and_drop():
+    import pytest as _pytest
+
+    c = _view_ctx()
+    with _pytest.raises(Exception):
+        # definition must PARSE at CREATE time (syntax error surfaces now)
+        c.sql("CREATE VIEW bad AS SELECT FROM vt WHERE")
+    c.sql("CREATE VIEW ok AS SELECT g FROM vt")
+    c.sql("DROP VIEW ok")
+    with _pytest.raises(Exception):
+        c.sql("SELECT * FROM ok")
+    c.sql("DROP VIEW IF EXISTS ok")  # no error
+    with _pytest.raises(KeyError):
+        c.sql("DROP VIEW ok")
+
+
+def test_ctas_materializes():
+    c = _view_ctx()
+    c.sql(
+        "CREATE TABLE rollup1 AS "
+        "SELECT g, sum(v) AS s, count(*) AS n FROM vt GROUP BY g"
+    )
+    ds = c.catalog.get("rollup1")
+    assert ds is not None and ds.num_rows == 3
+    got = c.sql("SELECT g, s FROM rollup1 ORDER BY s DESC LIMIT 1")
+    assert got["g"].iloc[0] == "c" and float(got["s"].iloc[0]) == 4.0
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="already exists"):
+        c.sql("CREATE TABLE rollup1 AS SELECT g FROM vt")
