@@ -279,7 +279,20 @@ class Planner:
         if agg.grouping_sets:
             b = b.with_(grouping_sets=tuple(agg.grouping_sets))
 
-        # LimitTransform
+        # LimitTransform.  A sort key naming a HOST-residual projection
+        # (e.g. a GROUPING() bit expression) cannot be ordered on the
+        # device — the column only exists after host finalize; route the
+        # whole query to the fallback rather than KeyError mid-execution.
+        host_post_names = {n for n, _ in host_posts}
+        for k in sort_keys:
+            if (
+                isinstance(k.expr, (E.Col, E.AggRef))
+                and k.expr.name in host_post_names
+            ):
+                raise RewriteError(
+                    f"ORDER BY {k.expr.name} references a host-residual "
+                    "projection; host fallback required"
+                )
         rankable = agg_names + list(post_names)
         b = apply_sort_limit(b, sort_keys, limit, offset, self.cfg, rankable)
         b = b.with_(output_columns=tuple(output_columns))

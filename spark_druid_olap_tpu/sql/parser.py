@@ -58,6 +58,18 @@ class AggCall(E.Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class GroupingCall(E.Expr):
+    """SQL GROUPING(col): 1 when `col` is rolled away in the current
+    grouping set, else 0.  The analyzer desugars it to a bit test over the
+    __grouping_id column the grouping-set machinery already emits."""
+
+    col: E.Expr
+
+    def __str__(self):
+        return f"grouping({self.col})"
+
+
+@dataclasses.dataclass(frozen=True)
 class WindowCall(E.Expr):
     """Parser-level `fn(...) OVER (...)`; the analyzer lifts these into
     `L.Window` specs and replaces them with hidden-column Col refs.  Field
@@ -1134,6 +1146,10 @@ class Parser:
             for a in reversed(args[:-1]):
                 out = E.IfExpr(E.Comparison("!=", a, E.Literal(None)), a, out)
             return out
+        if fn == "grouping":
+            arg = self.expr()
+            self.expect_op(")")
+            return GroupingCall(arg)
         if fn in WINDOW_FNS:
             # the OVER clause itself attaches in _maybe_over
             if fn in ("row_number", "rank", "dense_rank"):
@@ -1212,6 +1228,20 @@ def _contains_agg(e: E.Expr) -> bool:
             return True
         if isinstance(v, tuple) and any(
             isinstance(x, E.Expr) and _contains_agg(x) for x in v
+        ):
+            return True
+    return False
+
+
+def _contains_grouping(e: E.Expr) -> bool:
+    if isinstance(e, GroupingCall):
+        return True
+    for f in dataclasses.fields(e):  # type: ignore[arg-type]
+        v = getattr(e, f.name)
+        if isinstance(v, E.Expr) and _contains_grouping(v):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, E.Expr) and _contains_grouping(x) for x in v
         ):
             return True
     return False
@@ -1358,18 +1388,26 @@ class Analyzer:
         post_exprs: List[Tuple[str, E.Expr]] = []
         out_exprs: List[Tuple[str, E.Expr]] = []
         self._win_groups = list(group_exprs)
+        has_sets = stmt.group_mode != "plain"
+        k_groups = len(group_exprs)
         for alias, e in stmt.items:
-            es = _strip_qualifiers(e, self.aliases)
+            es0 = _strip_qualifiers(e, self.aliases)
+            had_grouping = _contains_grouping(es0)
+            es = self._sub_grouping_calls(es0, group_keys, k_groups, has_sets)
             if _contains_window(es):
-                name = alias or _auto_name(es)
+                name = alias or _auto_name(es0)
                 lifted = self._lift_windows(es)
                 if _contains_agg(lifted):
                     lifted = self._lift_aggs(lifted, name, _top=False)
                 out_exprs.append((name, self._sub_group_refs(lifted)))
                 continue
-            if _contains_agg(es):
-                name = alias or _auto_name(es)
-                post = self._lift_aggs(es, name)
+            if _contains_agg(es) or had_grouping:
+                # GROUPING()-containing items are post-aggregate
+                # expressions over __grouping_id even without an aggregate
+                name = alias or _auto_name(es0)
+                post = (
+                    self._lift_aggs(es, name) if _contains_agg(es) else es
+                )
                 post_exprs.append((name, post))
                 out_exprs.append((name, E.Col(name)))
             else:
@@ -1385,6 +1423,7 @@ class Analyzer:
         having_expr = None
         if stmt.having is not None:
             hs = _strip_qualifiers(stmt.having, self.aliases)
+            hs = self._sub_grouping_calls(hs, group_keys, k_groups, has_sets)
             having_expr = self._lift_aggs(hs, "having")
 
         grouping_sets: Tuple[Tuple[int, ...], ...] = ()
@@ -1509,6 +1548,55 @@ class Analyzer:
                 kw[f.name] = self._sub_group_refs(v)
             elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
                 kw[f.name] = tuple(self._sub_group_refs(x) for x in v)
+            else:
+                kw[f.name] = v
+        return type(e)(**kw)
+
+    def _sub_grouping_calls(
+        self, e: E.Expr, group_keys, k: int, has_sets: bool
+    ) -> E.Expr:
+        """GROUPING(col) -> bit test over __grouping_id (or literal 0 for
+        a plain GROUP BY, where nothing is ever rolled away)."""
+        if isinstance(e, GroupingCall):
+            arg = _strip_qualifiers(e.col, self.aliases)
+            idx = _find_group(arg, group_keys)
+            if idx is None:
+                raise ParseError(
+                    f"GROUPING({e.col}) argument must be a GROUP BY "
+                    "expression"
+                )
+            if not has_sets:
+                return E.Literal(0)
+            # bit (k-1-idx) of __grouping_id: floor(gid / 2^(k-1-idx)) % 2
+            return E.Cast(
+                E.BinaryOp(
+                    "%",
+                    E.UnaryOp(
+                        "floor",
+                        E.BinaryOp(
+                            "/",
+                            E.Col("__grouping_id"),
+                            E.Literal(float(1 << (k - 1 - idx))),
+                        ),
+                    ),
+                    E.Literal(2.0),
+                ),
+                "long",
+            )
+        if isinstance(e, (E.Literal, E.Col, E.AggRef)):
+            return e
+        kw = {}
+        for f in dataclasses.fields(e):  # type: ignore[arg-type]
+            v = getattr(e, f.name)
+            if isinstance(v, E.Expr):
+                kw[f.name] = self._sub_grouping_calls(
+                    v, group_keys, k, has_sets
+                )
+            elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
+                kw[f.name] = tuple(
+                    self._sub_grouping_calls(x, group_keys, k, has_sets)
+                    for x in v
+                )
             else:
                 kw[f.name] = v
         return type(e)(**kw)

@@ -356,3 +356,37 @@ def test_scan_wire_bad_order_column_is_clean_error(ctx):
 
     with pytest.raises(ValueError, match="unknown column"):
         ctx.engine.execute(q, ctx.catalog.get("lineitem"))
+
+
+def test_grouping_function(ctx):
+    """SQL GROUPING(col): 1 on rolled-away rows, 0 elsewhere — desugared
+    to a bit test over __grouping_id; works on device AND fallback, in
+    SELECT and HAVING; plain GROUP BY yields constant 0."""
+    got = ctx.sql(
+        "SELECT l_returnflag, l_linestatus, GROUPING(l_returnflag) AS gf, "
+        "GROUPING(l_linestatus) AS gs, sum(l_quantity) AS q "
+        "FROM lineitem GROUP BY CUBE (l_returnflag, l_linestatus)"
+    )
+    # rolled-away dimension <=> its GROUPING bit set
+    for _, r in got.iterrows():
+        assert (int(r["gf"]) == 1) == pd.isna(r["l_returnflag"])
+        assert (int(r["gs"]) == 1) == pd.isna(r["l_linestatus"])
+    # HAVING GROUPING: keep only the grand total
+    tot = ctx.sql(
+        "SELECT sum(l_quantity) AS q, GROUPING(l_returnflag) AS gf "
+        "FROM lineitem GROUP BY ROLLUP (l_returnflag) "
+        "HAVING GROUPING(l_returnflag) = 1"
+    )
+    assert len(tot) == 1 and int(tot["gf"].iloc[0]) == 1
+    plain = ctx.sql(
+        "SELECT l_returnflag, GROUPING(l_returnflag) AS gf FROM lineitem "
+        "GROUP BY l_returnflag"
+    )
+    assert (plain["gf"] == 0).all()
+    from spark_druid_olap_tpu.sql.parser import ParseError
+
+    with pytest.raises(ParseError, match="GROUP BY"):
+        ctx.sql(
+            "SELECT GROUPING(l_quantity) FROM lineitem "
+            "GROUP BY l_returnflag"
+        )
