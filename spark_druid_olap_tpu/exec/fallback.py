@@ -1325,6 +1325,17 @@ def _window_partition(w, idxs, peer_codes, va, fm, res):
         for i in range(m):
             res[idxs[i]] = int(peer_id[i]) + 1
         return
+    if fn == "percent_rank":
+        # (rank - 1) / (partition rows - 1); 0 for a single-row partition
+        for i in range(m):
+            res[idxs[i]] = (
+                0.0 if m == 1 else float(peer_start[i]) / (m - 1)
+            )
+        return
+    if fn == "cume_dist":
+        for i in range(m):
+            res[idxs[i]] = float(peer_end[i] + 1) / m
+        return
     if fn == "ntile":
         k = int(w.args[0])
         base, rem = divmod(m, k)
@@ -1364,13 +1375,21 @@ def _window_partition(w, idxs, peer_codes, va, fm, res):
     vp = va[idxs] if va is not None else None
     fmp = fm[idxs] if fm is not None else None
 
-    if fn in ("first_value", "last_value"):
+    if fn in ("first_value", "last_value", "nth_value"):
+        nth = int(w.args[0]) if fn == "nth_value" else 1
         for i in range(m):
             lo_i, hi_i = frame_bounds(i)
             if lo_i > hi_i:
                 res[idxs[i]] = None
                 continue
-            v = vp[lo_i] if fn == "first_value" else vp[hi_i]
+            if fn == "last_value":
+                j = hi_i
+            else:  # first_value == nth_value(.., 1)
+                j = lo_i + nth - 1
+                if j > hi_i:
+                    res[idxs[i]] = None  # frame shorter than N rows
+                    continue
+            v = vp[j]
             res[idxs[i]] = None if pd.isna(v) else v
         return
 

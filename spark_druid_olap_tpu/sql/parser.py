@@ -29,6 +29,7 @@ AGG_FNS = {"sum", "count", "avg", "min", "max", "approx_count_distinct"}
 WINDOW_FNS = {
     "row_number", "rank", "dense_rank", "ntile",
     "lag", "lead", "first_value", "last_value",
+    "percent_rank", "cume_dist", "nth_value",
 }
 #: aggregates legal inside OVER (sketches/quantiles are not)
 WINDOW_AGG_FNS = {"sum", "count", "avg", "min", "max"}
@@ -532,11 +533,6 @@ class Parser:
         inner_views = {k: v for k, v in self.views.items() if k != name}
         p2 = Parser(self.views[name], views=inner_views)
         stmt = p2.parse()
-        if not isinstance(stmt, SelectStmt):
-            raise ParseError(
-                f"view {name!r} is a set-operation statement; only plain "
-                "SELECT views are supported"
-            )
         return Subquery(stmt, alias or name, tuple(p2.aliases.items()))
 
     def _qualified_name(self) -> str:
@@ -827,7 +823,8 @@ class Parser:
             base = WindowCall(e.fn, e.arg, e.args, filter=e.filter)
         else:
             raise ParseError("OVER must follow a function call")
-        if base.fn in ("rank", "dense_rank", "ntile", "lag", "lead"):
+        if base.fn in ("rank", "dense_rank", "ntile", "lag", "lead",
+                       "percent_rank", "cume_dist"):
             if not order_exprs:
                 raise ParseError(
                     f"{base.fn.upper()} requires ORDER BY in its OVER clause"
@@ -1152,7 +1149,8 @@ class Parser:
             return GroupingCall(arg)
         if fn in WINDOW_FNS:
             # the OVER clause itself attaches in _maybe_over
-            if fn in ("row_number", "rank", "dense_rank"):
+            if fn in ("row_number", "rank", "dense_rank",
+                      "percent_rank", "cume_dist"):
                 self.expect_op(")")
                 return WindowCall(fn, None)
             if fn == "ntile":
@@ -1193,6 +1191,19 @@ class Parser:
                         args = args + (d.value,)
                 self.expect_op(")")
                 return WindowCall(fn, arg, args)
+            if fn == "nth_value":
+                arg = self.expr()
+                self.expect_op(",")
+                n = self.expr()
+                self.expect_op(")")
+                if not isinstance(n, E.Literal) or not isinstance(
+                    n.value, int
+                ) or n.value < 1:
+                    raise ParseError(
+                        "NTH_VALUE position must be a positive integer "
+                        "literal"
+                    )
+                return WindowCall(fn, arg, (n.value,))
             # first_value / last_value
             arg = self.expr()
             self.expect_op(")")
@@ -1495,6 +1506,16 @@ class Analyzer:
             # reference the subquery's SELECT-list names (the planner's
             # Project-collapsing walk would otherwise resolve renamed-away
             # names against the base table — silent wrong data)
+            if isinstance(t.stmt, UnionStmt):
+                # a set-operation view expands here: fold its branches
+                names = _stmt_out_names(
+                    t.stmt.branches[0], dict(t.aliases)
+                )
+                return L.SubqueryScan(
+                    _union_logical(t.stmt, dict(t.aliases)),
+                    tuple(names) if names else None,
+                    t.alias,
+                )
             inner = Analyzer(t.stmt, dict(t.aliases))
             names = _stmt_out_names(t.stmt, self.aliases)  # [] = SELECT *
             return L.SubqueryScan(
@@ -1812,43 +1833,52 @@ def parse_sql(
     p = Parser(sql, views=views)
     stmt = p.parse()
     if isinstance(stmt, UnionStmt):
-        plans = [
-            Analyzer(b, dict(p.aliases)).to_logical() for b in stmt.branches
-        ]
-        plan = _fold_setops(plans, stmt.ops)
-        first = stmt.branches[0]
-        if stmt.order_by:
-            # mirror Analyzer._order_limit's resolution: ordinals bind to
-            # the first branch's SELECT items; aggregates have no grouping
-            # context after UNION ALL and are rejected, not crashed on
-            keys = []
-            for e, asc in stmt.order_by:
-                es = _strip_qualifiers(e, p.aliases)
-                if _contains_agg(es) or _contains_window(es):
-                    raise ParseError(
-                        "ORDER BY after a set operation must reference "
-                        "output columns, not aggregates or window functions"
-                    )
-                if isinstance(es, E.Literal) and isinstance(es.value, int):
-                    idx = es.value - 1
-                    if not 0 <= idx < len(first.items):
-                        raise ParseError(
-                            f"ORDER BY ordinal {es.value} out of range"
-                        )
-                    alias, ie = first.items[idx]
-                    es = E.Col(
-                        alias
-                        or _auto_name(_strip_qualifiers(ie, p.aliases))
-                    )
-                keys.append(L.SortKey(es, asc))
-            plan = L.Sort(tuple(keys), plan)
-        if stmt.limit is not None or stmt.offset:
-            plan = L.Limit(
-                stmt.limit if stmt.limit is not None else (1 << 62),
-                plan,
-                stmt.offset,
-            )
-        return plan, stmt.explain, _stmt_out_names(first, p.aliases)
+        plan = _union_logical(stmt, p.aliases)
+        return (
+            plan,
+            stmt.explain,
+            _stmt_out_names(stmt.branches[0], p.aliases),
+        )
     analyzer = Analyzer(stmt, p.aliases)
     plan = analyzer.to_logical()
     return plan, stmt.explain, _stmt_out_names(stmt, p.aliases)
+
+
+def _union_logical(stmt: UnionStmt, aliases) -> L.LogicalPlan:
+    """UnionStmt -> folded logical tree with trailing ORDER BY / LIMIT."""
+    plans = [
+        Analyzer(b, dict(aliases)).to_logical() for b in stmt.branches
+    ]
+    plan = _fold_setops(plans, stmt.ops)
+    first = stmt.branches[0]
+    if stmt.order_by:
+        # mirror Analyzer._order_limit's resolution: ordinals bind to
+        # the first branch's SELECT items; aggregates have no grouping
+        # context after UNION ALL and are rejected, not crashed on
+        keys = []
+        for e, asc in stmt.order_by:
+            es = _strip_qualifiers(e, aliases)
+            if _contains_agg(es) or _contains_window(es):
+                raise ParseError(
+                    "ORDER BY after a set operation must reference "
+                    "output columns, not aggregates or window functions"
+                )
+            if isinstance(es, E.Literal) and isinstance(es.value, int):
+                idx = es.value - 1
+                if not 0 <= idx < len(first.items):
+                    raise ParseError(
+                        f"ORDER BY ordinal {es.value} out of range"
+                    )
+                alias, ie = first.items[idx]
+                es = E.Col(
+                    alias or _auto_name(_strip_qualifiers(ie, aliases))
+                )
+            keys.append(L.SortKey(es, asc))
+        plan = L.Sort(tuple(keys), plan)
+    if stmt.limit is not None or stmt.offset:
+        plan = L.Limit(
+            stmt.limit if stmt.limit is not None else (1 << 62),
+            plan,
+            stmt.offset,
+        )
+    return plan

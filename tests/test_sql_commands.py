@@ -248,3 +248,22 @@ def test_ctas_materializes():
 
     with _pytest.raises(ValueError, match="already exists"):
         c.sql("CREATE TABLE rollup1 AS SELECT g FROM vt")
+
+
+def test_setop_view():
+    """Views defined as set operations expand through the union fold."""
+    c = _view_ctx()
+    c.register_table(
+        "vt2",
+        {"g": np.array(["b", "d"], dtype=object),
+         "v": np.array([9.0, 9.0], np.float32)},
+        dimensions=["g"], metrics=["v"],
+    )
+    c.sql("CREATE VIEW allg AS SELECT g FROM vt UNION SELECT g FROM vt2")
+    got = c.sql("SELECT g, count(*) AS n FROM allg GROUP BY g ORDER BY g")
+    assert list(got["g"]) == ["a", "b", "c", "d"]
+    assert (got["n"] == 1).all()
+    got2 = c.sql(
+        "CREATE TABLE mat AS SELECT g FROM vt EXCEPT SELECT g FROM vt2"
+    )
+    assert c.catalog.get("mat").num_rows == 2  # a, c

@@ -489,3 +489,30 @@ def test_fuzz_windows_vs_oracle(ctx, seed):
                 continue
             assert not pd.isna(a) and b2 is not None, (q, idx, a, b2)
             assert abs(float(a) - float(b2)) < 1e-6, (q, idx, a, b2)
+
+
+def test_percent_rank_cume_dist_nth_value(ctx):
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "pr", {"v": np.array([1.0, 2.0, 2.0, 4.0])}, metrics=["v"]
+    )
+    got = c.sql(
+        "SELECT v, PERCENT_RANK() OVER (ORDER BY v) AS pr, "
+        "CUME_DIST() OVER (ORDER BY v) AS cd, "
+        "NTH_VALUE(v, 2) OVER (ORDER BY v ROWS BETWEEN UNBOUNDED "
+        "PRECEDING AND UNBOUNDED FOLLOWING) AS n2, "
+        "NTH_VALUE(v, 9) OVER (ORDER BY v ROWS BETWEEN UNBOUNDED "
+        "PRECEDING AND UNBOUNDED FOLLOWING) AS n9 FROM pr"
+    )
+    np.testing.assert_allclose(
+        sorted(got["pr"].astype(float)), [0.0, 1 / 3, 1 / 3, 1.0]
+    )
+    np.testing.assert_allclose(
+        sorted(got["cd"].astype(float)), [0.25, 0.75, 0.75, 1.0]
+    )
+    assert (got["n2"].astype(float) == 2.0).all()
+    assert got["n9"].isna().all()  # frame shorter than 9 rows -> NULL
+    with pytest.raises(ParseError, match="requires ORDER BY"):
+        c.sql("SELECT PERCENT_RANK() OVER () FROM pr")
+    with pytest.raises(ParseError, match="positive integer"):
+        c.sql("SELECT NTH_VALUE(v, 0) OVER (ORDER BY v) FROM pr")
