@@ -224,4 +224,43 @@ def filter_from_druid(d: Dict[str, Any]) -> Filter:
         return Or(tuple(filter_from_druid(f) for f in d["fields"]))
     if t == "not":
         return Not(filter_from_druid(d["field"]))
+    if t == "search":
+        # contains / insensitive_contains map onto the Regex filter (same
+        # O(dictionary) evaluation; re.escape keeps %/_/metacharacters
+        # literal, which the LIKE translator cannot express)
+        import re as _re
+
+        q = d.get("query", {})
+        qt = q.get("type")
+        value = q.get("value", "")
+        insensitive = qt in (
+            "insensitiveContains", "insensitive_contains"
+        ) or (qt == "contains" and not q.get("caseSensitive", True))
+        if qt not in ("contains", "insensitiveContains",
+                      "insensitive_contains"):
+            raise ValueError(f"unsupported search query type {qt!r}")
+        pat = ("(?i)" if insensitive else "") + _re.escape(value)
+        return Regex(d["dimension"], pat)
+    if t == "interval":
+        from .wire import intervals_from_druid
+
+        return IntervalFilter(
+            d.get("dimension", "__time"),
+            intervals_from_druid(d.get("intervals", [])),
+        )
+    if t == "expression":
+        from .wire import _expr
+
+        return ExpressionFilter(_expr(d["expression"]))
+    if t == "columnComparison":
+        from ..plan import expr as E
+
+        dims = d.get("dimensions", [])
+        if len(dims) != 2 or not all(isinstance(x, str) for x in dims):
+            raise ValueError(
+                "columnComparison requires exactly two plain dimensions"
+            )
+        return ExpressionFilter(
+            E.Comparison("==", E.Col(dims[0]), E.Col(dims[1]))
+        )
     raise ValueError(f"unsupported filter type {t!r}")

@@ -550,3 +550,65 @@ def test_sort_by_with_nulls():
     nulls = codes < 0
     assert not nulls[:3].any() and nulls[3:].all()
     assert list(codes[:3]) == sorted(codes[:3])
+
+
+def test_search_expression_columncomparison_filters(served):
+    """Round-3 wire filters: search (contains / insensitiveContains),
+    expression, interval, columnComparison."""
+    ctx, srv, frame = served
+    base = {
+        "queryType": "timeseries",
+        "dataSource": "ev",
+        "granularity": "all",
+        "aggregations": [{"type": "count", "name": "n"}],
+    }
+    code, out = _post(
+        srv, "/druid/v2",
+        {**base, "filter": {
+            "type": "search", "dimension": "city",
+            "query": {"type": "contains", "value": "F"},
+        }},
+    )
+    assert code == 200
+    assert out[0]["result"]["n"] == int((frame["city"] == "SF").sum())
+    code, out = _post(
+        srv, "/druid/v2",
+        {**base, "filter": {
+            "type": "search", "dimension": "city",
+            "query": {"type": "insensitiveContains", "value": "f"},
+        }},
+    )
+    assert code == 200
+    assert out[0]["result"]["n"] == int((frame["city"] == "SF").sum())
+    code, out = _post(
+        srv, "/druid/v2",
+        {**base, "filter": {"type": "expression", "expression": "v > 0.5"}},
+    )
+    assert code == 200
+    assert out[0]["result"]["n"] == int((frame["v"] > 0.5).sum())
+    code, out = _post(
+        srv, "/druid/v2",
+        {**base, "filter": {
+            "type": "interval", "dimension": "__time",
+            "intervals": ["2021-01-01T00:00:00.000Z/2021-01-08T00:00:00.000Z"],
+        }},
+    )
+    assert code == 200 and out[0]["result"]["n"] > 0
+
+
+def test_column_comparison_filter_decode():
+    from spark_druid_olap_tpu.models.filters import (
+        ExpressionFilter,
+        filter_from_druid,
+    )
+
+    f = filter_from_druid(
+        {"type": "columnComparison", "dimensions": ["a", "b"]}
+    )
+    assert isinstance(f, ExpressionFilter)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="two plain dimensions"):
+        filter_from_druid(
+            {"type": "columnComparison", "dimensions": ["a"]}
+        )
