@@ -612,3 +612,36 @@ def test_column_comparison_filter_decode():
         filter_from_druid(
             {"type": "columnComparison", "dimensions": ["a"]}
         )
+
+
+def test_sql_endpoint_round3_surface(served):
+    """Windows, set operations, and views all reach the HTTP SQL endpoint
+    (they execute on the host fallback behind the same ctx.sql path)."""
+    ctx, srv, df = served
+    code, rows = _post(
+        srv, "/druid/v2/sql",
+        {"query": "SELECT city, sum(v) AS s, "
+                  "RANK() OVER (ORDER BY sum(v) DESC) AS r "
+                  "FROM ev GROUP BY city"},
+    )
+    assert code == 200
+    by_rank = sorted(rows, key=lambda r: r["r"])
+    want = df.groupby("city")["v"].sum().sort_values(ascending=False)
+    assert [r["city"] for r in by_rank] == list(want.index)
+    code, rows = _post(
+        srv, "/druid/v2/sql",
+        {"query": "SELECT city FROM ev WHERE v > 0.9 "
+                  "INTERSECT SELECT city FROM ev WHERE v < 0.1"},
+    )
+    assert code == 200 and len(rows) == 4  # all four cities span both tails
+    code, _ = _post(
+        srv, "/druid/v2/sql",
+        {"query": "CREATE VIEW hot AS SELECT city, v FROM ev WHERE v > 0.5"},
+    )
+    assert code == 200
+    code, rows = _post(
+        srv, "/druid/v2/sql",
+        {"query": "SELECT count(*) AS n FROM hot"},
+    )
+    assert code == 200
+    assert rows[0]["n"] == int((df["v"] > 0.5).sum())
