@@ -630,8 +630,6 @@ class TableQuery:
         self._offset: int = 0
 
     def _copy(self) -> "TableQuery":
-        import copy
-
         out = TableQuery(self.ctx, self._table)
         out._filter = self._filter
         out._select = list(self._select)

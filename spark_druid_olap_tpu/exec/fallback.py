@@ -573,39 +573,18 @@ def _broadcast_rows(vals, n: int) -> np.ndarray:
 
 
 def _expr_has_outer(e, refs: set) -> bool:
-    import dataclasses as _dc
-
-    if isinstance(e, E.Col) and e.name in refs:
-        return True
-    if not isinstance(e, Expr):
-        return False
-    for f in _dc.fields(e):
-        v = getattr(e, f.name)
-        if isinstance(v, Expr) and _expr_has_outer(v, refs):
-            return True
-        if isinstance(v, tuple) and any(
-            isinstance(x, Expr) and _expr_has_outer(x, refs) for x in v
-        ):
-            return True
-    return False
+    return E.any_node(
+        e, lambda x: isinstance(x, E.Col) and x.name in refs
+    )
 
 
 def _expr_has_subquery(e) -> bool:
-    import dataclasses as _dc
-
-    if isinstance(e, (E.InSubquery, E.ScalarSubquery, E.ExistsSubquery)):
-        return True
-    if not isinstance(e, Expr):
-        return False
-    for f in _dc.fields(e):
-        v = getattr(e, f.name)
-        if isinstance(v, Expr) and _expr_has_subquery(v):
-            return True
-        if isinstance(v, tuple) and any(
-            isinstance(x, Expr) and _expr_has_subquery(x) for x in v
-        ):
-            return True
-    return False
+    return E.any_node(
+        e,
+        lambda x: isinstance(
+            x, (E.InSubquery, E.ScalarSubquery, E.ExistsSubquery)
+        ),
+    )
 
 
 def _try_decorrelate_fill(sub, df, catalog, refs, out) -> bool:

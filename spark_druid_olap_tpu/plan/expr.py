@@ -147,6 +147,27 @@ def map_expr(e, fn):
     return fn(dataclasses.replace(e, **kw) if kw else e)
 
 
+def any_node(e, pred) -> bool:
+    """True when `pred` holds for any node of an expression tree — the
+    read-only sibling of `map_expr` (the only other place allowed to know
+    how Expr dataclasses hold children); "contains X" predicates build on
+    this instead of hand-rolling the dataclass walk."""
+    if not isinstance(e, Expr):
+        return False
+    if pred(e):
+        return True
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expr):
+            if any_node(v, pred):
+                return True
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, Expr) and any_node(x, pred):
+                    return True
+    return False
+
+
 def _collect_cols(e: Expr, out: list):
     if isinstance(e, Col):
         out.append(e.name)

@@ -907,6 +907,18 @@ class Parser:
             raise ParseError("expected PRECEDING or FOLLOWING")
         return -e.value if d == "preceding" else e.value
 
+    @staticmethod
+    def _fold_neg_literal(d: E.Expr) -> E.Expr:
+        """`-3` parses as UnaryOp('-', Literal(3)); literal-argument
+        positions (LAG/LEAD defaults, ROUND digits) want the folded form."""
+        if (
+            isinstance(d, E.UnaryOp)
+            and d.op == "-"
+            and isinstance(d.operand, E.Literal)
+        ):
+            return E.Literal(-d.operand.value)
+        return d
+
     def _filter_clause(self) -> Optional[E.Expr]:
         """Optional SQL `FILTER (WHERE <cond>)` after an aggregate call."""
         if not self.accept_kw("filter"):
@@ -1091,13 +1103,7 @@ class Parser:
             arg = self.expr()
             digits = 0
             if self.accept_op(","):
-                d = self.expr()
-                if (
-                    isinstance(d, E.UnaryOp)
-                    and d.op == "-"
-                    and isinstance(d.operand, E.Literal)
-                ):
-                    d = E.Literal(-d.operand.value)
+                d = self._fold_neg_literal(self.expr())
                 if not isinstance(d, E.Literal) or not isinstance(
                     d.value, int
                 ):
@@ -1177,13 +1183,7 @@ class Parser:
                         )
                     args = (off.value,)
                     if self.accept_op(","):
-                        d = self.expr()
-                        if (
-                            isinstance(d, E.UnaryOp)
-                            and d.op == "-"
-                            and isinstance(d.operand, E.Literal)
-                        ):
-                            d = E.Literal(-d.operand.value)
+                        d = self._fold_neg_literal(self.expr())
                         if not isinstance(d, E.Literal):
                             raise ParseError(
                                 f"{fn.upper()} default must be a literal"
@@ -1231,45 +1231,15 @@ def _contains_agg(e: E.Expr) -> bool:
     # NOTE: deliberately descends into WindowCall specs — an AggCall inside
     # an OVER clause (RANK() OVER (ORDER BY SUM(v))) makes the query an
     # aggregate query, while the window function itself does not
-    if isinstance(e, AggCall):
-        return True
-    for f in dataclasses.fields(e):  # type: ignore[arg-type]
-        v = getattr(e, f.name)
-        if isinstance(v, E.Expr) and _contains_agg(v):
-            return True
-        if isinstance(v, tuple) and any(
-            isinstance(x, E.Expr) and _contains_agg(x) for x in v
-        ):
-            return True
-    return False
+    return E.any_node(e, lambda x: isinstance(x, AggCall))
 
 
 def _contains_grouping(e: E.Expr) -> bool:
-    if isinstance(e, GroupingCall):
-        return True
-    for f in dataclasses.fields(e):  # type: ignore[arg-type]
-        v = getattr(e, f.name)
-        if isinstance(v, E.Expr) and _contains_grouping(v):
-            return True
-        if isinstance(v, tuple) and any(
-            isinstance(x, E.Expr) and _contains_grouping(x) for x in v
-        ):
-            return True
-    return False
+    return E.any_node(e, lambda x: isinstance(x, GroupingCall))
 
 
 def _contains_window(e: E.Expr) -> bool:
-    if isinstance(e, WindowCall):
-        return True
-    for f in dataclasses.fields(e):  # type: ignore[arg-type]
-        v = getattr(e, f.name)
-        if isinstance(v, E.Expr) and _contains_window(v):
-            return True
-        if isinstance(v, tuple) and any(
-            isinstance(x, E.Expr) and _contains_window(x) for x in v
-        ):
-            return True
-    return False
+    return E.any_node(e, lambda x: isinstance(x, WindowCall))
 
 
 def _strip_qualifiers(e: E.Expr, aliases: Dict[str, str]) -> E.Expr:
