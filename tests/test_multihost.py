@@ -8,6 +8,7 @@ process-count-agnostic.  The collectives themselves are covered by
 tests/test_distributed.py on the 8-device CPU mesh."""
 
 import jax
+import pytest
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -67,8 +68,18 @@ def test_local_segments_partition(monkeypatch):
     assert sorted(owned) == segs
 
 
-def test_true_two_process_distributed_groupby(tmp_path):
-    """VERDICT r2 #4: a REAL two-process `jax.distributed` runtime (no
+@pytest.mark.parametrize(
+    "nproc,devs_per_proc,want_mesh",
+    [
+        (2, 4, {"data": 8, "groups": 1}),
+        # 4 DCN processes x 2 local devices: the deeper multi-host shape
+        (4, 2, {"data": 8, "groups": 1}),
+    ],
+)
+def test_true_multi_process_distributed_groupby(
+    tmp_path, nproc, devs_per_proc, want_mesh
+):
+    """VERDICT r2 #4: a REAL multi-process `jax.distributed` runtime (no
     monkeypatching) — localhost rendezvous, hybrid DCNxICI mesh over 8
     global CPU devices, multi-process put_sharded placement, one
     distributed GroupBy — with parity against a single-process run."""
@@ -86,21 +97,23 @@ def test_true_two_process_distributed_groupby(tmp_path):
     env.update(
         {
             "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "XLA_FLAGS": (
+                f"--xla_force_host_platform_device_count={devs_per_proc}"
+            ),
             "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
         }
     )
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
-    outs = [str(tmp_path / f"w{i}.json") for i in range(2)]
+    outs = [str(tmp_path / f"w{i}.json") for i in range(nproc)]
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(port), str(i), "2", outs[i]],
+            [sys.executable, worker, str(port), str(i), str(nproc), outs[i]],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     for i, p in enumerate(procs):
         try:
@@ -111,11 +124,12 @@ def test_true_two_process_distributed_groupby(tmp_path):
             raise
         assert p.returncode == 0, f"worker {i} failed:\n{se[-3000:]}"
     results = [json.load(open(o)) for o in outs]
-    assert results[0]["info"]["process_count"] == 2
+    assert results[0]["info"]["process_count"] == nproc
     assert results[0]["info"]["global_devices"] == 8
-    assert results[0]["mesh_shape"] == {"data": 8, "groups": 1}
-    # both processes computed the SAME full result
-    assert results[0]["rows"] == results[1]["rows"]
+    assert results[0]["mesh_shape"] == want_mesh
+    # every process computed the SAME full result
+    for r in results[1:]:
+        assert results[0]["rows"] == r["rows"]
 
     # single-process parity on the same deterministic data
     import numpy as np

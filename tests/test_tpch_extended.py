@@ -259,3 +259,59 @@ def test_q2_class_window_rank_per_region(world):
         best.sort_values("s_region")["mn"].values,
         rtol=1e-6,
     )
+
+
+def test_q20_class_nested_in_chain(world):
+    """Q20: potential part promotion — IN over a grouped HAVING subquery
+    whose WHERE contains another IN subquery (two nesting levels)."""
+    ctx, tables, _ = world
+    got = ctx.sql("""
+        SELECT s_nation, count(*) AS n FROM supplier
+        WHERE s_suppkey IN
+          (SELECT l_suppkey FROM rawline
+           WHERE l_partkey IN
+             (SELECT p_partkey FROM part
+              WHERE p_type = 'ECONOMY ANODIZED STEEL')
+           GROUP BY l_suppkey HAVING sum(l_quantity) > 50)
+        GROUP BY s_nation ORDER BY s_nation
+    """)
+    li = pd.DataFrame(
+        {k: tables["lineitem"][k]
+         for k in ("l_suppkey", "l_partkey", "l_quantity")}
+    )
+    part = pd.DataFrame(tables["part"])
+    sup = pd.DataFrame(tables["supplier"])
+    steel = set(part[part.p_type == "ECONOMY ANODIZED STEEL"].p_partkey)
+    vol = li[li.l_partkey.isin(steel)].groupby("l_suppkey")["l_quantity"].sum()
+    hot = set(vol[vol > 50].index)
+    want = sup[sup.s_suppkey.isin(hot)].groupby("s_nation").size().sort_index()
+    assert want.sum() > 0  # non-vacuous
+    assert list(got["s_nation"]) == list(want.index)
+    assert [int(x) for x in got["n"]] == list(want.values)
+
+
+def test_q21_class_exists_and_not_exists(world):
+    """Q21: suppliers who kept orders waiting — EXISTS and NOT EXISTS
+    conjoined on the same correlation key."""
+    ctx, tables, _ = world
+    got = ctx.sql("""
+        SELECT s_nation, count(*) AS n FROM supplier s
+        WHERE EXISTS (SELECT l_orderkey FROM rawline
+                      WHERE l_suppkey = s.s_suppkey AND l_quantity > 25)
+          AND NOT EXISTS (SELECT l_orderkey FROM rawline
+                          WHERE l_suppkey = s.s_suppkey
+                            AND l_extendedprice > 55400)
+        GROUP BY s_nation ORDER BY s_nation
+    """)
+    li = pd.DataFrame(
+        {k: tables["lineitem"][k]
+         for k in ("l_suppkey", "l_quantity", "l_extendedprice")}
+    )
+    sup = pd.DataFrame(tables["supplier"])
+    big = set(li[li.l_quantity > 25].l_suppkey)
+    small = set(li[li.l_extendedprice > 55400].l_suppkey)
+    sel = sup[sup.s_suppkey.isin(big) & ~sup.s_suppkey.isin(small)]
+    want = sel.groupby("s_nation").size().sort_index()
+    assert want.sum() > 0  # non-vacuous at SCALE=0.004
+    assert list(got["s_nation"]) == list(want.index)
+    assert [int(x) for x in got["n"]] == list(want.values)
