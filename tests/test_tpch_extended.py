@@ -315,3 +315,43 @@ def test_q21_class_exists_and_not_exists(world):
     assert want.sum() > 0  # non-vacuous at SCALE=0.004
     assert list(got["s_nation"]) == list(want.index)
     assert [int(x) for x in got["n"]] == list(want.values)
+
+
+def test_q11_class_having_scalar_fraction(world):
+    """Q11: important stock identification — GROUP BY with HAVING compared
+    against a scalar subquery over the SAME aggregate (global fraction).
+    Completes the 22-class sweep: partsupp is synthesized here (the shared
+    generator's star schema deliberately omits it)."""
+    ctx, tables, _ = world
+    rng = np.random.default_rng(41)
+    n_s = len(tables["supplier"]["s_suppkey"])
+    n_p = len(tables["part"]["p_partkey"])
+    n = 4 * n_p
+    ps = {
+        "ps_partkey": rng.integers(0, n_p, n).astype(np.int64),
+        "ps_suppkey": rng.integers(0, n_s, n).astype(np.int64),
+        "ps_availqty": rng.integers(1, 1000, n).astype(np.float32),
+        "ps_supplycost": (rng.random(n) * 100).astype(np.float32),
+    }
+    ctx.register_table("partsupp", ps)
+    got = ctx.sql("""
+        SELECT ps_partkey, sum(ps_supplycost * ps_availqty) AS value
+        FROM partsupp
+        GROUP BY ps_partkey
+        HAVING sum(ps_supplycost * ps_availqty) >
+               (SELECT 0.002 * sum(ps_supplycost * ps_availqty)
+                FROM partsupp)
+        ORDER BY value DESC
+    """)
+    f = pd.DataFrame(ps).astype(
+        {"ps_availqty": np.float64, "ps_supplycost": np.float64}
+    )
+    f["value"] = f.ps_supplycost * f.ps_availqty
+    per = f.groupby("ps_partkey")["value"].sum()
+    thr = 0.002 * f["value"].sum()
+    want = per[per > thr].sort_values(ascending=False)
+    assert len(want) > 0
+    assert [int(k) for k in got["ps_partkey"]] == list(want.index)
+    np.testing.assert_allclose(
+        got["value"].astype(float), want.values, rtol=1e-5
+    )
