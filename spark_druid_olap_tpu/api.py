@@ -766,21 +766,13 @@ def _to_arrow(df):
 def _col_to_aggref(e: E.Expr, aggs) -> E.Expr:
     """In HAVING/ORDER BY over a grouped TableQuery, a Col naming an agg
     output means the aggregate (SQL alias semantics)."""
-    import dataclasses as _dc
-
     names = {a.name for a in aggs}
-    if isinstance(e, E.Col):
-        return E.AggRef(e.name) if e.name in names else e
-    if isinstance(e, (E.Literal, E.AggRef)):
-        return e
-    kw = {}
-    for f in _dc.fields(e):
-        v = getattr(e, f.name)
-        if isinstance(v, E.Expr):
-            kw[f.name] = _col_to_aggref(v, aggs)
-        elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
-            kw[f.name] = tuple(_col_to_aggref(x, aggs) for x in v)
-    return _dc.replace(e, **kw) if kw else e
+    return E.map_expr(
+        e,
+        lambda x: E.AggRef(x.name)
+        if isinstance(x, E.Col) and x.name in names
+        else x,
+    )
 
 
 # module-level default context (the implicit SQLContext analog)

@@ -605,6 +605,10 @@ def _try_decorrelate_fill(sub, df, catalog, refs, out) -> bool:
         return False
     if stmt.group_by or stmt.grouping_sets or stmt.having is not None:
         return False
+    if any(
+        isinstance(e, E.Col) and e.name == "*" for _, e in stmt.items
+    ):
+        return False  # SELECT *: the Analyzer would discard synthetic items
     # nested subqueries inside the correlated statement: too opaque
     exprs = [e for _, e in stmt.items]
     if stmt.where is not None:

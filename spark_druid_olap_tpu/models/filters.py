@@ -233,9 +233,10 @@ def filter_from_druid(d: Dict[str, Any]) -> Filter:
         q = d.get("query", {})
         qt = q.get("type")
         value = q.get("value", "")
+        cs = q.get("case_sensitive", q.get("caseSensitive", True))
         insensitive = qt in (
             "insensitiveContains", "insensitive_contains"
-        ) or (qt == "contains" and not q.get("caseSensitive", True))
+        ) or (qt == "contains" and not cs)
         if qt not in ("contains", "insensitiveContains",
                       "insensitive_contains"):
             raise ValueError(f"unsupported search query type {qt!r}")

@@ -291,14 +291,26 @@ def run_command(ctx, cmd: Command):
         # dimensions/metrics are inferred from the result dtypes
         if ctx.catalog.get(cmd.table) is not None:
             raise ValueError(f"table {cmd.table!r} already exists")
+        if cmd.table in ctx.views:
+            raise ValueError(
+                f"a view named {cmd.table!r} exists; it would shadow the "
+                "new table (DROP VIEW first)"
+            )
         df = ctx.sql(cmd.value)
         ds = ctx.register_table(cmd.table, df)
         return pd.DataFrame(
             {"status": [f"created {cmd.table} ({ds.num_rows} rows)"]}
         )
     if cmd.kind == "create_view":
-        # validate the definition NOW (parse + plan against the current
-        # catalog) so a broken view fails at CREATE, not first use
+        # the definition is PARSE-validated now (a syntactically broken
+        # view fails at CREATE; name/type resolution happens per query,
+        # so a view may legitimately precede its tables)
+        if ctx.catalog.get(cmd.table) is not None:
+            raise ValueError(
+                f"a table named {cmd.table!r} exists; the view would "
+                "shadow it (queries would silently read the view while "
+                "DESCRIBE/DROP TABLE address the table)"
+            )
         from .parser import parse_sql
 
         views = dict(ctx.views)

@@ -1270,6 +1270,9 @@ class Analyzer:
         self.agg_exprs: List[L.AggExpr] = []
         self.agg_by_key: Dict[str, str] = {}  # str(AggCall) -> assigned name
         self.win_exprs: List[L.WindowExpr] = []
+        # GROUPING() substitution context: (group keys, k, has grouping
+        # sets) — set by the aggregate path so ORDER BY can substitute too
+        self._grouping_ctx: tuple = ([], 0, False)
         # (output name, group-key expr) pairs — window specs over an
         # aggregated frame must reference group keys by their OUTPUT names
         # (GROUP BY g with `g AS grp` yields a frame column `grp`, not `g`)
@@ -1371,6 +1374,7 @@ class Analyzer:
         self._win_groups = list(group_exprs)
         has_sets = stmt.group_mode != "plain"
         k_groups = len(group_exprs)
+        self._grouping_ctx = (group_keys, k_groups, has_sets)
         for alias, e in stmt.items:
             es0 = _strip_qualifiers(e, self.aliases)
             had_grouping = _contains_grouping(es0)
@@ -1524,7 +1528,10 @@ class Analyzer:
     def _sub_group_refs(self, e: E.Expr) -> E.Expr:
         """Replace subtrees equal to a GROUP BY key with the key's OUTPUT
         column (no-op outside aggregate queries; aggregates were already
-        lifted to AggRefs before this runs)."""
+        lifted to AggRefs before this runs).  NOT expressible via
+        map_expr: the match is whole-subtree equality against the key
+        expression, and map_expr's bottom-up order would rewrite the
+        children first and break the comparison."""
         if e is None or not self._win_groups:
             return e
         for name, ge in self._win_groups:
@@ -1548,12 +1555,15 @@ class Analyzer:
     ) -> E.Expr:
         """GROUPING(col) -> bit test over __grouping_id (or literal 0 for
         a plain GROUP BY, where nothing is ever rolled away)."""
-        if isinstance(e, GroupingCall):
-            arg = _strip_qualifiers(e.col, self.aliases)
+
+        def sub(x):
+            if not isinstance(x, GroupingCall):
+                return x
+            arg = _strip_qualifiers(x.col, self.aliases)
             idx = _find_group(arg, group_keys)
             if idx is None:
                 raise ParseError(
-                    f"GROUPING({e.col}) argument must be a GROUP BY "
+                    f"GROUPING({x.col}) argument must be a GROUP BY "
                     "expression"
                 )
             if not has_sets:
@@ -1574,23 +1584,8 @@ class Analyzer:
                 ),
                 "long",
             )
-        if isinstance(e, (E.Literal, E.Col, E.AggRef)):
-            return e
-        kw = {}
-        for f in dataclasses.fields(e):  # type: ignore[arg-type]
-            v = getattr(e, f.name)
-            if isinstance(v, E.Expr):
-                kw[f.name] = self._sub_grouping_calls(
-                    v, group_keys, k, has_sets
-                )
-            elif isinstance(v, tuple) and v and isinstance(v[0], E.Expr):
-                kw[f.name] = tuple(
-                    self._sub_grouping_calls(x, group_keys, k, has_sets)
-                    for x in v
-                )
-            else:
-                kw[f.name] = v
-        return type(e)(**kw)
+
+        return E.map_expr(e, sub)
 
     def _check_window_positions(self, stmt: SelectStmt):
         """Window functions are legal only in the SELECT list (SQL: they
@@ -1707,6 +1702,10 @@ class Analyzer:
             keys = []
             for e, asc in stmt.order_by:
                 es = _strip_qualifiers(e, self.aliases)
+                if _contains_grouping(es):
+                    if not post_agg:
+                        raise ParseError("GROUPING() requires GROUP BY")
+                    es = self._sub_grouping_calls(es, *self._grouping_ctx)
                 if post_agg and _contains_agg(es):
                     es = self._lift_aggs(es, _auto_name(es))
                     if not isinstance(es, E.AggRef):

@@ -980,3 +980,22 @@ def test_decorrelation_edge_shapes():
         "(SELECT v FROM ei WHERE j = eo.k)"
     )
     assert int(r2["n"][0]) == 1  # only k=1 has {10,20}
+
+
+def test_select_star_in_subquery_stays_on_loop():
+    """High-review finding: IN (SELECT * ...) must not crash the
+    single-pass decorrelation (the loop path owns it)."""
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "so", {"g": np.array([1, 2], dtype=np.int64),
+               "v": np.array([5.0, 7.0])},
+        dimensions=["g"], metrics=["v"],
+    )
+    c.register_table(
+        "si", {"h": np.array([5.0, 9.0])}, metrics=["h"]
+    )
+    got = c.sql(
+        "SELECT count(*) AS n FROM so o WHERE v IN "
+        "(SELECT * FROM si WHERE h = o.v)"
+    )
+    assert int(got["n"][0]) == 1  # v=5 matches h=5

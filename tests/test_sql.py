@@ -390,3 +390,15 @@ def test_grouping_function(ctx):
             "SELECT GROUPING(l_quantity) FROM lineitem "
             "GROUP BY l_returnflag"
         )
+
+
+def test_grouping_in_order_by(ctx):
+    """High-review finding: ORDER BY GROUPING(col) — the standard idiom
+    for pushing subtotal rows last — substitutes like SELECT/HAVING."""
+    got = ctx.sql(
+        "SELECT l_returnflag, sum(l_quantity) AS q FROM lineitem "
+        "GROUP BY ROLLUP (l_returnflag) ORDER BY GROUPING(l_returnflag), "
+        "l_returnflag"
+    )
+    assert pd.isna(got["l_returnflag"].iloc[-1])  # grand total last
+    assert not got["l_returnflag"].iloc[:-1].isna().any()

@@ -267,3 +267,14 @@ def test_setop_view():
         "CREATE TABLE mat AS SELECT g FROM vt EXCEPT SELECT g FROM vt2"
     )
     assert c.catalog.get("mat").num_rows == 2  # a, c
+
+
+def test_view_table_name_collisions_rejected():
+    import pytest as _pytest
+
+    c = _view_ctx()
+    with _pytest.raises(ValueError, match="shadow"):
+        c.sql("CREATE VIEW vt AS SELECT g FROM vt")  # table vt exists
+    c.sql("CREATE VIEW okv AS SELECT g FROM vt")
+    with _pytest.raises(ValueError, match="shadow"):
+        c.sql("CREATE TABLE okv AS SELECT g FROM vt")  # view okv exists
