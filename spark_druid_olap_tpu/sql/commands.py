@@ -202,6 +202,10 @@ def run_command(ctx, cmd: Command):
         )
     if cmd.kind == "describe":
         ds = ctx.catalog.get(cmd.table)
+        if ds is None and cmd.table in ctx.views:
+            return pd.DataFrame(
+                {"view": [cmd.table], "definition": [ctx.views[cmd.table]]}
+            )
         if ds is None:
             raise KeyError(f"table {cmd.table!r} does not exist")
         return pd.DataFrame(

@@ -278,3 +278,11 @@ def test_view_table_name_collisions_rejected():
     c.sql("CREATE VIEW okv AS SELECT g FROM vt")
     with _pytest.raises(ValueError, match="shadow"):
         c.sql("CREATE TABLE okv AS SELECT g FROM vt")  # view okv exists
+
+
+def test_describe_view_shows_definition():
+    c = _view_ctx()
+    c.sql("CREATE VIEW dv AS SELECT g FROM vt")
+    out = c.sql("DESCRIBE dv")
+    assert out["view"].iloc[0] == "dv"
+    assert "SELECT g FROM vt" in out["definition"].iloc[0]

@@ -1,0 +1,57 @@
+"""Wide fuzz sweep — run the committed differential generators over many
+more seeds than the suite pins (a bug-shaking pass for long idle compute;
+failures print the reproducing seed).
+
+Usage:  PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/fuzz_sweep.py [start] [end]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    lo = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    hi = int(sys.argv[2]) if len(sys.argv) > 2 else 160
+
+    import tests.test_fuzz_differential as T
+
+    world = T.world.__wrapped__()
+    ctx, df = world
+    failures = []
+    for seed in range(lo, hi):
+        try:
+            T._run_case(ctx, df, seed)
+        except Exception as e:  # noqa: BLE001 — report and continue
+            failures.append(("device", seed, repr(e)[:200]))
+            print(f"FAIL device seed={seed}: {e!r}", flush=True)
+        if seed % 10 == 0:
+            print(f"... seed {seed}", flush=True)
+
+    fb = T.fallback_world.__wrapped__(world)
+    ctx2, df2 = fb
+    for seed in range(lo, hi, 3):
+        try:
+            T._run_case(ctx2, df2, seed)
+        except Exception as e:  # noqa: BLE001
+            failures.append(("fallback", seed, repr(e)[:200]))
+            print(f"FAIL fallback seed={seed}: {e!r}", flush=True)
+
+    import tests.test_setops as S
+
+    for seed in range(lo, lo + 20):
+        try:
+            S.test_setop_fuzz_differential(seed)
+        except Exception as e:  # noqa: BLE001
+            failures.append(("setop", seed, repr(e)[:200]))
+            print(f"FAIL setop seed={seed}: {e!r}", flush=True)
+
+    print(f"swept seeds [{lo},{hi}); failures: {len(failures)}")
+    for f in failures:
+        print("  ", f)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
