@@ -90,7 +90,15 @@ def _ensure_calibration():
                 and "cost_per_row_compact" in cal
             ):
                 return
-        C.calibrate(rows=1 << 19)
+        # bounded: over a flaky tunneled accelerator a full sweep ran
+        # ~26 min; the budget keeps implicit calibration from eating the
+        # bench run (unmeasured constants stay at profile defaults)
+        C.calibrate(
+            rows=1 << 19,
+            budget_s=float(
+                _os.environ.get("SD_CALIBRATE_BUDGET_S", "600")
+            ),
+        )
     except Exception:
         pass  # calibration is an optimization; never fail the bench on it
 

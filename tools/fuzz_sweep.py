@@ -38,6 +38,35 @@ def main() -> int:
             failures.append(("fallback", seed, repr(e)[:200]))
             print(f"FAIL fallback seed={seed}: {e!r}", flush=True)
 
+    # cross-executor (local / 8-device mesh / streaming) — needs the
+    # virtual CPU mesh, so only when the interpreter was launched with
+    # xla_force_host_platform_device_count=8
+    import jax
+
+    if len(jax.devices()) >= 8:
+        from spark_druid_olap_tpu.exec.engine import Engine
+        from spark_druid_olap_tpu.exec.streaming import StreamExecutor
+        from spark_druid_olap_tpu.parallel.distributed import (
+            DistributedEngine,
+        )
+        from spark_druid_olap_tpu.parallel.mesh import make_mesh
+
+        execs = (
+            Engine(),
+            DistributedEngine(mesh=make_mesh(n_data=8)),
+            StreamExecutor(),
+        )
+        import _pytest.outcomes
+
+        for seed in range(lo, lo + 25):
+            try:
+                T.test_fuzz_cross_executor_parity(world, execs, seed)
+            except _pytest.outcomes.Skipped:
+                continue  # seed drew a fallback-only predicate
+            except Exception as e:  # noqa: BLE001
+                failures.append(("cross-exec", seed, repr(e)[:200]))
+                print(f"FAIL cross-exec seed={seed}: {e!r}", flush=True)
+
     import tests.test_setops as S
 
     for seed in range(lo, lo + 20):
