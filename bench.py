@@ -578,9 +578,15 @@ def bench_cube_theta(scale: float):
 
 
 def bench_calibrate(rows_log2: int):
+    import os
+
     from spark_druid_olap_tpu.plan.calibrate import calibrate
 
-    out = calibrate(rows=1 << rows_log2)
+    budget = os.environ.get("SD_CALIBRATE_BUDGET_S")
+    out = calibrate(
+        rows=1 << rows_log2,
+        budget_s=float(budget) if budget else None,
+    )
     return {
         "metric": "calibration_cost_per_row_dense",
         "value": out["cost_per_row_dense"],

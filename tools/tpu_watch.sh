@@ -103,7 +103,7 @@ run_window() {
     # captures smoke/pallas/bench evidence above.
     if ! bench_ok BENCH_tpu_calibrate_r3.json; then
         reprobe_alive || return
-        SD_BENCH_TIMEOUT_S=1800 timeout 1900 python bench.py calibrate \
+        SD_CALIBRATE_BUDGET_S=1500 SD_BENCH_TIMEOUT_S=1800 timeout 1900 python bench.py calibrate \
             > BENCH_tpu_calibrate_r3.json.tmp 2>/tmp/tpu_cal_err.txt \
             && mv BENCH_tpu_calibrate_r3.json.tmp BENCH_tpu_calibrate_r3.json
         echo "calibrate rc=$? $(ts)" >> "$LOG"
