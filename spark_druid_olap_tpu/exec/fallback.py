@@ -1450,15 +1450,46 @@ def _window_partition(w, idxs, peer_codes, va, fm, res):
                 raise NotImplementedError(f"window function {fn!r}")
 
 
+#: decoded scan frames above this row count are not cached (a dashboard
+#: re-issuing window/set-op queries repays the decode; a one-off huge scan
+#: must not pin gigabytes)
+_FRAME_CACHE_MAX_ROWS = 5_000_000
+
+
+def _cached_scan_frame(catalog, table: str, needed) -> pd.DataFrame:
+    """Decoded frame of a table with a small per-catalog LRU: repeated
+    fallback queries (BI dashboards full of window/set-op SQL) would
+    otherwise pay the full dimension decode on every statement.  Keyed on
+    the catalog version, so any re-registration invalidates.  Consumers
+    only ever ADD columns to scan frames (assign/copy), never write rows
+    in place, so a shallow copy shares the column arrays safely."""
+    ds = catalog.get(table)
+    if ds is None:
+        raise KeyError(f"unknown table {table!r}")
+    cache = getattr(catalog, "_fallback_frames", None)
+    if cache is None:
+        from ..utils.lru import CountBudgetCache
+
+        cache = catalog._fallback_frames = CountBudgetCache(4)
+    key = (
+        table,
+        getattr(catalog, "version", 0),
+        frozenset(needed) if needed is not None else None,
+    )
+    df = cache.get(key)
+    if df is None:
+        df = decoded_frame(ds, columns=needed)
+        if len(df) <= _FRAME_CACHE_MAX_ROWS:
+            cache[key] = df
+    return df.copy(deep=False)
+
+
 def _exec(
     lp: L.LogicalPlan, catalog, _needed=None
 ) -> pd.DataFrame:
     """Interpret a logical plan over decoded host frames."""
     if isinstance(lp, L.Scan):
-        ds = catalog.get(lp.table)
-        if ds is None:
-            raise KeyError(f"unknown table {lp.table!r}")
-        return decoded_frame(ds, columns=_needed)
+        return _cached_scan_frame(catalog, lp.table, _needed)
     if isinstance(lp, L.Filter):
         df = _exec(lp.child, catalog, _needed)
         if not len(df):

@@ -999,3 +999,48 @@ def test_select_star_in_subquery_stays_on_loop():
         "(SELECT * FROM si WHERE h = o.v)"
     )
     assert int(got["n"][0]) == 1  # v=5 matches h=5
+
+
+def test_fallback_scan_frame_cache():
+    """Repeated fallback queries reuse the decoded scan frame (keyed on
+    catalog version: re-registration invalidates), and cached frames are
+    never corrupted by downstream column additions."""
+    from spark_druid_olap_tpu.exec import fallback as F
+
+    c = sd.TPUOlapContext()
+    c.register_table(
+        "fc",
+        {"g": np.array(["a", "b", "a"], dtype=object),
+         "v": np.array([1.0, 2.0, 3.0])},
+        dimensions=["g"], metrics=["v"],
+    )
+    calls = {"n": 0}
+    orig = F.decoded_frame
+
+    def spy(ds, columns=None):
+        calls["n"] += 1
+        return orig(ds, columns=columns)
+
+    F.decoded_frame = spy
+    try:
+        q = ("SELECT g, v, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) "
+             "AS rn FROM fc")
+        r1 = c.sql(q)
+        n_after_first = calls["n"]
+        pd.testing.assert_frame_equal(r1, c.sql(q))  # cache not corrupted
+        assert calls["n"] == n_after_first  # identical query: frame reused
+        c.sql("SELECT g FROM fc INTERSECT SELECT g FROM fc")
+        n_after_setop = calls["n"]  # narrower column set: its own entry...
+        c.sql("SELECT g FROM fc INTERSECT SELECT g FROM fc")
+        assert calls["n"] == n_after_setop  # ...reused on repeat
+        # re-registration bumps the catalog version -> fresh decode
+        c.register_table(
+            "fc",
+            {"g": np.array(["z"], dtype=object), "v": np.array([9.0])},
+            dimensions=["g"], metrics=["v"],
+        )
+        r3 = c.sql("SELECT g FROM fc INTERSECT SELECT g FROM fc")
+        assert calls["n"] > n_after_first
+        assert list(r3["g"]) == ["z"]
+    finally:
+        F.decoded_frame = orig
