@@ -715,13 +715,79 @@ def _probe_with_retry(probe_s, window_s, interval_s):
         time.sleep(interval_s)
 
 
+def _emit(result, tag):
+    """Print the driver-facing headline as ONE COMPACT JSON line and write
+    everything else to a sidecar detail file.  Round 3 broke the driver's
+    parse by letting per-query metrics + probe logs grow the stdout line past
+    what it reads; the contract is now: stdout stays small (asserted < 2000
+    chars by tests), the full record lives in BENCH_<tag>_detail.json where
+    tag is "<mode>_<arg>" (e.g. ssb_100) so runs at different scales of the
+    same mode keep separate evidence."""
+    root = os.environ.get("SD_BENCH_DETAIL_DIR") or os.path.dirname(
+        os.path.abspath(__file__)
+    )
+    payload = json.dumps(result, indent=1, default=str)
+    write_err = None
+    detail_path = os.path.join(root, "BENCH_%s_detail.json" % tag)
+    try:
+        with open(detail_path, "w") as f:
+            f.write(payload)
+    except OSError as e:
+        detail_path, write_err = None, e
+    # a non-degraded accelerator run is rare evidence: keep it under a name
+    # a later CPU rerun of the same mode cannot overwrite, and point the
+    # headline at THAT copy (independent of the primary write — its failure
+    # must not null a valid detail_path)
+    dev = str(result.get("device", "cpu")).lower()
+    if not result.get("degraded") and "cpu" not in dev:
+        tpu_path = os.path.join(root, "BENCH_tpu_%s_detail.json" % tag)
+        try:
+            with open(tpu_path, "w") as f:
+                f.write(payload)
+            detail_path = tpu_path
+        except OSError as e:
+            write_err = write_err or e
+    compact = {
+        "metric": result.get("metric", tag),
+        "value": result.get("value", 0.0),
+        "unit": result.get("unit", "error"),
+        "vs_baseline": result.get("vs_baseline", 0.0),
+        "degraded": result.get("degraded", False),
+        "device": str(result.get("device", "unknown")),
+        "detail_artifact": detail_path,
+    }
+    detail = result.get("detail") or {}
+    # a few small load-bearing summary fields, never the nested per-query
+    # maps (strings only when short: the whole point is a bounded line)
+    for k in ("rows", "max_rel_err", "rows_per_sec_per_chip", "ingest_s"):
+        v = detail.get(k)
+        if isinstance(v, (int, float)) or (
+            isinstance(v, str) and len(v) < 100
+        ):
+            compact[k] = v
+    if compact["unit"] == "error" or write_err is not None:
+        # never lose the diagnosis to a failed sidecar write
+        msg = str(detail.get("error", "")) or ""
+        if write_err is not None:
+            msg = ("sidecar write failed: %s; " % write_err) + msg
+        compact["error"] = msg[:400]
+    if detail_path is None:
+        # last-ditch: the fat record goes to stderr so a redirect (the watch
+        # loop captures 2>) can still recover a rare hardware run's evidence
+        print(payload, file=sys.stderr)
+    print(json.dumps(compact))
+
+
 def main():
     if sys.argv[1:2] == ["--child"]:
         sys.argv = [sys.argv[0]] + sys.argv[2:]
         _run_child()
         return
 
-    mode, _, _ = _parse_args(sys.argv[1:])
+    mode, _, arg = _parse_args(sys.argv[1:])
+    # the sidecar is keyed on mode AND its argument so e.g. an ssb-sf1 run
+    # inside a hardware window cannot clobber the sf100 per-query evidence
+    tag = "%s_%g" % (mode, arg)
     probe_s = int(os.environ.get("SD_BENCH_PROBE_TIMEOUT_S", "120"))
     run_s = int(os.environ.get("SD_BENCH_TIMEOUT_S", "1500"))
     # total window spent retrying a down tunnel before settling for CPU
@@ -758,24 +824,23 @@ def main():
             dev = "cpu" if "cpu" in dev else dev.replace(" ", "_")
             result["metric"] = "%s_%s_degraded" % (result["metric"], dev)
         result.setdefault("detail", {})["probe_attempts"] = probe_attempts
-        print(json.dumps(result))
+        _emit(result, tag)
     else:
         # Last resort: still one parseable JSON line, never a bare traceback.
-        print(
-            json.dumps(
-                {
-                    "metric": mode,
-                    "value": 0.0,
-                    "unit": "error",
-                    "vs_baseline": 0.0,
-                    "degraded": True,
-                    "device": platform or "unavailable",
-                    "detail": {
-                        "error": (err or "unknown")[:2000],
-                        "probe_attempts": probe_attempts,
-                    },
-                }
-            )
+        _emit(
+            {
+                "metric": mode,
+                "value": 0.0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "degraded": True,
+                "device": platform or "unavailable",
+                "detail": {
+                    "error": (err or "unknown")[:2000],
+                    "probe_attempts": probe_attempts,
+                },
+            },
+            tag,
         )
 
 

@@ -1,0 +1,132 @@
+"""The driver's parse contract for bench.py (VERDICT r3 #4).
+
+Round 3 regression: the single stdout JSON line grew past what the driver
+parses (per-query metrics + probe logs), so the round's headline landed as
+``parsed: null``.  The contract now under test: ``_emit`` prints ONE compact
+JSON line (< 2000 chars, machine-parseable, headline fields present) and
+writes the full record to BENCH_<mode>_detail.json.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fat_result():
+    # a round-3-shaped result: 13 queries x nested metrics + a long probe log
+    per_q = {
+        "q%d_%d" % (i, j): {
+            "tpu_ms": 123.45,
+            "pandas_ms": 678.9,
+            "max_rel_err": 1e-12,
+            "metrics": {k: 1.0 for k in ("scan_bytes", "kernel_ms",
+                                         "merge_ms", "roofline_util_pct",
+                                         "segments", "rows_scanned")},
+        }
+        for i in range(1, 5)
+        for j in range(1, 4)
+    }
+    probe = [
+        {"t": "2026-07-31T00:00:00Z", "platform": None,
+         "error": "probe timeout after 120s " + "x" * 200}
+        for _ in range(30)
+    ]
+    return {
+        "metric": "ssb_sf100_q1-q4_p50_latency",
+        "value": 5090.0,
+        "unit": "ms",
+        "vs_baseline": 4.2,
+        "degraded": True,
+        "device": "TFRT_CPU_0",
+        "detail": {
+            "rows": 600_037_902,
+            "max_rel_err": 3e-9,
+            "rows_per_sec_per_chip": 117_906_269,
+            "ingest_s": 1344.6,
+            "queries": per_q,
+            "probe_attempts": probe,
+        },
+    }
+
+
+def test_emit_stdout_is_compact_and_parseable(capsys, tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    bench._emit(_fat_result(), "ssb")
+    line = capsys.readouterr().out.strip()
+    assert "\n" not in line, "must be ONE line"
+    assert len(line) < 2000, "headline line must stay driver-parseable"
+    parsed = json.loads(line)
+    for k in ("metric", "value", "unit", "vs_baseline", "degraded", "device"):
+        assert k in parsed, k
+    assert parsed["metric"] == "ssb_sf100_q1-q4_p50_latency"
+    assert parsed["vs_baseline"] == 4.2
+    # absolute path so a consumer can resolve it regardless of its cwd
+    assert parsed["detail_artifact"] == str(tmp_path / "BENCH_ssb_detail.json")
+    # nested fat maps must NOT be inline
+    assert "queries" not in parsed and "probe_attempts" not in parsed
+
+    detail = json.load(open(tmp_path / "BENCH_ssb_detail.json"))
+    assert detail["detail"]["queries"]["q1_1"]["tpu_ms"] == 123.45
+    assert len(detail["detail"]["probe_attempts"]) == 30
+
+
+def test_emit_preserves_tpu_detail_from_cpu_overwrite(tmp_path, monkeypatch,
+                                                      capsys):
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    tpu = dict(_fat_result(), degraded=False, device="axon:0")
+    bench._emit(tpu, "ssb")
+    # the headline points at the clobber-proof TPU copy, not the primary
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["detail_artifact"] == str(
+        tmp_path / "BENCH_tpu_ssb_detail.json"
+    )
+    # a later degraded CPU rerun must not clobber the TPU sidecar
+    bench._emit(_fat_result(), "ssb")
+    kept = json.load(open(tmp_path / "BENCH_tpu_ssb_detail.json"))
+    assert kept["device"] == "axon:0"
+    capsys.readouterr()
+
+
+def test_production_tag_keys_scale(monkeypatch):
+    bench = _load_bench()
+    mode, _, arg = bench._parse_args(["ssb", "100"])
+    assert "%s_%g" % (mode, arg) == "ssb_100"
+    mode, _, arg = bench._parse_args(["tpch_q1", "0.1"])
+    assert "%s_%g" % (mode, arg) == "tpch_q1_0.1"
+    mode, _, arg = bench._parse_args([])
+    assert "%s_%g" % (mode, arg) == "ssb_1"
+
+
+def test_emit_error_shape(capsys, tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    bench._emit(
+        {
+            "metric": "ssb",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "degraded": True,
+            "device": "unavailable",
+            "detail": {"error": "x" * 5000, "probe_attempts": []},
+        },
+        "ssb",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["degraded"] is True and parsed["unit"] == "error"
