@@ -507,6 +507,7 @@ def bench_timeseries(n_chunks: int):
             "rows": rows,
             "chunks": n_chunks,
             "pandas_s": round(t_pd, 2),
+            "pipeline_stages": ex.stats.to_dict(),
             "device": _device(),
         },
     }

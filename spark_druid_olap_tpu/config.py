@@ -86,6 +86,17 @@ class SessionConfig:
     cost_per_row_dense: float = 1e-4
     # us per row for the scatter (segment-sum) kernel — serializes on TPU
     cost_per_row_scatter: float = 0.05
+    # us per row for scatter at a LARGE group domain (state no longer fits
+    # cache: random writes miss).  The model interpolates per-row scatter
+    # cost log-linearly in G between (scatter_lo_groups, cost_per_row_
+    # scatter) and (scatter_hi_groups, cost_per_row_scatter_hi) — measured
+    # on CPU: 0.0015us/row at G=1K vs 0.0071us/row at G=2M, a 5x cliff the
+    # flat model missed (it routed SSB q3_2 SF100 to scatter: 12.1s, losing
+    # to pandas).  On TPU scatter serializes regardless, so the default is
+    # flat until hardware calibration says otherwise.
+    cost_per_row_scatter_hi: float = 0.05
+    scatter_lo_groups: int = 1024
+    scatter_hi_groups: int = 1 << 21
     # us per row for the sort-compaction (sparse) path
     cost_per_row_sparse: float = 5e-3
     # us per row for the FILTER-COMPACTION pass (mask -> survivor slots):
@@ -162,9 +173,17 @@ class SessionConfig:
             )
             data = None  # measured on a different backend: do not apply
         if data is not None:
+            # platform profile FIRST, measured keys on top: a PARTIAL
+            # calibration file (budget-clipped sweep) must fall back to
+            # platform-correct values for its missing keys, not the class's
+            # v5e-flavoured defaults.  Round 3's SF100 q3_2 regression came
+            # from exactly this mix: measured CPU scatter cost + v5e
+            # cost_per_group_state routed a 504K-group query to scatter.
+            cfg.apply_platform_profile()
             for k in (
                 "cost_per_row_dense",
                 "cost_per_row_scatter",
+                "cost_per_row_scatter_hi",
                 "cost_per_row_sparse",
                 "cost_per_row_compact",
                 "cost_per_group_state",
@@ -173,6 +192,9 @@ class SessionConfig:
             ):
                 if k in data and data[k] is not None and data[k] > 0:
                     setattr(cfg, k, float(data[k]))
+            for k in ("scatter_lo_groups", "scatter_hi_groups"):
+                if k in data and data[k] is not None and data[k] > 0:
+                    setattr(cfg, k, int(data[k]))
             return cfg
         return cfg.apply_platform_profile()
 
@@ -194,6 +216,13 @@ class SessionConfig:
             return self
         self.cost_per_row_dense = 0.58
         self.cost_per_row_scatter = 0.0012
+        # measured on this container (8M rows, segment_sum): 0.00145us/row
+        # at G=1024 rising to 0.00707us/row at G=2M as the state outgrows
+        # cache — the G-dependence that routes huge-domain GroupBys off
+        # raw scatter
+        self.cost_per_row_scatter_hi = 0.0071
+        self.scatter_lo_groups = 1024
+        self.scatter_hi_groups = 1 << 21
         self.cost_per_row_sparse = 0.49
         self.cost_per_row_compact = 0.0012
         self.cost_per_group_state = 0.0023

@@ -59,6 +59,7 @@ from .finalize import (  # noqa: F401
     finalize_topn,
 )
 from ..utils.log import get_logger
+from .adaptive_exec import AdaptiveDomainMixin
 from .sparse_exec import SparseExecMixin
 
 log = get_logger("exec.engine")
@@ -242,7 +243,7 @@ def _default_device_budget() -> int:
         return 4 << 30
 
 
-class Engine(SparseExecMixin):
+class Engine(AdaptiveDomainMixin, SparseExecMixin):
     """Executes query specs on the local device set.
 
     `strategy` mirrors the reference's cost-model execution choice
@@ -279,6 +280,14 @@ class Engine(SparseExecMixin):
         # sort) — deterministic for a given (query, data), so repeats go
         # straight to the remembered rung
         self._sparse_row_capacity: Dict = {}
+        # adaptive dictionary-domain compaction (exec/adaptive_exec.py):
+        # per-query kept code sets and the decline memo
+        self._adaptive_kept: Dict = {}
+        self._adaptive_declined: set = set()
+        # queries whose distinct-present count overflowed the one-hot slot
+        # tier: remembered SLOTS_LADDER rung for the segmented-reduce tier
+        # (sparse_exec.fetch_slot_laddered)
+        self._sparse_slots: Dict = {}
         # LRU residency cache under a byte budget (VERDICT r1 weak #7: the
         # unbounded caches OOMed HBM over long sessions).  4 GiB default
         # leaves headroom on a 16 GiB v5e chip for kernel workspace.
@@ -419,9 +428,13 @@ class Engine(SparseExecMixin):
         return segs
 
     def _partials_for_query(
-        self, q: Q.GroupByQuery, ds: DataSource, lowering=None
+        self, q: Q.GroupByQuery, ds: DataSource, lowering=None, key_extra=()
     ):
         """Compute merged partial state across local segments.
+
+        `key_extra` disambiguates the program cache when the SAME query runs
+        over a rewritten lowering (adaptive domain compaction passes the
+        compacted cardinalities).
 
         Returns (dims, la, G, sums[G, Ms], mins, maxs, sketch_states)."""
         if lowering is None:
@@ -439,13 +452,13 @@ class Engine(SparseExecMixin):
         # segments fuse into batched programs (partial agg + cross-segment
         # merge inside): the common case is ONE dispatch + ONE fetch per
         # query; oversized scopes merge across a few batch dispatches
-        seg_fn = self._segment_program(q, ds, lowering)
+        seg_fn = self._segment_program(q, ds, lowering, key_extra=key_extra)
         for batch in self._segment_batches(segs, need):
             cols_list = [
                 self._cols_for_segment(seg, ds, need) for seg in batch
             ]
             (s, mn, mx, sk), seg_fn = self._call_segment_program(
-                q, ds, lowering, seg_fn, cols_list
+                q, ds, lowering, seg_fn, cols_list, key_extra=key_extra
             )
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
@@ -453,7 +466,9 @@ class Engine(SparseExecMixin):
             _merge_sketch_states(la, sketch_states, sk)
         return dims, la, G, sums, mins, maxs, sketch_states
 
-    def _call_segment_program(self, q, ds, lowering, seg_fn, cols_list):
+    def _call_segment_program(
+        self, q, ds, lowering, seg_fn, cols_list, key_extra=()
+    ):
         """Run one segment program (over a list of per-segment column dicts)
         with the Pallas compile-failure fallback.  Returns (result, seg_fn) —
         seg_fn may be a rebuilt XLA-dense program after a Mosaic failure."""
@@ -488,9 +503,13 @@ class Engine(SparseExecMixin):
             ):
                 raise
             self._pallas_broken = True
-            for k in [k for k in self._query_fn_cache if k[2] == "pallas"]:
+            for k in [
+                k
+                for k in self._query_fn_cache
+                if any("pallas" in str(p) for p in k[2:])
+            ]:
                 self._query_fn_cache.pop(k)
-            seg_fn = self._segment_program(q, ds, lowering)
+            seg_fn = self._segment_program(q, ds, lowering, key_extra=key_extra)
             try:
                 return seg_fn(cols_list), seg_fn
             except Exception:
@@ -517,10 +536,10 @@ class Engine(SparseExecMixin):
             ):
                 return "pallas"
             return "dense"
-        if self.strategy == "sparse":
-            # "sparse" is an execution-layer accelerator, not a kernel
-            # strategy: when the sparse path declines a query (low G, sketch
-            # aggs, overflow) the standard path resolves as if "auto"
+        if self.strategy in ("sparse", "adaptive"):
+            # execution-layer accelerators, not kernel strategies: when the
+            # sparse/adaptive path declines a query (low G, sketch aggs,
+            # overflow, no shrink) the standard path resolves as if "auto"
             return resolve_strategy(
                 "auto", num_groups, pallas_ok=not self._pallas_broken
             )
@@ -529,7 +548,11 @@ class Engine(SparseExecMixin):
         )
 
     def _segment_program(
-        self, q: Q.GroupByQuery, ds: DataSource, lowering: "GroupByLowering"
+        self,
+        q: Q.GroupByQuery,
+        ds: DataSource,
+        lowering: "GroupByLowering",
+        key_extra=(),
     ) -> Callable:
         """One fused, cached XLA program per query: row pipeline (virtual
         columns, filter mask, group ids) + partial aggregation + sketch
@@ -540,7 +563,7 @@ class Engine(SparseExecMixin):
         strategy = self._resolve_strategy(G)
         # _query_key includes schema_signature: a re-ingested datasource
         # (new dict cardinalities => new G) must not reuse a stale program
-        key = _query_key(q, ds) + (strategy,)
+        key = _query_key(q, ds) + (strategy,) + tuple(key_extra)
         cached = self._query_fn_cache.get(key)
         if cached is not None:
             if self._m is not None:
@@ -727,10 +750,25 @@ class Engine(SparseExecMixin):
             self._m = None
             log.info("%s", m.describe())
 
+        adaptive_resolve = None
         sparse_resolve = None
         dense_state = None
         try:
+            # adaptive dictionary-domain compaction first: it covers sketch
+            # aggs too and repeats skip its presence pass via the kept-set
+            # cache.  A None return means it declined at dispatch time and
+            # the sparse/dense paths proceed as before.
             if (
+                self._adaptive_eligible(lowering)
+                and segs
+                and qkey not in self._adaptive_declined
+            ):
+                adaptive_resolve = self._dispatch_groupby_adaptive(
+                    q, ds, lowering
+                )
+                if adaptive_resolve is not None:
+                    m.strategy = "adaptive"
+            if adaptive_resolve is None and (
                 self._sparse_eligible(lowering)
                 and segs
                 and qkey not in self._sparse_disabled
@@ -739,7 +777,7 @@ class Engine(SparseExecMixin):
                 sparse_resolve = self._dispatch_groupby_sparse(
                     q, ds, lowering
                 )
-            else:
+            elif adaptive_resolve is None:
                 dense_state = self._partials_for_query(
                     q, ds, lowering=lowering
                 )
@@ -758,6 +796,25 @@ class Engine(SparseExecMixin):
             self._m = m
             t_resolve = _time.perf_counter()
             try:
+                if adaptive_resolve is not None:
+                    out, reason = adaptive_resolve()
+                    if out is not None:
+                        m.device_ms = (
+                            (_time.perf_counter() - t_resolve) * 1e3
+                            + dispatch_ms
+                        )
+                        return out
+                    # adaptive failed at resolve time: serial dense fallback
+                    # for THIS execution (no pin — transient errors only;
+                    # deterministic declines happened at dispatch time)
+                    m.strategy = self._resolve_strategy(lowering.num_groups)
+                    log.warning(
+                        "adaptive path failed (%s); falling back to %s",
+                        reason, m.strategy,
+                    )
+                    dense_state = self._partials_for_query(
+                        q, ds, lowering=lowering
+                    )
                 if sparse_resolve is not None:
                     out, reason = sparse_resolve()
                     if out is not None:

@@ -55,7 +55,10 @@ class SparseExecMixin:
             lowering.num_groups > SCATTER_CUTOVER
             and not lowering.la.sketch_aggs
             and bool(lowering.dims)
-            and (auto_upgrade or self.strategy == "sparse")
+            # 'adaptive' falls through here when per-dim marginals didn't
+            # shrink: jointly-sparse domains are exactly the sparse tier's
+            # case
+            and (auto_upgrade or self.strategy in ("sparse", "adaptive"))
         )
 
     def _sparse_program(
@@ -64,20 +67,27 @@ class SparseExecMixin:
         ds: DataSource,
         lowering: "GroupByLowering",
         row_capacity: Optional[int] = None,
+        slots: Optional[int] = None,
     ) -> Callable:
         from ..ops.pallas_groupby import pallas_available
-        from ..ops.sparse_groupby import sparse_partial_aggregate
+        from ..ops.sparse_groupby import (
+            SPARSE_SLOTS,
+            sparse_partial_aggregate,
+        )
 
         la = lowering.la
+        slots = slots or SPARSE_SLOTS
         # inner kernel over the compacted slots: the Pallas one-hot on TPU;
         # scatter on CPU backends (4096-slot one-hot matmuls starve a CPU,
-        # and at `slots` segments CPU scatter is cheap)
+        # and at `slots` segments CPU scatter is cheap).  Past SPARSE_SLOTS
+        # a non-scatter inner routes to the segmented-reduce-over-ranks
+        # kernel inside sparse_partial_aggregate (the sort-agg tier).
         inner = (
             "pallas"
             if not self._pallas_broken and pallas_available()
             else "segment"
         )
-        key = _query_key(q, ds) + (f"sparse:{inner}:{row_capacity}",)
+        key = _query_key(q, ds) + (f"sparse:{inner}:{row_capacity}:{slots}",)
         cached = self._query_fn_cache.get(key)
         if cached is not None:
             if self._m is not None:
@@ -93,6 +103,7 @@ class SparseExecMixin:
                 num_groups=lowering.num_groups,
                 num_min=len(la.min_names),
                 num_max=len(la.max_names),
+                slots=slots,
                 inner_strategy=inner,
                 row_capacity=row_capacity,
             )
@@ -138,9 +149,9 @@ class SparseExecMixin:
         # segment would overflow the capacity by construction.
         selective = q.filter is not None or bool(q.intervals)
 
-        def dispatch(row_capacity=None):
+        def dispatch(row_capacity=None, slots=None):
             seg_fn = self._sparse_program(
-                q, ds, lowering, row_capacity=row_capacity
+                q, ds, lowering, row_capacity=row_capacity, slots=slots
             )
             state = None
             for batch in self._segment_batches(segs, lowering.columns):
@@ -170,21 +181,46 @@ class SparseExecMixin:
         qkey = _query_key(q, ds)
         from ..ops import sparse_groupby as _sg
 
-        # tier 1: filter-compacted sort (128K-row sort network by default,
-        # or the rung remembered from a previous overflow on this query)
-        cap = (
-            self._sparse_row_capacity.get(qkey, _sg.ROW_CAPACITY)
-            if selective
-            else None
-        )
+        # tier 1: filter-compacted sort.  The initial capacity rung comes
+        # from the planner's selectivity estimate with 2x headroom (the
+        # remembered rung from a previous overflow wins when present) —
+        # sorting a fixed 128K slots per segment regardless of survivors
+        # was round 3's hidden per-segment cost.  A None rung = full sort.
+        if not selective:
+            cap = None
+        elif qkey in self._sparse_row_capacity:
+            cap = self._sparse_row_capacity[qkey]
+        else:
+            from ..plan.cost import estimate_selectivity
 
-        def fetch_tiered(state, row_capacity):
+            sel = (
+                estimate_selectivity(q.filter, ds)
+                if q.filter is not None
+                else 1.0
+            )
+            if sel >= 1.0:
+                # unmodeled filter or interval-only scope: no estimate to
+                # act on — keep the historical default rung (the overflow
+                # ladder corrects upward, never a full-segment sort here)
+                cap = _sg.ROW_CAPACITY
+            else:
+                seg_rows = max((s.num_rows for s in segs), default=1)
+                need = 2.0 * sel * seg_rows
+                cap = next(
+                    (c for c in _sg.ROW_CAPACITY_LADDER if c >= need), None
+                )
+        # slot capacity: SPARSE_SLOTS one-hot by default, or the remembered
+        # SLOTS_LADDER rung (segmented-reduce tier) from a prior overflow
+        slots0 = self._sparse_slots.get(qkey, _sg.SPARSE_SLOTS)
+
+        def fetch_tiered(state, row_capacity, slots):
             # On row overflow the kernel's exact survivor count picks the
             # smallest adequate ROW_CAPACITY_LADDER rung (full-R sort only
             # past the top rung) — sort cost grows ~linearly with capacity,
             # so q3_1-class queries (180K survivors of 6M rows) stay 3-4x
             # off the full sort.  The rung is deterministic per (query,
-            # data) and remembered.  Slot overflow falls out in resolve().
+            # data) and remembered.  Slot overflow is handled by the
+            # caller's SLOTS_LADDER loop.
             host = jax.device_get(state)
             if row_capacity is not None and bool(host["row_overflow"]):
                 n = int(host["n_rows"])
@@ -203,8 +239,48 @@ class SparseExecMixin:
                     n, row_capacity,
                     "full-segment sort" if new_cap is None else new_cap,
                 )
-                host = jax.device_get(dispatch(row_capacity=new_cap))
+                host = jax.device_get(
+                    dispatch(row_capacity=new_cap, slots=slots)
+                )
             return host
+
+        def fetch_slot_laddered(state, row_capacity, slots):
+            # Slot-capacity ladder (VERDICT r3 #2): when more groups are
+            # GENUINELY populated than the one-hot slot tier holds, rung up
+            # through the segmented-reduce capacities instead of abandoning
+            # the device path.  The kernel's exact distinct-present count
+            # (`n_real`) picks the smallest adequate rung; only past the
+            # ladder top does the query fall back to raw scatter.
+            host = fetch_tiered(state, row_capacity, slots)
+            while bool(host["overflow"]):
+                n_est = int(host["n_real"])
+                new_slots = next(
+                    (
+                        s
+                        for s in _sg.SLOTS_LADDER
+                        if s >= n_est and s > slots
+                    ),
+                    None,
+                )
+                if new_slots is None:
+                    return host, slots  # beyond the ladder: caller declines
+                self._sparse_slots[qkey] = new_slots
+                log.info(
+                    "sparse slots overflowed (~%d distinct present > %d); "
+                    "rerunning on the segmented-reduce tier at %d slots "
+                    "(remembered for repeats)",
+                    n_est, slots, new_slots,
+                )
+                slots = new_slots
+                row_capacity = self._sparse_row_capacity.get(
+                    qkey, row_capacity
+                )
+                host = fetch_tiered(
+                    dispatch(row_capacity=row_capacity, slots=slots),
+                    row_capacity,
+                    slots,
+                )
+            return host, slots
 
         # phase 1: dispatch (async — no fetch).  Exceptions are deferred
         # into resolve() so batch callers see the same decline protocol as
@@ -217,7 +293,7 @@ class SparseExecMixin:
         used_pallas_inner = not self._pallas_broken and pallas_available()
         state = dispatch_exc = None
         try:
-            state = dispatch(row_capacity=cap)
+            state = dispatch(row_capacity=cap, slots=slots0)
         except Exception as exc:  # noqa: BLE001 — re-raised in resolve
             dispatch_exc = exc
 
@@ -226,7 +302,7 @@ class SparseExecMixin:
             try:
                 if dispatch_exc is not None:
                     raise dispatch_exc
-                host = fetch_tiered(state, cap)
+                host, _ = fetch_slot_laddered(state, cap, slots0)
                 state = None  # free the device partials promptly
             except Exception:
                 state = None
@@ -240,10 +316,14 @@ class SparseExecMixin:
                 self._pallas_broken = True
                 try:
                     # the failed attempt may already have learned the right
-                    # row-capacity rung; retry there, not at the stale cap
+                    # row-capacity / slot rungs; retry there, not at the
+                    # stale ones
                     retry_cap = self._sparse_row_capacity.get(qkey, cap)
-                    host = fetch_tiered(
-                        dispatch(row_capacity=retry_cap), retry_cap
+                    retry_slots = self._sparse_slots.get(qkey, slots0)
+                    host, _ = fetch_slot_laddered(
+                        dispatch(row_capacity=retry_cap, slots=retry_slots),
+                        retry_cap,
+                        retry_slots,
                     )
                 except Exception:
                     # only unflag if WE set the flag — an earlier query may

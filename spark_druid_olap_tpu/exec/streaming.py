@@ -58,6 +58,22 @@ _STOP = object()
 class StreamStats:
     rows: int = 0
     chunks: int = 0
+    # pipeline-stage seconds (BASELINE config #4 observability): normalize
+    # runs on the producer thread (overlapped with compute); put/dispatch
+    # are consumer-side walls.  dispatch_s is async-dispatch time, NOT
+    # device occupancy — the final block shows up in total wall time.
+    normalize_s: float = 0.0
+    put_s: float = 0.0
+    dispatch_s: float = 0.0
+
+    def to_dict(self):
+        return {
+            "rows": self.rows,
+            "chunks": self.chunks,
+            "normalize_s": round(self.normalize_s, 3),
+            "put_s": round(self.put_s, 3),
+            "dispatch_s": round(self.dispatch_s, 3),
+        }
 
 
 class StreamExecutor:
@@ -171,6 +187,7 @@ class StreamExecutor:
         need = list(lowering.columns)
         eng = self.engine
 
+        prep = self._prep_fn(ds.time_column, chunk_rows)
         dist_run = None
         if self.mesh is not None:
             # per-chunk SPMD program shared with DistributedEngine: dense
@@ -187,27 +204,39 @@ class StreamExecutor:
             dist_run = dist._spmd_fn(
                 lowering, chunk_rows // nd, ds, tuple(col_keys)
             )
+            run = lambda dev, base, nrows: dist_run(prep(dev, base, nrows))
         else:
-            seg_fn = eng._segment_program(q, ds, lowering)
+            # prep (time reconstruction + validity) FUSED into the chunk
+            # program: two back-to-back jits materialized a 16 MB int64
+            # time column per 2M-row chunk between them (~30 ms/chunk on
+            # CPU, measured) that XLA folds away entirely once fused
+            run = self._fused_local_fn(q, ds, lowering, prep)
 
         sums = mins = maxs = None
         sketch_states: Dict[str, jnp.ndarray] = {}
         self.stats = StreamStats()
+        t_disp = 0.0
 
-        for dev_cols in self._prefetched_device_chunks(
+        import time as _time
+
+        for dev, base, nrows in self._prefetched_device_chunks(
             chunks, need, ds, chunk_rows
         ):
-            if dist_run is not None:
-                s, mn, mx, sk = dist_run(dev_cols)
-            else:
-                (s, mn, mx, sk), seg_fn = eng._call_segment_program(
-                    q, ds, lowering, seg_fn, [dev_cols]
+            t0 = _time.perf_counter()
+            try:
+                s, mn, mx, sk = run(dev, base, nrows)
+            except Exception:
+                run = self._downgrade_pallas(
+                    q, ds, lowering, prep, dist_run
                 )
+                s, mn, mx, sk = run(dev, base, nrows)
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
             _merge_sketch_states(la, sketch_states, sk)
             self.stats.chunks += 1
+            t_disp += _time.perf_counter() - t0
+        self.stats.dispatch_s = t_disp
 
         if sums is None:  # empty stream
             sums, mins, maxs, sketch_states = empty_partials(la, G)
@@ -220,6 +249,53 @@ class StreamExecutor:
             np.asarray(sums), np.asarray(mins), np.asarray(maxs),
             {k: np.asarray(v) for k, v in sketch_states.items()},
         )
+
+    def _fused_local_fn(self, q, ds, lowering, prep):
+        """One jitted program per (query, chunk shape): prep + partial
+        aggregation, cached on the engine's program cache so repeats and
+        shape-identical streams reuse the compile."""
+        eng = self.engine
+        from .lowering import _query_key
+
+        key = _query_key(q, ds) + (
+            "stream-fused",
+            prep,  # carries (time_col, chunk_rows) identity
+            eng._resolve_strategy(lowering.num_groups),
+        )
+        cached = eng._query_fn_cache.get(key)
+        if cached is not None:
+            return cached
+        seg_fn = eng._segment_program(q, ds, lowering)
+
+        @jax.jit
+        def fused(dev, base, nrows):
+            return seg_fn([prep(dev, base, nrows)])
+
+        eng._query_fn_cache[key] = fused
+        return fused
+
+    def _downgrade_pallas(self, q, ds, lowering, prep, dist_run):
+        """Mirror Engine._call_segment_program's Mosaic-failure downgrade
+        for the fused streaming program: flag Pallas broken, evict, rebuild
+        on the XLA strategies, and let the retry surface real errors."""
+        from ..ops.pallas_groupby import pallas_available
+
+        eng = self.engine
+        if (
+            dist_run is not None
+            or eng._pallas_broken
+            or not pallas_available()
+            or eng._resolve_strategy(lowering.num_groups) != "pallas"
+        ):
+            raise  # re-raise the active exception: not a Pallas downgrade
+        eng._pallas_broken = True
+        for k in [
+            k
+            for k in eng._query_fn_cache
+            if any("pallas" in str(p) for p in k[2:]) or "stream-fused" in k
+        ]:
+            eng._query_fn_cache.pop(k)
+        return self._fused_local_fn(q, ds, lowering, prep)
 
     # -- chunk plumbing ------------------------------------------------------
 
@@ -296,10 +372,15 @@ class StreamExecutor:
                     continue
             return False
 
+        import time as _time
+
         def produce():
             try:
                 for chunk in chunks:
-                    if not _put(self._normalize_chunk(chunk, need, ds, chunk_rows)):
+                    t0 = _time.perf_counter()
+                    item = self._normalize_chunk(chunk, need, ds, chunk_rows)
+                    self.stats.normalize_s += _time.perf_counter() - t0
+                    if not _put(item):
                         return
                 _put(_STOP)
             except BaseException as e:  # surface producer errors to consumer
@@ -313,8 +394,6 @@ class StreamExecutor:
 
             sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
-        prep = self._prep_fn(ds.time_column, chunk_rows)
-
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         try:
@@ -326,11 +405,13 @@ class StreamExecutor:
                     raise item
                 rows = item.pop("__rows")
                 base = item.pop("__time_base", np.int64(0))
+                t0 = _time.perf_counter()
                 dev = {
                     k: jax.device_put(v, sharding) for k, v in item.items()
                 }
+                self.stats.put_s += _time.perf_counter() - t0
                 self.stats.rows += int(rows)
-                yield prep(dev, base, np.int32(rows))
+                yield dev, base, np.int32(rows)
         finally:
             cancelled.set()
             while True:  # unblock a producer stuck on a full queue

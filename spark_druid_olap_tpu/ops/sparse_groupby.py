@@ -33,10 +33,18 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .groupby import partial_aggregate
 
 SPARSE_SLOTS = 4096
+
+# Slot-capacity rungs for the HIGH-POPULATED tier (VERDICT r3 #2: the
+# sort-agg half of SURVEY.md §7 hard-part #1).  Up to SPARSE_SLOTS the inner
+# aggregation is the dense/Pallas one-hot over slots; past it, the
+# segmented-reduce-over-ranks kernel below scales to ~2M genuinely populated
+# groups.  Past the top rung the engine falls back to raw scatter.
+SLOTS_LADDER = (SPARSE_SLOTS, 1 << 15, 1 << 18, 1 << 21)
 
 # Row capacity of the filter-compaction stage: selective queries (the normal
 # OLAP case that reaches the sparse path — think city-level predicates over a
@@ -45,13 +53,18 @@ SPARSE_SLOTS = 4096
 # multiple of 1024 (ROW_PAD) so the inner one-hot blocks divide evenly.
 ROW_CAPACITY = 1 << 17
 
-# When the 128K tier overflows, the kernel's exact survivor count (`n_rows`)
-# picks the smallest adequate rung instead of falling all the way back to the
-# full-segment sort: sort cost grows roughly linearly with capacity (an
-# ESTIMATE from the O(n log n) sort bound — no committed TPU artifact backs
-# a measured number yet), so one rung of headroom is worth compiling a
-# second program for.
-ROW_CAPACITY_LADDER = (1 << 17, 1 << 18, 1 << 19, 1 << 20, 1 << 21)
+# Capacity rungs.  The engine picks the INITIAL rung from the planner's
+# selectivity estimate (x2 headroom) — a q3_2-class segment with ~700
+# survivors sorts 4K slots, not 128K (the fixed 128K floor cost ~35 ms of
+# sort PER SEGMENT, which at SF100's ~1000 segments was the whole sparse
+# budget).  On overflow the kernel's exact survivor count (`n_rows`) picks
+# the smallest adequate rung (full-segment sort only past the top): sort
+# cost grows roughly linearly with capacity (an ESTIMATE from the
+# O(n log n) sort bound — no committed TPU artifact backs a measured
+# number yet).
+ROW_CAPACITY_LADDER = (
+    1 << 12, 1 << 14, 1 << 17, 1 << 18, 1 << 19, 1 << 20, 1 << 21
+)
 
 
 def compact_rows(
@@ -91,6 +104,121 @@ def compact_rows(
         row_overflow,
         n,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("capacity", "block_rows", "num_min", "num_max"),
+)
+def segmented_reduce_sorted(
+    slot: jnp.ndarray,  # i32[R] run index per SORTED row: nondecreasing, +<=1/row
+    mask: jnp.ndarray,  # bool[R]
+    sum_values: jnp.ndarray,  # f32[R, Ms] pre-masked
+    minmax_values: jnp.ndarray,  # f32[R, Mnx]
+    minmax_masks: jnp.ndarray,  # bool[R, Mnx]
+    capacity: int,
+    block_rows: int,
+    num_min: int,
+    num_max: int,
+):
+    """Per-run aggregation over rows already sorted by group — the sort-agg
+    tier of SURVEY.md §7 hard-part #1, for group domains too populated for a
+    one-hot over slots (> SPARSE_SLOTS distinct present).
+
+    TPU-first: because `slot` (the run index from the caller's sort) is
+    nondecreasing and grows by at most 1 per row, any B consecutive rows
+    span at most B distinct runs.  So each B-row block one-hot-matmuls
+    against its LOCAL run offsets (a [B, B] MXU contraction — no scatter)
+    and accumulates into the output window [base, base+B) with a contiguous
+    dynamic-slice read-modify-write.  A run straddling two blocks is summed
+    by both partial windows — addition/min/max identities make that exact.
+    Total MXU work is B FLOPs/row/agg regardless of how many groups exist.
+
+    Returns (sums[capacity, Ms], mins[capacity, Mn], maxs[capacity, Mx]).
+    The caller guarantees slot < capacity (clamped); rows whose run was
+    clamped land in the last slot, which the caller treats as overflow.
+    """
+    R = slot.shape[0]
+    B = block_rows
+    pad_rows = (-R) % B
+    if pad_rows:
+        # repeat the final slot (keeps the nondecreasing invariant) with
+        # mask off so padding never contributes
+        slot = jnp.concatenate(
+            [slot, jnp.broadcast_to(slot[-1], (pad_rows,))]
+        )
+        mask = jnp.concatenate([mask, jnp.zeros(pad_rows, jnp.bool_)])
+        sum_values = jnp.concatenate(
+            [sum_values, jnp.zeros((pad_rows,) + sum_values.shape[1:],
+                                   sum_values.dtype)]
+        )
+        minmax_values = jnp.concatenate(
+            [minmax_values,
+             jnp.zeros((pad_rows,) + minmax_values.shape[1:],
+                       minmax_values.dtype)]
+        )
+        minmax_masks = jnp.concatenate(
+            [minmax_masks,
+             jnp.zeros((pad_rows,) + minmax_masks.shape[1:], jnp.bool_)]
+        )
+        R += pad_rows
+    nb = R // B
+    Ms = sum_values.shape[1]
+
+    slot_b = slot.reshape(nb, B)
+    mask_b = mask.reshape(nb, B)
+    sumv_b = sum_values.reshape(nb, B, Ms)
+    mmv_b = minmax_values.reshape(nb, B, -1)
+    mmm_b = minmax_masks.reshape(nb, B, -1)
+
+    iota = lax.iota(jnp.int32, B)
+    padded = capacity + B  # windows near the tail stay in-bounds
+    init = (
+        jnp.zeros((padded, Ms), jnp.float32),
+        jnp.full((padded, num_min), jnp.inf, jnp.float32),
+        jnp.full((padded, num_max), -jnp.inf, jnp.float32),
+    )
+
+    def body(carry, xs):
+        sums, mins, maxs = carry
+        s, m, sv, mmv, mmm = xs
+        base = s[0]
+        z = jnp.zeros((), base.dtype)  # start indices must share one dtype
+        local = s - base  # in [0, B): nondecreasing, +<=1 over B rows
+        match = (local[:, None] == iota[None, :]) & m[:, None]  # [B, B]
+        onehot = match.astype(jnp.float32)
+        block_sums = lax.dot(
+            onehot.T, sv, precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        win = lax.dynamic_slice(sums, (base, z), (B, Ms))
+        sums = lax.dynamic_update_slice(sums, win + block_sums, (base, z))
+        if num_min:
+            v = mmv[:, :num_min]
+            mm = m[:, None] & mmm[:, :num_min]
+            w = jnp.where(
+                match[:, :, None] & mm[:, None, :], v[:, None, :], jnp.inf
+            ).min(axis=0)
+            win = lax.dynamic_slice(mins, (base, z), (B, num_min))
+            mins = lax.dynamic_update_slice(
+                mins, jnp.minimum(win, w), (base, z)
+            )
+        if num_max:
+            v = mmv[:, num_min:]
+            mm = m[:, None] & mmm[:, num_min:]
+            w = jnp.where(
+                match[:, :, None] & mm[:, None, :], v[:, None, :], -jnp.inf
+            ).max(axis=0)
+            win = lax.dynamic_slice(maxs, (base, z), (B, num_max))
+            maxs = lax.dynamic_update_slice(
+                maxs, jnp.maximum(win, w), (base, z)
+            )
+        return (sums, mins, maxs), None
+
+    (sums, mins, maxs), _ = lax.scan(
+        body, init, (slot_b, mask_b, sumv_b, mmv_b, mmm_b)
+    )
+    return sums[:capacity], mins[:capacity], maxs[:capacity]
 
 
 def sparse_partial_aggregate(
@@ -153,17 +281,32 @@ def sparse_partial_aggregate(
     uniq = jnp.where(
         pos < R, sg[jnp.minimum(pos, R - 1)], jnp.int32(G)
     )
-    sums, mins, maxs = partial_aggregate(
-        slot_sorted,
-        mask[order],
-        sum_values[order],
-        minmax_values[order],
-        minmax_masks[order],
-        num_groups=n_state,
-        num_min=num_min,
-        num_max=num_max,
-        strategy=inner_strategy,
-    )
+    if slots > SPARSE_SLOTS and inner_strategy not in ("segment", "scatter"):
+        # high-populated tier: a one-hot over `slots` would blow VMEM; the
+        # rows are already sorted by run, so segmented-reduce them
+        sums, mins, maxs = segmented_reduce_sorted(
+            slot_sorted,
+            mask[order],
+            sum_values[order],
+            minmax_values[order],
+            minmax_masks[order],
+            capacity=n_state,
+            block_rows=1024,
+            num_min=num_min,
+            num_max=num_max,
+        )
+    else:
+        sums, mins, maxs = partial_aggregate(
+            slot_sorted,
+            mask[order],
+            sum_values[order],
+            minmax_values[order],
+            minmax_masks[order],
+            num_groups=n_state,
+            num_min=num_min,
+            num_max=num_max,
+            strategy=inner_strategy,
+        )
     gids = jnp.where(uniq >= G, jnp.int32(-1), uniq.astype(jnp.int32))
     return {
         "gids": gids,
@@ -173,6 +316,9 @@ def sparse_partial_aggregate(
         "overflow": overflow,
         "row_overflow": row_overflow,
         "n_rows": n_rows,
+        # exact distinct-present count (when not overflowed): the engine's
+        # slot-ladder rung selector reads it instead of guessing
+        "n_real": n_real,
     }
 
 
@@ -217,6 +363,11 @@ def merge_sparse_states(
         .max(jnp.concatenate([a["maxs"], b["maxs"]]))
     )
     gids = jnp.where(uniq >= G, jnp.int32(-1), uniq.astype(jnp.int32))
+    # distinct-present in the merged state: exact from the unique when it
+    # fit; the a+b upper bound when truncation makes the exact count
+    # unknowable (the rung selector needs >= the truth, never less)
+    exact = jnp.sum((uniq < G).astype(jnp.int32))
+    n_real = jnp.where(overflow, a["n_real"] + b["n_real"], exact)
     return {
         "gids": gids,
         "sums": sums,
@@ -227,4 +378,5 @@ def merge_sparse_states(
         # max, not sum: capacity is per-segment, so the rung the engine picks
         # must cover the worst single segment
         "n_rows": jnp.maximum(a["n_rows"], b["n_rows"]),
+        "n_real": n_real,
     }

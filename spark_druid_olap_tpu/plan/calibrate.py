@@ -106,6 +106,7 @@ def calibrate(
     gid_w = jnp.asarray(rng.integers(0, wide, size=rows).astype(np.int32))
 
     cost_per_group_state = None
+    cost_per_row_scatter_hi = None
     if not over():
         @jax.jit
         def scatter_wide(gid, v):
@@ -114,8 +115,22 @@ def calibrate(
         t_wide = _timeit(
             lambda: jax.block_until_ready(scatter_wide(gid_w, sv))
         )
+        # second row count at the same domain separates the per-ROW cost at
+        # high G (cache-missing random writes — measured 5x the low-G cost
+        # on CPU; the flat model routed SSB q3_2 SF100 onto a 12 s scatter)
+        # from the per-GROUP state cost (alloc + merge traffic)
+        half = rows // 2
+        t_half = _timeit(
+            lambda: jax.block_until_ready(
+                scatter_wide(gid_w[:half], sv[:half])
+            )
+        )
+        cost_per_row_scatter_hi = max(
+            (t_wide - t_half) * 1e6 / max(rows - half, 1),
+            cost_per_row_scatter,
+        )
         cost_per_group_state = max(
-            (t_wide - t_scatter) * 1e6 / max(wide - groups, 1), 0.0
+            (t_wide * 1e6 - rows * cost_per_row_scatter_hi) / wide, 0.0
         )
 
     # sort-compaction (sparse) path: us/row on the same wide domain
@@ -201,6 +216,10 @@ def calibrate(
     }
     if cost_per_group_state is not None:
         out["cost_per_group_state"] = cost_per_group_state
+    if cost_per_row_scatter_hi is not None:
+        out["cost_per_row_scatter_hi"] = cost_per_row_scatter_hi
+        out["scatter_lo_groups"] = groups
+        out["scatter_hi_groups"] = wide
     if cost_per_row_sparse is not None:
         out["cost_per_row_sparse"] = cost_per_row_sparse
     # always written so consumers can distinguish "measured" from "probe

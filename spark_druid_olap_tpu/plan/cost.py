@@ -76,48 +76,106 @@ def _g_tiles(num_groups: int) -> int:
     return max(1, -(-num_groups // 128))
 
 
+def scatter_row_cost(num_groups: int, cfg: SessionConfig) -> float:
+    """Per-row scatter cost at this group-domain size: log-linear
+    interpolation between the calibrated low-G and high-G anchor points,
+    clamped outside them.  Models the cache cliff — random scatter into a
+    state that outgrows cache costs several times a cache-resident one
+    (measured 0.0015 -> 0.0071 us/row from G=1K to G=2M on CPU); a flat
+    per-row constant routed SSB q3_2-class queries onto a 12 s scatter."""
+    import math
+
+    lo_g = max(1, cfg.scatter_lo_groups)
+    hi_g = max(lo_g + 1, cfg.scatter_hi_groups)
+    lo = cfg.cost_per_row_scatter
+    # a partial calibration can pair a measured lo with the profile's hi;
+    # scatter must never get CHEAPER as G grows
+    hi = max(cfg.cost_per_row_scatter_hi, lo)
+    if num_groups <= lo_g:
+        return lo
+    if num_groups >= hi_g:
+        return hi
+    f = math.log(num_groups / lo_g) / math.log(hi_g / lo_g)
+    return lo + (hi - lo) * f
+
+
 def _kernel_costs(
     rows: int,
     num_groups: int,
     cfg: SessionConfig,
     sparse_ok: bool,
     selectivity: float = 1.0,
+    n_segments: int = 1,
+    adaptive_ok: bool = False,
+    ndims: int = 1,
 ) -> Tuple[Tuple[str, float], ...]:
     """(strategy, modelled us) for each kernel class (inf = inapplicable).
 
     `selectivity` is the estimated surviving-row fraction of the query's
-    filter (estimate_selectivity).  It changes only the SPARSE model:
-    filter compaction pays one linear pass over all rows plus the
-    sort-aggregate over the SURVIVORS — which is how a 1/600-selective
-    GROUP BY over a 400K-group domain (SSB q3-class) beats raw scatter's
-    per-group state cost.  Dense and scatter process every row regardless
-    (the mask does not shrink their work), so they are unchanged."""
+    filter (estimate_selectivity).  `n_segments` matters because scatter
+    state and the sparse tier's sort network are paid PER SEGMENT (round 3
+    modelled them once and underpriced both by ~1000x at SF100's 982
+    segments).  The ADAPTIVE class models dictionary-domain compaction
+    (exec/adaptive_exec.py): one probe pass measuring per-dim presence,
+    then the best kernel over the compacted domain, estimated as
+    G' ~ G * selectivity (per-dim admitted fractions multiply the same way
+    row selectivities do)."""
+    n_segments = max(1, n_segments)
     dense = (
         rows * cfg.cost_per_row_dense * _g_tiles(num_groups)
         if num_groups <= cfg.dense_max_groups
         else float("inf")
     )
-    scatter = (
-        rows * cfg.cost_per_row_scatter + num_groups * cfg.cost_per_group_state
-    )
+
+    def scatter_at(g: int) -> float:
+        return (
+            rows * scatter_row_cost(g, cfg)
+            + g * cfg.cost_per_group_state * n_segments
+        )
+
+    scatter = scatter_at(num_groups)
+    # The compact constant is floored at the scatter per-row cost
+    # defensively (see plan/calibrate.py — an over-subtracted constant
+    # from an older calibration file must not flip large scans onto the
+    # sparse path)
+    compact = max(cfg.cost_per_row_compact, cfg.cost_per_row_scatter)
     if not sparse_ok:
         sparse = float("inf")
     elif selectivity >= 1.0:
         sparse = rows * cfg.cost_per_row_sparse  # full-row sort, no compact
     else:
-        from ..ops.sparse_groupby import ROW_CAPACITY
+        from ..ops.sparse_groupby import ROW_CAPACITY_LADDER
 
-        # tier-1 sorts at least ROW_CAPACITY slots however few survive.
-        # The compact constant is floored at the scatter per-row cost
-        # defensively (see plan/calibrate.py — an over-subtracted
-        # constant from an older calibration file must not flip large
-        # scans onto the sparse path)
-        compact = max(cfg.cost_per_row_compact, cfg.cost_per_row_scatter)
-        sorted_rows = min(
-            rows, max(selectivity * rows, float(ROW_CAPACITY))
+        # the engine picks the smallest capacity rung covering the
+        # estimated survivors PER SEGMENT and sorts that many slots in
+        # EVERY segment
+        seg_rows = max(1.0, rows / n_segments)
+        need = 2.0 * selectivity * seg_rows
+        rung = next(
+            (c for c in ROW_CAPACITY_LADDER if c >= need), seg_rows
         )
+        sorted_rows = n_segments * min(seg_rows, float(rung))
         sparse = rows * compact + sorted_rows * cfg.cost_per_row_sparse
-    return (("dense", dense), ("segment", scatter), ("sparse", sparse))
+    if not adaptive_ok:
+        adaptive = float("inf")
+    else:
+        g_c = max(1, min(num_groups, round(num_groups * selectivity)))
+        probe = rows * ndims * min(
+            cfg.cost_per_row_dense, cfg.cost_per_row_scatter
+        )
+        main = min(
+            scatter_at(g_c),
+            rows * cfg.cost_per_row_dense * _g_tiles(g_c)
+            if g_c <= cfg.dense_max_groups
+            else float("inf"),
+        )
+        adaptive = probe + main
+    return (
+        ("dense", dense),
+        ("segment", scatter),
+        ("sparse", sparse),
+        ("adaptive", adaptive),
+    )
 
 
 def estimate_selectivity(filt, ds: DataSource) -> float:
@@ -219,14 +277,27 @@ def choose_physical(
         )
         for a in aggs
     )
+    dims = getattr(q, "dimensions", ())
     sparse_ok = (
-        num_groups > SCATTER_CUTOVER
-        and not has_sketch
-        and bool(getattr(q, "dimensions", ()))
+        num_groups > SCATTER_CUTOVER and not has_sketch and bool(dims)
+    )
+    # adaptive compaction re-keys sketch states transparently (the compact
+    # program IS the normal program over a rewritten lowering), so sketches
+    # do not disqualify it
+    adaptive_ok = num_groups > SCATTER_CUTOVER and bool(dims)
+    segs = getattr(ds, "segments", None)
+    n_segments = (
+        len(segs) if segs is not None else max(1, rows // (1 << 22))
     )
     sel = estimate_selectivity(getattr(q, "filter", None), ds)
     costs = dict(
-        _kernel_costs(rows, num_groups, cfg, sparse_ok, selectivity=sel)
+        _kernel_costs(
+            rows, num_groups, cfg, sparse_ok,
+            selectivity=sel,
+            n_segments=n_segments,
+            adaptive_ok=adaptive_ok,
+            ndims=max(1, len(dims)),
+        )
     )
     if not cfg.cost_model_enabled:
         # static fallback: dense inside the domain cap, else compaction

@@ -1,0 +1,218 @@
+"""Adaptive dictionary-domain compaction (exec/adaptive_exec.py).
+
+The SSB q3/q4 shape: a huge combined dictionary domain where the filter
+admits only a few codes per dimension.  These tests pin the compacted
+execution to a float64 pandas oracle, the decline paths (no marginal
+shrink -> sparse/scatter), sketch aggregates through the compact domain,
+and the kept-set cache making repeats one-pass.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_druid_olap_tpu.catalog.segment import DimensionDict, build_datasource
+from spark_druid_olap_tpu.exec.engine import Engine
+from spark_druid_olap_tpu.exec.lowering import _query_key
+from spark_druid_olap_tpu.models.aggregations import (
+    Count,
+    DoubleMax,
+    DoubleMin,
+    DoubleSum,
+    HyperUnique,
+)
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.filters import Bound, InFilter, Selector
+from spark_druid_olap_tpu.models.query import GroupByQuery
+
+
+def _make_ds(n=60_000, da=400, db=400, seed=3, segs=3, name="ad"):
+    """Marginally-shrinkable data: rows concentrate on a few codes per dim
+    UNDER THE FILTER, while the combined domain is da*db >> 4096."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, da, size=n)
+    b = rng.integers(0, db, size=n)
+    cols = {
+        "a": a,
+        "b": b,
+        "v": (rng.random(n) * 100).astype(np.float32),
+        "k": rng.integers(0, 5000, size=n),
+    }
+    ds = build_datasource(
+        name,
+        cols,
+        dimension_cols=["a", "b"],
+        metric_cols=["v", "k"],
+        rows_per_segment=n // segs,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+        },
+    )
+    return ds, cols
+
+
+def _oracle(cols, mask):
+    df = pd.DataFrame(
+        {k: np.asarray(v, dtype=np.float64) for k, v in cols.items()}
+    )
+    df = df[mask]
+    g = df.groupby(["a", "b"], as_index=False).agg(
+        n=("v", "count"), s=("v", "sum"), lo=("v", "min"), hi=("v", "max")
+    )
+    return g.sort_values(["a", "b"]).reset_index(drop=True)
+
+
+def _norm(df):
+    out = df.sort_values(["a", "b"]).reset_index(drop=True)
+    return out.assign(
+        a=out.a.astype(np.float64),
+        b=out.b.astype(np.float64),
+        n=out.n.astype(np.int64),
+    )
+
+
+def _query(filter=None, aggs=None):
+    return GroupByQuery(
+        datasource="ad",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=aggs
+        or (
+            Count("n"),
+            DoubleSum("s", "v"),
+            DoubleMin("lo", "v"),
+            DoubleMax("hi", "v"),
+        ),
+        filter=filter,
+    )
+
+
+def test_adaptive_parity_and_kept_cache():
+    ds, cols = _make_ds()
+    keep_a = tuple(range(0, 12))
+    keep_b = tuple(range(0, 9))
+    q = _query(
+        filter=InFilter("a", keep_a).and_(InFilter("b", keep_b))
+        if hasattr(InFilter, "and_")
+        else None
+    )
+    from spark_druid_olap_tpu.models.filters import And
+
+    q = _query(filter=And((InFilter("a", keep_a), InFilter("b", keep_b))))
+    eng = Engine(strategy="adaptive")
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], keep_a) & np.isin(cols["b"], keep_b)
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["a"], want["a"])
+    np.testing.assert_array_equal(got["b"], want["b"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    np.testing.assert_allclose(got["lo"], want["lo"], rtol=1e-6)
+    np.testing.assert_allclose(got["hi"], want["hi"], rtol=1e-6)
+    assert eng.last_metrics.strategy == "adaptive"
+    # kept sets cached; a repeat skips phase A and stays exact
+    qkey = _query_key(q, ds)
+    assert qkey in eng._adaptive_kept
+    kept = eng._adaptive_kept[qkey]
+    assert len(kept[0]) <= len(keep_a) and len(kept[1]) <= len(keep_b)
+    got2 = _norm(eng.execute(q, ds))
+    pd.testing.assert_frame_equal(got, got2)
+
+
+def test_adaptive_declines_without_shrink_falls_to_sparse():
+    """Uniform data: marginals keep every code, compaction gains nothing —
+    decline memo set, sparse path answers, results exact."""
+    ds, cols = _make_ds()
+    q = _query()
+    eng = Engine(strategy="adaptive")
+    got = _norm(eng.execute(q, ds))
+    want = _oracle(cols, np.ones(len(cols["a"]), bool))
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    assert eng._adaptive_declined
+    assert eng.last_metrics.strategy in ("sparse", "segment", "dense")
+
+
+def test_adaptive_with_hll_sketch():
+    """Sketch aggregates run through the compact domain (the sparse tier
+    cannot take them; adaptive must)."""
+    ds, cols = _make_ds()
+    from spark_druid_olap_tpu.models.filters import And
+
+    q = _query(
+        filter=And(
+            (InFilter("a", tuple(range(6))), InFilter("b", tuple(range(6))))
+        ),
+        aggs=(
+            Count("n"),
+            DoubleSum("s", "v"),
+            HyperUnique("u", "k"),
+        ),
+    )
+    eng = Engine(strategy="adaptive")
+    got = eng.execute(q, ds)
+    assert eng.last_metrics.strategy == "adaptive"
+    mask = np.isin(cols["a"], range(6)) & np.isin(cols["b"], range(6))
+    df = pd.DataFrame({k: v[mask] for k, v in cols.items()})
+    want = df.groupby(["a", "b"]).agg(
+        n=("v", "count"), s=("v", "sum"), u=("k", "nunique")
+    ).reset_index()
+    got = got.sort_values(["a", "b"]).reset_index(drop=True)
+    want = want.sort_values(["a", "b"]).reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(
+        got["n"].astype(np.int64), want["n"].astype(np.int64)
+    )
+    # HLL is approximate: per-group counts are small here so the sparse
+    # register path is near-exact; allow generous slack anyway
+    err = np.abs(got["u"].astype(float) - want["u"].astype(float))
+    assert (err <= np.maximum(2, 0.15 * want["u"])).all()
+
+
+def test_adaptive_empty_filter_result():
+    """A filter admitting NO code for some dim yields the empty grouped
+    frame with the right columns (not a crash, not a full scan result)."""
+    ds, cols = _make_ds()
+    q = _query(filter=Selector("a", 99999))  # value not in the dictionary
+    eng = Engine(strategy="adaptive")
+    got = eng.execute(q, ds)
+    assert len(got) == 0
+    # same column set AND order as a real (non-empty) execution produces
+    ref = Engine(strategy="segment").execute(_query(), ds)
+    assert list(got.columns) == list(ref.columns)
+
+
+def test_adaptive_not_used_for_explicit_segment():
+    ds, cols = _make_ds()
+    from spark_druid_olap_tpu.models.filters import And
+
+    q = _query(
+        filter=And(
+            (InFilter("a", tuple(range(5))), InFilter("b", tuple(range(5))))
+        )
+    )
+    eng = Engine(strategy="segment")
+    got = eng.execute(q, ds)
+    assert eng.last_metrics.strategy == "segment"
+    mask = np.isin(cols["a"], range(5)) & np.isin(cols["b"], range(5))
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(_norm(got)["n"], want["n"])
+
+
+def test_adaptive_matches_scatter_bit_for_bit_groups():
+    """Adaptive and raw scatter agree on the full result frame (float sums
+    compared tightly: both accumulate in f32 over the same per-segment
+    order, modulo the domain re-key)."""
+    ds, cols = _make_ds(segs=4)
+    from spark_druid_olap_tpu.models.filters import And
+
+    q = _query(
+        filter=And(
+            (InFilter("a", tuple(range(10))), InFilter("b", tuple(range(7))))
+        )
+    )
+    a_df = _norm(Engine(strategy="adaptive").execute(q, ds))
+    s_df = _norm(Engine(strategy="segment").execute(q, ds))
+    np.testing.assert_array_equal(a_df[["a", "b", "n"]], s_df[["a", "b", "n"]])
+    for c in ("s", "lo", "hi"):
+        np.testing.assert_allclose(a_df[c], s_df[c], rtol=1e-6)

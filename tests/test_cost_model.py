@@ -166,3 +166,63 @@ def test_distributed_parity_when_cost_model_picks_mesh():
     )
     np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
     np.testing.assert_array_equal(got["n"], want["n"])
+
+
+def _cpu_profile_cfg():
+    cfg = SessionConfig()
+    # the committed CPU profile values (config.apply_platform_profile) set
+    # explicitly so this stays a pure-unit test on any backend
+    cfg.cost_per_row_dense = 0.58
+    cfg.cost_per_row_scatter = 0.0012
+    cfg.cost_per_row_scatter_hi = 0.0071
+    cfg.scatter_lo_groups = 1024
+    cfg.scatter_hi_groups = 1 << 21
+    cfg.cost_per_row_sparse = 0.49
+    cfg.cost_per_row_compact = 0.0012
+    cfg.cost_per_group_state = 0.0023
+    return cfg
+
+
+def test_scatter_row_cost_interpolates_in_log_g():
+    from spark_druid_olap_tpu.plan.cost import scatter_row_cost
+
+    cfg = _cpu_profile_cfg()
+    assert scatter_row_cost(1, cfg) == cfg.cost_per_row_scatter
+    assert scatter_row_cost(1024, cfg) == cfg.cost_per_row_scatter
+    assert scatter_row_cost(1 << 22, cfg) == cfg.cost_per_row_scatter_hi
+    mid = scatter_row_cost(1 << 16, cfg)
+    assert cfg.cost_per_row_scatter < mid < cfg.cost_per_row_scatter_hi
+    # monotone in G
+    grid = [scatter_row_cost(g, cfg) for g in (1024, 8192, 65536, 1 << 19)]
+    assert grid == sorted(grid)
+
+
+def test_q3_2_shape_routes_to_sparse_on_cpu_profile():
+    """The round-3 regression shape: 600M rows, 504K-group domain, a
+    ~1/730-selective filter.  The G-aware scatter cost must route this to
+    the sort-compaction path (measured: scatter ran 12.1s and lost to
+    pandas; sparse is a linear scan + a 131K-row sort)."""
+    from spark_druid_olap_tpu.models.filters import Selector
+    from spark_druid_olap_tpu.plan.cost import _kernel_costs
+
+    cfg = _cpu_profile_cfg()
+    costs = dict(
+        _kernel_costs(600_000_000, 504_008, cfg, sparse_ok=True,
+                      selectivity=1.0 / 730)
+    )
+    assert costs["sparse"] < costs["segment"]
+    assert costs["dense"] == float("inf")
+
+
+def test_dense_populated_unfiltered_stays_on_scatter_on_cpu():
+    """No filter, huge truly-populated domain: the sparse model charges a
+    full-row sort (0.49us/row on CPU), so raw scatter must win — on CPU the
+    sort-agg tier only pays off when compaction shrinks the sort."""
+    from spark_druid_olap_tpu.plan.cost import _kernel_costs
+
+    cfg = _cpu_profile_cfg()
+    costs = dict(
+        _kernel_costs(100_000_000, 2_000_000, cfg, sparse_ok=True,
+                      selectivity=1.0)
+    )
+    assert costs["segment"] < costs["sparse"]

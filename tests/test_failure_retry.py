@@ -118,7 +118,7 @@ def test_persistent_failure_surfaces(ds):
     eng = Engine()
     q = groupby_with_time_granularity(_q())
 
-    def always_fail(self, q, ds, lowering):
+    def always_fail(self, q, ds, lowering, **kwargs):
         def fn(cols_list):
             raise RuntimeError("device permanently unreachable")
 

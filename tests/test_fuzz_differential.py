@@ -578,3 +578,141 @@ def test_fuzz_fallback_matches_oracle(fallback_world, seed):
     fallback's filters/aggregates/having/order semantics."""
     ctx2, df = fallback_world
     _run_case(ctx2, df, seed)
+
+
+# ---------------------------------------------------------------------------
+# High-cardinality strategy matrix (round 4): the adaptive-compaction and
+# big-slots sparse tiers must agree with raw scatter on randomized
+# high-domain queries — the differential for VERDICT r3 #2's new paths.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hc_world():
+    from spark_druid_olap_tpu.catalog.segment import (
+        DimensionDict,
+        build_datasource,
+    )
+
+    rng = np.random.default_rng(77)
+    n, da, db = 50_000, 350, 290
+    cols = {
+        "a": rng.integers(0, da, size=n),
+        "b": rng.integers(0, db, size=n),
+        "v": (rng.random(n) * 50 - 10).astype(np.float32),
+        "w": rng.integers(0, 1000, size=n).astype(np.float32),
+    }
+    ds = build_datasource(
+        "hcfuzz",
+        cols,
+        dimension_cols=["a", "b"],
+        metric_cols=["v", "w"],
+        rows_per_segment=n // 4,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+        },
+    )
+    return ds, pd.DataFrame({k: np.asarray(v) for k, v in cols.items()})
+
+
+def _hc_query(seed):
+    from spark_druid_olap_tpu.models.aggregations import (
+        Count,
+        DoubleMax,
+        DoubleMin,
+        DoubleSum,
+    )
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.filters import And, Bound, InFilter
+    from spark_druid_olap_tpu.models.query import GroupByQuery
+
+    rng = np.random.default_rng(seed)
+    conj = []
+    mask_parts = []
+    if rng.random() < 0.8:
+        ka = tuple(int(x) for x in rng.choice(350, rng.integers(2, 40),
+                                              replace=False))
+        conj.append(InFilter("a", ka))
+        mask_parts.append(("a", set(ka)))
+    if rng.random() < 0.6:
+        kb = tuple(int(x) for x in rng.choice(290, rng.integers(2, 30),
+                                              replace=False))
+        conj.append(InFilter("b", kb))
+        mask_parts.append(("b", set(kb)))
+    if rng.random() < 0.4:
+        hi = float(rng.integers(5, 40))
+        conj.append(Bound("v", upper=str(hi), ordering="numeric"))
+        mask_parts.append(("v<=", hi))
+    filt = None
+    if len(conj) == 1:
+        filt = conj[0]
+    elif conj:
+        filt = And(tuple(conj))
+    q = GroupByQuery(
+        datasource="hcfuzz",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(
+            Count("n"),
+            DoubleSum("s", "v"),
+            DoubleMin("lo", "w"),
+            DoubleMax("hi", "w"),
+        ),
+        filter=filt,
+    )
+    return q, mask_parts
+
+
+def _hc_mask(df, mask_parts):
+    m = np.ones(len(df), bool)
+    for kind, val in mask_parts:
+        if kind == "a":
+            m &= df["a"].isin(val).to_numpy()
+        elif kind == "b":
+            m &= df["b"].isin(val).to_numpy()
+        else:
+            m &= (df["v"] <= val).to_numpy()
+    return m
+
+
+@pytest.mark.parametrize("seed", [1, 2, 5, 8, 13, 21, 34, 55, 89, 144])
+def test_fuzz_high_cardinality_strategy_matrix(hc_world, seed):
+    from spark_druid_olap_tpu.exec.engine import Engine
+
+    ds, df = hc_world
+    q, mask_parts = _hc_query(seed)
+    m = _hc_mask(df, mask_parts)
+    sub = df[m]
+    want = (
+        sub.groupby(["a", "b"], as_index=False)
+        .agg(n=("v", "count"), s=("v", "sum"), lo=("w", "min"),
+             hi=("w", "max"))
+        .sort_values(["a", "b"])
+        .reset_index(drop=True)
+    )
+    frames = {}
+    for strat in ("segment", "sparse", "adaptive"):
+        got = Engine(strategy=strat).execute(q, ds)
+        got = got.sort_values(["a", "b"]).reset_index(drop=True)
+        assert len(got) == len(want), (strat, seed)
+        np.testing.assert_array_equal(
+            got["a"].astype(np.int64), want["a"].astype(np.int64),
+            err_msg=f"{strat} seed={seed}",
+        )
+        np.testing.assert_array_equal(
+            got["n"].astype(np.int64), want["n"].astype(np.int64),
+            err_msg=f"{strat} seed={seed}",
+        )
+        np.testing.assert_allclose(
+            got["s"].astype(float), want["s"], rtol=2e-5, atol=1e-3,
+            err_msg=f"{strat} seed={seed}",
+        )
+        np.testing.assert_allclose(
+            got["lo"].astype(float), want["lo"], rtol=1e-6,
+            err_msg=f"{strat} seed={seed}",
+        )
+        np.testing.assert_allclose(
+            got["hi"].astype(float), want["hi"], rtol=1e-6,
+            err_msg=f"{strat} seed={seed}",
+        )
+        frames[strat] = got

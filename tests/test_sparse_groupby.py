@@ -125,22 +125,15 @@ def test_sparse_with_filter():
     np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
 
 
-def test_sparse_overflow_falls_back_to_scatter():
-    """More distinct groups than SPARSE_SLOTS: overflow flag must trip and
-    the engine must still return exact results (scatter rerun)."""
-    from spark_druid_olap_tpu.ops.sparse_groupby import SPARSE_SLOTS
-
-    n = 40_000
-    da = db = 300
-    rng = np.random.default_rng(11)
-    # ~ min(n, 90000) distinct pairs >> SPARSE_SLOTS
+def _overflow_ds(n=40_000, da=300, db=300, seed=11, name="hc2"):
+    rng = np.random.default_rng(seed)
     a = rng.integers(0, da, size=n)
     b = rng.integers(0, db, size=n)
     cols = {"a": a, "b": b, "v": np.ones(n, np.float32)}
     from spark_druid_olap_tpu.catalog.segment import DimensionDict
 
     ds = build_datasource(
-        "hc2",
+        name,
         cols,
         dimension_cols=["a", "b"],
         metric_cols=["v"],
@@ -149,28 +142,156 @@ def test_sparse_overflow_falls_back_to_scatter():
             "b": DimensionDict(values=tuple(range(db))),
         },
     )
+    return ds, cols
+
+
+def test_sparse_overflow_rungs_up_slots_ladder():
+    """More distinct groups than SPARSE_SLOTS: the engine now rungs up the
+    SLOTS_LADDER (segmented-reduce tier, VERDICT r3 #2) instead of
+    abandoning the device path — results exact, rung remembered."""
+    from spark_druid_olap_tpu.exec.lowering import _query_key
+    from spark_druid_olap_tpu.ops.sparse_groupby import SPARSE_SLOTS
+
+    ds, cols = _overflow_ds()
     df = pd.DataFrame(cols)
     distinct = len(df.groupby(["a", "b"]))
     assert distinct > SPARSE_SLOTS
 
     # explicit 'sparse': auto only self-upgrades on TPU backends now
     eng = Engine(strategy="sparse")
-    q = _query()
     q = GroupByQuery(
         datasource="hc2",
-        dimensions=q.dimensions,
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
         aggregations=(Count("n"), DoubleSum("s", "v")),
     )
     got = eng.execute(q, ds)
     assert len(got) == distinct
-    assert int(got["n"].sum()) == n
-    assert eng._sparse_disabled  # the fallback actually triggered
-    # second run takes the pinned scatter path directly
+    assert int(got["n"].sum()) == n_total(cols)
+    # the ladder engaged (rung remembered), the query was NOT pinned off
+    assert _query_key(q, ds) in eng._sparse_slots
+    assert not eng._sparse_disabled
+    # second run goes straight to the remembered rung, same result
     got2 = eng.execute(q, ds)
     pd.testing.assert_frame_equal(
         got.sort_values(["a", "b"]).reset_index(drop=True),
         got2.sort_values(["a", "b"]).reset_index(drop=True),
     )
+
+
+def n_total(cols):
+    return len(cols["v"])
+
+
+def test_sparse_overflow_past_ladder_top_pins_to_scatter(monkeypatch):
+    """Distinct-present beyond the top SLOTS_LADDER rung: fall back to raw
+    scatter and pin, exactly the old overflow behavior."""
+    from spark_druid_olap_tpu.ops import sparse_groupby as _sg
+
+    monkeypatch.setattr(_sg, "SLOTS_LADDER", (_sg.SPARSE_SLOTS, 8192))
+    ds, cols = _overflow_ds(name="hc3")
+    distinct = len(pd.DataFrame(cols).groupby(["a", "b"]))
+    assert distinct > 8192
+
+    eng = Engine(strategy="sparse")
+    q = GroupByQuery(
+        datasource="hc3",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+    )
+    got = eng.execute(q, ds)
+    assert len(got) == distinct
+    assert int(got["n"].sum()) == len(cols["v"])
+    assert eng._sparse_disabled  # pinned off the sparse path
+    got2 = eng.execute(q, ds)
+    pd.testing.assert_frame_equal(
+        got.sort_values(["a", "b"]).reset_index(drop=True),
+        got2.sort_values(["a", "b"]).reset_index(drop=True),
+    )
+
+
+def test_segmented_reduce_sorted_kernel_parity():
+    """Direct kernel test: per-run sums/mins/maxs over sorted runs match a
+    float64 numpy oracle, including run-straddles-block boundaries, masked
+    rows, and a non-multiple-of-block row count."""
+    import jax.numpy as jnp
+
+    from spark_druid_olap_tpu.ops.sparse_groupby import (
+        segmented_reduce_sorted,
+    )
+
+    rng = np.random.default_rng(5)
+    R, n_runs = 5000, 37  # R % 1024 != 0 exercises the padding path
+    # sorted run ids with runs of wildly uneven length (some longer than a
+    # block, some single-row)
+    cuts = np.sort(rng.choice(np.arange(1, R), size=n_runs - 1,
+                              replace=False))
+    slot = np.zeros(R, np.int32)
+    slot[cuts] = 1
+    slot = np.cumsum(slot).astype(np.int32)
+    mask = rng.random(R) < 0.8
+    v = (rng.random((R, 2)) * 10).astype(np.float32)
+    sv = v * mask[:, None]
+    mmv = (rng.random((R, 2)) * 10 - 5).astype(np.float32)
+    mmm = np.ones((R, 2), np.bool_)
+
+    sums, mins, maxs = segmented_reduce_sorted(
+        jnp.asarray(slot), jnp.asarray(mask), jnp.asarray(sv),
+        jnp.asarray(mmv), jnp.asarray(mmm),
+        capacity=64, block_rows=1024, num_min=1, num_max=1,
+    )
+    sums, mins, maxs = map(np.asarray, (sums, mins, maxs))
+    for r in range(n_runs):
+        sel = (slot == r) & mask
+        np.testing.assert_allclose(
+            sums[r], sv[sel].astype(np.float64).sum(axis=0), rtol=2e-5,
+            atol=1e-4,
+        )
+        want_min = mmv[sel, 0].min() if sel.any() else np.inf
+        want_max = mmv[sel, 1].max() if sel.any() else -np.inf
+        assert mins[r, 0] == np.float32(want_min)
+        assert maxs[r, 0] == np.float32(want_max)
+    # untouched capacity slots hold the identities
+    assert (sums[n_runs:] == 0).all()
+    assert (mins[n_runs:] == np.inf).all()
+    assert (maxs[n_runs:] == -np.inf).all()
+
+
+def test_sparse_big_slots_segmented_reduce_path():
+    """sparse_partial_aggregate at slots > SPARSE_SLOTS with a non-scatter
+    inner must use the segmented-reduce tier and stay exact."""
+    import jax.numpy as jnp
+
+    from spark_druid_olap_tpu.ops.sparse_groupby import (
+        SPARSE_SLOTS,
+        sparse_partial_aggregate,
+    )
+
+    rng = np.random.default_rng(9)
+    R, G = 1 << 15, 1 << 20
+    distinct = 9000
+    assert distinct > SPARSE_SLOTS
+    pool = rng.choice(G, size=distinct, replace=False).astype(np.int32)
+    gid = pool[rng.integers(0, distinct, size=R)]
+    mask = rng.random(R) < 0.9
+    v = rng.random((R, 1)).astype(np.float32)
+    sv = v * mask[:, None]
+    st = sparse_partial_aggregate(
+        jnp.asarray(gid), jnp.asarray(mask), jnp.asarray(sv),
+        jnp.zeros((R, 0), jnp.float32), jnp.zeros((R, 0), jnp.bool_),
+        num_groups=G, num_min=0, num_max=0,
+        slots=16384, inner_strategy="dense",
+    )
+    assert not bool(st["overflow"])
+    got_g = np.asarray(st["gids"])
+    got_s = np.asarray(st["sums"])[:, 0]
+    df = pd.DataFrame({"g": gid[mask], "v": v[mask, 0].astype(np.float64)})
+    want = df.groupby("g")["v"].sum()
+    live = got_g >= 0
+    assert live.sum() == len(want)
+    got = pd.Series(got_s[live], index=got_g[live]).sort_index()
+    np.testing.assert_allclose(got.values, want.values, rtol=2e-5)
+    np.testing.assert_array_equal(got.index.values, want.index.values)
+    assert int(np.asarray(st["n_real"])) == len(want)
 
 
 def test_sparse_multi_segment_merge():
@@ -333,6 +454,12 @@ def test_engine_ladder_picks_intermediate_rung(monkeypatch):
 
     monkeypatch.setattr(sg, "ROW_CAPACITY", 1024)
     monkeypatch.setattr(sg, "ROW_CAPACITY_LADDER", (1024, 4096, 16384))
+    # force a bad (tiny) selectivity estimate so the initial rung is the
+    # ladder bottom and the OVERFLOW path is what gets exercised
+    from spark_druid_olap_tpu.plan import cost as plan_cost
+    monkeypatch.setattr(
+        plan_cost, "estimate_selectivity", lambda f, ds: 1e-4
+    )
     ds, cols = _make_ds()  # 60k rows over 3 segments (20k rows each)
     keep = list(range(0, 30))  # ~6k survivors: >1024, fits 4096-per-segment
     q = _query(filter=InFilter("a", tuple(keep)))
@@ -357,6 +484,10 @@ def test_engine_ladder_exhausted_falls_back_to_full_sort(monkeypatch):
 
     monkeypatch.setattr(sg, "ROW_CAPACITY", 1024)
     monkeypatch.setattr(sg, "ROW_CAPACITY_LADDER", (1024, 2048))
+    from spark_druid_olap_tpu.plan import cost as plan_cost
+    monkeypatch.setattr(
+        plan_cost, "estimate_selectivity", lambda f, ds: 1e-4
+    )
     ds, cols = _make_ds()
     keep = list(range(0, 150))  # ~half the rows survive >> 2048 per segment
     q = _query(filter=InFilter("a", tuple(keep)))
@@ -388,3 +519,24 @@ def test_engine_compacted_tier_parity(monkeypatch):
     np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
     np.testing.assert_allclose(got["lo"], want["lo"], rtol=1e-6)
     np.testing.assert_allclose(got["hi"], want["hi"], rtol=1e-6)
+
+
+def test_selectivity_estimate_picks_initial_rung(monkeypatch):
+    """A well-estimated filter goes straight to an adequate rung: no
+    overflow, no remembered rung, exact results."""
+    import spark_druid_olap_tpu.ops.sparse_groupby as sg
+
+    monkeypatch.setattr(
+        sg, "ROW_CAPACITY_LADDER", (1024, 4096, 16384, 65536)
+    )
+    ds, cols = _make_ds()  # 60k rows over 3 segments
+    keep = list(range(0, 30))  # sel ~0.1 -> need ~4096/segment
+    q = _query(filter=InFilter("a", tuple(keep)))
+    eng = Engine(strategy="sparse")
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], keep)
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    # estimate was adequate: the overflow rung-up never had to fire
+    assert eng._sparse_row_capacity == {}
