@@ -342,13 +342,51 @@ class TPUOlapContext:
             "rewrite failed (%s); executing on the host fallback", err
         )
         t0 = _time.perf_counter()
+        assists = {"n": 0}
+
+        def device_subplan(sub_lp):
+            """Device-assist hook: offer an Aggregate subtree to the normal
+            rewrite path.  Any failure means 'interpret it host-side' —
+            the assist must never turn a working fallback into an error.
+            Small bases stay on the (float64-exact, instant) interpreter:
+            see SessionConfig.device_assist_min_rows."""
+            try:
+                if (
+                    plan_input_rows(sub_lp, self.catalog)
+                    < self.config.device_assist_min_rows
+                ):
+                    return None
+                rw = self._planner().plan(sub_lp)
+            except RewriteError:
+                return None
+            except Exception:
+                # quirk-shaped internal subtrees (decorrelator output) may
+                # crash the planner rather than decline; the assist must
+                # never turn a working fallback into an error
+                log.warning(
+                    "device-assist planning failed; interpreting host-side",
+                    exc_info=True,
+                )
+                return None
+            try:
+                out = self.execute_rewrite(rw, use_result_cache=False)
+            except Exception:
+                log.warning(
+                    "device-assist subplan failed; interpreting host-side",
+                    exc_info=True,
+                )
+                return None
+            assists["n"] += 1
+            return out
+
         df = execute_fallback(
-            lp, self.catalog, max_rows=self.config.fallback_max_rows
+            lp, self.catalog, max_rows=self.config.fallback_max_rows,
+            device_exec=device_subplan,
         )
         self._last_engine_metrics = QueryMetrics(
             query_type="fallback",
             strategy="host-pandas",
-            executor="fallback",
+            executor="device+fallback" if assists["n"] else "fallback",
             rows_scanned=plan_input_rows(lp, self.catalog),
             total_ms=(_time.perf_counter() - t0) * 1e3,
         )

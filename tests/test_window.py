@@ -516,3 +516,58 @@ def test_percent_rank_cume_dist_nth_value(ctx):
         c.sql("SELECT PERCENT_RANK() OVER () FROM pr")
     with pytest.raises(ParseError, match="positive integer"):
         c.sql("SELECT NTH_VALUE(v, 0) OVER (ORDER BY v) FROM pr")
+
+
+def test_device_assist_window_over_aggregate():
+    """A window over a device-eligible GROUP BY base above the assist
+    threshold runs the aggregate on the engine (executor device+fallback)
+    and matches the float64 oracle (integer values: f32-exact sums)."""
+    import numpy as np
+    import pandas as pd
+
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    cfg = SessionConfig(device_assist_min_rows=1000)
+    c = sd.TPUOlapContext(cfg)
+    rng = np.random.default_rng(4)
+    n = 30_000
+    f = pd.DataFrame({
+        "g": rng.choice(["a", "b", "c", "d"], n),
+        "s": rng.choice(["x", "y", "z"], n),
+        "v": rng.integers(0, 100, n).astype(np.float64),
+    })
+    c.register_table("wbig", f)
+    got = c.sql(
+        "SELECT g, s, sum(v) AS sv, "
+        "RANK() OVER (PARTITION BY g ORDER BY sum(v) DESC) AS r "
+        "FROM wbig GROUP BY g, s"
+    )
+    assert c.last_metrics.executor == "device+fallback"
+    want = f.groupby(["g", "s"], as_index=False)["v"].sum()
+    want["r"] = want.groupby("g")["v"].rank(
+        method="min", ascending=False
+    ).astype(int)
+    m = got.merge(want, on=["g", "s"])
+    assert len(m) == len(want)
+    np.testing.assert_array_equal(
+        m["sv"].astype(np.int64), m["v"].astype(np.int64)
+    )
+    np.testing.assert_array_equal(
+        m["r_x"].astype(int), m["r_y"].astype(int)
+    )
+
+    # below the threshold: pure host fallback, still correct
+    cfg2 = SessionConfig()  # default threshold far above 30k rows
+    c2 = sd.TPUOlapContext(cfg2)
+    c2.register_table("wbig", f)
+    got2 = c2.sql(
+        "SELECT g, s, sum(v) AS sv, "
+        "RANK() OVER (PARTITION BY g ORDER BY sum(v) DESC) AS r "
+        "FROM wbig GROUP BY g, s"
+    )
+    assert c2.last_metrics.executor == "fallback"
+    m2 = got.merge(got2, on=["g", "s"])
+    np.testing.assert_array_equal(
+        m2["r_x"].astype(int), m2["r_y"].astype(int)
+    )
