@@ -533,6 +533,9 @@ class TPUOlapContext:
                         mesh=make_mesh(*phys.mesh_shape)
                     )
                 return self._dist_engine
+        # the engine's adaptive tier picks its compact-domain kernel from
+        # the session's cost constants, not a fresh file load
+        self.engine._calibrated_cfg = self.config
         if self.engine.strategy != phys.strategy:
             self.engine.strategy = phys.strategy
             # strategy participates in the engine's program cache key, so

@@ -230,7 +230,7 @@ class SessionConfig:
         self.scatter_lo_groups = 1024
         self.scatter_hi_groups = 1 << 21
         self.cost_per_row_sparse = 0.49
-        self.cost_per_row_compact = 0.0012
+        self.cost_per_row_compact = 0.0065
         self.cost_per_group_state = 0.0023
         # "collective" on a CPU mesh is shared-memory copies and a local
         # dispatch is function-call cheap — the ICI/RPC-flavoured defaults

@@ -130,12 +130,33 @@ class AdaptiveDomainMixin:
             keep.update(v.expression.columns())
         return [c for c in lowering.columns if c in keep]
 
+    def _adaptive_main_strategy(self, ds: DataSource, g_compact: int) -> str:
+        from ..config import SessionConfig
+        from ..ops.groupby import SCATTER_CUTOVER
+        from ..ops.pallas_groupby import pallas_available
+        from ..plan.cost import choose_kernel_strategy
+
+        cfg = getattr(self, "_calibrated_cfg", None)
+        if cfg is None:
+            cfg = SessionConfig.load_calibrated()
+            self._calibrated_cfg = cfg
+        strat = choose_kernel_strategy(ds.num_rows, g_compact, cfg)
+        if (
+            strat == "dense"
+            and g_compact <= SCATTER_CUTOVER
+            and pallas_available()
+            and not self._pallas_broken
+        ):
+            strat = "pallas"
+        return strat
+
     def _presence_program(self, q, ds, lowering: GroupByLowering):
         """Fused per-segment program: presence COUNTS per grouping dim under
         the query's row mask — one data read covers every dim."""
-        from ..ops.groupby import partial_aggregate, resolve_strategy
+        from ..ops.groupby import partial_aggregate
+        from ..ops.pallas_groupby import pallas_available
 
-        pallas_ok = not self._pallas_broken
+        pallas_ok = not self._pallas_broken and pallas_available()
         # pallas_ok participates in the key: after a Mosaic failure flips
         # _pallas_broken, the rebuilt program must not reuse the cached one
         # with Pallas strategies baked in
@@ -144,8 +165,17 @@ class AdaptiveDomainMixin:
         if cached is not None:
             return cached
 
+        # same inner convention as the sparse tier: one-hot kernels on a
+        # TPU backend (within the one-hot domain cap), scatter everywhere
+        # else (a card-sized scatter state is cache-resident on CPU; the
+        # static auto-resolver would pick the dense one-hot there —
+        # measured 55 s for one SF10 presence pass vs ~0.5 s on scatter)
+        from ..ops.groupby import SCATTER_CUTOVER
+
         strategies = [
-            resolve_strategy("auto", d.cardinality, pallas_ok=pallas_ok)
+            "pallas"
+            if pallas_ok and d.cardinality <= SCATTER_CUTOVER
+            else "segment"
             for d in lowering.dims
         ]
 
@@ -270,9 +300,17 @@ class AdaptiveDomainMixin:
 
         clow = compacted_lowering(lowering, kept)
         cards = tuple(d.cardinality for d in clow.dims)
+        # the compact program's kernel comes from the CALIBRATED cost
+        # model at the compacted cardinality — the engine's static "auto"
+        # resolver picks the dense one-hot below the cutover, which on a
+        # CPU backend is the wrong side of a ~200x inversion (measured:
+        # a 60M-row phase B at G'=600 ran 49 s dense vs sub-second
+        # scatter; on TPU the same choice lands on Pallas/dense)
+        strat = self._adaptive_main_strategy(ds, clow.num_groups)
         try:
             state = self._partials_for_query(
-                q, ds, lowering=clow, key_extra=("adaptive",) + cards
+                q, ds, lowering=clow, key_extra=("adaptive",) + cards,
+                strategy_override=strat,
             )
         except Exception:
             log.warning("adaptive compact dispatch failed", exc_info=True)

@@ -428,7 +428,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         return segs
 
     def _partials_for_query(
-        self, q: Q.GroupByQuery, ds: DataSource, lowering=None, key_extra=()
+        self,
+        q: Q.GroupByQuery,
+        ds: DataSource,
+        lowering=None,
+        key_extra=(),
+        strategy_override=None,
     ):
         """Compute merged partial state across local segments.
 
@@ -452,7 +457,10 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # segments fuse into batched programs (partial agg + cross-segment
         # merge inside): the common case is ONE dispatch + ONE fetch per
         # query; oversized scopes merge across a few batch dispatches
-        seg_fn = self._segment_program(q, ds, lowering, key_extra=key_extra)
+        seg_fn = self._segment_program(
+            q, ds, lowering, key_extra=key_extra,
+            strategy_override=strategy_override,
+        )
         for batch in self._segment_batches(segs, need):
             cols_list = [
                 self._cols_for_segment(seg, ds, need) for seg in batch
@@ -553,6 +561,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         ds: DataSource,
         lowering: "GroupByLowering",
         key_extra=(),
+        strategy_override=None,
     ) -> Callable:
         """One fused, cached XLA program per query: row pipeline (virtual
         columns, filter mask, group ids) + partial aggregation + sketch
@@ -560,7 +569,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         dispatch.  The analog of Druid compiling a query into one engine pass,
         with the broker's cross-segment merge folded in."""
         la, G = lowering.la, lowering.num_groups
-        strategy = self._resolve_strategy(G)
+        strategy = strategy_override or self._resolve_strategy(G)
         # _query_key includes schema_signature: a re-ingested datasource
         # (new dict cardinalities => new G) must not reuse a stale program
         key = _query_key(q, ds) + (strategy,) + tuple(key_extra)

@@ -153,41 +153,28 @@ def calibrate(
     except Exception:
         cost_per_row_sparse = None  # declined (overflow etc.): keep default
 
-    # filter-compaction pass: sparse with a 1% mask at the default row
-    # capacity isolates the linear compact scan (the survivors' sort is
-    # ~1% of t_sparse and subtracted out)
+    # filter-compaction pass measured DIRECTLY on compact_rows (cumsum +
+    # searchsorted + gathers): the round-3 by-subtraction estimate came
+    # out ~3x low (it credited the tier-1 sort with time the cumsum
+    # actually spent), which routed SF10 q3_2 onto a sparse plan that a
+    # measured scatter beat 539 ms to 763 ms.  FLOOR at the scatter
+    # per-row cost: compaction reads at least as much as a scatter pass.
     cost_per_row_compact = None
-    if cost_per_row_sparse is not None and not over():
-        from ..ops.sparse_groupby import ROW_CAPACITY
+    if not over():
+        from ..ops.sparse_groupby import compact_rows
 
         sel = 0.01
         mask_sel = jnp.asarray(rng.random(rows) < sel)
-        spc = functools.partial(
-            sparse_partial_aggregate,
-            num_groups=wide,
-            num_min=0,
-            num_max=0,
-            inner_strategy="segment",
-            row_capacity=ROW_CAPACITY,
-        )
+        cap = max(4096, int(rows * sel * 2))
+        fc = jax.jit(functools.partial(compact_rows, capacity=cap))
         try:
             t_compact = _timeit(
                 lambda: jax.block_until_ready(
-                    spc(gid_w, mask_sel, sv, mmv, mmm)
+                    fc(gid_w, mask_sel, sv, mmv, mmm)
                 )
             )
-            # the tier-1 run sorts ROW_CAPACITY slots (not just the 1%
-            # survivors) — subtract the CAPACITY's worth of sort cost or
-            # it leaks into the compact constant.  FLOOR at the scatter
-            # per-row cost: compaction reads at least as much as a
-            # scatter pass, and an over-subtracted near-zero constant
-            # mis-routes large scans onto the sparse path (observed: SF100
-            # q3-class 8s -> 55s when the floor was 1e-6)
-            sorted_rows = min(ROW_CAPACITY, rows)
             cost_per_row_compact = max(
-                (t_compact * 1e6 - sorted_rows * cost_per_row_sparse)
-                / rows,
-                cost_per_row_scatter,
+                t_compact * 1e6 / rows, cost_per_row_scatter
             )
         except Exception:
             pass

@@ -169,7 +169,15 @@ def _kernel_costs(
             if g_c <= cfg.dense_max_groups
             else float("inf"),
         )
-        adaptive = probe + main
+        # probe amortized over repeats: the kept-set cache (the engine's
+        # analog of Druid's bitmap indexes) makes every later execution of
+        # the query a single compact-domain pass, and the OLAP workload
+        # shape this system exists for (dashboards; the reference's result
+        # cache carries the same assumption) repeats queries.  /3 keeps a
+        # one-shot query's worst case bounded at ~1.3x the best
+        # alternative while routing repeat-heavy shapes onto the path
+        # that wins them.
+        adaptive = probe / 3.0 + main
     return (
         ("dense", dense),
         ("segment", scatter),

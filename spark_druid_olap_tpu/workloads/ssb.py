@@ -388,7 +388,8 @@ def register_streamed(ctx, scale: float, seed: int = 7,
     range — date-derived predicates prune via zone maps across the whole
     stream.  `workers` > 0 produces chunks on a fork pool (independent
     deterministic chunk streams make this order-preserving and exact);
-    default from SD_INGEST_WORKERS / core count.  Returns the dimension
+    the default is SERIAL unless SD_INGEST_WORKERS opts in — see
+    ingest_workers() for the fork-safety contract.  Returns the dimension
     tables (for oracle use)."""
     from ..catalog.segment import build_datasource_streamed
 

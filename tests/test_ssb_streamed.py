@@ -98,30 +98,49 @@ def test_gen_tables_unchanged_by_refactor():
     ) == 160_092_057.99
 
 
-def test_parallel_ingest_matches_serial():
+def test_parallel_ingest_matches_serial(tmp_path):
     """workers>0 (fork pool) must register a byte-identical datasource to
-    the serial path: chunk streams are independent deterministic rngs, so
-    parallelism cannot change content or order."""
+    the serial path.  The parallel side runs in a FRESH python child:
+    forking inside this pytest process — whose JAX backend earlier tests
+    initialized — is the documented deadlock hazard ingest_workers()
+    warns about, and would reproduce only intermittently here."""
+    import hashlib
+    import os
+    import subprocess
+    import sys
+
     import numpy as np
 
     import spark_druid_olap_tpu as sd
 
-    ctx_a = sd.TPUOlapContext()
-    ssb.register_streamed(ctx_a, scale=0.02, seed=7, workers=0)
-    ctx_b = sd.TPUOlapContext()
-    ssb.register_streamed(ctx_b, scale=0.02, seed=7, workers=2)
-    a = ctx_a.catalog.get("lineorder")
-    b = ctx_b.catalog.get("lineorder")
-    assert a.num_rows == b.num_rows
-    assert len(a.segments) == len(b.segments)
-    for sa, sb in zip(a.segments, b.segments):
-        assert sa.num_rows == sb.num_rows
-        np.testing.assert_array_equal(np.asarray(sa.time), np.asarray(sb.time))
-        for n in ("c_city", "p_brand1"):
-            np.testing.assert_array_equal(
-                np.asarray(sa.column(n)), np.asarray(sb.column(n))
-            )
-        for n in ("lo_revenue",):
-            np.testing.assert_array_equal(
-                np.asarray(sa.column(n)), np.asarray(sb.column(n))
-            )
+    digest_src = r"""
+import hashlib, sys
+import numpy as np
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.workloads import ssb
+
+ctx = sd.TPUOlapContext()
+ssb.register_streamed(ctx, scale=0.02, seed=7, workers=int(sys.argv[1]))
+ds = ctx.catalog.get("lineorder")
+h = hashlib.sha256()
+h.update(str(ds.num_rows).encode())
+for seg in ds.segments:
+    h.update(str(seg.num_rows).encode())
+    h.update(np.ascontiguousarray(np.asarray(seg.time)).tobytes())
+    for n in ("c_city", "p_brand1", "lo_revenue"):
+        h.update(np.ascontiguousarray(np.asarray(seg.column(n))).tobytes())
+print(h.hexdigest())
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+
+    def run(workers: int) -> str:
+        p = subprocess.run(
+            [sys.executable, "-c", digest_src, str(workers)],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        return p.stdout.strip().splitlines()[-1]
+
+    assert run(0) == run(2)
