@@ -184,7 +184,10 @@ def bench_ssb_streamed(scale: float):
     want = {n: ssb.merge_oracle_parts(parts[n]) for n in ssb.QUERIES}
     del parts
 
-    reps = 2 if scale >= 5 else 3
+    # 3 reps at every scale: median-of-2 is a mean, and a single noisy
+    # rep (this host's memory subsystem has ~2x run-to-run variance)
+    # polluted round-4's first SF100 q4_1 reading by 3x
+    reps = 3
     bw = _stream_bw()
     per_q, tpu_times, ratios, errs = {}, [], [], []
     for name in ssb.QUERIES:
