@@ -216,3 +216,37 @@ def test_adaptive_matches_scatter_bit_for_bit_groups():
     np.testing.assert_array_equal(a_df[["a", "b", "n"]], s_df[["a", "b", "n"]])
     for c in ("s", "lo", "hi"):
         np.testing.assert_allclose(a_df[c], s_df[c], rtol=1e-6)
+
+
+def test_adaptive_inner_kernels_follow_platform_not_static_resolver():
+    """Regression for the round-4 bug class: every adaptive-tier program
+    (presence pass, compact phase B) must pick its kernel from the
+    platform/calibrated model, never the static auto resolver — on CPU
+    the static choice lands on the dense one-hot, a ~200x inversion
+    (measured 49-55s for SF10 passes that run sub-second on scatter)."""
+    import jax
+
+    from spark_druid_olap_tpu.models.filters import And
+
+    if jax.devices()[0].platform != "cpu":
+        import pytest
+
+        pytest.skip("asserts the CPU-side routing")
+    ds, cols = _make_ds()
+    q = _query(
+        filter=And(
+            (InFilter("a", tuple(range(8))), InFilter("b", tuple(range(8))))
+        )
+    )
+    eng = Engine(strategy="adaptive")
+    eng.execute(q, ds)
+    assert eng.last_metrics.strategy == "adaptive"
+    adaptive_keys = [
+        k for k in eng._query_fn_cache if "adaptive" in map(str, k[2:])
+    ]
+    assert adaptive_keys, "adaptive programs should be cached"
+    for k in adaptive_keys:
+        # k[2] is the kernel strategy element for compact phase-B programs
+        assert k[2] != "dense", (
+            f"compact program compiled with the dense one-hot on CPU: {k[2:]}"
+        )
