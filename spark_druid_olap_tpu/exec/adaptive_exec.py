@@ -62,6 +62,29 @@ ADAPTIVE_MAX_COMPACT_GROUPS = 1 << 17
 ADAPTIVE_MIN_SHRINK = 0.5
 
 
+def presence_columns(q, lowering: GroupByLowering, ds=None):
+    """Columns phase A reads: only what the mask + dim codes need —
+    aggregate input columns stay on the host until phase B.  The PHYSICAL
+    time column must survive the cut whenever the lowering fetches it:
+    row_mask reads cols["__time"], which the engines alias from
+    ds.time_column (review r5: dropping it made every interval-scoped
+    query KeyError out of phase A and silently decline adaptive).  Shared
+    by the local mixin and parallel/distributed.py."""
+    from .lowering import _filter_columns
+
+    keep = {"__valid", "__time"}
+    tc = getattr(ds, "time_column", None) if ds is not None else None
+    if tc:
+        keep.add(tc)
+    for d in lowering.dims:
+        keep.add(d.spec.dimension)
+    if q.filter is not None:
+        keep.update(_filter_columns(q.filter))
+    for v in q.virtual_columns:
+        keep.update(v.expression.columns())
+    return [c for c in lowering.columns if c in keep]
+
+
 def compacted_lowering(
     lowering: GroupByLowering, kept: List[np.ndarray]
 ) -> GroupByLowering:
@@ -116,19 +139,8 @@ class AdaptiveDomainMixin:
             # still win (populated << domain), so no filter requirement
         )
 
-    def _presence_columns(self, q, lowering: GroupByLowering):
-        """Phase A reads only what the mask + dim codes need — aggregate
-        input columns stay on the host until phase B."""
-        from .lowering import _filter_columns
-
-        keep = {"__valid", "__time"}
-        for d in lowering.dims:
-            keep.add(d.spec.dimension)
-        if q.filter is not None:
-            keep.update(_filter_columns(q.filter))
-        for v in q.virtual_columns:
-            keep.update(v.expression.columns())
-        return [c for c in lowering.columns if c in keep]
+    def _presence_columns(self, q, lowering: GroupByLowering, ds=None):
+        return presence_columns(q, lowering, ds)
 
     def _adaptive_main_strategy(self, ds: DataSource, g_compact: int) -> str:
         from ..config import SessionConfig
@@ -214,7 +226,7 @@ class AdaptiveDomainMixin:
         qkey = _query_key(q, ds)
         kept = self._adaptive_kept.get(qkey)
         if kept is None:
-            need = self._presence_columns(q, lowering)
+            need = self._presence_columns(q, lowering, ds)
 
             def run_presence():
                 seg_fn = self._presence_program(q, ds, lowering)

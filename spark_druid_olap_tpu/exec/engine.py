@@ -190,6 +190,34 @@ def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
         )
     return out
 
+def segments_in_scope(q, ds: DataSource) -> List[Segment]:
+    """Segment pruning: by time interval (the analog of the reference
+    narrowing the Druid query interval from time predicates, §3.2) and
+    by per-segment zone maps (SURVEY.md §2 metadata "stats" row) —
+    a top-level filter conjunct whose values provably fall outside a
+    segment's [min, max] excludes that segment without a dispatch.
+    Module-level: the distributed engine shares this exact policy for its
+    metrics scope (its shards span the full set; the row mask excludes)."""
+    segs = list(ds.segments)
+    if q.intervals:
+        out = []
+        for s in segs:
+            if s.interval is None:
+                out.append(s)
+                continue
+            lo, hi = s.interval
+            if any(a <= hi and lo < b for a, b in q.intervals):
+                out.append(s)
+        segs = out
+    filt = getattr(q, "filter", None)
+    if filt is not None and segs:
+        vcols = frozenset(
+            v.name for v in getattr(q, "virtual_columns", ()) or ()
+        )
+        segs = _prune_by_stats(segs, filt, ds, vcols)
+    return segs
+
+
 # Above this many in-scope segments a query stops unrolling them into one
 # fused program (compile time grows linearly with the unroll) and falls back
 # to the per-segment dispatch loop.  Below it, the whole query is ONE device
@@ -403,29 +431,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
     # -- groupby -------------------------------------------------------------
 
     def _segments_in_scope(self, q, ds: DataSource) -> List[Segment]:
-        """Segment pruning: by time interval (the analog of the reference
-        narrowing the Druid query interval from time predicates, §3.2) and
-        by per-segment zone maps (SURVEY.md §2 metadata "stats" row) —
-        a top-level filter conjunct whose values provably fall outside a
-        segment's [min, max] excludes that segment without a dispatch."""
-        segs = list(ds.segments)
-        if q.intervals:
-            out = []
-            for s in segs:
-                if s.interval is None:
-                    out.append(s)
-                    continue
-                lo, hi = s.interval
-                if any(a <= hi and lo < b for a, b in q.intervals):
-                    out.append(s)
-            segs = out
-        filt = getattr(q, "filter", None)
-        if filt is not None and segs:
-            vcols = frozenset(
-                v.name for v in getattr(q, "virtual_columns", ()) or ()
-            )
-            segs = _prune_by_stats(segs, filt, ds, vcols)
-        return segs
+        return segments_in_scope(q, ds)
 
     def _partials_for_query(
         self,

@@ -263,6 +263,14 @@ class SparseExecMixin:
                     None,
                 )
                 if new_slots is None:
+                    # an overflowed merge reports max-per-state n_real — a
+                    # LOWER bound (ADVICE r4) — so a bound past the ladder
+                    # top does not prove the true count is: ladder up one
+                    # rung at a time and let the rerun's exact count decide.
+                    new_slots = next(
+                        (s for s in _sg.SLOTS_LADDER if s > slots), None
+                    )
+                if new_slots is None:
                     return host, slots  # beyond the ladder: caller declines
                 self._sparse_slots[qkey] = new_slots
                 log.info(

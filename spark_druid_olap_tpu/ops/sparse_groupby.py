@@ -364,10 +364,16 @@ def merge_sparse_states(
     )
     gids = jnp.where(uniq >= G, jnp.int32(-1), uniq.astype(jnp.int32))
     # distinct-present in the merged state: exact from the unique when it
-    # fit; the a+b upper bound when truncation makes the exact count
-    # unknowable (the rung selector needs >= the truth, never less)
+    # fit.  When truncation makes the exact count unknowable, report
+    # max(a, b) — a LOWER bound.  (ADVICE r4: the a+b upper bound inflated
+    # by up to N over N same-group segments, making the rung selector skip
+    # workable SLOTS_LADDER rungs or decline outright; with a lower bound
+    # the engine ladders up one rung at a time instead — see
+    # exec/sparse_exec.fetch_slot_laddered.)
     exact = jnp.sum((uniq < G).astype(jnp.int32))
-    n_real = jnp.where(overflow, a["n_real"] + b["n_real"], exact)
+    n_real = jnp.where(
+        overflow, jnp.maximum(a["n_real"], b["n_real"]), exact
+    )
     return {
         "gids": gids,
         "sums": sums,

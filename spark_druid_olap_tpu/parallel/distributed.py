@@ -19,6 +19,33 @@ TP-analog): each device matches only its slice of [0, G), shrinking the
 one-hot block and sketch states by the axis size; no collective is needed on
 that axis — outputs stay group-sharded until the host gathers them.
 
+**Kernel ladder (VERDICT r4 #1).**  The per-shard kernel is routed by the
+same calibrated cost model as the single-device engine
+(`plan.cost.choose_query_kernel`) — the round-4 engine hard-coded the dense
+one-hot, which made every high-cardinality SSB query (9 of 13) inexecutable
+on the mesh.  The full ladder now runs SPMD:
+
+* dense / Pallas one-hot  — small G (psum/pmin/pmax merge over ``data``)
+* segment scatter         — large G, dense [Gl, M] state, same collectives
+* sparse sort-compaction  — huge domain, few present: per-device
+  `sparse_partial_aggregate` (slots ladder included), then an
+  `all_gather` + `merge_sparse_states` fold over ``data`` — the broker
+  merge in sparse-state form.  The ``groups`` axis shards the *group-id
+  domain* (each device keeps only gids in its slice), multiplying slot
+  capacity by the axis size.
+* adaptive domain compaction — a distributed phase A measures per-dim
+  presence counts (tiny per-dim GroupBys, psum-merged like any aggregate);
+  the host builds the kept-code LUTs; phase B is the normal SPMD program
+  over the compacted lowering (LUTs broadcast as staged jit constants).
+
+**Durable shard residency (VERDICT r4 #3).**  Row shards are keyed by
+(datasource, column, data-axis size, FULL segment signature) — never by a
+query's pruned segment scope — so assembly is paid once per datasource
+version, like Druid historicals owning their segments across queries.
+Correctness needs no segment exclusion: the row mask (intervals + the full
+filter) already excludes every row interval/zone pruning would have dropped,
+so pruning here only narrows the *metrics* scope.
+
 Long-context analog (SURVEY.md §5): rows are the "sequence" axis.  Blockwise
 partial aggregation over row chunks + ring/allreduce merge of aggregate state
 is the same communication shape ring-attention uses for KV blocks — scaling
@@ -27,7 +54,7 @@ group-by past one chip's HBM without materializing anything global.
 
 from __future__ import annotations
 
-import functools
+import time as _time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -37,16 +64,12 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..catalog.segment import ROW_PAD, DataSource
-from ..models.dimensions import DimensionSpec
 from ..exec.engine import (
     GroupByLowering,
-    _prune_by_stats,
     finalize_groupby,
     finalize_timeseries,
     finalize_topn,
     groupby_with_time_granularity,
-    lower_groupby,
-    schema_signature,
     timeseries_to_groupby,
     topn_to_groupby,
 )
@@ -55,41 +78,81 @@ from ..models import query as Q
 from ..ops import hll as hll_ops
 from ..ops import quantiles as quantiles_ops
 from ..ops import theta as theta_ops
-from ..ops.groupby import choose_block_rows, dense_partial_aggregate
+from ..ops.groupby import (
+    SCATTER_CUTOVER,
+    choose_block_rows,
+    dense_partial_aggregate,
+    partial_aggregate,
+    scatter_partial_aggregate,
+)
+from ..utils.log import get_logger
 from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh
 from .multihost import put_sharded
+
+log = get_logger("parallel.distributed")
+
+_SPARSE_STATE_KEYS = ("gids", "sums", "mins", "maxs")
+_SPARSE_FLAG_KEYS = ("overflow", "row_overflow", "n_rows", "n_real")
 
 
 class DistributedEngine:
     """Executes GroupBy-family queries SPMD over a mesh.
 
-    Row shards are built host-side by concatenating segment columns and
+    Row shards are built host-side by concatenating ALL segment columns and
     padding to a multiple of (mesh data size × ROW_PAD); `jax.device_put`
-    with a NamedSharding places each shard in its device's HBM (streaming /
-    residency caching mirrors the local engine and will move to the async
-    ingest path of catalog/ingest.py)."""
+    with a NamedSharding places each shard in its device's HBM.  Residency
+    is durable across queries (see module docstring)."""
 
     def __init__(
         self,
         mesh: Optional[Mesh] = None,
         shard_cache_bytes: int = 4 << 30,
         program_cache_entries: int = 128,
+        strategy: str = "auto",
     ):
         from ..utils.lru import ByteBudgetCache, CountBudgetCache
 
         self.mesh = mesh if mesh is not None else make_mesh()
+        # "auto" routes by the calibrated cost model; an explicit kernel
+        # class is honored as such, same contract as
+        # exec.engine.Engine(strategy=...).  Validated here: an unknown
+        # string would otherwise fall into the dense one-hot branch — at
+        # high G that is a pathological compile, not an error message
+        if strategy not in (
+            "auto", "dense", "pallas", "segment", "scatter", "sparse",
+            "adaptive",
+        ):
+            raise ValueError(f"unknown groupby strategy {strategy!r}")
+        self.strategy = strategy
         self.last_metrics = None  # observability (exec/metrics.py)
-        # row-shard cache: keyed by the exact segment set the shard was built
-        # from (interval pruning changes the set => different global layout);
-        # LRU under a byte budget (VERDICT r1 weak #7)
+        # row-shard cache: keyed by (ds, column, data-axis, full segment
+        # signature) — durable across queries; LRU under a byte budget
         self._shard_cache = ByteBudgetCache(shard_cache_bytes)
-        # compiled SPMD program cache (query shape x schema x local rows);
-        # without it every execute() re-traces and re-compiles the shard_map
+        # compiled SPMD program cache (query shape x schema x local rows x
+        # strategy); without it every execute() re-traces the shard_map
         self._spmd_cache = CountBudgetCache(program_cache_entries)
         # lowering cache: rebuilding a lowering stages device constants
         # (dictionary remaps, bucket tables) — one blocking H2D per constant
         # on every execution without it (same as exec/engine.py)
         self._lowering_cache = CountBudgetCache(program_cache_entries)
+        # calibrated cost model for kernel routing (loaded once)
+        self._calibrated_cfg = None
+        # kernel-ladder memos, mirroring exec/engine.py Engine.__init__:
+        # adaptive kept-code sets + decline memo, remembered sparse rungs,
+        # and sparse declines (ladder exhausted -> route straight to
+        # scatter on repeats)
+        self._adaptive_kept: Dict = {}
+        self._adaptive_declined: set = set()
+        self._sparse_slots: Dict = {}
+        self._sparse_row_capacity: Dict = {}
+        self._sparse_declined: set = set()
+
+    def _cfg(self):
+        if self._calibrated_cfg is None:
+            from ..config import SessionConfig
+
+            self._calibrated_cfg = SessionConfig.load_calibrated()
+        return self._calibrated_cfg
 
     def _lowering_for(self, q: Q.GroupByQuery, ds: DataSource):
         from ..exec.lowering import cached_lowering
@@ -98,26 +161,17 @@ class DistributedEngine:
 
     # -- host-side row-shard assembly ---------------------------------------
 
-    def _global_columns(
-        self, ds: DataSource, names, intervals, filt=None,
-        vcol_names=frozenset(),
-    ):
+    def _global_columns(self, ds: DataSource, names):
+        """Assemble (or reuse) sharded columns over the FULL segment set.
+
+        Durable residency: the key has no query component, so every query
+        against this datasource version reuses the same placed shards —
+        `shard_assembly_ms` is paid once per (datasource, column), the
+        analog of historicals owning segments across queries (SURVEY.md §2
+        data-parallelism row; VERDICT r4 #3).  A fixed layout also keeps
+        `local_rows` constant, so SPMD programs cache across queries."""
         nd = self.mesh.shape[DATA_AXIS]
         segs = list(ds.segments)
-        if intervals:
-            segs = [
-                s
-                for s in segs
-                if s.interval is None
-                or any(a <= s.interval[1] and s.interval[0] < b
-                       for a, b in intervals)
-            ]
-        if filt is not None and segs:
-            # zone-map pruning, same conservative rules as the local
-            # engine.  NOTE: each distinct pruned set keys its own shard
-            # layout and SPMD compile (the precedent interval pruning set);
-            # the byte-budget LRU bounds residency if filters churn
-            segs = _prune_by_stats(segs, filt, ds, vcol_names)
         total = sum(s.num_rows_padded for s in segs)
         chunk = nd * ROW_PAD
         padded = -(-max(total, 1) // chunk) * chunk
@@ -159,36 +213,63 @@ class DistributedEngine:
         cols["__valid"] = valid
         if ds.time_column and ds.time_column in cols:
             cols["__time"] = cols[ds.time_column]
-        return cols, padded, segs
+        return cols, padded
+
+    def _scope_for_metrics(self, q, ds: DataSource):
+        """Interval + zone-map pruned segment scope — METRICS ONLY (the
+        shards always span the full set; the row mask does the excluding).
+        Shares the local engine's exact pruning policy."""
+        from ..exec.engine import segments_in_scope
+
+        return segments_in_scope(q, ds)
 
     def clear_cache(self):
         self._shard_cache.clear()
         self._lowering_cache.clear()
         self._spmd_cache.clear()
 
-    # -- SPMD program --------------------------------------------------------
+    # -- SPMD programs -------------------------------------------------------
 
-    def _spmd_fn(self, lowering: GroupByLowering, local_rows: int,
-                 ds: DataSource, col_keys: Tuple[str, ...]):
-        """Build (or fetch) the compiled SPMD program for this lowering.
+    def _mesh_key(self) -> Tuple:
+        return tuple(sorted(self.mesh.shape.items()))
 
-        Cached on (query shape, schema signature, local rows, mesh shape):
-        jit's compilation cache is keyed on callable identity, so rebuilding
-        the closure per query would recompile every time."""
+    def _groups_split(self, G: int) -> Tuple[int, int]:
+        """(ng, Gl): group-domain shard count and per-device slice size.
+        The axis must divide G; otherwise groups are replicated."""
+        ng = self.mesh.shape[GROUPS_AXIS]
+        if G % ng:
+            ng = 1
+        return ng, G // max(ng, 1)
+
+    def _spmd_fn(
+        self,
+        lowering: GroupByLowering,
+        local_rows: int,
+        ds: DataSource,
+        col_keys: Tuple[str, ...],
+        strategy: str = "dense",
+        key_extra: Tuple = (),
+    ):
+        """Build (or fetch) the compiled dense-state SPMD program.
+
+        `strategy` routes the per-shard kernel (dense one-hot / Pallas /
+        segment scatter); all produce the same [Gl, M] state, so the
+        psum/pmin/pmax broker merge is strategy-independent.  Cached on
+        (query shape, schema signature, local rows, mesh shape, strategy,
+        key_extra): jit's compilation cache is keyed on callable identity,
+        so rebuilding the closure per query would recompile every time."""
         from ..exec.lowering import _query_key
 
         cache_key = _query_key(lowering.query, ds) + (
             local_rows,
-            tuple(sorted(self.mesh.shape.items())),
-        )
+            self._mesh_key(),
+            strategy,
+        ) + tuple(key_extra)
         if cache_key in self._spmd_cache:
             return self._spmd_cache[cache_key]
         G = lowering.num_groups
         la = lowering.la
-        ng = self.mesh.shape[GROUPS_AXIS]
-        if G % ng:
-            ng = 1  # group axis must divide G; fall back to replicated groups
-        Gl = G // max(ng, 1)
+        ng, Gl = self._groups_split(G)
         num_min, num_max = len(la.min_names), len(la.max_names)
         sketches = list(la.sketch_aggs)
         block = choose_block_rows(local_rows, Gl)
@@ -197,17 +278,34 @@ class DistributedEngine:
         block = max(block, ROW_PAD)
 
         def shard_fn(cols: Dict[str, jax.Array]):
+            cols = lowering.add_virtual(dict(cols))  # sketches read virtuals
             gid, mask, sv, mmv, mmm = lowering.row_arrays(cols)
             if ng > 1:
                 off = lax.axis_index(GROUPS_AXIS).astype(jnp.int32) * Gl
                 gid_l = gid - off  # ids outside [0, Gl) never match the iota
+                if strategy in ("segment", "scatter"):
+                    # scatter indexes the state directly — out-of-slice ids
+                    # must be masked, not merely non-matching
+                    mask = mask & (gid_l >= 0) & (gid_l < Gl)
             else:
                 gid_l = gid
-            sums, mins, maxs = dense_partial_aggregate(
-                gid_l, mask, sv, mmv, mmm,
-                num_groups=Gl, block_rows=block,
-                num_min=num_min, num_max=num_max,
-            )
+            if strategy in ("segment", "scatter"):
+                sums, mins, maxs = scatter_partial_aggregate(
+                    gid_l, mask, sv, mmv, mmm,
+                    num_groups=Gl, num_min=num_min, num_max=num_max,
+                )
+            elif strategy == "pallas":
+                sums, mins, maxs = partial_aggregate(
+                    gid_l, mask, sv, mmv, mmm,
+                    num_groups=Gl, num_min=num_min, num_max=num_max,
+                    strategy="pallas",
+                )
+            else:
+                sums, mins, maxs = dense_partial_aggregate(
+                    gid_l, mask, sv, mmv, mmm,
+                    num_groups=Gl, block_rows=block,
+                    num_min=num_min, num_max=num_max,
+                )
             # broker-merge over the data axis (ICI collectives)
             sums = lax.psum(sums, DATA_AXIS)
             if num_min:
@@ -258,6 +356,166 @@ class DistributedEngine:
         self._spmd_cache[cache_key] = run
         return run
 
+    def _sparse_inner(self) -> str:
+        """Inner kernel over the compacted slots: Pallas one-hot on a TPU
+        backend, scatter elsewhere (same convention as exec/sparse_exec.py;
+        past SPARSE_SLOTS the segmented-reduce tier takes over inside
+        sparse_partial_aggregate regardless)."""
+        from ..ops.pallas_groupby import pallas_available
+
+        return "pallas" if pallas_available() else "segment"
+
+    def _spmd_sparse_fn(
+        self,
+        lowering: GroupByLowering,
+        local_rows: int,
+        ds: DataSource,
+        col_keys: Tuple[str, ...],
+        slots: int,
+        row_capacity: Optional[int],
+    ):
+        """Sparse sort-compaction SPMD program.
+
+        Per device: compact the local shard's surviving rows, aggregate
+        into `slots` sparse slots (one-hot within SPARSE_SLOTS, the
+        segmented-reduce tier above).  Merge over the data axis is an
+        `all_gather` + `merge_sparse_states` fold — the broker merge in
+        sparse-state form.  The groups axis shards the GROUP-ID DOMAIN:
+        each device keeps only gids in its slice (global ids preserved in
+        the state), so the concatenated output holds up to ng × slots
+        distinct groups with disjoint gid sets — finalize_groupby's
+        slot_gids layout handles it unchanged.
+
+        Returns (state, flags): state arrays are [ng*(slots+1), ...]
+        (gids/sums/mins/maxs), flags are [ng] per-slice scalars
+        (overflow / row_overflow / n_rows / n_real)."""
+        from ..exec.lowering import _query_key
+        from ..ops.sparse_groupby import (
+            merge_sparse_states,
+            sparse_partial_aggregate,
+        )
+
+        inner = self._sparse_inner()
+        cache_key = _query_key(lowering.query, ds) + (
+            local_rows,
+            self._mesh_key(),
+            f"sparse:{inner}:{row_capacity}:{slots}",
+        )
+        if cache_key in self._spmd_cache:
+            return self._spmd_cache[cache_key]
+        G = lowering.num_groups
+        la = lowering.la
+        ng, Gl = self._groups_split(G)
+        num_min, num_max = len(la.min_names), len(la.max_names)
+        nd = self.mesh.shape[DATA_AXIS]
+
+        def shard_fn(cols: Dict[str, jax.Array]):
+            gid, mask, sv, mmv, mmm = lowering.row_arrays(dict(cols))
+            if ng > 1:
+                off = lax.axis_index(GROUPS_AXIS).astype(jnp.int32) * Gl
+                mask = mask & (gid >= off) & (gid < off + Gl)
+            st = sparse_partial_aggregate(
+                gid, mask, sv, mmv, mmm,
+                num_groups=G, num_min=num_min, num_max=num_max,
+                slots=slots, inner_strategy=inner,
+                row_capacity=row_capacity,
+            )
+            gathered = jax.tree.map(
+                lambda x: lax.all_gather(x, DATA_AXIS), st
+            )
+            acc = jax.tree.map(lambda x: x[0], gathered)
+            for i in range(1, nd):
+                acc = merge_sparse_states(
+                    acc,
+                    jax.tree.map(lambda x, i=i: x[i], gathered),
+                    num_groups=G,
+                )
+            state = {k: acc[k] for k in _SPARSE_STATE_KEYS}
+            flags = {
+                k: acc[k].reshape(1) for k in _SPARSE_FLAG_KEYS
+            }
+            return state, flags
+
+        specs = {n: P(DATA_AXIS) for n in col_keys}
+        gspec = P(GROUPS_AXIS) if ng > 1 else P()
+        out_spec = (
+            {k: gspec for k in _SPARSE_STATE_KEYS},
+            {k: gspec for k in _SPARSE_FLAG_KEYS},
+        )
+        run = jax.jit(
+            jax.shard_map(
+                shard_fn,
+                mesh=self.mesh,
+                in_specs=(specs,),
+                out_specs=out_spec,
+                check_vma=False,
+            )
+        )
+        self._spmd_cache[cache_key] = run
+        return run
+
+    def _presence_fn(
+        self,
+        lowering: GroupByLowering,
+        local_rows: int,
+        ds: DataSource,
+        col_keys: Tuple[str, ...],
+    ):
+        """Adaptive phase A as an SPMD program: per-dim presence counts
+        under the query's row mask, psum-merged over the data axis like any
+        aggregate (VERDICT r4 #1's prescription).  Output is replicated
+        (cardinality-sized vectors, tiny)."""
+        from ..exec.lowering import _query_key
+        from ..ops.pallas_groupby import pallas_available
+
+        pallas_ok = pallas_available()
+        cache_key = _query_key(lowering.query, ds) + (
+            local_rows,
+            self._mesh_key(),
+            "adaptive-presence",
+            pallas_ok,
+        )
+        if cache_key in self._spmd_cache:
+            return self._spmd_cache[cache_key]
+        # same platform convention as exec/adaptive_exec.py: one-hot
+        # kernels only on a TPU backend, scatter everywhere else (a
+        # cardinality-sized scatter state is cache-resident on CPU)
+        strategies = [
+            "pallas"
+            if pallas_ok and d.cardinality <= SCATTER_CUTOVER
+            else "segment"
+            for d in lowering.dims
+        ]
+
+        def shard_fn(cols: Dict[str, jax.Array]):
+            cols = lowering.add_virtual(dict(cols))
+            mask = lowering.row_mask(cols)
+            ones = mask.astype(jnp.float32)[:, None]
+            zero_mm = jnp.zeros((ones.shape[0], 0), jnp.float32)
+            zero_mmm = jnp.zeros((ones.shape[0], 0), jnp.bool_)
+            per = []
+            for d, strat in zip(lowering.dims, strategies):
+                s, _, _ = partial_aggregate(
+                    d.codes_fn(cols), mask, ones, zero_mm, zero_mmm,
+                    num_groups=d.cardinality, num_min=0, num_max=0,
+                    strategy=strat,
+                )
+                per.append(lax.psum(s[:, 0], DATA_AXIS))
+            return per
+
+        specs = {n: P(DATA_AXIS) for n in col_keys}
+        run = jax.jit(
+            jax.shard_map(
+                shard_fn,
+                mesh=self.mesh,
+                in_specs=(specs,),
+                out_specs=[P() for _ in lowering.dims],
+                check_vma=False,
+            )
+        )
+        self._spmd_cache[cache_key] = run
+        return run
+
     # -- entry points --------------------------------------------------------
 
     def execute(self, q: Q.QuerySpec, ds: DataSource):
@@ -278,9 +536,7 @@ class DistributedEngine:
         except NotImplementedError:
             raise
         except RuntimeError as err:
-            from ..utils.log import get_logger
-
-            get_logger("parallel.distributed").warning(
+            log.warning(
                 "transient device failure (%s: %s); evicting shards and "
                 "re-dispatching once",
                 type(err).__name__,
@@ -290,63 +546,120 @@ class DistributedEngine:
 
             qkey = _query_key(q, ds)
             self._lowering_cache.pop(qkey)
-            # spmd keys are _query_key + (local_rows, mesh): evict only this
-            # query's programs, not every cached query's compile
+            # spmd keys are _query_key + (local_rows, mesh, ...): evict only
+            # this query's programs, not every cached query's compile
             for k in [k for k in self._spmd_cache if k[:2] == qkey]:
                 self._spmd_cache.pop(k)
             for k in [k for k in self._shard_cache if k[0] == ds.name]:
                 self._shard_cache.pop(k)
             return self._execute_groupby_once(q, ds)
 
-    def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
-        import time as _time
+    def _route_strategy(self, q, ds, lowering, qkey) -> str:
+        """Kernel-class choice for this query on the mesh — the identical
+        calibrated model the single-device engine routes by (plan/cost.py),
+        with this engine's decline memos applied."""
+        from ..plan.cost import choose_query_kernel
 
-        from ..config import SessionConfig
+        exclude: List[str] = []
+        if qkey in self._adaptive_declined:
+            exclude.append("adaptive")
+        if qkey in self._sparse_declined:
+            exclude.append("sparse")
+        if self.strategy != "auto" and self.strategy not in exclude:
+            return self.strategy
+        strat = choose_query_kernel(
+            q, ds, lowering.num_groups, self._cfg(), exclude=tuple(exclude)
+        )
+        if strat == "dense":
+            # the cost model's "dense" is a kernel CLASS; the Pallas kernel
+            # is its hand-scheduled TPU implementation (same upgrade rule as
+            # Engine._resolve_strategy, but per-device: the groups axis
+            # shrinks the one-hot domain to Gl)
+            from ..ops.pallas_groupby import pallas_available
+
+            _, Gl = self._groups_split(lowering.num_groups)
+            if Gl <= SCATTER_CUTOVER and pallas_available():
+                return "pallas"
+        return strat
+
+    def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
+        from ..exec.lowering import _query_key
         from ..exec.metrics import QueryMetrics
-        from ..plan.cost import groupby_state_bytes
 
         t_total = _time.perf_counter()
-
         lowering = self._lowering_for(q, ds)
+        qkey = _query_key(q, ds)
+        strategy = self._route_strategy(q, ds, lowering, qkey)
         m = QueryMetrics(
             query_type="groupBy",
-            strategy="dense",
+            strategy=strategy,
             distributed=True,
             mesh_shape=tuple(self.mesh.shape.values()),
             rows_scanned=ds.num_rows,
             segments=len(ds.segments),
             num_groups=lowering.num_groups,
         )
-        t0 = _time.perf_counter()
-        known = len(self._shard_cache)
-        before_bytes = self._shard_cache.bytes_used
-        cols, padded, scope = self._global_columns(
-            ds, lowering.columns, q.intervals, q.filter,
-            frozenset(
-                v.name for v in getattr(q, "virtual_columns", ()) or ()
-            ),
-        )
-        # post-prune counts, matching the local engine's metrics semantics
+        # metrics scope: what pruning WOULD scan (parity with the local
+        # engine's numbers); shards themselves always span the full set
         from ..exec.engine import _bytes_scanned
 
+        scope = self._scope_for_metrics(q, ds)
         m.rows_scanned = sum(sg.num_rows for sg in scope)
         m.bytes_scanned = _bytes_scanned(scope, lowering.columns)
         m.segments = len(scope)
+
+        out = None
+        if strategy == "adaptive":
+            out = self._execute_adaptive(q, ds, lowering, qkey, m)
+            if out is None:  # declined: re-route without the adaptive class
+                strategy = self._route_strategy(q, ds, lowering, qkey)
+                m.strategy = strategy
+        if out is None and strategy == "sparse":
+            out = self._execute_sparse(q, ds, lowering, qkey, m)
+            if out is None:  # ladder exhausted: dense-state scatter
+                strategy = "segment"
+                m.strategy = strategy
+        if out is None:
+            out = self._execute_dense_state(q, ds, lowering, m, strategy)
+        m.total_ms = (_time.perf_counter() - t_total) * 1e3
+        m.bytes_resident = self._shard_cache.bytes_used
+        self.last_metrics = m
+        log.info("%s", m.describe())
+        return out
+
+    def _place_shards(self, ds, columns, m):
+        t0 = _time.perf_counter()
+        known = len(self._shard_cache)
+        before_bytes = self._shard_cache.bytes_used
+        cols, padded = self._global_columns(ds, columns)
         if len(self._shard_cache) > known:  # new shards were placed
-            m.h2d_ms = (_time.perf_counter() - t0) * 1e3
-            m.h2d_bytes = max(
+            m.h2d_ms += (_time.perf_counter() - t0) * 1e3
+            m.h2d_bytes += max(
                 0, self._shard_cache.bytes_used - before_bytes
             )
+        return cols, padded
+
+    def _execute_dense_state(
+        self, q, ds, lowering, m, strategy, key_extra=()
+    ):
+        """The dense-[Gl, M]-state path (dense / Pallas / scatter kernels
+        share it — only the per-shard kernel differs)."""
+        from ..plan.cost import groupby_state_bytes
+
+        cols, padded = self._place_shards(ds, lowering.columns, m)
         local_rows = padded // self.mesh.shape[DATA_AXIS]
         compiled = self._spmd_cache
         key_count = len(compiled)
-        run = self._spmd_fn(lowering, local_rows, ds, tuple(cols.keys()))
+        run = self._spmd_fn(
+            lowering, local_rows, ds, tuple(cols.keys()), strategy,
+            key_extra=key_extra,
+        )
         m.program_cache_hit = len(compiled) == key_count
         nd = self.mesh.shape[DATA_AXIS]
         m.est_collective_ms = (
             2.0 * (nd - 1) / nd
             * groupby_state_bytes(q, lowering.num_groups, None)
-            / SessionConfig().collective_bytes_per_us
+            / self._cfg().collective_bytes_per_us
             / 1e3
         )
         t0 = _time.perf_counter()
@@ -367,8 +680,217 @@ class DistributedEngine:
             np.asarray(maxs),
             {k: np.asarray(v) for k, v in sk.items()},
         )
-        m.finalize_ms = (_time.perf_counter() - t0) * 1e3
-        m.total_ms = (_time.perf_counter() - t_total) * 1e3
-        m.bytes_resident = self._shard_cache.bytes_used
-        self.last_metrics = m
+        m.finalize_ms += (_time.perf_counter() - t0) * 1e3
         return out
+
+    # -- sparse tier ---------------------------------------------------------
+
+    def _initial_row_capacity(
+        self, q, ds, lowering, qkey, local_rows
+    ) -> Optional[int]:
+        """Initial compaction rung from the planner's selectivity estimate
+        with 2x headroom, per DEVICE (the distributed analog of
+        exec/sparse_exec.py's per-segment rung); a remembered rung from a
+        previous overflow wins.  None = full local sort."""
+        from ..ops import sparse_groupby as _sg
+
+        selective = q.filter is not None or bool(q.intervals)
+        if not selective:
+            return None
+        if qkey in self._sparse_row_capacity:
+            return self._sparse_row_capacity[qkey]
+        from ..plan.cost import estimate_selectivity
+
+        sel = (
+            estimate_selectivity(q.filter, ds)
+            if q.filter is not None
+            else 1.0
+        )
+        if sel >= 1.0:
+            return _sg.ROW_CAPACITY
+        need = 2.0 * sel * local_rows
+        return next(
+            (c for c in _sg.ROW_CAPACITY_LADDER if c >= need), None
+        )
+
+    def _execute_sparse(self, q, ds, lowering, qkey, m):
+        """Sparse sort-compaction over the mesh with the full rung ladder
+        (row capacity + slots).  Returns None when the slots ladder is
+        exhausted by an exact count — the caller falls back to the
+        dense-state scatter path, and the decline is remembered."""
+        from ..ops import sparse_groupby as _sg
+
+        if lowering.la.sketch_aggs or not lowering.dims:
+            # sparse states carry no sketch registers and need real dims
+            # (same eligibility as exec/sparse_exec.py); an explicit
+            # strategy="sparse" on such a query falls through to scatter
+            self._sparse_declined.add(qkey)
+            return None
+        cols, padded = self._place_shards(ds, lowering.columns, m)
+        local_rows = padded // self.mesh.shape[DATA_AXIS]
+        cap = self._initial_row_capacity(q, ds, lowering, qkey, local_rows)
+        slots = self._sparse_slots.get(qkey, _sg.SPARSE_SLOTS)
+        compiled = self._spmd_cache
+        key_count = len(compiled)
+        t0 = _time.perf_counter()
+        while True:
+            run = self._spmd_sparse_fn(
+                lowering, local_rows, ds, tuple(cols.keys()), slots, cap
+            )
+            state, flags = jax.device_get(run(cols))
+            if cap is not None and bool(flags["row_overflow"].any()):
+                n = int(flags["n_rows"].max())
+                new_cap = next(
+                    (
+                        c
+                        for c in _sg.ROW_CAPACITY_LADDER
+                        if c >= n and c > cap
+                    ),
+                    None,
+                )
+                self._sparse_row_capacity[qkey] = new_cap
+                log.info(
+                    "mesh sparse row compaction overflowed %d of %d; "
+                    "rerunning at %s",
+                    n, cap,
+                    "full-shard sort" if new_cap is None else new_cap,
+                )
+                cap = new_cap
+                continue
+            if bool(flags["overflow"].any()):
+                n_est = int(flags["n_real"].max())
+                new_slots = next(
+                    (
+                        s
+                        for s in _sg.SLOTS_LADDER
+                        if s >= n_est and s > slots
+                    ),
+                    None,
+                )
+                if new_slots is None:
+                    # n_real can be a lower bound after a truncated merge
+                    # (ADVICE r4): one rung at a time before giving up
+                    new_slots = next(
+                        (s for s in _sg.SLOTS_LADDER if s > slots), None
+                    )
+                if new_slots is None:
+                    log.info(
+                        "mesh sparse slots ladder exhausted at %d (~%d "
+                        "distinct); falling back to scatter (remembered)",
+                        slots, n_est,
+                    )
+                    self._sparse_declined.add(qkey)
+                    return None
+                self._sparse_slots[qkey] = new_slots
+                log.info(
+                    "mesh sparse slots overflowed (~%d distinct > %d); "
+                    "rerunning at %d slots",
+                    n_est, slots, new_slots,
+                )
+                slots = new_slots
+                cap = self._sparse_row_capacity.get(qkey, cap)
+                continue
+            break
+        m.program_cache_hit = len(compiled) == key_count
+        if m.program_cache_hit:
+            m.device_ms = (_time.perf_counter() - t0) * 1e3
+        else:
+            m.compile_ms = (_time.perf_counter() - t0) * 1e3
+        t0 = _time.perf_counter()
+        out = finalize_groupby(
+            q,
+            lowering.dims,
+            lowering.la,
+            np.asarray(state["sums"]),
+            np.asarray(state["mins"]),
+            np.asarray(state["maxs"]),
+            {},
+            slot_gids=np.asarray(state["gids"]),
+        )
+        m.finalize_ms += (_time.perf_counter() - t0) * 1e3
+        return out
+
+    # -- adaptive tier -------------------------------------------------------
+
+    def _execute_adaptive(self, q, ds, lowering, qkey, m):
+        """Adaptive dictionary-domain compaction as a distributed phase A
+        (presence counts psum-merged over the data axis) + the normal SPMD
+        program over the compacted lowering (phase B).  Returns None when
+        declining — the caller re-routes among the remaining classes."""
+        from ..exec.adaptive_exec import (
+            ADAPTIVE_MAX_COMPACT_GROUPS,
+            ADAPTIVE_MIN_SHRINK,
+            compacted_lowering,
+        )
+        from ..exec.lowering import empty_partials
+        from ..plan.cost import choose_kernel_strategy
+
+        kept = self._adaptive_kept.get(qkey)
+        if kept is None:
+            # phase A reads only mask + dim-code columns (the shared
+            # helper keeps the physical time column when intervals need it)
+            from ..exec.adaptive_exec import presence_columns
+
+            need = presence_columns(q, lowering, ds)
+            try:
+                cols, padded = self._place_shards(ds, need, m)
+                local_rows = padded // self.mesh.shape[DATA_AXIS]
+                run = self._presence_fn(
+                    lowering, local_rows, ds, tuple(cols.keys())
+                )
+                counts = jax.device_get(run(cols))
+            except RuntimeError:
+                # transient device failures belong to execute()'s
+                # evict-and-retry path, NOT a permanent decline (review r5)
+                raise
+            except Exception:
+                log.warning(
+                    "mesh adaptive presence pass failed; declining",
+                    exc_info=True,
+                )
+                self._adaptive_declined.add(qkey)
+                return None
+            kept = [
+                np.nonzero(np.asarray(c) > 0)[0].astype(np.int32)
+                for c in counts
+            ]
+            self._adaptive_kept[qkey] = kept
+        Gc = 1
+        for kd in kept:
+            Gc *= len(kd)
+        if Gc > ADAPTIVE_MAX_COMPACT_GROUPS or (
+            Gc > ADAPTIVE_MIN_SHRINK * lowering.num_groups
+        ):
+            log.info(
+                "mesh adaptive compaction declined: G'=%d of G=%d",
+                Gc, lowering.num_groups,
+            )
+            self._adaptive_declined.add(qkey)
+            self._adaptive_kept.pop(qkey, None)
+            return None
+        if any(len(kd) == 0 for kd in kept):
+            # some grouping dim has NO present code under the filter: the
+            # exact result is the empty grouped frame
+            la = lowering.la
+            sums, mins, maxs, sketch_states = empty_partials(la, 0)
+            return finalize_groupby(
+                q, lowering.dims, la,
+                np.asarray(sums), np.asarray(mins), np.asarray(maxs),
+                {k: np.asarray(v) for k, v in sketch_states.items()},
+            )
+        clow = compacted_lowering(lowering, kept)
+        cards = tuple(d.cardinality for d in clow.dims)
+        # phase B kernel from the calibrated model at the COMPACTED
+        # cardinality (the r4 engine bug class: a static resolver's dense
+        # pick is a ~200x inversion on CPU backends)
+        strat = choose_kernel_strategy(ds.num_rows, clow.num_groups, self._cfg())
+        if strat == "dense":
+            from ..ops.pallas_groupby import pallas_available
+
+            _, Gl = self._groups_split(clow.num_groups)
+            if Gl <= SCATTER_CUTOVER and pallas_available():
+                strat = "pallas"
+        m.num_groups = clow.num_groups
+        return self._execute_dense_state(
+            q, ds, clow, m, strat, key_extra=("adaptive",) + cards
+        )

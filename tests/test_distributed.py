@@ -119,8 +119,10 @@ def test_distributed_transient_retry(lineitem_ds):
     calls = {"n": 0}
     orig = DistributedEngine._spmd_fn
 
-    def flaky(self, lowering, local_rows, ds, col_keys):
-        fn = orig(self, lowering, local_rows, ds, col_keys)
+    def flaky(self, lowering, local_rows, ds, col_keys, strategy="dense",
+              key_extra=()):
+        fn = orig(self, lowering, local_rows, ds, col_keys, strategy,
+                  key_extra=key_extra)
         if calls["n"] == 0:
             def poisoned(cols):
                 calls["n"] += 1
@@ -138,3 +140,240 @@ def test_distributed_transient_retry(lineitem_ds):
     want = want.sort_values(key).reset_index(drop=True)
     np.testing.assert_array_equal(got["n"], want["n"])
     np.testing.assert_allclose(got["sum_qty"], want["sum_qty"], rtol=1e-5)
+
+
+# -- kernel ladder on the mesh (VERDICT r4 #1) ------------------------------
+#
+# The distributed engine routes the same four-rung ladder as the local one:
+# dense/Pallas one-hot, segment scatter, sparse sort-compaction (slots
+# ladder included), and adaptive dictionary-domain compaction.  These pin
+# every tier at G >= 500K on the 8-device CPU mesh, with group-domain
+# sharding (groups axis) covered too.
+
+
+def _high_g_ds(n=120_000, da=900, db=900, populated=2_000, seed=3, segs=4,
+               name="hcm"):
+    """Combined domain da*db = 810K (> 500K), few distinct pairs present —
+    the SSB q3_x/q4_x shape that was modelled-only on the round-4 mesh."""
+    from spark_druid_olap_tpu.catalog.segment import (
+        DimensionDict,
+        build_datasource,
+    )
+
+    rng = np.random.default_rng(seed)
+    pairs = rng.choice(da * db, size=populated, replace=False)
+    pick = rng.integers(0, populated, size=n)
+    cols = {
+        "a": (pairs[pick] // db).astype(np.int64),
+        "b": (pairs[pick] % db).astype(np.int64),
+        "v": (rng.random(n) * 100).astype(np.float32),
+    }
+    ds = build_datasource(
+        name,
+        cols,
+        dimension_cols=["a", "b"],
+        metric_cols=["v"],
+        rows_per_segment=n // segs,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+        },
+    )
+    return ds, cols
+
+
+def _high_g_query(name="hcm", filter=None):
+    from spark_druid_olap_tpu.models.aggregations import DoubleMax, DoubleMin
+
+    return GroupByQuery(
+        datasource=name,
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(
+            Count("n"),
+            DoubleSum("s", "v"),
+            DoubleMin("lo", "v"),
+            DoubleMax("hi", "v"),
+        ),
+        filter=filter,
+    )
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
+def test_mesh_sparse_high_cardinality_parity(mesh_shape):
+    """Sparse sort-compaction SPMD at G=810K: per-device compaction,
+    all_gather+merge fold over the data axis, group-domain sharding over
+    the groups axis.  Parity vs the local engine."""
+    ds, _ = _high_g_ds()
+    q = _high_g_query()
+    dist = DistributedEngine(
+        mesh=make_mesh(n_data=mesh_shape[0], n_groups=mesh_shape[1]),
+        strategy="sparse",
+    )
+    got = dist.execute(q, ds)
+    assert dist.last_metrics.strategy == "sparse"
+    want = Engine(strategy="sparse").execute(q, ds)
+    key = ["a", "b"]
+    got = got.sort_values(key).reset_index(drop=True)
+    want = want.sort_values(key).reset_index(drop=True)
+    assert len(got) == len(want) == 2_000
+    np.testing.assert_array_equal(got["n"], want["n"])
+    for c in ("s", "lo", "hi"):
+        np.testing.assert_allclose(got[c], want[c], rtol=2e-5)
+
+
+def test_mesh_sparse_slots_ladder_rungs_up():
+    """More distinct present than SPARSE_SLOTS: the mesh engine reruns on
+    the segmented-reduce rung (slots ladder) instead of failing, and the
+    rung is remembered for repeats."""
+    ds, cols = _high_g_ds(n=90_000, populated=6_000, name="hcm2")
+    q = _high_g_query(name="hcm2")
+    dist = DistributedEngine(mesh=make_mesh(n_data=8), strategy="sparse")
+    got = dist.execute(q, ds)
+    # the DS-level 6000 distinct overflowed the 4096-slot one-hot tier: a
+    # segmented-reduce rung was remembered so repeats skip the base tier
+    from spark_druid_olap_tpu.exec.lowering import (
+        _query_key,
+        groupby_with_time_granularity,
+    )
+
+    qkey = _query_key(groupby_with_time_granularity(q), ds)
+    assert dist._sparse_slots.get(qkey, 0) > 4096
+    import pandas as pd
+
+    df = pd.DataFrame({k: np.asarray(v) for k, v in cols.items()})
+    want = (
+        df.groupby(["a", "b"], as_index=False)
+        .agg(n=("v", "count"), s=("v", "sum"))
+        .sort_values(["a", "b"])
+        .reset_index(drop=True)
+    )
+    got = got.sort_values(["a", "b"]).reset_index(drop=True)
+    assert len(got) == len(want) == 6_000
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    # repeat goes straight through (remembered rung or base tier), parity
+    got2 = dist.execute(q, ds)
+    got2 = got2.sort_values(["a", "b"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got2["n"], want["n"])
+
+
+def test_mesh_adaptive_compaction_parity():
+    """Adaptive domain compaction as a distributed phase A/B: presence
+    counts psum-merge over the data axis, kept-code LUTs broadcast, phase B
+    runs the compact domain.  A selective filter keeps few codes."""
+    ds, cols = _high_g_ds(name="hcm3")
+    keep = list(range(0, 30))
+    from spark_druid_olap_tpu.models.filters import InFilter
+
+    q = _high_g_query(name="hcm3", filter=InFilter("a", tuple(keep)))
+    dist = DistributedEngine(mesh=make_mesh(n_data=8), strategy="adaptive")
+    got = dist.execute(q, ds)
+    assert dist.last_metrics.strategy == "adaptive"
+    # compacted domain engaged: far fewer groups than the full 810K
+    assert dist.last_metrics.num_groups < 100_000
+    mask = np.isin(cols["a"], keep)
+    import pandas as pd
+
+    df = pd.DataFrame({k: np.asarray(v) for k, v in cols.items()})[mask]
+    want = (
+        df.groupby(["a", "b"], as_index=False)
+        .agg(n=("v", "count"), s=("v", "sum"))
+        .sort_values(["a", "b"])
+        .reset_index(drop=True)
+    )
+    got = got.sort_values(["a", "b"]).reset_index(drop=True)
+    assert len(got) == len(want)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    # kept sets cached: repeat skips phase A (still correct)
+    got2 = dist.execute(q, ds).sort_values(["a", "b"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got2["n"], want["n"])
+
+
+def test_mesh_auto_routes_high_g_and_matches_local():
+    """'auto' on the mesh routes by the same calibrated cost model as the
+    local engine — a G=810K query EXECUTES (round 4: modelled-only) and
+    matches the local result, whatever class the platform picks."""
+    ds, _ = _high_g_ds(name="hcm4")
+    q = _high_g_query(name="hcm4")
+    dist = DistributedEngine(mesh=make_mesh(n_data=8))
+    got = dist.execute(q, ds)
+    assert dist.last_metrics.strategy in (
+        "segment", "sparse", "adaptive", "dense", "pallas"
+    )
+    want = Engine().execute(q, ds)
+    key = ["a", "b"]
+    got = got.sort_values(key).reset_index(drop=True)
+    want = want.sort_values(key).reset_index(drop=True)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+
+
+def test_mesh_shard_residency_durable_across_queries():
+    """VERDICT r4 #3: shard assembly is keyed on (datasource, column), not
+    the query's pruned scope — a second, differently-filtered query over
+    the same columns reuses the placed shards (h2d_ms ~ 0)."""
+    ds, _ = _high_g_ds(name="hcm5")
+    dist = DistributedEngine(mesh=make_mesh(n_data=8), strategy="segment")
+    q1 = _high_g_query(name="hcm5")
+    dist.execute(q1, ds)
+    first_h2d = dist.last_metrics.h2d_bytes
+    assert first_h2d > 0  # first touch pays assembly
+    from spark_druid_olap_tpu.models.filters import Selector
+
+    q2 = _high_g_query(name="hcm5", filter=Selector("a", 3))
+    dist.execute(q2, ds)
+    assert dist.last_metrics.h2d_bytes == 0  # durable residency: no re-place
+    assert dist.last_metrics.h2d_ms == 0.0
+
+
+def test_mesh_adaptive_interval_scoped_query():
+    """Review r5 regression: phase A must fetch the PHYSICAL time column —
+    an interval-scoped query used to KeyError out of the presence pass and
+    silently decline adaptive (both engines)."""
+    from spark_druid_olap_tpu.catalog.segment import (
+        DimensionDict,
+        build_datasource,
+    )
+
+    rng = np.random.default_rng(5)
+    n, da, db = 60_000, 900, 900
+    cols = {
+        "a": rng.integers(0, 40, n),  # few present codes: compaction wins
+        "b": rng.integers(0, 40, n),
+        "t": np.sort(rng.integers(0, 1000, n)),
+        "v": np.ones(n, np.float32),
+    }
+    ds = build_datasource(
+        "hcm6", cols, dimension_cols=["a", "b"], metric_cols=["v"],
+        time_col="t", rows_per_segment=30_000,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+        },
+    )
+    q = GroupByQuery(
+        datasource="hcm6",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+        intervals=((0, 500),),
+    )
+    dist = DistributedEngine(mesh=make_mesh(n_data=8), strategy="adaptive")
+    got = dist.execute(q, ds)
+    assert dist.last_metrics.strategy == "adaptive"  # no silent decline
+    import pandas as pd
+
+    df = pd.DataFrame({k: np.asarray(v) for k, v in cols.items()})
+    df = df[df.t < 500]
+    want = (
+        df.groupby(["a", "b"], as_index=False)
+        .agg(n=("v", "count"), s=("v", "sum"))
+        .sort_values(["a", "b"]).reset_index(drop=True)
+    )
+    got = got.sort_values(["a", "b"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    # the local engine too (same shared presence-column helper)
+    eng = Engine(strategy="adaptive")
+    lgot = eng.execute(q, ds).sort_values(["a", "b"]).reset_index(drop=True)
+    assert eng.last_metrics.strategy == "adaptive"
+    np.testing.assert_array_equal(lgot["n"], want["n"])
