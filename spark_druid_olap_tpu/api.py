@@ -389,6 +389,7 @@ class TPUOlapContext:
             executor="device+fallback" if assists["n"] else "fallback",
             rows_scanned=plan_input_rows(lp, self.catalog),
             total_ms=(_time.perf_counter() - t0) * 1e3,
+            assist_subplans=assists["n"],
         )
         return df
 

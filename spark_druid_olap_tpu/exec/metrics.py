@@ -61,6 +61,12 @@ class QueryMetrics:
     total_ms: float = 0.0
     bytes_resident: int = 0
     program_cache_hit: bool = False
+    # fallback observability (ADVICE r4): how many Aggregate subtrees the
+    # host interpreter offloaded to the device engine this query.  Assisted
+    # subtrees accumulate in f32 (vs the interpreter's float64) — rank/
+    # comparison windows over near-ties can order differently; non-zero
+    # here is the flag to check when chasing such a divergence
+    assist_subplans: int = 0
 
     @property
     def rows_per_sec(self) -> float:

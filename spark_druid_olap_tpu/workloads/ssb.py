@@ -361,19 +361,46 @@ def _parallel_sorted_chunks(tables, ad, scale, seed, chunk_rows, workers):
             _PAR_STATE.clear()
 
 
+def _jax_backend_live() -> bool:
+    """True when an XLA backend has already initialized in this process —
+    the state in which forking is the documented deadlock hazard.  Checked
+    WITHOUT initializing a backend (that would defeat the point)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return True  # unknown internals: assume live (the safe side)
+
+
 def ingest_workers() -> int:
     """Worker count for parallel ingest — OPT-IN via SD_INGEST_WORKERS.
 
     Serial by default: the pool uses the fork start method (spawn would
     hang re-importing jax through a wedged accelerator tunnel), and
     forking a process whose JAX runtime threads are already live is a
-    documented deadlock hazard.  Set SD_INGEST_WORKERS>0 only where
-    ingest runs before/without backend initialization (the bench driver
-    does, freshly-started)."""
+    documented deadlock hazard.  Even with SD_INGEST_WORKERS>0, a live
+    backend downgrades to serial with a warning (ADVICE r4: bench's
+    calibrated-context load touches the backend before ingest, so the
+    'runs before initialization' assumption cannot be trusted here)."""
     import os
 
     env = os.environ.get("SD_INGEST_WORKERS")
-    return max(0, int(env)) if env is not None else 0
+    n = max(0, int(env)) if env is not None else 0
+    if n > 0 and _jax_backend_live():
+        from ..utils.log import get_logger
+
+        get_logger("workloads.ssb").warning(
+            "SD_INGEST_WORKERS=%d requested but the JAX backend is already "
+            "initialized in this process; forking now risks deadlock — "
+            "falling back to serial ingest", n,
+        )
+        return 0
+    return n
 
 
 def register_streamed(ctx, scale: float, seed: int = 7,
@@ -395,6 +422,14 @@ def register_streamed(ctx, scale: float, seed: int = 7,
 
     if workers is None:
         workers = ingest_workers()
+    elif workers > 0 and _jax_backend_live():
+        from ..utils.log import get_logger
+
+        get_logger("workloads.ssb").warning(
+            "register_streamed(workers=%d) with a live JAX backend; "
+            "forking now risks deadlock — running serial", workers,
+        )
+        workers = 0
     tables = gen_dim_tables(scale, np.random.default_rng(seed))
     ad = _attr_dicts(tables)
     dicts = {attr: d for attr, (d, _) in ad.items()}

@@ -226,3 +226,52 @@ def test_dense_populated_unfiltered_stays_on_scatter_on_cpu():
                       selectivity=1.0)
     )
     assert costs["segment"] < costs["sparse"]
+
+
+def test_calibration_platform_mismatch_guard(tmp_path):
+    """VERDICT r4 #8: constants measured on a different backend are never
+    applied; strict mode raises instead of warning, and calibration_meta
+    records the provenance either way."""
+    import json
+
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    p = tmp_path / "calibration.json"
+    p.write_text(json.dumps({
+        "device": "TPU_v5e_FAKE_0",
+        "cost_per_row_dense": 123.0,
+        "cost_per_row_scatter": 456.0,
+        "partial": False,
+    }))
+    cfg = SessionConfig.load_calibrated(path=str(p))
+    # mismatched constants NOT applied (platform profile instead)
+    assert cfg.cost_per_row_dense != 123.0
+    assert cfg.calibration_meta["mismatch"] is True
+    assert cfg.calibration_meta["applied"] is False
+    assert cfg.calibration_meta["device"] == "TPU_v5e_FAKE_0"
+    with pytest.raises(RuntimeError, match="measured on"):
+        SessionConfig.load_calibrated(path=str(p), strict_device=True)
+
+
+def test_calibration_meta_applied(tmp_path):
+    """A same-device file applies and says so in calibration_meta."""
+    import json
+
+    import jax
+
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    p = tmp_path / "calibration.json"
+    p.write_text(json.dumps({
+        "device": str(jax.devices()[0]),
+        "cost_per_row_dense": 123.0,
+        "partial": True,
+    }))
+    cfg = SessionConfig.load_calibrated(path=str(p))
+    assert cfg.cost_per_row_dense == 123.0
+    assert cfg.calibration_meta == {
+        "path": str(p),
+        "device": str(jax.devices()[0]),
+        "partial": True,
+        "applied": True,
+    }

@@ -6,16 +6,16 @@
 # short per-step timeouts, re-probes between steps, and keeps looping after
 # a lost window; each step is skipped once its non-degraded artifact exists.
 #
-#   step 1  tools/tpu_smoke.py       -> TPU_SMOKE_r3.json       (~2 min)
-#   step 2  pallas tests, real chip  -> TPU_PALLAS_TESTS_r3.txt (~5 min)
-#   step 3  bench.py calibrate       -> BENCH_tpu_calibrate_r3.json
-#   step 4  bench.py ssb 1           -> BENCH_tpu_ssb1_r3.json
-#   step 5  tpch_q1 topn_hll timeseries cube_theta -> BENCH_tpu_<mode>_r3.json
+#   step 1  tools/tpu_smoke.py       -> TPU_SMOKE_r5.json       (~2 min)
+#   step 2  pallas tests, real chip  -> TPU_PALLAS_TESTS_r5.txt (~5 min)
+#   step 3  bench.py calibrate       -> BENCH_tpu_calibrate_r5.json
+#   step 4  bench.py ssb 1           -> BENCH_tpu_ssb1_r5.json
+#   step 5  tpch_q1 topn_hll timeseries cube_theta -> BENCH_tpu_<mode>_r5.json
 #
 # Run detached:  setsid nohup bash tools/tpu_watch.sh >/tmp/tpu_watch_out.txt 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-LOG=TPU_PROBE_LOG_r3.txt
+LOG=TPU_PROBE_LOG_r5.txt
 INTERVAL=${TPU_WATCH_INTERVAL:-180}
 # grep -c prints "0" AND exits 1 on zero matches — an `|| echo 0` fallback
 # would yield the two-line string "0\n0" and break the arithmetic below
@@ -41,11 +41,11 @@ sys.exit(0 if (not d.get("degraded") and "cpu" not in str(d.get("device", "cpu")
 EOF
 }
 
-smoke_ok() { [ -s TPU_SMOKE_r3.json ] && grep -q '"ok": true' TPU_SMOKE_r3.json \
-             && ! grep -q '"interpret_dryrun": true' TPU_SMOKE_r3.json; }
+smoke_ok() { [ -s TPU_SMOKE_r5.json ] && grep -q '"ok": true' TPU_SMOKE_r5.json \
+             && ! grep -q '"interpret_dryrun": true' TPU_SMOKE_r5.json; }
 
-pallas_ok() { [ -s TPU_PALLAS_TESTS_r3.txt ] && grep -q 'passed' TPU_PALLAS_TESTS_r3.txt \
-              && ! grep -qi 'failed\|error' TPU_PALLAS_TESTS_r3.txt; }
+pallas_ok() { [ -s TPU_PALLAS_TESTS_r5.txt ] && grep -q 'passed' TPU_PALLAS_TESTS_r5.txt \
+              && ! grep -qi 'failed\|error' TPU_PALLAS_TESTS_r5.txt; }
 
 reprobe_alive() {
     P=$(probe)
@@ -65,7 +65,7 @@ run_window() {
     # later ones: a single flaky/broken step must not make the rest of the
     # evidence permanently unreachable.  Only a dead tunnel ends the window.
     if ! smoke_ok; then
-        timeout 300 python tools/tpu_smoke.py TPU_SMOKE_r3.json \
+        timeout 300 python tools/tpu_smoke.py TPU_SMOKE_r5.json \
             >> /tmp/tpu_smoke_out.txt 2>&1
         echo "smoke rc=$? $(ts)" >> "$LOG"
     fi
@@ -73,26 +73,26 @@ run_window() {
     if ! pallas_ok; then
         reprobe_alive || return
         SDOL_TEST_TPU=1 timeout 420 python -m pytest tests/test_pallas_kernel.py -q \
-            > TPU_PALLAS_TESTS_r3.txt.tmp 2>&1 \
-            && mv TPU_PALLAS_TESTS_r3.txt.tmp TPU_PALLAS_TESTS_r3.txt
+            > TPU_PALLAS_TESTS_r5.txt.tmp 2>&1 \
+            && mv TPU_PALLAS_TESTS_r5.txt.tmp TPU_PALLAS_TESTS_r5.txt
         echo "pallas tests rc=$? $(ts)" >> "$LOG"
     fi
 
-    if ! bench_ok BENCH_tpu_ssb1_r3.json; then
+    if ! bench_ok BENCH_tpu_ssb1_r5.json; then
         reprobe_alive || return
         SD_BENCH_TIMEOUT_S=1200 timeout 1300 python bench.py ssb 1 \
-            > BENCH_tpu_ssb1_r3.json.tmp 2>/tmp/tpu_ssb1_err.txt \
-            && mv BENCH_tpu_ssb1_r3.json.tmp BENCH_tpu_ssb1_r3.json
+            > BENCH_tpu_ssb1_r5.json.tmp 2>/tmp/tpu_ssb1_err.txt \
+            && mv BENCH_tpu_ssb1_r5.json.tmp BENCH_tpu_ssb1_r5.json
         echo "bench ssb 1 rc=$? $(ts)" >> "$LOG"
     fi
 
     local mode
     for mode in tpch_q1 topn_hll timeseries cube_theta; do
-        if ! bench_ok "BENCH_tpu_${mode}_r3.json"; then
+        if ! bench_ok "BENCH_tpu_${mode}_r5.json"; then
             reprobe_alive || return
             SD_BENCH_TIMEOUT_S=600 timeout 700 python bench.py "$mode" \
-                > "BENCH_tpu_${mode}_r3.json.tmp" 2>"/tmp/tpu_${mode}_err.txt" \
-                && mv "BENCH_tpu_${mode}_r3.json.tmp" "BENCH_tpu_${mode}_r3.json"
+                > "BENCH_tpu_${mode}_r5.json.tmp" 2>"/tmp/tpu_${mode}_err.txt" \
+                && mv "BENCH_tpu_${mode}_r5.json.tmp" "BENCH_tpu_${mode}_r5.json"
             echo "bench $mode rc=$? $(ts)" >> "$LOG"
         fi
     done
@@ -101,16 +101,16 @@ run_window() {
     # observed over the tunnel) and the least essential evidence.  Needs a
     # long stable window; until one appears every shorter window still
     # captures smoke/pallas/bench evidence above.
-    if ! bench_ok BENCH_tpu_calibrate_r3.json; then
+    if ! bench_ok BENCH_tpu_calibrate_r5.json; then
         reprobe_alive || return
         SD_CALIBRATE_BUDGET_S=1500 SD_BENCH_TIMEOUT_S=1800 timeout 1900 python bench.py calibrate \
-            > BENCH_tpu_calibrate_r3.json.tmp 2>/tmp/tpu_cal_err.txt \
-            && mv BENCH_tpu_calibrate_r3.json.tmp BENCH_tpu_calibrate_r3.json
+            > BENCH_tpu_calibrate_r5.json.tmp 2>/tmp/tpu_cal_err.txt \
+            && mv BENCH_tpu_calibrate_r5.json.tmp BENCH_tpu_calibrate_r5.json
         echo "calibrate rc=$? $(ts)" >> "$LOG"
         # calibration.json is gitignored; preserve TPU constants under a
         # tracked name the session can commit
-        if bench_ok BENCH_tpu_calibrate_r3.json && [ -s calibration.json ]; then
-            cp calibration.json CALIBRATION_tpu_r3.json
+        if bench_ok BENCH_tpu_calibrate_r5.json && [ -s calibration.json ]; then
+            cp calibration.json CALIBRATION_tpu_r5.json
         fi
     fi
 
@@ -124,7 +124,7 @@ all_done() {
     smoke_ok && pallas_ok || return 1
     local m
     for m in calibrate ssb1 tpch_q1 topn_hll timeseries cube_theta; do
-        bench_ok "BENCH_tpu_${m}_r3.json" || return 1
+        bench_ok "BENCH_tpu_${m}_r5.json" || return 1
     done
     return 0
 }
