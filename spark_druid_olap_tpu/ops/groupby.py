@@ -72,6 +72,8 @@ def combine_group_ids(
         G *= int(c)
     gid = None
     for code, card in zip(codes, cards):
+        # width choke point: codes may be STORED at int8/int16
+        # (catalog.segment.code_dtype); every combined gid is int32
         c = jnp.maximum(code.astype(jnp.int32), 0)
         gid = c if gid is None else gid * jnp.int32(card) + c
     if gid is None:
@@ -183,7 +185,9 @@ def scatter_partial_aggregate(
 
     Used when G is too large for one-hot blocks (cost model decision,
     the analog of the reference's cost-model broker-vs-historicals choice)."""
-    seg = jnp.where(mask, gid, num_groups)  # route masked-out rows to a trash slot
+    # no-op guard (producers are int32 today): a narrow gid would wrap on
+    # this trash-slot write, so widen before it
+    seg = jnp.where(mask, gid.astype(jnp.int32), num_groups)
     sums = jax.ops.segment_sum(
         sum_values, seg, num_segments=num_groups + 1
     )[:num_groups]
@@ -235,7 +239,13 @@ def partial_aggregate(
 ):
     """Strategy dispatcher.  'auto' uses the Pallas kernel on TPU (dense
     one-hot in VMEM) up to SCATTER_CUTOVER groups (the XLA dense scan on
-    non-TPU backends), and the scatter/segment path above it."""
+    non-TPU backends), and the scatter/segment path above it.
+
+    Every current producer (combine_group_ids, the lowering codes_fns)
+    already yields int32 gids; the astype below is a free no-op guard so a
+    FUTURE narrow-width producer cannot wrap in trash-slot writes like
+    `where(mask, gid, num_groups)`."""
+    gid = gid.astype(jnp.int32)
     if strategy == "auto":
         strategy = resolve_strategy("auto", num_groups)
     if strategy == "pallas":

@@ -247,6 +247,7 @@ def sparse_partial_aggregate(
     "row_overflow": bool[], "n_rows": i32[] exact survivor count}.
     """
     G = num_groups
+    gid = gid.astype(jnp.int32)  # no-op guard: see partial_aggregate
     row_overflow = jnp.zeros((), jnp.bool_)
     if row_capacity is not None and row_capacity < gid.shape[0]:
         (
