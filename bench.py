@@ -888,6 +888,199 @@ def bench_cube_theta(scale: float):
     }
 
 
+def _assist_ctx(rows: int, mode: str):
+    """Fallback-workload context: mode is "auto" (the platform-aware default
+    threshold — what a user gets), "off" (assist disabled), or "force"
+    (assist at any size — the crossover probe)."""
+    import numpy as np
+    import pandas as pd
+
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0
+    if mode == "off":
+        cfg.device_assist_min_rows = 1 << 62
+    elif mode == "force":
+        cfg.device_assist_min_rows = 1000
+        cfg.device_assist_force = True  # bypass the cost gate: the curve
+        # must MEASURE the losing regimes the gate exists to avoid
+    cfg.fallback_max_rows = 200_000_000
+    ctx = sd.TPUOlapContext(cfg)
+
+    rng = np.random.default_rng(3)
+    n_orders = max(1000, rows // 4)
+    n_parts = max(500, rows // 20)
+    f = pd.DataFrame(
+        {
+            "l_orderkey": rng.integers(0, n_orders, rows),
+            "l_partkey": rng.integers(0, n_parts, rows),
+            "l_quantity": rng.integers(1, 51, rows).astype(np.float64),
+            "l_extendedprice": (rng.random(rows) * 55_000 + 90).round(2),
+            "c_name": np.char.add(
+                "Customer#", (rng.integers(0, n_orders // 8, rows)).astype(str)
+            ),
+            "p_brand": np.char.add(
+                "Brand#", rng.integers(11, 56, rows).astype(str)
+            ),
+            "s_region": rng.choice(
+                ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"], rows
+            ),
+            "p_type": np.char.add(
+                "TYPE#", rng.integers(0, 150, rows).astype(str)
+            ),
+        }
+    )
+    ctx.register_table(
+        "lineitem", f,
+        dimensions=(
+            "l_orderkey", "l_partkey", "c_name", "p_brand",
+            "s_region", "p_type",
+        ),
+        metrics=("l_quantity", "l_extendedprice"),
+    )
+    return ctx
+
+
+ASSIST_QUERIES = {
+    # q2-class: window rank over a grouped frame
+    "q2_window_rank": """
+        SELECT s_region, p_type, mn, rnk FROM
+          (SELECT s_region, p_type, min(l_extendedprice) AS mn,
+                  RANK() OVER (PARTITION BY s_region
+                               ORDER BY min(l_extendedprice)) AS rnk
+           FROM lineitem GROUP BY s_region, p_type) x
+        WHERE rnk = 1 ORDER BY s_region
+    """,
+    # q17-class: correlated scalar AVG per part
+    "q17_correlated_avg": """
+        SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+        FROM lineitem o
+        WHERE l_quantity <
+              (SELECT 0.5 * avg(l_quantity) FROM lineitem
+               WHERE l_partkey = o.l_partkey)
+    """,
+    # q18-class: IN over a grouped HAVING subquery
+    "q18_in_grouped_having": """
+        SELECT c_name, l_orderkey, sum(l_quantity) AS total
+        FROM lineitem
+        WHERE l_orderkey IN
+              (SELECT l_orderkey FROM lineitem
+               GROUP BY l_orderkey HAVING sum(l_quantity) > 180)
+        GROUP BY c_name, l_orderkey
+        ORDER BY total DESC, l_orderkey LIMIT 10
+    """,
+}
+
+
+def bench_assist(rows: int):
+    """Device-assist: never-slower under the DEFAULT auto-threshold, with a
+    committed crossover curve (VERDICT r4 #6 / weak #3).
+
+    Round 4 measured assist with the threshold forced to 1000 rows — a
+    regime the platform-aware default (SessionConfig.apply_platform_profile:
+    8.4M rows on CPU, where engine and interpreter share the silicon) never
+    enters, so min_speedup 0.57 told users nothing about shipped behavior.
+    This mode measures three things:
+
+    1. headline: auto (default threshold) vs assist-off at `rows` — the
+       never-slower guarantee users actually get (>= 1.0 modulo timer noise
+       on this shared host; the paths are IDENTICAL when auto declines).
+    2. crossover curve: host vs FORCED assist at several sizes — the
+       committed evidence for where the threshold should sit on this
+       backend (it must lie above the largest losing size).
+    3. TPU-conditional projection FROM CALIBRATION: the assisted subtree's
+       modelled device time (scan bytes / calibrated stream bandwidth +
+       dispatch) vs the measured host interpreter time — a number, not
+       prose; refreshed automatically when a TPU calibration.json lands.
+    """
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    cfg = SessionConfig.load_calibrated()
+    per_q = {}
+    # 1. the shipped configuration: auto vs off
+    ctxs = {m: _assist_ctx(rows, m) for m in ("auto", "off")}
+    for name, q in ASSIST_QUERIES.items():
+        rec = {}
+        frames = {}
+        for m, ctx in ctxs.items():
+            ctx.sql(q)  # warmup (compiles, decode caches)
+            rec[m + "_ms"] = round(
+                _timed(lambda: frames.__setitem__(m, ctx.sql(q)),
+                       reps=2, warmup=0) * 1e3, 1,
+            )
+        rec["auto_executor"] = ctxs["auto"].last_metrics.executor
+        rec["auto_assist_subplans"] = (
+            ctxs["auto"].last_metrics.assist_subplans
+        )
+        rec["speedup_auto_vs_off"] = round(
+            rec["off_ms"] / max(rec["auto_ms"], 1e-9), 2
+        )
+        rec["parity_rows"] = bool(len(frames["auto"]) == len(frames["off"]))
+        per_q[name] = rec
+    del ctxs
+    min_speedup = min(r["speedup_auto_vs_off"] for r in per_q.values())
+
+    # 2. crossover curve (forced assist vs host at growing sizes)
+    curve = []
+    for n in (rows // 4, rows, rows * 4):
+        cxs = {m: _assist_ctx(n, m) for m in ("off", "force")}
+        pt = {"rows": n}
+        for name, q in ASSIST_QUERIES.items():
+            ts = {}
+            for m, ctx in cxs.items():
+                ctx.sql(q)
+                ts[m] = _timed(lambda: ctx.sql(q), reps=1, warmup=0)
+            pt[name] = round(ts["off"] / max(ts["force"], 1e-9), 2)
+        curve.append(pt)
+        del cxs
+    # the COST GATE (not a row threshold) is the shipped protection: every
+    # query whose FORCED assist lost at the headline size must have been
+    # declined by the gate in the auto run (assist_subplans == 0)
+    headline_pt = next(p for p in curve if p["rows"] == rows)
+    gate_ok = all(
+        per_q[qn]["auto_assist_subplans"] == 0
+        for qn in ASSIST_QUERIES
+        if headline_pt[qn] < 0.95
+    )
+
+    # 3. TPU-conditional projection from calibration constants: the
+    # q18-class aggregate base (sum over ~rows of f32 + int keys)
+    scan_bytes = rows * (4 + 4 + 1)
+    bw = _stream_bw()
+    projection = None
+    if bw:
+        modelled_device_s = scan_bytes / bw + cfg.cost_dispatch_us / 1e6
+        host_s = per_q["q18_in_grouped_having"]["off_ms"] / 1e3
+        projection = {
+            "modelled_subtree_device_s": round(modelled_device_s, 4),
+            "measured_host_interpreter_s": round(host_s, 4),
+            "modelled_speedup_if_assisted": round(
+                host_s / max(modelled_device_s, 1e-9), 1
+            ),
+            "calibration_device": cfg.calibration_meta.get("device")
+            if cfg.calibration_meta
+            else None,
+        }
+    return {
+        "metric": "fallback_assist_auto_min_speedup_%drows" % rows,
+        "value": min_speedup,
+        "unit": "x",
+        # the never-slower bar itself: >= 1.0 means the default threshold
+        # never makes a fallback query slower than assist-off
+        "vs_baseline": min_speedup,
+        "detail": {
+            "rows": rows,
+            "queries": per_q,
+            "crossover_curve": curve,
+            "cost_gate_declined_all_losing_shapes": gate_ok,
+            "tpu_projection": projection,
+            "device": _device(),
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # cost-model calibration (writes calibration.json; SessionConfig.load_calibrated)
 # ---------------------------------------------------------------------------
@@ -916,6 +1109,7 @@ MODES = {
     "ssb": (bench_ssb, 1.0),
     "ssb_mesh": (bench_ssb_mesh, 10.0),
     "sketch_mesh": (bench_sketch_mesh, 1.0),
+    "assist": (bench_assist, 2_000_000),
     "tpch_q1": (bench_tpch_q1, 1.0),
     "topn_hll": (bench_topn_hll, 1.0),
     "timeseries": (bench_timeseries, 12),
@@ -1086,7 +1280,10 @@ def _emit(result, tag):
     detail = result.get("detail") or {}
     # a few small load-bearing summary fields, never the nested per-query
     # maps (strings only when short: the whole point is a bounded line)
-    for k in ("rows", "max_rel_err", "rows_per_sec_per_chip", "ingest_s"):
+    for k in (
+        "rows", "max_rel_err", "rows_per_sec_per_chip", "ingest_s",
+        "ingest_rows_per_sec",
+    ):
         v = detail.get(k)
         if isinstance(v, (int, float)) or (
             isinstance(v, str) and len(v) < 100

@@ -373,17 +373,23 @@ def build_datasource(
         elif d in dicts:
             # caller contract: an integer column WITH a supplied dictionary is
             # already dictionary-encoded (codes), whatever the dict's kind —
-            # the fast path for pre-flattened star datasources (workloads/)
-            codes = arr.astype(np.int32)
+            # the fast path for pre-flattened star datasources (workloads/).
+            # No cast here: the shared narrowing below normalizes the width
+            # (zero-copy when the caller already encodes narrow)
+            codes = arr
         else:
             raw = arr.astype(np.int64)
             uniq = np.unique(raw[raw >= 0]) if len(raw) else raw
             dicts[d] = DimensionDict(values=tuple(int(v) for v in uniq))
             codes = dicts[d].encode_numeric(raw)
         dtype = "long" if dicts[d].numeric_values is not None else "string"
-        encoded[d] = codes.astype(
-            code_dtype(dicts[d].cardinality), copy=False
-        )
+        narrow = codes.astype(code_dtype(dicts[d].cardinality), copy=False)
+        if np.shares_memory(narrow, arr):
+            # pre-encoded caller arrays must never alias into the
+            # (immutable) segments: a later in-place mutation of the
+            # caller's column would silently change query results
+            narrow = narrow.copy()
+        encoded[d] = narrow
         metas.append(
             ColumnMeta(d, "dimension", dtype, cardinality=dicts[d].cardinality)
         )

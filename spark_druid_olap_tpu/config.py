@@ -79,6 +79,24 @@ class SessionConfig:
     # instant anyway AND float64-exact — rank/comparison windows over
     # f32-accumulated device sums could tie differently on tiny frames.
     device_assist_min_rows: int = 1 << 18
+    # Assist decision constants (see api._run_fallback.device_subplan).
+    # cost_per_row_interp: ONE vectorized pandas grouped-agg pass over the
+    # subtree's base (~0.1 us/row measured on this container — NOT the
+    # whole fallback query, which runs several passes).  A deliberate
+    # under-estimate: assist engages only when the modelled engine side
+    # wins 2x (never-slower bar).  cost_per_group_decode: host cost per
+    # RESULT group the assisted path re-pays (dictionary decode + frame
+    # build + downstream interpretation) — this is what makes
+    # G ~ rows/4-shaped subtrees (TPC-H q18) a wash that assist must
+    # decline, while G << rows shapes (q2's rank base) win 15-100x.
+    # Both run on the HOST on every backend, so neither flips with the
+    # device platform.
+    cost_per_row_interp: float = 0.1
+    cost_per_group_decode: float = 1.0
+    # bypass the assist cost gate (row floor still applies): the bench's
+    # crossover probe needs to MEASURE the losing regimes the gate exists
+    # to avoid; not a user knob
+    device_assist_force: bool = False
 
     # cost model (reference: DruidQueryCostModel constants via SQLConf).
     # Units are MICROSECONDS so the constants are physically measurable:
@@ -273,10 +291,14 @@ class SessionConfig:
         # would misprice the distributed-vs-local choice
         self.collective_bytes_per_us = 10_000.0
         self.cost_dispatch_us = 100.0
-        # on CPU the engine and the (vectorized) host interpreter run on
-        # the same silicon: assist only pays once the scan is large
-        # (measured ~wash at 2M rows, clear engine win by ~100M)
-        self.device_assist_min_rows = 1 << 23
+        # small-frame floor only: the COST MODEL now makes the real
+        # assist decision per subtree (api._run_fallback compares the
+        # modelled engine kernel cost at the subtree's G against
+        # rows x cost_per_row_interp).  The r4 blunt 8.4M-row threshold
+        # blocked q2-class subtrees the engine wins 15-100x (tiny G over
+        # a big base) to protect against q18-class losses (G ~ rows/4);
+        # the model separates the two shapes directly.
+        self.device_assist_min_rows = 1 << 18
         return self
 
 

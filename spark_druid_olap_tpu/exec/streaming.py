@@ -189,29 +189,40 @@ class StreamExecutor:
         eng = self.engine
 
         prep = self._prep_fn(ds.time_column, chunk_rows)
+        build_mesh_run = None
         dist_run = None
         if self.mesh is not None:
-            # per-chunk SPMD program shared with DistributedEngine: dense
-            # partials on each device's row shard, psum/pmin/pmax + sketch
-            # merges over ICI, replicated [G, M] state back
+            # per-chunk SPMD program shared with DistributedEngine:
+            # partials on each device's row shard (kernel routed by the
+            # calibrated model AT THE PER-DEVICE SHAPE, same as every
+            # other executor — round 4 hard-coded dense here, which at
+            # high G cannot execute), psum/pmin/pmax + sketch merges over
+            # ICI, replicated [G, M] state back
             from ..parallel.distributed import DistributedEngine
             from ..parallel.mesh import DATA_AXIS
 
             nd = self.mesh.shape[DATA_AXIS]
+            strat = self._stream_strategy(G, chunk_rows // nd)
             dist = DistributedEngine(mesh=self.mesh)
             col_keys = list(need) + ["__valid"]
             if ds.time_column and ds.time_column in need:
                 col_keys.append("__time")
-            dist_run = dist._spmd_fn(
-                lowering, chunk_rows // nd, ds, tuple(col_keys)
-            )
+
+            def build_mesh_run(strategy):
+                return dist._spmd_fn(
+                    lowering, chunk_rows // nd, ds, tuple(col_keys),
+                    strategy=strategy,
+                )
+
+            dist_run = build_mesh_run(strat)
             run = lambda dev, base, nrows: dist_run(prep(dev, base, nrows))
         else:
             # prep (time reconstruction + validity) FUSED into the chunk
             # program: two back-to-back jits materialized a 16 MB int64
             # time column per 2M-row chunk between them (~30 ms/chunk on
             # CPU, measured) that XLA folds away entirely once fused
-            run = self._fused_local_fn(q, ds, lowering, prep)
+            strat = self._stream_strategy(G, chunk_rows)
+            run = self._fused_local_fn(q, ds, lowering, prep, strat)
 
         sums = mins = maxs = None
         sketch_states: Dict[str, jnp.ndarray] = {}
@@ -228,7 +239,7 @@ class StreamExecutor:
                 s, mn, mx, sk = run(dev, base, nrows)
             except Exception:
                 run = self._downgrade_pallas(
-                    q, ds, lowering, prep, dist_run
+                    q, ds, lowering, prep, build_mesh_run, strat
                 )
                 s, mn, mx, sk = run(dev, base, nrows)
             sums = s if sums is None else sums + s
@@ -251,7 +262,41 @@ class StreamExecutor:
             {k: np.asarray(v) for k, v in sketch_states.items()},
         )
 
-    def _fused_local_fn(self, q, ds, lowering, prep):
+    def _stream_strategy(self, G: int, rows_per_dispatch: int) -> str:
+        """Per-dispatch kernel class.  An engine constructed with an
+        explicit strategy is honored through its own resolver (the local
+        and mesh paths agree); "auto" routes through the CALIBRATED model
+        at (rows_per_dispatch, G) — the shape each dispatch actually runs
+        (per-device shard rows on a mesh).  Streaming accumulates dense
+        [G, M] states across chunks, so only the dense-state classes
+        apply: dense/Pallas one-hot or segment scatter.  This is the
+        engine-level rule from the round-4 postmortems: every NEW
+        execution path routes through the calibrated constants, never the
+        static resolver (CPU and TPU invert dense-vs-scatter by ~200x)."""
+        eng = self.engine
+        if eng.strategy != "auto":
+            return eng._resolve_strategy(G)
+        from ..config import SessionConfig
+        from ..plan.cost import choose_kernel_strategy
+
+        cfg = getattr(eng, "_calibrated_cfg", None)
+        if cfg is None:
+            cfg = SessionConfig.load_calibrated()
+            eng._calibrated_cfg = cfg
+        strat = choose_kernel_strategy(rows_per_dispatch, G, cfg)
+        if strat == "dense":
+            from ..ops.groupby import SCATTER_CUTOVER
+            from ..ops.pallas_groupby import pallas_available
+
+            if (
+                G <= SCATTER_CUTOVER
+                and pallas_available()
+                and not eng._pallas_broken
+            ):
+                strat = "pallas"
+        return strat
+
+    def _fused_local_fn(self, q, ds, lowering, prep, strat=None):
         """One jitted program per (query, chunk shape): prep + partial
         aggregation, cached on the engine's program cache so repeats and
         shape-identical streams reuse the compile."""
@@ -261,12 +306,12 @@ class StreamExecutor:
         key = _query_key(q, ds) + (
             "stream-fused",
             prep,  # carries (time_col, chunk_rows) identity
-            eng._resolve_strategy(lowering.num_groups),
+            strat or eng._resolve_strategy(lowering.num_groups),
         )
         cached = eng._query_fn_cache.get(key)
         if cached is not None:
             return cached
-        seg_fn = eng._segment_program(q, ds, lowering)
+        seg_fn = eng._segment_program(q, ds, lowering, strategy_override=strat)
 
         @jax.jit
         def fused(dev, base, nrows):
@@ -275,19 +320,17 @@ class StreamExecutor:
         eng._query_fn_cache[key] = fused
         return fused
 
-    def _downgrade_pallas(self, q, ds, lowering, prep, dist_run):
+    def _downgrade_pallas(
+        self, q, ds, lowering, prep, build_mesh_run, strat
+    ):
         """Mirror Engine._call_segment_program's Mosaic-failure downgrade
-        for the fused streaming program: flag Pallas broken, evict, rebuild
-        on the XLA strategies, and let the retry surface real errors."""
+        for the streaming program (local AND mesh): flag Pallas broken,
+        evict, rebuild on the XLA dense kernel — the same class — and let
+        the retry surface real errors."""
         from ..ops.pallas_groupby import pallas_available
 
         eng = self.engine
-        if (
-            dist_run is not None
-            or eng._pallas_broken
-            or not pallas_available()
-            or eng._resolve_strategy(lowering.num_groups) != "pallas"
-        ):
+        if eng._pallas_broken or not pallas_available() or strat != "pallas":
             raise  # re-raise the active exception: not a Pallas downgrade
         eng._pallas_broken = True
         for k in [
@@ -296,7 +339,10 @@ class StreamExecutor:
             if any("pallas" in str(p) for p in k[2:]) or "stream-fused" in k
         ]:
             eng._query_fn_cache.pop(k)
-        return self._fused_local_fn(q, ds, lowering, prep)
+        if build_mesh_run is not None:
+            fresh = build_mesh_run("dense")
+            return lambda dev, base, nrows: fresh(prep(dev, base, nrows))
+        return self._fused_local_fn(q, ds, lowering, prep, "dense")
 
     # -- chunk plumbing ------------------------------------------------------
 

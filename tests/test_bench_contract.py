@@ -130,3 +130,20 @@ def test_emit_error_shape(capsys, tmp_path, monkeypatch):
     assert len(line) < 2000
     parsed = json.loads(line)
     assert parsed["degraded"] is True and parsed["unit"] == "error"
+
+
+def test_committed_r5_headline_artifacts_follow_contract():
+    """Every committed BENCH_*_r5.json headline must carry the driver's
+    parse keys (VERDICT r4 weak #6: BENCH_assist_r4.json silently broke
+    the contract the same round it was restored elsewhere)."""
+    import glob
+
+    paths = glob.glob(os.path.join(REPO, "BENCH_*_r5.json"))
+    assert paths, "round-5 headline artifacts should exist"
+    for p in paths:
+        with open(p) as f:
+            d = json.load(f)
+        for k in ("metric", "value", "unit", "vs_baseline", "degraded",
+                  "device"):
+            assert k in d, (os.path.basename(p), k)
+        assert isinstance(d["value"], (int, float)), p

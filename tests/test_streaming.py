@@ -258,3 +258,66 @@ def test_stats_track_rows(stream_data, schema_ds):
     assert ex.stats.rows == total
     assert ex.stats.chunks == len(stream_data)
     assert int(got["n"][0]) == total
+
+
+def test_streaming_high_cardinality_routes_off_dense():
+    """Round-5 regression: the streaming executor (local AND mesh) routes
+    per-chunk kernels by the calibrated model — a G=810K grouped stream
+    used to hard-code the dense one-hot on the mesh path (a [B, 810K]
+    one-hot block cannot execute); now it runs via scatter and matches a
+    float64 oracle."""
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from spark_druid_olap_tpu.catalog.segment import (
+        DimensionDict,
+        schema_datasource,
+    )
+    from spark_druid_olap_tpu.exec.streaming import StreamExecutor
+    from spark_druid_olap_tpu.models.aggregations import Count, DoubleSum
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+    from spark_druid_olap_tpu.models.query import GroupByQuery
+    from spark_druid_olap_tpu.parallel.mesh import make_mesh
+
+    da = db = 900  # G = 811,801 with null slots
+    ds = schema_datasource(
+        "hs",
+        {"a": DimensionDict(values=tuple(range(da))),
+         "b": DimensionDict(values=tuple(range(db)))},
+        {"v": "double"},
+    )
+    rng = np.random.default_rng(11)
+    n, chunk = 60_000, 20_480
+    pairs = rng.choice(da * db, size=1500, replace=False)
+    pick = pairs[rng.integers(0, 1500, n)]
+    cols = {
+        "a": (pick // db).astype(np.int32),
+        "b": (pick % db).astype(np.int32),
+        "v": rng.random(n).astype(np.float32),
+    }
+    chunks = [
+        {k: v[i:i + chunk] for k, v in cols.items()}
+        for i in range(0, n, chunk)
+    ]
+    q = GroupByQuery(
+        datasource="hs",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+    )
+    df = pd.DataFrame({k: np.asarray(v) for k, v in cols.items()})
+    want = (
+        df.assign(v=df.v.astype(np.float64))
+        .groupby(["a", "b"], as_index=False)
+        .agg(n=("v", "count"), s=("v", "sum"))
+        .sort_values(["a", "b"]).reset_index(drop=True)
+    )
+    for mesh in (None, make_mesh(n_data=8)):
+        got = (
+            StreamExecutor(mesh=mesh)
+            .execute(q, ds, iter(chunks), chunk)
+            .sort_values(["a", "b"]).reset_index(drop=True)
+        )
+        assert len(got) == len(want), mesh
+        np.testing.assert_array_equal(got["n"], want["n"])
+        np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
