@@ -195,9 +195,9 @@ def code_dtype(cardinality: int) -> np.dtype:
     arithmetic is not the goal — HBM/stream bytes are), and hashing is
     value-preserving across widths (utils/hashing.hash_column sign-extends
     through uint32), so sketches keep bit-parity."""
-    if cardinality <= 127:
+    if cardinality - 1 <= 127:  # stored codes span [-1, cardinality-1]
         return np.dtype(np.int8)
-    if cardinality <= 32767:
+    if cardinality - 1 <= 32767:
         return np.dtype(np.int16)
     return np.dtype(np.int32)
 

@@ -272,3 +272,21 @@ def test_execute_groupby_batch_matches_serial():
         pd.testing.assert_frame_equal(
             w.reset_index(drop=True), g.reset_index(drop=True)
         )
+
+
+def test_code_dtype_boundaries():
+    """Narrow-code width selection holds codes [-1, card) exactly at the
+    signed-dtype boundaries: max stored code is card-1, so card=128 still
+    fits int8 and card=32768 still fits int16."""
+    from spark_druid_olap_tpu.catalog.segment import code_dtype
+
+    assert code_dtype(1) == np.int8
+    assert code_dtype(128) == np.int8
+    assert code_dtype(129) == np.int16
+    assert code_dtype(32768) == np.int16
+    assert code_dtype(32769) == np.int32
+    assert code_dtype(5_000_000) == np.int32
+    for card in (128, 129, 32768, 32769):
+        dt = code_dtype(card)
+        assert np.array(-1, dt) == -1
+        assert int(np.array(card - 1, dt)) == card - 1
