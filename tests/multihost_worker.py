@@ -103,6 +103,44 @@ def main():
         ]
         for _, r in out2.iterrows()
     )
+
+    # round-5: the HIGH-CARDINALITY sparse tier across the real process
+    # boundary — per-device sort-compaction, then the all_gather +
+    # merge_sparse_states fold rides the DCNxICI collectives (the same
+    # data-axis merge the dense psum above crosses).  rng draws stay in
+    # lockstep with the parent's replay (g, v, ksk, lat, THEN these).
+    from spark_druid_olap_tpu.catalog.segment import DimensionDict
+
+    da = db = 300  # combined domain 90K >> SPARSE_SLOTS
+    pairs = rng.choice(da * db, size=800, replace=False)
+    pick = pairs[rng.integers(0, 800, n)]
+    ds3 = build_datasource(
+        "mhhc",
+        {
+            "a": (pick // db).astype(np.int64),
+            "b": (pick % db).astype(np.int64),
+            "v": v,
+        },
+        dimension_cols=["a", "b"], metric_cols=["v"],
+        rows_per_segment=2048,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+        },
+    )
+    q3 = GroupByQuery(
+        datasource="mhhc",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+    )
+    sp_eng = DistributedEngine(mesh=mesh, strategy="sparse")
+    out3 = sp_eng.execute(q3, ds3)
+    assert sp_eng.last_metrics.strategy == "sparse"
+    res["sparse_rows"] = sorted(
+        [str(r["a"]), str(r["b"]), int(r["n"]), round(float(r["s"]), 4)]
+        for _, r in out3.iterrows()
+    )
+
     with open(outpath, "w") as f:
         json.dump(res, f)
     print("WORKER_OK", pid)
