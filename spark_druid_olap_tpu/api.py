@@ -579,6 +579,10 @@ class TPUOlapContext:
                     self._dist_engine = DistributedEngine(
                         mesh=make_mesh(*phys.mesh_shape)
                     )
+                # route mesh kernels by the SESSION's cost constants, not
+                # a fresh file load — re-synced EVERY call (same as the
+                # local engine below) so a replaced ctx.config is honored
+                self._dist_engine._calibrated_cfg = self.config
                 return self._dist_engine
         # the engine's adaptive tier picks its compact-domain kernel from
         # the session's cost constants, not a fresh file load

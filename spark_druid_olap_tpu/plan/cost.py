@@ -255,7 +255,11 @@ def choose_kernel_strategy(
 
 
 def query_kernel_costs(
-    q: Q.QuerySpec, ds: DataSource, num_groups: int, cfg: SessionConfig
+    q: Q.QuerySpec,
+    ds: DataSource,
+    num_groups: int,
+    cfg: SessionConfig,
+    selectivity: Optional[float] = None,
 ) -> dict:
     """strategy -> modelled microseconds for a PLANNED query over `ds`: the
     kernel half of `choose_physical`, factored out so the distributed and
@@ -285,7 +289,11 @@ def query_kernel_costs(
     n_segments = (
         len(segs) if segs is not None else max(1, rows // (1 << 22))
     )
-    sel = estimate_selectivity(getattr(q, "filter", None), ds)
+    sel = (
+        selectivity
+        if selectivity is not None
+        else estimate_selectivity(getattr(q, "filter", None), ds)
+    )
     return dict(
         _kernel_costs(
             rows, num_groups, cfg, sparse_ok,
@@ -346,35 +354,64 @@ def choose_physical(
     # kernel-class eligibility + costs shared with every executor
     # (query_kernel_costs); adaptive compaction re-keys sketch states
     # transparently (the compact program IS the normal program over a
-    # rewritten lowering), so sketches do not disqualify it there
-    costs = query_kernel_costs(q, ds, num_groups, cfg)
+    # rewritten lowering), so sketches do not disqualify it there.  The
+    # selectivity tree walk runs ONCE and feeds both the local and the
+    # per-device cost evaluations below.
+    sel = estimate_selectivity(getattr(q, "filter", None), ds)
+    costs = query_kernel_costs(q, ds, num_groups, cfg, selectivity=sel)
     strategy = choose_query_kernel(q, ds, num_groups, cfg, costs=costs)
     local_cost = costs[strategy]
 
-    # distributed target: only the dense GroupBy-family path runs SPMD
-    # (parallel/distributed.py); scans and the scatter/sparse strategies are
-    # single-device by construction
+    # distributed target: since round 5 the FULL kernel ladder runs SPMD
+    # (parallel/distributed.py routes dense/scatter/sparse/adaptive per
+    # shard), so every GroupBy-family strategy is mesh-eligible; scans
+    # stay single-device by construction
     aggregate_family = isinstance(
         q, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
     )
     distributed = False
     mesh_shape = None
     dist_cost = local_cost
-    if n_devices > 1 and aggregate_family and strategy == "dense":
+    if n_devices > 1 and aggregate_family:
         ng = max(1, cfg.mesh_groups_axis)
         nd = cfg.mesh_data_axis or max(1, n_devices // ng)
         nd = min(nd, max(1, n_devices // ng))
         # rows shard over the data axis (replicated across the groups axis);
-        # the groups axis shards the one-hot block, shrinking per-device G
+        # the groups axis shards the group-id domain, shrinking per-device G
+        # for the one-hot block, the sketch states, AND the sparse slot
+        # capacity alike.  Per-shard compute comes from the SAME model at
+        # the per-device shape for every class (no duplicated formulas).
         per_device_groups = -(-num_groups // ng)
-        compute = (
-            rows / nd * cfg.cost_per_row_dense * _g_tiles(per_device_groups)
-        )
-        state_bytes = groupby_state_bytes(q, per_device_groups, cfg)
-        # ring allreduce over the data axis moves ~2*(nd-1)/nd of the state
+        compute = dict(
+            _kernel_costs(
+                max(1, rows // nd), per_device_groups, cfg,
+                sparse_ok=strategy == "sparse",
+                selectivity=sel,
+                n_segments=1,  # one shard per device
+                adaptive_ok=strategy == "adaptive",
+                ndims=max(1, len(getattr(q, "dimensions", ()) or ())),
+            )
+        )[strategy]
+        # collective bytes are what the merge ACTUALLY moves: the dense/
+        # scatter rungs allreduce the full [Gl, M] state, but the sparse
+        # rung all_gathers only slot-compacted state and adaptive merges
+        # the compacted domain — both bounded by the POPULATED group count
+        # (~ G x selectivity), not the domain (pricing the full domain
+        # silently kept exactly the high-G queries the mesh ladder exists
+        # for off the mesh)
+        if strategy in ("sparse", "adaptive"):
+            g_eff = max(
+                1, min(per_device_groups, round(num_groups * sel))
+            )
+            state_bytes = groupby_state_bytes(q, g_eff, cfg)
+            # all_gather moves ~(nd-1) x one device's state
+            factor = float(nd - 1)
+        else:
+            state_bytes = groupby_state_bytes(q, per_device_groups, cfg)
+            # ring allreduce moves ~2*(nd-1)/nd of the state
+            factor = 2.0 * (nd - 1) / nd
         collective = (
-            2.0 * (nd - 1) / nd * state_bytes
-            / max(cfg.collective_bytes_per_us, 1e-9)
+            factor * state_bytes / max(cfg.collective_bytes_per_us, 1e-9)
         )
         dist_cost = compute + collective + cfg.cost_dispatch_us
         distributed = cfg.prefer_distributed and (

@@ -95,10 +95,25 @@ def test_big_sketch_state_stays_local():
     assert not p.distributed
 
 
-def test_segment_strategy_never_distributed():
+def test_high_g_strategies_are_mesh_eligible():
+    """Rounds 1-4 pinned 'non-dense never distributes' because the mesh
+    engine only had the dense rung; round 5's distributed ladder makes
+    every GroupBy-family strategy mesh-eligible — the choice is purely
+    cost-based and the plan stays well-formed either way."""
     cfg = SessionConfig()
-    p = choose_physical(_gb(), _FakeDS(500_000_000), cfg.dense_max_groups * 2, cfg, 8)
-    assert p.strategy in ("segment", "sparse") and not p.distributed
+    p = choose_physical(
+        _gb(), _FakeDS(500_000_000), cfg.dense_max_groups * 2, cfg, 8
+    )
+    assert p.strategy in ("segment", "sparse", "adaptive")
+    if p.distributed:
+        assert p.mesh_shape is not None
+        assert p.est_cost_dist <= p.est_cost_local
+    # and with distribution preferred off, it must stay local
+    cfg2 = SessionConfig(prefer_distributed=False)
+    p2 = choose_physical(
+        _gb(), _FakeDS(500_000_000), cfg.dense_max_groups * 2, cfg2, 8
+    )
+    assert not p2.distributed
 
 
 def test_scan_never_distributed():

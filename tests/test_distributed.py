@@ -377,3 +377,131 @@ def test_mesh_adaptive_interval_scoped_query():
     lgot = eng.execute(q, ds).sort_values(["a", "b"]).reset_index(drop=True)
     assert eng.last_metrics.strategy == "adaptive"
     np.testing.assert_array_equal(lgot["n"], want["n"])
+
+
+def test_mesh_adaptive_rekeys_sketches():
+    """Adaptive phase B re-keys SKETCH states through the compacted domain
+    (the compact program IS the normal SPMD program over a rewritten
+    lowering) — HLL estimates must match the local engine's adaptive path
+    exactly on both mesh shapes."""
+    from spark_druid_olap_tpu.catalog.segment import (
+        DimensionDict,
+        build_datasource,
+    )
+    from spark_druid_olap_tpu.models.aggregations import HyperUnique
+    from spark_druid_olap_tpu.models.filters import InFilter
+
+    rng = np.random.default_rng(7)
+    n, da, db = 100_000, 900, 900
+    pairs = rng.choice(da * db, size=1500, replace=False)
+    pick = pairs[rng.integers(0, 1500, n)]
+    cols = {
+        "a": (pick // db).astype(np.int64),
+        "b": (pick % db).astype(np.int64),
+        "k": rng.integers(0, 5000, n).astype(np.int64),
+        "v": rng.random(n).astype(np.float32),
+    }
+    ds = build_datasource(
+        "hcsk", cols, dimension_cols=["a", "b"], metric_cols=["v", "k"],
+        rows_per_segment=25_000,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+        },
+    )
+    q = GroupByQuery(
+        datasource="hcsk",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(
+            Count("n"),
+            DoubleSum("s", "v"),
+            HyperUnique("u", "k"),
+        ),
+        filter=InFilter("a", tuple(range(0, 40))),
+    )
+    want = Engine(strategy="adaptive").execute(q, ds)
+    for shape in ((8, 1), (4, 2)):
+        dist = DistributedEngine(
+            mesh=make_mesh(n_data=shape[0], n_groups=shape[1]),
+            strategy="adaptive",
+        )
+        got = dist.execute(q, ds)
+        assert dist.last_metrics.strategy == "adaptive", shape
+        key = ["a", "b"]
+        g = got.sort_values(key).reset_index(drop=True)
+        w = want.sort_values(key).reset_index(drop=True)
+        np.testing.assert_array_equal(g["n"], w["n"])
+        # HLL registers merge by max: estimates are deterministic integers
+        np.testing.assert_array_equal(
+            g["u"].astype(np.int64), w["u"].astype(np.int64)
+        )
+        np.testing.assert_allclose(g["s"], w["s"], rtol=2e-5)
+
+
+def test_mesh_sparse_filtered_aggs_and_minmax():
+    """Per-agg FILTER masks and min/max identities survive the sparse
+    mesh path's compaction + cross-device merge fold."""
+    from spark_druid_olap_tpu.catalog.segment import (
+        DimensionDict,
+        build_datasource,
+    )
+    from spark_druid_olap_tpu.models.aggregations import (
+        DoubleMax,
+        DoubleMin,
+        FilteredAgg,
+    )
+    from spark_druid_olap_tpu.models.filters import Selector
+
+    rng = np.random.default_rng(13)
+    n, da, db = 80_000, 700, 700
+    pairs = rng.choice(da * db, size=900, replace=False)
+    pick = pairs[rng.integers(0, 900, n)]
+    cols = {
+        "a": (pick // db).astype(np.int64),
+        "b": (pick % db).astype(np.int64),
+        "flag": rng.integers(0, 3, n).astype(np.int64),
+        "v": (rng.random(n) * 50).astype(np.float32),
+    }
+    ds = build_datasource(
+        "hcfa", cols, dimension_cols=["a", "b", "flag"],
+        metric_cols=["v"], rows_per_segment=20_000,
+        dicts={
+            "a": DimensionDict(values=tuple(range(da))),
+            "b": DimensionDict(values=tuple(range(db))),
+            "flag": DimensionDict(values=(0, 1, 2)),
+        },
+    )
+    q = GroupByQuery(
+        datasource="hcfa",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(
+            Count("n"),
+            FilteredAgg(Selector("flag", 1), DoubleSum("s1", "v")),
+            DoubleMin("lo", "v"),
+            DoubleMax("hi", "v"),
+        ),
+    )
+    dist = DistributedEngine(mesh=make_mesh(n_data=8), strategy="sparse")
+    got = dist.execute(q, ds)
+    assert dist.last_metrics.strategy == "sparse"
+    import pandas as pd
+
+    df = pd.DataFrame({k: np.asarray(x) for k, x in cols.items()})
+    df["v64"] = df.v.astype(np.float64)
+    want = df.groupby(["a", "b"], as_index=False).agg(
+        n=("v64", "count"), lo=("v64", "min"), hi=("v64", "max")
+    )
+    s1 = (
+        df[df.flag == 1].groupby(["a", "b"])["v64"].sum()
+        .reindex(list(zip(want.a, want.b)), fill_value=0.0)
+        .to_numpy()
+    )
+    got = got.sort_values(["a", "b"]).reset_index(drop=True)
+    want = want.sort_values(["a", "b"]).reset_index(drop=True)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["lo"], want["lo"], rtol=1e-6)
+    np.testing.assert_allclose(got["hi"], want["hi"], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.nan_to_num(got["s1"].to_numpy(np.float64)), s1, rtol=2e-5,
+        atol=1e-9,
+    )
