@@ -380,7 +380,21 @@ class TPUOlapContext:
                         return None
                 else:
                     ds = self.catalog.get(rw.datasource)
-                    G = lower_groupby(rw.query, ds).num_groups
+                    lowering = lower_groupby(rw.query, ds)
+                    G = lowering.num_groups
+                    # h2d: columns of the subtree's base not yet resident in
+                    # the engine's device cache must cross the host->device
+                    # link first.  Negligible locally; decisive over a thin
+                    # link (the round-5 tunnel measured 46 MB/s — cold data
+                    # costs ~22 s/GB there).  Amortized /3 like the adaptive
+                    # probe: the cache keeps columns warm across the repeat
+                    # queries this workload shape is built around.
+                    h2d_us = (
+                        self.engine.missing_resident_bytes(
+                            ds, lowering.columns
+                        )
+                        / self.config.h2d_bytes_per_s * 1e6
+                    )
                     assist_us = (
                         min(
                             query_kernel_costs(
@@ -388,6 +402,7 @@ class TPUOlapContext:
                             ).values()
                         )
                         + self.config.cost_dispatch_us
+                        + h2d_us / 3.0
                         # the assisted path re-pays host work PER RESULT
                         # GROUP (decode, frame build, downstream
                         # interpretation)

@@ -134,6 +134,11 @@ class SessionConfig:
     collective_bytes_per_us: float = 40_000.0
     # fixed overhead of one SPMD dispatch + multi-device host gather, us
     cost_dispatch_us: float = 300.0
+    # host->device transfer bandwidth, bytes/s.  Default is PCIe-class;
+    # calibration measures the real link (the round-5 tunneled chip: 46
+    # MB/s, 300x below PCIe — the constant that decides whether shipping a
+    # fallback subtree's base to the device can ever pay for itself)
+    h2d_bytes_per_s: float = 1e10
 
     # result guards (reference: maxCardinality / maxResultCardinality)
     max_result_cardinality: int = 1 << 22
@@ -243,6 +248,7 @@ class SessionConfig:
                 "cost_per_group_state",
                 "collective_bytes_per_us",
                 "cost_dispatch_us",
+                "h2d_bytes_per_s",
             ):
                 if k in data and data[k] is not None and data[k] > 0:
                     setattr(cfg, k, float(data[k]))
@@ -291,6 +297,8 @@ class SessionConfig:
         # would misprice the distributed-vs-local choice
         self.collective_bytes_per_us = 10_000.0
         self.cost_dispatch_us = 100.0
+        # "h2d" on CPU is a memcpy into the runtime's buffer
+        self.h2d_bytes_per_s = 2e10
         # small-frame floor only: the COST MODEL now makes the real
         # assist decision per subtree (api._run_fallback compares the
         # modelled engine kernel cost at the subtree's G against

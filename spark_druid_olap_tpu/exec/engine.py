@@ -360,6 +360,21 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         """HBM bytes held by the segment residency cache."""
         return self._device_cache.bytes_used
 
+    def missing_resident_bytes(self, ds, cols) -> int:
+        """Estimated bytes a query over `cols` would have to move
+        host->device before executing — 0 when everything is already
+        resident.  Owns the cache-key scheme AND the buffer set
+        (_device_cols: per-segment columns plus the validity buffer) so
+        planner-side h2d costing (api device-assist) never re-encodes
+        either.  4 bytes/row/buffer: codes are <=4 B, metric values f32."""
+        need = list(cols) + ["__valid"]
+        return sum(
+            4 * seg.num_rows
+            for seg in ds.segments
+            for c in need
+            if (seg.uid, c) not in self._device_cache
+        )
+
     def clear_cache(self):
         """Analog of the reference's metadata/cache clear command.  Drops the
         program cache too: compiled programs close over their lowering's

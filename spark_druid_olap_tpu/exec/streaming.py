@@ -65,6 +65,10 @@ class StreamStats:
     normalize_s: float = 0.0
     put_s: float = 0.0
     dispatch_s: float = 0.0
+    # bytes actually shipped host->device (post-normalization dtypes), so
+    # consumers can bound throughput by the measured link rate instead of
+    # guessing a bytes/row layout
+    h2d_bytes: int = 0
 
     def to_dict(self):
         return {
@@ -73,6 +77,7 @@ class StreamStats:
             "normalize_s": round(self.normalize_s, 3),
             "put_s": round(self.put_s, 3),
             "dispatch_s": round(self.dispatch_s, 3),
+            "h2d_bytes": self.h2d_bytes,
         }
 
 
@@ -465,6 +470,9 @@ class StreamExecutor:
                     k: jax.device_put(v, sharding) for k, v in item.items()
                 }
                 self.stats.put_s += _time.perf_counter() - t0
+                self.stats.h2d_bytes += sum(
+                    v.nbytes for v in item.values() if hasattr(v, "nbytes")
+                )
                 self.stats.rows += int(rows)
                 yield dev, base, np.int32(rows)
         finally:

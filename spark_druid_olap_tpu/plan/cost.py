@@ -176,8 +176,11 @@ def _kernel_costs(
         # cache carries the same assumption) repeats queries.  /3 keeps a
         # one-shot query's worst case bounded at ~1.3x the best
         # alternative while routing repeat-heavy shapes onto the path
-        # that wins them.
-        adaptive = probe / 3.0 + main
+        # that wins them.  The phase-A probe is also a SEPARATE dispatch;
+        # its fixed overhead (66 ms on the round-5 tunneled chip, where it
+        # rivals a whole scatter pass) amortizes with the probe itself —
+        # the kept-set cache skips phase A on repeats.
+        adaptive = (probe + cfg.cost_dispatch_us) / 3.0 + main
     return (
         ("dense", dense),
         ("segment", scatter),
