@@ -102,11 +102,15 @@ def _ensure_calibration():
             # round-5 slope-based methodology — earlier files measured
             # through a sync that the tunneled backend did not honor and
             # carry constants off by orders of magnitude) -> reuse
+            # .get() truthiness, not key presence: a budget-truncated sweep
+            # saves null for the constants it never reached, and reusing
+            # such a file forever would leave e.g. a 46 MB/s link priced
+            # at the 1e10 B/s profile default
             if (
                 cal.get("device") == dev
-                and "stream_bytes_per_s" in cal
-                and "cost_per_row_compact" in cal
-                and "h2d_bytes_per_s" in cal
+                and cal.get("stream_bytes_per_s")
+                and cal.get("cost_per_row_compact")
+                and cal.get("h2d_bytes_per_s")
             ):
                 return
         # bounded: over a flaky tunneled accelerator a full sweep ran

@@ -389,11 +389,19 @@ class TPUOlapContext:
                     # costs ~22 s/GB there).  Amortized /3 like the adaptive
                     # probe: the cache keeps columns warm across the repeat
                     # queries this workload shape is built around.
-                    h2d_us = (
-                        self.engine.missing_resident_bytes(
+                    phys = rw.physical
+                    if phys.distributed and phys.mesh_shape is not None:
+                        # mesh execution: the DistributedEngine's shard
+                        # residency is not visible here — price the
+                        # transfer fully cold (conservative: borderline
+                        # assists decline, the never-slower direction)
+                        miss_bytes = 4 * (len(lowering.columns) + 1) * rows
+                    else:
+                        miss_bytes = self.engine.missing_resident_bytes(
                             ds, lowering.columns
                         )
-                        / self.config.h2d_bytes_per_s * 1e6
+                    h2d_us = (
+                        miss_bytes / self.config.h2d_bytes_per_s * 1e6
                     )
                     assist_us = (
                         min(

@@ -14,15 +14,16 @@ normal aggregation over the compacted domain:
            mask — a tiny GroupBy per dimension (cardinality-sized states,
            one data read for all dims), merged across segments.
   host     kept_d = codes with count > 0;  G' = prod(|kept_d|).  If G' is
-           small enough, build LUT_d: code -> compact code (-1 = absent).
+           small enough, build the remap code -> compact code (-1 = absent).
   phase B  the UNMODIFIED segment program machinery over a *compacted
            lowering*: same query, same aggs (sketches included), dims
-           rewritten to gather through LUT_d with cardinality |kept_d| —
+           rewritten through the remap (identity / unrolled compare-select
+           chain / LUT gather by kept-set size — see compacted_lowering) —
            so the kernel runs dense/Pallas at G' instead of scatter at G.
 
 Soundness: presence is computed under exactly the row mask phase B applies,
 so every masked-in row's codes are in kept_d by construction; a -1 from the
-LUT can only occur on rows the mask already excludes (combine_group_ids
+remap can only occur on rows the mask already excludes (combine_group_ids
 clamps them into slot 0, which the mask keeps out of every aggregate).
 
 The kept sets are cached per (query, datasource-version): repeat queries
@@ -61,6 +62,18 @@ ADAPTIVE_MAX_COMPACT_GROUPS = 1 << 17
 # extra pass even once.
 ADAPTIVE_MIN_SHRINK = 0.5
 
+def _compare_chain_max() -> int:
+    """Kept-sets at or under this size remap codes via an unrolled
+    compare-select chain instead of a device LUT gather (see
+    compacted_lowering): ~0.3 ms per compare over 52M rows vs ~360 ms for
+    one gather on the round-5 TPU.  On CPU the inversion is the other way
+    — a small LUT gather is one L1-resident load per row while 64 fused
+    compares are 64 ALU ops — so the chain is capped near the width XLA
+    itself would select-lower."""
+    import jax
+
+    return 64 if jax.default_backend() == "tpu" else 4
+
 
 def presence_columns(q, lowering: GroupByLowering, ds=None):
     """Columns phase A reads: only what the mask + dim codes need —
@@ -90,19 +103,41 @@ def compacted_lowering(
 ) -> GroupByLowering:
     """The same lowered query over the compacted code domain.
 
-    Each dim's codes_fn gathers through a LUT (original code -> compact
-    code, -1 for absent codes, which only masked-out rows can carry);
-    decode() maps compact codes back through kept_d then the original
-    decoder — so finalize_groupby and every kernel work unchanged."""
+    Each dim's codes_fn remaps original -> compact codes by one of three
+    equivalent strategies: identity (every code kept), an unrolled
+    compare-select chain (small kept-sets — the common case compaction
+    exists for; a TPU LUT gather cost ~360 ms/dim over 52M rows, profiled
+    round 5), or a device LUT gather (large kept-sets).  All three emit -1
+    for absent codes, which only masked-out rows can carry; decode() maps
+    compact codes back through kept_d then the original decoder — so
+    finalize_groupby and every kernel work unchanged."""
     new_dims: List[ResolvedDim] = []
     G = 1
     for d, kd in zip(lowering.dims, kept):
-        lut = np.full(d.cardinality, -1, np.int32)
-        lut[kd] = np.arange(len(kd), dtype=np.int32)
-        lut_dev = jnp.asarray(lut)
+        if len(kd) == d.cardinality:
+            # identity remap (every code present): no rewrite at all
+            codes_fn = d.codes_fn
+        elif len(kd) <= _compare_chain_max():
+            # Unrolled compare-select instead of a table gather.  On TPU a
+            # gather through even a 250-entry LUT runs ~0.36 s per dim over
+            # 52M rows (profiled round 5: tools/profile_adaptive_phaseb.py
+            # — it was 97% of q3_2's 1117 ms phase B), while |kept| vector
+            # compares fuse into the scan for ~free; XLA only does this
+            # lowering itself for tables of ~32 entries.  Compaction exists
+            # precisely because |kept| is small, so this is the common case.
+            def codes_fn(cols, base=d.codes_fn, kd_list=kd.tolist()):
+                c = base(cols)
+                acc = jnp.zeros(c.shape, jnp.int32)
+                for i, k in enumerate(kd_list):
+                    acc = acc + jnp.where(c == k, jnp.int32(i + 1), 0)
+                return acc - 1  # absent codes -> -1, same as the LUT
+        else:
+            lut = np.full(d.cardinality, -1, np.int32)
+            lut[kd] = np.arange(len(kd), dtype=np.int32)
+            lut_dev = jnp.asarray(lut)
 
-        def codes_fn(cols, base=d.codes_fn, lut_dev=lut_dev):
-            return lut_dev[base(cols)]
+            def codes_fn(cols, base=d.codes_fn, lut_dev=lut_dev):
+                return lut_dev[base(cols)]
 
         def decode(codes, base=d.decode, kd=kd):
             return base(kd[np.asarray(codes, dtype=np.int64)])

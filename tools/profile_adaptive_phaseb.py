@@ -1,0 +1,125 @@
+"""On-chip stage profile of the adaptive tier's phase-B shape (q3_2 SF10).
+
+Round-5 observation (BENCH_tpu_ssb_10_detail.json): q3_1 and q3_2 both run
+`adaptive` over the same 52M scanned rows, yet q3_2's warm device time is
+1117 ms vs q3_1's 238 ms — a 4.7x gap the compact-domain model says should
+not exist (both compact to tiny G').  This script times each stage of the
+phase-B program shape in isolation on the live backend to localize the gap:
+
+  filter     2-value IN over a 250-domain int16 code column
+  gathers    LUT remap (original code -> compact code) per grouping dim
+  combine    mixed-radix combine_group_ids to one gid
+  kernel     dense one-hot partial aggregate at the compacted G'
+  fused      all of the above in ONE jit (what the engine dispatches)
+
+Timing methodology matches plan/calibrate.py: salted inputs, completion
+proven by a 4-byte device_get, median of 3.
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+))
+
+
+def t_sync(fn, reps=3):
+    # the calibration module owns the timing methodology; reuse it so the
+    # tool's numbers stay comparable to the constants it cross-checks
+    from spark_druid_olap_tpu.plan.calibrate import _timeit_synced
+
+    return _timeit_synced(fn, reps=reps)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print("device:", jax.devices()[0])
+    R = 51_970_048  # 1586 x 32768: dense block_rows must divide
+    rng = np.random.default_rng(0)
+    # q3_2 shape: c_city/s_city 250-wide int16 codes, d_year 8-wide int8
+    c_city = jax.device_put(jnp.asarray(rng.integers(0, 250, R).astype(np.int16)))
+    s_city = jax.device_put(jnp.asarray(rng.integers(0, 250, R).astype(np.int16)))
+    d_year = jax.device_put(jnp.asarray(rng.integers(0, 8, R).astype(np.int8)))
+    rev = jax.device_put(jnp.asarray(rng.random(R).astype(np.float32)))
+
+    lut_c = np.full(250, -1, np.int32); lut_c[[11, 17]] = [0, 1]
+    lut_s = np.full(250, -1, np.int32); lut_s[[42, 99]] = [0, 1]
+    lut_y = np.arange(8, dtype=np.int32)
+    lut_c_d, lut_s_d, lut_y_d = map(jnp.asarray, (lut_c, lut_s, lut_y))
+
+    @jax.jit
+    def filt(c, s, salt):
+        return jnp.sum(
+            (((c == 11) | (c == 17)) & ((s == 42) | (s == 99))).astype(
+                jnp.float32
+            )
+        ) + salt
+
+    @jax.jit
+    def gathers(c, s, y, salt):
+        a = lut_c_d[c.astype(jnp.int32)]
+        b = lut_s_d[s.astype(jnp.int32)]
+        cc = lut_y_d[y.astype(jnp.int32)]
+        return (
+            jnp.sum(a.astype(jnp.float32))
+            + jnp.sum(b.astype(jnp.float32))
+            + jnp.sum(cc.astype(jnp.float32))
+            + salt
+        )
+
+    @jax.jit
+    def fused(c, s, y, v, salt):
+        mask = ((c == 11) | (c == 17)) & ((s == 42) | (s == 99))
+        a = lut_c_d[c.astype(jnp.int32)]
+        b = lut_s_d[s.astype(jnp.int32)]
+        cc = lut_y_d[y.astype(jnp.int32)]
+        gid = (a * 2 + b) * 8 + cc          # mixed radix, G' = 32
+        gid = jnp.where(mask, gid, 32)      # trash slot
+        st = jax.ops.segment_sum(
+            jnp.where(mask, v + salt, 0.0), gid, num_segments=33
+        )
+        return jnp.sum(st)
+
+    @jax.jit
+    def fused_onehot(c, s, y, v, salt):
+        mask = ((c == 11) | (c == 17)) & ((s == 42) | (s == 99))
+        a = lut_c_d[c.astype(jnp.int32)]
+        b = lut_s_d[s.astype(jnp.int32)]
+        cc = lut_y_d[y.astype(jnp.int32)]
+        gid = (a * 2 + b) * 8 + cc
+        gid = jnp.where(mask, gid, 32)
+        oh = jax.nn.one_hot(gid.reshape(-1, 4096), 33, dtype=jnp.bfloat16)
+        vv = jnp.where(mask, v + salt, 0.0).reshape(-1, 4096)
+        return jnp.sum(jnp.einsum("brg,br->g", oh, vv.astype(jnp.bfloat16)))
+
+    print("filter        %.4f s" % t_sync(lambda s: filt(c_city, s_city, jnp.float32(s))))
+    print("lut gathers   %.4f s" % t_sync(lambda s: gathers(c_city, s_city, d_year, jnp.float32(s))))
+    print("fused scatter %.4f s" % t_sync(lambda s: fused(c_city, s_city, d_year, rev, jnp.float32(s))))
+    print("fused one-hot %.4f s" % t_sync(lambda s: fused_onehot(c_city, s_city, d_year, rev, jnp.float32(s))))
+
+    # the real engine path for comparison: dense_partial_aggregate at G'=32
+    from spark_druid_olap_tpu.ops.groupby import dense_partial_aggregate
+
+    dense_fn = functools.partial(
+        dense_partial_aggregate, num_groups=32, block_rows=1 << 15,
+        num_min=0, num_max=0,
+    )
+
+    @jax.jit
+    def engine_dense(gid, mask, v, salt):
+        out = dense_fn(gid, mask, (v + salt)[:, None], jnp.zeros((R, 0), jnp.float32), jnp.zeros((R, 0), jnp.bool_))
+        return sum(x.astype(jnp.float32).sum() for x in jax.tree_util.tree_leaves(out) if hasattr(x, "dtype"))
+
+    gid32 = jax.device_put(jnp.asarray(rng.integers(0, 32, R).astype(np.int32)))
+    mask_d = jax.device_put(jnp.asarray(rng.random(R) < 0.01))
+    print("engine dense G'=32 (block 32K) %.4f s" % t_sync(lambda s: engine_dense(gid32, mask_d, rev, jnp.float32(s))))
+
+
+if __name__ == "__main__":
+    main()
