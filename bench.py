@@ -199,18 +199,53 @@ def bench_ssb_streamed(scale: float):
     ingest_s = _t.perf_counter() - t0
     n_rows = ctx.catalog.get("lineorder").num_rows
 
-    # one decode pass per chunk, all 13 oracle partials on it
-    parts = {name: [] for name in ssb.QUERIES}
-    t_pd = {name: 0.0 for name in ssb.QUERIES}
-    for lo in ssb.fact_chunks(scale, 7, 1 << 22, tables):
-        f = ssb.flat_frame_chunk(tables, lo)
-        for name in ssb.QUERIES:
-            t1 = _t.perf_counter()
-            parts[name].append(ssb.oracle(f, name))
-            t_pd[name] += _t.perf_counter() - t1
-        del f, lo
-    want = {n: ssb.merge_oracle_parts(parts[n]) for n in ssb.QUERIES}
-    del parts
+    # The float64 oracle is a pure function of (scale, seed) and at SF100
+    # costs ~an hour of single-core pandas — it timed out a 90-minute TPU
+    # window in round 5 while the device sat idle.  Cache it on disk; a
+    # cached load still asserts full parity (same exact frames), only the
+    # single-threaded-baseline seconds are reused from the measuring run.
+    import pickle
+
+    oracle_cache = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        ".ssb_oracle_sf%g_seed7.pkl" % scale,
+    )
+    # bump when the oracle computation itself changes: a stale cache must
+    # recompute, never silently assert parity against old expected frames
+    oracle_ver = 1
+    want = t_pd = None
+    if os.path.exists(oracle_cache):
+        try:
+            with open(oracle_cache, "rb") as f:
+                ver, cached_want, cached_t_pd = pickle.load(f)
+            # self-healing: recompute on version or query-set drift (a
+            # renamed/added query must not KeyError an hour into a window)
+            if ver == oracle_ver and set(cached_want) == set(ssb.QUERIES):
+                want, t_pd = cached_want, cached_t_pd
+        except Exception:
+            want = t_pd = None
+    if want is None:
+        # one decode pass per chunk, all 13 oracle partials on it
+        parts = {name: [] for name in ssb.QUERIES}
+        t_pd = {name: 0.0 for name in ssb.QUERIES}
+        for lo in ssb.fact_chunks(scale, 7, 1 << 22, tables):
+            f = ssb.flat_frame_chunk(tables, lo)
+            for name in ssb.QUERIES:
+                t1 = _t.perf_counter()
+                parts[name].append(ssb.oracle(f, name))
+                t_pd[name] += _t.perf_counter() - t1
+            del f, lo
+        want = {n: ssb.merge_oracle_parts(parts[n]) for n in ssb.QUERIES}
+        del parts
+        try:
+            # atomic: a watchdog kill mid-dump must leave the cache absent
+            # or whole, never truncated (a broken pickle would force the
+            # hour-long recompute the cache exists to avoid)
+            with open(oracle_cache + ".tmp", "wb") as f:
+                pickle.dump((oracle_ver, want, t_pd), f)
+            os.replace(oracle_cache + ".tmp", oracle_cache)
+        except Exception:
+            pass
 
     # 3 reps at every scale: median-of-2 is a mean, and a single noisy
     # rep (this host's memory subsystem has ~2x run-to-run variance)

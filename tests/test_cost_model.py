@@ -290,3 +290,67 @@ def test_calibration_meta_applied(tmp_path):
         "partial": True,
         "applied": True,
     }
+
+
+def test_slope_fallback_guards_inverted_measurements():
+    """Round-5 tunnel lesson: an inverted two-size slope (t_hi <= t_lo,
+    jitter or rung-padding) must fall back to single-point-minus-RTT, never
+    persist as 'this kernel is free' (a 1e-9 us/row sparse constant would
+    route every query onto the sort path)."""
+    from spark_druid_olap_tpu.plan.calibrate import (
+        _clamp_bandwidth,
+        _slope_or_fallback,
+    )
+
+    # healthy slope: used as-is
+    assert abs(_slope_or_fallback(0.2, 0.1, 1000, 500, 0.05) - 200.0) < 1e-6
+    # inverted slope: single-point fallback with the RTT subtracted
+    got = _slope_or_fallback(0.1, 0.11, 1000, 500, 0.06)
+    assert abs(got - (0.1 - 0.06) * 1e6 / 1000) < 1e-6
+    # kernel-specific floor wins over a too-cheap fallback
+    got = _slope_or_fallback(0.060001, 0.07, 1000, 500, 0.06, floor=5.0)
+    assert got == 5.0
+    # bandwidths stay inside physical reality in BOTH directions
+    assert _clamp_bandwidth(1e17) == 2e12
+    assert _clamp_bandwidth(1.0) == 1e6
+    assert _clamp_bandwidth(4.5e7) == 4.5e7
+
+
+def test_compare_chain_remap_matches_lut():
+    """compacted_lowering's three remap strategies (identity / unrolled
+    compare-select / LUT gather) must be interchangeable: same compact
+    codes, -1 for absent, on every kept-set size around the chain cap."""
+    import numpy as np
+
+    from spark_druid_olap_tpu.exec import adaptive_exec as AE
+    from spark_druid_olap_tpu.exec.lowering import ResolvedDim
+
+    rng = np.random.default_rng(3)
+    card = 250
+    codes = rng.integers(0, card, 10_000).astype(np.int16)
+
+    def make_dim():
+        return ResolvedDim(
+            spec=None,
+            cardinality=card,
+            codes_fn=lambda cols: cols["c"],
+            decode=lambda cs: cs,
+        )
+
+    from spark_druid_olap_tpu.exec.lowering import GroupByLowering
+
+    for n_kept in (2, 4, 64, 200, card):
+        kept = np.sort(
+            rng.choice(card, size=n_kept, replace=False)
+        ).astype(np.int32) if n_kept < card else np.arange(card, dtype=np.int32)
+        lut = np.full(card, -1, np.int32)
+        lut[kept] = np.arange(len(kept), dtype=np.int32)
+        want = lut[codes]
+
+        base = GroupByLowering(
+            query=None, dims=[make_dim()], la=None, num_groups=card,
+            columns=["c"], filter_fn=None, vcol_fns={},
+        )
+        compacted = AE.compacted_lowering(base, [kept])
+        got = np.asarray(compacted.dims[0].codes_fn({"c": codes}))
+        assert (got == want).all(), n_kept
