@@ -252,7 +252,12 @@ _SPARSE_ERROR_PIN_AFTER = 2
 def _default_device_budget() -> int:
     """Residency byte budget when the caller does not pin one.
 
-    TPU/GPU: 4 GiB — headroom on a 16 GiB v5e chip for kernel workspace.
+    TPU/GPU: 3/4 of the device's HBM (12 GiB on a 16 GiB v5e when the
+    runtime does not report memory stats), leaving the rest for kernel
+    workspace and merge states.  The round-4 default of 4 GiB looked
+    safe but was a trap at SF100: the ~9 GB working set thrashed through
+    the eviction window, and over the tunneled link (45 MB/s measured)
+    each re-upload of an evicted column set cost minutes per query.
     CPU backend: "device" buffers ARE host RAM, so evicting to re-copy is
     pure waste — budget half the machine's memory instead (SF100's 51 GB
     of encoded segments stays resident across queries on a 125 GB host
@@ -260,8 +265,19 @@ def _default_device_budget() -> int:
     try:
         import jax
 
-        if jax.devices()[0].platform != "cpu":
-            return 4 << 30
+        dev = jax.devices()[0]
+        if dev.platform != "cpu":
+            try:
+                hbm = int(dev.memory_stats()["bytes_limit"])
+                return hbm * 3 // 4
+            except Exception:
+                # no memory stats: size by known device kinds, else stay
+                # at the conservative floor (a 12 GiB budget on an 8 GiB
+                # accelerator would turn graceful eviction into hard OOM)
+                kind = str(getattr(dev, "device_kind", "")).lower()
+                if "v5 lite" in kind or "v5e" in kind:
+                    return 12 << 30
+                return 4 << 30
         import os
 
         pages = os.sysconf("SC_PHYS_PAGES")

@@ -1,0 +1,103 @@
+#!/bin/bash
+# Round-5 second-window watch.  The first window (08:31-~13:50 UTC, 5.5 h)
+# captured the full v2 pipeline (TPU_SUCCESS) and the slope-recalibrated
+# reruns of ssb1/ssb10 — but closed before the SF100 run and the
+# post-adaptive-fix reruns landed.  This watch converts the NEXT window
+# into exactly those, most-valuable-first.  SF100's float64 oracle is now
+# disk-cached (.ssb_oracle_sf100_seed7.pkl) and the TPU residency budget
+# fits the working set, so SF100 needs ~40 min of window, not ~90.
+#
+# Run detached:  setsid nohup bash tools/tpu_watch_v3.sh >/tmp/tpu_watch3_out.txt 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_PROBE_LOG_r5.txt
+INTERVAL=${TPU_WATCH_INTERVAL:-180}
+
+ts() { date -u +%FT%TZ; }
+
+probe() {
+    timeout 90 python -c 'import jax; print(jax.devices()[0].platform)' \
+        2>/tmp/tpu_probe_err.txt
+}
+
+bench_ok() {  # $1 = json path
+    [ -s "$1" ] && python - "$1" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if (not d.get("degraded") and "cpu" not in str(d.get("device", "cpu")).lower()) else 1)
+EOF
+}
+
+reprobe_alive() {
+    P=$(probe)
+    [ -n "$P" ] && [ "$P" != "cpu" ]
+}
+
+# step markers: a bench artifact produced AFTER the adaptive-remap fix
+# (commit 32cf9ca).  The v2-window artifacts predate it, so re-run each
+# mode once; completed steps are tracked via stamp files.
+run_step() {  # $1 = stamp, $2 = out json, $3 = timeout, rest = bench args
+    local stamp="$1" out="$2" to="$3"; shift 3
+    [ -s ".probe/$stamp" ] && return 0
+    reprobe_alive || return 1
+    local rc=1
+    if SD_BENCH_TIMEOUT_S=$((to - 100)) timeout "$to" python bench.py "$@" \
+        > "$out.tmp" 2>"/tmp/tpu_w3_${stamp}.txt"; then
+        mv "$out.tmp" "$out"
+        rc=0
+    fi
+    echo "w3 $stamp rc=$rc $(ts)" >> "$LOG"
+    # the stamp asserts "a POST-adaptive-fix run succeeded", so it needs
+    # BOTH this run's success and a non-degraded artifact — a stale v2
+    # artifact passing bench_ok alone must not mark the step done
+    if [ "$rc" = 0 ] && bench_ok "$out"; then
+        mkdir -p .probe && date -u +%FT%TZ > ".probe/$stamp"
+    fi
+    return 0
+}
+
+run_window() {
+    echo "=== w3 window open $(ts)" >> "$LOG"
+    export SD_BENCH_PROBE_WINDOW_S=30 SD_BENCH_PROBE_INTERVAL_S=15 SD_BENCH_PROBE_TIMEOUT_S=60
+    export SD_BENCH_SKIP_CALIBRATE=1 SD_BENCH_NO_CPU_FALLBACK=1
+
+    run_step ssb10_v3 BENCH_tpu_ssb10_r5.json 2500 ssb 10 || return
+    run_step ssb100_v3 BENCH_tpu_ssb100_r5.json 5500 ssb 100 || return
+    run_step timeseries_v3 BENCH_tpu_timeseries_r5.json 900 timeseries || return
+    run_step assist_v3 BENCH_tpu_assist_r5.json 1300 assist || return
+    run_step tpch_v3 BENCH_tpu_tpch_q1_r5.json 700 tpch_q1 || return
+    run_step topn_v3 BENCH_tpu_topn_hll_r5.json 700 topn_hll || return
+    run_step cube_v3 BENCH_tpu_cube_theta_r5.json 700 cube_theta || return
+
+    if all_done; then
+        echo "=== w3 ALL STEPS CAPTURED $(ts)" >> "$LOG"
+    fi
+}
+
+all_done() {
+    local s
+    for s in ssb10_v3 ssb100_v3 timeseries_v3 assist_v3 tpch_v3 topn_v3 cube_v3; do
+        [ -s ".probe/$s" ] || return 1
+    done
+    return 0
+}
+
+N=0
+while true; do
+    if all_done; then
+        echo "=== w3 watch exiting: all evidence captured $(ts)" >> "$LOG"
+        exit 0
+    fi
+    N=$((N + 1))
+    P=$(probe)
+    if [ -n "$P" ] && [ "$P" != "cpu" ]; then
+        echo "$(ts) w3 probe=$N SUCCESS platform=$P" >> "$LOG"
+        run_window
+    else
+        echo "$(ts) w3 probe=$N down" >> "$LOG"
+    fi
+    sleep "$INTERVAL"
+done
