@@ -95,13 +95,25 @@ def _ensure_calibration():
         import jax
 
         dev = str(jax.devices()[0])
-        if _os.path.exists(C.DEFAULT_PATH):
-            with open(C.DEFAULT_PATH) as f:
-                cal = _json.load(f)
+        plat = jax.devices()[0].platform
+        # primary file first, then the per-platform sidecar (CPU and TPU
+        # runs alternate on this host; each overwrites the primary, and
+        # SessionConfig.load_calibrated knows the same fallback)
+        candidates = [C.DEFAULT_PATH, C.sidecar_path(plat)]
+        for cp in candidates:
+            if not _os.path.exists(cp):
+                continue
+            try:
+                with open(cp) as f:
+                    cal = _json.load(f)
+            except (OSError, ValueError):
+                # a truncated primary (killed mid-write) must not mask a
+                # valid sidecar or suppress the re-sweep below
+                continue
             # same device AND current schema (h2d_bytes_per_s marks the
             # round-5 slope-based methodology — earlier files measured
             # through a sync that the tunneled backend did not honor and
-            # carry constants off by orders of magnitude) -> reuse
+            # carry constants off by orders of magnitude) -> reuse.
             # .get() truthiness, not key presence: a budget-truncated sweep
             # saves null for the constants it never reached, and reusing
             # such a file forever would leave e.g. a 46 MB/s link priced
@@ -112,6 +124,12 @@ def _ensure_calibration():
                 and cal.get("cost_per_row_compact")
                 and cal.get("h2d_bytes_per_s")
             ):
+                if cp != C.DEFAULT_PATH:
+                    # promote the matching sidecar so _calibrated_ctx /
+                    # _stream_bw (which read the primary path) see it
+                    import shutil
+
+                    shutil.copyfile(cp, C.DEFAULT_PATH)
                 return
         # bounded: over a flaky tunneled accelerator a full sweep ran
         # ~26 min; the budget keeps implicit calibration from eating the

@@ -187,24 +187,44 @@ class SessionConfig:
         import os
 
         cfg = cls()
-        p = path or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "calibration.json",
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
         )
+        p = path or os.path.join(root, "calibration.json")
+
+        def _read(fp):
+            try:
+                with open(fp) as f:
+                    d = json.load(f)
+            except (OSError, ValueError):
+                return None
+            return d if isinstance(d, dict) else None
+
         data = None
         if os.path.exists(p):
-            try:
-                with open(p) as f:
-                    data = json.load(f)
-            except (OSError, ValueError):
-                data = None
-            if not isinstance(data, dict):
-                data = None
+            data = _read(p)
             if data is None:
                 _log().warning(
                     "ignoring unreadable calibration file %s; using the "
                     "platform cost profile", p,
                 )
+        # A CPU bench run and a TPU window alternate on this host, each
+        # overwriting calibration.json; plan/calibrate.py therefore also
+        # saves calibration.<platform>.json (plan.calibrate.sidecar_path
+        # owns the naming).  Whenever the primary file cannot serve this
+        # backend — measured elsewhere, unreadable, or missing — prefer
+        # the platform-matching sidecar over falling all the way back to
+        # profile guesses (the round-5 TPU constants exist precisely so a
+        # later CPU run cannot erase them).
+        if path is None:
+            cur = _current_device_str()
+            if data is None or data.get("device") not in (None, cur):
+                from .plan.calibrate import sidecar_path
+
+                alt = sidecar_path(_current_platform() or "unknown", root)
+                alt_data = _read(alt) if os.path.exists(alt) else None
+                if alt_data is not None and alt_data.get("device") == cur:
+                    p, data = alt, alt_data
         if data is not None and data.get("device") not in (
             None,
             _current_device_str(),

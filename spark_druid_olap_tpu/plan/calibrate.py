@@ -34,6 +34,17 @@ _REPO_ROOT = os.path.dirname(
 DEFAULT_PATH = os.path.join(_REPO_ROOT, "calibration.json")
 
 
+def sidecar_path(platform: str, root: Optional[str] = None) -> str:
+    """calibration.<platform>.json next to DEFAULT_PATH.  Single owner of
+    the per-platform sidecar naming: calibrate() writes it, and both
+    SessionConfig.load_calibrated and bench._ensure_calibration read it —
+    three sites that must never drift apart."""
+    return os.path.join(
+        root if root is not None else _REPO_ROOT,
+        "calibration.%s.json" % platform,
+    )
+
+
 def _timeit_synced(fn, reps: int = 3) -> float:
     """Median wall seconds of fn(salt) where fn must RETURN A SCALAR jax
     array and the timer fetches its 4 bytes to the host each rep.
@@ -534,6 +545,18 @@ def calibrate(
     if save_path:
         with open(save_path, "w") as f:
             json.dump(out, f, indent=1)
+        # per-platform sidecar: CPU and TPU runs alternate on this host and
+        # each overwrites the primary file; SessionConfig.load_calibrated
+        # falls back to calibration.<platform>.json on a device mismatch so
+        # measured constants survive runs on the other backend
+        try:
+            plat_path = sidecar_path(
+                out["platform"], root=os.path.dirname(save_path)
+            )
+            with open(plat_path, "w") as f:
+                json.dump(out, f, indent=1)
+        except OSError:
+            pass
     return out
 
 

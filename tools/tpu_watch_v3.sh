@@ -44,16 +44,14 @@ run_step() {  # $1 = stamp, $2 = out json, $3 = timeout, rest = bench args
     [ -s ".probe/$stamp" ] && return 0
     reprobe_alive || return 1
     local rc=1
-    if SD_BENCH_TIMEOUT_S=$((to - 100)) timeout "$to" python bench.py "$@" \
-        > "$out.tmp" 2>"/tmp/tpu_w3_${stamp}.txt"; then
-        mv "$out.tmp" "$out"
-        rc=0
-    fi
+    SD_BENCH_TIMEOUT_S=$((to - 100)) timeout "$to" python bench.py "$@" \
+        > "$out.tmp" 2>"/tmp/tpu_w3_${stamp}.txt" && rc=0
     echo "w3 $stamp rc=$rc $(ts)" >> "$LOG"
-    # the stamp asserts "a POST-adaptive-fix run succeeded", so it needs
-    # BOTH this run's success and a non-degraded artifact — a stale v2
-    # artifact passing bench_ok alone must not mark the step done
-    if [ "$rc" = 0 ] && bench_ok "$out"; then
+    # validate BEFORE replacing: a degraded rerun (rc=0, degraded:true)
+    # must neither clobber first-window hardware evidence in $out nor
+    # stamp the step; only a non-degraded THIS-RUN artifact does both
+    if [ "$rc" = 0 ] && bench_ok "$out.tmp"; then
+        mv "$out.tmp" "$out"
         mkdir -p .probe && date -u +%FT%TZ > ".probe/$stamp"
     fi
     return 0
