@@ -201,13 +201,10 @@ class SessionConfig:
             return d if isinstance(d, dict) else None
 
         data = None
+        primary_unreadable = False
         if os.path.exists(p):
             data = _read(p)
-            if data is None:
-                _log().warning(
-                    "ignoring unreadable calibration file %s; using the "
-                    "platform cost profile", p,
-                )
+            primary_unreadable = data is None
         # A CPU bench run and a TPU window alternate on this host, each
         # overwriting calibration.json; plan/calibrate.py therefore also
         # saves calibration.<platform>.json (plan.calibrate.sidecar_path
@@ -224,7 +221,15 @@ class SessionConfig:
                 alt = sidecar_path(_current_platform() or "unknown", root)
                 alt_data = _read(alt) if os.path.exists(alt) else None
                 if alt_data is not None and alt_data.get("device") == cur:
-                    p, data = alt, alt_data
+                    p, data, primary_unreadable = alt, alt_data, False
+        if primary_unreadable:
+            # only warn once the sidecar fallback ALSO failed — an
+            # operator reading "using the platform cost profile" must be
+            # able to trust that profile guesses are really in effect
+            _log().warning(
+                "ignoring unreadable calibration file %s; using the "
+                "platform cost profile", p,
+            )
         if data is not None and data.get("device") not in (
             None,
             _current_device_str(),

@@ -98,6 +98,133 @@ def presence_columns(q, lowering: GroupByLowering, ds=None):
     return [c for c in lowering.columns if c in keep]
 
 
+def filter_derived_kept(
+    q, lowering: GroupByLowering, ds
+) -> Optional[List[np.ndarray]]:
+    """Phase A WITHOUT the scan: per-dim kept-code sets derived from the
+    query's own filter, evaluated over the host-side dictionaries.
+
+    When every grouping dim is directly pinned by a dictionary-evaluable
+    conjunct (Selector / In / Bound on that dim), the accepted-code sets
+    are computable in O(cardinality) host work — the Druid bitmap-index /
+    dictionary-pruning analog (SURVEY.md §1 L1 row) with zero device
+    passes.  Soundness: every masked-in row satisfies every conjunct, so
+    its code for a pinned dim lies in that conjunct's accepted set; the
+    derived kept is a (possibly proper) SUPERSET of measured presence,
+    which only costs a few empty compact slots.  Returns None when any
+    dim is unpinned or not dictionary-backed — callers fall back to the
+    measured presence pass.
+
+    Only AND-conjuncts pin a dim: disjunctions/negations/expressions may
+    admit rows their sub-predicates reject, so they derive nothing (the
+    dim counts as unpinned unless another conjunct covers it)."""
+    from ..models import filters as F
+
+    conjuncts: List[object] = []
+
+    def collect(f):
+        if isinstance(f, F.And):
+            for c in f.fields:
+                collect(c)
+        elif f is not None:
+            conjuncts.append(f)
+
+    collect(getattr(q, "filter", None))
+
+    kept: List[np.ndarray] = []
+    for d in lowering.dims:
+        spec = d.spec
+        if (
+            spec.dimension == "__time"
+            or getattr(spec, "granularity", None) is not None
+            or getattr(spec, "extraction", None) is not None
+            or spec.dimension not in getattr(ds, "dicts", {})
+        ):
+            return None  # not a plain dictionary dim: cannot derive
+        dic = ds.dicts[spec.dimension]
+        # Accepted codes per derivable conjunct, intersected.  Every
+        # branch MIRRORS the device filter compiler's translation
+        # (ops/filters.py) exactly — kept must be a superset of the
+        # device-true codes, so the two sides must agree on how literals
+        # map into code space (review r5: values.index() diverged from
+        # code_of on numeric dictionaries and silently dropped rows).
+        acc: Optional[set] = None
+        for f in conjuncts:
+            if getattr(f, "dimension", None) != spec.dimension:
+                continue
+            cur: Optional[set] = None
+            if isinstance(f, F.Selector):
+                if f.value is None:
+                    cur = {d.cardinality - 1}  # the null slot
+                else:
+                    c = dic.code_of(f.value)
+                    cur = set() if c is None else {c}
+            elif isinstance(f, F.InFilter):
+                cur = {
+                    c
+                    for c in (dic.code_of(v) for v in f.values)
+                    if c is not None
+                }
+            elif isinstance(f, F.Bound):
+                cur = _bound_accepted_codes(f, dic)
+            if cur is not None:
+                acc = cur if acc is None else (acc & cur)
+        if acc is None:
+            return None  # unpinned dim: a device presence pass is needed
+        kept.append(np.array(sorted(acc), dtype=np.int32))
+    return kept
+
+
+def _bound_accepted_codes(f, dic) -> Optional[set]:
+    """Dictionary codes a Bound conjunct accepts, mirroring the device
+    compile branch-for-branch (ops/filters.py Bound handling); None when
+    the branch cannot be mirrored soundly (the conjunct then derives
+    nothing and the dim falls back to the presence scan)."""
+    import numpy as _np
+
+    from ..ops.filters import numeric_dict_code_bounds
+
+    nv = dic.numeric_values
+    card = dic.cardinality
+    if nv is not None:
+        cb = numeric_dict_code_bounds(f, _np.asarray(nv))
+        if cb is not None:
+            lo_c, hi_c = cb
+            lo_c = 0 if lo_c is None else lo_c
+            hi_c = card - 1 if hi_c is None else hi_c
+            return set(range(max(0, lo_c), min(card - 1, hi_c) + 1))
+        # non-numeric literal: device compares STRINGIFIED values
+        vals = [str(v) for v in dic.values]
+        ok = set(range(card))
+        if f.lower is not None:
+            lo_s = str(f.lower)
+            ok = {
+                i for i in ok
+                if (vals[i] > lo_s if f.lower_strict else vals[i] >= lo_s)
+            }
+        if f.upper is not None:
+            hi_s = str(f.upper)
+            ok = {
+                i for i in ok
+                if (vals[i] < hi_s if f.upper_strict else vals[i] <= hi_s)
+            }
+        return ok
+    if f.ordering == "lexicographic":
+        vals = _np.asarray(dic.values, dtype=str)
+        lo_c, hi_c = 0, card - 1
+        if f.lower is not None:
+            side = "right" if f.lower_strict else "left"
+            lo_c = int(_np.searchsorted(vals, f.lower, side=side))
+        if f.upper is not None:
+            side = "left" if f.upper_strict else "right"
+            hi_c = int(_np.searchsorted(vals, f.upper, side=side)) - 1
+        return set(range(max(0, lo_c), min(card - 1, hi_c) + 1))
+    # string dictionary + numeric ordering: the device falls through to a
+    # raw-CODE numeric compare (a degenerate legacy semantic) — decline
+    # rather than risk a kept set narrower than the device mask
+    return None
+
+
 def compacted_lowering(
     lowering: GroupByLowering, kept: List[np.ndarray]
 ) -> GroupByLowering:
@@ -260,6 +387,14 @@ class AdaptiveDomainMixin:
         None when compaction should be declined for this query."""
         qkey = _query_key(q, ds)
         kept = self._adaptive_kept.get(qkey)
+        if kept is None:
+            # dictionary-derived shortcut: when the filter itself pins
+            # every grouping dim, phase A needs NO device pass at all —
+            # O(cardinality) host work over the dictionaries replaces the
+            # full presence scan (and its dispatch round-trip)
+            kept = filter_derived_kept(q, lowering, ds)
+            if kept is not None:
+                self._adaptive_kept[qkey] = kept
         if kept is None:
             need = self._presence_columns(q, lowering, ds)
 

@@ -827,6 +827,15 @@ class DistributedEngine:
 
         kept = self._adaptive_kept.get(qkey)
         if kept is None:
+            # dictionary-derived shortcut (shared with the local engine):
+            # a filter that pins every grouping dim replaces the SPMD
+            # presence pass with O(cardinality) host work
+            from ..exec.adaptive_exec import filter_derived_kept
+
+            kept = filter_derived_kept(q, lowering, ds)
+            if kept is not None:
+                self._adaptive_kept[qkey] = kept
+        if kept is None:
             # phase A reads only mask + dim-code columns (the shared
             # helper keeps the physical time column when intervals need it)
             from ..exec.adaptive_exec import presence_columns

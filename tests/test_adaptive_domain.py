@@ -250,3 +250,68 @@ def test_adaptive_inner_kernels_follow_platform_not_static_resolver():
         assert k[2] != "dense", (
             f"compact program compiled with the dense one-hot on CPU: {k[2:]}"
         )
+
+
+def test_filter_derived_kept_skips_presence_scan():
+    """A filter that pins every grouping dim (In/Bound conjuncts) derives
+    the kept sets from the dictionaries host-side: phase A must run ZERO
+    device passes (the presence program is poisoned here), and parity
+    must hold bit-for-bit with the scan-based path."""
+    ds, cols = _make_ds()
+    keep_a = tuple(range(3, 15))
+    from spark_druid_olap_tpu.models.filters import And, Bound
+
+    q = _query(
+        filter=And(
+            (
+                InFilter("a", keep_a),
+                Bound("b", lower=10, upper=30, ordering="numeric"),
+            )
+        )
+    )
+    eng = Engine(strategy="adaptive")
+
+    def boom(*a, **k):  # pragma: no cover - fails the test if reached
+        raise AssertionError("presence scan ran despite derivable filter")
+
+    eng._presence_program = boom
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], keep_a) & (cols["b"] >= 10) & (cols["b"] <= 30)
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["a"], want["a"])
+    np.testing.assert_array_equal(got["b"], want["b"])
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
+    assert eng.last_metrics.strategy == "adaptive"
+    # derived kept = the accepted-code sets, already cached
+    kept = eng._adaptive_kept[_query_key(q, ds)]
+    assert [int(x) for x in kept[0]] == sorted(keep_a)
+    assert [int(x) for x in kept[1]] == list(range(10, 31))
+
+
+def test_filter_derived_kept_declines_unpinned_dim():
+    """A dim with no derivable conjunct (only an Or across dims) must NOT
+    be derived — the scan-based phase A takes over and parity holds."""
+    ds, cols = _make_ds()
+    from spark_druid_olap_tpu.exec.adaptive_exec import filter_derived_kept
+    from spark_druid_olap_tpu.exec.lowering import lower_groupby
+    from spark_druid_olap_tpu.models.filters import And, Or
+
+    q = _query(
+        filter=And(
+            (
+                InFilter("a", (1, 2, 3)),
+                Or((Selector("b", 5), Selector("a", 1))),
+            )
+        )
+    )
+    lowering = lower_groupby(q, ds)
+    assert filter_derived_kept(q, lowering, ds) is None
+    eng = Engine(strategy="adaptive")
+    got = _norm(eng.execute(q, ds))
+    mask = np.isin(cols["a"], (1, 2, 3)) & (
+        (cols["b"] == 5) | (cols["a"] == 1)
+    )
+    want = _oracle(cols, mask)
+    np.testing.assert_array_equal(got["n"], want["n"])
+    np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)

@@ -49,10 +49,16 @@ run_step() {  # $1 = stamp, $2 = out json, $3 = timeout, rest = bench args
     echo "w3 $stamp rc=$rc $(ts)" >> "$LOG"
     # validate BEFORE replacing: a degraded rerun (rc=0, degraded:true)
     # must neither clobber first-window hardware evidence in $out nor
-    # stamp the step; only a non-degraded THIS-RUN artifact does both
+    # stamp the step; only a non-degraded THIS-RUN artifact does both.
+    # A failed/degraded run's output is preserved under .degraded (not
+    # stranded as .tmp, not thrown away) for triage.
     if [ "$rc" = 0 ] && bench_ok "$out.tmp"; then
         mv "$out.tmp" "$out"
         mkdir -p .probe && date -u +%FT%TZ > ".probe/$stamp"
+    elif [ -s "$out.tmp" ]; then
+        mv "$out.tmp" "$out.degraded"
+    else
+        rm -f "$out.tmp"
     fi
     return 0
 }
