@@ -228,9 +228,11 @@ def bench_ssb_streamed(scale: float):
         os.path.dirname(os.path.abspath(__file__)),
         ".ssb_oracle_sf%g_seed7.pkl" % scale,
     )
-    # bump when the oracle computation itself changes: a stale cache must
-    # recompute, never silently assert parity against old expected frames
-    oracle_ver = 1
+    # bump when the oracle computation OR the synthetic data stream
+    # changes: a stale cache must recompute, never silently assert parity
+    # against old expected frames.  v2: pre-sorted date generation
+    # (workloads/ssb._gen_fact) changed the row<->value pairing.
+    oracle_ver = 2
     want = t_pd = None
     if os.path.exists(oracle_cache):
         try:

@@ -83,19 +83,20 @@ def test_streamed_dict_requirement():
 
 
 def test_gen_tables_unchanged_by_refactor():
-    """gen_tables must remain byte-identical to round 2 (rng draw order):
-    pinned by a checksum of the SF0.001 fact."""
+    """gen_tables must stay byte-identical ACROSS REFACTORS (rng draw
+    order): pinned by a checksum of the SF0.001 fact.  Round-5 rebaseline:
+    pre-sorted int16 date generation (_gen_fact) deliberately changed the
+    rng stream — bench.py's oracle cache version was bumped in the same
+    commit; any future mismatch here without such a bump is a bug."""
     t = ssb.gen_tables(scale=0.001, seed=7)
     lo = t["lineorder"]
     assert len(lo["lo_custkey"]) == 6_000
-    # fingerprint captured by running the ROUND-2 (pre-refactor) generator
-    # at this seed/scale in this environment
-    assert int(lo["lo_custkey"].sum()) == 297_349
-    assert int(lo["lo_suppkey"].sum()) == 145_675
-    assert int(lo["lo_partkey"].sum()) == 603_722
+    assert int(lo["lo_custkey"].sum()) == 298_323
+    assert int(lo["lo_suppkey"].sum()) == 146_596
+    assert int(lo["lo_partkey"].sum()) == 598_578
     assert round(
         float(np.asarray(lo["lo_revenue"], np.float64).sum()), 2
-    ) == 160_092_057.99
+    ) == 160_034_403.61
 
 
 def test_parallel_ingest_matches_serial(tmp_path):
