@@ -1,0 +1,91 @@
+"""On-chip stage profile of the q2-class adaptive shape (SF10).
+
+Round-5 open question: with kept-sets cached, q2_1's warm device time is
+~585 ms over 60M rows while q4_1 (same bytes, plain pallas/dense) runs
+255 ms.  Phase B at G'~280 should be dense-tier fast; this script times
+the candidate inner kernels at exactly that shape so the next hardware
+window can attribute the gap in one ~2-minute run.
+
+Stages: 40-entry compare-chain remap, dense one-hot at G'=280 (3 tiles),
+scatter at G'=280, scatter at the raw G=8008, and the fused
+chain+filter+dense program.  Methodology = plan/calibrate.py
+(_timeit_synced: salted, device_get-proven).
+"""
+
+import functools
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from spark_druid_olap_tpu.plan.calibrate import _timeit_synced
+
+    print("device:", jax.devices()[0])
+    R = 60_000_000 - (60_000_000 % 32768)
+    rng = np.random.default_rng(0)
+    brand = jax.device_put(jnp.asarray(rng.integers(0, 1000, R).astype(np.int16)))
+    year = jax.device_put(jnp.asarray(rng.integers(0, 8, R).astype(np.int8)))
+    cat = jax.device_put(jnp.asarray(rng.integers(0, 25, R).astype(np.int8)))
+    sreg = jax.device_put(jnp.asarray(rng.integers(0, 5, R).astype(np.int8)))
+    rev = jax.device_put(jnp.asarray(rng.random(R).astype(np.float32)))
+    kept_brands = sorted(rng.choice(1000, 40, replace=False).tolist())
+
+    @jax.jit
+    def chain_only(b, salt):
+        acc = jnp.zeros(b.shape, jnp.int32)
+        for i, k in enumerate(kept_brands):
+            acc = acc + jnp.where(b == k, jnp.int32(i + 1), 0)
+        return jnp.sum((acc - 1).astype(jnp.float32)) + salt
+
+    def fused_factory(inner):
+        @jax.jit
+        def fused(b, y, c, s, v, salt):
+            mask = (c == 7) & (s == 2)
+            acc = jnp.zeros(b.shape, jnp.int32)
+            for i, k in enumerate(kept_brands):
+                acc = acc + jnp.where(b == k, jnp.int32(i + 1), 0)
+            gid = (acc - 1) * 8 + y.astype(jnp.int32)
+            gp = 40 * 8
+            gid = jnp.where(mask & (acc > 0), gid, gp)
+            vv = jnp.where(mask, v + salt, 0.0)
+            return inner(gid, vv, gp)
+
+        return fused
+
+    def inner_scatter(gid, vv, gp):
+        return jnp.sum(jax.ops.segment_sum(vv, gid, num_segments=gp + 1))
+
+    def inner_onehot(gid, vv, gp):
+        oh = jax.nn.one_hot(
+            gid.reshape(-1, 4096), gp + 1, dtype=jnp.bfloat16
+        )
+        return jnp.sum(
+            jnp.einsum("brg,br->g", oh, vv.reshape(-1, 4096).astype(jnp.bfloat16))
+        )
+
+    @jax.jit
+    def scatter_raw(b, y, v, salt):
+        gid = b.astype(jnp.int32) * 8 + y.astype(jnp.int32)
+        return jnp.sum(
+            jax.ops.segment_sum(v + salt, gid, num_segments=8008)
+        )
+
+    t = lambda fn: _timeit_synced(fn, reps=3)
+    print("chain(40) only      %.4f s" % t(lambda s: chain_only(brand, jnp.float32(s))))
+    f_sc = fused_factory(inner_scatter)
+    print("fused chain+scatter %.4f s" % t(lambda s: f_sc(brand, year, cat, sreg, rev, jnp.float32(s))))
+    f_oh = fused_factory(inner_onehot)
+    print("fused chain+one-hot %.4f s" % t(lambda s: f_oh(brand, year, cat, sreg, rev, jnp.float32(s))))
+    print("raw scatter G=8008  %.4f s" % t(lambda s: scatter_raw(brand, year, rev, jnp.float32(s))))
+
+
+if __name__ == "__main__":
+    main()
