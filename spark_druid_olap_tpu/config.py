@@ -167,7 +167,10 @@ class SessionConfig:
 
     @classmethod
     def load_calibrated(
-        cls, path: Optional[str] = None, strict_device: bool = False
+        cls,
+        path: Optional[str] = None,
+        strict_device: bool = False,
+        root: Optional[str] = None,
     ) -> "SessionConfig":
         """SessionConfig with measured cost constants, when a calibration
         file (plan/calibrate.py) exists AND was measured on the current
@@ -187,9 +190,13 @@ class SessionConfig:
         import os
 
         cfg = cls()
-        root = os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))
-        )
+        # `root` overrides the repo-root discovery (tests point it at a
+        # tmp dir so the sidecar fallback is pinned without touching the
+        # real calibration files)
+        if root is None:
+            root = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
         p = path or os.path.join(root, "calibration.json")
 
         def _read(fp):

@@ -354,3 +354,61 @@ def test_compare_chain_remap_matches_lut():
         compacted = AE.compacted_lowering(base, [kept])
         got = np.asarray(compacted.dims[0].codes_fn({"c": codes}))
         assert (got == want).all(), n_kept
+
+
+def test_platform_sidecar_fallback(tmp_path):
+    """Per-platform calibration sidecars (round 5): when the primary
+    calibration.json mismatches, is corrupt, or is missing, load_calibrated
+    must fall back to calibration.<platform>.json measured on THIS backend
+    — so a TPU window's constants survive a later CPU run and vice versa."""
+    import json
+
+    from spark_druid_olap_tpu.config import (
+        SessionConfig,
+        _current_device_str,
+        _current_platform,
+    )
+
+    from spark_druid_olap_tpu.plan.calibrate import sidecar_path
+
+    dev = _current_device_str()
+    plat = _current_platform()
+    assert plat is not None  # conftest pins the CPU backend
+    import pathlib
+
+    side = pathlib.Path(sidecar_path(plat, str(tmp_path)))
+    side.write_text(json.dumps({
+        "device": dev, "platform": plat,
+        "cost_per_row_dense": 0.123, "cost_per_row_scatter": 0.017,
+        "partial": False,
+    }))
+
+    # 1. primary measured on another backend -> sidecar preferred
+    (tmp_path / "calibration.json").write_text(json.dumps({
+        "device": "TPU imaginary9", "cost_per_row_dense": 9.9,
+    }))
+    cfg = SessionConfig.load_calibrated(root=str(tmp_path))
+    assert cfg.cost_per_row_dense == 0.123
+    assert cfg.calibration_meta["applied"] and str(side) == cfg.calibration_meta["path"]
+
+    # 2. corrupt primary -> sidecar still serves
+    (tmp_path / "calibration.json").write_text("{trunc")
+    cfg = SessionConfig.load_calibrated(root=str(tmp_path))
+    assert cfg.cost_per_row_scatter == 0.017
+
+    # 3. missing primary -> sidecar still serves
+    (tmp_path / "calibration.json").unlink()
+    cfg = SessionConfig.load_calibrated(root=str(tmp_path))
+    assert cfg.cost_per_row_dense == 0.123
+
+    # 4. sidecar from another backend too -> platform profile, mismatch
+    #    recorded (never silently wrong-platform constants)
+    side.write_text(json.dumps({
+        "device": "TPU imaginary9", "cost_per_row_dense": 9.9,
+    }))
+    (tmp_path / "calibration.json").write_text(json.dumps({
+        "device": "TPU imaginary9", "cost_per_row_dense": 9.9,
+    }))
+    cfg = SessionConfig.load_calibrated(root=str(tmp_path))
+    assert cfg.cost_per_row_dense != 9.9
+    assert cfg.calibration_meta["mismatch"] is True
