@@ -29,6 +29,67 @@ def _device() -> str:
     return str(jax.devices()[0])
 
 
+# ---------------------------------------------------------------------------
+# Crash-safe artifacts: every BENCH_*.json is written atomically (tmp +
+# os.replace — the oracle-cache pattern), and long per-query sweeps flush
+# each completed query to a *_partial.json sidecar incrementally, so a
+# watchdog kill mid-window leaves the completed queries' numbers instead of
+# a zero-length .tmp (the round-5 SF100 wound).
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write(path: str, payload):
+    """Durable atomic file write (str or bytes): tmp + fsync + os.replace,
+    so a kill at ANY point leaves the previous artifact whole."""
+    import os as _os
+
+    tmp = path + ".tmp"
+    mode = "wb" if isinstance(payload, bytes) else "w"
+    with open(tmp, mode) as f:
+        f.write(payload)
+        f.flush()
+        _os.fsync(f.fileno())
+    _os.replace(tmp, path)
+
+
+# set by _run_child to BENCH_<tag>_partial.json; bench modes call
+# _note_partial after each completed query
+_PARTIAL = {"path": None, "mode": None, "items": {}}
+
+
+def _partial_path(tag: str) -> str:
+    import os as _os
+
+    root = _os.environ.get("SD_BENCH_DETAIL_DIR") or _os.path.dirname(
+        _os.path.abspath(__file__)
+    )
+    return _os.path.join(root, "BENCH_%s_partial.json" % tag)
+
+
+def _note_partial(name, record):
+    """Flush one completed query's numbers to the partial sidecar (atomic;
+    best-effort — a failed flush must never fail the bench)."""
+    if _PARTIAL["path"] is None:
+        return
+    _PARTIAL["items"][name] = record
+    try:
+        _atomic_write(
+            _PARTIAL["path"],
+            json.dumps(
+                {
+                    "mode": _PARTIAL["mode"],
+                    "completed": _PARTIAL["items"],
+                    "n_completed": len(_PARTIAL["items"]),
+                    "final": False,
+                },
+                indent=1,
+                default=str,
+            ),
+        )
+    except OSError:
+        pass
+
+
 def _timed(fn, reps=3, warmup=1):
     for _ in range(warmup):
         fn()
@@ -258,12 +319,13 @@ def bench_ssb_streamed(scale: float):
         want = {n: ssb.merge_oracle_parts(parts[n]) for n in ssb.QUERIES}
         del parts
         try:
-            # atomic: a watchdog kill mid-dump must leave the cache absent
-            # or whole, never truncated (a broken pickle would force the
-            # hour-long recompute the cache exists to avoid)
-            with open(oracle_cache + ".tmp", "wb") as f:
-                pickle.dump((oracle_ver, want, t_pd), f)
-            os.replace(oracle_cache + ".tmp", oracle_cache)
+            # atomic + fsync'd (_atomic_write): a watchdog kill mid-dump
+            # must leave the cache absent or whole, never truncated (a
+            # broken pickle would force the hour-long recompute the cache
+            # exists to avoid)
+            _atomic_write(
+                oracle_cache, pickle.dumps((oracle_ver, want, t_pd))
+            )
         except Exception:
             pass
 
@@ -289,6 +351,7 @@ def bench_ssb_streamed(scale: float):
                 bw,
             ),
         }
+        _note_partial(name, per_q[name])
         tpu_times.append(t_tpu)
         ratios.append(t_pd[name] / t_tpu)
     p50 = statistics.median(tpu_times)
@@ -341,6 +404,7 @@ def bench_ssb(scale: float):
                 bw,
             ),
         }
+        _note_partial(name, per_q[name])
         tpu_times.append(t_tpu)
         ratios.append(t_pd / t_tpu)
     p50 = statistics.median(tpu_times)
@@ -1207,6 +1271,10 @@ def _parse_args(argv):
 
 def _run_child():
     mode, fn, arg = _parse_args(sys.argv[1:])
+    # incremental partial flush: each completed query lands in
+    # BENCH_<tag>_partial.json so a watchdog kill mid-window keeps them
+    _PARTIAL["path"] = _partial_path("%s_%g" % (mode, arg))
+    _PARTIAL["mode"] = mode
     if mode != "calibrate":
         _ensure_calibration()
     result = fn(arg)
@@ -1328,8 +1396,9 @@ def _emit(result, tag):
     write_err = None
     detail_path = os.path.join(root, "BENCH_%s_detail.json" % tag)
     try:
-        with open(detail_path, "w") as f:
-            f.write(payload)
+        # atomic (tmp + os.replace): a watchdog kill mid-write leaves the
+        # previous artifact whole, never a truncated/zero-length file
+        _atomic_write(detail_path, payload)
     except OSError as e:
         detail_path, write_err = None, e
     # a non-degraded accelerator run is rare evidence: keep it under a name
@@ -1340,11 +1409,18 @@ def _emit(result, tag):
     if not result.get("degraded") and "cpu" not in dev:
         tpu_path = os.path.join(root, "BENCH_tpu_%s_detail.json" % tag)
         try:
-            with open(tpu_path, "w") as f:
-                f.write(payload)
+            _atomic_write(tpu_path, payload)
             detail_path = tpu_path
         except OSError as e:
             write_err = write_err or e
+    if detail_path is not None and result.get("unit") != "error":
+        # a completed run's final artifact supersedes the incremental
+        # sidecar; a FAILED run keeps it (the completed queries' numbers
+        # are exactly what the sidecar exists to preserve)
+        try:
+            os.remove(_partial_path(tag))
+        except OSError:
+            pass
     compact = {
         "metric": result.get("metric", tag),
         "value": result.get("value", 0.0),
@@ -1414,6 +1490,21 @@ def main():
         result, err = _child(dict(os.environ), run_s)
         if result is None:
             degraded = True
+            # the failed accelerated child's partial sidecar holds rare
+            # hardware evidence; the CPU rerun writes under the SAME tag,
+            # so move it to a name the rerun cannot clobber first
+            try:
+                pp = _partial_path(tag)
+                if os.path.exists(pp):
+                    os.replace(
+                        pp,
+                        os.path.join(
+                            os.path.dirname(pp),
+                            "BENCH_tpu_%s_partial.json" % tag,
+                        ),
+                    )
+            except OSError:
+                pass
     if result is None and os.environ.get("SD_BENCH_NO_CPU_FALLBACK") != "1":
         # Backend unavailable/wedged or the accelerated run failed: rerun on
         # a sanitized CPU interpreter so the round still gets a number.

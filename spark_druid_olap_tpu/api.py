@@ -54,6 +54,13 @@ class TPUOlapContext:
         self.engine = Engine()
         self._dist_engine = None
         self._last_engine_metrics = None  # metrics of the engine that last ran
+        # query-lifecycle resilience (resilience.py): the breaker every
+        # engine reports transient failures to, the admission pool the
+        # serving layer gates on, and the health counters
+        from .resilience import ResilienceState
+
+        self.resilience = ResilienceState(self.config)
+        self._sync_engine_resilience(self.engine)
         # SQL-text -> Rewrite cache (the reference re-plans every Catalyst
         # round; locally a repeated dashboard query should pay parse+plan
         # once).  Keyed on catalog version + config so any re-registration
@@ -298,35 +305,128 @@ class TPUOlapContext:
         )
 
     def sql(self, sql_text: str):
+        from .resilience import deadline_scope
         from .sql.commands import parse_command, run_command
 
         cmd = parse_command(sql_text)
         if cmd is not None:
             return run_command(self, cmd)
-        key = self._plan_cache_key(sql_text)
-        cached = self._plan_cache.get(key)
-        if cached is not None:
-            return self.execute_rewrite(cached)
-        lp, explain, out_names = parse_sql(sql_text, views=self.views)
-        planner = self._planner()
-        if explain:
-            import pandas as pd
+        # per-query deadline: the session default arms here unless an outer
+        # scope (the server's wire `context.timeout`) is already active
+        with deadline_scope(self.config.query_timeout_ms):
+            key = self._plan_cache_key(sql_text)
+            cached = self._plan_cache.get(key)
+            if cached is not None:
+                rw, lp = cached
+                return self._execute_with_resilience(rw, lp)
+            lp, explain, out_names = parse_sql(sql_text, views=self.views)
+            planner = self._planner()
+            if explain:
+                import pandas as pd
 
-            return pd.DataFrame({"plan": planner.explain(lp).split("\n")})
+                return pd.DataFrame(
+                    {"plan": planner.explain(lp).split("\n")}
+                )
+            try:
+                rw = planner.plan(lp)
+            except RewriteError as err:
+                return self._run_fallback(lp, err)
+            self._plan_cache[key] = (rw, lp)
+            return self._execute_with_resilience(rw, lp)
+
+    def _sync_engine_resilience(self, engine):
+        """Point an engine at this context's shared breaker and sync the
+        retry budget from the session config (engines construct with
+        standalone defaults so direct Engine() use keeps working)."""
+        engine.breaker = self.resilience.breaker
+        engine._retry_attempts = self.config.retry_max_attempts
+        engine._retry_backoff_ms = self.config.retry_backoff_ms
+
+    def _execute_with_resilience(self, rw: Rewrite, lp):
+        """Device execution under the circuit breaker, degrading to the
+        host fallback on an open circuit or a transient failure that
+        survived the engine's retry budget — the runtime extension of the
+        reference's 'a failed rewrite is never an error' stance.  Static
+        errors and deadline expiry surface unchanged (retrying a timed-out
+        query would only time out slower)."""
+        from .resilience import classify_error
+
+        res = self.resilience
+        br = res.breaker
+        can_degrade = (
+            lp is not None
+            and self.config.fallback_execution
+        )
+        if can_degrade and not br.allow():
+            # an open circuit must not cost a cached answer: the result
+            # cache holds exact device-quality frames that need NO device
+            hit = self._cached_result(rw)
+            if hit is not None:
+                return hit
+            log.warning(
+                "device circuit open; answering on the host fallback"
+            )
+            df = self._run_fallback(
+                lp, None, reason="device circuit open"
+            )
+            self._stamp_degraded(None)
+            return df
         try:
-            rw = planner.plan(lp)
-        except RewriteError as err:
-            return self._run_fallback(lp, err)
-        self._plan_cache[key] = rw
-        return self.execute_rewrite(rw)
+            df = self.execute_rewrite(rw)
+        except Exception as err:
+            kind = classify_error(err)
+            if kind == "deadline":
+                res.note_deadline_exceeded()
+                err._sdol_counted = True  # the server layer must not re-count
+                m = self.last_metrics
+                if m is not None:
+                    m.deadline_exceeded = True
+                raise
+            if kind != "transient" or not can_degrade:
+                raise
+            log.warning(
+                "device execution failed (%s: %s) after retries; "
+                "degrading to the host fallback",
+                type(err).__name__, err,
+            )
+            df = self._run_fallback(
+                lp, err, reason="device execution failed"
+            )
+            self._stamp_degraded(err)
+            return df
+        m = self.last_metrics
+        # report to the breaker for EVERY query type: the GroupBy engines
+        # record internally, but a half-open probe served by a timeseries/
+        # topN/scan (or a result-cache hit that never touched the device)
+        # must not leave the lease dangling and the breaker half-open on a
+        # healthy device
+        if m is not None and m.strategy == "result-cache":
+            br.release_probe()
+        else:
+            br.record_success()
+        if m is not None and not m.circuit_state:
+            m.circuit_state = br.state
+        return df
 
-    def _run_fallback(self, lp, err):
+    def _stamp_degraded(self, err):
+        """Mark the (fallback) metrics of a degraded answer and count it."""
+        self.resilience.note_degraded()
+        m = self.last_metrics
+        if m is not None:
+            m.degraded = True
+            m.circuit_state = self.resilience.breaker.state
+            if err is not None:
+                m.error_class = type(err).__name__
+
+    def _run_fallback(self, lp, err, reason: str = "rewrite failed"):
         """The reference's vanilla-Spark fallback: a failed rewrite runs
         the logical plan host-side instead of erroring — observably
         (QueryMetrics.executor = "fallback") and size-guarded
         (SessionConfig.fallback_max_rows).  Policy rejections and a
         disabled fallback re-raise the original RewriteError — the gate
-        lives HERE so every caller (sql, explain_analyze) agrees."""
+        lives HERE so every caller (sql, explain_analyze, circuit-broken
+        degradation) agrees.  `err` may be None (breaker-open routing:
+        there is no triggering exception)."""
         import time as _time
 
         from .exec.fallback import execute_fallback, plan_input_rows
@@ -336,10 +436,12 @@ class TPUOlapContext:
         if isinstance(err, RewritePolicyError):
             raise err  # explicit policy/validation rejection — no fallback
         if not self.config.fallback_execution:
-            raise err
+            if err is not None:
+                raise err
+            raise RewriteError("fallback execution is disabled")
 
         log.warning(
-            "rewrite failed (%s); executing on the host fallback", err
+            "%s (%s); executing on the host fallback", reason, err
         )
         t0 = _time.perf_counter()
         assists = {"n": 0}
@@ -462,6 +564,47 @@ class TPUOlapContext:
         )
         return df
 
+    def _result_key(self, rw: Rewrite, ds=None):
+        """Result-cache key of a rewrite, or None when it isn't cacheable
+        (unknown table / exact-distinct outer shape)."""
+        if rw.exact_distinct is not None:
+            return None
+        ds = ds or self.catalog.get(rw.datasource)
+        if ds is None:
+            return None
+        from .exec.lowering import schema_signature
+
+        return (
+            rw.to_json(),
+            schema_signature(ds),
+            repr(rw.output_columns),
+            repr(rw.grouping_sets),
+            repr(rw.host_post_exprs),
+            repr(rw.residual_having),
+            repr(self.config),
+        )
+
+    def _cached_result(self, rw: Rewrite, rkey=None):
+        """Serve a result-cache hit (restamping last_metrics so they
+        describe THIS query — a prior fallback would otherwise leave
+        executor="fallback" pinned on a cached device hit), or None."""
+        if self.config.result_cache_entries <= 0:
+            return None
+        rkey = rkey or self._result_key(rw)
+        if rkey is None:
+            return None
+        hit = self._result_cache.get(rkey)
+        if hit is None:
+            return None
+        from .exec.metrics import QueryMetrics
+
+        self._last_engine_metrics = QueryMetrics(
+            query_type=type(rw.query).__name__,
+            strategy="result-cache",
+            executor="device",
+        )
+        return hit.copy()
+
     def execute_rewrite(self, rw: Rewrite, use_result_cache: bool = True):
         import pandas as pd
 
@@ -475,30 +618,10 @@ class TPUOlapContext:
 
         rkey = None
         if use_result_cache and self.config.result_cache_entries > 0:
-            from .exec.lowering import schema_signature
-
-            rkey = (
-                rw.to_json(),
-                schema_signature(ds),
-                repr(rw.output_columns),
-                repr(rw.grouping_sets),
-                repr(rw.host_post_exprs),
-                repr(rw.residual_having),
-                repr(self.config),
-            )
-            hit = self._result_cache.get(rkey)
+            rkey = self._result_key(rw, ds)
+            hit = self._cached_result(rw, rkey)
             if hit is not None:
-                # restamp: last_metrics must describe THIS query, not
-                # whatever ran before (a prior fallback would otherwise
-                # leave executor="fallback" pinned on a cached device hit)
-                from .exec.metrics import QueryMetrics
-
-                self._last_engine_metrics = QueryMetrics(
-                    query_type=type(rw.query).__name__,
-                    strategy="result-cache",
-                    executor="device",
-                )
-                return hit.copy()
+                return hit
 
         engine = self._engine_for(rw)
         if rw.grouping_sets and isinstance(rw.query, Q.GroupByQuery):
@@ -606,10 +729,12 @@ class TPUOlapContext:
                 # a fresh file load — re-synced EVERY call (same as the
                 # local engine below) so a replaced ctx.config is honored
                 self._dist_engine._calibrated_cfg = self.config
+                self._sync_engine_resilience(self._dist_engine)
                 return self._dist_engine
         # the engine's adaptive tier picks its compact-domain kernel from
         # the session's cost constants, not a fresh file load
         self.engine._calibrated_cfg = self.config
+        self._sync_engine_resilience(self.engine)
         if self.engine.strategy != phys.strategy:
             self.engine.strategy = phys.strategy
             # strategy participates in the engine's program cache key, so
@@ -857,12 +982,15 @@ class TableQuery:
         return plan
 
     def collect(self):
+        from .resilience import deadline_scope
+
         lp = self._logical()
-        try:
-            rw = self.ctx._planner().plan(lp)
-        except RewriteError as err:
-            return self.ctx._run_fallback(lp, err)
-        return self.ctx.execute_rewrite(rw)
+        with deadline_scope(self.ctx.config.query_timeout_ms):
+            try:
+                rw = self.ctx._planner().plan(lp)
+            except RewriteError as err:
+                return self.ctx._run_fallback(lp, err)
+            return self.ctx._execute_with_resilience(rw, lp)
 
     def collect_arrow(self):
         """`collect()` as a `pyarrow.Table`."""

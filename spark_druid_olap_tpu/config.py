@@ -159,6 +159,27 @@ class SessionConfig:
     # rows.  0 disables.
     result_cache_entries: int = 64
 
+    # -- query-lifecycle resilience (resilience.py) -------------------------
+    # wall-clock budget per query; 0 = unbounded.  The wire path's
+    # Druid-native `context.timeout` (ms) overrides it per request.
+    query_timeout_ms: int = 0
+    # serving admission control: bounded slot pool + queue-wait timeout;
+    # a full pool answers 503 + Retry-After instead of piling handler
+    # threads behind a slow device
+    max_concurrent_queries: int = 8
+    admission_queue_timeout_ms: int = 2000
+    # device circuit breaker: consecutive TRANSIENT failures before queries
+    # route straight to the host fallback, and how long the breaker stays
+    # open before a half-open probe may try the device again
+    breaker_failure_threshold: int = 3
+    breaker_cooldown_ms: int = 2000
+    # transient-failure retry budget for one device execution (attempts
+    # TOTAL, so 2 = one retry — the historical behavior) and the base
+    # backoff between attempts (doubles per retry, clipped to the active
+    # deadline's remaining budget)
+    retry_max_attempts: int = 2
+    retry_backoff_ms: float = 25.0
+
     # provenance of the cost constants (set by load_calibrated): {path,
     # device, partial, applied, mismatch?} or None when never loaded from
     # a file — artifacts record it so "which platform routed this" is

@@ -58,6 +58,7 @@ from .finalize import (  # noqa: F401
     finalize_timeseries,
     finalize_topn,
 )
+from ..resilience import checkpoint, fire
 from ..utils.log import get_logger
 from .adaptive_exec import AdaptiveDomainMixin
 from .sparse_exec import SparseExecMixin
@@ -270,7 +271,7 @@ def _default_device_budget() -> int:
             try:
                 hbm = int(dev.memory_stats()["bytes_limit"])
                 return hbm * 3 // 4
-            except Exception:
+            except Exception:  # fault-ok: capacity probe; sized fallback below
                 # no memory stats: size by known device kinds, else stay
                 # at the conservative floor (a 12 GiB budget on an 8 GiB
                 # accelerator would turn graceful eviction into hard OOM)
@@ -283,7 +284,7 @@ def _default_device_budget() -> int:
         pages = os.sysconf("SC_PHYS_PAGES")
         page = os.sysconf("SC_PAGE_SIZE")
         return max(4 << 30, int(pages * page) // 2)
-    except Exception:
+    except Exception:  # fault-ok: capacity probe; conservative floor below
         return 4 << 30
 
 
@@ -309,6 +310,16 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         self.last_metrics = None
         self._m = None  # metrics object being filled during one execution
         self._pallas_broken = False  # set on first Mosaic-compile failure
+        # resilience wiring (resilience.py): transient device failures and
+        # recoveries are reported to the breaker; TPUOlapContext replaces
+        # this default with its shared per-context breaker and syncs the
+        # retry budget from SessionConfig.  The breaker never gates THIS
+        # layer — routing around an open circuit is the api's job.
+        from ..resilience import CircuitBreaker
+
+        self.breaker = CircuitBreaker()
+        self._retry_attempts = 2  # total attempts (2 = one retry)
+        self._retry_backoff_ms = 25.0
         # queries pinned off the sparse accelerator because compaction
         # deterministically overflowed SPARSE_SLOTS distinct groups.
         # Exception fallbacks do NOT pin immediately (a transient device
@@ -355,6 +366,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         cols: Dict[str, jnp.ndarray] = {}
 
         def put(key, host):
+            fire("h2d")  # fault-injection site: host->device transfer
             t0 = _time.perf_counter()
             arr = jnp.asarray(host)
             self._device_cache[key] = arr
@@ -499,6 +511,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             strategy_override=strategy_override,
         )
         for batch in self._segment_batches(segs, need):
+            # cooperative deadline checkpoint: a query with a wall-clock
+            # budget cancels between batch dispatches, not at the very end
+            checkpoint("engine.segment_loop")
             cols_list = [
                 self._cols_for_segment(seg, ds, need) for seg in batch
             ]
@@ -519,6 +534,11 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         seg_fn may be a rebuilt XLA-dense program after a Mosaic failure."""
         import time as _time
 
+        # fault-injection site OUTSIDE the try below: an injected (or real
+        # pre-dispatch) transient fault must reach the retry/breaker
+        # machinery, not be misread as a Mosaic compile failure that pins
+        # _pallas_broken for the engine's lifetime
+        fire("device_dispatch")
         try:
             # first call of a newly-built program = trace+compile (+async
             # dispatch); attribute it to compile_ms (see metrics.py)
@@ -615,6 +635,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             if self._m is not None:
                 self._m.program_cache_hit = True
             return cached
+        fire("compile")  # fault-injection site: new program build
 
         from ..ops import hll as hll_ops
         from ..ops import theta as theta_ops
@@ -668,28 +689,25 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         return seg_fn
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
-        """GroupBy with one idempotent re-dispatch on transient device
-        failure — the analog of Spark retrying a DruidRDD partition
-        (SURVEY.md §5 failure-detection row: queries are read-only, so a
-        retry is always safe).  Static errors (RewriteError / ValueError,
-        and NotImplementedError — a RuntimeError subclass) propagate
-        immediately."""
+        """GroupBy with idempotent re-dispatch on transient device failure
+        — the analog of Spark retrying a DruidRDD partition (SURVEY.md §5
+        failure-detection row: queries are read-only, so a retry is always
+        safe) — generalized (resilience.run_device_attempts) into
+        retry-with-backoff under a budget, with every outcome reported to
+        the circuit breaker.  Static errors (RewriteError / ValueError,
+        NotImplementedError — a RuntimeError subclass — and
+        DeadlineExceeded) propagate immediately and never touch the
+        breaker."""
+        from ..resilience import run_device_attempts
+
         # normalize ONCE so the retry evicts under the same cache identity
         # the execution cached under (granularity adds a __time dimension)
         q = groupby_with_time_granularity(q)
-        try:
-            return self._execute_groupby_once(q, ds)
-        except NotImplementedError:
-            raise
-        except RuntimeError as err:
-            log.warning(
-                "transient device failure (%s: %s); evicting cached state "
-                "and re-dispatching once",
-                type(err).__name__,
-                err,
-            )
-            self._evict_query_state(q, ds)
-            return self._execute_groupby_once(q, ds)
+        return run_device_attempts(
+            self,
+            lambda: self._execute_groupby_once(q, ds),
+            lambda: self._evict_query_state(q, ds),
+        )
 
     def _evict_query_state(self, q: Q.GroupByQuery, ds: DataSource):
         """Drop everything a failed dispatch may have poisoned: this query's
@@ -827,7 +845,11 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 dense_state = self._partials_for_query(
                     q, ds, lowering=lowering
                 )
-        except BaseException:
+        except BaseException as err:
+            from ..resilience import DeadlineExceeded
+
+            if isinstance(err, DeadlineExceeded):
+                m.deadline_exceeded = True
             finish()
             raise
         dispatch_ms = (_time.perf_counter() - t_total) * 1e3
@@ -842,6 +864,10 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             self._m = m
             t_resolve = _time.perf_counter()
             try:
+                # deadline checkpoint between dispatch and the blocking
+                # fetch: a budget blown during dispatch cancels before
+                # paying the device round trip
+                checkpoint("engine.resolve")
                 if adaptive_resolve is not None:
                     out, reason = adaptive_resolve()
                     if out is not None:
@@ -922,6 +948,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 )
                 m.finalize_ms = (_time.perf_counter() - t0) * 1e3
                 return out
+            except BaseException as err:
+                from ..resilience import DeadlineExceeded
+
+                if isinstance(err, DeadlineExceeded):
+                    m.deadline_exceeded = True
+                raise
             finally:
                 finish()
 
@@ -991,6 +1023,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             else (q.limit + q.offset if q.limit is not None else None)
         )
         for seg in self._segments_in_scope(q, ds):
+            checkpoint("engine.scan_loop")
             cols = self._device_cols(seg, need)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]

@@ -44,10 +44,17 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     values, metrics as float64, time as int64 ms.  `columns` restricts the
     decode to the names a plan actually references (decoding a wide
     table's every column would dominate fallback latency)."""
+    from ..resilience import checkpoint, fire, injector
+
+    fire("fallback_decode")  # fault-injection site: host decode
+    # `partial` fault mode truncates every segment's decode to a fraction —
+    # the deterministic torn-result shape watchdog/flush tests need
+    frac = injector().partial_fraction("fallback_decode")
     out: Dict[str, np.ndarray] = {}
     for c in ds.columns:
         if columns is not None and c.name not in columns:
             continue
+        checkpoint("fallback.decode")
         parts = []
         for seg in ds.segments:
             arr = np.asarray(seg.column(c.name))[seg.valid]
@@ -55,6 +62,8 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
                 arr = ds.dicts[c.name].decode(arr)
             elif arr.dtype.kind == "f":
                 arr = arr.astype(np.float64)
+            if frac is not None:
+                arr = arr[: int(len(arr) * frac)]
             parts.append(arr)
         out[c.name] = (
             np.concatenate(parts) if parts else np.array([], dtype=object)
@@ -377,8 +386,12 @@ def _aggregate(node: L.Aggregate, df: pd.DataFrame) -> pd.DataFrame:
             grouped = df.groupby(
                 [kf[n] for n, _ in keys], dropna=False, sort=False
             )
+            from ..resilience import checkpoint
+
             rows = []
-            for gv, gdf in grouped:
+            for i, (gv, gdf) in enumerate(grouped):
+                if i % 256 == 0:  # the q18-class per-group Python loop
+                    checkpoint("fallback.group_loop")
                 gv = gv if isinstance(gv, tuple) else (gv,)
                 row = dict(zip((n for n, _ in keys), gv))
                 for ae in node.agg_exprs:
@@ -1575,6 +1588,12 @@ def _cached_scan_frame(catalog, table: str, needed) -> pd.DataFrame:
     ds = catalog.get(table)
     if ds is None:
         raise KeyError(f"unknown table {table!r}")
+    from ..resilience import injector
+
+    if injector().armed("fallback_decode"):
+        # injected decode faults (error/partial) must neither be masked by
+        # a cached frame nor poison the cache for later healthy queries
+        return decoded_frame(ds, columns=needed)
     cache = getattr(catalog, "_fallback_frames", None)
     if cache is None:
         from ..utils.lru import CountBudgetCache
@@ -1597,6 +1616,11 @@ def _exec(
     lp: L.LogicalPlan, catalog, _needed=None
 ) -> pd.DataFrame:
     """Interpret a logical plan over decoded host frames."""
+    from ..resilience import checkpoint
+
+    # cooperative deadline checkpoint per plan node: a budgeted query
+    # cancels between interpreter stages instead of grinding to the end
+    checkpoint("fallback.interp")
     if isinstance(lp, L.Scan):
         return _cached_scan_frame(catalog, lp.table, _needed)
     if isinstance(lp, L.Filter):
@@ -1617,14 +1641,36 @@ def _exec(
             index=df.index,
         )
     if isinstance(lp, L.Join):
+        # star-conforming joins collapse to the denormalized fact exactly
+        # like the planner's JoinTransform: the flat fact may not even
+        # carry the FK columns (lo_partkey et al. are dropped at
+        # registration), so interpreting the textual join would KeyError —
+        # and the collapse is also what keeps circuit-degraded SSB queries
+        # at fact-scan cost instead of a 4-way host merge
+        try:
+            from ..catalog.star import try_collapse_join
+
+            collapsed = try_collapse_join(lp, catalog)
+        except Exception:  # fault-ok: collapse is an optimization; merge path below
+            collapsed = None
+        if collapsed is not None:
+            return _exec(collapsed, catalog, _needed)
         left = _exec(lp.left, catalog, _needed)
         right = _exec(lp.right, catalog, _needed)
-        return left.merge(
+        out = left.merge(
             right,
             left_on=list(lp.left_keys),
             right_on=list(lp.right_keys),
             how=lp.how,
+            # overlapping non-key names (a denormalized flat fact joined
+            # back to its dimension table — the circuit-degraded SSB
+            # shape): keep the LEFT column under its bare name so plan
+            # expressions still resolve; the suffixed right duplicate is
+            # unreachable by any expression and is dropped below
+            suffixes=("", "__joindup"),
         )
+        dup = [c for c in out.columns if c.endswith("__joindup")]
+        return out.drop(columns=dup) if dup else out
     if isinstance(lp, L.Union):
         # each branch projects to ITS select list first (an aggregate
         # branch's frame carries group/helper columns that would wreck

@@ -67,6 +67,16 @@ class QueryMetrics:
     # comparison windows over near-ties can order differently; non-zero
     # here is the flag to check when chasing such a divergence
     assist_subplans: int = 0
+    # query-lifecycle resilience (resilience.py): transient-failure
+    # re-dispatches this query paid; whether it answered DEGRADED (device
+    # path failed or breaker open -> host fallback); whether it died on its
+    # deadline; the breaker state observed when the query was routed; and
+    # the exception class when the query ultimately failed
+    retries: int = 0
+    degraded: bool = False
+    deadline_exceeded: bool = False
+    circuit_state: str = ""
+    error_class: Optional[str] = None
 
     @property
     def rows_per_sec(self) -> float:
@@ -104,7 +114,16 @@ class QueryMetrics:
             f"finalize={self.finalize_ms:.2f}ms) "
             f"rows/s={self.rows_per_sec:,.0f} "
             f"resident={self.bytes_resident}B "
-            f"cache_hit={self.program_cache_hit}]"
+            f"cache_hit={self.program_cache_hit}"
+            + (f" retries={self.retries}" if self.retries else "")
+            + (" DEGRADED" if self.degraded else "")
+            + (" DEADLINE-EXCEEDED" if self.deadline_exceeded else "")
+            + (
+                f" circuit={self.circuit_state}"
+                if self.circuit_state and self.circuit_state != "closed"
+                else ""
+            )
+            + "]"
         )
 
 
@@ -117,5 +136,5 @@ def trace(logdir: str):
     try:
         with jax.profiler.trace(logdir):
             yield
-    except Exception:
+    except Exception:  # fault-ok: profiler is optional; trace degrades to no-op
         yield

@@ -302,7 +302,7 @@ class SparseExecMixin:
         state = dispatch_exc = None
         try:
             state = dispatch(row_capacity=cap, slots=slots0)
-        except Exception as exc:  # noqa: BLE001 — re-raised in resolve
+        except Exception as exc:  # fault-ok: re-raised in resolve below
             dispatch_exc = exc
 
         def resolve():
@@ -312,7 +312,7 @@ class SparseExecMixin:
                     raise dispatch_exc
                 host, _ = fetch_slot_laddered(state, cap, slots0)
                 state = None  # free the device partials promptly
-            except Exception:
+            except Exception:  # fault-ok: returns "error"; caller logs + falls back
                 state = None
                 evict()
                 # mirror _call_segment_program: a Mosaic failure of the
@@ -333,7 +333,7 @@ class SparseExecMixin:
                         retry_cap,
                         retry_slots,
                     )
-                except Exception:
+                except Exception:  # fault-ok: returns "error"; caller logs + falls back
                     # only unflag if WE set the flag — an earlier query may
                     # have legitimately discovered the broken kernel
                     if we_broke_it:

@@ -236,13 +236,20 @@ class StreamExecutor:
 
         import time as _time
 
+        from ..resilience import checkpoint, fire
+
         for dev, base, nrows in self._prefetched_device_chunks(
             chunks, need, ds, chunk_rows
         ):
+            # cooperative deadline checkpoint + device-dispatch fault site:
+            # a budgeted 1B-row stream cancels between chunks, and injected
+            # device faults hit the streaming path like every other executor
+            checkpoint("streaming.chunk_loop")
+            fire("device_dispatch")
             t0 = _time.perf_counter()
             try:
                 s, mn, mx, sk = run(dev, base, nrows)
-            except Exception:
+            except Exception:  # fault-ok: _downgrade_pallas re-raises non-Pallas errors
                 run = self._downgrade_pallas(
                     q, ds, lowering, prep, build_mesh_run, strat
                 )
@@ -443,7 +450,7 @@ class StreamExecutor:
                     if not _put(item):
                         return
                 _put(_STOP)
-            except BaseException as e:  # surface producer errors to consumer
+            except BaseException as e:  # fault-ok: surfaced to (re-raised by) consumer
                 _put(e)
 
         sharding = None

@@ -44,6 +44,18 @@ _NEG = -jnp.inf
 _POS = jnp.inf
 
 
+def _enable_x64_compat(flag: bool):
+    """`jax.enable_x64` across JAX versions: top-level on new releases,
+    `jax.experimental.enable_x64` on older ones (this container's 0.4.37)
+    — same degrade-to-available-API convention as
+    parallel.mesh.shard_map_compat."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(flag)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(flag)
+
+
 def _kernel(
     gid_ref,
     mask_ref,
@@ -185,7 +197,7 @@ def pallas_partial_aggregate(
     # injects (func.return (i32, i64) fails on real TPUs) — trace the kernel
     # in 32-bit mode.  All operands are already concrete i32/f32 arrays, so
     # semantics are unchanged.
-    with jax.enable_x64(False):
+    with _enable_x64_compat(False):
         sums_t, mins_t, maxs_t = pl.pallas_call(
             kernel,
             grid=grid,

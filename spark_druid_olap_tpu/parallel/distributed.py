@@ -86,7 +86,7 @@ from ..ops.groupby import (
     scatter_partial_aggregate,
 )
 from ..utils.log import get_logger
-from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh
+from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh, shard_map_compat
 from .multihost import put_sharded
 
 log = get_logger("parallel.distributed")
@@ -146,6 +146,15 @@ class DistributedEngine:
         self._sparse_slots: Dict = {}
         self._sparse_row_capacity: Dict = {}
         self._sparse_declined: set = set()
+        # resilience wiring (resilience.py): same contract as
+        # exec.engine.Engine — transient failures/recoveries report to the
+        # breaker (TPUOlapContext swaps in its shared one); the breaker
+        # gates routing at the api layer, never execution here
+        from ..resilience import CircuitBreaker
+
+        self.breaker = CircuitBreaker()
+        self._retry_attempts = 2
+        self._retry_backoff_ms = 25.0
 
     def _cfg(self):
         if self._calibrated_cfg is None:
@@ -345,12 +354,11 @@ class DistributedEngine:
         gspec = P(GROUPS_AXIS) if ng > 1 else P()
         out_spec = (gspec, gspec, gspec, {a.name: gspec for a in sketches})
         run = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 shard_fn,
                 mesh=self.mesh,
                 in_specs=(specs,),
                 out_specs=out_spec,
-                check_vma=False,
             )
         )
         self._spmd_cache[cache_key] = run
@@ -443,12 +451,11 @@ class DistributedEngine:
             {k: gspec for k in _SPARSE_FLAG_KEYS},
         )
         run = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 shard_fn,
                 mesh=self.mesh,
                 in_specs=(specs,),
                 out_specs=out_spec,
-                check_vma=False,
             )
         )
         self._spmd_cache[cache_key] = run
@@ -505,12 +512,11 @@ class DistributedEngine:
 
         specs = {n: P(DATA_AXIS) for n in col_keys}
         run = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 shard_fn,
                 mesh=self.mesh,
                 in_specs=(specs,),
                 out_specs=[P() for _ in lowering.dims],
-                check_vma=False,
             )
         )
         self._spmd_cache[cache_key] = run
@@ -529,30 +535,29 @@ class DistributedEngine:
             return finalize_topn(df, q)
         assert isinstance(q, Q.GroupByQuery), type(q)
         # idempotent re-dispatch on transient device failure, mirroring
-        # exec/engine.py (queries are read-only; SURVEY.md §5 failure row)
+        # exec/engine.py: the SAME shared retry/backoff/breaker policy
+        # (resilience.run_device_attempts), differing only in what a
+        # failed dispatch has to evict (shards + SPMD programs here)
+        from ..resilience import run_device_attempts
+
         q = groupby_with_time_granularity(q)
-        try:
-            return self._execute_groupby_once(q, ds)
-        except NotImplementedError:
-            raise
-        except RuntimeError as err:
-            log.warning(
-                "transient device failure (%s: %s); evicting shards and "
-                "re-dispatching once",
-                type(err).__name__,
-                err,
-            )
+
+        def evict():
             from ..exec.lowering import _query_key
 
             qkey = _query_key(q, ds)
             self._lowering_cache.pop(qkey)
-            # spmd keys are _query_key + (local_rows, mesh, ...): evict only
-            # this query's programs, not every cached query's compile
+            # spmd keys are _query_key + (local_rows, mesh, ...): evict
+            # only this query's programs, not every cached compile
             for k in [k for k in self._spmd_cache if k[:2] == qkey]:
                 self._spmd_cache.pop(k)
             for k in [k for k in self._shard_cache if k[0] == ds.name]:
                 self._shard_cache.pop(k)
-            return self._execute_groupby_once(q, ds)
+
+        return run_device_attempts(
+            self, lambda: self._execute_groupby_once(q, ds), evict,
+            what="mesh device",
+        )
 
     def _route_strategy(self, q, ds, lowering, qkey) -> str:
         """Kernel-class choice for this query on the mesh — the identical
@@ -586,6 +591,12 @@ class DistributedEngine:
         from ..exec.lowering import _query_key
         from ..exec.metrics import QueryMetrics
 
+        from ..resilience import checkpoint, fire
+
+        # deadline checkpoint + device-dispatch fault site: the SPMD path
+        # honors the same lifecycle contract as the single-device engine
+        checkpoint("mesh.dispatch")
+        fire("device_dispatch")
         t_total = _time.perf_counter()
         lowering = self._lowering_for(q, ds)
         qkey = _query_key(q, ds)
@@ -628,6 +639,9 @@ class DistributedEngine:
         return out
 
     def _place_shards(self, ds, columns, m):
+        from ..resilience import fire
+
+        fire("h2d")  # fault-injection site: shard placement
         t0 = _time.perf_counter()
         known = len(self._shard_cache)
         before_bytes = self._shard_cache.bytes_used

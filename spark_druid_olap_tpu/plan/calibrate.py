@@ -462,7 +462,7 @@ def calibrate(
     if n_dev > 1 and not over():
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.mesh import DATA_AXIS, make_mesh
+        from ..parallel.mesh import DATA_AXIS, make_mesh, shard_map_compat
 
         mesh = make_mesh(n_data=n_dev, n_groups=1)
         state_g, state_m = 4096, 64  # 1 MiB of f32 merge state
@@ -477,22 +477,20 @@ def calibrate(
         # executing — hazard (b) of _timeit_synced
         @jax.jit
         @functools.partial(
-            jax.shard_map,
+            shard_map_compat,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P(),
-            check_vma=False,
         )
         def allreduce(x, salt):
             return jnp.sum(jax.lax.psum(x + salt, DATA_AXIS))
 
         @jax.jit
         @functools.partial(
-            jax.shard_map,
+            shard_map_compat,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P()),
             out_specs=P(),
-            check_vma=False,
         )
         def no_comm(x, salt):
             # the baseline's tiny psum carries the SALT (not a foldable
@@ -523,11 +521,10 @@ def calibrate(
 
         @jax.jit
         @functools.partial(
-            jax.shard_map,
+            shard_map_compat,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
             out_specs=P(),
-            check_vma=False,
         )
         def tiny_agg(gid, v, salt):
             return jnp.sum(

@@ -35,6 +35,10 @@ import numpy as np
 from .models import query as Q
 from .models.filters import _ms_to_iso
 from .models.wire import WireError, query_from_druid
+from .resilience import CircuitOpenError, DeadlineExceeded, deadline_scope
+from .utils.log import get_logger
+
+log = get_logger("server")
 
 
 def _jsonable(v: Any):
@@ -144,38 +148,66 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default
         pass
 
-    def _send(self, code: int, payload: Any):
+    def _send(self, code: int, payload: Any, headers: Optional[dict] = None):
         body = json.dumps(payload, default=_jsonable).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, msg: str):
-        self._send(code, {"error": msg})
+    def _error(
+        self,
+        code: int,
+        msg: str,
+        error_class: str = "QueryInterruptedException",
+        headers: Optional[dict] = None,
+    ):
+        # Druid's structured error object: `error` stays the readable
+        # message (clients and older tests read it), `errorMessage` /
+        # `errorClass` carry the structure Druid clients dispatch on
+        self._send(
+            code,
+            {"error": msg, "errorMessage": msg, "errorClass": error_class},
+            headers=headers,
+        )
 
     def _body(self) -> Optional[dict]:
         try:
             n = int(self.headers.get("Content-Length", 0))
-            return json.loads(self.rfile.read(n) or b"{}")
+            body = json.loads(self.rfile.read(n) or b"{}")
         except (ValueError, json.JSONDecodeError):
             return None
+        # valid JSON that isn't an object (`[1,2]`, `"x"`) is equally a
+        # client error, not a 500 from a surprised .get()
+        return body if isinstance(body, dict) else None
 
     # -- routes --------------------------------------------------------------
+
+    def _resilience(self):
+        return getattr(self.ctx, "resilience", None)
 
     def do_GET(self):
         path = self.path.split("?")[0].rstrip("/")
         if path in ("/status/health", ""):
-            return self._send(200, True)
+            res = self._resilience()
+            if res is None:
+                return self._send(200, True)
+            # breaker state + slots in use: a load balancer (or the
+            # concurrent-serving test) reads degradation from here
+            return self._send(200, res.health())
         if path == "/status":
             m = self.ctx.last_metrics
+            res = self._resilience()
             return self._send(
                 200,
                 {
                     "service": "spark-druid-olap-tpu",
                     "datasources": sorted(self.ctx.catalog.tables()),
                     "last_query_metrics": m.to_dict() if m else None,
+                    "resilience": res.health() if res else None,
                 },
             )
         if path == "/druid/v2/datasources":
@@ -205,27 +237,105 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?")[0].rstrip("/")
         body = self._body()
         if body is None:
-            return self._error(400, "invalid JSON body")
+            return self._error(
+                400, "invalid JSON body", "BadJsonQueryException"
+            )
+        if path not in ("/druid/v2", "/druid/v2/sql"):
+            return self._error(404, f"no route {path!r}", "NotFound")
+        res = self._resilience()
+        # admission control: a bounded slot pool with a queue-wait timeout
+        # answers 503 + Retry-After instead of piling handler threads
+        # behind a slow device until the process wedges
+        if res is not None and not res.admission.acquire():
+            return self._error(
+                503,
+                "query capacity exceeded; retry later",
+                "QueryCapacityExceededException",
+                headers={"Retry-After": res.admission.retry_after_s()},
+            )
         try:
-            if path == "/druid/v2":
-                return self._native_query(body)
-            if path == "/druid/v2/sql":
+            # Druid-native per-query deadline: `context.timeout` (ms)
+            # overrides the session default — including `timeout: 0`,
+            # Druid's explicit "no timeout".  The scope set HERE is the
+            # outermost, so ctx.sql's own scope defers to it.  A non-dict
+            # context is client noise, not a server error: ignore it.
+            qctx = body.get("context")
+            qctx = qctx if isinstance(qctx, dict) else {}
+            if "timeout" in qctx:
+                try:
+                    timeout_ms = float(qctx["timeout"])
+                except (TypeError, ValueError):
+                    timeout_ms = 0
+                if timeout_ms <= 0:
+                    # explicit opt-out: arm an INFINITE deadline so the
+                    # session default inside ctx.sql (which defers to any
+                    # outer scope) cannot re-arm a budget the client
+                    # declined
+                    timeout_ms = float("inf")
+            else:
+                cfg = getattr(self.ctx, "config", None)
+                timeout_ms = cfg.query_timeout_ms if cfg else 0
+            with deadline_scope(timeout_ms):
+                if path == "/druid/v2":
+                    return self._native_query(body)
                 return self._sql_query(body)
         except WireError as e:
-            return self._error(400, str(e))
+            return self._error(400, str(e), "BadQueryException")
         except KeyError as e:
-            return self._error(400, f"missing field: {e}")
+            return self._error(400, f"missing field: {e}", "BadQueryException")
         except Q.QueryValidationError as e:
             # validation of a decoded query (unknown orderBy column,
             # __time ordering on a timeless table): client error.  Plain
             # ValueError stays a 500 — internal invariants are not the
             # client's fault
-            return self._error(400, str(e))
-        except Exception as e:  # surface engine errors as 500 JSON
-            return self._error(500, f"{type(e).__name__}: {e}")
-        return self._error(404, f"no route {path!r}")
+            return self._error(400, str(e), "BadQueryException")
+        except CircuitOpenError as e:
+            # native wire queries have no logical plan to degrade to the
+            # host fallback with: an open breaker fails them FAST (503 +
+            # Retry-After) instead of burning retry budget on a device
+            # known to be down
+            return self._error(
+                503, str(e), "QueryUnavailableException",
+                headers={
+                    "Retry-After": res.admission.retry_after_s()
+                    if res is not None
+                    else 1
+                },
+            )
+        except DeadlineExceeded as e:
+            # the api layer counts SQL deadline expiry itself; only count
+            # here when the exception arrives uncounted (the native path)
+            if res is not None and not getattr(e, "_sdol_counted", False):
+                res.note_deadline_exceeded()
+            return self._error(504, str(e), "QueryTimeoutException")
+        except Exception as e:
+            # a 500 must not leak raw exception text (internals, paths,
+            # data values) to clients: structured Druid-style error out,
+            # full traceback to the server log, failure recorded on the
+            # resilience counters + the query's metrics
+            log.error("query failed: %s", type(e).__name__, exc_info=True)
+            # the failing query's OWN metrics already carry error_class
+            # (the engine retry loop stamps it); stamping last_metrics here
+            # would pollute an unrelated earlier query when the failure
+            # precedes execution (e.g. a parse error)
+            if res is not None:
+                res.note_server_error(e)
+            return self._error(
+                500,
+                "query execution failed; see server logs",
+                type(e).__name__,
+            )
+        finally:
+            if res is not None:
+                res.admission.release()
 
     def _native_query(self, body: dict):
+        res = self._resilience()
+        if res is not None and not res.breaker.allow():
+            raise CircuitOpenError(
+                "device circuit open; native queries cannot degrade to "
+                "the host fallback — retry after the breaker's cooldown"
+            )
         try:
             q = query_from_druid(body)
         except ValueError as e:

@@ -83,3 +83,63 @@ def f32_adjusted_compare(op: str, c: float):
     if adj_op == ">=":
         return lambda x: x >= thr
     return lambda x: x <= thr
+
+
+# f32-vs-f64 accumulation tolerance for comparing a device result against a
+# float64 host result (the fallback interpreter / a pandas oracle): the
+# engine's partial sums accumulate in f32, so equal queries agree to ~1e-5
+# relative.  Shared by the fault-injection differential suite and ad-hoc
+# parity checks so "identical within tolerance" means ONE thing repo-wide.
+F32_ACCUM_RTOL = 2e-5
+
+
+def frames_allclose(a, b, rtol: float = F32_ACCUM_RTOL, atol: float = 1e-8):
+    """Order-insensitive DataFrame equivalence under f32-accumulation
+    tolerance: same columns, same rows after sorting by every column
+    (string columns compared exactly, numeric within rtol/atol, NaN==NaN).
+    Returns (ok, message)."""
+    import numpy as np
+    import pandas as pd
+
+    if list(a.columns) != list(b.columns):
+        return False, f"columns differ: {list(a.columns)} vs {list(b.columns)}"
+    if len(a) != len(b):
+        return False, f"row counts differ: {len(a)} vs {len(b)}"
+    if not len(a):
+        return True, ""
+
+    def _norm(df):
+        out = df.copy()
+        for c in out.columns:
+            v = out[c]
+            if v.dtype.kind in "fc":
+                # quantize floats so near-ties sort identically both sides
+                out[c] = np.round(v.astype(np.float64), 4)
+        return out
+
+    order = list(a.columns)
+    ai = _norm(a).sort_values(order, kind="stable").index
+    bi = _norm(b).sort_values(order, kind="stable").index
+    a = a.loc[ai].reset_index(drop=True)
+    b = b.loc[bi].reset_index(drop=True)
+    for c in a.columns:
+        av, bv = np.asarray(a[c]), np.asarray(b[c])
+        if av.dtype.kind in "fc" or bv.dtype.kind in "fc":
+            av = av.astype(np.float64)
+            bv = bv.astype(np.float64)
+            both_nan = np.isnan(av) & np.isnan(bv)
+            close = np.isclose(av, bv, rtol=rtol, atol=atol) | both_nan
+            if not close.all():
+                i = int(np.argmin(close))
+                return False, (
+                    f"column {c!r} row {i}: {av[i]!r} != {bv[i]!r} "
+                    f"(rtol={rtol})"
+                )
+        else:
+            sa, sb = pd.Series(av), pd.Series(bv)
+            na_a, na_b = np.asarray(sa.isna()), np.asarray(sb.isna())
+            eq = (np.asarray(sa.eq(sb)) & ~na_a & ~na_b) | (na_a & na_b)
+            if not eq.all():
+                i = int(np.argmin(eq))
+                return False, f"column {c!r} row {i}: {av[i]!r} != {bv[i]!r}"
+    return True, ""

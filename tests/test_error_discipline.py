@@ -1,0 +1,104 @@
+"""Tier-1 gate for tools/check_error_discipline.py: every broad `except`
+in the serving/execution layers must re-raise, route through the
+resilience classifier, record observably, or carry an explicit
+`# fault-ok: <reason>` pragma — no silent swallows (ISSUE 1 satellite)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import check_error_discipline as ced  # noqa: E402
+
+
+def test_no_silent_broad_excepts():
+    violations = ced.check_paths(_ROOT)
+    assert not violations, "\n".join(
+        f"{p}:{ln}: {msg}" for p, ln, msg in violations
+    )
+
+
+def test_target_set_covers_serving_and_execution():
+    files = {os.path.relpath(f, _ROOT) for f in ced.target_files(_ROOT)}
+    assert "spark_druid_olap_tpu/server.py" in files
+    assert any(f.startswith("spark_druid_olap_tpu/exec/") for f in files)
+    assert any(f.startswith("spark_druid_olap_tpu/parallel/") for f in files)
+
+
+def test_checker_flags_a_silent_swallow(tmp_path):
+    """The checker actually catches the bad shape (guards against the
+    checker rotting into a rubber stamp)."""
+    pkg = tmp_path / "spark_druid_olap_tpu"
+    (pkg / "exec").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    (pkg / "server.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    (pkg / "exec" / "ok.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        raise\n"
+    )
+    violations = ced.check_paths(str(tmp_path))
+    assert len(violations) == 1
+    assert violations[0][0].endswith("server.py")
+
+
+def test_checker_accepts_pragma_and_logging(tmp_path):
+    pkg = tmp_path / "spark_druid_olap_tpu"
+    (pkg / "exec").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    (pkg / "server.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:  # fault-ok: best-effort probe\n"
+        "        pass\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        log.warning('failed', exc_info=True)\n"
+    )
+    assert ced.check_paths(str(tmp_path)) == []
+    # a bare pragma with no reason does NOT count
+    (pkg / "server.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:  # fault-ok:\n"
+        "        pass\n"
+    )
+    assert len(ced.check_paths(str(tmp_path))) == 1
+
+
+def test_cli_entrypoint_exit_codes(tmp_path):
+    tool = os.path.join(_ROOT, "tools", "check_error_discipline.py")
+    # the real repo passes
+    out = subprocess.run(
+        [sys.executable, tool, _ROOT], capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    # a violating tree fails
+    pkg = tmp_path / "spark_druid_olap_tpu"
+    (pkg / "exec").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    (pkg / "server.py").write_text(
+        "try:\n    x()\nexcept Exception:\n    y = 1\n"
+    )
+    out = subprocess.run(
+        [sys.executable, tool, str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 1
+    assert "server.py" in out.stdout

@@ -1,0 +1,217 @@
+"""Fault-injection differential suite + the SSB-13 full-degradation
+acceptance run (ISSUE 1 tentpole).
+
+An injected device-path failure must produce a fallback result identical
+(within utils/floatcmp tolerance) to the uninjected device result, with
+the degradation observable (executor == "fallback", degraded flag,
+breaker state).  With 100% device-dispatch failure armed, every SSB-13
+query still answers correctly, the breaker reports `open` on
+`/status/health`, and after disarming it recovers to `closed` within the
+half-open probe budget."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.resilience import InjectedFault, injector
+from spark_druid_olap_tpu.utils.floatcmp import frames_allclose
+from spark_druid_olap_tpu.workloads import ssb
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injector().disarm()
+    yield
+    injector().disarm()
+
+
+def _ctx(**overrides):
+    cfg = SessionConfig.load_calibrated()
+    # the differential reruns the SAME query: the result cache would serve
+    # the device answer back and hide the fallback path entirely
+    cfg.result_cache_entries = 0
+    cfg.retry_backoff_ms = 1.0
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return sd.TPUOlapContext(cfg)
+
+
+@pytest.fixture(scope="module")
+def ssb_ctx_tables():
+    tables = ssb.gen_tables(scale=0.01, seed=7)
+    return tables
+
+
+def _fresh_ssb_ctx(tables, **overrides):
+    ctx = _ctx(**overrides)
+    ssb.register(ctx, tables=tables, rows_per_segment=1 << 15)
+    return ctx
+
+
+# -- differential: injected device failure == uninjected device result ------
+
+# sampled across the suite's shapes: scalar aggregate, star groupby,
+# high-cardinality groupby, multi-join rollup
+_SAMPLED = ("q1_1", "q2_1", "q3_2", "q4_1")
+
+
+@pytest.mark.parametrize("qname", _SAMPLED)
+def test_device_fault_differential(ssb_ctx_tables, qname):
+    ctx = _fresh_ssb_ctx(ssb_ctx_tables)
+    want = ctx.sql(ssb.QUERIES[qname])
+    assert ctx.last_metrics.executor == "device"  # the baseline ran on-path
+
+    injector().arm("device_dispatch", "error")
+    got = ctx.sql(ssb.QUERIES[qname])
+    m = ctx.last_metrics
+    assert m.executor == "fallback"
+    assert m.degraded is True
+    ok, msg = frames_allclose(got, want)
+    assert ok, f"{qname}: {msg}"
+
+
+def test_h2d_fault_differential(ssb_ctx_tables):
+    """A failure on the host->device transfer path degrades identically."""
+    ctx = _fresh_ssb_ctx(ssb_ctx_tables)
+    want = ctx.sql(ssb.QUERIES["q2_1"])
+    # evict residency so the rerun actually pays (and fails) the transfer
+    ctx.engine.clear_cache()
+    injector().arm("h2d", "error")
+    got = ctx.sql(ssb.QUERIES["q2_1"])
+    assert ctx.last_metrics.executor == "fallback"
+    ok, msg = frames_allclose(got, want)
+    assert ok, msg
+
+
+def test_fault_metrics_record_retries_and_error_class(ssb_ctx_tables):
+    ctx = _fresh_ssb_ctx(ssb_ctx_tables, retry_max_attempts=2)
+    injector().arm("device_dispatch", "error")
+    ctx.sql(ssb.QUERIES["q1_1"])
+    m = ctx.last_metrics
+    assert m.degraded and m.executor == "fallback"
+    assert m.error_class == "InjectedFault"
+    assert m.circuit_state in ("closed", "open", "half_open")
+
+
+def test_transient_blip_retries_and_stays_on_device(ssb_ctx_tables):
+    """ONE injected dispatch failure is absorbed by the engine's retry:
+    the query still answers on the device path, observably retried."""
+    ctx = _fresh_ssb_ctx(ssb_ctx_tables)
+    want = ctx.sql(ssb.QUERIES["q2_1"])
+    injector().arm("device_dispatch", "error", times=1)
+    got = ctx.sql(ssb.QUERIES["q2_1"])
+    m = ctx.last_metrics
+    assert m.executor == "device"
+    assert m.retries == 1
+    ok, msg = frames_allclose(got, want)
+    assert ok, msg
+
+
+# -- acceptance: SSB-13 under 100% device-dispatch failure ------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def test_ssb13_answers_through_open_breaker_then_recovers(ssb_ctx_tables):
+    from spark_druid_olap_tpu.server import OlapServer
+
+    # long cooldown: the breaker must still read `open` after all 13
+    # degraded queries, however slowly the host interpreter grinds
+    ctx = _fresh_ssb_ctx(
+        ssb_ctx_tables,
+        breaker_failure_threshold=3,
+        breaker_cooldown_ms=600_000,
+    )
+    baseline = {}
+    for name, q in ssb.QUERIES.items():
+        baseline[name] = ctx.sql(q)
+        assert ctx.last_metrics.executor == "device", name
+
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        injector().arm("device_dispatch", "error")  # 100% failure
+        fallback_count = 0
+        for name, q in ssb.QUERIES.items():
+            got = ctx.sql(q)
+            m = ctx.last_metrics
+            assert m.executor == "fallback", name
+            assert m.degraded is True, name
+            fallback_count += 1
+            ok, msg = frames_allclose(got, baseline[name])
+            assert ok, f"{name}: {msg}"
+        assert fallback_count == len(ssb.QUERIES) == 13
+
+        health = _get(srv.port, "/status/health")
+        assert health["breaker"]["state"] == "open"
+        assert health["breaker"]["trips"] >= 1
+        assert health["counters"]["degraded_total"] >= 13
+
+        # disarm and recover: within the half-open probe budget (one
+        # successful probe after the cooldown) the breaker closes and
+        # queries run on the device again
+        injector().disarm()
+        ctx.resilience.breaker.cooldown_ms = 0.0  # cooldown elapses now
+        got = ctx.sql(ssb.QUERIES["q1_1"])
+        m = ctx.last_metrics
+        assert m.executor == "device"
+        ok, msg = frames_allclose(got, baseline["q1_1"])
+        assert ok, msg
+        health = _get(srv.port, "/status/health")
+        assert health["breaker"]["state"] == "closed"
+    finally:
+        srv.shutdown()
+
+
+def test_breaker_open_skips_device_attempts(ssb_ctx_tables):
+    """While open (cooldown pending), queries must not burn retry budget
+    against a known-bad device: no new dispatch fires reach the injector."""
+    ctx = _fresh_ssb_ctx(
+        ssb_ctx_tables,
+        breaker_failure_threshold=1,
+        breaker_cooldown_ms=600_000,
+    )
+    ctx.sql(ssb.QUERIES["q1_1"])  # warm plans on the healthy device
+    injector().arm("device_dispatch", "error")
+    ctx.sql(ssb.QUERIES["q1_1"])  # trips the breaker (threshold 1)
+    assert ctx.resilience.breaker.state == "open"
+    fired_before = injector().state()["fired"].get("device_dispatch", 0)
+    ctx.sql(ssb.QUERIES["q1_2"])
+    assert ctx.last_metrics.executor == "fallback"
+    assert ctx.last_metrics.circuit_state == "open"
+    fired_after = injector().state()["fired"].get("device_dispatch", 0)
+    assert fired_after == fired_before  # no device attempt while open
+
+
+def test_fallback_decode_partial_fault_truncates():
+    """The `partial` mode at the fallback-decode site deterministically
+    truncates the decode — the torn-result shape crash-safety tests use."""
+    import pandas as pd
+
+    ctx = _ctx()
+    n = 1000
+    ctx.register_table(
+        "pt",
+        {
+            "d": np.array(["a", "b"] * (n // 2), dtype=object),
+            "v": np.ones(n, dtype=np.float32),
+        },
+        dimensions=["d"],
+        metrics=["v"],
+    )
+    # an unplannable shape (window fn) forces the host fallback
+    q = "SELECT d, sum(v) AS s, RANK() OVER (ORDER BY sum(v)) r FROM pt GROUP BY d"
+    full = ctx.sql(q)
+    assert int(full["s"].sum()) == n
+    injector().arm("fallback_decode", "partial", fraction=0.5)
+    half = ctx.sql(q)
+    assert int(half["s"].sum()) == n // 2

@@ -70,7 +70,11 @@ def _post(srv, path, payload):
 
 def test_health_and_metadata(served):
     _, srv, _ = served
-    assert _get(srv, "/status/health") is True
+    health = _get(srv, "/status/health")
+    assert health["healthy"] is True
+    assert health["breaker"]["state"] == "closed"
+    assert health["admission"]["slots_in_use"] == 0
+    assert health["admission"]["slots_total"] >= 1
     assert _get(srv, "/druid/v2/datasources") == ["ev"]
     meta = _get(srv, "/druid/v2/datasources/ev")
     assert meta["dimensions"] == ["city"]

@@ -1,0 +1,357 @@
+"""Serving-layer resilience: admission control (503 + Retry-After),
+structured error objects (no leaked internals), wire `context.timeout`
+deadlines (504), and health consistency under concurrent load with faults
+armed (ISSUE 1 satellites)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.resilience import injector
+from spark_druid_olap_tpu.server import OlapServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injector().disarm()
+    yield
+    injector().disarm()
+
+
+def _make_ctx(**overrides):
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0
+    cfg.retry_backoff_ms = 1.0
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    ctx = sd.TPUOlapContext(cfg)
+    n = 4_000
+    rng = np.random.default_rng(11)
+    ctx.register_table(
+        "ev",
+        {
+            "city": rng.choice(
+                np.array(["NY", "SF", "LA"], dtype=object), n
+            ),
+            "v": rng.random(n).astype(np.float32),
+        },
+        dimensions=["city"],
+        metrics=["v"],
+    )
+    return ctx
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def _health_drained(port):
+    """Health snapshot once slots drain (the handler releases its slot a
+    hair after the response bytes land — poll out the benign race)."""
+    h = None
+    for _ in range(100):
+        h = _get(port, "/status/health")
+        if h["admission"]["slots_in_use"] == 0:
+            return h
+        time.sleep(0.01)
+    return h
+
+
+def _post(port, path, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+_SQL = {"query": "SELECT city, sum(v) AS s FROM ev GROUP BY city"}
+
+
+def test_structured_500_no_internal_leak():
+    ctx = _make_ctx(fallback_execution=False)
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        injector().arm("device_dispatch", "error")
+        code, body, _ = _post(srv.port, "/druid/v2/sql", _SQL)
+        assert code == 500
+        # structured Druid-style error object, raw exception text withheld
+        assert set(body) == {"error", "errorMessage", "errorClass"}
+        assert body["errorClass"] == "InjectedFault"
+        for v in body.values():
+            assert "Traceback" not in v
+            assert "injected fault at site" not in v  # raw str(e) withheld
+        # the failure is recorded on the health counters
+        h = _get(srv.port, "/status/health")
+        assert h["counters"]["server_errors_total"] >= 1
+        assert h["counters"]["last_error"]["errorClass"] == "InjectedFault"
+    finally:
+        srv.shutdown()
+
+
+def test_client_errors_keep_readable_message():
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        code, body, _ = _post(
+            srv.port, "/druid/v2",
+            {"queryType": "groupBy", "dataSource": "nope",
+             "dimensions": [], "aggregations": []},
+        )
+        assert code == 400
+        assert "unknown dataSource" in body["error"]
+        assert body["errorClass"]
+    finally:
+        srv.shutdown()
+
+
+def test_wire_context_timeout_yields_504():
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        # a 150ms device stall against a 30ms wire deadline: the resolve
+        # checkpoint fires deterministically after the injected delay
+        injector().arm("device_dispatch", "delay", delay_ms=150)
+        code, body, _ = _post(
+            srv.port, "/druid/v2/sql",
+            {**_SQL, "context": {"timeout": 30}},
+        )
+        assert code == 504
+        assert body["errorClass"] == "QueryTimeoutException"
+        assert "deadline" in body["error"]
+        h = _get(srv.port, "/status/health")
+        assert h["counters"]["deadline_exceeded_total"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_admission_503_carries_retry_after():
+    ctx = _make_ctx(
+        max_concurrent_queries=1, admission_queue_timeout_ms=60
+    )
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        injector().arm("device_dispatch", "delay", delay_ms=400)
+        results = []
+        lock = threading.Lock()
+
+        def hit():
+            r = _post(srv.port, "/druid/v2/sql", _SQL)
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=hit) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        codes = sorted(c for c, _, _ in results)
+        assert 503 in codes  # the pool is 1 wide: someone was rejected
+        for code, body, headers in results:
+            if code == 503:
+                assert body["errorClass"] == "QueryCapacityExceededException"
+                assert int(headers["Retry-After"]) >= 1
+            else:
+                assert code == 200
+        # slots drain fully once the burst is over
+        h = _health_drained(srv.port)
+        assert h["admission"]["slots_in_use"] == 0
+        assert h["admission"]["rejected_total"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_concurrent_hammer_with_faults_no_unstructured_500s():
+    """N threads against /druid/v2/sql while device faults are armed: every
+    response is 200 (degraded fallback answers) or a STRUCTURED error;
+    /status/health stays consistent before/during/after the tripped
+    breaker."""
+    ctx = _make_ctx(
+        max_concurrent_queries=2,
+        admission_queue_timeout_ms=100,
+        breaker_failure_threshold=2,
+        breaker_cooldown_ms=600_000,
+    )
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        h0 = _get(srv.port, "/status/health")
+        assert h0["breaker"]["state"] == "closed"
+
+        injector().arm("device_dispatch", "error")
+        results = []
+        lock = threading.Lock()
+
+        def hit():
+            r = _post(srv.port, "/druid/v2/sql", _SQL)
+            with lock:
+                results.append(r)
+            # health must stay servable mid-storm
+            h = _get(srv.port, "/status/health")
+            assert h["breaker"]["state"] in ("closed", "open", "half_open")
+            assert (
+                0
+                <= h["admission"]["slots_in_use"]
+                <= h["admission"]["slots_total"]
+            )
+
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(results) == 8
+        want = ctx.sql(_SQL["query"]).sort_values("city")
+        for code, body, headers in results:
+            if code == 200:
+                got = sorted(r["city"] for r in body)
+                assert got == list(want["city"])
+            else:
+                # every error is structured — no unstructured 500s
+                assert isinstance(body, dict) and "errorClass" in body, body
+                assert code in (500, 503, 504)
+                if code == 503:
+                    assert "Retry-After" in headers
+        # the failure storm tripped the breaker; health reports it and
+        # all slots drained
+        h1 = _health_drained(srv.port)
+        assert h1["breaker"]["state"] == "open"
+        assert h1["admission"]["slots_in_use"] == 0
+        assert h1["counters"]["degraded_total"] >= 1
+
+        # after disarm + cooldown the breaker closes again on a probe
+        injector().disarm()
+        ctx.resilience.breaker.cooldown_ms = 0.0
+        code, body, _ = _post(srv.port, "/druid/v2/sql", _SQL)
+        assert code == 200
+        h2 = _health_drained(srv.port)
+        assert h2["breaker"]["state"] == "closed"
+        assert h2["admission"]["slots_in_use"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_native_path_fails_fast_while_breaker_open():
+    """Native wire queries have no logical plan to degrade with: an open
+    breaker answers 503 + Retry-After immediately instead of burning the
+    retry budget against a known-bad device."""
+    ctx = _make_ctx(breaker_failure_threshold=1, breaker_cooldown_ms=600_000)
+    srv = OlapServer(ctx, port=0).start()
+    native = {
+        "queryType": "timeseries",
+        "dataSource": "ev",
+        "granularity": "all",
+        "aggregations": [{"type": "count", "name": "n"}],
+    }
+    try:
+        injector().arm("device_dispatch", "error")
+        ctx.sql(_SQL["query"])  # trips the breaker (threshold 1)
+        assert ctx.resilience.breaker.state == "open"
+        fired = injector().state()["fired"].get("device_dispatch", 0)
+        code, body, headers = _post(srv.port, "/druid/v2", native)
+        assert code == 503
+        assert body["errorClass"] == "QueryUnavailableException"
+        assert int(headers["Retry-After"]) >= 1
+        # failed fast: no device attempt reached the injector
+        assert injector().state()["fired"].get("device_dispatch", 0) == fired
+        # SQL still answers (degraded) through the same open breaker
+        code, rows, _ = _post(srv.port, "/druid/v2/sql", _SQL)
+        assert code == 200
+    finally:
+        srv.shutdown()
+
+
+def test_context_timeout_zero_disables_session_deadline():
+    """Druid semantics: `context.timeout: 0` means NO timeout and must
+    override a session default, not fall through to it; a non-dict
+    context is client noise (ignored), not a 500."""
+    ctx = _make_ctx(query_timeout_ms=30)
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        injector().arm("device_dispatch", "delay", delay_ms=120)
+        # session deadline (30ms) would 504 this — timeout:0 opts out
+        code, rows, _ = _post(
+            srv.port, "/druid/v2/sql", {**_SQL, "context": {"timeout": 0}}
+        )
+        assert code == 200 and len(rows) == 3
+        # a string context must not become a 500
+        injector().disarm()
+        code, rows, _ = _post(
+            srv.port, "/druid/v2/sql", {**_SQL, "context": "fast"}
+        )
+        assert code == 200
+    finally:
+        srv.shutdown()
+
+
+def test_non_groupby_probe_closes_breaker():
+    """A half-open probe served by a SCAN query must still close the
+    breaker (breaker accounting is not GroupBy-only)."""
+    ctx = _make_ctx(breaker_failure_threshold=1, breaker_cooldown_ms=600_000)
+    injector().arm("device_dispatch", "error")
+    ctx.sql(_SQL["query"])  # trips it
+    assert ctx.resilience.breaker.state == "open"
+    injector().disarm()
+    ctx.resilience.breaker.cooldown_ms = 0.0
+    df = ctx.sql("SELECT city FROM ev LIMIT 5")  # scan path probe
+    assert len(df) == 5
+    assert ctx.resilience.breaker.state == "closed"
+
+
+def test_non_object_json_body_is_400():
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        code, body, _ = _post(srv.port, "/druid/v2/sql", [1, 2, 3])
+        assert code == 400
+        assert body["errorClass"] == "BadJsonQueryException"
+    finally:
+        srv.shutdown()
+
+
+def test_breaker_open_serves_result_cache_hits():
+    """A cached exact device answer must not be re-paid on the host
+    interpreter just because the breaker is open."""
+    cfg_overrides = dict(
+        breaker_failure_threshold=1, breaker_cooldown_ms=600_000
+    )
+    ctx = _make_ctx(**cfg_overrides)
+    ctx.config.result_cache_entries = 8  # cache ON for this test
+    q = _SQL["query"]
+    want = ctx.sql(q)
+    assert ctx.last_metrics.executor == "device"
+    injector().arm("device_dispatch", "error")
+    ctx.sql("SELECT count(*) AS n FROM ev WHERE city = 'NY'")  # trips it
+    assert ctx.resilience.breaker.state == "open"
+    injector().disarm()
+    got = ctx.sql(q)  # same query: served from the result cache
+    m = ctx.last_metrics
+    assert m.strategy == "result-cache"
+    assert m.executor == "device" and not m.degraded
+    assert list(got["s"]) == list(want["s"])
+
+
+def test_status_includes_resilience_block():
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        st = _get(srv.port, "/status")
+        assert st["resilience"]["breaker"]["state"] == "closed"
+        assert st["resilience"]["admission"]["slots_total"] >= 1
+    finally:
+        srv.shutdown()
