@@ -87,7 +87,10 @@ class SparseExecMixin:
             if not self._pallas_broken and pallas_available()
             else "segment"
         )
-        key = _query_key(q, ds) + (f"sparse:{inner}:{row_capacity}:{slots}",)
+        # structured key, NOT an f-string: interpolation collapses distinct
+        # identities (None vs "None") and the pallas-eviction scan matches
+        # on the rendered tuple (graftlint jit-cache/GL103)
+        key = _query_key(q, ds) + ("sparse", inner, row_capacity, slots)
         cached = self._query_fn_cache.get(key)
         if cached is not None:
             if self._m is not None:

@@ -122,6 +122,7 @@ class StreamExecutor:
             cols = dict(dev)
             off = cols.pop("__time_off", None)
             if off is not None:
+                # graftlint: disable=dtype-x64 -- time is int64 ms by engine contract
                 t = base + off.astype(jnp.int64)
                 cols[time_col] = t
                 cols["__time"] = t

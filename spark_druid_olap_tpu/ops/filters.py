@@ -82,7 +82,10 @@ class DecodedView:
         d = self._dicts[name] if name in self._dicts else None
         if d is not None and d.numeric_values is not None:
             nv = jnp.asarray(d.numeric_values)
-            # null codes (-1) decode to -1, matching the raw-value convention
+            # null codes (-1) decode to -1, matching the raw-value
+            # convention; the sentinel is int64 because numeric dictionary
+            # values may be int64 (times)
+            # graftlint: disable=dtype-x64 -- null sentinel must match int64 dict values
             return jnp.where(c >= 0, nv[jnp.maximum(c, 0)], jnp.int64(-1))
         return c
 

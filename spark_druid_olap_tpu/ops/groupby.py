@@ -149,15 +149,19 @@ def dense_partial_aggregate(
             v = mmv[:, :num_min]
             mm = m[:, None] & mmm[:, :num_min]
             # [B, G, Mn] masked-where then reduce rows — VPU, B*G*Mn elems.
+            # inf fills are dtype-matched: a weak Python float promotes the
+            # select to f64 under x64 (graftlint dtype-x64/GL303)
             w = jnp.where(
-                match[:, :, None] & mm[:, None, :], v[:, None, :], jnp.inf
+                match[:, :, None] & mm[:, None, :], v[:, None, :],
+                jnp.asarray(jnp.inf, dtype=v.dtype),
             )
             mins = jnp.minimum(mins, w.min(axis=0))
         if num_max:
             v = mmv[:, num_min:]
             mm = m[:, None] & mmm[:, num_min:]
             w = jnp.where(
-                match[:, :, None] & mm[:, None, :], v[:, None, :], -jnp.inf
+                match[:, :, None] & mm[:, None, :], v[:, None, :],
+                jnp.asarray(-jnp.inf, dtype=v.dtype),
             )
             maxs = jnp.maximum(maxs, w.max(axis=0))
         return (sums, mins, maxs), None
@@ -195,14 +199,17 @@ def scatter_partial_aggregate(
     maxs = jnp.zeros((num_groups, num_max), jnp.float32)
     if num_min + num_max:
         Mn = num_min
+        # dtype-matched inf fills (weak floats promote to f64 under x64 —
+        # graftlint dtype-x64/GL303)
+        pos = jnp.asarray(jnp.inf, dtype=minmax_values.dtype)
         if Mn:
-            v = jnp.where(minmax_masks[:, :Mn], minmax_values[:, :Mn], jnp.inf)
+            v = jnp.where(minmax_masks[:, :Mn], minmax_values[:, :Mn], pos)
             mins = jax.ops.segment_min(v, seg, num_segments=num_groups + 1)[
                 :num_groups
             ]
         Mx = minmax_values.shape[1] - Mn
         if Mx:
-            v = jnp.where(minmax_masks[:, Mn:], minmax_values[:, Mn:], -jnp.inf)
+            v = jnp.where(minmax_masks[:, Mn:], minmax_values[:, Mn:], -pos)
             maxs = jax.ops.segment_max(v, seg, num_segments=num_groups + 1)[
                 :num_groups
             ]

@@ -98,12 +98,18 @@ def _kernel(
         preferred_element_type=jnp.float32,
     )
 
-    # VPU: masked min/max over the same match tile, one agg column at a time
+    # VPU: masked min/max over the same match tile, one agg column at a time.
+    # The +/-inf fill is materialized AT THE REF DTYPE: a bare Python float
+    # here is weak-typed, and under x64 the old-jax interpret-mode lowering
+    # promotes the select to f64 ('func.call' operand mismatch, the seed
+    # pallas failure) — dtype-matched selects never promote.
     for m in range(num_min):
-        w = jnp.where(match, minv_ref[m, :][:, None], _POS)  # (BR, BG)
+        pos = jnp.asarray(_POS, dtype=out_min_ref.dtype)
+        w = jnp.where(match, minv_ref[m, :][:, None], pos)  # (BR, BG)
         out_min_ref[m, :] = jnp.minimum(out_min_ref[m, :], w.min(axis=0))
     for m in range(num_max):
-        w = jnp.where(match, maxv_ref[m, :][:, None], _NEG)
+        neg = jnp.asarray(_NEG, dtype=out_max_ref.dtype)
+        w = jnp.where(match, maxv_ref[m, :][:, None], neg)
         out_max_ref[m, :] = jnp.maximum(out_max_ref[m, :], w.max(axis=0))
 
 
@@ -157,7 +163,8 @@ def pallas_partial_aggregate(
     mn_t = (
         jnp.where(
             mask[:, None] & minmax_masks[:, :num_min],
-            minmax_values[:, :num_min], _POS,
+            minmax_values[:, :num_min],
+            jnp.asarray(_POS, dtype=minmax_values.dtype),
         ).T
         if num_min
         else jnp.zeros((1, R), jnp.float32)
@@ -165,7 +172,8 @@ def pallas_partial_aggregate(
     mx_t = (
         jnp.where(
             mask[:, None] & minmax_masks[:, num_min:],
-            minmax_values[:, num_min:], _NEG,
+            minmax_values[:, num_min:],
+            jnp.asarray(_NEG, dtype=minmax_values.dtype),
         ).T
         if num_max
         else jnp.zeros((1, R), jnp.float32)

@@ -196,8 +196,11 @@ def segmented_reduce_sorted(
         if num_min:
             v = mmv[:, :num_min]
             mm = m[:, None] & mmm[:, :num_min]
+            # dtype-matched inf fill (weak floats promote to f64 under x64
+            # — graftlint dtype-x64/GL303)
             w = jnp.where(
-                match[:, :, None] & mm[:, None, :], v[:, None, :], jnp.inf
+                match[:, :, None] & mm[:, None, :], v[:, None, :],
+                jnp.asarray(jnp.inf, dtype=v.dtype),
             ).min(axis=0)
             win = lax.dynamic_slice(mins, (base, z), (B, num_min))
             mins = lax.dynamic_update_slice(
@@ -207,7 +210,8 @@ def segmented_reduce_sorted(
             v = mmv[:, num_min:]
             mm = m[:, None] & mmm[:, num_min:]
             w = jnp.where(
-                match[:, :, None] & mm[:, None, :], v[:, None, :], -jnp.inf
+                match[:, :, None] & mm[:, None, :], v[:, None, :],
+                jnp.asarray(-jnp.inf, dtype=v.dtype),
             ).max(axis=0)
             win = lax.dynamic_slice(maxs, (base, z), (B, num_max))
             maxs = lax.dynamic_update_slice(

@@ -404,10 +404,11 @@ class DistributedEngine:
         )
 
         inner = self._sparse_inner()
+        # structured key, NOT an f-string (graftlint jit-cache/GL103)
         cache_key = _query_key(lowering.query, ds) + (
             local_rows,
             self._mesh_key(),
-            f"sparse:{inner}:{row_capacity}:{slots}",
+            "sparse", inner, row_capacity, slots,
         )
         if cache_key in self._spmd_cache:
             return self._spmd_cache[cache_key]

@@ -867,6 +867,7 @@ def compile_expr(
                 f"calendar granularity {e.granularity!r} has no fixed period; "
                 "only legal in GROUP BY position (dimension bucketing)"
             )
+        # graftlint: disable=dtype-x64 -- time bucketing is int64 ms by contract
         return lambda cols: (jnp.asarray(f(cols)) // p * p).astype(jnp.int64)
     if isinstance(e, TimeExtract):
         if e.field not in _EXTRACT_FIELDS:

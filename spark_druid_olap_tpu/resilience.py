@@ -494,24 +494,48 @@ class AdmissionController:
     `acquire()` waits up to `queue_timeout_ms` for a slot and returns
     False on timeout — the serving layer turns that into 503 +
     Retry-After instead of letting ThreadingHTTPServer stack an unbounded
-    thread pile-up behind a slow device."""
+    thread pile-up behind a slow device.
+
+    The Retry-After hint is computed from OBSERVED load, not the
+    configured wait (ROADMAP resilience follow-up (d)): the pool tracks
+    how many callers are currently queued (`queue_depth`) and an EWMA of
+    how long admitted queries actually hold a slot; the hint is the
+    estimated drain time of the queue ahead of a returning client.  An
+    idle pool that rejected a burst therefore says "1s", while a pool
+    behind a slow device scales the hint with real backlog instead of
+    parroting `queue_timeout_ms`."""
+
+    # EWMA weight of the newest hold-time observation: heavy enough to
+    # track a phase change (cold compiles -> warm dispatch) within a few
+    # queries, light enough that one outlier does not swing the hint
+    _HOLD_EWMA_ALPHA = 0.2
 
     def __init__(self, max_concurrent: int = 8,
-                 queue_timeout_ms: float = 2000.0):
+                 queue_timeout_ms: float = 2000.0,
+                 clock: Callable[[], float] = time.monotonic):
         self.max_concurrent = max(1, int(max_concurrent))
         self.queue_timeout_ms = float(queue_timeout_ms)
+        self._clock = clock
         self._sem = threading.BoundedSemaphore(self.max_concurrent)
         self._lock = threading.Lock()
         self._in_use = 0
         self.admitted_total = 0
         self.rejected_total = 0
+        # observed-load tracking for the Retry-After estimate
+        self._waiting = 0  # callers currently blocked in acquire()
+        self._hold_ewma_ms: Optional[float] = None
+        self._held_since: Dict[int, float] = {}  # thread id -> acquire time
 
     def acquire(self) -> bool:
+        with self._lock:
+            self._waiting += 1
         ok = self._sem.acquire(timeout=self.queue_timeout_ms / 1e3)
         with self._lock:
+            self._waiting -= 1
             if ok:
                 self._in_use += 1
                 self.admitted_total += 1
+                self._held_since[threading.get_ident()] = self._clock()
             else:
                 self.rejected_total += 1
         return ok
@@ -519,6 +543,15 @@ class AdmissionController:
     def release(self) -> None:
         with self._lock:
             self._in_use -= 1
+            t0 = self._held_since.pop(threading.get_ident(), None)
+            if t0 is not None:
+                held_ms = (self._clock() - t0) * 1e3
+                a = self._HOLD_EWMA_ALPHA
+                self._hold_ewma_ms = (
+                    held_ms
+                    if self._hold_ewma_ms is None
+                    else (1 - a) * self._hold_ewma_ms + a * held_ms
+                )
         self._sem.release()
 
     @property
@@ -526,15 +559,37 @@ class AdmissionController:
         with self._lock:
             return self._in_use
 
+    @property
+    def queue_depth(self) -> int:
+        """Callers currently blocked waiting for a slot."""
+        with self._lock:
+            return self._waiting
+
     def retry_after_s(self) -> int:
-        """Client backoff hint: at least the queue wait we already burned."""
-        return max(1, int(-(-self.queue_timeout_ms // 1000)))
+        """Client backoff hint from observed queue depth x observed hold
+        time: a returning client waits for the queue ahead of it to drain
+        (`depth / slots` hold intervals) plus its own slot tenure.  Before
+        any hold time is observed the configured queue wait stands in.
+        Clamped to [1s, 60s] — HTTP Retry-After is a coarse hint, and a
+        wedged device must not tell dashboards to go away for an hour."""
+        with self._lock:
+            depth = self._waiting
+            hold_ms = (
+                self._hold_ewma_ms
+                if self._hold_ewma_ms is not None
+                else self.queue_timeout_ms
+            )
+        eta_ms = hold_ms * (depth / self.max_concurrent + 1.0)
+        return max(1, min(60, int(-(-eta_ms // 1000))))
 
     def to_dict(self) -> dict:
         with self._lock:
+            hold = self._hold_ewma_ms
             return {
                 "slots_in_use": self._in_use,
                 "slots_total": self.max_concurrent,
+                "queue_depth": self._waiting,
+                "hold_ewma_ms": round(hold, 3) if hold is not None else None,
                 "queue_timeout_ms": self.queue_timeout_ms,
                 "admitted_total": self.admitted_total,
                 "rejected_total": self.rejected_total,

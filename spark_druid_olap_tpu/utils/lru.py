@@ -101,10 +101,14 @@ class ByteBudgetCache:
 
     def _evict(self):
         # never evict the just-inserted entry: a single over-budget column
-        # must still execute (the caller holds a live reference anyway)
-        while self._bytes > self.budget_bytes and len(self._od) > 1:
-            _, old = self._od.popitem(last=False)
-            self._bytes -= int(old.nbytes)
+        # must still execute (the caller holds a live reference anyway).
+        # Takes the (reentrant) lock itself rather than assuming the caller
+        # holds it — implicit caller-holds-the-lock contracts rot
+        # (graftlint lock-discipline/GL501)
+        with self._lock:
+            while self._bytes > self.budget_bytes and len(self._od) > 1:
+                _, old = self._od.popitem(last=False)
+                self._bytes -= int(old.nbytes)
 
 
 class CountBudgetCache:

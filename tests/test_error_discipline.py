@@ -1,7 +1,8 @@
-"""Tier-1 gate for tools/check_error_discipline.py: every broad `except`
-in the serving/execution layers must re-raise, route through the
-resilience classifier, record observably, or carry an explicit
-`# fault-ok: <reason>` pragma — no silent swallows (ISSUE 1 satellite)."""
+"""Tier-1 gate for the error-discipline graftlint pass (PR 1's standalone
+tools/check_error_discipline.py, ported into the framework by ISSUE 2):
+every broad `except` in the serving/execution layers must re-raise, route
+through the resilience classifier, record observably, or carry an explicit
+`# fault-ok: <reason>` pragma — no silent swallows."""
 
 import os
 import subprocess
@@ -10,27 +11,41 @@ import sys
 import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(_ROOT, "tools"))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-import check_error_discipline as ced  # noqa: E402
+from tools.graftlint import run_lint  # noqa: E402
+from tools.graftlint.passes.error_discipline import (  # noqa: E402
+    ErrorDisciplinePass,
+)
+
+_TARGETS = ["spark_druid_olap_tpu", "tests", "bench.py"]
+
+
+def _check(root, paths=None):
+    res = run_lint(
+        root, paths or _TARGETS, pass_names=["error-discipline"],
+        # an isolated fixture tree has no baseline; the repo's own run
+        # (test_no_silent_broad_excepts) uses the real baseline path
+        baseline_path=os.path.join(root, "graftlint_baseline.json"),
+    )
+    return res.new
 
 
 def test_no_silent_broad_excepts():
-    violations = ced.check_paths(_ROOT)
-    assert not violations, "\n".join(
-        f"{p}:{ln}: {msg}" for p, ln, msg in violations
-    )
+    violations = _check(_ROOT)
+    assert not violations, "\n".join(f.render() for f in violations)
 
 
 def test_target_set_covers_serving_and_execution():
-    files = {os.path.relpath(f, _ROOT) for f in ced.target_files(_ROOT)}
-    assert "spark_druid_olap_tpu/server.py" in files
-    assert any(f.startswith("spark_druid_olap_tpu/exec/") for f in files)
-    assert any(f.startswith("spark_druid_olap_tpu/parallel/") for f in files)
+    include = ErrorDisciplinePass.default_config["include"]
+    assert "spark_druid_olap_tpu/server.py" in include
+    assert any(p.startswith("spark_druid_olap_tpu/exec") for p in include)
+    assert any(p.startswith("spark_druid_olap_tpu/parallel") for p in include)
 
 
 def test_checker_flags_a_silent_swallow(tmp_path):
-    """The checker actually catches the bad shape (guards against the
+    """The pass actually catches the bad shape (guards against the
     checker rotting into a rubber stamp)."""
     pkg = tmp_path / "spark_druid_olap_tpu"
     (pkg / "exec").mkdir(parents=True)
@@ -49,9 +64,9 @@ def test_checker_flags_a_silent_swallow(tmp_path):
         "    except Exception:\n"
         "        raise\n"
     )
-    violations = ced.check_paths(str(tmp_path))
+    violations = _check(str(tmp_path), ["spark_druid_olap_tpu"])
     assert len(violations) == 1
-    assert violations[0][0].endswith("server.py")
+    assert violations[0].path.endswith("server.py")
 
 
 def test_checker_accepts_pragma_and_logging(tmp_path):
@@ -70,7 +85,7 @@ def test_checker_accepts_pragma_and_logging(tmp_path):
         "    except Exception:\n"
         "        log.warning('failed', exc_info=True)\n"
     )
-    assert ced.check_paths(str(tmp_path)) == []
+    assert _check(str(tmp_path), ["spark_druid_olap_tpu"]) == []
     # a bare pragma with no reason does NOT count
     (pkg / "server.py").write_text(
         "def f():\n"
@@ -79,14 +94,35 @@ def test_checker_accepts_pragma_and_logging(tmp_path):
         "    except Exception:  # fault-ok:\n"
         "        pass\n"
     )
-    assert len(ced.check_paths(str(tmp_path))) == 1
+    assert len(_check(str(tmp_path), ["spark_druid_olap_tpu"])) == 1
+
+
+def test_resilience_routing_and_metrics_count_as_discipline(tmp_path):
+    pkg = tmp_path / "spark_druid_olap_tpu"
+    (pkg / "exec").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    (pkg / "server.py").write_text(
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as e:\n"
+        "        kind = classify_error(e)\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        self._m.retries += 1\n"
+    )
+    assert _check(str(tmp_path), ["spark_druid_olap_tpu"]) == []
 
 
 def test_cli_entrypoint_exit_codes(tmp_path):
-    tool = os.path.join(_ROOT, "tools", "check_error_discipline.py")
+    env = {**os.environ, "PYTHONPATH": _ROOT}
     # the real repo passes
     out = subprocess.run(
-        [sys.executable, tool, _ROOT], capture_output=True, text=True
+        [sys.executable, "-m", "tools.graftlint",
+         "--pass", "error-discipline", *_TARGETS],
+        capture_output=True, text=True, cwd=_ROOT, env=env,
     )
     assert out.returncode == 0, out.stdout + out.stderr
     # a violating tree fails
@@ -97,8 +133,17 @@ def test_cli_entrypoint_exit_codes(tmp_path):
         "try:\n    x()\nexcept Exception:\n    y = 1\n"
     )
     out = subprocess.run(
-        [sys.executable, tool, str(tmp_path)],
-        capture_output=True, text=True,
+        [sys.executable, "-m", "tools.graftlint",
+         "--pass", "error-discipline", "spark_druid_olap_tpu"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
     )
     assert out.returncode == 1
     assert "server.py" in out.stdout
+
+
+def test_standalone_checker_is_gone():
+    """ISSUE 2 satellite: the one-off tool was ported into the framework
+    and deleted — a resurrected copy would drift from the pass."""
+    assert not os.path.exists(
+        os.path.join(_ROOT, "tools", "check_error_discipline.py")
+    )

@@ -1,0 +1,687 @@
+"""Tier-1 gate for tools/graftlint — the AST static-analysis framework.
+
+Three layers of coverage (ISSUE 2):
+
+1. **Fixture matrix** — every pass is exercised against >=2 violating and
+   >=2 clean snippets, so the gate is self-testing: a pass that rots into
+   a rubber stamp (or starts flagging idiomatic code) fails here, not in
+   review.
+2. **Repo gate** — `run_lint` over the real tree must be clean (no new
+   findings, no stale baseline entries): this is the actual lint gate
+   running under tier-1.
+3. **CLI contract** — `python -m tools.graftlint` exit codes, --json,
+   --pass, --update-baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.graftlint import (  # noqa: E402
+    ALL_PASSES,
+    LintConfigError,
+    load_baseline,
+    run_lint,
+)
+
+_TARGETS = ["spark_druid_olap_tpu", "tests", "bench.py"]
+
+
+def _run_on(tmp_path, files, passes=None):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint(str(tmp_path), ["."], pass_names=passes)
+
+
+# ---------------------------------------------------------------------------
+# Fixture matrix: >=2 violating + >=2 clean snippets per pass
+# ---------------------------------------------------------------------------
+
+# pass -> (violating: [(files, expected_codes)], clean: [files])
+_MATRIX = {
+    "jit-cache": {
+        "violating": [
+            (
+                {"pkg/serve.py": """
+                    import jax
+
+                    def handler(x):
+                        f = jax.jit(lambda v: v + 1)
+                        return f(x)
+                """},
+                {"GL101"},
+            ),
+            (
+                {"pkg/serve.py": """
+                    import jax
+
+                    def build(self, q, shape):
+                        @jax.jit
+                        def prog(cols):
+                            return cols
+
+                        return prog
+                """},
+                {"GL101"},
+            ),
+            (
+                {"pkg/keys.py": """
+                    def program_for(self, q, shape):
+                        key = f"{q}:{shape}"
+                        return self._program_cache.get(key)
+                """},
+                {"GL103"},
+            ),
+            (
+                {"pkg/spec.py": """
+                    import jax
+
+                    def build(f, nums):
+                        return jax.jit(f, static_argnums=nums)
+                """},
+                {"GL101", "GL102"},
+            ),
+        ],
+        "clean": [
+            {"pkg/mod.py": """
+                import functools
+
+                import jax
+
+                @jax.jit
+                def f(x):
+                    return x + 1
+
+                @functools.partial(jax.jit, static_argnames=("n",))
+                def g(x, n):
+                    return x * n
+            """},
+            {"pkg/eng.py": """
+                import jax
+
+                class Engine:
+                    def program(self, q, shape):
+                        key = (q, shape)
+                        fn = self._program_cache.get(key)
+                        if fn is None:
+                            fn = jax.jit(lambda v: v * 2)
+                            self._program_cache[key] = fn
+                        return fn
+            """},
+            # the calibration harness is excluded by pass config: it
+            # deliberately rebuilds jits (compile time is what it measures)
+            {"spark_druid_olap_tpu/plan/calibrate.py": """
+                import jax
+
+                def bench(x):
+                    f = jax.jit(lambda v: v + 1)
+                    return f(x)
+            """},
+        ],
+    },
+    "trace-purity": {
+        "violating": [
+            (
+                {"pkg/traced.py": """
+                    import time
+
+                    import jax
+
+                    @jax.jit
+                    def f(x):
+                        t = time.time()
+                        return x + t
+                """},
+                {"GL202"},
+            ),
+            (
+                {"pkg/traced.py": """
+                    import jax
+                    import numpy as np
+
+                    @jax.jit
+                    def g(x):
+                        return np.asarray(x) + 1
+                """},
+                {"GL203"},
+            ),
+            (
+                {"pkg/kern.py": """
+                    import numpy as np
+
+                    def _sum_kernel(x_ref, o_ref):
+                        o_ref[:] = np.random.rand() + x_ref[:]
+                """},
+                {"GL202"},
+            ),
+            (
+                {"spark_druid_olap_tpu/exec/engine.py": """
+                    import jax
+
+                    def resolve(batches):
+                        out = []
+                        for b in batches:
+                            out.append(jax.device_get(b))
+                        return out
+                """},
+                {"GL204"},
+            ),
+        ],
+        "clean": [
+            {"pkg/pure.py": """
+                import jax
+                import jax.numpy as jnp
+
+                @jax.jit
+                def f(x):
+                    return jnp.sum(x * 2)
+            """},
+            # host code may sync freely outside loops / off the hot paths
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                import jax
+
+                def resolve(state):
+                    sums, mins = jax.device_get(state)
+                    return sums, mins
+            """},
+            {"pkg/host.py": """
+                import time
+
+                def timer_loop(items):
+                    for it in items:
+                        t0 = time.perf_counter()
+                        work(it)
+            """},
+        ],
+    },
+    "dtype-x64": {
+        "violating": [
+            (
+                {"pkg/wide.py": """
+                    import jax.numpy as jnp
+
+                    x = jnp.zeros(4, jnp.float64)
+                """},
+                {"GL301"},
+            ),
+            (
+                {"pkg/weak.py": """
+                    import jax
+                    import jax.numpy as jnp
+
+                    _POS = jnp.inf
+
+                    @jax.jit
+                    def f(m, v):
+                        return jnp.where(m, v, _POS)
+                """},
+                {"GL303"},
+            ),
+            (
+                {"pkg/strdtype.py": """
+                    import jax.numpy as jnp
+
+                    def widen(x):
+                        return jnp.asarray(x, dtype="int64")
+                """},
+                {"GL302"},
+            ),
+        ],
+        "clean": [
+            # dtype COMPARISONS inspect width, they don't create it
+            {"pkg/cmp.py": """
+                import jax.numpy as jnp
+
+                def is_wide(c):
+                    return c.dtype == jnp.int64 or c.dtype in (jnp.float64,)
+            """},
+            {"pkg/matched.py": """
+                import jax
+                import jax.numpy as jnp
+
+                @jax.jit
+                def f(m, v):
+                    return jnp.where(m, v, jnp.asarray(jnp.inf, dtype=v.dtype))
+            """},
+            # the pragma spelling documents a deliberate wide dtype
+            {"pkg/time64.py": """
+                import jax.numpy as jnp
+
+                def widen_time(off, base):
+                    # graftlint: disable=dtype-x64 -- time is int64 ms by contract
+                    return base + off.astype(jnp.int64)
+            """},
+        ],
+    },
+    "compat-import": {
+        "violating": [
+            (
+                {"pkg/direct.py": """
+                    from jax.experimental.shard_map import shard_map
+                """},
+                {"GL401"},
+            ),
+            (
+                {"pkg/flip.py": """
+                    import jax
+
+                    jax.config.update("jax_enable_x64", True)
+                """},
+                {"GL402"},
+            ),
+            (
+                {"pkg/attr.py": """
+                    import jax
+
+                    def shim(fn, mesh, specs):
+                        return jax.experimental.shard_map.shard_map(
+                            fn, mesh=mesh, in_specs=specs, out_specs=specs
+                        )
+                """},
+                {"GL401"},
+            ),
+        ],
+        "clean": [
+            # the shim modules themselves are the sanctioned owners
+            {"spark_druid_olap_tpu/parallel/mesh.py": """
+                from jax.experimental.shard_map import shard_map
+            """},
+            {"spark_druid_olap_tpu/ops/pallas_groupby.py": """
+                import jax
+
+                def _enable_x64_compat(flag):
+                    from jax.experimental import enable_x64
+                    return enable_x64(flag)
+            """},
+            {"pkg/user.py": """
+                from spark_druid_olap_tpu.parallel.mesh import shard_map_compat
+
+                def build(fn, mesh, specs):
+                    return shard_map_compat(
+                        fn, mesh=mesh, in_specs=specs, out_specs=specs
+                    )
+            """},
+        ],
+    },
+    "lock-discipline": {
+        "violating": [
+            (
+                {"pkg/breaker.py": """
+                    import threading
+
+                    class CircuitBreaker:
+                        def __init__(self):
+                            self._lock = threading.Lock()
+                            self._state = "closed"
+
+                        def trip(self):
+                            self._state = "open"
+                """},
+                {"GL501"},
+            ),
+            (
+                {"pkg/cachemod.py": """
+                    import threading
+
+                    class MetadataCache:
+                        def __init__(self):
+                            self._lock = threading.Lock()
+                            self._tables = {}
+
+                        def put(self, name, ds):
+                            self._tables[name] = ds
+                """},
+                {"GL502"},
+            ),
+            (
+                {"pkg/adm.py": """
+                    import threading
+
+                    class AdmissionController:
+                        def __init__(self):
+                            self._lock = threading.Lock()
+                            self.admitted_total = 0
+
+                        def acquire(self):
+                            self.admitted_total += 1
+                            return True
+                """},
+                {"GL501"},
+            ),
+        ],
+        "clean": [
+            {"pkg/locked.py": """
+                import threading
+
+                class CircuitBreaker:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._state = "closed"
+
+                    def trip(self):
+                        with self._lock:
+                            self._state = "open"
+            """},
+            # unregistered classes keep their own conventions
+            {"pkg/other.py": """
+                class ScratchPad:
+                    def __init__(self):
+                        self._state = "x"
+
+                    def set(self, v):
+                        self._state = v
+            """},
+        ],
+    },
+    "error-discipline": {
+        "violating": [
+            (
+                {"spark_druid_olap_tpu/server.py": """
+                    def f():
+                        try:
+                            g()
+                        except Exception:
+                            pass
+                """},
+                {"GL601"},
+            ),
+            (
+                {"spark_druid_olap_tpu/exec/eng.py": """
+                    def f():
+                        try:
+                            g()
+                        except BaseException:
+                            y = 1
+                """},
+                {"GL601"},
+            ),
+        ],
+        "clean": [
+            {"spark_druid_olap_tpu/server.py": """
+                def f():
+                    try:
+                        g()
+                    except Exception:
+                        raise
+
+                def h():
+                    try:
+                        g()
+                    except Exception:
+                        log.warning("failed", exc_info=True)
+
+                def k():
+                    try:
+                        g()
+                    except Exception:  # fault-ok: best-effort probe
+                        pass
+            """},
+            # outside the serving/execution layers broad excepts are the
+            # caller's business — the pass is scoped
+            {"spark_druid_olap_tpu/plan/opt.py": """
+                def f():
+                    try:
+                        g()
+                    except Exception:
+                        pass
+            """},
+        ],
+    },
+}
+
+
+def test_matrix_covers_every_pass_with_minimum_fixtures():
+    names = {cls.name for cls in ALL_PASSES}
+    assert set(_MATRIX) == names
+    for name, cases in _MATRIX.items():
+        assert len(cases["violating"]) >= 2, name
+        assert len(cases["clean"]) >= 2, name
+
+
+@pytest.mark.parametrize("pass_name", sorted(_MATRIX))
+def test_violating_fixtures_are_flagged(pass_name, tmp_path):
+    for i, (files, want_codes) in enumerate(_MATRIX[pass_name]["violating"]):
+        sub = tmp_path / f"v{i}"
+        res = _run_on(sub, files, passes=[pass_name])
+        got_codes = {f.code for f in res.new}
+        assert want_codes <= got_codes, (
+            f"{pass_name} fixture {i}: wanted {want_codes}, got "
+            f"{[f.render() for f in res.new]}"
+        )
+        assert all(f.pass_name == pass_name for f in res.new)
+
+
+@pytest.mark.parametrize("pass_name", sorted(_MATRIX))
+def test_clean_fixtures_pass(pass_name, tmp_path):
+    for i, files in enumerate(_MATRIX[pass_name]["clean"]):
+        sub = tmp_path / f"c{i}"
+        res = _run_on(sub, files, passes=[pass_name])
+        assert res.new == [], (
+            f"{pass_name} clean fixture {i} flagged: "
+            f"{[f.render() for f in res.new]}"
+        )
+
+
+def test_framework_pragma_suppresses_any_pass(tmp_path):
+    res = _run_on(
+        tmp_path,
+        {"pkg/p.py": """
+            import threading
+
+            class CircuitBreaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = "closed"
+
+                def trip(self):
+                    # graftlint: disable=lock-discipline -- single-threaded test helper
+                    self._state = "open"
+        """},
+        passes=["lock-discipline"],
+    )
+    assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# Repo gate (THE lint gate) + baseline meta-tests
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_is_lint_clean():
+    res = run_lint(_ROOT, _TARGETS)
+    assert set(res.pass_names) == {cls.name for cls in ALL_PASSES}
+    assert res.new == [], "\n".join(f.render() for f in res.new)
+
+
+def test_baseline_entries_all_still_exist():
+    """Stale baseline entries (the finding was fixed but the entry kept)
+    fail: the baseline may only shrink on its own."""
+    res = run_lint(_ROOT, _TARGETS)
+    assert res.stale == [], "\n".join(
+        f"stale: {e.path} [{e.pass_name}/{e.code}] {e.snippet!r}"
+        for e in res.stale
+    )
+    # and every grandfathered finding carries a real justification
+    for f, e in res.baselined:
+        assert e.reason.strip(), f.render()
+
+
+def test_baseline_without_reason_is_rejected(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    bl = tmp_path / "graftlint_baseline.json"
+    bl.write_text(json.dumps({
+        "entries": [{
+            "pass": "jit-cache", "code": "GL101", "path": "m.py",
+            "snippet": "x = 1", "reason": "  ",
+        }],
+    }))
+    with pytest.raises(LintConfigError):
+        run_lint(str(tmp_path), ["m.py"], baseline_path=str(bl))
+
+
+def test_stale_baseline_entry_detected(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    bl = tmp_path / "graftlint_baseline.json"
+    bl.write_text(json.dumps({
+        "entries": [{
+            "pass": "jit-cache", "code": "GL101", "path": "m.py",
+            "snippet": "f = jax.jit(lambda v: v)", "reason": "was fixed",
+        }],
+    }))
+    res = run_lint(str(tmp_path), ["m.py"], baseline_path=str(bl))
+    assert len(res.stale) == 1
+    assert not res.ok
+
+
+def test_baselined_finding_does_not_fail(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "m.py").write_text(
+        "import jax\n\n"
+        "def handler(x):\n"
+        "    f = jax.jit(lambda v: v + 1)\n"
+        "    return f(x)\n"
+    )
+    bl = tmp_path / "graftlint_baseline.json"
+    bl.write_text(json.dumps({
+        "entries": [{
+            "pass": "jit-cache", "code": "GL101", "path": "pkg/m.py",
+            "snippet": "f = jax.jit(lambda v: v + 1)",
+            "reason": "fixture: deliberately grandfathered",
+        }],
+    }))
+    res = run_lint(str(tmp_path), ["pkg"], baseline_path=str(bl))
+    assert res.new == [] and res.stale == [] and len(res.baselined) == 1
+    assert res.ok
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "PYTHONPATH": _ROOT},
+    )
+
+
+def test_cli_clean_on_repo_tree():
+    out = _cli(_TARGETS, cwd=_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_cli_flags_introduced_violation(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n\njax.config.update(\"jax_enable_x64\", True)\n"
+    )
+    out = _cli(["pkg"], cwd=str(tmp_path))
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "GL402" in out.stdout
+
+
+def test_cli_json_and_pass_filter(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n\njax.config.update(\"jax_enable_x64\", True)\n"
+        "\n\ndef f():\n    g = jax.jit(lambda v: v)\n    return g\n"
+    )
+    out = _cli(["--json", "pkg"], cwd=str(tmp_path))
+    doc = json.loads(out.stdout)
+    codes = {f["code"] for f in doc["findings"]}
+    assert {"GL402", "GL101"} <= codes
+    # --pass scopes to one pass only
+    out = _cli(["--json", "--pass", "compat-import", "pkg"], cwd=str(tmp_path))
+    doc = json.loads(out.stdout)
+    assert {f["code"] for f in doc["findings"]} == {"GL402"}
+    assert doc["passes"] == ["compat-import"]
+    # unknown pass name is a config error (exit 2)
+    out = _cli(["--pass", "nope", "pkg"], cwd=str(tmp_path))
+    assert out.returncode == 2
+
+
+def test_scoped_runs_do_not_report_out_of_scope_entries_stale():
+    """A --pass or single-file run must not claim baseline entries from
+    other passes/files are stale (they are out of scope, not fixed)."""
+    res = run_lint(
+        _ROOT, ["spark_druid_olap_tpu/server.py"],
+        pass_names=["error-discipline"],
+    )
+    assert res.stale == []
+    assert res.ok
+    # the skipped entries are reported as out-of-scope, not dropped
+    assert len(res.out_of_scope_entries) == len(load_baseline(
+        os.path.join(_ROOT, "graftlint_baseline.json")
+    ))
+    out = _cli(["spark_druid_olap_tpu/server.py"], cwd=_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_scoped_update_baseline_preserves_other_scopes(tmp_path):
+    """--update-baseline under --pass (or a path subset) must carry
+    out-of-scope entries through untouched, not delete them."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "import jax\n\njax.config.update(\"jax_enable_x64\", True)\n"
+    )
+    (pkg / "b.py").write_text(
+        "import jax\n\n"
+        "def handler(x):\n"
+        "    f = jax.jit(lambda v: v + 1)\n"
+        "    return f(x)\n"
+    )
+    # grandfather everything, then re-update scoped to one pass
+    assert _cli(["--update-baseline", "pkg"], cwd=str(tmp_path)).returncode == 0
+    before = load_baseline(str(tmp_path / "graftlint_baseline.json"))
+    assert {e.pass_name for e in before} == {"compat-import", "jit-cache"}
+    out = _cli(
+        ["--update-baseline", "--pass", "jit-cache", "pkg"],
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    after = load_baseline(str(tmp_path / "graftlint_baseline.json"))
+    assert {e.pass_name for e in after} == {"compat-import", "jit-cache"}
+    # and a scoped update over a file subset keeps the other file's entry
+    out = _cli(
+        ["--update-baseline", "pkg/a.py"], cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    after = load_baseline(str(tmp_path / "graftlint_baseline.json"))
+    assert {e.pass_name for e in after} == {"compat-import", "jit-cache"}
+    # the full gate still passes afterwards
+    assert _cli(["pkg"], cwd=str(tmp_path)).returncode == 0
+
+
+def test_cli_update_baseline_grandfathers_and_then_passes(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n\njax.config.update(\"jax_enable_x64\", True)\n"
+    )
+    assert _cli(["pkg"], cwd=str(tmp_path)).returncode == 1
+    out = _cli(["--update-baseline", "pkg"], cwd=str(tmp_path))
+    assert out.returncode == 0, out.stdout + out.stderr
+    entries = load_baseline(str(tmp_path / "graftlint_baseline.json"))
+    assert len(entries) == 1 and entries[0].code == "GL402"
+    # grandfathered: the gate passes now
+    assert _cli(["pkg"], cwd=str(tmp_path)).returncode == 0
+    # fixing the violation makes the entry STALE: exit 2
+    (pkg / "bad.py").write_text("import jax\n")
+    out = _cli(["pkg"], cwd=str(tmp_path))
+    assert out.returncode == 2
+    assert "STALE" in out.stdout

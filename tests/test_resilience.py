@@ -187,6 +187,69 @@ def test_admission_slots_and_timeout():
     assert d["slots_total"] == 2 and d["admitted_total"] == 3
 
 
+def test_retry_after_from_observed_hold_time():
+    """Retry-After reflects the observed slot hold EWMA, not the
+    configured queue wait (ROADMAP resilience follow-up (d))."""
+    clk = _FakeClock()
+    adm = R.AdmissionController(
+        max_concurrent=1, queue_timeout_ms=30000, clock=clk
+    )
+    # before any observation the configured wait stands in (clamped)
+    assert adm.retry_after_s() == 30
+    assert adm.acquire()
+    clk.t += 2.5  # the query held its slot for 2.5s
+    adm.release()
+    # idle pool, observed ~2.5s hold: hint is ceil(2.5) = 3, NOT 30
+    assert adm.retry_after_s() == 3
+    assert adm.to_dict()["hold_ewma_ms"] == pytest.approx(2500.0)
+
+
+def test_retry_after_scales_with_queue_depth():
+    clk = _FakeClock()
+    adm = R.AdmissionController(
+        max_concurrent=1, queue_timeout_ms=60000, clock=clk
+    )
+    # observe a 4s hold to seed the EWMA
+    assert adm.acquire()
+    clk.t += 4.0
+    adm.release()
+    # occupy the slot and queue two real waiters behind it
+    assert adm.acquire()
+    started = threading.Barrier(3)
+
+    def waiter():
+        started.wait(timeout=5)
+        adm.acquire()  # parks until release (60s budget)
+        adm.release()
+
+    threads = [threading.Thread(target=waiter) for _ in range(2)]
+    for t in threads:
+        t.start()
+    started.wait(timeout=5)
+    deadline = time.perf_counter() + 5
+    while adm.queue_depth < 2 and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    assert adm.queue_depth == 2
+    # depth 2 on 1 slot at ~4s/hold: ceil(4 * (2/1 + 1)) = 12s; an
+    # unqueued pool with the same EWMA would say 4s
+    assert adm.retry_after_s() == 12
+    d = adm.to_dict()
+    assert d["queue_depth"] == 2
+    adm.release()  # drain: each waiter acquires and releases in turn
+    for t in threads:
+        t.join(timeout=5)
+    assert adm.queue_depth == 0
+    # hint is clamped to [1, 60] even under absurd observed holds
+    clk2 = _FakeClock()
+    adm2 = R.AdmissionController(
+        max_concurrent=1, queue_timeout_ms=1000, clock=clk2
+    )
+    assert adm2.acquire()
+    clk2.t += 500.0
+    adm2.release()
+    assert adm2.retry_after_s() == 60
+
+
 def test_admission_queued_caller_gets_freed_slot():
     adm = R.AdmissionController(max_concurrent=1, queue_timeout_ms=2000)
     assert adm.acquire()

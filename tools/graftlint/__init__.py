@@ -1,0 +1,30 @@
+"""graftlint: pluggable AST static analysis for JAX/serving discipline.
+
+Entry points:
+
+    python -m tools.graftlint spark_druid_olap_tpu tests bench.py
+    python -m tools.graftlint --json --pass jit-cache spark_druid_olap_tpu
+    python -m tools.graftlint --update-baseline spark_druid_olap_tpu tests bench.py
+
+Library API (what tests/test_lint.py drives):
+
+    from tools.graftlint import run_lint
+    result = run_lint(root, ["spark_druid_olap_tpu", "tests", "bench.py"])
+    assert result.ok
+
+See `core.py` for the framework (shared walker, findings, baseline) and
+`passes/` for the pass catalog.
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_NAME,
+    BaselineEntry,
+    Finding,
+    LintConfigError,
+    LintPass,
+    LintResult,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from .passes import ALL_PASSES, PASS_BY_NAME, build_passes  # noqa: F401

@@ -1,0 +1,132 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint [options] <path> [<path> ...]
+
+Paths are files or directories, resolved relative to --root (default:
+the current working directory, which must be the repo root for the
+standard invocation).  Exit codes: 0 clean, 1 new findings, 2 stale
+baseline entries or configuration errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (
+    BASELINE_NAME,
+    BaselineEntry,
+    LintConfigError,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from .passes import PASS_BY_NAME
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST static analysis for JAX/serving discipline",
+    )
+    ap.add_argument("paths", nargs="+", help=".py files or directories")
+    ap.add_argument(
+        "--root", default=os.getcwd(),
+        help="repo root findings are reported relative to (default: cwd)",
+    )
+    ap.add_argument(
+        "--pass", dest="passes", action="append", metavar="NAME",
+        help=f"run only this pass (repeatable); one of "
+             f"{sorted(PASS_BY_NAME)}",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <root>/{BASELINE_NAME})",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to grandfather every current finding "
+             "(existing justifications are preserved; new entries get a "
+             "placeholder reason to fill in before merging)",
+    )
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    try:
+        result = run_lint(
+            root, args.paths, pass_names=args.passes,
+            baseline_path=baseline_path,
+        )
+    except LintConfigError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        reasons = {}
+        for e in load_baseline(baseline_path):
+            reasons.setdefault(e.fingerprint, []).append(e.reason)
+        # entries outside this run's scope (other passes under --pass, or
+        # files outside the scanned paths) are carried through untouched:
+        # a scoped update must never delete another scope's justifications
+        entries = list(result.out_of_scope_entries)
+        for f, old in result.baselined:
+            entries.append(
+                BaselineEntry(
+                    pass_name=f.pass_name, code=f.code, path=f.path,
+                    snippet=f.snippet, reason=old.reason,
+                )
+            )
+        for f in result.new:
+            bucket = reasons.get(f.fingerprint)
+            reason = bucket.pop() if bucket else (
+                "grandfathered by --update-baseline; justify before merge"
+            )
+            entries.append(
+                BaselineEntry(
+                    pass_name=f.pass_name, code=f.code, path=f.path,
+                    snippet=f.snippet, reason=reason,
+                )
+            )
+        entries.sort(key=lambda e: (e.path, e.pass_name, e.code, e.snippet))
+        save_baseline(baseline_path, entries)
+        print(
+            f"baseline updated: {len(entries)} entr"
+            f"{'y' if len(entries) == 1 else 'ies'} -> {baseline_path}"
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for f in result.new:
+            print(f.render())
+        for e in result.stale:
+            print(
+                f"{e.path}: STALE baseline entry [{e.pass_name}/{e.code}] "
+                f"{e.snippet!r} — the finding no longer exists; remove it "
+                "(or run --update-baseline)"
+            )
+        n_pass = len(result.pass_names)
+        print(
+            f"graftlint: {result.files_scanned} files, {n_pass} pass"
+            f"{'' if n_pass == 1 else 'es'}: "
+            f"{len(result.new)} finding(s), "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.stale)} stale baseline entr"
+            f"{'y' if len(result.stale) == 1 else 'ies'}"
+        )
+    if result.new:
+        return 1
+    if result.stale:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

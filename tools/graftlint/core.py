@@ -1,0 +1,556 @@
+"""graftlint core: the shared AST machinery every pass builds on.
+
+The framework generalizes PR 1's one-off error-discipline checker into a
+pluggable static-analysis harness for JAX/serving discipline:
+
+  * **One parse, one walk** — every target file is parsed once into a
+    `ModuleContext`; a single `Walker` traversal dispatches each AST node
+    to every active pass (`on_<NodeType>` handlers), maintaining the
+    scope state passes need (enclosing functions, active `with` items,
+    loop nesting, enclosing classes) so no pass re-implements traversal.
+  * **Findings with stable identity** — a finding's fingerprint is
+    (pass, code, path, stripped source line), NOT the line number, so a
+    grandfathered finding survives unrelated edits above it.
+  * **Grandfathering baseline** — `graftlint_baseline.json` holds
+    deliberate violations, each with a mandatory justification string.
+    Baselined findings don't fail the gate; baseline entries whose
+    finding no longer exists are STALE and fail it (the baseline can
+    only shrink on its own).
+  * **Pragmas** — `# graftlint: disable=<pass>[,<pass>...] -- <reason>`
+    on the flagged line (or the line above) suppresses findings inline;
+    the error-discipline pass additionally honors PR 1's
+    `# fault-ok: <reason>` spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class LintConfigError(Exception):
+    """Invalid pass config or malformed/unjustified baseline."""
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    pass_name: str
+    code: str  # e.g. "GL101"
+    path: str  # root-relative, posix separators
+    line: int
+    message: str
+    snippet: str  # stripped source line: the baseline identity
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        return (self.pass_name, self.code, self.path, self.snippet)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.pass_name}/{self.code}] "
+            f"{self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by passes
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name for Name/Attribute chains: `a.b.c` ->
+    "a.b.c"; anything else in the chain (calls, subscripts) renders its
+    own chain when possible, else "". Leading underscores on the FIRST
+    segment are stripped so `import time as _time` aliases still match
+    "time."-prefixed rules."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id.lstrip("_") or node.id)
+    elif parts:
+        return ""  # chain rooted in a call/subscript: not a plain name
+    return ".".join(reversed(parts))
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted_name(node.func)
+
+
+def is_jit_callee(node: ast.AST) -> bool:
+    """True for expressions that produce a jit transform: `jax.jit`,
+    bare `jit`, or `functools.partial(jax.jit, ...)`."""
+    dn = dotted_name(node)
+    if dn in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        if call_name(node) in ("functools.partial", "partial") and node.args:
+            return is_jit_callee(node.args[0])
+    return False
+
+
+def has_jit_decorator(func: ast.AST) -> bool:
+    return any(is_jit_callee(d) for d in getattr(func, "decorator_list", ()))
+
+
+def has_caching_decorator(func: ast.AST) -> bool:
+    caching = {
+        "functools.lru_cache", "lru_cache", "functools.cache", "cache",
+        "functools.cached_property", "cached_property",
+    }
+    for d in getattr(func, "decorator_list", ()):
+        dn = dotted_name(d)
+        if dn in caching:
+            return True
+        if isinstance(d, ast.Call) and call_name(d) in caching:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Module context + scope
+# ---------------------------------------------------------------------------
+
+
+class ModuleContext:
+    """Everything passes may ask about one parsed file."""
+
+    def __init__(self, path: str, relpath: str, source: str, tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.scope = _Scope()
+        self._parents: Optional[Dict[int, ast.AST]] = None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        """Parent AST node (map built lazily on first use)."""
+        if self._parents is None:
+            self._parents = {}
+            for p in ast.walk(self.tree):
+                for c in ast.iter_child_nodes(p):
+                    self._parents[id(c)] = p
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST):
+        p = self.parent(node)
+        while p is not None:
+            yield p
+            p = self.parent(p)
+
+
+class _Frame:
+    """Per-function scope frame: `with` and loop state must NOT leak into
+    nested function bodies (a closure defined under `with self._lock` does
+    not RUN under the lock)."""
+
+    __slots__ = ("func", "with_items", "loops")
+
+    def __init__(self, func: Optional[ast.AST]):
+        self.func = func
+        self.with_items: List[ast.withitem] = []
+        self.loops: List[ast.AST] = []
+
+
+class _Scope:
+    def __init__(self):
+        self.frames: List[_Frame] = [_Frame(None)]  # module frame
+        self.class_stack: List[ast.ClassDef] = []
+
+    # -- queries passes use ---------------------------------------------------
+
+    @property
+    def func_stack(self) -> List[ast.AST]:
+        return [f.func for f in self.frames if f.func is not None]
+
+    @property
+    def current_func(self) -> Optional[ast.AST]:
+        return self.frames[-1].func
+
+    @property
+    def in_function(self) -> bool:
+        return self.frames[-1].func is not None
+
+    @property
+    def with_items(self) -> List[ast.withitem]:
+        return self.frames[-1].with_items
+
+    @property
+    def in_loop(self) -> bool:
+        return bool(self.frames[-1].loops)
+
+    @property
+    def current_class(self) -> Optional[ast.ClassDef]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    def holds_lock(self, lock_attr: str) -> bool:
+        """Is `with self.<lock_attr>:` lexically active in THIS frame?"""
+        want = f"self.{lock_attr}"
+        for item in self.frames[-1].with_items:
+            if dotted_name(item.context_expr) == want:
+                return True
+        return False
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+
+class Walker:
+    """One traversal, all passes.  Handlers fire BEFORE the node's own
+    scope is pushed, so `on_FunctionDef` sees the stack of *enclosing*
+    functions only."""
+
+    def __init__(self, passes: Sequence["LintPass"]):
+        self._passes = passes
+        self._handlers: Dict[str, List] = {}
+        for p in passes:
+            for attr in dir(p):
+                if attr.startswith("on_"):
+                    self._handlers.setdefault(attr[3:], []).append(
+                        getattr(p, attr)
+                    )
+
+    def run(self, ctx: ModuleContext) -> None:
+        for p in self._passes:
+            p.begin_module(ctx)
+        self._visit(ctx.tree, ctx)
+        for p in self._passes:
+            p.end_module(ctx)
+
+    def _visit(self, node: ast.AST, ctx: ModuleContext) -> None:
+        for h in self._handlers.get(type(node).__name__, ()):
+            h(node, ctx)
+        scope = ctx.scope
+        if isinstance(node, _FUNC_NODES):
+            scope.frames.append(_Frame(node))
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, ctx)
+            scope.frames.pop()
+            return
+        if isinstance(node, ast.ClassDef):
+            scope.class_stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, ctx)
+            scope.class_stack.pop()
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            frame = scope.frames[-1]
+            frame.with_items.extend(node.items)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, ctx)
+            del frame.with_items[-len(node.items):]
+            return
+        if isinstance(node, _LOOP_NODES):
+            frame = scope.frames[-1]
+            frame.loops.append(node)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, ctx)
+            frame.loops.pop()
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, ctx)
+
+
+# ---------------------------------------------------------------------------
+# Pass base
+# ---------------------------------------------------------------------------
+
+
+class LintPass:
+    """Base class: subclasses set `name`, `default_config`, and implement
+    `on_<NodeType>` handlers that call `self.report(...)`."""
+
+    name: str = ""
+    default_config: dict = {}
+
+    def __init__(self, config: Optional[dict] = None):
+        cfg = dict(self.default_config)
+        cfg.update(config or {})
+        self.config = cfg
+        self._sink: List[Finding] = []
+
+    # -- lifecycle (runner-managed) ------------------------------------------
+
+    def bind_sink(self, sink: List[Finding]) -> None:
+        self._sink = sink
+
+    def applies_to(self, relpath: str) -> bool:
+        include = self.config.get("include")
+        if include and not any(relpath.startswith(p) for p in include):
+            return False
+        exclude = self.config.get("exclude", ())
+        return not any(relpath.startswith(p) for p in exclude)
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        pass
+
+    def end_module(self, ctx: ModuleContext) -> None:
+        pass
+
+    # -- reporting ------------------------------------------------------------
+
+    def report(
+        self, ctx: ModuleContext, node: ast.AST, code: str, message: str
+    ) -> None:
+        lineno = getattr(node, "lineno", 0)
+        if _pragma_suppressed(ctx, lineno, self.name):
+            return
+        self._sink.append(
+            Finding(
+                pass_name=self.name,
+                code=code,
+                path=ctx.relpath,
+                line=lineno,
+                message=message,
+                snippet=ctx.line_text(lineno),
+            )
+        )
+
+
+def _pragma_suppressed(ctx: ModuleContext, lineno: int, pass_name: str) -> bool:
+    for ln in (lineno - 1, lineno - 2):  # flagged line, then line above
+        if not (0 <= ln < len(ctx.lines)):
+            continue
+        line = ctx.lines[ln]
+        if "graftlint:" not in line:
+            continue
+        directive = line.split("graftlint:", 1)[1].strip()
+        if not directive.startswith("disable="):
+            continue
+        names = directive[len("disable="):].split("--", 1)[0]
+        wanted = {n.strip() for n in names.split(",")}
+        if pass_name in wanted or "all" in wanted:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_NAME = "graftlint_baseline.json"
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    pass_name: str
+    code: str
+    path: str
+    snippet: str
+    reason: str
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        return (self.pass_name, self.code, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return {
+            "pass": self.pass_name,
+            "code": self.code,
+            "path": self.path,
+            "snippet": self.snippet,
+            "reason": self.reason,
+        }
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise LintConfigError(f"unparseable baseline {path}: {e}")
+    entries = []
+    for i, e in enumerate(doc.get("entries", [])):
+        missing = {"pass", "code", "path", "snippet", "reason"} - set(e)
+        if missing:
+            raise LintConfigError(
+                f"baseline entry #{i} missing fields: {sorted(missing)}"
+            )
+        if not str(e["reason"]).strip():
+            raise LintConfigError(
+                f"baseline entry #{i} ({e['path']}) has no justification — "
+                "every grandfathered finding must say WHY it is kept"
+            )
+        entries.append(
+            BaselineEntry(
+                pass_name=e["pass"], code=e["code"], path=e["path"],
+                snippet=e["snippet"], reason=str(e["reason"]),
+            )
+        )
+    return entries
+
+
+def save_baseline(path: str, entries: Iterable[BaselineEntry]) -> None:
+    doc = {
+        "version": 1,
+        "comment": (
+            "graftlint grandfathering baseline: deliberate findings with "
+            "justifications.  Regenerate with --update-baseline; stale "
+            "entries (finding no longer present) fail the gate."
+        ),
+        "entries": [e.to_dict() for e in entries],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: List[Finding]
+    baselined: List[Tuple[Finding, BaselineEntry]]
+    stale: List[BaselineEntry]
+    files_scanned: int
+    pass_names: List[str]
+    # root-relative paths of every scanned file, plus the baseline entries
+    # that were OUT of this run's scope (pass not active / file not
+    # scanned) — --update-baseline must carry these through untouched
+    scanned_paths: List[str] = dataclasses.field(default_factory=list)
+    out_of_scope_entries: List[BaselineEntry] = dataclasses.field(
+        default_factory=list
+    )
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "passes": self.pass_names,
+            "findings": [f.to_dict() for f in self.new],
+            "baselined": [
+                {**f.to_dict(), "reason": e.reason}
+                for f, e in self.baselined
+            ],
+            "stale_baseline": [e.to_dict() for e in self.stale],
+        }
+
+
+def iter_target_files(root: str, paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+        elif full.endswith(".py") and os.path.exists(full):
+            out.append(full)
+        else:
+            raise LintConfigError(f"target {p!r} is not a .py file or dir")
+    return out
+
+
+def _relpath(root: str, path: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def run_lint(
+    root: str,
+    paths: Sequence[str],
+    pass_names: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+    config_overrides: Optional[Dict[str, dict]] = None,
+) -> LintResult:
+    """Parse every target file once, run the selected passes over it, and
+    reconcile findings against the grandfathering baseline."""
+    from .passes import build_passes
+
+    passes = build_passes(pass_names, config_overrides)
+    findings: List[Finding] = []
+    for p in passes:
+        p.bind_sink(findings)
+
+    files = iter_target_files(root, paths)
+    for path in files:
+        rel = _relpath(root, path)
+        active = [p for p in passes if p.applies_to(rel)]
+        if not active:
+            continue
+        with open(path) as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    pass_name="core", code="GL001", path=rel,
+                    line=e.lineno or 0,
+                    message=f"unparseable: {e.msg}",
+                    snippet="",
+                )
+            )
+            continue
+        ctx = ModuleContext(path, rel, source, tree)
+        Walker(active).run(ctx)
+
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    active_pass_names = {p.name for p in passes}
+    scanned_rels = {_relpath(root, f) for f in files}
+    # entries for passes that are not running this invocation, or for
+    # files outside the scanned target set, are out of scope: a
+    # `--pass jit-cache` or single-file run must not report every other
+    # entry as stale (and --update-baseline must preserve them)
+    entries: List[BaselineEntry] = []
+    out_of_scope: List[BaselineEntry] = []
+    for e in load_baseline(baseline_path):
+        if e.pass_name in active_pass_names and e.path in scanned_rels:
+            entries.append(e)
+        else:
+            out_of_scope.append(e)
+    # multiset match on fingerprints: each entry absorbs ONE finding
+    remaining: Dict[Tuple, List[BaselineEntry]] = {}
+    for e in entries:
+        remaining.setdefault(e.fingerprint, []).append(e)
+    new: List[Finding] = []
+    baselined: List[Tuple[Finding, BaselineEntry]] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        bucket = remaining.get(f.fingerprint)
+        if bucket:
+            baselined.append((f, bucket.pop()))
+        else:
+            new.append(f)
+    stale = [e for bucket in remaining.values() for e in bucket]
+    active_names = [p.name for p in passes]
+    return LintResult(
+        new=new, baselined=baselined, stale=stale,
+        files_scanned=len(files), pass_names=active_names,
+        scanned_paths=sorted(scanned_rels),
+        out_of_scope_entries=out_of_scope,
+    )

@@ -1,0 +1,50 @@
+"""graftlint pass registry.
+
+Adding a pass: subclass `core.LintPass` in a new module here, set
+`name`/`default_config`, implement `on_<NodeType>` handlers that call
+`self.report(ctx, node, code, message)`, and append the class to
+`ALL_PASSES`.  Codes are namespaced per pass (GL1xx jit-cache, GL2xx
+trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
+lock-discipline, GL6xx error-discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..core import LintConfigError, LintPass
+from .compat_import import CompatImportPass
+from .dtype_x64 import DtypeX64Pass
+from .error_discipline import ErrorDisciplinePass
+from .jit_cache import JitCachePass
+from .lock_discipline import LockDisciplinePass
+from .trace_purity import TracePurityPass
+
+ALL_PASSES = (
+    JitCachePass,
+    TracePurityPass,
+    DtypeX64Pass,
+    CompatImportPass,
+    LockDisciplinePass,
+    ErrorDisciplinePass,
+)
+
+PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}
+
+
+def build_passes(
+    pass_names: Optional[Sequence[str]] = None,
+    config_overrides: Optional[Dict[str, dict]] = None,
+) -> List[LintPass]:
+    names = list(pass_names) if pass_names else [c.name for c in ALL_PASSES]
+    overrides = config_overrides or {}
+    out: List[LintPass] = []
+    for name in names:
+        cls = PASS_BY_NAME.get(name)
+        if cls is None:
+            raise LintConfigError(
+                f"unknown pass {name!r}; available: "
+                f"{sorted(PASS_BY_NAME)}"
+            )
+        out.append(cls(overrides.get(name)))
+    return out

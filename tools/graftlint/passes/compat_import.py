@@ -1,0 +1,111 @@
+"""Compat-import discipline pass.
+
+The repo runs on jax 0.4.x AND newer releases only because two
+version-compat shims own every cross-version API:
+`parallel/mesh.py:shard_map_compat` (jax.shard_map vs
+jax.experimental.shard_map, check_vma vs check_rep) and
+`ops/pallas_groupby.py:_enable_x64_compat` (jax.enable_x64 vs
+jax.experimental.enable_x64).  A direct use ANYWHERE else silently
+un-fixes the virtual-mesh distributed path or the pallas kernel on one
+side of the version split.  Checks (outside the shim allowlist):
+
+* **GL401** — any import or attribute use of
+  `jax.experimental.shard_map` (route through `shard_map_compat`).
+* **GL402** — `*.config.update("jax_enable_x64", ...)` or any use of
+  `jax.enable_x64` / `jax.experimental.enable_x64` (route through the
+  `_enable_x64_compat` shim; the package-level global enable in
+  `__init__.py` is the single sanctioned exception, grandfathered in
+  the baseline).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, ModuleContext, dotted_name
+
+_X64_ATTRS = ("jax.enable_x64", "jax.experimental.enable_x64")
+
+
+class CompatImportPass(LintPass):
+    name = "compat-import"
+    default_config = {
+        "allow_paths": (
+            "spark_druid_olap_tpu/parallel/mesh.py",
+            "spark_druid_olap_tpu/ops/pallas_groupby.py",
+        ),
+    }
+
+    def applies_to(self, relpath: str) -> bool:
+        if relpath in self.config["allow_paths"]:
+            return False
+        return super().applies_to(relpath)
+
+    # -- GL401 ----------------------------------------------------------------
+
+    def on_Import(self, node: ast.Import, ctx: ModuleContext):
+        for alias in node.names:
+            if alias.name.startswith("jax.experimental.shard_map"):
+                self.report(
+                    ctx, node, "GL401",
+                    "direct import of jax.experimental.shard_map bypasses "
+                    "the version-compat shim — use "
+                    "parallel.mesh.shard_map_compat",
+                )
+
+    def on_ImportFrom(self, node: ast.ImportFrom, ctx: ModuleContext):
+        mod = node.module or ""
+        if mod.startswith("jax.experimental.shard_map") or (
+            mod == "jax.experimental"
+            and any(a.name == "shard_map" for a in node.names)
+        ):
+            self.report(
+                ctx, node, "GL401",
+                "direct import of jax.experimental.shard_map bypasses the "
+                "version-compat shim — use parallel.mesh.shard_map_compat",
+            )
+        if mod == "jax.experimental" and any(
+            a.name == "enable_x64" for a in node.names
+        ):
+            self.report(
+                ctx, node, "GL402",
+                "direct import of jax.experimental.enable_x64 bypasses the "
+                "version-compat shim — use "
+                "ops.pallas_groupby._enable_x64_compat",
+            )
+
+    def on_Attribute(self, node: ast.Attribute, ctx: ModuleContext):
+        dn = dotted_name(node)
+        if dn == "jax.experimental.shard_map":
+            self.report(
+                ctx, node, "GL401",
+                "jax.experimental.shard_map used directly — route through "
+                "parallel.mesh.shard_map_compat",
+            )
+        elif dn in _X64_ATTRS:
+            self.report(
+                ctx, node, "GL402",
+                f"{dn} used directly — route through "
+                "ops.pallas_groupby._enable_x64_compat",
+            )
+
+    # -- GL402 ----------------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "update"):
+            return
+        recv = dotted_name(fn.value)
+        if not recv.endswith("config") and ".config" not in recv:
+            return
+        if (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == "jax_enable_x64"
+        ):
+            self.report(
+                ctx, node, "GL402",
+                'config.update("jax_enable_x64", ...) outside the x64 shim: '
+                "flipping x64 mid-process invalidates every traced program "
+                "and splits dtype semantics across modules",
+            )

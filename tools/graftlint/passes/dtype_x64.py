@@ -1,0 +1,154 @@
+"""dtype/x64 discipline pass.
+
+The package enables x64 globally (time is int64 ms), which makes JAX's
+weak-type promotion a live hazard: a bare Python float in a traced
+expression is weak-f64, and old-jax pallas interpret-mode lowers the
+resulting `where`/select at f64 — the seed's kernel breakage
+(`'func.call' op operand type mismatch ... tensor<f64>`).  Checks:
+
+* **GL301** — bare 64-bit jnp dtype (`jnp.float64`/`jnp.int64`/
+  `jnp.uint64`).  Device arrays are f32/i32 by engine contract (HBM and
+  MXU both want 32-bit); a 64-bit device dtype doubles HBM traffic and
+  breaks Mosaic lowering.  Deliberate uses (the int64 time column)
+  carry a pragma or baseline entry.  Host-side numpy (`np.float64`
+  oracles in tests) is NOT flagged.
+* **GL302** — 64-bit dtype STRING (`dtype="float64"`, `.astype("int64")`)
+  in jnp-receiver calls: same hazard, stringly spelled.
+* **GL303** — weak-typed `jnp.where`/`jnp.select` branch inside a traced
+  function: a branch that is a bare float literal (or a module-level
+  float constant like `_POS = jnp.inf`) promotes under x64.  Use an
+  explicit dtype-matched fill (`jnp.asarray(v, dtype=x.dtype)` /
+  `jnp.full_like`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, ModuleContext, call_name, dotted_name
+from .trace_purity import TracePurityPass
+
+_WIDE = ("float64", "int64", "uint64")
+_JNP_ROOTS = ("jnp.", "jax.numpy.")
+
+
+def _is_float_literalish(node: ast.AST, float_consts) -> bool:
+    """A bare (weak-typed) float expression: literal, +/-inf attribute,
+    a module-level float constant name, or a negation of any of these."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_float_literalish(node.operand, float_consts)
+    if isinstance(node, ast.Name):
+        return node.id in float_consts
+    dn = dotted_name(node)
+    if dn in ("jnp.inf", "np.inf", "numpy.inf", "math.inf", "jnp.nan",
+              "np.nan", "math.nan"):
+        return True
+    if isinstance(node, ast.Call) and call_name(node) == "float":
+        return True
+    return False
+
+
+class DtypeX64Pass(LintPass):
+    name = "dtype-x64"
+    default_config = {
+        "kernel_name_suffixes": ("_kernel",),
+    }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        # reuse the purity pass's traced-scope detection
+        self._traced = TracePurityPass(
+            {"kernel_name_suffixes": self.config["kernel_name_suffixes"]}
+        )
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        # module-level float constants: `_POS = jnp.inf`, `_NEG = -jnp.inf`,
+        # `EPS = 1e-9` — names that smuggle a weak float into kernels
+        self._float_consts = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_float_literalish(
+                stmt.value, ()
+            ):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self._float_consts.add(t.id)
+
+    # -- GL301 ----------------------------------------------------------------
+
+    def on_Attribute(self, node: ast.Attribute, ctx: ModuleContext):
+        if node.attr not in _WIDE:
+            return
+        dn = dotted_name(node)
+        if dn not in ("jnp.float64", "jnp.int64", "jnp.uint64",
+                      "jax.numpy.float64", "jax.numpy.int64",
+                      "jax.numpy.uint64"):
+            return
+        # dtype COMPARISONS (`col.dtype == jnp.int64`, `dtype in (...,
+        # jnp.float64)`) inspect width, they don't create it — skip
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Compare):
+                return
+            if isinstance(anc, ast.stmt):
+                break
+        self.report(
+            ctx, node, "GL301",
+            f"bare 64-bit device dtype {dn}: engine arrays are f32/i32 "
+            "by contract (HBM/MXU width, Mosaic lowering) — narrow, or "
+            "justify via pragma/baseline",
+        )
+
+    # -- GL302 / GL303 --------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        dn = call_name(node)
+        # GL302: dtype="float64" in a jnp call, or .astype("int64") where
+        # the receiver chain is jnp-rooted
+        if any(dn.startswith(r) for r in _JNP_ROOTS):
+            for kw in node.keywords:
+                if (
+                    kw.arg == "dtype"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value in _WIDE
+                ):
+                    self.report(
+                        ctx, kw.value, "GL302",
+                        f'string dtype "{kw.value.value}" in {dn}(): '
+                        "64-bit device dtypes break the f32/i32 engine "
+                        "contract",
+                    )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value in _WIDE
+        ):
+            self.report(
+                ctx, node.args[0], "GL302",
+                f'.astype("{node.args[0].value}") with a string 64-bit '
+                "dtype — use an explicit narrow dtype object",
+            )
+        # GL303: weak-typed where/select branch in traced scope
+        if dn in ("jnp.where", "jax.numpy.where", "jnp.select",
+                  "jax.numpy.select"):
+            if not self._in_traced_scope(ctx):
+                return
+            for arg in node.args[1:3]:
+                if _is_float_literalish(arg, self._float_consts):
+                    self.report(
+                        ctx, node, "GL303",
+                        f"weak-typed {dn} branch: a bare Python float "
+                        "promotes to f64 under x64 (the seed pallas "
+                        "interpret-mode breakage) — use a dtype-matched "
+                        "fill (jnp.asarray(v, dtype=x.dtype) / full_like)",
+                    )
+                    return
+
+    def _in_traced_scope(self, ctx: ModuleContext) -> bool:
+        return any(
+            self._traced._is_traced(f) for f in ctx.scope.func_stack
+        )

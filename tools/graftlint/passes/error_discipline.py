@@ -1,31 +1,29 @@
-#!/usr/bin/env python
-"""Static error-handling discipline check (AST walk, run under tier-1 via
-tests/test_error_discipline.py).
+"""Error-discipline pass (PR 1's standalone checker, now a framework pass).
 
-Every `except Exception` (or broader) handler in the serving/execution
-layers — `server.py`, `exec/`, `parallel/` — must do at least one of:
+Every broad `except Exception` / `except BaseException` / bare `except:`
+handler in the serving/execution layers must do at least one of:
 
-  * re-raise (a bare or explicit `raise` anywhere in the handler body),
+  * re-raise (a `raise` anywhere in the handler body),
   * route through the resilience layer (`classify_error`, `checkpoint`,
-    breaker `record_*`, `note_*`),
+    breaker `record_*`, `note_*`, `fire`),
   * record the failure observably (touch `self._m` / `*metrics*` /
-    `QueryMetrics`, or call a logger: `log.warning(...)`, `logger.error`,
-    `self.log_...`),
-  * or carry an explicit `# fault-ok: <reason>` pragma on the `except`
-    line, documenting WHY swallowing is correct there.
+    `QueryMetrics`, or call a logger method),
+  * or carry an explicit `# fault-ok: <reason>` pragma on (or above)
+    the `except` line, documenting WHY swallowing is correct there.
 
 Anything else is a silent swallow — the round-5 class of wound where a
 wedged device path turns into a mystery hang or a wrong answer with no
-trace.  Exit code 1 + a listing when violations exist; importable
-(`check_paths`) for the tier-1 test.
+trace.  **GL601** flags each violation.  The framework-level
+`# graftlint: disable=error-discipline` pragma works too, but the
+fault-ok spelling is preferred: it names the reason in place.
 """
 
 from __future__ import annotations
 
 import ast
-import os
-import sys
-from typing import List, Tuple
+from typing import List
+
+from ..core import LintPass, ModuleContext
 
 # names whose call inside a handler counts as routing through resilience
 _RESILIENCE_CALLS = {
@@ -44,12 +42,8 @@ def _is_broad(handler: ast.ExceptHandler) -> bool:
     t = handler.type
     if t is None:
         return True  # bare except:
-    names = []
-    if isinstance(t, ast.Tuple):
-        names = [e for e in t.elts]
-    else:
-        names = [t]
-    for e in names:
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
         if isinstance(e, ast.Name) and e.id in _BROAD:
             return True
         if isinstance(e, ast.Attribute) and e.attr in _BROAD:
@@ -57,12 +51,12 @@ def _is_broad(handler: ast.ExceptHandler) -> bool:
     return False
 
 
-def _has_pragma(src_lines: List[str], handler: ast.ExceptHandler) -> bool:
+def _has_fault_ok(lines: List[str], handler: ast.ExceptHandler) -> bool:
     # the pragma must sit on the `except` line itself (or the line above),
     # with a reason after the colon
     for ln in (handler.lineno - 1, handler.lineno - 2):
-        if 0 <= ln < len(src_lines):
-            line = src_lines[ln]
+        if 0 <= ln < len(lines):
+            line = lines[ln]
             if "fault-ok:" in line and line.split("fault-ok:", 1)[1].strip():
                 return True
     return False
@@ -119,72 +113,31 @@ class _HandlerScan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _check_file(path: str) -> List[Tuple[str, int, str]]:
-    with open(path) as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"unparseable: {e.msg}")]
-    lines = src.splitlines()
-    out: List[Tuple[str, int, str]] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
+class ErrorDisciplinePass(LintPass):
+    name = "error-discipline"
+    default_config = {
+        # the serving/execution layers PR 1 gated; other layers (planner,
+        # catalog, host fallback) surface errors through normal raises
+        "include": (
+            "spark_druid_olap_tpu/server.py",
+            "spark_druid_olap_tpu/exec/",
+            "spark_druid_olap_tpu/parallel/",
+        ),
+    }
+
+    def on_ExceptHandler(self, node: ast.ExceptHandler, ctx: ModuleContext):
         if not _is_broad(node):
-            continue
-        if _has_pragma(lines, node):
-            continue
+            return
+        if _has_fault_ok(ctx.lines, node):
+            return
         scan = _HandlerScan()
         for stmt in node.body:
             scan.visit(stmt)
         if scan.raises or scan.resilience or scan.metrics or scan.logs:
-            continue
-        out.append(
-            (
-                path,
-                node.lineno,
-                "broad `except` swallows silently: add a re-raise, route "
-                "through resilience.classify_error, record to metrics/log, "
-                "or annotate `# fault-ok: <reason>`",
-            )
+            return
+        self.report(
+            ctx, node, "GL601",
+            "broad `except` swallows silently: add a re-raise, route "
+            "through resilience.classify_error, record to metrics/log, "
+            "or annotate `# fault-ok: <reason>`",
         )
-    return out
-
-
-def target_files(root: str) -> List[str]:
-    pkg = os.path.join(root, "spark_druid_olap_tpu")
-    files = [os.path.join(pkg, "server.py")]
-    for sub in ("exec", "parallel"):
-        d = os.path.join(pkg, sub)
-        for name in sorted(os.listdir(d)):
-            if name.endswith(".py"):
-                files.append(os.path.join(d, name))
-    return [f for f in files if os.path.exists(f)]
-
-
-def check_paths(root: str) -> List[Tuple[str, int, str]]:
-    out: List[Tuple[str, int, str]] = []
-    for f in target_files(root):
-        out.extend(_check_file(f))
-    return out
-
-
-def main() -> int:
-    root = (
-        sys.argv[1]
-        if len(sys.argv) > 1
-        else os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    violations = check_paths(root)
-    for path, line, msg in violations:
-        print(f"{path}:{line}: {msg}")
-    if violations:
-        print(f"{len(violations)} error-discipline violation(s)")
-        return 1
-    print("error discipline OK")
-    return 0
-
-
-if __name__ == "__main__":
-    sys.exit(main())

@@ -1,0 +1,253 @@
+"""jit-cache hazard detector.
+
+An OLAP server sees thousands of query shapes; the compile cache is the
+difference between microsecond dispatch and a recompile storm.  Three
+hazards this pass catches:
+
+* **GL101 — jit closure rebuilt per call.**  `jax.jit(...)` (call or
+  decorator) inside a function body creates a NEW callable identity each
+  invocation, so jit's own cache never hits and every call re-traces and
+  re-compiles.  Building a jitted closure in a function is fine ONLY when
+  the function stores it in an explicit program cache (an assignment
+  into a `*cache*`-named container, the engine convention) or is itself
+  memoized (`functools.lru_cache`/`cache`).
+* **GL102 — non-literal static-arg spec.**  `static_argnums`/
+  `static_argnames` built from runtime values (names, calls,
+  comprehensions) makes the static signature itself unstable — and an
+  array-valued static arg is unhashable at call time.  Specs must be
+  literal constants/tuples.
+* **GL103 — stringified compile-cache key.**  f-strings or `str(...)`
+  inside a program-cache key collapse distinct identities ("None" the
+  string vs None the value; "1:2" + "3" vs "1" + "2:3") and hide
+  unhashable parts.  Keys must stay structured tuples.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import (
+    LintPass,
+    ModuleContext,
+    call_name,
+    dotted_name,
+    has_caching_decorator,
+    is_jit_callee,
+)
+
+
+def _is_cache_store(node: ast.Assign, name: str) -> bool:
+    """`<anything>cache<anything>[...] = <name>`"""
+    for t in node.targets:
+        if isinstance(t, ast.Subscript):
+            base = dotted_name(t.value)
+            if "cache" in base.lower():
+                v = node.value
+                if isinstance(v, ast.Name) and v.id == name:
+                    return True
+    return False
+
+
+def _stored_in_cache(func: ast.AST, name: str) -> bool:
+    for n in ast.walk(func):
+        if isinstance(n, ast.Assign) and _is_cache_store(n, name):
+            return True
+    return False
+
+
+def _literal_static_spec(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_literal_static_spec(e) for e in node.elts)
+    return False
+
+
+def _contains_stringification(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.JoinedStr):
+            return True
+        if isinstance(n, ast.Call) and call_name(n) in (
+            "str", "repr", "format"
+        ):
+            return True
+    return False
+
+
+class JitCachePass(LintPass):
+    name = "jit-cache"
+    default_config = {
+        # the calibration harness deliberately rebuilds jits per run: the
+        # compile IS part of what it measures
+        "exclude": ("spark_druid_olap_tpu/plan/calibrate.py",),
+    }
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._decorator_nodes: set = set()
+
+    # -- GL101 ----------------------------------------------------------------
+
+    def on_FunctionDef(self, node: ast.FunctionDef, ctx: ModuleContext):
+        for d in node.decorator_list:
+            for sub in ast.walk(d):
+                self._decorator_nodes.add(id(sub))
+        scope = ctx.scope
+        if not scope.in_function:
+            return  # module/class-level jit: one identity, cached by jax
+        if not any(is_jit_callee(d) for d in node.decorator_list):
+            return
+        if any(has_caching_decorator(f) for f in scope.func_stack):
+            return
+        enclosing = scope.current_func
+        if _stored_in_cache(enclosing, node.name):
+            return
+        self.report(
+            ctx, node, "GL101",
+            f"jit-decorated closure {node.name!r} is rebuilt on every call "
+            "of its enclosing function — each rebuild re-traces and "
+            "re-compiles; store it in a program cache or memoize the "
+            "builder",
+        )
+
+    on_AsyncFunctionDef = on_FunctionDef
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        self._check_static_spec(node, ctx)
+        self._check_cache_get_key(node, ctx)
+        if id(node) in self._decorator_nodes:
+            return  # decorator use handled via on_FunctionDef
+        if dotted_name(node.func) not in ("jax.jit", "jit"):
+            return
+        scope = ctx.scope
+        if not scope.in_function:
+            return
+        if any(has_caching_decorator(f) for f in scope.func_stack):
+            return
+        # find the local name the jitted callable binds to, then look for
+        # a cache store of that name in the enclosing function
+        enclosing = scope.current_func
+        bound = self._binding_name(enclosing, node)
+        if bound is not None and _stored_in_cache(enclosing, bound):
+            return
+        if bound is None and self._directly_cached(enclosing, node):
+            return
+        self.report(
+            ctx, node, "GL101",
+            "jax.jit(...) called inside a function builds a fresh program "
+            "identity per call (recompile storm under many query shapes); "
+            "cache the jitted callable or lift it to module scope",
+        )
+
+    @staticmethod
+    def _binding_name(func: ast.AST, call: ast.Call):
+        for n in ast.walk(func):
+            if isinstance(n, ast.Assign) and n.value is call:
+                t = n.targets[0]
+                if isinstance(t, ast.Name):
+                    return t.id
+        return None
+
+    @staticmethod
+    def _directly_cached(func: ast.AST, call: ast.Call) -> bool:
+        """`cache[key] = jax.jit(...)` with no intermediate name."""
+        for n in ast.walk(func):
+            if isinstance(n, ast.Assign) and n.value is call:
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript) and (
+                        "cache" in dotted_name(t.value).lower()
+                    ):
+                        return True
+        return False
+
+    # -- GL102 ----------------------------------------------------------------
+
+    def _check_static_spec(self, node: ast.Call, ctx: ModuleContext):
+        is_jit_call = dotted_name(node.func) in ("jax.jit", "jit")
+        is_partial_jit = (
+            call_name(node) in ("functools.partial", "partial")
+            and node.args
+            and is_jit_callee(node.args[0])
+        )
+        if not (is_jit_call or is_partial_jit):
+            return
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            if not _literal_static_spec(kw.value):
+                self.report(
+                    ctx, kw.value, "GL102",
+                    f"{kw.arg} must be a literal int/str (or tuple/list of "
+                    "them): a runtime-built spec makes the compile-cache "
+                    "signature unstable, and array-valued static args are "
+                    "unhashable at call time",
+                )
+
+    # -- GL103 ----------------------------------------------------------------
+
+    def on_Assign(self, node: ast.Assign, ctx: ModuleContext):
+        # `key = ... f"..." ...` where `key` later indexes a *cache*
+        # container, or a direct stringified store `cache[f"..."] = ...`
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Name)
+                and _contains_stringification(node.value)
+                and self._keys_a_cache(ctx, t.id)
+            ):
+                self.report(
+                    ctx, node, "GL103",
+                    "compile-cache key built with an f-string/str(): "
+                    "string interpolation collapses distinct identities "
+                    "(None vs 'None') — keep keys structured tuples",
+                )
+                return
+            if isinstance(t, ast.Subscript) and (
+                "cache" in dotted_name(t.value).lower()
+            ):
+                if _contains_stringification(t.slice):
+                    self.report(
+                        ctx, t, "GL103",
+                        "cache subscript keyed by an f-string/str() — keep "
+                        "compile-cache keys structured tuples",
+                    )
+                    return
+
+    def _keys_a_cache(self, ctx: ModuleContext, name: str) -> bool:
+        """Is `name` used to index (or .get/.setdefault/.pop on) a
+        container whose dotted name contains "cache", anywhere in the
+        enclosing scope?"""
+        scope = ctx.scope.current_func or ctx.tree
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Subscript) and (
+                "cache" in dotted_name(n.value).lower()
+            ):
+                idx = n.slice
+                if isinstance(idx, ast.Name) and idx.id == name:
+                    return True
+            if isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ):
+                if (
+                    n.func.attr in ("get", "setdefault", "pop")
+                    and "cache" in dotted_name(n.func.value).lower()
+                    and n.args
+                    and isinstance(n.args[0], ast.Name)
+                    and n.args[0].id == name
+                ):
+                    return True
+        return False
+
+    def _check_cache_get_key(self, node: ast.Call, ctx: ModuleContext):
+        # cache.get(f"...")/cache.setdefault(f"...", ...)
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        if fn.attr not in ("get", "setdefault", "pop"):
+            return
+        if "cache" not in dotted_name(fn.value).lower():
+            return
+        if node.args and _contains_stringification(node.args[0]):
+            self.report(
+                ctx, node, "GL103",
+                f"cache.{fn.attr}() keyed by an f-string/str() — keep "
+                "compile-cache keys structured tuples",
+            )

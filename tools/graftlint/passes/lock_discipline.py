@@ -1,0 +1,170 @@
+"""Lock-discipline pass: guarded-field writes must hold the owner's lock.
+
+The serving layer runs queries on ThreadingHTTPServer handler threads;
+the breaker, admission pool, resilience counters, metadata cache, and
+the engine's LRU caches are all cross-thread state guarded by an
+instance lock.  A write that skips the `with self._lock:` block is a
+data race that shows up as a wedged breaker or a corrupted LRU under
+concurrency — precisely when nobody is watching.
+
+The pass carries a REGISTRY (per-pass config) of class name -> (lock
+attribute, guarded fields).  Inside methods of a registered class it
+flags:
+
+* **GL501** — assignment / augmented assignment to a guarded
+  `self.<field>` outside a lexical `with self.<lock>:` block.
+* **GL502** — mutating operation on a guarded container field outside
+  the lock: `self.<field>[k] = v`, `del self.<field>[k]`, or a mutator
+  method call (`append`/`pop`/`clear`/`update`/`popitem`/
+  `move_to_end`/`setdefault`/`add`/`discard`/`remove`/`extend`).
+
+`__init__` is exempt (no concurrent access before construction
+completes).  The analysis is lexical: a helper that the class only ever
+calls under the lock should take the (reentrant) lock itself or carry a
+pragma — implicit caller-holds-the-lock contracts are exactly what rots.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, ModuleContext, dotted_name
+
+_MUTATORS = {
+    "append", "pop", "clear", "update", "popitem", "move_to_end",
+    "setdefault", "add", "discard", "remove", "extend", "insert",
+}
+
+# Default registry: the resilience/serving/caching state machines.
+_DEFAULT_REGISTRY = {
+    "CircuitBreaker": {
+        "lock": "_lock",
+        "fields": [
+            "_state", "_consecutive_failures", "_opened_at",
+            "_failures_total", "_successes_total", "_trips",
+            "_probe_started_at",
+        ],
+    },
+    "AdmissionController": {
+        "lock": "_lock",
+        "fields": [
+            "_in_use", "admitted_total", "rejected_total", "_waiting",
+            "_hold_ewma_ms", "_held_since",
+        ],
+    },
+    "ResilienceState": {
+        "lock": "_lock",
+        "fields": [
+            "degraded_total", "deadline_exceeded_total",
+            "server_errors_total", "last_error",
+        ],
+    },
+    "FaultInjector": {
+        "lock": "_lock",
+        "fields": ["_sites", "_fired"],
+    },
+    "MetadataCache": {
+        "lock": "_lock",
+        "fields": ["_tables", "_stars", "_lookups", "version"],
+    },
+    "ByteBudgetCache": {
+        "lock": "_lock",
+        "fields": ["_od", "_bytes"],
+    },
+    "CountBudgetCache": {
+        "lock": "_lock",
+        "fields": ["_od", "budget_entries"],
+    },
+}
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    default_config = {"registry": _DEFAULT_REGISTRY}
+
+    def _spec(self, ctx: ModuleContext):
+        cls = ctx.scope.current_class
+        if cls is None:
+            return None
+        return self.config["registry"].get(cls.name)
+
+    def _exempt(self, ctx: ModuleContext) -> bool:
+        func = ctx.scope.current_func
+        return func is None or getattr(func, "name", "") == "__init__"
+
+    @staticmethod
+    def _self_field(node: ast.AST):
+        """`self.<attr>` -> attr, else None."""
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _flag(self, ctx, node, code, field, spec):
+        self.report(
+            ctx, node, code,
+            f"write to guarded field self.{field} outside "
+            f"`with self.{spec['lock']}:` — cross-thread state must "
+            "mutate under its lock (take the lock reentrantly in helpers "
+            "or justify via pragma/baseline)",
+        )
+
+    def on_Assign(self, node: ast.Assign, ctx: ModuleContext):
+        spec = self._spec(ctx)
+        if spec is None or self._exempt(ctx):
+            return
+        if ctx.scope.holds_lock(spec["lock"]):
+            return
+        for t in node.targets:
+            field = self._self_field(t)
+            if field in spec["fields"]:
+                self._flag(ctx, node, "GL501", field, spec)
+                return
+            if isinstance(t, ast.Subscript):
+                field = self._self_field(t.value)
+                if field in spec["fields"]:
+                    self._flag(ctx, node, "GL502", field, spec)
+                    return
+
+    def on_AugAssign(self, node: ast.AugAssign, ctx: ModuleContext):
+        spec = self._spec(ctx)
+        if spec is None or self._exempt(ctx):
+            return
+        if ctx.scope.holds_lock(spec["lock"]):
+            return
+        field = self._self_field(node.target)
+        if field in spec["fields"]:
+            self._flag(ctx, node, "GL501", field, spec)
+            return
+        if isinstance(node.target, ast.Subscript):
+            field = self._self_field(node.target.value)
+            if field in spec["fields"]:
+                self._flag(ctx, node, "GL502", field, spec)
+
+    def on_Delete(self, node: ast.Delete, ctx: ModuleContext):
+        spec = self._spec(ctx)
+        if spec is None or self._exempt(ctx):
+            return
+        if ctx.scope.holds_lock(spec["lock"]):
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                field = self._self_field(t.value)
+                if field in spec["fields"]:
+                    self._flag(ctx, node, "GL502", field, spec)
+                    return
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        spec = self._spec(ctx)
+        if spec is None or self._exempt(ctx):
+            return
+        if ctx.scope.holds_lock(spec["lock"]):
+            return
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            field = self._self_field(fn.value)
+            if field in spec["fields"]:
+                self._flag(ctx, node, "GL502", field, spec)
