@@ -399,9 +399,15 @@ class AdaptiveDomainMixin:
             need = self._presence_columns(q, lowering, ds)
 
             def run_presence():
+                from ..resilience import checkpoint
+
                 seg_fn = self._presence_program(q, ds, lowering)
                 counts = None
                 for batch in self._segment_batches(segs, need):
+                    # phase A dispatches the full segment scope too: a
+                    # deadlined query cancels between presence batches
+                    # (checkpoint-coverage/GL901)
+                    checkpoint("adaptive.presence_loop")
                     cols_list = [
                         self._cols_for_segment(seg, ds, need)
                         for seg in batch

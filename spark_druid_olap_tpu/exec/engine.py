@@ -71,6 +71,7 @@ def _bytes_scanned(segs, columns) -> int:
     the validity mask (and time when fetched) over REAL rows — the
     roofline numerator (QueryMetrics.bytes_scanned)."""
     total = 0
+    # graftlint: disable=checkpoint-coverage -- O(segments) host metadata sum, no dispatch/decode per iteration
     for s in segs:
         row_bytes = 1  # valid mask
         for n in columns:
@@ -202,6 +203,7 @@ def segments_in_scope(q, ds: DataSource) -> List[Segment]:
     segs = list(ds.segments)
     if q.intervals:
         out = []
+        # graftlint: disable=checkpoint-coverage -- interval pruning is O(segments) metadata arithmetic, no per-iteration work
         for s in segs:
             if s.interval is None:
                 out.append(s)
@@ -422,6 +424,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         unroll_max = _platform_unroll_max()
         batch: List[Segment] = []
         batch_bytes = 0
+        # graftlint: disable=checkpoint-coverage -- batching is nbytes arithmetic; every CONSUMER of these batches checkpoints per batch
         for seg in segs:
             est = int(seg.valid.nbytes) + sum(
                 int(seg.column(n).nbytes) for n in names
@@ -1123,6 +1126,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             for c in ds.columns
         }
         rows = []
+        # graftlint: disable=checkpoint-coverage -- segmentMetadata renders catalog dicts, no column data touched
         for seg in self._segments_in_scope(q, ds):
             rows.append(
                 {
@@ -1178,6 +1182,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             for dim in live_dims
         }
         for seg in segs:
+            # per-segment filter evaluation + bincount is real work on a
+            # wide segment: honor the deadline between segments
+            checkpoint("engine.search_loop")
             base = np.asarray(seg.valid)
             if q.intervals and seg.time is not None:
                 t = np.asarray(seg.time)

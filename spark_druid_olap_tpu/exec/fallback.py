@@ -31,12 +31,58 @@ import numpy as np
 import pandas as pd
 
 from ..catalog.segment import DataSource
+from ..models import aggregations as A
 from ..plan import logical as L
 from ..plan.expr import Expr, compile_expr
 from ..plan import expr as E
 from ..utils.log import get_logger
 
 log = get_logger("exec.fallback")
+
+# Wire-aggregator parity registry (graftlint wire-parity/GL1002): every
+# aggregation class `models/wire.py` can decode from a Druid request
+# maps to the host aggregate function `_agg_one` interprets it with —
+# so a degraded answer can never silently lose a feature the device
+# path serves.  Distinct-count sketches evaluate EXACTLY on the host
+# (pandas nunique; the fallback has no reason to approximate), which is
+# the documented semantics divergence, not a missing feature.  Adding a
+# wire aggregator without extending this table (and `_agg_one` when the
+# function is new) fails the lint gate.
+WIRE_AGG_FALLBACK = {
+    A.Count: "count",
+    A.LongSum: "sum",
+    A.DoubleSum: "sum",
+    A.LongMin: "min",
+    A.DoubleMin: "min",
+    A.LongMax: "max",
+    A.DoubleMax: "max",
+    # FD-pruning carrier: max over dictionary codes, decoded at the API
+    # layer — plain max on the host
+    A.DimCodeMax: "max",
+    # base-routed at lowering time; every base ("doubleSum"/"longSum"/
+    # "doubleMin"/"doubleMax") is one of the host functions above
+    A.ExpressionAgg: "sum",
+    # wrapper: interpreted as the inner aggregator under AggExpr.filter
+    A.FilteredAgg: "count",
+    A.HyperUnique: "approx_count_distinct",
+    A.CardinalityAgg: "approx_count_distinct",
+    A.ThetaSketch: "approx_count_distinct_ds_theta",
+    A.QuantilesSketch: "approx_quantile",
+}
+
+
+def fallback_agg_fn(agg: A.Aggregation) -> str:
+    """The `_agg_one` function name that interprets `agg` on the host.
+    Raises for classes outside the wire-parity registry — a loud signal
+    the degraded path is about to lose a feature."""
+    if isinstance(agg, A.FilteredAgg):
+        return fallback_agg_fn(agg.aggregator)
+    for cls, fn in WIRE_AGG_FALLBACK.items():
+        if type(agg) is cls:
+            return fn
+    raise NotImplementedError(
+        f"no host fallback interpretation for {type(agg).__name__}"
+    )
 
 
 def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
@@ -54,9 +100,12 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     for c in ds.columns:
         if columns is not None and c.name not in columns:
             continue
-        checkpoint("fallback.decode")
         parts = []
         for seg in ds.segments:
+            # per-(column, segment) decode is the fallback's unit of
+            # work; checkpointing inside the segment loop keeps the
+            # deadline granularity finer than whole-column decodes
+            checkpoint("fallback.decode")
             arr = np.asarray(seg.column(c.name))[seg.valid]
             if c.name in ds.dicts:
                 arr = ds.dicts[c.name].decode(arr)

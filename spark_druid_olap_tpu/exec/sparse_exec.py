@@ -153,11 +153,17 @@ class SparseExecMixin:
         selective = q.filter is not None or bool(q.intervals)
 
         def dispatch(row_capacity=None, slots=None):
+            from ..resilience import checkpoint
+
             seg_fn = self._sparse_program(
                 q, ds, lowering, row_capacity=row_capacity, slots=slots
             )
             state = None
             for batch in self._segment_batches(segs, lowering.columns):
+                # cooperative deadline checkpoint between batch
+                # dispatches — same lifecycle contract as the dense
+                # engine's segment loop (checkpoint-coverage/GL901)
+                checkpoint("sparse.segment_loop")
                 cols_list = [
                     self._cols_for_segment(seg, ds, lowering.columns)
                     for seg in batch
@@ -254,8 +260,14 @@ class SparseExecMixin:
             # the device path.  The kernel's exact distinct-present count
             # (`n_real`) picks the smallest adequate rung; only past the
             # ladder top does the query fall back to raw scatter.
+            from ..resilience import checkpoint
+
             host = fetch_tiered(state, row_capacity, slots)
             while bool(host["overflow"]):
+                # every ladder rung re-dispatches the whole segment
+                # scope — a deadlined query must cancel between rungs,
+                # not after the ladder converges
+                checkpoint("sparse.slots_ladder")
                 n_est = int(host["n_real"])
                 new_slots = next(
                     (

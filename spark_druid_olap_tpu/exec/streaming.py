@@ -444,6 +444,7 @@ class StreamExecutor:
 
         def produce():
             try:
+                # graftlint: disable=checkpoint-coverage -- producer THREAD: the deadline contextvar lives on the query thread; cancellation reaches this loop via cancelled.set() in the consumer's finally, and the consumer's chunk loop checkpoints
                 for chunk in chunks:
                     t0 = _time.perf_counter()
                     item = self._normalize_chunk(chunk, need, ds, chunk_rows)

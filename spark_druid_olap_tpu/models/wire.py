@@ -12,6 +12,14 @@ Limits (documented, loud): JavaScript aggregators/filters are accepted only
 when their `expression` string re-parses under our SQL expression grammar
 (the `to_druid()` printer emits exactly that form for everything except
 CASE/IF trees); true JS source raises.
+
+This module is the REGISTRY graftlint's wire-parity pass (GL10xx) reads:
+every queryType branch in `query_from_druid` and every aggregator class
+in `agg_from_druid` must be referenced by the device dispatch
+(exec/engine.py), the wire result shaping (server.py), the device
+lowering (exec/lowering.py), and the host fallback's WIRE_AGG_FALLBACK
+translation table (exec/fallback.py).  Registering a new wire feature
+here without teaching those surfaces fails the lint gate.
 """
 
 from __future__ import annotations

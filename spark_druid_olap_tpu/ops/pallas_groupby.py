@@ -21,6 +21,12 @@ Layout choices (pallas_guide.md tiling rules):
 
 The kernel covers sum-class and min/max aggregations (sketch partials stay in
 XLA — scatter-shaped, see ops/hll.py).  `interpret=True` under CPU tests.
+
+The pallas_call <-> kernel contract (grid arity vs index_map signatures,
+BlockSpec ranks vs ref indexing, spec count vs kernel refs, dtype-matched
+fills) is enforced statically by graftlint's pallas-shape pass (GL7xx),
+which resolves `kernel`/`grid`/`*_specs` through local assignments and
+`functools.partial` — keep those shapes statically spellable.
 """
 
 from __future__ import annotations

@@ -24,8 +24,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# The ONLY axis names any mesh in this package declares.  Every
+# collective / PartitionSpec in the tree is checked against these by
+# graftlint's collective-axis pass (GL8xx) — add an axis here (or as a
+# `*_AXIS` constant) before using it in an SPMD body.
 DATA_AXIS = "data"
 GROUPS_AXIS = "groups"
+AXIS_NAMES = (DATA_AXIS, GROUPS_AXIS)
 
 
 def make_mesh(
@@ -43,7 +48,7 @@ def make_mesh(
     if n_data * n_groups != len(devs):
         devs = devs[: n_data * n_groups]
     arr = np.array(devs).reshape(n_data, n_groups)
-    return Mesh(arr, (DATA_AXIS, GROUPS_AXIS))
+    return Mesh(arr, AXIS_NAMES)
 
 
 def shard_map_compat(fn, *, mesh, in_specs, out_specs):

@@ -124,7 +124,7 @@ def hybrid_mesh(n_groups: int = 1):
     Single-slice / single-host: identical to `mesh.make_mesh`."""
     from jax.sharding import Mesh
 
-    from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh
+    from .mesh import AXIS_NAMES, make_mesh
 
     if jax.process_count() <= 1:
         return make_mesh(n_groups=n_groups)
@@ -136,7 +136,7 @@ def hybrid_mesh(n_groups: int = 1):
         dcn_mesh_shape=(jax.process_count(), 1),
         process_is_granule=True,
     )
-    return Mesh(devs, (DATA_AXIS, GROUPS_AXIS))
+    return Mesh(devs, AXIS_NAMES)
 
 
 def put_sharded(host: np.ndarray, sharding) -> jax.Array:

@@ -6,6 +6,16 @@ Paths are files or directories, resolved relative to --root (default:
 the current working directory, which must be the repo root for the
 standard invocation).  Exit codes: 0 clean, 1 new findings, 2 stale
 baseline entries or configuration errors.
+
+`--changed [BASE]` lints only .py files that differ from
+`git merge-base HEAD BASE` (default BASE: main) plus untracked files —
+the fast pre-commit loop.  Positional paths, when given, scope the
+changed set; with none, every changed file is linted.
+
+`--format github` emits GitHub-Actions `::error file=...,line=...`
+workflow annotations so CI findings are clickable in the log; `--format
+json` (alias: `--json`) is the machine-readable shape with the same
+finding set.
 """
 
 from __future__ import annotations
@@ -13,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from .core import (
@@ -26,12 +37,149 @@ from .core import (
 from .passes import PASS_BY_NAME
 
 
+def git_changed_files(root: str, base: str):
+    """Root-relative posix paths of .py files differing from
+    merge-base(HEAD, base), plus untracked .py files.  Deleted files are
+    dropped (nothing to lint)."""
+
+    def git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+        )
+
+    mb = git("merge-base", "HEAD", base)
+    if mb.returncode != 0:
+        raise LintConfigError(
+            f"--changed: `git merge-base HEAD {base}` failed: "
+            f"{mb.stderr.strip() or mb.stdout.strip()}"
+        )
+    merge_base = mb.stdout.strip()
+    diff = git("diff", "--name-only", merge_base)
+    if diff.returncode != 0:
+        raise LintConfigError(
+            f"--changed: `git diff --name-only {merge_base}` failed: "
+            f"{diff.stderr.strip()}"
+        )
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if untracked.returncode != 0:
+        raise LintConfigError(
+            "--changed: `git ls-files --others` failed: "
+            f"{untracked.stderr.strip()}"
+        )
+    names = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    out = []
+    for name in names:
+        name = name.strip()
+        if not name.endswith(".py"):
+            continue
+        if not os.path.exists(os.path.join(root, name)):
+            continue  # deleted on the branch
+        out.append(name)
+    return merge_base, sorted(set(out))
+
+
+def _scope_changed(changed, scope_paths, root):
+    """Restrict the changed set to files under the given paths.  Scope
+    paths are normalized to root-relative posix form first so `./tools`
+    and absolute spellings scope the same files as `tools` (a verbatim
+    comparison would silently scope to zero files and exit green)."""
+    if not scope_paths:
+        return changed
+    prefixes = []
+    for p in scope_paths:
+        if os.path.isabs(p):
+            p = os.path.relpath(p, root)
+        p = os.path.normpath(p).replace(os.sep, "/").rstrip("/")
+        prefixes.append(p)
+    return [
+        f for f in changed
+        if any(f == p or f.startswith(p + "/") for p in prefixes)
+    ]
+
+
+def _emit_github(result) -> None:
+    for f in result.new:
+        print(
+            f"::error file={f.path},line={f.line},"
+            f"title={f.pass_name}/{f.code}::{f.message}"
+        )
+    for e in result.stale:
+        print(
+            f"::error file={e.path},title={e.pass_name}/{e.code} stale"
+            f"::stale baseline entry {e.snippet!r} — the finding no "
+            "longer exists; remove it (or run --update-baseline)"
+        )
+
+
+def _update_baseline(result, baseline_path: str) -> None:
+    """Rewrite the baseline from the current findings, carrying existing
+    justifications over: exact fingerprint matches keep their reason, and
+    a finding whose snippet changed (identity moved) inherits the reason
+    of a now-stale entry with the same (pass, code, path) — an edited
+    line must not force the justification to be re-entered."""
+    # identity fallback carries a justification over ONLY from entries
+    # whose finding no longer exists (stale): an entry still matched by
+    # a live finding keeps its reason there, and a genuinely NEW second
+    # violation in the same file must get the placeholder, not silently
+    # inherit a reviewed justification
+    live = {f.fingerprint for f, _ in result.baselined}
+    live |= {f.fingerprint for f in result.new}
+    by_fingerprint = {}
+    by_identity = {}
+    for e in load_baseline(baseline_path):
+        by_fingerprint.setdefault(e.fingerprint, []).append(e.reason)
+        if e.fingerprint not in live:
+            by_identity.setdefault(
+                (e.pass_name, e.code, e.path), []
+            ).append(e.reason)
+    # entries outside this run's scope (other passes under --pass, or
+    # files outside the scanned paths) are carried through untouched:
+    # a scoped update must never delete another scope's justifications
+    entries = list(result.out_of_scope_entries)
+    for f, old in result.baselined:
+        entries.append(
+            BaselineEntry(
+                pass_name=f.pass_name, code=f.code, path=f.path,
+                snippet=f.snippet, reason=old.reason,
+            )
+        )
+    for f in result.new:
+        bucket = by_fingerprint.get(f.fingerprint)
+        if bucket:
+            reason = bucket.pop()
+        else:
+            stale_bucket = by_identity.get(
+                (f.pass_name, f.code, f.path)
+            )
+            reason = (
+                stale_bucket.pop()
+                if stale_bucket
+                else "grandfathered by --update-baseline; justify "
+                     "before merge"
+            )
+        entries.append(
+            BaselineEntry(
+                pass_name=f.pass_name, code=f.code, path=f.path,
+                snippet=f.snippet, reason=reason,
+            )
+        )
+    entries.sort(key=lambda e: (e.path, e.pass_name, e.code, e.snippet))
+    save_baseline(baseline_path, entries)
+    print(
+        f"baseline updated: {len(entries)} entr"
+        f"{'y' if len(entries) == 1 else 'ies'} -> {baseline_path}"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="AST static analysis for JAX/serving discipline",
     )
-    ap.add_argument("paths", nargs="+", help=".py files or directories")
+    ap.add_argument(
+        "paths", nargs="*", help=".py files or directories (required "
+        "unless --changed is given, where they scope the changed set)",
+    )
     ap.add_argument(
         "--root", default=os.getcwd(),
         help="repo root findings are reported relative to (default: cwd)",
@@ -46,21 +194,47 @@ def main(argv=None) -> int:
         help=f"baseline file (default: <root>/{BASELINE_NAME})",
     )
     ap.add_argument(
-        "--json", action="store_true", help="machine-readable output"
+        "--format", dest="fmt", choices=("text", "json", "github"),
+        default="text",
+        help="output format: human text (default), machine json, or "
+             "GitHub-Actions ::error annotations",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="alias for --format json",
+    )
+    ap.add_argument(
+        "--changed", nargs="?", const="main", default=None, metavar="BASE",
+        help="lint only files differing from `git merge-base HEAD BASE` "
+             "(default BASE: main) plus untracked files",
     )
     ap.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite the baseline to grandfather every current finding "
-             "(existing justifications are preserved; new entries get a "
-             "placeholder reason to fill in before merging)",
+             "(existing justifications are preserved — including across "
+             "snippet edits via (pass, code, path) identity; new entries "
+             "get a placeholder reason to fill in before merging)",
     )
     args = ap.parse_args(argv)
+    fmt = "json" if args.json else args.fmt
 
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
     try:
+        if args.changed is not None:
+            merge_base, targets = git_changed_files(root, args.changed)
+            targets = _scope_changed(targets, args.paths, root)
+            if fmt == "text":
+                print(
+                    f"graftlint --changed: {len(targets)} file(s) differ "
+                    f"from merge-base {merge_base[:12]}"
+                )
+        else:
+            if not args.paths:
+                ap.error("paths are required unless --changed is given")
+            targets = args.paths
         result = run_lint(
-            root, args.paths, pass_names=args.passes,
+            root, targets, pass_names=args.passes,
             baseline_path=baseline_path,
         )
     except LintConfigError as e:
@@ -68,41 +242,13 @@ def main(argv=None) -> int:
         return 2
 
     if args.update_baseline:
-        reasons = {}
-        for e in load_baseline(baseline_path):
-            reasons.setdefault(e.fingerprint, []).append(e.reason)
-        # entries outside this run's scope (other passes under --pass, or
-        # files outside the scanned paths) are carried through untouched:
-        # a scoped update must never delete another scope's justifications
-        entries = list(result.out_of_scope_entries)
-        for f, old in result.baselined:
-            entries.append(
-                BaselineEntry(
-                    pass_name=f.pass_name, code=f.code, path=f.path,
-                    snippet=f.snippet, reason=old.reason,
-                )
-            )
-        for f in result.new:
-            bucket = reasons.get(f.fingerprint)
-            reason = bucket.pop() if bucket else (
-                "grandfathered by --update-baseline; justify before merge"
-            )
-            entries.append(
-                BaselineEntry(
-                    pass_name=f.pass_name, code=f.code, path=f.path,
-                    snippet=f.snippet, reason=reason,
-                )
-            )
-        entries.sort(key=lambda e: (e.path, e.pass_name, e.code, e.snippet))
-        save_baseline(baseline_path, entries)
-        print(
-            f"baseline updated: {len(entries)} entr"
-            f"{'y' if len(entries) == 1 else 'ies'} -> {baseline_path}"
-        )
+        _update_baseline(result, baseline_path)
         return 0
 
-    if args.json:
+    if fmt == "json":
         print(json.dumps(result.to_dict(), indent=2))
+    elif fmt == "github":
+        _emit_github(result)
     else:
         for f in result.new:
             print(f.render())

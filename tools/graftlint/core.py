@@ -277,7 +277,13 @@ class Walker:
 
 class LintPass:
     """Base class: subclasses set `name`, `default_config`, and implement
-    `on_<NodeType>` handlers that call `self.report(...)`."""
+    `on_<NodeType>` handlers that call `self.report(...)`.
+
+    Semantic (project-aware) passes additionally read `self.project` — a
+    `project.Project` bound before the walk with the whole scanned tree's
+    symbol tables — and/or override `finish(project)`, which runs once
+    after every module has been walked (the place for cross-module
+    contract checks that need the full picture, e.g. wire-parity)."""
 
     name: str = ""
     default_config: dict = {}
@@ -287,11 +293,18 @@ class LintPass:
         cfg.update(config or {})
         self.config = cfg
         self._sink: List[Finding] = []
+        self.project = None  # bound by the runner before walking
 
     # -- lifecycle (runner-managed) ------------------------------------------
 
     def bind_sink(self, sink: List[Finding]) -> None:
         self._sink = sink
+
+    def bind_project(self, project) -> None:
+        self.project = project
+
+    def finish(self, project) -> None:
+        """Called once after all modules are walked (project complete)."""
 
     def applies_to(self, relpath: str) -> bool:
         include = self.config.get("include")
@@ -326,21 +339,67 @@ class LintPass:
         )
 
 
+def parse_pragma(line: str):
+    """Parse a `graftlint:` pragma comment line.
+
+    Returns (kind, names): kind is "ok" with the frozenset of disabled
+    pass names, "none" when the line carries no pragma at all, or
+    "malformed" when the directive is a disable spelling with NO pass
+    list (`# graftlint: disable`, `disable=`, `disable= -- reason`) —
+    the shape that used to silently disable nothing."""
+    if "graftlint:" not in line:
+        return "none", frozenset()
+    directive = line.split("graftlint:", 1)[1].strip()
+    if not directive.startswith("disable"):
+        return "none", frozenset()
+    rest = directive[len("disable"):]
+    if rest and rest[0] not in ("=", " ", "\t", "-"):
+        return "none", frozenset()  # e.g. "disabled" prose, not a pragma
+    if not rest.lstrip().startswith("="):
+        return "malformed", frozenset()
+    names_part = rest.lstrip()[1:].split("--", 1)[0]
+    names = frozenset(
+        n.strip() for n in names_part.split(",") if n.strip()
+    )
+    if not names:
+        return "malformed", frozenset()
+    return "ok", names
+
+
 def _pragma_suppressed(ctx: ModuleContext, lineno: int, pass_name: str) -> bool:
     for ln in (lineno - 1, lineno - 2):  # flagged line, then line above
         if not (0 <= ln < len(ctx.lines)):
             continue
-        line = ctx.lines[ln]
-        if "graftlint:" not in line:
-            continue
-        directive = line.split("graftlint:", 1)[1].strip()
-        if not directive.startswith("disable="):
-            continue
-        names = directive[len("disable="):].split("--", 1)[0]
-        wanted = {n.strip() for n in names.split(",")}
-        if pass_name in wanted or "all" in wanted:
+        kind, names = parse_pragma(ctx.lines[ln])
+        if kind == "ok" and (pass_name in names or "all" in names):
             return True
     return False
+
+
+def _pragma_findings(ctx: ModuleContext) -> List[Finding]:
+    """GL002: a disable pragma with no pass list is an explicit finding,
+    not a silent no-op — the author believed something was suppressed."""
+    out: List[Finding] = []
+    for i, line in enumerate(ctx.lines):
+        kind, _ = parse_pragma(line)
+        if kind != "malformed":
+            continue
+        lineno = i + 1
+        if _pragma_suppressed(ctx, lineno, "core"):
+            continue
+        out.append(
+            Finding(
+                pass_name="core", code="GL002", path=ctx.relpath,
+                line=lineno,
+                message=(
+                    "malformed graftlint pragma: `disable` needs a pass "
+                    "list (`# graftlint: disable=<pass>[,<pass>] -- "
+                    "reason`) — this line suppresses NOTHING"
+                ),
+                snippet=ctx.line_text(lineno),
+            )
+        )
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -487,9 +546,12 @@ def run_lint(
     baseline_path: Optional[str] = None,
     config_overrides: Optional[Dict[str, dict]] = None,
 ) -> LintResult:
-    """Parse every target file once, run the selected passes over it, and
-    reconcile findings against the grandfathering baseline."""
+    """Parse every target file once into a whole-tree Project (symbol
+    tables + call graph), run the selected passes over each module, then
+    give every pass a `finish(project)` turn for cross-module checks —
+    and reconcile all findings against the grandfathering baseline."""
     from .passes import build_passes
+    from .project import Project
 
     passes = build_passes(pass_names, config_overrides)
     findings: List[Finding] = []
@@ -497,11 +559,10 @@ def run_lint(
         p.bind_sink(findings)
 
     files = iter_target_files(root, paths)
+    project = Project(root)
+    ctxs: List[ModuleContext] = []
     for path in files:
         rel = _relpath(root, path)
-        active = [p for p in passes if p.applies_to(rel)]
-        if not active:
-            continue
         with open(path) as f:
             source = f.read()
         try:
@@ -517,11 +578,24 @@ def run_lint(
             )
             continue
         ctx = ModuleContext(path, rel, source, tree)
-        Walker(active).run(ctx)
+        project.add_module(ctx)
+        findings.extend(_pragma_findings(ctx))
+        ctxs.append(ctx)
+    project.finalize()
+    for p in passes:
+        p.bind_project(project)
+    for ctx in ctxs:
+        active = [p for p in passes if p.applies_to(ctx.relpath)]
+        if active:
+            Walker(active).run(ctx)
+    for p in passes:
+        p.finish(project)
 
     if baseline_path is None:
         baseline_path = os.path.join(root, BASELINE_NAME)
-    active_pass_names = {p.name for p in passes}
+    # "core" is always in scope: GL001/GL002 come from the runner itself,
+    # and their baseline entries must be matchable/stale-checkable
+    active_pass_names = {p.name for p in passes} | {"core"}
     scanned_rels = {_relpath(root, f) for f in files}
     # entries for passes that are not running this invocation, or for
     # files outside the scanned target set, are out of scope: a

@@ -2,10 +2,14 @@
 
 Adding a pass: subclass `core.LintPass` in a new module here, set
 `name`/`default_config`, implement `on_<NodeType>` handlers that call
-`self.report(ctx, node, code, message)`, and append the class to
-`ALL_PASSES`.  Codes are namespaced per pass (GL1xx jit-cache, GL2xx
-trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
-lock-discipline, GL6xx error-discipline).
+`self.report(ctx, node, code, message)` — and, for semantic passes,
+read `self.project` (the whole-tree symbol table, `project.Project`) or
+override `finish(project)` for cross-module checks — then append the
+class to `ALL_PASSES`.  Codes are namespaced per pass (GL1xx jit-cache,
+GL2xx trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
+lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
+collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity; GL00x
+are the core's own: GL001 unparseable file, GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -13,12 +17,16 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from ..core import LintConfigError, LintPass
+from .checkpoint_coverage import CheckpointCoveragePass
+from .collective_axis import CollectiveAxisPass
 from .compat_import import CompatImportPass
 from .dtype_x64 import DtypeX64Pass
 from .error_discipline import ErrorDisciplinePass
 from .jit_cache import JitCachePass
 from .lock_discipline import LockDisciplinePass
+from .pallas_shape import PallasShapePass
 from .trace_purity import TracePurityPass
+from .wire_parity import WireParityPass
 
 ALL_PASSES = (
     JitCachePass,
@@ -27,6 +35,10 @@ ALL_PASSES = (
     CompatImportPass,
     LockDisciplinePass,
     ErrorDisciplinePass,
+    PallasShapePass,
+    CollectiveAxisPass,
+    CheckpointCoveragePass,
+    WireParityPass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,132 @@
+"""checkpoint-coverage pass: segment/chunk/rung loops must checkpoint
+(GL9xx).
+
+PR 1's deadline machinery is COOPERATIVE: a query past its wall-clock
+budget is only cancelled when execution reaches a
+`resilience.checkpoint(site)` call.  A per-segment dispatch loop (or a
+sparse-ladder rerun loop) without one turns a 250 ms deadline into
+"whenever the loop finishes" — the engine's >100 ms units of work all
+live in these loops, so every one of them must reach a checkpoint.
+
+The pass walks the configured hot execution modules and flags loops that
+iterate the expensive units — identified by segment/chunk/batch/rung
+vocabulary in the loop header (target, iterable, or while-condition,
+including string keys like `host["overflow"]`) — whose body does NOT
+reach a `checkpoint(...)` call either lexically or through ONE level of
+intra-project calls (the flow layer's call-through: a helper may carry
+the checkpoint for its caller, a helper-of-a-helper may not — implicit
+two-deep contracts are unauditable).
+
+Loops inside traced code (`@jax.jit` bodies, `*_kernel` functions) are
+exempt: those run at trace time and a host checkpoint inside them would
+be wrong, not missing.  Cheap metadata loops that merely ITERATE
+segments (pruning, byte accounting) are expected to carry a pragma with
+a reason — the pass deliberately errs toward asking.
+
+* **GL901** — segment/chunk/rung loop with no reachable checkpoint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import LintPass, ModuleContext, has_jit_decorator
+
+_LOOP_HEADER_KEYWORDS = (
+    "seg", "chunk", "batch", "rung", "slot", "overflow",
+)
+
+
+def _header_tokens(nodes: Iterable[ast.AST]):
+    for root in nodes:
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Name):
+                yield sub.id.lower()
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr.lower()
+            elif isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str
+            ):
+                yield sub.value.lower()
+
+
+def _is_checkpoint(name: str, canon: str) -> bool:
+    return (
+        name == "checkpoint"
+        or name.endswith(".checkpoint")
+        or canon.endswith("resilience.checkpoint")
+    )
+
+
+class CheckpointCoveragePass(LintPass):
+    name = "checkpoint-coverage"
+    default_config = {
+        # the hot execution modules the PR 1 deadline contract names
+        "include": (
+            "spark_druid_olap_tpu/exec/engine.py",
+            "spark_druid_olap_tpu/exec/streaming.py",
+            "spark_druid_olap_tpu/exec/sparse_exec.py",
+            "spark_druid_olap_tpu/exec/fallback.py",
+            "spark_druid_olap_tpu/exec/adaptive_exec.py",
+        ),
+        "keywords": _LOOP_HEADER_KEYWORDS,
+        "kernel_name_suffixes": ("_kernel",),
+        "call_through_depth": 1,
+    }
+
+    # -- scope ---------------------------------------------------------------
+
+    def _in_traced_scope(self, ctx: ModuleContext) -> bool:
+        suffixes = self.config["kernel_name_suffixes"]
+        for f in ctx.scope.func_stack:
+            if has_jit_decorator(f):
+                return True
+            name = getattr(f, "name", "")
+            if any(name.endswith(s) for s in suffixes):
+                return True
+        return False
+
+    def _matches(self, header_nodes) -> bool:
+        kws = self.config["keywords"]
+        return any(
+            any(k in tok for k in kws)
+            for tok in _header_tokens(header_nodes)
+        )
+
+    # -- handlers -------------------------------------------------------------
+
+    def on_For(self, node: ast.For, ctx: ModuleContext):
+        self._check(node, (node.target, node.iter), ctx)
+
+    def on_AsyncFor(self, node: ast.AsyncFor, ctx: ModuleContext):
+        self._check(node, (node.target, node.iter), ctx)
+
+    def on_While(self, node: ast.While, ctx: ModuleContext):
+        self._check(node, (node.test,), ctx)
+
+    def _check(self, node, header_nodes, ctx: ModuleContext):
+        if self.project is None:
+            return
+        if self._in_traced_scope(ctx):
+            return
+        if not self._matches(header_nodes):
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is None:
+            return
+        covered = self.project.reaches_call(
+            module, node, _is_checkpoint,
+            depth=int(self.config["call_through_depth"]),
+            cls=ctx.scope.current_class,
+        )
+        if covered:
+            return
+        self.report(
+            ctx, node, "GL901",
+            "segment/chunk/rung loop never reaches a "
+            "resilience.checkpoint(site) — a deadline cannot fire "
+            "mid-loop, so the query's wall-clock budget is unenforceable "
+            "here (checkpoint in the body or one call level down; cheap "
+            "metadata-only loops take a pragma with a reason)",
+        )

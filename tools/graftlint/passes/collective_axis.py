@@ -1,0 +1,178 @@
+"""collective-axis pass: mesh axis-name contracts (GL8xx).
+
+`lax.psum(x, "dta")` inside a shard_map body is not a typo XLA catches
+at trace time on a single-device test mesh — it surfaces as a
+`NameError: unbound axis` only when the SPMD path actually runs, or
+silently merges over the wrong axis when two axes exist.  The axis
+names are declared in one module (`parallel/mesh.py`: `DATA_AXIS`,
+`GROUPS_AXIS`, and the `Mesh(arr, (...))` constructors) and consumed
+everywhere else — exactly the cross-file distance the project symbol
+table closes.
+
+The pass first collects every axis name the scanned tree declares:
+
+* module-level string constants named `*_AXIS`;
+* literal / resolvable axis-name tuples passed to `Mesh(...)`
+  constructors (second positional argument or `axis_names=`).
+
+Then it checks every consumer, resolving names through imports:
+
+* **GL801** — a collective (`lax.psum`/`pmin`/`pmax`/`pmean`/
+  `all_gather`/`psum_scatter`/`all_to_all`/`axis_index`) whose
+  axis-name argument statically resolves to a string no mesh declares.
+* **GL802** — a `PartitionSpec` (`P(...)`) entry naming an undeclared
+  axis: `P("dat")` shards over nothing and silently replicates.
+
+When the scanned tree declares no axes at all (e.g. a single-file run
+that excludes the mesh module) the pass stays silent: absence of
+evidence is not a finding.  Unresolvable (dynamic) axis expressions are
+likewise skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import LintPass, call_name
+
+# collective -> index of the positional axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmin": 1, "pmax": 1, "pmean": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1,
+    "axis_index": 0,
+}
+
+
+def _collective_name(canon: str) -> Optional[str]:
+    """The collective's short name when `canon` is a lax collective."""
+    short = canon.rsplit(".", 1)[-1]
+    if short not in _COLLECTIVES:
+        return None
+    if canon in (short, f"lax.{short}", f"jax.lax.{short}"):
+        return short
+    if canon.endswith(f".lax.{short}"):
+        return short
+    return None
+
+
+def _is_partition_spec(canon: str) -> bool:
+    return canon == "PartitionSpec" or canon.endswith(".PartitionSpec")
+
+
+class CollectiveAxisPass(LintPass):
+    name = "collective-axis"
+    # extra_axes: names declared outside the scanned tree (ops teams can
+    # add deployment-specific axes without touching the pass)
+    default_config = {"extra_axes": ()}
+
+    def _declared_axes(self, project) -> Set[str]:
+        axes: Set[str] = set(self.config["extra_axes"])
+        for m in project.modules.values():
+            for name, expr in m.constants.items():
+                if (
+                    name.endswith("_AXIS")
+                    and isinstance(expr, ast.Constant)
+                    and isinstance(expr.value, str)
+                ):
+                    axes.add(expr.value)
+            for node in ast.walk(m.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = project.canonical(m, call_name(node))
+                if not (canon == "Mesh" or canon.endswith(".Mesh")):
+                    continue
+                names_arg = node.args[1] if len(node.args) > 1 else None
+                for k in node.keywords:
+                    if k.arg == "axis_names":
+                        names_arg = k.value
+                owner = m
+                if isinstance(names_arg, ast.Name):
+                    # `Mesh(arr, AXIS_NAMES)`: follow the constant to
+                    # its tuple literal — and resolve the tuple's OWN
+                    # element names against the module that wrote it,
+                    # not the importer
+                    entry = project.resolve_constant_entry(
+                        m, names_arg.id
+                    )
+                    if entry is not None:
+                        owner, names_arg = entry
+                if isinstance(names_arg, (ast.Tuple, ast.List)):
+                    for elt in names_arg.elts:
+                        s = project.resolve_string(owner, elt)
+                        if s is not None:
+                            axes.add(s)
+                else:
+                    s = project.resolve_string(owner, names_arg) \
+                        if names_arg is not None else None
+                    if s is not None:
+                        axes.add(s)
+        return axes
+
+    def finish(self, project) -> None:
+        axes = self._declared_axes(project)
+        if not axes:
+            return
+        shown = ", ".join(sorted(axes))
+        for m in project.modules.values():
+            if not self.applies_to(m.relpath):
+                continue
+            for node in ast.walk(m.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = project.canonical(m, call_name(node))
+                short = _collective_name(canon)
+                if short is not None:
+                    self._check_collective(
+                        project, m, node, short, axes, shown
+                    )
+                elif _is_partition_spec(canon):
+                    self._check_pspec(project, m, node, axes, shown)
+
+    def _axis_exprs(self, node: ast.Call, short: str) -> List[ast.AST]:
+        arg = None
+        for k in node.keywords:
+            if k.arg == "axis_name":
+                arg = k.value
+        if arg is None:
+            idx = _COLLECTIVES[short]
+            if len(node.args) > idx:
+                arg = node.args[idx]
+        if arg is None:
+            return []
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            return list(arg.elts)
+        return [arg]
+
+    def _check_collective(self, project, m, node, short, axes, shown):
+        for expr in self._axis_exprs(node, short):
+            s = project.resolve_string(m, expr)
+            if s is None or s in axes:
+                continue
+            self.report(
+                m.ctx, node, "GL801",
+                f"lax.{short} over axis {s!r}: no mesh in the scanned "
+                f"tree declares that axis (declared: {shown}) — an "
+                "unbound axis name fails only when the SPMD path "
+                "actually runs",
+            )
+
+    def _check_pspec(self, project, m, node, axes, shown):
+        entries: List[ast.AST] = []
+        for a in node.args:
+            if isinstance(a, (ast.Tuple, ast.List)):
+                entries.extend(a.elts)
+            else:
+                entries.append(a)
+        for expr in entries:
+            if isinstance(expr, ast.Constant) and expr.value is None:
+                continue
+            s = project.resolve_string(m, expr)
+            if s is None or s in axes:
+                continue
+            self.report(
+                m.ctx, node, "GL802",
+                f"PartitionSpec names axis {s!r}, which no mesh in the "
+                f"scanned tree declares (declared: {shown}) — the array "
+                "silently replicates instead of sharding",
+            )

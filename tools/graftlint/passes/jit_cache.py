@@ -77,9 +77,12 @@ def _contains_stringification(node: ast.AST) -> bool:
 class JitCachePass(LintPass):
     name = "jit-cache"
     default_config = {
-        # the calibration harness deliberately rebuilds jits per run: the
-        # compile IS part of what it measures
-        "exclude": ("spark_druid_olap_tpu/plan/calibrate.py",),
+        # the calibration and profiling harnesses deliberately rebuild
+        # jits per run: the compile IS part of what they measure
+        "exclude": (
+            "spark_druid_olap_tpu/plan/calibrate.py",
+            "tools/profile_",
+        ),
     }
 
     def begin_module(self, ctx: ModuleContext) -> None:

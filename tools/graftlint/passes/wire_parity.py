@@ -1,0 +1,127 @@
+"""wire-parity pass: the Druid wire surface vs the execution surfaces
+(GL10xx).
+
+`models/wire.py` is the registry of everything a client can ask for:
+`query_from_druid` enumerates the queryTypes, `agg_from_druid` the
+aggregator classes.  Each registered feature must be HANDLED by the
+surfaces that answer queries — the device dispatch/lowering AND the
+degraded-path modules — or a client request decodes fine and then dies
+(or worse: silently drops a feature) deep in execution.  Nothing ties
+those files together at import time, so only a project-level pass can
+keep them in lockstep.
+
+Mechanics: the pass reads the registries structurally (constructor calls
+returned by the decoder functions, plus mapping-dict values like the
+`simple` sum/min/max table), then requires each registered class name to
+be *referenced* in every configured surface (a reference means an
+isinstance dispatch, a mapping entry, or an explicit
+translation-registry entry like `exec/fallback.py`'s
+`WIRE_AGG_FALLBACK`).  Surfaces whose modules are not in the scanned
+tree are skipped — a scoped run proves nothing about absent files.
+
+* **GL1001** — a wire-registered QUERY TYPE's model class is not
+  referenced by a surface (e.g. `query_from_druid` gained a queryType
+  that `Engine.execute` never dispatches, or `druid_result_shape`
+  cannot shape).
+* **GL1002** — a wire-registered AGGREGATOR class is not referenced by
+  a surface (e.g. decodable from the wire but absent from the device
+  lowering's `_lower_aggs`, or missing a host-fallback translation —
+  the degraded path would silently lose the feature).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..core import LintPass
+
+_QUERY_SURFACES = (
+    ("device query dispatch",
+     ("spark_druid_olap_tpu/exec/engine.py",)),
+    ("wire result shaping",
+     ("spark_druid_olap_tpu/server.py",)),
+)
+_AGG_SURFACES = (
+    ("device lowering",
+     ("spark_druid_olap_tpu/exec/lowering.py",)),
+    ("host fallback interpreter",
+     ("spark_druid_olap_tpu/exec/fallback.py",)),
+)
+
+
+def _registered_classes(fi) -> List[Tuple[str, ast.AST]]:
+    """(class name, registration node) for every `Mod.Class(...)`
+    constructor a decoder function returns, plus every `Mod.Class`
+    value in mapping dicts (the `simple` table)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Return) and isinstance(
+            node.value, ast.Call
+        ):
+            func = node.value.func
+            if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name
+            ):
+                out.setdefault(func.attr, node)
+        elif isinstance(node, ast.Dict):
+            for v in node.values:
+                if isinstance(v, ast.Attribute) and isinstance(
+                    v.value, ast.Name
+                ):
+                    out.setdefault(v.attr, v)
+    return sorted(out.items())
+
+
+class WireParityPass(LintPass):
+    name = "wire-parity"
+    default_config = {
+        "wire_path": "spark_druid_olap_tpu/models/wire.py",
+        "query_decoder": "query_from_druid",
+        "agg_decoder": "agg_from_druid",
+        "query_surfaces": _QUERY_SURFACES,
+        "agg_surfaces": _AGG_SURFACES,
+    }
+
+    def finish(self, project) -> None:
+        wire = project.modules.get(self.config["wire_path"])
+        if wire is None:
+            return
+        self._check_registry(
+            project, wire, self.config["query_decoder"],
+            self.config["query_surfaces"], "GL1001", "query type",
+        )
+        self._check_registry(
+            project, wire, self.config["agg_decoder"],
+            self.config["agg_surfaces"], "GL1002", "aggregator",
+        )
+
+    def _check_registry(
+        self, project, wire, decoder, surfaces, code, what
+    ) -> None:
+        fi = wire.functions.get(decoder)
+        if fi is None:
+            return
+        registered = _registered_classes(fi)
+        if not registered:
+            return
+        for surface_name, paths in surfaces:
+            mods = [
+                project.modules[p] for p in paths if p in project.modules
+            ]
+            if not mods:
+                continue  # surface not in this run's scope
+            idents = set()
+            for m in mods:
+                idents |= m.identifiers
+            files = ", ".join(m.relpath for m in mods)
+            for cls_name, node in registered:
+                if cls_name in idents:
+                    continue
+                self.report(
+                    wire.ctx, node, code,
+                    f"wire-registered {what} {cls_name} is not handled "
+                    f"by the {surface_name} surface ({files}) — a "
+                    "client request decodes and then fails (or silently "
+                    "loses the feature) at execution",
+                )

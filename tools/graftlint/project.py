@@ -1,0 +1,351 @@
+"""graftlint project layer: whole-package symbol resolution + flow queries.
+
+PR 2's core parses one file and walks it once; the passes it enables are
+syntactic.  The bugs that actually burn the serving stack are cross-file
+*contract* violations — a kernel whose BlockSpecs live two modules away
+from its `pl.pallas_call`, a collective over an axis name the mesh never
+declares, a segment loop whose cooperative checkpoint lives in a helper.
+This module gives passes the project-level facts those checks need:
+
+  * **Module table** — every scanned file parsed once (the same
+    `ModuleContext` the walker uses) and indexed by root-relative path
+    AND dotted module name.
+  * **Symbol table per module** — import aliases (including relative
+    imports resolved against the module's package), module-level
+    constants, functions/methods by qualname, classes and their
+    attribute sets.
+  * **Canonical names** — `pl.BlockSpec` resolves through
+    `from jax.experimental import pallas as pl` to
+    `jax.experimental.pallas.BlockSpec`; decorators resolve the same
+    way.  Passes match canonical names, not spelling-of-the-day aliases.
+  * **Call graph** — intra-project call edges per function qualname
+    (best-effort: bare names, import aliases, `self.method`).
+  * **Flow layer** — `reaches_call(...)`: does this statement body reach
+    a call matching a predicate, lexically or through ONE level of
+    intra-project calls?  That is the depth the checkpoint-coverage and
+    kernel passes need without whole-program dataflow.
+
+Everything here is best-effort static resolution: when a name cannot be
+resolved the answer is "unknown" and passes are expected to stay silent
+(no finding) rather than guess — a semantic lint that cries wolf on
+dynamic code gets pragma'd into uselessness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .core import ModuleContext, call_name, dotted_name
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module name for a root-relative posix path:
+    `pkg/exec/engine.py` -> "pkg.exec.engine"; `pkg/__init__.py` ->
+    "pkg"; `bench.py` -> "bench"."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else (
+        relpath.split("/")
+    )
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class FunctionInfo:
+    """One function or method: its AST, owning module, and call edges."""
+
+    __slots__ = ("module", "qualname", "node", "cls", "calls")
+
+    def __init__(self, module: "ModuleInfo", qualname: str, node: ast.AST,
+                 cls: Optional[ast.ClassDef]):
+        self.module = module
+        self.qualname = qualname  # "f" or "Cls.f"
+        self.node = node
+        self.cls = cls
+        # (call node, canonical dotted callee) — resolved lazily by
+        # Project._build_call_graph; intra-project targets only
+        self.calls: List[Tuple[ast.Call, str]] = []
+
+
+class ModuleInfo:
+    """Per-module symbol table."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.relpath = ctx.relpath
+        self.modname = module_name_for(ctx.relpath)
+        # package the module's RELATIVE imports resolve against
+        self.package = (
+            self.modname
+            if ctx.relpath.endswith("/__init__.py")
+            else self.modname.rpartition(".")[0]
+        )
+        # local alias -> canonical dotted target ("jnp" -> "jax.numpy",
+        # "checkpoint" -> "<pkg>.resilience.checkpoint")
+        self.import_aliases: Dict[str, str] = {}
+        # module-level `NAME = <expr>` (last assignment wins)
+        self.constants: Dict[str, ast.expr] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.class_attrs: Dict[str, Set[str]] = {}
+        # every Name id and Attribute attr in the module — the cheap
+        # "does this module reference symbol X at all" query wire-parity
+        # style passes need
+        self.identifiers: Set[str] = set()
+        self._index()
+
+    # -- construction ---------------------------------------------------------
+
+    def _resolve_relative(self, level: int, mod: Optional[str]) -> str:
+        base = self.package.split(".") if self.package else []
+        if level > 1:
+            base = base[: len(base) - (level - 1)]
+        tail = mod.split(".") if mod else []
+        return ".".join(base + tail)
+
+    def _index(self) -> None:
+        tree = self.ctx.tree
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.constants[t.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    self.constants[stmt.target.id] = stmt.value
+        # imports anywhere in the module (this codebase leans on
+        # function-local imports); collisions are rare enough to accept
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                mod = (
+                    self._resolve_relative(node.level, node.module)
+                    if node.level
+                    else (node.module or "")
+                )
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.import_aliases[a.asname or a.name] = (
+                        f"{mod}.{a.name}" if mod else a.name
+                    )
+            elif isinstance(node, ast.Name):
+                self.identifiers.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                self.identifiers.add(node.attr)
+        self._index_scope(tree.body, prefix="", cls=None)
+
+    def _index_scope(self, body, prefix: str, cls) -> None:
+        for stmt in body:
+            if isinstance(stmt, _FUNC_NODES):
+                qual = prefix + stmt.name
+                self.functions[qual] = FunctionInfo(self, qual, stmt, cls)
+                # one nesting level of defs inside defs is not indexed:
+                # closures are resolved lexically by reaches_call instead
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = c = stmt
+                attrs: Set[str] = set()
+                for sub in ast.walk(c):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"
+                        and isinstance(sub.ctx, ast.Store)
+                    ):
+                        attrs.add(sub.attr)
+                self.class_attrs[stmt.name] = attrs
+                self._index_scope(c.body, prefix=f"{stmt.name}.", cls=c)
+
+
+class Project:
+    """All scanned modules + resolution/flow queries for semantic passes."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}  # by relpath
+        self.by_name: Dict[str, ModuleInfo] = {}  # by dotted module name
+        # (relpath, qualname) -> canonical callee dotted names; built by
+        # finalize() for intra-project edges only
+        self.call_graph: Dict[Tuple[str, str], List[str]] = {}
+
+    def add_module(self, ctx: ModuleContext) -> ModuleInfo:
+        info = ModuleInfo(ctx)
+        self.modules[info.relpath] = info
+        self.by_name[info.modname] = info
+        return info
+
+    def finalize(self) -> None:
+        for info in self.modules.values():
+            for fi in info.functions.values():
+                edges: List[str] = []
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = call_name(node)
+                    if not name:
+                        continue
+                    target = self.resolve_function(info, name, cls=fi.cls)
+                    if target is not None:
+                        canon = (
+                            f"{target.module.modname}.{target.qualname}"
+                        )
+                        fi.calls.append((node, canon))
+                        edges.append(canon)
+                if edges:
+                    self.call_graph[(info.relpath, fi.qualname)] = edges
+
+    # -- name resolution ------------------------------------------------------
+
+    def canonical(self, module: ModuleInfo, dotted: str) -> str:
+        """Resolve the leading segment of a dotted name through the
+        module's import aliases: `pl.BlockSpec` ->
+        "jax.experimental.pallas.BlockSpec".  Unknown roots pass through
+        unchanged."""
+        if not dotted:
+            return dotted
+        head, _, rest = dotted.partition(".")
+        target = module.import_aliases.get(head)
+        if target is None:
+            return dotted
+        return f"{target}.{rest}" if rest else target
+
+    def resolve_constant_entry(
+        self, module: ModuleInfo, dotted: str, _depth: int = 0
+    ) -> Optional[Tuple[ModuleInfo, ast.expr]]:
+        """Follow a (possibly imported) name to (owning module,
+        module-level expression), across project modules.  The owner
+        matters: sub-expressions of the result (e.g. the Names inside an
+        axis tuple) must be resolved against the module that WROTE them,
+        not the importer."""
+        if _depth > 5 or not dotted:
+            return None
+        if "." not in dotted:
+            if dotted in module.constants:
+                return module, module.constants[dotted]
+            alias = module.import_aliases.get(dotted)
+            if alias and alias != dotted:
+                return self._entry_by_canonical(alias, _depth + 1)
+            return None
+        return self._entry_by_canonical(
+            self.canonical(module, dotted), _depth + 1
+        )
+
+    def resolve_constant(
+        self, module: ModuleInfo, dotted: str, _depth: int = 0
+    ) -> Optional[ast.expr]:
+        """`resolve_constant_entry` without the owner; None for anything
+        unresolvable (parameters, locals, externals)."""
+        entry = self.resolve_constant_entry(module, dotted, _depth)
+        return entry[1] if entry is not None else None
+
+    def _entry_by_canonical(
+        self, canon: str, depth: int
+    ) -> Optional[Tuple[ModuleInfo, ast.expr]]:
+        modpath, _, sym = canon.rpartition(".")
+        target = self.by_name.get(modpath)
+        if target is None or not sym:
+            return None
+        return self.resolve_constant_entry(target, sym, depth)
+
+    def resolve_string(
+        self, module: ModuleInfo, node: ast.AST
+    ) -> Optional[str]:
+        """Static string value of an expression: literal, or a
+        (possibly imported) module-level string constant."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        dotted = dotted_name(node)
+        if dotted:
+            expr = self.resolve_constant(module, dotted)
+            if isinstance(expr, ast.Constant) and isinstance(
+                expr.value, str
+            ):
+                return expr.value
+        return None
+
+    def resolve_function(
+        self,
+        module: ModuleInfo,
+        dotted: str,
+        cls: Optional[ast.ClassDef] = None,
+    ) -> Optional[FunctionInfo]:
+        """Best-effort intra-project function resolution: bare names
+        and `Cls.name` in this module, `self.method` against the
+        enclosing class, `from x import f` / `import x` aliases across
+        project modules."""
+        if not dotted:
+            return None
+        if dotted.startswith("self.") and cls is not None:
+            meth = dotted[len("self."):]
+            if "." in meth:
+                return None
+            return module.functions.get(f"{cls.name}.{meth}")
+        if dotted in module.functions:
+            return module.functions[dotted]
+        # `dotted_name` strips a leading underscore on the first segment
+        # (so `import x as _x` aliases match); undo that for bare local
+        # helpers like `_helper()`
+        if "." not in dotted and f"_{dotted}" in module.functions:
+            return module.functions[f"_{dotted}"]
+        canon = self.canonical(module, dotted)
+        modpath, _, sym = canon.rpartition(".")
+        target = self.by_name.get(modpath)
+        if target is not None and sym:
+            fi = target.functions.get(sym)
+            if fi is not None:
+                return fi
+        # `from .x import f`: canon is "<pkg>.x.f" and "<pkg>.x" is the
+        # module — handled above; "import pkg.x" usage "pkg.x.f" too.
+        # As a last resort treat the whole canon as module-level symbol
+        # of a scanned module two segments up (Cls.method references).
+        if target is None and "." in modpath:
+            outer, _, clsname = modpath.rpartition(".")
+            mod2 = self.by_name.get(outer)
+            if mod2 is not None:
+                return mod2.functions.get(f"{clsname}.{sym}")
+        return None
+
+    # -- flow layer -----------------------------------------------------------
+
+    def reaches_call(
+        self,
+        module: ModuleInfo,
+        body: ast.AST,
+        pred: Callable[[str, str], bool],
+        depth: int = 1,
+        cls: Optional[ast.ClassDef] = None,
+    ) -> bool:
+        """True when `body` contains a call matching `pred(raw_name,
+        canonical_name)` — lexically, or (depth permitting) inside the
+        body of an intra-project callee.  One level of call-through is
+        the contract the checkpoint-coverage pass is specified against:
+        helpers may carry the checkpoint, helpers-of-helpers may not."""
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name:
+                continue
+            if pred(name, self.canonical(module, name)):
+                return True
+        if depth <= 0:
+            return False
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name:
+                continue
+            target = self.resolve_function(module, name, cls=cls)
+            if target is not None and self.reaches_call(
+                target.module, target.node, pred,
+                depth=depth - 1, cls=target.cls,
+            ):
+                return True
+        return False

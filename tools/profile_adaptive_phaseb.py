@@ -82,7 +82,7 @@ def main():
         gid = (a * 2 + b) * 8 + cc          # mixed radix, G' = 32
         gid = jnp.where(mask, gid, 32)      # trash slot
         st = jax.ops.segment_sum(
-            jnp.where(mask, v + salt, 0.0), gid, num_segments=33
+            jnp.where(mask, v + salt, jnp.asarray(0.0, v.dtype)), gid, num_segments=33
         )
         return jnp.sum(st)
 
@@ -95,7 +95,7 @@ def main():
         gid = (a * 2 + b) * 8 + cc
         gid = jnp.where(mask, gid, 32)
         oh = jax.nn.one_hot(gid.reshape(-1, 4096), 33, dtype=jnp.bfloat16)
-        vv = jnp.where(mask, v + salt, 0.0).reshape(-1, 4096)
+        vv = jnp.where(mask, v + salt, jnp.asarray(0.0, v.dtype)).reshape(-1, 4096)
         return jnp.sum(jnp.einsum("brg,br->g", oh, vv.astype(jnp.bfloat16)))
 
     print("filter        %.4f s" % t_sync(lambda s: filt(c_city, s_city, jnp.float32(s))))

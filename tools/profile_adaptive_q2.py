@@ -55,7 +55,7 @@ def main():
             gid = (acc - 1) * 8 + y.astype(jnp.int32)
             gp = 40 * 8
             gid = jnp.where(mask & (acc > 0), gid, gp)
-            vv = jnp.where(mask, v + salt, 0.0)
+            vv = jnp.where(mask, v + salt, jnp.asarray(0.0, v.dtype))
             return inner(gid, vv, gp)
 
         return fused
