@@ -237,6 +237,16 @@ def _with_roofline(metrics_dict, bw):
     return metrics_dict
 
 
+def _span_tree(ctx):
+    """Span tree of the context's most recent query (obs/ tracer), for
+    the bench detail artifacts — `python -m tools.obs_dump
+    BENCH_<tag>_detail.json` renders it as a phase/latency table."""
+    try:
+        return ctx.tracer.last_trace_dict()
+    except Exception:  # fault-ok: artifacts must not die on a trace gap
+        return None
+
+
 def _ssb_parity(got, want) -> float:
     """Max relative error of an engine SSB result vs the (float64, exact)
     merged oracle.  Grouped results align on sorted group columns; a
@@ -350,6 +360,7 @@ def bench_ssb_streamed(scale: float):
                 ctx.last_metrics.to_dict() if ctx.last_metrics else None,
                 bw,
             ),
+            "span_tree": _span_tree(ctx),
         }
         _note_partial(name, per_q[name])
         tpu_times.append(t_tpu)
@@ -403,6 +414,7 @@ def bench_ssb(scale: float):
                 ctx.last_metrics.to_dict() if ctx.last_metrics else None,
                 bw,
             ),
+            "span_tree": _span_tree(ctx),
         }
         _note_partial(name, per_q[name])
         tpu_times.append(t_tpu)
@@ -655,6 +667,13 @@ def bench_tpch_q1(scale: float):
     out = eng.execute(q, ds)  # warmup: compile + device transfer
     assert len(out) == 6, out
     p50 = _timed(lambda: eng.execute(q, ds), reps=5, warmup=0)
+    # one traced rep for the detail artifact's span tree (direct Engine
+    # use has no context tracer; the process-default one serves here)
+    from spark_druid_olap_tpu.obs import default_tracer
+
+    with default_tracer().query_trace(query_type="bench"):
+        eng.execute(q, ds)
+    span_tree = default_tracer().last_trace_dict()
 
     # pandas oracle baseline (single-threaded host groupby, float64)
     import pandas as pd
@@ -691,6 +710,7 @@ def bench_tpch_q1(scale: float):
             "metrics": (
                 eng.last_metrics.to_dict() if eng.last_metrics else None
             ),
+            "span_tree": span_tree,
         },
     }
 

@@ -33,6 +33,16 @@ from .catalog.star import StarSchemaInfo
 from .config import SessionConfig, TableOptions
 from .exec.engine import Engine
 from .models import query as Q
+from .obs import (
+    SPAN_DEGRADED,
+    SPAN_EXECUTE,
+    SPAN_FALLBACK,
+    SPAN_PLAN,
+    Tracer,
+    current_query_id,
+    record_query_metrics,
+    span,
+)
 from .plan import expr as E
 from .plan import logical as L
 from .plan.planner import Planner, Rewrite, RewriteError
@@ -61,6 +71,10 @@ class TPUOlapContext:
 
         self.resilience = ResilienceState(self.config)
         self._sync_engine_resilience(self.engine)
+        # per-query span tracing (obs/): the ring buffer behind
+        # GET /druid/v2/trace/{query_id}; the metrics registry itself is
+        # process-global (obs.registry.get_registry)
+        self.tracer = Tracer(capacity=self.config.trace_ring_capacity)
         # SQL-text -> Rewrite cache (the reference re-plans every Catalyst
         # round; locally a repeated dashboard query should pay parse+plan
         # once).  Keyed on catalog version + config so any re-registration
@@ -271,25 +285,41 @@ class TPUOlapContext:
 
     def explain_analyze(self, sql_text: str):
         """EXPLAIN ANALYZE analog: run the query, return (DataFrame,
-        explain text + measured QueryMetrics).  Bypasses the result cache —
-        the metrics must describe THIS execution, not a cache lookup."""
+        explain text + measured QueryMetrics + the span tree).  Bypasses
+        the result cache — the metrics must describe THIS execution, not
+        a cache lookup."""
+        from .obs import current_trace
+
         lp, _, _ = parse_sql(sql_text, views=self.views)
         planner = self._planner()
-        try:
-            rw = planner.plan(lp)
-        except RewriteError as err:
-            df = self._run_fallback(lp, err)
-            text = f"== Host Fallback ==\nrewrite failed: {err}"
+        # finishing pins the root duration so the appended render shows a
+        # real total — but only when WE opened the trace (a joined outer
+        # trace must not be truncated mid-request)
+        owned = current_trace() is None
+        with self.tracer.query_trace(
+            query_type="explain_analyze", slow_ms=self.config.slow_query_ms
+        ) as tr:
+            try:
+                with span(SPAN_PLAN):
+                    rw = planner.plan(lp)
+            except RewriteError as err:
+                df = self._run_fallback(lp, err)
+                text = f"== Host Fallback ==\nrewrite failed: {err}"
+                m = self.last_metrics
+                if m is not None:
+                    text += "\n\n== Execution Metrics ==\n" + m.describe()
+                if owned:
+                    tr.finish()
+                return df, text + "\n\n== Span Tree ==\n" + tr.render()
+            with span(SPAN_EXECUTE):
+                df = self.execute_rewrite(rw, use_result_cache=False)
+            text = planner.explain(lp)
             m = self.last_metrics
             if m is not None:
                 text += "\n\n== Execution Metrics ==\n" + m.describe()
-            return df, text
-        df = self.execute_rewrite(rw, use_result_cache=False)
-        text = planner.explain(lp)
-        m = self.last_metrics
-        if m is not None:
-            text += "\n\n== Execution Metrics ==\n" + m.describe()
-        return df, text
+            if owned:
+                tr.finish()
+            return df, text + "\n\n== Span Tree ==\n" + tr.render()
 
     # -- execution -----------------------------------------------------------
 
@@ -312,27 +342,40 @@ class TPUOlapContext:
         if cmd is not None:
             return run_command(self, cmd)
         # per-query deadline: the session default arms here unless an outer
-        # scope (the server's wire `context.timeout`) is already active
-        with deadline_scope(self.config.query_timeout_ms):
-            key = self._plan_cache_key(sql_text)
-            cached = self._plan_cache.get(key)
-            if cached is not None:
-                rw, lp = cached
-                return self._execute_with_resilience(rw, lp)
-            lp, explain, out_names = parse_sql(sql_text, views=self.views)
-            planner = self._planner()
-            if explain:
-                import pandas as pd
+        # scope (the server's wire `context.timeout`) is already active.
+        # The query trace joins the server's when one is active (outermost
+        # wins, same contract as deadline_scope); a direct ctx.sql call
+        # gets its own generated query_id.
+        with self.tracer.query_trace(
+            query_type="sql", slow_ms=self.config.slow_query_ms
+        ), deadline_scope(self.config.query_timeout_ms):
+            plan_err = None
+            with span(SPAN_PLAN):
+                key = self._plan_cache_key(sql_text)
+                cached = self._plan_cache.get(key)
+                if cached is not None:
+                    rw, lp = cached
+                else:
+                    lp, explain, out_names = parse_sql(
+                        sql_text, views=self.views
+                    )
+                    planner = self._planner()
+                    if explain:
+                        import pandas as pd
 
-                return pd.DataFrame(
-                    {"plan": planner.explain(lp).split("\n")}
-                )
-            try:
-                rw = planner.plan(lp)
-            except RewriteError as err:
-                return self._run_fallback(lp, err)
-            self._plan_cache[key] = (rw, lp)
-            return self._execute_with_resilience(rw, lp)
+                        return pd.DataFrame(
+                            {"plan": planner.explain(lp).split("\n")}
+                        )
+                    try:
+                        rw = planner.plan(lp)
+                    except RewriteError as err:
+                        rw, plan_err = None, err
+                    else:
+                        self._plan_cache[key] = (rw, lp)
+            if rw is None:
+                return self._run_fallback(lp, plan_err)
+            with span(SPAN_EXECUTE):
+                return self._execute_with_resilience(rw, lp)
 
     def _sync_engine_resilience(self, engine):
         """Point an engine at this context's shared breaker and sync the
@@ -366,9 +409,10 @@ class TPUOlapContext:
             log.warning(
                 "device circuit open; answering on the host fallback"
             )
-            df = self._run_fallback(
-                lp, None, reason="device circuit open"
-            )
+            with span(SPAN_DEGRADED, reason="circuit_open"):
+                df = self._run_fallback(
+                    lp, None, reason="device circuit open"
+                )
             self._stamp_degraded(None)
             return df
         try:
@@ -389,9 +433,10 @@ class TPUOlapContext:
                 "degrading to the host fallback",
                 type(err).__name__, err,
             )
-            df = self._run_fallback(
-                lp, err, reason="device execution failed"
-            )
+            with span(SPAN_DEGRADED, reason="device_failed"):
+                df = self._run_fallback(
+                    lp, err, reason="device execution failed"
+                )
             self._stamp_degraded(err)
             return df
         m = self.last_metrics
@@ -550,18 +595,25 @@ class TPUOlapContext:
             assists["n"] += 1
             return out
 
-        df = execute_fallback(
-            lp, self.catalog, max_rows=self.config.fallback_max_rows,
-            device_exec=device_subplan,
-        )
-        self._last_engine_metrics = QueryMetrics(
+        with span(SPAN_FALLBACK, reason=reason):
+            df = execute_fallback(
+                lp, self.catalog, max_rows=self.config.fallback_max_rows,
+                device_exec=device_subplan,
+            )
+        m = QueryMetrics(
             query_type="fallback",
             strategy="host-pandas",
             executor="device+fallback" if assists["n"] else "fallback",
+            query_id=current_query_id(),
             rows_scanned=plan_input_rows(lp, self.catalog),
             total_ms=(_time.perf_counter() - t0) * 1e3,
             assist_subplans=assists["n"],
         )
+        self._last_engine_metrics = m
+        # the host interpreter publishes into the process registry like
+        # the device engines do (obs/): fallback traffic must be visible
+        # in the fleet-level counts, not just last_metrics
+        record_query_metrics(m, "ok")
         return df
 
     def _result_key(self, rw: Rewrite, ds=None):
@@ -598,11 +650,23 @@ class TPUOlapContext:
             return None
         from .exec.metrics import QueryMetrics
 
-        self._last_engine_metrics = QueryMetrics(
-            query_type=type(rw.query).__name__,
+        # wire-style query_type (the vocabulary the engines stamp and the
+        # registry labels by): a cache hit for a groupBy must land on the
+        # same metric series as its executed siblings
+        try:
+            qt = rw.query.to_druid().get(
+                "queryType", type(rw.query).__name__
+            )
+        except Exception:  # fault-ok: metrics labeling must not fail a hit
+            qt = type(rw.query).__name__
+        m = QueryMetrics(
+            query_type=qt,
             strategy="result-cache",
             executor="device",
+            query_id=current_query_id(),
         )
+        self._last_engine_metrics = m
+        record_query_metrics(m, "ok")
         return hit.copy()
 
     def execute_rewrite(self, rw: Rewrite, use_result_cache: bool = True):
@@ -985,12 +1049,18 @@ class TableQuery:
         from .resilience import deadline_scope
 
         lp = self._logical()
-        with deadline_scope(self.ctx.config.query_timeout_ms):
-            try:
-                rw = self.ctx._planner().plan(lp)
-            except RewriteError as err:
-                return self.ctx._run_fallback(lp, err)
-            return self.ctx._execute_with_resilience(rw, lp)
+        with self.ctx.tracer.query_trace(
+            query_type="dataframe", slow_ms=self.ctx.config.slow_query_ms
+        ), deadline_scope(self.ctx.config.query_timeout_ms):
+            with span(SPAN_PLAN):
+                try:
+                    rw = self.ctx._planner().plan(lp)
+                except RewriteError as err:
+                    rw, plan_err = None, err
+            if rw is None:
+                return self.ctx._run_fallback(lp, plan_err)
+            with span(SPAN_EXECUTE):
+                return self.ctx._execute_with_resilience(rw, lp)
 
     def collect_arrow(self):
         """`collect()` as a `pyarrow.Table`."""

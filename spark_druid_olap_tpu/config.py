@@ -180,6 +180,14 @@ class SessionConfig:
     retry_max_attempts: int = 2
     retry_backoff_ms: float = 25.0
 
+    # -- observability (obs/) -----------------------------------------------
+    # slow-query log: a finished query whose span-tree total exceeds this
+    # logs the rendered tree at WARNING through utils/log.py; 0 disables
+    slow_query_ms: float = 0.0
+    # finished span trees retained for GET /druid/v2/trace/{query_id}
+    # (FIFO eviction past the capacity)
+    trace_ring_capacity: int = 64
+
     # provenance of the cost constants (set by load_calibrated): {path,
     # device, partial, applied, mismatch?} or None when never loaded from
     # a file — artifacts record it so "which platform routed this" is

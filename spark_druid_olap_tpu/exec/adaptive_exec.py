@@ -399,20 +399,24 @@ class AdaptiveDomainMixin:
             need = self._presence_columns(q, lowering, ds)
 
             def run_presence():
+                from ..obs import SPAN_ADAPTIVE_PROBE, span
                 from ..resilience import checkpoint
 
                 seg_fn = self._presence_program(q, ds, lowering)
                 counts = None
-                for batch in self._segment_batches(segs, need):
+                for bi, batch in enumerate(
+                    self._segment_batches(segs, need)
+                ):
                     # phase A dispatches the full segment scope too: a
                     # deadlined query cancels between presence batches
                     # (checkpoint-coverage/GL901)
                     checkpoint("adaptive.presence_loop")
-                    cols_list = [
-                        self._cols_for_segment(seg, ds, need)
-                        for seg in batch
-                    ]
-                    out = seg_fn(cols_list)
+                    with span(SPAN_ADAPTIVE_PROBE, batch=bi):
+                        cols_list = [
+                            self._cols_for_segment(seg, ds, need)
+                            for seg in batch
+                        ]
+                        out = seg_fn(cols_list)
                     counts = (
                         out
                         if counts is None

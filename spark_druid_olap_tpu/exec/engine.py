@@ -58,6 +58,16 @@ from .finalize import (  # noqa: F401
     finalize_timeseries,
     finalize_topn,
 )
+from ..obs import (
+    SPAN_DEVICE_FETCH,
+    SPAN_FINALIZE,
+    SPAN_H2D,
+    SPAN_LOWER,
+    SPAN_SEGMENT_DISPATCH,
+    current_query_id,
+    record_query_metrics,
+    span,
+)
 from ..resilience import checkpoint, fire
 from ..utils.log import get_logger
 from .adaptive_exec import AdaptiveDomainMixin
@@ -310,7 +320,14 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         self.strategy = strategy
         # observability (SURVEY.md §5): populated on every execution
         self.last_metrics = None
-        self._m = None  # metrics object being filled during one execution
+        # metrics object being filled during one execution — THREAD-LOCAL
+        # (the `_m` property below): the serving layer runs concurrent
+        # queries through ONE engine, and a shared field let query A's
+        # finish() null the object query B was mid-way through stamping
+        # (crash) while both garbled each other's h2d/compile attribution
+        import threading as _threading
+
+        self._m_local = _threading.local()
         self._pallas_broken = False  # set on first Mosaic-compile failure
         # resilience wiring (resilience.py): transient device failures and
         # recoveries are reported to the breaker; TPUOlapContext replaces
@@ -359,6 +376,17 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # filter literal sets); rebuilding it per execution pays one blocking
         # H2D transfer per constant — the warm-path killer over a tunnel.
         self._lowering_cache = CountBudgetCache(program_cache_entries)
+
+    @property
+    def _m(self):
+        """The execution THIS THREAD is currently stamping metrics into
+        (None outside an execution).  `last_metrics` stays shared —
+        "most recent" is a cross-thread statement by design."""
+        return getattr(self._m_local, "m", None)
+
+    @_m.setter
+    def _m(self, value):
+        self._m_local.m = value
 
     # -- segment residency ---------------------------------------------------
 
@@ -513,16 +541,18 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             q, ds, lowering, key_extra=key_extra,
             strategy_override=strategy_override,
         )
-        for batch in self._segment_batches(segs, need):
+        for bi, batch in enumerate(self._segment_batches(segs, need)):
             # cooperative deadline checkpoint: a query with a wall-clock
             # budget cancels between batch dispatches, not at the very end
             checkpoint("engine.segment_loop")
-            cols_list = [
-                self._cols_for_segment(seg, ds, need) for seg in batch
-            ]
-            (s, mn, mx, sk), seg_fn = self._call_segment_program(
-                q, ds, lowering, seg_fn, cols_list, key_extra=key_extra
-            )
+            with span(SPAN_H2D, batch=bi, segments=len(batch)):
+                cols_list = [
+                    self._cols_for_segment(seg, ds, need) for seg in batch
+                ]
+            with span(SPAN_SEGMENT_DISPATCH, batch=bi, segments=len(batch)):
+                (s, mn, mx, sk), seg_fn = self._call_segment_program(
+                    q, ds, lowering, seg_fn, cols_list, key_extra=key_extra
+                )
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
@@ -542,19 +572,20 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # machinery, not be misread as a Mosaic compile failure that pins
         # _pallas_broken for the engine's lifetime
         fire("device_dispatch")
+        m = self._m  # one read: this thread's in-flight metrics object
         try:
             # first call of a newly-built program = trace+compile (+async
             # dispatch); attribute it to compile_ms (see metrics.py)
             t0 = (
                 _time.perf_counter()
-                if self._m is not None
-                and not self._m.program_cache_hit
-                and self._m.compile_ms == 0
+                if m is not None
+                and not m.program_cache_hit
+                and m.compile_ms == 0
                 else None
             )
             result = seg_fn(cols_list)
             if t0 is not None:
-                self._m.compile_ms = (_time.perf_counter() - t0) * 1e3
+                m.compile_ms = (_time.perf_counter() - t0) * 1e3
             return result, seg_fn
         except Exception:
             # Auto-selected Pallas may fail to Mosaic-compile on exotic
@@ -784,13 +815,15 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         from .metrics import QueryMetrics
 
         t_total = _time.perf_counter()
-        q = groupby_with_time_granularity(q)
-        lowering = self._lowering_for(q, ds)
-        segs = self._segments_in_scope(q, ds)
+        with span(SPAN_LOWER):
+            q = groupby_with_time_granularity(q)
+            lowering = self._lowering_for(q, ds)
+            segs = self._segments_in_scope(q, ds)
         qkey = _query_key(q, ds)
         m = self._m = QueryMetrics(
             query_type="groupBy",
             strategy=self._resolve_strategy(lowering.num_groups),
+            query_id=current_query_id(),
             rows_scanned=sum(s.num_rows for s in segs),
             bytes_scanned=_bytes_scanned(segs, lowering.columns),
             segments=len(segs),
@@ -805,6 +838,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # equivalent to the old dispatch-anchored measurement).
         dispatch_ms = 0.0
         t_resolve = None
+        outcome = {"v": "ok"}  # finish() publishes it; except paths set it
 
         def finish():
             now = _time.perf_counter()
@@ -815,6 +849,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             m.bytes_resident = self.bytes_resident()
             self.last_metrics = m
             self._m = None
+            # every completed execution publishes into the process metrics
+            # registry (obs/): fleet-level counts + phase histograms
+            record_query_metrics(m, outcome["v"])
             log.info("%s", m.describe())
 
         adaptive_resolve = None
@@ -853,6 +890,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
             if isinstance(err, DeadlineExceeded):
                 m.deadline_exceeded = True
+                outcome["v"] = "deadline"
+            else:
+                outcome["v"] = "error"
             finish()
             raise
         dispatch_ms = (_time.perf_counter() - t_total) * 1e3
@@ -929,9 +969,10 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 # of a device buffer pays a full round trip (dozens of ms
                 # when the TPU sits behind a network tunnel); a single
                 # pytree fetch pays one.
-                sums, mins, maxs, sketch_states = jax.device_get(
-                    (sums, mins, maxs, sketch_states)
-                )
+                with span(SPAN_DEVICE_FETCH):
+                    sums, mins, maxs, sketch_states = jax.device_get(
+                        (sums, mins, maxs, sketch_states)
+                    )
                 # the phase-1 dispatch share (minus its h2d/compile) plus
                 # this query's own fetch wait is the device time; overlap
                 # hidden behind other queries' resolves is deliberately NOT
@@ -944,11 +985,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                     - phase1_compile_ms,
                 )
                 t0 = _time.perf_counter()
-                out = finalize_groupby(
-                    q, dims, la,
-                    np.asarray(sums), np.asarray(mins), np.asarray(maxs),
-                    {k: np.asarray(v) for k, v in sketch_states.items()},
-                )
+                with span(SPAN_FINALIZE):
+                    out = finalize_groupby(
+                        q, dims, la,
+                        np.asarray(sums), np.asarray(mins), np.asarray(maxs),
+                        {k: np.asarray(v) for k, v in sketch_states.items()},
+                    )
                 m.finalize_ms = (_time.perf_counter() - t0) * 1e3
                 return out
             except BaseException as err:
@@ -956,6 +998,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
                 if isinstance(err, DeadlineExceeded):
                     m.deadline_exceeded = True
+                    outcome["v"] = "deadline"
+                else:
+                    outcome["v"] = "error"
                 raise
             finally:
                 finish()
@@ -1042,9 +1087,10 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             if filter_fn is not None:
                 mask = mask & filter_fn(cols)
             # one round trip for the mask + all projected columns
-            fetched = jax.device_get(
-                {"__mask": mask, **{c: cols[c] for c in fetch_list}}
-            )
+            with span(SPAN_DEVICE_FETCH):
+                fetched = jax.device_get(
+                    {"__mask": mask, **{c: cols[c] for c in fetch_list}}
+                )
             keep = fetched.pop("__mask")
             data = {}
             for c in fetch_list:

@@ -90,6 +90,7 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     values, metrics as float64, time as int64 ms.  `columns` restricts the
     decode to the names a plan actually references (decoding a wide
     table's every column would dominate fallback latency)."""
+    from ..obs import SPAN_FALLBACK_DECODE, span
     from ..resilience import checkpoint, fire, injector
 
     fire("fallback_decode")  # fault-injection site: host decode
@@ -97,26 +98,27 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     # the deterministic torn-result shape watchdog/flush tests need
     frac = injector().partial_fraction("fallback_decode")
     out: Dict[str, np.ndarray] = {}
-    for c in ds.columns:
-        if columns is not None and c.name not in columns:
-            continue
-        parts = []
-        for seg in ds.segments:
-            # per-(column, segment) decode is the fallback's unit of
-            # work; checkpointing inside the segment loop keeps the
-            # deadline granularity finer than whole-column decodes
-            checkpoint("fallback.decode")
-            arr = np.asarray(seg.column(c.name))[seg.valid]
-            if c.name in ds.dicts:
-                arr = ds.dicts[c.name].decode(arr)
-            elif arr.dtype.kind == "f":
-                arr = arr.astype(np.float64)
-            if frac is not None:
-                arr = arr[: int(len(arr) * frac)]
-            parts.append(arr)
-        out[c.name] = (
-            np.concatenate(parts) if parts else np.array([], dtype=object)
-        )
+    with span(SPAN_FALLBACK_DECODE, datasource=ds.name):
+        for c in ds.columns:
+            if columns is not None and c.name not in columns:
+                continue
+            parts = []
+            for seg in ds.segments:
+                # per-(column, segment) decode is the fallback's unit of
+                # work; checkpointing inside the segment loop keeps the
+                # deadline granularity finer than whole-column decodes
+                checkpoint("fallback.decode")
+                arr = np.asarray(seg.column(c.name))[seg.valid]
+                if c.name in ds.dicts:
+                    arr = ds.dicts[c.name].decode(arr)
+                elif arr.dtype.kind == "f":
+                    arr = arr.astype(np.float64)
+                if frac is not None:
+                    arr = arr[: int(len(arr) * frac)]
+                parts.append(arr)
+            out[c.name] = (
+                np.concatenate(parts) if parts else np.array([], dtype=object)
+            )
     return pd.DataFrame(out)
 
 

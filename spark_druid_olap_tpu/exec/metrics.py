@@ -37,6 +37,11 @@ from typing import Optional
 class QueryMetrics:
     query_type: str = ""
     strategy: str = ""
+    # the query's end-to-end id (obs/trace.py): set by the server boundary
+    # (Druid's context.queryId when the client sent one) or generated at
+    # the api layer; correlates this snapshot with its span tree in the
+    # trace ring buffer and the slow-query log
+    query_id: str = ""
     # which executor answered: "device" (local/distributed engine) or
     # "fallback" (host pandas interpreter, exec/fallback.py) — a user must
     # be able to SEE that a query left the accelerated path
@@ -130,11 +135,27 @@ class QueryMetrics:
 @contextlib.contextmanager
 def trace(logdir: str):
     """jax.profiler trace context for deep dives (kernel + collective
-    timelines in tensorboard); no-op if the profiler is unavailable."""
-    import jax
+    timelines in tensorboard); no-op if the profiler is unavailable.
 
+    Only PROFILER STARTUP is guarded: the old `try: with ...: yield`
+    shape swallowed exceptions raised by the BODY and then yielded a
+    second time — `RuntimeError: generator didn't stop after throw` —
+    so a failing profiled query crashed with the wrong error (ISSUE 4
+    satellite).  Body errors now propagate untouched; only a broken
+    profiler start/stop degrades to a no-op."""
+    prof = None
     try:
-        with jax.profiler.trace(logdir):
-            yield
+        import jax
+
+        prof = jax.profiler.trace(logdir)
+        prof.__enter__()
     except Exception:  # fault-ok: profiler is optional; trace degrades to no-op
+        prof = None
+    try:
         yield
+    finally:
+        if prof is not None:
+            try:
+                prof.__exit__(None, None, None)
+            except Exception:  # fault-ok: profiler teardown must not mask body errors
+                pass

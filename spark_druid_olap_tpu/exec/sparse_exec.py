@@ -153,27 +153,31 @@ class SparseExecMixin:
         selective = q.filter is not None or bool(q.intervals)
 
         def dispatch(row_capacity=None, slots=None):
+            from ..obs import SPAN_SPARSE_DISPATCH, span
             from ..resilience import checkpoint
 
             seg_fn = self._sparse_program(
                 q, ds, lowering, row_capacity=row_capacity, slots=slots
             )
             state = None
-            for batch in self._segment_batches(segs, lowering.columns):
+            for bi, batch in enumerate(
+                self._segment_batches(segs, lowering.columns)
+            ):
                 # cooperative deadline checkpoint between batch
                 # dispatches — same lifecycle contract as the dense
                 # engine's segment loop (checkpoint-coverage/GL901)
                 checkpoint("sparse.segment_loop")
-                cols_list = [
-                    self._cols_for_segment(seg, ds, lowering.columns)
-                    for seg in batch
-                ]
-                st = seg_fn(cols_list)
-                state = (
-                    st
-                    if state is None
-                    else merge_sparse_states(state, st, num_groups=G)
-                )
+                with span(SPAN_SPARSE_DISPATCH, batch=bi, segments=len(batch)):
+                    cols_list = [
+                        self._cols_for_segment(seg, ds, lowering.columns)
+                        for seg in batch
+                    ]
+                    st = seg_fn(cols_list)
+                    state = (
+                        st
+                        if state is None
+                        else merge_sparse_states(state, st, num_groups=G)
+                    )
             return state
 
         def evict():
@@ -230,7 +234,10 @@ class SparseExecMixin:
             # off the full sort.  The rung is deterministic per (query,
             # data) and remembered.  Slot overflow is handled by the
             # caller's SLOTS_LADDER loop.
-            host = jax.device_get(state)
+            from ..obs import SPAN_DEVICE_FETCH, span
+
+            with span(SPAN_DEVICE_FETCH):
+                host = jax.device_get(state)
             if row_capacity is not None and bool(host["row_overflow"]):
                 n = int(host["n_rows"])
                 new_cap = next(

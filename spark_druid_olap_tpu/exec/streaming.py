@@ -237,6 +237,7 @@ class StreamExecutor:
 
         import time as _time
 
+        from ..obs import SPAN_STREAM_CHUNK, span
         from ..resilience import checkpoint, fire
 
         for dev, base, nrows in self._prefetched_device_chunks(
@@ -248,13 +249,14 @@ class StreamExecutor:
             checkpoint("streaming.chunk_loop")
             fire("device_dispatch")
             t0 = _time.perf_counter()
-            try:
-                s, mn, mx, sk = run(dev, base, nrows)
-            except Exception:  # fault-ok: _downgrade_pallas re-raises non-Pallas errors
-                run = self._downgrade_pallas(
-                    q, ds, lowering, prep, build_mesh_run, strat
-                )
-                s, mn, mx, sk = run(dev, base, nrows)
+            with span(SPAN_STREAM_CHUNK, chunk=self.stats.chunks):
+                try:
+                    s, mn, mx, sk = run(dev, base, nrows)
+                except Exception:  # fault-ok: _downgrade_pallas re-raises non-Pallas errors
+                    run = self._downgrade_pallas(
+                        q, ds, lowering, prep, build_mesh_run, strat
+                    )
+                    s, mn, mx, sk = run(dev, base, nrows)
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
@@ -266,14 +268,18 @@ class StreamExecutor:
         if sums is None:  # empty stream
             sums, mins, maxs, sketch_states = empty_partials(la, G)
 
-        sums, mins, maxs, sketch_states = jax.device_get(
-            (sums, mins, maxs, sketch_states)
-        )
-        return finalize_groupby(
-            q, lowering.dims, la,
-            np.asarray(sums), np.asarray(mins), np.asarray(maxs),
-            {k: np.asarray(v) for k, v in sketch_states.items()},
-        )
+        from ..obs import SPAN_DEVICE_FETCH, SPAN_FINALIZE
+
+        with span(SPAN_DEVICE_FETCH):
+            sums, mins, maxs, sketch_states = jax.device_get(
+                (sums, mins, maxs, sketch_states)
+            )
+        with span(SPAN_FINALIZE):
+            return finalize_groupby(
+                q, lowering.dims, la,
+                np.asarray(sums), np.asarray(mins), np.asarray(maxs),
+                {k: np.asarray(v) for k, v in sketch_states.items()},
+            )
 
     def _stream_strategy(self, G: int, rows_per_dispatch: int) -> str:
         """Per-dispatch kernel class.  An engine constructed with an

@@ -1,0 +1,50 @@
+"""Observability subsystem: per-query span tracing + process metrics.
+
+Two halves (ISSUE 4 tentpole):
+
+  * `obs.trace` — a lock-safe, injectable-clock span tracer producing a
+    per-query span tree attached to a Druid-parity `query_id`, a bounded
+    trace ring buffer served over HTTP, and the slow-query log.
+  * `obs.registry` — a process-wide Prometheus-style metrics registry
+    (counters / gauges / histograms) the engines, resilience layer, and
+    HTTP server publish into; rendered at `GET /status/metrics`.
+
+Instrumented code imports from HERE (`from .obs import span, SPAN_...`)
+so the span-name registry and the context-manager discipline stay in one
+place — the span-discipline lint pass (GL11xx) enforces both.
+"""
+
+from .registry import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    record_query_metrics,
+)
+from .trace import (  # noqa: F401
+    SPAN_ADAPTIVE_PROBE,
+    SPAN_ADMISSION,
+    SPAN_COLLECTIVE_MERGE,
+    SPAN_DEGRADED,
+    SPAN_DEVICE_FETCH,
+    SPAN_EXECUTE,
+    SPAN_FALLBACK,
+    SPAN_FALLBACK_DECODE,
+    SPAN_FINALIZE,
+    SPAN_H2D,
+    SPAN_LOWER,
+    SPAN_NAMES,
+    SPAN_PLAN,
+    SPAN_QUERY,
+    SPAN_RETRY,
+    SPAN_SEGMENT_DISPATCH,
+    SPAN_SPARSE_DISPATCH,
+    SPAN_STREAM_CHUNK,
+    QueryTrace,
+    Span,
+    TraceRing,
+    Tracer,
+    current_query_id,
+    current_trace,
+    default_tracer,
+    new_query_id,
+    span,
+)

@@ -1,0 +1,354 @@
+"""Per-query span tracing: the observability layer's timeline substrate.
+
+Reference parity: Druid emits server-side query metrics keyed by a
+`queryId` the client may set in the query context, echoed back as the
+`X-Druid-Query-Id` response header, and request logs are queryId-tagged
+(SURVEY.md §5).  The TPU build's flat last-query `QueryMetrics` snapshot
+cannot answer "which concurrent query retried?" or "where did this
+deadline die?"; this module can:
+
+  * **Span tree per query** — a `QueryTrace` rooted at a `query` span,
+    with children for every lifecycle phase (`admission → plan → lower →
+    h2d → segment_dispatch → device_fetch → collective_merge →
+    finalize`, plus `fallback`/`retry`/`degraded` when a query leaves
+    the happy path).  Span names are DRAWN FROM the `SPAN_*` constant
+    registry below — the span-discipline lint pass (GL11xx) rejects
+    ad-hoc strings so the taxonomy cannot fragment.
+  * **query_id end-to-end** — generated at the server boundary (honoring
+    Druid's `context.queryId`), carried by a contextvar through engine,
+    sparse/adaptive/streaming exec, resilience, and the host fallback;
+    stamped onto `QueryMetrics.query_id`.
+  * **Instrumentation that disappears when idle** — `span(name)` costs
+    one contextvar read when no trace is active; with a trace it is two
+    clock reads and two list/lock operations.  The clock is injectable
+    (tests assert tracer overhead by *counting* clock calls, never by
+    timing wall-clock).
+  * **Trace ring buffer** — finished traces serialize to JSON and land
+    in a bounded FIFO ring served by `GET /druid/v2/trace/{query_id}`.
+  * **Slow-query log** — a finished trace whose total exceeds
+    `SessionConfig.slow_query_ms` logs its rendered span tree at
+    WARNING through `utils/log.py`.
+
+Concurrency: the contextvars give every handler thread its own active
+trace/span, so concurrent queries cannot interleave their trees; the
+per-trace lock makes child-append and finish safe if a span IS opened
+from another thread (the streaming producer thread deliberately sees no
+active trace — a fresh thread starts with an empty context).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.log import get_logger
+
+log = get_logger("obs.trace")
+
+
+# ---------------------------------------------------------------------------
+# Span-name registry (the span-discipline lint pass GL11xx enforces that
+# every `span(...)` call in the exec/resilience/serving modules names one
+# of these constants — add the constant HERE first, then use it)
+# ---------------------------------------------------------------------------
+
+SPAN_QUERY = "query"  # root span of every trace
+SPAN_ADMISSION = "admission"  # waiting for an admission slot
+SPAN_PLAN = "plan"  # parse + plan (or plan-cache lookup)
+SPAN_EXECUTE = "execute"  # device/fallback execution umbrella
+SPAN_LOWER = "lower"  # query lowering + segment scoping
+SPAN_H2D = "h2d"  # host->device column placement for one batch
+SPAN_SEGMENT_DISPATCH = "segment_dispatch"  # one fused program dispatch
+SPAN_DEVICE_FETCH = "device_fetch"  # blocking host fetch of partials
+SPAN_COLLECTIVE_MERGE = "collective_merge"  # mesh dispatch + ICI-merged fetch
+SPAN_FINALIZE = "finalize"  # host-side result materialization
+SPAN_FALLBACK = "fallback"  # host interpreter run
+SPAN_FALLBACK_DECODE = "fallback_decode"  # fallback table materialization
+SPAN_RETRY = "retry"  # one transient-failure re-attempt
+SPAN_DEGRADED = "degraded"  # breaker/failure degradation to the fallback
+SPAN_SPARSE_DISPATCH = "sparse_dispatch"  # sort-compaction tier dispatch
+SPAN_ADAPTIVE_PROBE = "adaptive_probe"  # adaptive phase-A presence pass
+SPAN_STREAM_CHUNK = "stream_chunk"  # one streaming chunk dispatch
+
+SPAN_NAMES = frozenset(
+    {
+        SPAN_QUERY,
+        SPAN_ADMISSION,
+        SPAN_PLAN,
+        SPAN_EXECUTE,
+        SPAN_LOWER,
+        SPAN_H2D,
+        SPAN_SEGMENT_DISPATCH,
+        SPAN_DEVICE_FETCH,
+        SPAN_COLLECTIVE_MERGE,
+        SPAN_FINALIZE,
+        SPAN_FALLBACK,
+        SPAN_FALLBACK_DECODE,
+        SPAN_RETRY,
+        SPAN_DEGRADED,
+        SPAN_SPARSE_DISPATCH,
+        SPAN_ADAPTIVE_PROBE,
+        SPAN_STREAM_CHUNK,
+    }
+)
+
+
+def new_query_id() -> str:
+    """Druid-shaped opaque query id (uuid4, the broker's own format)."""
+    return str(uuid.uuid4())
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One timed phase.  Start/end are tracer-clock readings (seconds);
+    `attrs` carry small JSON-able facts (segment index, retry attempt)."""
+
+    __slots__ = ("name", "start", "end", "attrs", "children")
+
+    def __init__(self, name: str, start: float, attrs: Optional[dict] = None):
+        self.name = name
+        self.start = start
+        self.end: Optional[float] = None
+        self.attrs = attrs or {}
+        self.children: List["Span"] = []
+
+    @property
+    def duration_ms(self) -> float:
+        if self.end is None:
+            return 0.0
+        return (self.end - self.start) * 1e3
+
+    def to_dict(self, origin: float) -> dict:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "start_ms": round((self.start - origin) * 1e3, 3),
+            "duration_ms": round(self.duration_ms, 3),
+        }
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict(origin) for c in self.children]
+        return d
+
+
+class QueryTrace:
+    """The span tree of ONE query, rooted at a `query` span."""
+
+    def __init__(
+        self,
+        query_id: str,
+        clock: Callable[[], float] = time.perf_counter,
+        query_type: str = "",
+    ):
+        self.query_id = query_id
+        self.query_type = query_type
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.root = Span(SPAN_QUERY, clock())
+
+    def start_span(
+        self, name: str, parent: Optional[Span], attrs: Optional[dict] = None
+    ) -> Span:
+        """INTERNAL pairing API — instrumented code must go through the
+        `span(...)` context manager (span-discipline/GL1102): a manual
+        begin/end pair leaks the span on every early return or raise."""
+        s = Span(name, self._clock(), attrs)
+        with self._lock:
+            (parent or self.root).children.append(s)
+        return s
+
+    def end_span(self, s: Span) -> None:
+        s.end = self._clock()
+
+    def finish(self) -> None:
+        with self._lock:
+            if self.root.end is None:
+                self.root.end = self._clock()
+
+    @property
+    def total_ms(self) -> float:
+        return self.root.duration_ms
+
+    def to_dict(self) -> dict:
+        return {
+            "query_id": self.query_id,
+            "query_type": self.query_type,
+            "total_ms": round(self.total_ms, 3),
+            "spans": self.root.to_dict(self.root.start),
+        }
+
+    def render(self) -> str:
+        """Indented phase/latency lines (the slow-query-log body)."""
+        lines: List[str] = []
+
+        def walk(s: Span, depth: int) -> None:
+            attrs = (
+                " " + " ".join(f"{k}={v}" for k, v in sorted(s.attrs.items()))
+                if s.attrs
+                else ""
+            )
+            lines.append(
+                f"{'  ' * depth}{s.name:<20} {s.duration_ms:>9.2f}ms{attrs}"
+            )
+            for c in s.children:
+                walk(c, depth + 1)
+
+        walk(self.root, 0)
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Active-trace plumbing (contextvars: per-thread/per-context isolation)
+# ---------------------------------------------------------------------------
+
+_active_trace: contextvars.ContextVar[Optional[QueryTrace]] = (
+    contextvars.ContextVar("sdol_active_trace", default=None)
+)
+_active_span: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+    "sdol_active_span", default=None
+)
+
+
+def current_trace() -> Optional[QueryTrace]:
+    return _active_trace.get()
+
+
+def current_query_id() -> str:
+    tr = _active_trace.get()
+    return tr.query_id if tr is not None else ""
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Open a child span of the active trace; a no-op (one contextvar
+    read) when no trace is active.  THE way instrumented code creates
+    spans — every early return / raise path closes the span because the
+    context manager owns the pairing (span-discipline/GL1102)."""
+    tr = _active_trace.get()
+    if tr is None:
+        yield None
+        return
+    s = tr.start_span(name, _active_span.get(), attrs or None)
+    token = _active_span.set(s)
+    try:
+        yield s
+    finally:
+        _active_span.reset(token)
+        tr.end_span(s)
+
+
+# ---------------------------------------------------------------------------
+# Ring buffer + tracer
+# ---------------------------------------------------------------------------
+
+
+class TraceRing:
+    """Bounded FIFO of finished traces, keyed by query_id.  A repeated
+    query_id overwrites in place (Druid lets clients reuse ids); capacity
+    evicts the OLDEST insertion."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+
+    def put(self, trace_dict: dict) -> None:
+        qid = trace_dict.get("query_id", "")
+        with self._lock:
+            if qid in self._traces:
+                self._traces.pop(qid)
+            self._traces[qid] = trace_dict
+            while len(self._traces) > self.capacity:
+                self._traces.popitem(last=False)
+
+    def get(self, query_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._traces.get(query_id)
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+class Tracer:
+    """Owns the clock, the finished-trace ring, and trace lifecycle.
+
+    `clock` is injectable so tests measure tracer overhead by counting
+    calls under a deterministic clock instead of timing wall-clock; the
+    ring capacity is `SessionConfig.trace_ring_capacity` when built by a
+    TPUOlapContext."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        capacity: int = 64,
+    ):
+        self.clock = clock
+        self.ring = TraceRing(capacity)
+        self.last: Optional[QueryTrace] = None
+
+    @contextlib.contextmanager
+    def query_trace(
+        self,
+        query_id: Optional[str] = None,
+        query_type: str = "",
+        slow_ms: float = 0.0,
+    ):
+        """Open (or join) the per-query trace.  The OUTERMOST scope wins,
+        exactly like `resilience.deadline_scope`: the server boundary
+        starts the trace and `ctx.sql` inside it joins rather than
+        nesting a second root."""
+        existing = _active_trace.get()
+        if existing is not None:
+            yield existing
+            return
+        tr = QueryTrace(
+            query_id or new_query_id(), clock=self.clock,
+            query_type=query_type,
+        )
+        tok_t = _active_trace.set(tr)
+        tok_s = _active_span.set(tr.root)
+        try:
+            yield tr
+        finally:
+            _active_span.reset(tok_s)
+            _active_trace.reset(tok_t)
+            tr.finish()
+            self.last = tr
+            self.ring.put(tr.to_dict())
+            if slow_ms and slow_ms > 0 and tr.total_ms >= slow_ms:
+                log.warning(
+                    "slow query %s: %.1fms >= %.0fms threshold\n%s",
+                    tr.query_id, tr.total_ms, slow_ms, tr.render(),
+                )
+
+    def last_trace_dict(self) -> Optional[dict]:
+        return self.last.to_dict() if self.last is not None else None
+
+
+_default_tracer: Optional[Tracer] = None
+_default_tracer_lock = threading.Lock()
+
+
+def default_tracer() -> Tracer:
+    """Process-default tracer for code running outside a TPUOlapContext
+    (direct Engine use, tooling)."""
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_tracer_lock:
+            if _default_tracer is None:
+                _default_tracer = Tracer()
+    return _default_tracer

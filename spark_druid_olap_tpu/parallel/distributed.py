@@ -78,6 +78,13 @@ from ..models import query as Q
 from ..ops import hll as hll_ops
 from ..ops import quantiles as quantiles_ops
 from ..ops import theta as theta_ops
+from ..obs import (
+    SPAN_COLLECTIVE_MERGE,
+    SPAN_FINALIZE,
+    current_query_id,
+    record_query_metrics,
+    span,
+)
 from ..ops.groupby import (
     SCATTER_CUTOVER,
     choose_block_rows,
@@ -605,6 +612,7 @@ class DistributedEngine:
         m = QueryMetrics(
             query_type="groupBy",
             strategy=strategy,
+            query_id=current_query_id(),
             distributed=True,
             mesh_shape=tuple(self.mesh.shape.values()),
             rows_scanned=ds.num_rows,
@@ -621,21 +629,39 @@ class DistributedEngine:
         m.segments = len(scope)
 
         out = None
-        if strategy == "adaptive":
-            out = self._execute_adaptive(q, ds, lowering, qkey, m)
-            if out is None:  # declined: re-route without the adaptive class
-                strategy = self._route_strategy(q, ds, lowering, qkey)
-                m.strategy = strategy
-        if out is None and strategy == "sparse":
-            out = self._execute_sparse(q, ds, lowering, qkey, m)
-            if out is None:  # ladder exhausted: dense-state scatter
-                strategy = "segment"
-                m.strategy = strategy
-        if out is None:
-            out = self._execute_dense_state(q, ds, lowering, m, strategy)
+        try:
+            if strategy == "adaptive":
+                out = self._execute_adaptive(q, ds, lowering, qkey, m)
+                if out is None:  # declined: re-route without adaptive
+                    strategy = self._route_strategy(q, ds, lowering, qkey)
+                    m.strategy = strategy
+            if out is None and strategy == "sparse":
+                out = self._execute_sparse(q, ds, lowering, qkey, m)
+                if out is None:  # ladder exhausted: dense-state scatter
+                    strategy = "segment"
+                    m.strategy = strategy
+            if out is None:
+                out = self._execute_dense_state(q, ds, lowering, m, strategy)
+        except BaseException as err:
+            # failed executions must reach the process registry too: a
+            # dashboard's outcome="error" rate would otherwise show zero
+            # for the distributed path while counting single-device ones
+            from ..resilience import DeadlineExceeded
+
+            m.total_ms = (_time.perf_counter() - t_total) * 1e3
+            m.bytes_resident = self._shard_cache.bytes_used
+            if isinstance(err, DeadlineExceeded):
+                m.deadline_exceeded = True
+            self.last_metrics = m
+            record_query_metrics(
+                m,
+                "deadline" if isinstance(err, DeadlineExceeded) else "error",
+            )
+            raise
         m.total_ms = (_time.perf_counter() - t_total) * 1e3
         m.bytes_resident = self._shard_cache.bytes_used
         self.last_metrics = m
+        record_query_metrics(m, "ok")
         log.info("%s", m.describe())
         return out
 
@@ -679,22 +705,26 @@ class DistributedEngine:
         )
         t0 = _time.perf_counter()
         # single host fetch (one round trip — see engine._execute_groupby)
-        sums, mins, maxs, sk = jax.device_get(run(cols))
+        # under the collective-merge span: the fetch blocks on the SPMD
+        # program, so this is where the ICI merge's wall time is paid
+        with span(SPAN_COLLECTIVE_MERGE):
+            sums, mins, maxs, sk = jax.device_get(run(cols))
         dt = (_time.perf_counter() - t0) * 1e3
         if m.program_cache_hit:
             m.device_ms = dt
         else:  # first call: trace+compile dominates (metrics.py semantics)
             m.compile_ms = dt
         t0 = _time.perf_counter()
-        out = finalize_groupby(
-            q,
-            lowering.dims,
-            lowering.la,
-            np.asarray(sums),
-            np.asarray(mins),
-            np.asarray(maxs),
-            {k: np.asarray(v) for k, v in sk.items()},
-        )
+        with span(SPAN_FINALIZE):
+            out = finalize_groupby(
+                q,
+                lowering.dims,
+                lowering.la,
+                np.asarray(sums),
+                np.asarray(mins),
+                np.asarray(maxs),
+                {k: np.asarray(v) for k, v in sk.items()},
+            )
         m.finalize_ms += (_time.perf_counter() - t0) * 1e3
         return out
 

@@ -43,9 +43,16 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from .obs import SPAN_RETRY, get_registry, span
 from .utils.log import get_logger
 
 log = get_logger("resilience")
+
+
+def _count(name: str, help_text: str, labels=(), **labelvals) -> None:
+    """Publish one event into the process metrics registry (obs/)."""
+    fam = get_registry().counter(name, help_text, labels=labels)
+    (fam.labels(**labelvals) if labels else fam).inc()
 
 
 # ---------------------------------------------------------------------------
@@ -326,7 +333,13 @@ def run_device_attempts(engine, run_once, evict, what: str = "device"):
     attempts = max(1, int(engine._retry_attempts))
     for i in range(attempts):
         try:
-            out = run_once()
+            if i == 0:
+                out = run_once()
+            else:
+                # re-attempts get their own span so the trace shows WHERE
+                # a query's latency went when a transient failure struck
+                with span(SPAN_RETRY, attempt=i, what=what):
+                    out = run_once()
             if engine.breaker is not None:
                 engine.breaker.record_success()
             if i and engine.last_metrics is not None:
@@ -445,6 +458,11 @@ class CircuitBreaker:
             self._probe_started_at = None
             if self._state != "closed":
                 log.info("circuit breaker closing (probe succeeded)")
+                _count(
+                    "sdol_breaker_transitions_total",
+                    "circuit breaker state transitions",
+                    labels=("to",), to="closed",
+                )
             self._state = "closed"
 
     def record_failure(self) -> None:
@@ -457,6 +475,11 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._trips += 1
                 log.warning("circuit breaker re-opened (probe failed)")
+                _count(
+                    "sdol_breaker_transitions_total",
+                    "circuit breaker state transitions",
+                    labels=("to",), to="open",
+                )
             elif (
                 self._state == "closed"
                 and self._consecutive_failures >= self.failure_threshold
@@ -468,6 +491,11 @@ class CircuitBreaker:
                     "circuit breaker OPEN after %d consecutive device "
                     "failures; queries degrade to the host fallback for "
                     "%.0fms", self._consecutive_failures, self.cooldown_ms,
+                )
+                _count(
+                    "sdol_breaker_transitions_total",
+                    "circuit breaker state transitions",
+                    labels=("to",), to="open",
                 )
 
     def to_dict(self) -> dict:
@@ -538,6 +566,11 @@ class AdmissionController:
                 self._held_since[threading.get_ident()] = self._clock()
             else:
                 self.rejected_total += 1
+        _count(
+            "sdol_admission_decisions_total",
+            "admission-pool outcomes (admitted vs 503-rejected)",
+            labels=("outcome",), outcome="admitted" if ok else "rejected",
+        )
         return ok
 
     def release(self) -> None:
@@ -622,14 +655,34 @@ class ResilienceState:
         self.deadline_exceeded_total = 0
         self.server_errors_total = 0
         self.last_error: Optional[Dict[str, Any]] = None
+        # live admission gauges: callback-read at scrape time, so the hot
+        # acquire/release path pays nothing extra (obs/registry.py).  A
+        # rebuilt context re-binds the callbacks and takes over the series.
+        reg = get_registry()
+        reg.gauge(
+            "sdol_admission_queue_depth",
+            "callers currently blocked waiting for an admission slot",
+        ).set_function(lambda a=self.admission: a.queue_depth)
+        reg.gauge(
+            "sdol_admission_slots_in_use",
+            "admission slots currently held by executing queries",
+        ).set_function(lambda a=self.admission: a.in_use)
 
     def note_degraded(self) -> None:
         with self._lock:
             self.degraded_total += 1
+        _count(
+            "sdol_degraded_total",
+            "queries answered DEGRADED on the host fallback",
+        )
 
     def note_deadline_exceeded(self) -> None:
         with self._lock:
             self.deadline_exceeded_total += 1
+        _count(
+            "sdol_deadline_exceeded_total",
+            "queries cancelled on their wall-clock deadline",
+        )
 
     def note_server_error(self, exc: BaseException) -> None:
         with self._lock:
@@ -638,6 +691,10 @@ class ResilienceState:
                 "errorClass": type(exc).__name__,
                 "classification": classify_error(exc),
             }
+        _count(
+            "sdol_server_errors_total",
+            "unhandled query failures surfaced as structured 500s",
+        )
 
     def health(self) -> dict:
         with self._lock:

@@ -11,7 +11,13 @@ clients/dashboards can point at this server:
     POST /druid/v2/sql        {"query": "SELECT ..."} -> array of row objects
     GET  /druid/v2/datasources            -> ["lineorder", ...]
     GET  /druid/v2/datasources/{name}     -> {"dimensions": .., "metrics": ..}
+    GET  /druid/v2/trace/{query_id}       -> span tree of a recent query
     GET  /status, /status/health          -> liveness + metrics of last query
+    GET  /status/metrics                  -> Prometheus text exposition
+
+Every query response carries an `X-Druid-Query-Id` header (the client's
+`context.queryId` when set, generated otherwise — Druid parity); the id
+keys the query's span tree in the trace ring buffer (obs/).
 
 Native queries bypass the SQL planner (they ARE the planner's output
 language) and run straight on the engine; SQL goes through the full rewrite
@@ -35,10 +41,34 @@ import numpy as np
 from .models import query as Q
 from .models.filters import _ms_to_iso
 from .models.wire import WireError, query_from_druid
+from .obs import (
+    SPAN_ADMISSION,
+    default_tracer,
+    get_registry,
+    new_query_id,
+    span,
+)
 from .resilience import CircuitOpenError, DeadlineExceeded, deadline_scope
 from .utils.log import get_logger
 
 log = get_logger("server")
+
+
+def _route_label(path: str) -> str:
+    """Coarse route label for the http-requests counter: bounded label
+    cardinality (per-datasource / per-query-id suffixes collapse)."""
+    for prefix in (
+        "/druid/v2/trace",
+        "/druid/v2/datasources",
+        "/druid/v2/sql",
+        "/druid/v2",
+        "/status/metrics",
+        "/status/health",
+        "/status",
+    ):
+        if path == prefix or path.startswith(prefix + "/"):
+            return prefix
+    return "other"
 
 
 def _jsonable(v: Any):
@@ -142,21 +172,65 @@ def druid_result_shape(q: Q.QuerySpec, df) -> Any:
 class _Handler(BaseHTTPRequestHandler):
     ctx = None  # set by OlapServer
     server_version = "sdol-tpu/0.2"
+    _query_id: Optional[str] = None  # per-request; set by do_POST
+    _req_t0: Optional[float] = None
 
     # -- plumbing ------------------------------------------------------------
 
-    def log_message(self, fmt, *args):  # quiet by default
-        pass
+    def log_message(self, fmt, *args):
+        # library etiquette: no stderr spray; stdlib-internal messages
+        # (malformed request lines etc.) surface at DEBUG instead of the
+        # old silent pass (ISSUE 4 satellite)
+        log.debug("http %s", (fmt % args) if args else fmt)
+
+    def log_request(self, code="-", size="-"):
+        """Structured access log at DEBUG: method, path, status, query_id,
+        duration — the queryId-tagged request log Druid keeps (SURVEY.md
+        §5), replacing the silenced default."""
+        import time as _time
+
+        dur_ms = (
+            (_time.perf_counter() - self._req_t0) * 1e3
+            if self._req_t0 is not None
+            else -1.0
+        )
+        log.debug(
+            "access method=%s path=%s status=%s query_id=%s "
+            "duration_ms=%.2f",
+            self.command, self.path, code, self._query_id or "-", dur_ms,
+        )
 
     def _send(self, code: int, payload: Any, headers: Optional[dict] = None):
         body = json.dumps(payload, default=_jsonable).encode()
+        self._send_bytes(code, body, "application/json", headers)
+
+    def _send_bytes(
+        self,
+        code: int,
+        body: bytes,
+        content_type: str,
+        headers: Optional[dict] = None,
+    ):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self._query_id:
+            # Druid parity: every query response (success OR error) echoes
+            # the query's id so clients can correlate logs and traces
+            self.send_header("X-Druid-Query-Id", self._query_id)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
+        get_registry().counter(
+            "sdol_http_requests_total",
+            "HTTP responses by method/route/status",
+            labels=("method", "route", "code"),
+        ).labels(
+            method=self.command or "-",
+            route=_route_label(self.path.split("?")[0].rstrip("/")),
+            code=str(code),
+        ).inc()
 
     def _error(
         self,
@@ -189,7 +263,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _resilience(self):
         return getattr(self.ctx, "resilience", None)
 
+    def _tracer(self):
+        return getattr(self.ctx, "tracer", None) or default_tracer()
+
     def do_GET(self):
+        import time as _time
+
+        self._req_t0 = _time.perf_counter()
         path = self.path.split("?")[0].rstrip("/")
         if path in ("/status/health", ""):
             res = self._resilience()
@@ -198,6 +278,23 @@ class _Handler(BaseHTTPRequestHandler):
             # breaker state + slots in use: a load balancer (or the
             # concurrent-serving test) reads degradation from here
             return self._send(200, res.health())
+        if path == "/status/metrics":
+            # Prometheus text exposition of the process registry (engines,
+            # resilience, http counters, per-phase latency histograms)
+            return self._send_bytes(
+                200,
+                get_registry().render_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        if path.startswith("/druid/v2/trace/"):
+            qid = path.rsplit("/", 1)[1]
+            tr = self._tracer().ring.get(qid)
+            if tr is None:
+                return self._error(
+                    404, f"no trace for query id {qid!r} (ring holds the "
+                    "most recent traces only)", "NotFound",
+                )
+            return self._send(200, tr)
         if path == "/status":
             m = self.ctx.last_metrics
             res = self._resilience()
@@ -208,6 +305,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "datasources": sorted(self.ctx.catalog.tables()),
                     "last_query_metrics": m.to_dict() if m else None,
                     "resilience": res.health() if res else None,
+                    # registry summary: counter/gauge values + histogram
+                    # p50/p95/p99 (full series live at /status/metrics)
+                    "metrics": get_registry().to_dict(),
                 },
             )
         if path == "/druid/v2/datasources":
@@ -234,6 +334,9 @@ class _Handler(BaseHTTPRequestHandler):
         return self._error(404, f"no route {path!r}")
 
     def do_POST(self):
+        import time as _time
+
+        self._req_t0 = _time.perf_counter()
         path = self.path.split("?")[0].rstrip("/")
         body = self._body()
         if body is None:
@@ -242,11 +345,33 @@ class _Handler(BaseHTTPRequestHandler):
             )
         if path not in ("/druid/v2", "/druid/v2/sql"):
             return self._error(404, f"no route {path!r}", "NotFound")
+        # A non-dict context is client noise, not a server error: ignore it.
+        qctx = body.get("context")
+        qctx = qctx if isinstance(qctx, dict) else {}
+        # query_id is born HERE, the server boundary: honor Druid's
+        # `context.queryId` when the client set one, generate otherwise.
+        # Echoed on every response as X-Druid-Query-Id (_send_bytes) and
+        # carried through the whole execution by the active trace.
+        client_qid = qctx.get("queryId")
+        self._query_id = (
+            str(client_qid) if client_qid else new_query_id()
+        )
+        cfg = getattr(self.ctx, "config", None)
         res = self._resilience()
+        with self._tracer().query_trace(
+            query_id=self._query_id,
+            query_type="native" if path == "/druid/v2" else "sql",
+            slow_ms=cfg.slow_query_ms if cfg else 0.0,
+        ):
+            return self._handle_query(path, body, qctx, res, cfg)
+
+    def _handle_query(self, path, body, qctx, res, cfg):
         # admission control: a bounded slot pool with a queue-wait timeout
         # answers 503 + Retry-After instead of piling handler threads
         # behind a slow device until the process wedges
-        if res is not None and not res.admission.acquire():
+        with span(SPAN_ADMISSION):
+            admitted = res is None or res.admission.acquire()
+        if not admitted:
             return self._error(
                 503,
                 "query capacity exceeded; retry later",
@@ -257,10 +382,7 @@ class _Handler(BaseHTTPRequestHandler):
             # Druid-native per-query deadline: `context.timeout` (ms)
             # overrides the session default — including `timeout: 0`,
             # Druid's explicit "no timeout".  The scope set HERE is the
-            # outermost, so ctx.sql's own scope defers to it.  A non-dict
-            # context is client noise, not a server error: ignore it.
-            qctx = body.get("context")
-            qctx = qctx if isinstance(qctx, dict) else {}
+            # outermost, so ctx.sql's own scope defers to it.
             if "timeout" in qctx:
                 try:
                     timeout_ms = float(qctx["timeout"])
@@ -273,7 +395,6 @@ class _Handler(BaseHTTPRequestHandler):
                     # declined
                     timeout_ms = float("inf")
             else:
-                cfg = getattr(self.ctx, "config", None)
                 timeout_ms = cfg.query_timeout_ms if cfg else 0
             with deadline_scope(timeout_ms):
                 if path == "/druid/v2":

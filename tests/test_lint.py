@@ -55,6 +55,17 @@ def _run_on(tmp_path, files, passes=None):
 # Fixture matrix: >=2 violating + >=2 clean snippets per pass
 # ---------------------------------------------------------------------------
 
+# miniature span-name registry the span-discipline fixtures resolve
+# against (the real one is spark_druid_olap_tpu/obs/trace.py)
+_OBS_TRACE_FIXTURE = """
+    SPAN_H2D = "h2d"
+    SPAN_FINALIZE = "finalize"
+    SPAN_NAMES = frozenset({SPAN_H2D, SPAN_FINALIZE})
+
+    def span(name, **attrs):
+        pass
+"""
+
 # pass -> (violating: [(files, expected_codes)], clean: [files])
 _MATRIX = {
     "jit-cache": {
@@ -899,6 +910,96 @@ _MATRIX = {
                     except Exception:
                         pass
             """},
+        ],
+    },
+    "span-discipline": {
+        "violating": [
+            # ad-hoc span name: a literal that is not in the registered
+            # SPAN_* constant set fragments the trace taxonomy
+            (
+                {
+                    "spark_druid_olap_tpu/obs/trace.py": _OBS_TRACE_FIXTURE,
+                    "spark_druid_olap_tpu/exec/engine.py": """
+                        from ..obs.trace import span
+
+                        def run(batches):
+                            for b in batches:
+                                with span("warmup_phase"):
+                                    dispatch(b)
+                    """,
+                },
+                {"GL1101"},
+            ),
+            # dynamically-built span name: not statically resolvable, so
+            # no consumer can match on it — the registry is the point
+            (
+                {
+                    "spark_druid_olap_tpu/obs/trace.py": _OBS_TRACE_FIXTURE,
+                    "spark_druid_olap_tpu/exec/engine.py": """
+                        from ..obs.trace import span
+
+                        def run(batches):
+                            for i, b in enumerate(batches):
+                                with span(f"segment-{i}"):
+                                    dispatch(b)
+                    """,
+                },
+                {"GL1101"},
+            ),
+            # manually paired begin/end: the early `return` leaks an open
+            # span — only the context manager owns the pairing
+            (
+                {
+                    "spark_druid_olap_tpu/obs/trace.py": _OBS_TRACE_FIXTURE,
+                    "spark_druid_olap_tpu/exec/engine.py": """
+                        def run(tr, batches):
+                            s = tr.start_span("h2d", None)
+                            if not batches:
+                                return None
+                            out = [dispatch(b) for b in batches]
+                            tr.end_span(s)
+                            return out
+                    """,
+                },
+                {"GL1102"},
+            ),
+        ],
+        "clean": [
+            # registered constant, resolved through the import alias
+            {
+                "spark_druid_olap_tpu/obs/trace.py": _OBS_TRACE_FIXTURE,
+                "spark_druid_olap_tpu/exec/engine.py": """
+                    from ..obs.trace import SPAN_H2D, span
+
+                    def run(batches):
+                        for b in batches:
+                            with span(SPAN_H2D, batch=0):
+                                dispatch(b)
+                """,
+            },
+            # a literal spelling of a REGISTERED name also verifies
+            {
+                "spark_druid_olap_tpu/obs/trace.py": _OBS_TRACE_FIXTURE,
+                "spark_druid_olap_tpu/exec/engine.py": """
+                    from ..obs.trace import span
+
+                    def run(batches):
+                        with span("finalize"):
+                            return [dispatch(b) for b in batches]
+                """,
+            },
+            # outside the instrumented surface the pass is silent (a
+            # notebook-ish helper may name spans however it likes)
+            {
+                "spark_druid_olap_tpu/obs/trace.py": _OBS_TRACE_FIXTURE,
+                "spark_druid_olap_tpu/plan/profile.py": """
+                    from ..obs.trace import span
+
+                    def probe():
+                        with span("experimental-probe"):
+                            pass
+                """,
+            },
         ],
     },
 }

@@ -1,0 +1,280 @@
+"""Unit coverage for the obs/ observability subsystem (ISSUE 4):
+span-tree exactness under an injectable clock, trace ring eviction,
+thread isolation of concurrent traces, metrics-registry semantics +
+Prometheus exposition, the slow-query log, and the tracer-overhead
+budget asserted by COUNTING clock calls (never wall-time)."""
+
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.obs import (
+    SPAN_EXECUTE,
+    SPAN_FINALIZE,
+    SPAN_PLAN,
+    MetricsRegistry,
+    Tracer,
+    current_query_id,
+    get_registry,
+    span,
+)
+
+
+class TickClock:
+    """Deterministic clock: each call returns the next value and counts
+    itself — tracer overhead = call count, not wall time."""
+
+    def __init__(self, step=1.0):
+        self.t = 0.0
+        self.step = step
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        v = self.t
+        self.t += self.step
+        return v
+
+
+# ---------------------------------------------------------------------------
+# Span trees
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_exact_under_injected_clock():
+    clk = TickClock(step=1.0)  # 1 simulated second per clock read
+    tracer = Tracer(clock=clk)
+    with tracer.query_trace(query_id="q-1", query_type="unit") as tr:
+        with span(SPAN_PLAN):
+            pass
+        with span(SPAN_EXECUTE):
+            with span(SPAN_FINALIZE):
+                pass
+    d = tr.to_dict()
+    assert d["query_id"] == "q-1"
+    root = d["spans"]
+    assert root["name"] == "query"
+    names = [c["name"] for c in root["children"]]
+    assert names == ["plan", "execute"]
+    execute = root["children"][1]
+    assert [c["name"] for c in execute["children"]] == ["finalize"]
+    # clock ticks once per read: plan = 1 tick wide, finalize = 1,
+    # execute = 3 (start, finalize's 2, end)
+    assert root["children"][0]["duration_ms"] == 1000.0
+    assert execute["children"][0]["duration_ms"] == 1000.0
+    assert execute["duration_ms"] == 3000.0
+    # children cover the root minus the one tick between them: the
+    # phase-sum ≈ total property the acceptance criteria name
+    assert sum(c["duration_ms"] for c in root["children"]) <= d["total_ms"]
+    assert d["total_ms"] == root["duration_ms"]
+
+
+def test_span_outside_trace_is_noop():
+    with span(SPAN_PLAN) as s:
+        assert s is None
+    assert current_query_id() == ""
+
+
+def test_query_trace_outermost_wins():
+    tracer = Tracer()
+    with tracer.query_trace(query_id="outer") as t1:
+        with tracer.query_trace(query_id="inner") as t2:
+            assert t2 is t1
+            assert current_query_id() == "outer"
+    # only ONE trace landed in the ring
+    assert tracer.ring.ids() == ["outer"]
+
+
+def test_trace_ring_eviction_fifo():
+    tracer = Tracer(capacity=2)
+    for qid in ("a", "b", "c"):
+        with tracer.query_trace(query_id=qid):
+            pass
+    assert tracer.ring.get("a") is None  # oldest evicted
+    assert tracer.ring.get("b") is not None
+    assert tracer.ring.get("c") is not None
+    assert len(tracer.ring) == 2
+
+
+def test_concurrent_traces_do_not_interleave():
+    """Each thread's spans land in ITS trace only (contextvars give every
+    thread an isolated active trace/span)."""
+    tracer = Tracer()
+    errs = []
+
+    def work(i):
+        try:
+            with tracer.query_trace(query_id=f"q{i}") as tr:
+                for _ in range(5):
+                    with span(SPAN_EXECUTE, worker=i):
+                        pass
+                assert len(tr.root.children) == 5
+                assert all(
+                    c.attrs.get("worker") == i for c in tr.root.children
+                )
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+    assert len(tracer.ring) == 8
+    for i in range(8):
+        d = tracer.ring.get(f"q{i}")
+        assert len(d["spans"]["children"]) == 5
+
+
+def test_slow_query_log_renders_span_tree(caplog):
+    tracer = Tracer()
+    with caplog.at_level(
+        logging.WARNING, logger="spark_druid_olap_tpu.obs.trace"
+    ):
+        with tracer.query_trace(query_id="slow-1", slow_ms=1e-9):
+            with span(SPAN_PLAN):
+                pass
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("slow query slow-1" in m and "plan" in m for m in msgs)
+    # under the threshold: silent
+    caplog.clear()
+    with caplog.at_level(
+        logging.WARNING, logger="spark_druid_olap_tpu.obs.trace"
+    ):
+        with tracer.query_trace(query_id="fast-1", slow_ms=60_000.0):
+            pass
+    assert not [r for r in caplog.records if "slow query" in r.getMessage()]
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="b").inc()
+    assert c.labels(kind="a").value == 3
+    with pytest.raises(ValueError):
+        c.labels(kind="a").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.labels(wrong="a")
+    # re-registration with the same shape returns the same family
+    assert reg.counter("t_total", labels=("kind",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("t_total")  # kind mismatch
+
+
+def test_registry_histogram_quantiles_and_render():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms", "latency", buckets=(1, 10, 100))
+    for v in (0.5, 5, 5, 50):
+        h.observe(v)
+    child = h.labels()
+    assert child.count == 4
+    assert child.quantile(0.5) is not None
+    assert 1 <= child.quantile(0.5) <= 10
+    # past the last bucket clamps to it
+    h.observe(1e9)
+    assert child.quantile(0.999) == 100
+    text = reg.render_prometheus()
+    assert "# TYPE lat_ms histogram" in text
+    assert 'lat_ms_bucket{le="+Inf"} 5' in text
+    assert "lat_ms_count 5" in text
+
+
+def test_registry_gauge_callback():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "queue depth")
+    state = {"v": 3}
+    g.set_function(lambda: state["v"])
+    assert "depth 3" in reg.render_prometheus()
+    state["v"] = 7
+    assert "depth 7" in reg.render_prometheus()
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("esc_total", labels=("msg",))
+    c.labels(msg='say "hi"\nback\\slash').inc()
+    text = reg.render_prometheus()
+    assert 'msg="say \\"hi\\"\\nback\\\\slash"' in text
+
+
+# ---------------------------------------------------------------------------
+# Tracer overhead (acceptance: <= 5% on a cached-program SSB query,
+# asserted with the injectable clock — by COUNTING, not timing)
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_overhead_on_cached_ssb_query_counted_not_timed():
+    from spark_druid_olap_tpu.workloads import ssb
+
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0  # must execute, not cache-hit
+    ctx = sd.TPUOlapContext(cfg)
+    ssb.register(ctx, tables=ssb.gen_tables(scale=0.01, seed=7))
+    q = ssb.QUERIES["q1_1"]
+    ctx.sql(q)  # compile
+    ctx.sql(q)  # warm
+    assert ctx.last_metrics.program_cache_hit
+
+    clk = TickClock(step=0.0)  # frozen clock: pure call counting
+    ctx.tracer = Tracer(clock=clk)
+    ctx.sql(q)
+    assert ctx.last_metrics.program_cache_hit
+    # Every tracer action is a clock read + O(1) bookkeeping; at a very
+    # conservative 2us per action (perf_counter + lock + append), the
+    # budget for <=5% overhead on a 10ms cached-program SSB query floor
+    # is 0.05 * 10ms / 2us = 250 actions.  The deterministic count makes
+    # the 5% acceptance bound wall-time-free: N_calls * 2us <= 500us.
+    assert 0 < clk.calls <= 250, clk.calls
+    # and the instrumentation actually produced the span tree
+    d = ctx.tracer.last.to_dict()
+    names = {c["name"] for c in d["spans"]["children"]}
+    assert {"plan", "execute"} <= names
+
+
+def test_engine_publishes_into_process_registry():
+    before = (
+        get_registry()
+        .counter(
+            "sdol_queries_total",
+            labels=("query_type", "executor", "outcome"),
+        )
+        .snapshot()
+    )
+    ctx = sd.TPUOlapContext()
+    rng = np.random.default_rng(3)
+    ctx.register_table(
+        "obs_t",
+        {
+            "k": rng.choice(np.array(["x", "y"], dtype=object), 500),
+            "v": rng.random(500).astype(np.float32),
+        },
+        dimensions=["k"],
+        metrics=["v"],
+    )
+    ctx.sql("SELECT k, sum(v) AS s FROM obs_t GROUP BY k")
+    after = (
+        get_registry()
+        .counter(
+            "sdol_queries_total",
+            labels=("query_type", "executor", "outcome"),
+        )
+        .snapshot()
+    )
+    key = "groupBy,device,ok"
+    assert after.get(key, 0) >= before.get(key, 0) + 1
+    # the query_id on the metrics snapshot matches the trace ring entry
+    m = ctx.last_metrics
+    assert m.query_id
+    assert ctx.tracer.ring.get(m.query_id) is not None

@@ -8,8 +8,9 @@ override `finish(project)` for cross-module checks — then append the
 class to `ALL_PASSES`.  Codes are namespaced per pass (GL1xx jit-cache,
 GL2xx trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
 lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
-collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity; GL00x
-are the core's own: GL001 unparseable file, GL002 malformed pragma).
+collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
+span-discipline; GL00x are the core's own: GL001 unparseable file,
+GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -25,6 +26,7 @@ from .error_discipline import ErrorDisciplinePass
 from .jit_cache import JitCachePass
 from .lock_discipline import LockDisciplinePass
 from .pallas_shape import PallasShapePass
+from .span_discipline import SpanDisciplinePass
 from .trace_purity import TracePurityPass
 from .wire_parity import WireParityPass
 
@@ -39,6 +41,7 @@ ALL_PASSES = (
     CollectiveAxisPass,
     CheckpointCoveragePass,
     WireParityPass,
+    SpanDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,132 @@
+"""Pretty-print a query span tree as an indented phase/latency table.
+
+Input: a span-tree JSON produced by the obs/ tracer — either a single
+trace document ({"query_id", "total_ms", "spans": {...}}), a bench
+detail artifact (BENCH_*_detail.json; every per-query "span_tree" found
+is printed), or a raw span node.  Sources:
+
+    python -m tools.obs_dump BENCH_tpu_ssb_1_detail.json
+    python -m tools.obs_dump trace.json
+    curl -s localhost:8082/druid/v2/trace/<qid> | python -m tools.obs_dump -
+    python -m tools.obs_dump --url http://localhost:8082/druid/v2/trace/<qid>
+
+Output per trace:
+
+    query 3f2a... (sql)                       total 12.41ms
+    phase                     start      dur    %tot
+    query                      0.00    12.41  100.0%
+      admission                0.01     0.02    0.2%
+      plan                     0.04     0.31    2.5%
+      execute                  0.37    11.98   96.5%
+        segment_dispatch       0.51     9.80   79.0%
+        ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+def _is_span(node: Any) -> bool:
+    return (
+        isinstance(node, dict)
+        and "name" in node
+        and "duration_ms" in node
+    )
+
+
+def _find_traces(doc: Any, label: str = "") -> Iterator[Tuple[str, dict]]:
+    """Yield (label, trace-or-span dict) for every span tree in a JSON
+    document: trace documents, bare span nodes, and any nested
+    "span_tree"/"trace"/"spans" members of a bench detail artifact."""
+    if isinstance(doc, dict):
+        if "spans" in doc and _is_span(doc.get("spans")):
+            yield label, doc
+            return
+        if _is_span(doc):
+            yield label, {"spans": doc, "total_ms": doc.get("duration_ms")}
+            return
+        for k, v in doc.items():
+            sub = f"{label}.{k}" if label else str(k)
+            if k in ("span_tree", "trace") or isinstance(v, (dict, list)):
+                yield from _find_traces(v, sub)
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            yield from _find_traces(v, f"{label}[{i}]")
+
+
+def render_trace(trace: dict, label: str = "") -> str:
+    root = trace.get("spans", trace)
+    total = float(trace.get("total_ms") or root.get("duration_ms") or 0.0)
+    head = trace.get("query_id", "")
+    qt = trace.get("query_type", "")
+    lines: List[str] = []
+    title = " ".join(
+        x for x in (label, head, f"({qt})" if qt else "") if x
+    )
+    lines.append(f"{title or 'trace'}    total {total:.2f}ms")
+    lines.append(f"{'phase':<28} {'start':>8} {'dur':>9} {'%tot':>7}")
+
+    def walk(node: dict, depth: int) -> None:
+        dur = float(node.get("duration_ms", 0.0))
+        start = float(node.get("start_ms", 0.0))
+        pct = (dur / total * 100.0) if total > 0 else 0.0
+        attrs = node.get("attrs") or {}
+        suffix = (
+            "  " + " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            if attrs
+            else ""
+        )
+        name = "  " * depth + str(node.get("name", "?"))
+        lines.append(
+            f"{name:<28} {start:>8.2f} {dur:>8.2f}ms {pct:>6.1f}%{suffix}"
+        )
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)
+
+
+def dump(doc: Any) -> str:
+    out = []
+    for label, trace in _find_traces(doc):
+        out.append(render_trace(trace, label))
+    if not out:
+        return "no span trees found in input"
+    return "\n\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_dump",
+        description="render obs/ span-tree JSON as a phase/latency table",
+    )
+    ap.add_argument(
+        "path", nargs="?", default="-",
+        help="JSON file (trace doc or bench detail artifact); '-' = stdin",
+    )
+    ap.add_argument(
+        "--url", help="fetch the trace JSON from a URL "
+        "(e.g. a server's /druid/v2/trace/<query_id>)",
+    )
+    args = ap.parse_args(argv)
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(args.url, timeout=30) as r:
+            doc = json.loads(r.read())
+    elif args.path == "-":
+        doc = json.load(sys.stdin)
+    else:
+        with open(args.path) as f:
+            doc = json.load(f)
+    print(dump(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
