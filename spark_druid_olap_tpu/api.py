@@ -42,6 +42,7 @@ from .obs import (
     current_query_id,
     record_query_metrics,
     span,
+    span_event,
 )
 from .plan import expr as E
 from .plan import logical as L
@@ -50,6 +51,17 @@ from .sql.parser import parse_sql
 from .utils.log import get_logger
 
 log = get_logger("api")
+
+
+def _breaker_observation(br) -> dict:
+    """Small JSON-able snapshot of the circuit breaker as the routing
+    layer saw it — what degraded-path span events carry."""
+    d = br.to_dict()
+    return {
+        "state": d["state"],
+        "consecutive_failures": d["consecutive_failures"],
+        "trips": d["trips"],
+    }
 
 
 class TPUOlapContext:
@@ -410,6 +422,11 @@ class TPUOlapContext:
                 "device circuit open; answering on the host fallback"
             )
             with span(SPAN_DEGRADED, reason="circuit_open"):
+                # the breaker state OBSERVED at routing time: the trace
+                # must show WHY the fallback was chosen, not leave the
+                # reader to reconstruct it from counters (ROADMAP obs
+                # follow-up (c))
+                span_event("breaker_state", **_breaker_observation(br))
                 df = self._run_fallback(
                     lp, None, reason="device circuit open"
                 )
@@ -434,6 +451,11 @@ class TPUOlapContext:
                 type(err).__name__, err,
             )
             with span(SPAN_DEGRADED, reason="device_failed"):
+                span_event(
+                    "breaker_state",
+                    error_class=type(err).__name__,
+                    **_breaker_observation(br),
+                )
                 df = self._run_fallback(
                     lp, err, reason="device execution failed"
                 )

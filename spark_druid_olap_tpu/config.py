@@ -106,6 +106,13 @@ class SessionConfig:
     cost_model_enabled: bool = True
     dense_max_groups: int = 1 << 17  # dense one-hot vs scatter cutover
     onehot_vmem_budget_mb: int = 32
+    # device VMEM capacity class, MiB: the budget kernel tile sets must
+    # fit (double-buffered) — ~16 MiB/core on v5e-class parts.  The
+    # calibrated files carry the authoritative per-platform figure as
+    # `vmem_budget_bytes`; this default is the fallback graftlint's
+    # resource-budget pass (GL12xx) and future tile autotuning read when
+    # no calibration exists for the target platform
+    vmem_budget_mb: int = 16
     # us per row per 128-wide group tile for the dense one-hot kernel (MXU)
     cost_per_row_dense: float = 1e-4
     # us per row for the scatter (segment-sum) kernel — serializes on TPU
@@ -316,6 +323,9 @@ class SessionConfig:
             for k in ("scatter_lo_groups", "scatter_hi_groups"):
                 if k in data and data[k] is not None and data[k] > 0:
                     setattr(cfg, k, int(data[k]))
+            vb = data.get("vmem_budget_bytes")
+            if vb is not None and vb > 0:
+                cfg.vmem_budget_mb = max(1, int(vb) >> 20)
             cfg.calibration_meta = {
                 "path": p,
                 "device": data.get("device"),

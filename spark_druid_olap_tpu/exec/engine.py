@@ -405,11 +405,13 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 self._m.h2d_ms += (_time.perf_counter() - t0) * 1e3
             return arr
 
+        # "col"/"valid" tags: a user column literally named "__valid"
+        # must not alias the validity-mask entry (jit-collision/GL1301)
         for n in names:
-            key = (seg.uid, n)
+            key = (seg.uid, "col", n)
             arr = self._device_cache.get(key)
             cols[n] = arr if arr is not None else put(key, seg.column(n))
-        key = (seg.uid, "__valid")
+        key = (seg.uid, "valid")
         arr = self._device_cache.get(key)
         cols["__valid"] = arr if arr is not None else put(key, seg.valid)
         return cols
@@ -425,12 +427,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         (_device_cols: per-segment columns plus the validity buffer) so
         planner-side h2d costing (api device-assist) never re-encodes
         either.  4 bytes/row/buffer: codes are <=4 B, metric values f32."""
-        need = list(cols) + ["__valid"]
+        need = [("col", c) for c in cols] + [("valid",)]
         return sum(
             4 * seg.num_rows
             for seg in ds.segments
-            for c in need
-            if (seg.uid, c) not in self._device_cache
+            for tail in need
+            if (seg.uid,) + tail not in self._device_cache
         )
 
     def clear_cache(self):
@@ -662,8 +664,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         la, G = lowering.la, lowering.num_groups
         strategy = strategy_override or self._resolve_strategy(G)
         # _query_key includes schema_signature: a re-ingested datasource
-        # (new dict cardinalities => new G) must not reuse a stale program
-        key = _query_key(q, ds) + (strategy,) + tuple(key_extra)
+        # (new dict cardinalities => new G) must not reuse a stale program.
+        # The "fused" tag pins this key family apart from the tagged
+        # sparse/adaptive/stream families sharing this cache: without it
+        # nothing stops `strategy` + key_extra from ever spelling another
+        # family's tuple (graftlint jit-collision/GL1301)
+        key = _query_key(q, ds) + ("fused", strategy) + tuple(key_extra)
         cached = self._query_fn_cache.get(key)
         if cached is not None:
             if self._m is not None:

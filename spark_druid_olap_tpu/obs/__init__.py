@@ -47,4 +47,5 @@ from .trace import (  # noqa: F401
     default_tracer,
     new_query_id,
     span,
+    span_event,
 )

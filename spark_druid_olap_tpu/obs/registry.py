@@ -233,30 +233,43 @@ class Histogram(_Family):
                 child = self._children[key] = Histogram._Child(self)
         return child  # type: ignore[return-value]
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         if self.label_names:
             raise ValueError(
                 f"metric {self.name!r} is labeled; use .labels(...).observe()"
             )
-        self.labels().observe(value)
+        self.labels().observe(value, exemplar=exemplar)
 
     class _Child:
-        __slots__ = ("_family", "counts", "sum", "count")
+        __slots__ = ("_family", "counts", "sum", "count", "exemplars")
 
         def __init__(self, family: "Histogram"):
             self._family = family
             self.counts = [0] * len(family.buckets)
             self.sum = 0.0
             self.count = 0
+            # per-NATIVE-bucket exemplar: the last (trace_id, value)
+            # observed in that bucket, +1 slot for the +Inf overflow —
+            # the one-hop link from "the p99 bucket is hot" to the
+            # query trace that landed there (ROADMAP obs follow-up (a))
+            self.exemplars: List[Optional[Tuple[str, float]]] = (
+                [None] * (len(family.buckets) + 1)
+            )
 
-        def observe(self, value: float) -> None:
+        def observe(
+            self, value: float, exemplar: Optional[str] = None
+        ) -> None:
             v = float(value)
             with self._family._lock:
                 self.sum += v
                 self.count += 1
+                native = len(self._family.buckets)
                 for i, b in enumerate(self._family.buckets):
                     if v <= b:
                         self.counts[i] += 1
+                        native = min(native, i)
+                if exemplar:
+                    self.exemplars[native] = (str(exemplar), v)
 
         def quantile(self, q: float) -> Optional[float]:
             """Bucket-interpolated quantile; None when empty.  Values past
@@ -284,15 +297,35 @@ class Histogram(_Family):
         with self._lock:
             items = sorted(self._children.items())
             for key, child in items:
-                for edge, cum in zip(self.buckets, child.counts):
+                for i, (edge, cum) in enumerate(
+                    zip(self.buckets, child.counts)
+                ):
                     lbls = _fmt_labels(
                         self.label_names + ("le",), key + (f"{edge:g}",)
                     )
                     out.append(f"{self.name}_bucket{lbls} {cum}")
+                    ex = child.exemplars[i]
+                    if ex is not None:
+                        # exemplar as a comment line: the 0.0.4 text
+                        # format has no native exemplar syntax and
+                        # scrapers skip comments, so the trace link
+                        # rides along without breaking any parser
+                        out.append(
+                            f"# exemplar {self.name}_bucket{lbls} "
+                            f'trace_id="{_escape_label(ex[0])}" '
+                            f"value={ex[1]:g}"
+                        )
                 lbls = _fmt_labels(
                     self.label_names + ("le",), key + ("+Inf",)
                 )
                 out.append(f"{self.name}_bucket{lbls} {child.count}")
+                ex = child.exemplars[-1]
+                if ex is not None:
+                    out.append(
+                        f"# exemplar {self.name}_bucket{lbls} "
+                        f'trace_id="{_escape_label(ex[0])}" '
+                        f"value={ex[1]:g}"
+                    )
                 base = _fmt_labels(self.label_names, key)
                 out.append(f"{self.name}_sum{base} {child.sum:g}")
                 out.append(f"{self.name}_count{base} {child.count}")
@@ -301,15 +334,30 @@ class Histogram(_Family):
     def snapshot(self) -> Dict[str, dict]:
         out: Dict[str, dict] = {}
         with self._lock:
-            items = list(self._children.items())
-        for key, child in items:
-            out[",".join(key) if key else ""] = {
+            # one acquisition: children plus their exemplar slots (the
+            # quantile calls below take the lock themselves, so they
+            # stay outside it)
+            items = [
+                (key, child, list(child.exemplars))
+                for key, child in self._children.items()
+            ]
+        for key, child, exemplar_slots in items:
+            entry = {
                 "count": child.count,
                 "sum_ms": round(child.sum, 3),
                 "p50": child.quantile(0.50),
                 "p95": child.quantile(0.95),
                 "p99": child.quantile(0.99),
             }
+            exemplars = {
+                (f"{self.buckets[i]:g}" if i < len(self.buckets)
+                 else "+Inf"): {"trace_id": ex[0], "value": ex[1]}
+                for i, ex in enumerate(exemplar_slots)
+                if ex is not None
+            }
+            if exemplars:
+                entry["exemplars"] = exemplars
+            out[",".join(key) if key else ""] = entry
         return out
 
 
@@ -437,6 +485,9 @@ def record_query_metrics(m, outcome: str = "ok") -> None:
         "per-phase query latency (ms)",
         labels=("phase",),
     )
+    # the query_id rides along as the bucket's exemplar, linking the
+    # latency distribution back to a concrete trace in the ring
+    qid = getattr(m, "query_id", "") or None
     for phase, value in (
         ("h2d", m.h2d_ms),
         ("compile", m.compile_ms),
@@ -446,4 +497,4 @@ def record_query_metrics(m, outcome: str = "ok") -> None:
         ("total", m.total_ms),
     ):
         if value > 0 or phase == "total":
-            hist.labels(phase=phase).observe(value)
+            hist.labels(phase=phase).observe(value, exemplar=qid)

@@ -110,9 +110,12 @@ def new_query_id() -> str:
 
 class Span:
     """One timed phase.  Start/end are tracer-clock readings (seconds);
-    `attrs` carry small JSON-able facts (segment index, retry attempt)."""
+    `attrs` carry small JSON-able facts (segment index, retry attempt);
+    `events` are point-in-time observations inside the phase (the
+    breaker state read at routing time) — a name, a clock reading, and
+    small attrs, without opening a child span."""
 
-    __slots__ = ("name", "start", "end", "attrs", "children")
+    __slots__ = ("name", "start", "end", "attrs", "children", "events")
 
     def __init__(self, name: str, start: float, attrs: Optional[dict] = None):
         self.name = name
@@ -120,6 +123,7 @@ class Span:
         self.end: Optional[float] = None
         self.attrs = attrs or {}
         self.children: List["Span"] = []
+        self.events: List[Dict[str, Any]] = []
 
     @property
     def duration_ms(self) -> float:
@@ -135,6 +139,17 @@ class Span:
         }
         if self.attrs:
             d["attrs"] = dict(self.attrs)
+        if self.events:
+            d["events"] = [
+                {
+                    "name": e["name"],
+                    "at_ms": round((e["at"] - origin) * 1e3, 3),
+                    **(
+                        {"attrs": dict(e["attrs"])} if e["attrs"] else {}
+                    ),
+                }
+                for e in self.events
+            ]
         if self.children:
             d["children"] = [c.to_dict(origin) for c in self.children]
         return d
@@ -169,6 +184,14 @@ class QueryTrace:
     def end_span(self, s: Span) -> None:
         s.end = self._clock()
 
+    def add_event(
+        self, s: Span, name: str, attrs: Optional[dict] = None
+    ) -> None:
+        with self._lock:
+            s.events.append(
+                {"name": name, "at": self._clock(), "attrs": attrs or {}}
+            )
+
     def finish(self) -> None:
         with self._lock:
             if self.root.end is None:
@@ -199,6 +222,14 @@ class QueryTrace:
             lines.append(
                 f"{'  ' * depth}{s.name:<20} {s.duration_ms:>9.2f}ms{attrs}"
             )
+            for e in s.events:
+                eattrs = " ".join(
+                    f"{k}={v}" for k, v in sorted(e["attrs"].items())
+                )
+                lines.append(
+                    f"{'  ' * (depth + 1)}@ {e['name']}"
+                    f"{' ' + eattrs if eattrs else ''}"
+                )
             for c in s.children:
                 walk(c, depth + 1)
 
@@ -244,6 +275,18 @@ def span(name: str, **attrs):
     finally:
         _active_span.reset(token)
         tr.end_span(s)
+
+
+def span_event(name: str, **attrs) -> None:
+    """Attach a point-in-time event to the ACTIVE span (no child span,
+    no duration): the routing layer records the breaker state it
+    observed, retries note which error class struck.  A no-op (one
+    contextvar read) when no trace is active."""
+    tr = _active_trace.get()
+    if tr is None:
+        return
+    s = _active_span.get()
+    tr.add_event(s if s is not None else tr.root, name, attrs or None)
 
 
 # ---------------------------------------------------------------------------

@@ -195,7 +195,9 @@ class DistributedEngine:
         seg_sig = tuple(s.uid for s in segs)
 
         def build(name: str, fill) -> jax.Array:
-            key = (ds.name, name, nd, seg_sig)
+            # "col"/"valid" tags: a user column literally named
+            # "__valid" must not alias the validity-mask entry (GL1301)
+            key = (ds.name, "col", name, nd, seg_sig)
             hit = self._shard_cache.get(key)
             if hit is not None:
                 return hit
@@ -213,7 +215,7 @@ class DistributedEngine:
         for n in names:
             fill = -1 if n in ds.dicts else 0
             cols[n] = build(n, fill)
-        vkey = (ds.name, "__valid", nd, seg_sig)
+        vkey = (ds.name, "valid", nd, seg_sig)
         valid = self._shard_cache.get(vkey)
         if valid is None:
             parts = [s.valid for s in segs]
@@ -276,9 +278,12 @@ class DistributedEngine:
         so rebuilding the closure per query would recompile every time."""
         from ..exec.lowering import _query_key
 
+        # "dense-state" pins this family apart from the "sparse" /
+        # "adaptive-presence" tuples sharing _spmd_cache (GL1301)
         cache_key = _query_key(lowering.query, ds) + (
             local_rows,
             self._mesh_key(),
+            "dense-state",
             strategy,
         ) + tuple(key_extra)
         if cache_key in self._spmd_cache:

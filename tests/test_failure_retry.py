@@ -64,7 +64,7 @@ def test_transient_failure_retries_once(ds):
         calls["n"] += 1
         raise RuntimeError("injected transient device failure")
 
-    eng._query_fn_cache[_query_key(q, ds) + (strategy,)] = poisoned
+    eng._query_fn_cache[_query_key(q, ds) + ("fused", strategy)] = poisoned
     got = eng.execute(_q(), ds).sort_values("d").reset_index(drop=True)
     want = _oracle(ds).sort_values("d").reset_index(drop=True)
     assert calls["n"] >= 1  # the poisoned program actually ran
@@ -109,7 +109,7 @@ def test_retry_evicts_transformed_query_identity(ds):
     def poisoned(cols_list):
         raise RuntimeError("injected transient device failure")
 
-    eng._query_fn_cache[_query_key(qt, tds) + (strategy,)] = poisoned
+    eng._query_fn_cache[_query_key(qt, tds) + ("fused", strategy)] = poisoned
     got = eng.execute(raw, tds)
     assert int(got["n"].sum()) == n
 

@@ -1002,6 +1002,393 @@ _MATRIX = {
             },
         ],
     },
+    "resource-budget": {
+        "violating": [
+            # tile set past the VMEM budget, shapes behind a module
+            # constant (GL1201: 2 refs x 2048x2048 f32 = 32 MiB, x2
+            # double-buffered = 64 MiB > the 16 MiB default budget)
+            (
+                {"pkg/kern.py": """
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.experimental import pallas as pl
+
+                    BLOCK = 2048
+
+                    def _sum_kernel(x_ref, o_ref):
+                        o_ref[:] = x_ref[:] + 1.0
+
+                    def run(x):
+                        return pl.pallas_call(
+                            _sum_kernel,
+                            grid=(4,),
+                            in_specs=[
+                                pl.BlockSpec(
+                                    (BLOCK, BLOCK), lambda i: (i, 0)
+                                ),
+                            ],
+                            out_specs=pl.BlockSpec(
+                                (BLOCK, BLOCK), lambda i: (i, 0)
+                            ),
+                            out_shape=jax.ShapeDtypeStruct(
+                                (8192, 2048), jnp.float32
+                            ),
+                        )(x)
+                """},
+                {"GL1201"},
+            ),
+            # grid axis floor-divided to zero (GL1202): the constant
+            # propagation resolves G // BG = 1024 // 4096 = 0
+            (
+                {"pkg/kern.py": """
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.experimental import pallas as pl
+
+                    G = 1024
+                    BG = 4096
+
+                    def _k(x_ref, o_ref):
+                        o_ref[:] = x_ref[:]
+
+                    def run(x):
+                        return pl.pallas_call(
+                            _k,
+                            grid=(G // BG, 4),
+                            in_specs=[
+                                pl.BlockSpec((128,), lambda i, j: (i,)),
+                            ],
+                            out_specs=pl.BlockSpec(
+                                (128,), lambda i, j: (i,)
+                            ),
+                            out_shape=jax.ShapeDtypeStruct(
+                                (512,), jnp.float32
+                            ),
+                        )(x)
+                """},
+                {"GL1202"},
+            ),
+            # block dimension arithmetic collapsing to zero (GL1203)
+            (
+                {"pkg/kern.py": """
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.experimental import pallas as pl
+
+                    WIDTH = 1024
+
+                    def _k(x_ref, o_ref):
+                        o_ref[:] = x_ref[:]
+
+                    def run(x):
+                        return pl.pallas_call(
+                            _k,
+                            grid=(8,),
+                            in_specs=[
+                                pl.BlockSpec(
+                                    (128, WIDTH - 1024), lambda i: (i, 0)
+                                ),
+                            ],
+                            out_specs=pl.BlockSpec(
+                                (128, 1), lambda i: (i, 0)
+                            ),
+                            out_shape=jax.ShapeDtypeStruct(
+                                (1024, 1), jnp.float32
+                            ),
+                        )(x)
+                """},
+                {"GL1203"},
+            ),
+        ],
+        "clean": [
+            # modest tiles through min()/conditional arithmetic: the
+            # evaluator proves them under budget
+            {"pkg/kern.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax.experimental import pallas as pl
+
+                def _k(x_ref, o_ref):
+                    o_ref[:] = x_ref[:]
+
+                def run(x):
+                    br = min(1024, 512)
+                    bg = 128 if br > 256 else 256
+                    return pl.pallas_call(
+                        _k,
+                        grid=(8,),
+                        in_specs=[
+                            pl.BlockSpec((br, bg), lambda i: (i, 0)),
+                        ],
+                        out_specs=pl.BlockSpec((br, bg), lambda i: (i, 0)),
+                        out_shape=jax.ShapeDtypeStruct(
+                            (4096, 128), jnp.float32
+                        ),
+                    )(x)
+            """},
+            # dynamically-tuned shapes (parameters without defaults) are
+            # unresolvable: silent, never guessed
+            {"pkg/kern.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax.experimental import pallas as pl
+
+                def _k(x_ref, o_ref):
+                    o_ref[:] = x_ref[:]
+
+                def run(x, block_rows, block_groups):
+                    return pl.pallas_call(
+                        _k,
+                        grid=(4, 2),
+                        in_specs=[
+                            pl.BlockSpec(
+                                (block_rows, block_groups),
+                                lambda j, i: (i, 0),
+                            ),
+                        ],
+                        out_specs=pl.BlockSpec(
+                            (block_rows, block_groups),
+                            lambda j, i: (0, j),
+                        ),
+                        out_shape=jax.ShapeDtypeStruct(
+                            (4096, 4096), jnp.float32
+                        ),
+                    )(x)
+            """},
+        ],
+    },
+    "jit-collision": {
+        "violating": [
+            # two key families for one cache with no distinguishing
+            # literal: same arity, every position dyn-vs-dyn or
+            # dyn-vs-lit (GL1301)
+            (
+                {"spark_druid_olap_tpu/exec/eng.py": """
+                    class Engine:
+                        def dense(self, q, shape, strategy):
+                            key = (q, shape, strategy)
+                            fn = self._program_cache.get(key)
+                            if fn is None:
+                                self._program_cache[key] = fn = object
+                            return fn
+
+                        def sparse(self, q, shape):
+                            key = ("sparse", q, shape)
+                            fn = self._program_cache.get(key)
+                            if fn is None:
+                                self._program_cache[key] = fn = object
+                            return fn
+                """},
+                {"GL1301"},
+            ),
+            # per-call-unique key element: the cache never hits (GL1302)
+            (
+                {"spark_druid_olap_tpu/exec/eng.py": """
+                    class Engine:
+                        def program(self, q, ds):
+                            key = (q, id(ds))
+                            fn = self._program_cache.get(key)
+                            if fn is None:
+                                self._program_cache[key] = fn = object
+                            return fn
+                """},
+                {"GL1302"},
+            ),
+            # the same function jit-wrapped twice across modules: two
+            # compile caches for one program (GL1303)
+            (
+                {
+                    "spark_druid_olap_tpu/ops/k.py": """
+                        import jax
+
+                        @jax.jit
+                        def f(x):
+                            return x + 1
+                    """,
+                    "spark_druid_olap_tpu/exec/use.py": """
+                        import jax
+
+                        from ..ops.k import f
+
+                        g = jax.jit(f)
+                    """,
+                },
+                {"GL1303"},
+            ),
+        ],
+        "clean": [
+            # tagged families over a shared structured-prefix builder:
+            # the anchors pin alignment and the tags distinguish
+            {"spark_druid_olap_tpu/exec/eng.py": """
+                def _query_key(q, ds):
+                    return (q, ds)
+
+                class Engine:
+                    def fused(self, q, ds, strategy):
+                        key = _query_key(q, ds) + ("fused", strategy)
+                        self._program_cache[key] = object
+                        return key
+
+                    def stream(self, q, ds, prep):
+                        key = _query_key(q, ds) + ("stream", prep, 1)
+                        self._program_cache[key] = object
+                        return key
+            """},
+            # eviction loops and identical shared keys are not findings
+            {"spark_druid_olap_tpu/exec/eng.py": """
+                class Engine:
+                    def put(self, seg_uid, name, arr):
+                        key = (seg_uid, name)
+                        self._device_cache[key] = arr
+
+                    def get(self, seg_uid, name):
+                        key = (seg_uid, name)
+                        return self._device_cache.get(key)
+
+                    def evict(self, base):
+                        for k in [
+                            k for k in self._device_cache
+                            if k[:2] == base
+                        ]:
+                            self._device_cache.pop(k)
+            """},
+        ],
+    },
+    "lock-order": {
+        "violating": [
+            # ABBA cycle in one module, one side through a helper
+            # (GL1401 at both edge sites)
+            (
+                {"spark_druid_olap_tpu/exec/locks.py": """
+                    import threading
+
+                    _A_LOCK = threading.Lock()
+                    _B_LOCK = threading.Lock()
+
+                    def a_then_b():
+                        with _A_LOCK:
+                            with _B_LOCK:
+                                pass
+
+                    def b_then_a():
+                        with _B_LOCK:
+                            _take_a()
+
+                    def _take_a():
+                        with _A_LOCK:
+                            pass
+                """},
+                {"GL1401"},
+            ),
+            # cross-module cycle through DEPTH-2 call-through: the
+            # breaker lock publishes into the registry lock, and a
+            # registry render reaches back into the breaker two calls
+            # down (GL1401)
+            (
+                {
+                    "spark_druid_olap_tpu/obs/reg.py": """
+                        import threading
+
+                        REG_LOCK = threading.Lock()
+
+                        def publish():
+                            with REG_LOCK:
+                                _note()
+
+                        def _note():
+                            from ..resilience import snap
+
+                            snap()
+                    """,
+                    "spark_druid_olap_tpu/resilience.py": """
+                        import threading
+
+                        from .obs.reg import publish
+
+                        BRK_LOCK = threading.Lock()
+
+                        def record():
+                            with BRK_LOCK:
+                                publish()
+
+                        def snap():
+                            with BRK_LOCK:
+                                pass
+                    """,
+                },
+                {"GL1401"},
+            ),
+            # blocking sleep while the breaker lock is held (GL1402),
+            # lexically and through a helper
+            (
+                {"spark_druid_olap_tpu/resilience.py": """
+                    import threading
+                    import time
+
+                    class CircuitBreaker:
+                        def __init__(self):
+                            self._lock = threading.Lock()
+
+                        def backoff(self):
+                            with self._lock:
+                                time.sleep(0.1)
+
+                        def backoff_via_helper(self):
+                            with self._lock:
+                                self._wait()
+
+                        def _wait(self):
+                            time.sleep(0.1)
+                """},
+                {"GL1402"},
+            ),
+        ],
+        "clean": [
+            # a consistent hierarchy (A before B, never the reverse)
+            {"spark_druid_olap_tpu/exec/locks.py": """
+                import threading
+
+                _A_LOCK = threading.Lock()
+                _B_LOCK = threading.Lock()
+
+                def a_then_b():
+                    with _A_LOCK:
+                        with _B_LOCK:
+                            pass
+
+                def also_a_then_b():
+                    with _A_LOCK:
+                        _take_b()
+
+                def _take_b():
+                    with _B_LOCK:
+                        pass
+            """},
+            # reentrant self-acquisition (the RLock eviction idiom) and
+            # sleeping AFTER the lock is released
+            {"spark_druid_olap_tpu/utils/lru.py": """
+                import threading
+                import time
+
+                class ByteBudgetCache:
+                    def __init__(self):
+                        self._lock = threading.RLock()
+
+                    def __setitem__(self, key, v):
+                        with self._lock:
+                            self._evict()
+
+                    def _evict(self):
+                        with self._lock:
+                            pass
+
+                def backoff_outside(lock):
+                    with lock:
+                        pass
+                    time.sleep(0.01)
+            """},
+        ],
+    },
 }
 
 

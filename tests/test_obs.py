@@ -278,3 +278,138 @@ def test_engine_publishes_into_process_registry():
     m = ctx.last_metrics
     assert m.query_id
     assert ctx.tracer.ring.get(m.query_id) is not None
+
+
+# ---------------------------------------------------------------------------
+# Span events + exemplars (ISSUE 5 obs satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_span_event_attaches_to_active_span():
+    from spark_druid_olap_tpu.obs import span_event
+
+    clk = TickClock(step=1.0)
+    tracer = Tracer(clock=clk)
+    with tracer.query_trace(query_id="q-ev") as tr:
+        with span(SPAN_EXECUTE):
+            span_event("breaker_state", state="open", trips=2)
+    d = tr.to_dict()
+    execute = d["spans"]["children"][0]
+    assert execute["name"] == "execute"
+    events = execute["events"]
+    assert len(events) == 1
+    assert events[0]["name"] == "breaker_state"
+    assert events[0]["attrs"] == {"state": "open", "trips": 2}
+    # the event timestamp is trace-relative, inside the span
+    assert 0 <= events[0]["at_ms"] <= d["total_ms"]
+    # events show up in the rendered tree (slow-query log body)
+    assert "@ breaker_state" in tr.render()
+
+
+def test_span_event_outside_trace_is_noop():
+    from spark_druid_olap_tpu.obs import span_event
+
+    span_event("breaker_state", state="open")  # must not raise
+
+
+def test_histogram_exemplars_link_buckets_to_trace_ids():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_ms", "test", buckets=(10.0, 100.0))
+    h.observe(5.0, exemplar="qid-fast")
+    h.observe(50.0, exemplar="qid-mid")
+    h.observe(5000.0, exemplar="qid-slow")
+    h.observe(2.0)  # no exemplar: must not clobber qid-fast
+    text = reg.render_prometheus()
+    assert '# exemplar t_ms_bucket{le="10"} trace_id="qid-fast"' in text
+    assert '# exemplar t_ms_bucket{le="100"} trace_id="qid-mid"' in text
+    assert '# exemplar t_ms_bucket{le="+Inf"} trace_id="qid-slow"' in text
+    # comment lines must not break a scrape: every non-comment line
+    # still parses as `name{labels} value`
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert len(line.rsplit(" ", 1)) == 2, line
+    ex = reg.to_dict()["t_ms"]["values"][""]["exemplars"]
+    assert ex["10"]["trace_id"] == "qid-fast"
+    assert ex["100"]["trace_id"] == "qid-mid"
+    assert ex["+Inf"]["trace_id"] == "qid-slow"
+    # a newer observation in the same bucket takes the slot over
+    h.observe(6.0, exemplar="qid-faster")
+    ex = reg.to_dict()["t_ms"]["values"][""]["exemplars"]
+    assert ex["10"]["trace_id"] == "qid-faster"
+
+
+def test_query_metrics_publish_exemplars_and_obs_dump_renders_them():
+    import tools.obs_dump as obs_dump
+
+    ctx = sd.TPUOlapContext()
+    rng = np.random.default_rng(5)
+    ctx.register_table(
+        "obs_ex",
+        {
+            "k": rng.choice(np.array(["x", "y"], dtype=object), 400),
+            "v": rng.random(400).astype(np.float32),
+        },
+        dimensions=["k"],
+        metrics=["v"],
+    )
+    ctx.sql("SELECT k, sum(v) AS s FROM obs_ex GROUP BY k")
+    qid = ctx.last_metrics.query_id
+    assert qid
+    fam = get_registry().to_dict()["sdol_query_phase_ms"]
+    total = fam["values"].get("total", {})
+    exemplars = total.get("exemplars", {})
+    assert any(e["trace_id"] == qid for e in exemplars.values())
+    # the exposition carries the link as a comment
+    text = get_registry().render_prometheus()
+    assert f'trace_id="{qid}"' in text
+    # and obs_dump renders the /status-shaped doc's exemplar table
+    rendered = obs_dump.dump({"metrics": get_registry().to_dict()})
+    assert "histogram exemplars" in rendered
+    assert qid in rendered
+
+
+def test_degraded_trace_records_breaker_state_event():
+    """ROADMAP obs follow-up (c): a degraded-path trace must SAY why the
+    fallback was chosen — the breaker state observed at routing time
+    rides on the `degraded` span as an event."""
+    from spark_druid_olap_tpu.resilience import injector
+
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0
+    cfg.retry_backoff_ms = 1.0
+    ctx = sd.TPUOlapContext(cfg)
+    rng = np.random.default_rng(9)
+    ctx.register_table(
+        "obs_deg",
+        {
+            "k": rng.choice(np.array(["x", "y"], dtype=object), 400),
+            "v": rng.random(400).astype(np.float32),
+        },
+        dimensions=["k"],
+        metrics=["v"],
+    )
+    try:
+        injector().arm("device_dispatch", "error")
+        ctx.sql("SELECT k, sum(v) AS s FROM obs_deg GROUP BY k")
+    finally:
+        injector().disarm()
+    assert ctx.last_metrics.degraded
+
+    def find_spans(node, name, out):
+        if node.get("name") == name:
+            out.append(node)
+        for c in node.get("children", ()):
+            find_spans(c, name, out)
+        return out
+
+    degraded = find_spans(ctx.tracer.last.to_dict()["spans"], "degraded", [])
+    assert degraded, "degraded span missing from the trace"
+    events = [
+        e for s in degraded for e in s.get("events", ())
+        if e["name"] == "breaker_state"
+    ]
+    assert events, "breaker_state event missing from the degraded span"
+    attrs = events[0]["attrs"]
+    assert attrs["state"] in ("closed", "open", "half_open")
+    assert "consecutive_failures" in attrs and "trips" in attrs

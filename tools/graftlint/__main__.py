@@ -116,7 +116,10 @@ def _update_baseline(result, baseline_path: str) -> None:
     justifications over: exact fingerprint matches keep their reason, and
     a finding whose snippet changed (identity moved) inherits the reason
     of a now-stale entry with the same (pass, code, path) — an edited
-    line must not force the justification to be re-entered."""
+    line must not force the justification to be re-entered.  Prints a
+    diff summary (added / removed / carried) instead of rewriting
+    silently — a baseline that grew is a review event, not a side
+    effect."""
     # identity fallback carries a justification over ONLY from entries
     # whose finding no longer exists (stale): an entry still matched by
     # a live finding keeps its reason there, and a genuinely NEW second
@@ -124,9 +127,10 @@ def _update_baseline(result, baseline_path: str) -> None:
     # inherit a reviewed justification
     live = {f.fingerprint for f, _ in result.baselined}
     live |= {f.fingerprint for f in result.new}
+    old_entries = load_baseline(baseline_path)
     by_fingerprint = {}
     by_identity = {}
-    for e in load_baseline(baseline_path):
+    for e in old_entries:
         by_fingerprint.setdefault(e.fingerprint, []).append(e.reason)
         if e.fingerprint not in live:
             by_identity.setdefault(
@@ -164,11 +168,33 @@ def _update_baseline(result, baseline_path: str) -> None:
             )
         )
     entries.sort(key=lambda e: (e.path, e.pass_name, e.code, e.snippet))
+    # multiset diff vs the previous baseline: each old entry cancels at
+    # most one new entry with the same fingerprint
+    old_buckets = {}
+    for e in old_entries:
+        old_buckets.setdefault(e.fingerprint, []).append(e)
+    added, carried = [], 0
+    for e in entries:
+        bucket = old_buckets.get(e.fingerprint)
+        if bucket:
+            bucket.pop()
+            carried += 1
+        else:
+            added.append(e)
+    removed = [e for b in old_buckets.values() for e in b]
     save_baseline(baseline_path, entries)
     print(
         f"baseline updated: {len(entries)} entr"
-        f"{'y' if len(entries) == 1 else 'ies'} -> {baseline_path}"
+        f"{'y' if len(entries) == 1 else 'ies'} -> {baseline_path} "
+        f"({len(added)} added, {len(removed)} removed, "
+        f"{carried} carried)"
     )
+    for e in added:
+        print(f"  + {e.path} [{e.pass_name}/{e.code}] {e.snippet!r}")
+    for e in sorted(
+        removed, key=lambda e: (e.path, e.pass_name, e.code, e.snippet)
+    ):
+        print(f"  - {e.path} [{e.pass_name}/{e.code}] {e.snippet!r}")
 
 
 def main(argv=None) -> int:
@@ -209,6 +235,12 @@ def main(argv=None) -> int:
              "(default BASE: main) plus untracked files",
     )
     ap.add_argument(
+        "--profile", action="store_true",
+        help="print per-pass timing (handler + finish seconds) after the "
+             "run — the budget watch now that the project layer does "
+             "constant propagation",
+    )
+    ap.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite the baseline to grandfather every current finding "
              "(existing justifications are preserved — including across "
@@ -235,11 +267,21 @@ def main(argv=None) -> int:
             targets = args.paths
         result = run_lint(
             root, targets, pass_names=args.passes,
-            baseline_path=baseline_path,
+            baseline_path=baseline_path, profile=args.profile,
         )
     except LintConfigError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    if args.profile and result.timings:
+        width = max(len(n) for n in result.timings)
+        print(f"graftlint --profile: per-pass seconds "
+              f"({result.files_scanned} files)")
+        for name, secs in sorted(
+            result.timings.items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  {name:<{width}}  {secs:8.3f}s")
+        print(f"  {'total':<{width}}  {sum(result.timings.values()):8.3f}s")
 
     if args.update_baseline:
         _update_baseline(result, baseline_path)

@@ -28,6 +28,7 @@ import ast
 import dataclasses
 import json
 import os
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
@@ -217,17 +218,26 @@ _LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
 class Walker:
     """One traversal, all passes.  Handlers fire BEFORE the node's own
     scope is pushed, so `on_FunctionDef` sees the stack of *enclosing*
-    functions only."""
+    functions only.
 
-    def __init__(self, passes: Sequence["LintPass"]):
+    `timings` (pass name -> accumulated seconds) arms per-pass handler
+    profiling for the CLI's `--profile` mode; None (the default) keeps
+    the hot path wrapper-free."""
+
+    def __init__(
+        self,
+        passes: Sequence["LintPass"],
+        timings: Optional[Dict[str, float]] = None,
+    ):
         self._passes = passes
         self._handlers: Dict[str, List] = {}
         for p in passes:
             for attr in dir(p):
                 if attr.startswith("on_"):
-                    self._handlers.setdefault(attr[3:], []).append(
-                        getattr(p, attr)
-                    )
+                    h = getattr(p, attr)
+                    if timings is not None:
+                        h = _timed_handler(h, p.name, timings)
+                    self._handlers.setdefault(attr[3:], []).append(h)
 
     def run(self, ctx: ModuleContext) -> None:
         for p in self._passes:
@@ -268,6 +278,18 @@ class Walker:
             return
         for child in ast.iter_child_nodes(node):
             self._visit(child, ctx)
+
+
+def _timed_handler(h, pass_name: str, timings: Dict[str, float]):
+    def wrapped(node, ctx):
+        t0 = time.perf_counter()
+        try:
+            return h(node, ctx)
+        finally:
+            timings[pass_name] = timings.get(pass_name, 0.0) + (
+                time.perf_counter() - t0
+            )
+    return wrapped
 
 
 # ---------------------------------------------------------------------------
@@ -496,6 +518,9 @@ class LintResult:
     out_of_scope_entries: List[BaselineEntry] = dataclasses.field(
         default_factory=list
     )
+    # pass name -> seconds (handlers + finish), plus the shared
+    # "core:parse+project" entry; populated only under profile=True
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -545,11 +570,13 @@ def run_lint(
     pass_names: Optional[Sequence[str]] = None,
     baseline_path: Optional[str] = None,
     config_overrides: Optional[Dict[str, dict]] = None,
+    profile: bool = False,
 ) -> LintResult:
     """Parse every target file once into a whole-tree Project (symbol
     tables + call graph), run the selected passes over each module, then
     give every pass a `finish(project)` turn for cross-module checks —
-    and reconcile all findings against the grandfathering baseline."""
+    and reconcile all findings against the grandfathering baseline.
+    `profile=True` accumulates per-pass seconds into `result.timings`."""
     from .passes import build_passes
     from .project import Project
 
@@ -558,6 +585,8 @@ def run_lint(
     for p in passes:
         p.bind_sink(findings)
 
+    timings: Optional[Dict[str, float]] = {} if profile else None
+    t_start = time.perf_counter()
     files = iter_target_files(root, paths)
     project = Project(root)
     ctxs: List[ModuleContext] = []
@@ -582,14 +611,23 @@ def run_lint(
         findings.extend(_pragma_findings(ctx))
         ctxs.append(ctx)
     project.finalize()
+    if timings is not None:
+        timings["core:parse+project"] = time.perf_counter() - t_start
     for p in passes:
         p.bind_project(project)
     for ctx in ctxs:
         active = [p for p in passes if p.applies_to(ctx.relpath)]
         if active:
-            Walker(active).run(ctx)
+            Walker(active, timings=timings).run(ctx)
     for p in passes:
-        p.finish(project)
+        if timings is None:
+            p.finish(project)
+        else:
+            t0 = time.perf_counter()
+            p.finish(project)
+            timings[p.name] = timings.get(p.name, 0.0) + (
+                time.perf_counter() - t0
+            )
 
     if baseline_path is None:
         baseline_path = os.path.join(root, BASELINE_NAME)
@@ -627,4 +665,5 @@ def run_lint(
         files_scanned=len(files), pass_names=active_names,
         scanned_paths=sorted(scanned_rels),
         out_of_scope_entries=out_of_scope,
+        timings=timings or {},
     )

@@ -9,8 +9,9 @@ class to `ALL_PASSES`.  Codes are namespaced per pass (GL1xx jit-cache,
 GL2xx trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
 lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
 collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
-span-discipline; GL00x are the core's own: GL001 unparseable file,
-GL002 malformed pragma).
+span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
+lock-order; GL00x are the core's own: GL001 unparseable file, GL002
+malformed pragma).
 """
 
 from __future__ import annotations
@@ -24,8 +25,11 @@ from .compat_import import CompatImportPass
 from .dtype_x64 import DtypeX64Pass
 from .error_discipline import ErrorDisciplinePass
 from .jit_cache import JitCachePass
+from .jit_collision import JitCollisionPass
 from .lock_discipline import LockDisciplinePass
+from .lock_order import LockOrderPass
 from .pallas_shape import PallasShapePass
+from .resource_budget import ResourceBudgetPass
 from .span_discipline import SpanDisciplinePass
 from .trace_purity import TracePurityPass
 from .wire_parity import WireParityPass
@@ -42,6 +46,9 @@ ALL_PASSES = (
     CheckpointCoveragePass,
     WireParityPass,
     SpanDisciplinePass,
+    ResourceBudgetPass,
+    JitCollisionPass,
+    LockOrderPass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

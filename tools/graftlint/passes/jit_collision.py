@@ -1,0 +1,359 @@
+"""jit-cache-collision pass: project-wide compile-cache key hygiene
+(GL13xx).
+
+PR 2's jit-cache pass (GL101-103) polices one file at a time: closures
+rebuilt per call, stringified keys.  What it cannot see is the KEY SPACE
+— several modules share one program cache (`Engine._query_fn_cache` is
+written by engine.py, sparse_exec.py, adaptive_exec.py AND streaming.py),
+and two sites that build structurally-compatible tuples for the same
+cache can hand different programs the same key.  A collision serves the
+wrong compiled program (wrong results); a near-miss churns keys and
+recompiles on the hot path.  This pass enumerates every cache-key
+construction project-wide and checks the key space itself:
+
+* **GL1301 — colliding key shapes.**  Two different key constructions
+  for the same cache (matched by attribute name, e.g.
+  `_query_fn_cache`) whose static shapes can produce EQUAL tuples: same
+  arity (after `+ tuple(...)` extensions make arity flexible) and no
+  position where both sides pin DIFFERENT literals.  The fix is a
+  distinguishing literal tag per key family — `("sparse", ...)` vs
+  `("fused", ...)` can never collide, while `(strategy,) + extra` vs
+  `("sparse", inner, cap, slots)` can (nothing stops `strategy` from
+  ever spelling "sparse").  Identical shapes at multiple sites are NOT
+  findings: same shape = deliberate shared keying.
+* **GL1302 — churning key elements.**  A key containing a
+  per-call-unique value (`id(...)`, `time.*()`, `uuid.*()`,
+  `random.*()`, a fresh `object()`): every call makes a NEW key, the
+  cache never hits, and the entry pile-up is an unbounded leak that
+  recompiles on every query.
+* **GL1303 — duplicate jit wrappers.**  The same project function
+  jit-wrapped at more than one site (two `jax.jit(f)` calls, or a
+  `@jax.jit` decorator plus a later re-wrap): each wrapper owns a
+  separate compile cache, so call sites split across them pay the same
+  trace+compile twice.
+
+Anything unresolvable (dynamic cache objects, keys built in helpers the
+resolver cannot see) stays silent, per the project-layer contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import (
+    LintPass,
+    ModuleContext,
+    call_name,
+    dotted_name,
+    has_jit_decorator,
+)
+
+# signature tokens: exact literal, one unknown element, any-many unknown
+_DYN = "?"
+_OPEN = "*"
+
+# canonical callables whose result is unique per call: a cache key
+# containing one never hits
+_CHURN_CALLS = {
+    "id", "object",
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "uuid.uuid1", "uuid.uuid4",
+    "random.random", "random.randint", "random.randrange",
+    "random.getrandbits",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+
+def _cache_attr(expr: ast.AST) -> Optional[str]:
+    """Last dotted segment of a cache-shaped container name
+    (`self._query_fn_cache` and `eng._query_fn_cache` unify)."""
+    dn = dotted_name(expr)
+    if not dn:
+        return None
+    seg = dn.rsplit(".", 1)[-1]
+    return seg if "cache" in seg.lower() else None
+
+
+def _is_anch(tok) -> bool:
+    return isinstance(tok, tuple) and tok[0] == "anch"
+
+
+class _KeySite:
+    __slots__ = ("ctx", "node", "cache", "tokens", "key_expr", "line")
+
+    def __init__(self, ctx, node, cache, tokens, key_expr):
+        self.ctx = ctx
+        self.node = node
+        self.cache = cache
+        self.tokens = tokens
+        self.key_expr = key_expr
+        self.line = getattr(node, "lineno", 0)
+
+
+def _can_collide(a: Tuple, b: Tuple, i: int = 0, j: int = 0) -> bool:
+    """Can the two token sequences produce an equal tuple?  OPEN matches
+    any run (including empty), DYN matches exactly one element, literals
+    must agree.  Two ANCHOR tokens naming the same builder call
+    (`_query_key(q, ds) + (...)` on both sides) consume each other as a
+    single same-length run — a shared structured-prefix builder pins the
+    suffix alignment, which is what makes literal tags AFTER the prefix
+    distinguishing; an anchor against anything else degrades to OPEN."""
+    if i == len(a) and j == len(b):
+        return True
+    if (
+        i < len(a) and j < len(b)
+        and _is_anch(a[i]) and a[i] == b[j]
+    ):
+        return _can_collide(a, b, i + 1, j + 1)
+    if i < len(a) and (a[i] == _OPEN or _is_anch(a[i])):
+        if _can_collide(a, b, i + 1, j):
+            return True
+        return j < len(b) and _can_collide(a, b, i, j + 1)
+    if j < len(b) and (b[j] == _OPEN or _is_anch(b[j])):
+        if _can_collide(a, b, i, j + 1):
+            return True
+        return i < len(a) and _can_collide(a, b, i + 1, j)
+    if i < len(a) and j < len(b):
+        ai, bj = a[i], b[j]
+        if ai == _DYN or bj == _DYN or ai == bj:
+            return _can_collide(a, b, i + 1, j + 1)
+    return False
+
+
+class JitCollisionPass(LintPass):
+    name = "jit-collision"
+    default_config = {
+        "include": ("spark_druid_olap_tpu/", "bench.py"),
+        # the calibration harness deliberately rebuilds jits per run
+        "exclude": ("spark_druid_olap_tpu/plan/calibrate.py",),
+    }
+
+    # -- key signature extraction --------------------------------------------
+
+    def _resolve_key(self, expr, func, site_line, _depth=0):
+        """Follow a Name to the last expression assigned to it ABOVE
+        the cache-access site (the `key = (...)` / `cache[key]` split).
+        Position matters: a function that builds a second key family
+        further down must not retokenize its earlier sites — that would
+        both fabricate and HIDE collisions."""
+        if _depth > 4 or not isinstance(expr, ast.Name) or func is None:
+            return expr
+        found, found_line = None, -1
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Assign) and (
+                found_line < sub.lineno < site_line
+            ):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name) and t.id == expr.id:
+                        found, found_line = sub.value, sub.lineno
+        if found is None or found is expr:
+            return expr
+        return self._resolve_key(found, func, site_line, _depth + 1)
+
+    def _tokens(self, expr, module, _depth=0) -> Tuple:
+        if _depth > 6:
+            return (_OPEN,)
+        if isinstance(expr, ast.Tuple):
+            out: List = []
+            for e in expr.elts:
+                if isinstance(e, ast.Constant):
+                    out.append(("lit", repr(e.value)))
+                elif isinstance(e, ast.Starred):
+                    out.append(_OPEN)
+                else:
+                    out.append(_DYN)
+            return tuple(out)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return self._tokens(expr.left, module, _depth + 1) + (
+                self._tokens(expr.right, module, _depth + 1)
+            )
+        if isinstance(expr, ast.Constant):
+            return (("lit", repr(expr.value)),)
+        if isinstance(expr, ast.Call):
+            canon = self.project.canonical(module, call_name(expr))
+            if canon:
+                # a named key-builder call: unknown length, but the SAME
+                # builder on two sides pins the suffix alignment
+                return (("anch", canon),)
+        return (_OPEN,)
+
+    def _churn_call(self, expr, module) -> Optional[str]:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                canon = self.project.canonical(module, call_name(sub))
+                if canon in _CHURN_CALLS:
+                    return canon
+        return None
+
+    # -- whole-project analysis ----------------------------------------------
+
+    def finish(self, project) -> None:
+        if project is None:
+            return
+        sites: Dict[str, List[_KeySite]] = {}
+        wraps: Dict[str, List[Tuple[ModuleContext, ast.AST, bool]]] = {}
+        for module in project.modules.values():
+            if not self.applies_to(module.relpath):
+                continue
+            self._collect_module(project, module, sites, wraps)
+        self._check_collisions(sites)
+        self._check_duplicate_wraps(wraps)
+
+    @staticmethod
+    def _module_level_nodes(tree):
+        """Nodes outside every function body (function subtrees are
+        visited per-FunctionInfo so key names resolve in their scope)."""
+        stack = list(ast.iter_child_nodes(tree))
+        while stack:
+            n = stack.pop()
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _collect_module(self, project, module, sites, wraps):
+        ctx = module.ctx
+        scopes = [(None, list(self._module_level_nodes(ctx.tree)))] + [
+            (fi, list(ast.walk(fi.node)))
+            for fi in module.functions.values()
+        ]
+        seen_nodes = set()
+        for fi, nodes in scopes:
+            func = fi.node if fi is not None else None
+            for sub in nodes:
+                cache, key_expr, site_node = None, None, None
+                if isinstance(sub, ast.Subscript):
+                    cache = _cache_attr(sub.value)
+                    key_expr, site_node = sub.slice, sub
+                elif isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and sub.func.attr in ("get", "setdefault", "pop") \
+                        and sub.args:
+                    cache = _cache_attr(sub.func.value)
+                    key_expr, site_node = sub.args[0], sub
+                if cache is not None and id(site_node) not in seen_nodes:
+                    seen_nodes.add(id(site_node))
+                    resolved = self._resolve_key(
+                        key_expr, func,
+                        getattr(site_node, "lineno", 1 << 30),
+                    )
+                    tokens = self._tokens(resolved, module)
+                    # bare-Name caches that are not module-level globals
+                    # are locals/parameters: their key space is private
+                    # to the function, never shared project-wide
+                    ident = cache
+                    base = (
+                        sub.value if isinstance(sub, ast.Subscript)
+                        else sub.func.value
+                    )
+                    if isinstance(base, ast.Name) and (
+                        base.id not in module.constants
+                    ):
+                        qual = fi.qualname if fi is not None else "<module>"
+                        ident = f"{module.relpath}::{qual}::{cache}"
+                    # a signature with no static structure at all (an
+                    # eviction loop variable, a key built elsewhere)
+                    # proves nothing — skip it
+                    informative = any(
+                        tok != _OPEN and not _is_anch(tok)
+                        for tok in tokens
+                    ) or len(tokens) > 1
+                    if informative:
+                        sites.setdefault(ident, []).append(
+                            _KeySite(
+                                ctx, site_node, cache, tokens, resolved
+                            )
+                        )
+                    churn = self._churn_call(resolved, module)
+                    if churn is not None:
+                        self.report(
+                            ctx, site_node, "GL1302",
+                            f"cache key for {cache!r} contains a "
+                            f"per-call-unique value ({churn}()): every "
+                            "call builds a fresh key, the cache never "
+                            "hits, and entries accumulate without bound "
+                            "— key on the stable identity instead",
+                        )
+                # GL1303 collection: jit(f) wrap sites over named
+                # project functions
+                if isinstance(sub, ast.Call) and project.canonical(
+                    module, call_name(sub)
+                ) in ("jax.jit", "jit") and sub.args:
+                    arg = sub.args[0]
+                    raw = arg.id if isinstance(arg, ast.Name) else (
+                        dotted_name(arg)
+                    )
+                    target = project.resolve_function(
+                        module, raw, cls=fi.cls if fi is not None else None,
+                    )
+                    if target is not None:
+                        canon = (
+                            f"{target.module.modname}.{target.qualname}"
+                        )
+                        wraps.setdefault(canon, []).append(
+                            (ctx, sub, has_jit_decorator(target.node))
+                        )
+
+    def _check_collisions(self, sites: Dict[str, List[_KeySite]]):
+        for cache, entries in sorted(sites.items()):
+            # dedup identical shapes: one representative per signature
+            # (same shape at many sites = deliberate shared keying)
+            by_sig: Dict[Tuple, _KeySite] = {}
+            for s in sorted(
+                entries, key=lambda s: (s.ctx.relpath, s.line)
+            ):
+                by_sig.setdefault(s.tokens, s)
+            sigs = list(by_sig.items())
+            reported = set()
+            for i in range(len(sigs)):
+                for j in range(i + 1, len(sigs)):
+                    (tok_a, a), (tok_b, b) = sigs[i], sigs[j]
+                    if not _can_collide(tok_a, tok_b):
+                        continue
+                    # anchor the finding at the later site (usually the
+                    # untagged newcomer), name the earlier one
+                    first, second = sorted(
+                        (a, b), key=lambda s: (s.ctx.relpath, s.line)
+                    )
+                    if id(second.node) in reported:
+                        continue
+                    reported.add(id(second.node))
+                    self.report(
+                        second.ctx, second.node, "GL1301",
+                        f"key for cache {cache!r} can collide with the "
+                        f"key built at {first.ctx.relpath}:{first.line} "
+                        "— no position pins distinct literals, so the "
+                        "two key families can alias and serve the wrong "
+                        "compiled program; give each family a "
+                        "distinguishing literal tag",
+                    )
+
+    def _check_duplicate_wraps(self, wraps):
+        for canon, entries in sorted(wraps.items()):
+            entries = sorted(
+                entries, key=lambda e: (e[0].relpath, e[1].lineno)
+            )
+            decorated = any(dec for _, _, dec in entries)
+            # the first bare wrap of an undecorated function is the
+            # function's one jit identity; every wrap AFTER that (or any
+            # wrap of an already-@jit function) is a second compile cache
+            extras = entries if decorated else entries[1:]
+            for ctx, node, _ in extras:
+                first_ctx, first_node, _ = entries[0]
+                where = (
+                    "a @jax.jit decorator on the function itself"
+                    if decorated
+                    else f"the wrapper at {first_ctx.relpath}:"
+                         f"{first_node.lineno}"
+                )
+                self.report(
+                    ctx, node, "GL1303",
+                    f"{canon} is jit-wrapped here AND by {where}: each "
+                    "wrapper owns a separate compile cache, so call "
+                    "sites split across them re-trace and re-compile "
+                    "the same program — share one wrapped callable",
+                )

@@ -1,0 +1,338 @@
+"""lock-order pass: deadlock cycles and blocking calls under locks
+(GL14xx).
+
+The serving stack is a web of small locks — the breaker, the admission
+pool, the metrics registry and its per-family locks, the tracer ring,
+the LRU caches.  Each is individually correct (lock-discipline/GL5xx
+checks that); what nobody checks is the ORDER they nest in.  A holds its
+lock while publishing a metric (registry lock); if a registry render
+callback ever takes A's lock, two threads deadlock — only under
+concurrent load, never in tests.  This pass builds the project-wide
+lock-acquisition graph and flags:
+
+* **GL1401 — lock-order cycle.**  Lock A is held while lock B is
+  acquired (lexically inside `with A:`, or inside a callee reached
+  through up to `call_depth` levels of intra-project calls), and
+  elsewhere B is held while A is acquired — the classic ABBA deadlock.
+  Lock identity is (owning class, attribute) for `self.<attr>` locks
+  and (module, name) for module-level locks; self-edges are excluded
+  (the caches take their RLock reentrantly on purpose).
+* **GL1402 — blocking call under a lock.**  `time.sleep`,
+  `jax.device_get`, or `.block_until_ready()` reached while a lock is
+  held: every other thread needing that lock now waits out the sleep or
+  a device round-trip (the breaker's backoff sleeping inside its own
+  lock would wedge ALL queries, not just the retried one).
+
+Call-through uses `factories` hints to see through the singleton
+accessor idiom (`get_registry().counter(...)` resolves to
+`MetricsRegistry.counter`); anything else unresolvable stays silent.
+Lock-shaped names are anything whose last segment contains "lock".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import LintPass, call_name, dotted_name
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_BLOCKING_EXACT = {"time.sleep", "jax.device_get"}
+_BLOCKING_SUFFIX = (".block_until_ready",)
+
+
+def _walk_scope(node: ast.AST):
+    """Walk a function's own AST, skipping nested function bodies: code
+    inside a closure does not run when the enclosing function does."""
+    stack = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNC_NODES) and not first:
+            continue
+        first = False
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class LockOrderPass(LintPass):
+    name = "lock-order"
+    default_config = {
+        "include": ("spark_druid_olap_tpu/",),
+        # depth-N call-through: a lock taken three helpers down still
+        # orders against the one held here
+        "call_depth": 3,
+        # singleton-accessor hints: `get_registry().counter(...)`
+        # resolves through the factory's return class (both the defining
+        # module and the obs package re-export spellings)
+        "factories": {
+            "spark_druid_olap_tpu.obs.registry.get_registry":
+                "spark_druid_olap_tpu.obs.registry.MetricsRegistry",
+            "spark_druid_olap_tpu.obs.get_registry":
+                "spark_druid_olap_tpu.obs.registry.MetricsRegistry",
+            "spark_druid_olap_tpu.obs.trace.default_tracer":
+                "spark_druid_olap_tpu.obs.trace.Tracer",
+            "spark_druid_olap_tpu.obs.default_tracer":
+                "spark_druid_olap_tpu.obs.trace.Tracer",
+            "spark_druid_olap_tpu.resilience.injector":
+                "spark_druid_olap_tpu.resilience.FaultInjector",
+        },
+    }
+
+    # -- resolution helpers ---------------------------------------------------
+
+    def _lock_id(self, module, cls, expr) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            return None  # `with make_lock():` — a fresh lock, unordered
+        # bare names: only MODULE-LEVEL locks (or imported ones) have a
+        # stable identity — a `lock` parameter/local names a different
+        # object per call and must stay silent, not unify into
+        # fabricated cycles.  Raw spelling, not dotted_name: that helper
+        # strips the leading underscore `_REG_LOCK` is declared with.
+        if isinstance(expr, ast.Name):
+            raw = expr.id
+            if "lock" not in raw.lower():
+                return None
+            if raw in module.import_aliases:
+                return self.project.canonical(module, raw)
+            if raw in module.constants:
+                return f"{module.modname}.{raw}"
+            return None
+        dn = dotted_name(expr)
+        if not dn:
+            return None
+        last = dn.rsplit(".", 1)[-1]
+        if "lock" not in last.lower():
+            return None
+        if dn.startswith("self."):
+            attr = dn[len("self."):]
+            if "." in attr or cls is None:
+                return None
+            return f"{module.modname}.{cls.name}.{attr}"
+        return None  # `other._lock`: instance untypable, stay silent
+
+    def _resolve_call(self, module, call: ast.Call, cls):
+        name = call_name(call)
+        if name:
+            return self.project.resolve_function(module, name, cls=cls)
+        # `factory().method(...)`
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Call):
+            inner = self.project.canonical(
+                module, call_name(fn.value)
+            )
+            cls_canon = self.config["factories"].get(inner)
+            if cls_canon:
+                modpath, _, clsname = cls_canon.rpartition(".")
+                mod = self.project.by_name.get(modpath)
+                if mod is not None:
+                    return mod.functions.get(f"{clsname}.{fn.attr}")
+        return None
+
+    @staticmethod
+    def _is_blocking(canon: str) -> bool:
+        return canon in _BLOCKING_EXACT or canon.endswith(
+            _BLOCKING_SUFFIX
+        )
+
+    # -- transitive acquire/blocking sets -------------------------------------
+
+    def _locks_of(
+        self, fi, depth: int, _visiting: Set[int]
+    ) -> Tuple[Set[str], bool]:
+        """(locks a function acquires — lexically plus callees to depth,
+        context-independent?).  A result computed while a caller was
+        being cycle-pruned depends on WHICH caller was on the path, so
+        only clean (unpruned) results enter the memo — a pruned partial
+        set cached during one scan must never hide lock edges from an
+        unrelated one."""
+        key = (id(fi), depth)
+        cached = self._locks_memo.get(key)
+        if cached is not None:
+            return cached, True
+        out: Set[str] = set()
+        clean = True
+        module, cls = fi.module, fi.cls
+        for n in _walk_scope(fi.node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    lid = self._lock_id(module, cls, item.context_expr)
+                    if lid is not None:
+                        out.add(lid)
+            elif isinstance(n, ast.Call) and depth > 0:
+                target = self._resolve_call(module, n, cls)
+                if target is None:
+                    continue
+                if id(target) in _visiting or target is fi:
+                    clean = False  # cycle-pruned: partial result
+                    continue
+                sub, sub_clean = self._locks_of(
+                    target, depth - 1, _visiting | {id(fi)}
+                )
+                out |= sub
+                clean = clean and sub_clean
+        if clean:
+            self._locks_memo[key] = out
+        return out, clean
+
+    def _blocking_of(
+        self, fi, depth: int, _visiting: Set[int]
+    ) -> Tuple[Optional[str], bool]:
+        key = (id(fi), depth)
+        if key in self._blocking_memo:
+            return self._blocking_memo[key], True
+        out: Optional[str] = None
+        clean = True
+        module, cls = fi.module, fi.cls
+        for n in _walk_scope(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            canon = self.project.canonical(module, call_name(n))
+            if self._is_blocking(canon):
+                out = canon
+                break
+            if depth > 0:
+                target = self._resolve_call(module, n, cls)
+                if target is None:
+                    continue
+                if id(target) in _visiting or target is fi:
+                    clean = False
+                    continue
+                found, sub_clean = self._blocking_of(
+                    target, depth - 1, _visiting | {id(fi)}
+                )
+                clean = clean and sub_clean
+                if found is not None:
+                    out = found
+                    break
+        if clean:
+            self._blocking_memo[key] = out
+        return out, clean
+
+    # -- whole-project analysis ----------------------------------------------
+
+    def finish(self, project) -> None:
+        if project is None:
+            return
+        self._locks_memo: Dict = {}
+        self._blocking_memo: Dict = {}
+        depth = int(self.config["call_depth"])
+        # edges: (held, acquired) -> first site (ctx, node, via)
+        edges: Dict[Tuple[str, str], Tuple] = {}
+        for relpath in sorted(project.modules):
+            module = project.modules[relpath]
+            if not self.applies_to(relpath):
+                continue
+            for qual in sorted(module.functions):
+                self._scan_function(
+                    module, module.functions[qual], depth, edges
+                )
+        adj: Dict[str, Set[str]] = {}
+        for held, acquired in edges:
+            adj.setdefault(held, set()).add(acquired)
+        for (held, acquired), (ctx, node, via) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].relpath, kv[1][1].lineno)
+        ):
+            path = self._path(adj, acquired, held)
+            if path is None:
+                continue
+            cycle = " -> ".join([held, acquired] + path[1:])
+            self.report(
+                ctx, node, "GL1401",
+                f"lock-order cycle: {cycle} — here {held} is held while "
+                f"{acquired} is acquired{via}, and the reverse order "
+                "exists elsewhere in the project; two threads taking the "
+                "ends concurrently deadlock.  Pick one global order (or "
+                "publish outside the lock)",
+            )
+
+    def _scan_function(self, module, fi, depth, edges):
+        """Single descent over the function tracking the FULL held-lock
+        stack: a blocking call under nested locks reports ONCE with the
+        whole held set, and every (held, acquired) pair becomes one
+        edge — not one partial finding per enclosing `with`."""
+        self._descend(module, fi, fi.node, [], depth, edges)
+
+    def _descend(self, module, fi, node, held, depth, edges):
+        ctx, cls = module.ctx, fi.cls
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                continue  # a closure body does not run under the with
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                ids = [
+                    self._lock_id(module, cls, item.context_expr)
+                    for item in child.items
+                ]
+                ids = [i for i in ids if i is not None]
+                for lid in ids:
+                    for h in held:
+                        if lid != h:
+                            edges.setdefault(
+                                (h, lid), (ctx, child, " directly")
+                            )
+                self._descend(
+                    module, fi, child, held + ids, depth, edges
+                )
+                continue
+            if isinstance(child, ast.Call) and held:
+                self._check_call_under(
+                    module, fi, held, child, depth, edges, ctx
+                )
+            self._descend(module, fi, child, held, depth, edges)
+
+    def _check_call_under(self, module, fi, held, sub, depth, edges, ctx):
+        cls = fi.cls
+        canon = self.project.canonical(module, call_name(sub))
+        if self._is_blocking(canon):
+            self.report(
+                ctx, sub, "GL1402",
+                f"blocking call {canon}() while holding "
+                f"{' + '.join(held)} — every thread needing the "
+                "lock now waits out the sleep/device round-trip; "
+                "release the lock first",
+            )
+            return
+        if depth <= 0:
+            return  # lexical-only contract: no call-through
+        target = self._resolve_call(module, sub, cls)
+        if target is None:
+            return
+        via = (
+            f" via {target.module.modname}.{target.qualname}()"
+        )
+        acquired, _ = self._locks_of(target, depth - 1, {id(fi)})
+        for lid in acquired:
+            for h in held:
+                if lid != h:
+                    edges.setdefault((h, lid), (ctx, sub, via))
+        blocking, _ = self._blocking_of(target, depth - 1, {id(fi)})
+        if blocking is not None:
+            self.report(
+                ctx, sub, "GL1402",
+                f"call reaches blocking {blocking}() (inside "
+                f"{target.module.modname}.{target.qualname}) while "
+                f"holding {' + '.join(held)} — every thread needing "
+                "the lock waits out the sleep/device round-trip; "
+                "release the lock first",
+            )
+
+    @staticmethod
+    def _path(adj, src: str, dst: str) -> Optional[List[str]]:
+        """Shortest src -> dst lock path (BFS), None when unreachable."""
+        if src == dst:
+            return [src]
+        seen = {src}
+        frontier = [[src]]
+        while frontier:
+            nxt = []
+            for path in frontier:
+                for n in sorted(adj.get(path[-1], ())):
+                    if n in seen:
+                        continue
+                    if n == dst:
+                        return path + [n]
+                    seen.add(n)
+                    nxt.append(path + [n])
+            frontier = nxt
+        return None

@@ -1,0 +1,370 @@
+"""resource-budget pass: kernel tile footprints vs the calibrated VMEM
+budget (GL12xx).
+
+An oversized Pallas tile does not fail in CPU interpret mode — it fails
+on real hardware, at lowering time, after a full CI cycle passed (the
+round-1/2 lesson: the kernel's block tuning notes literally say
+"ESTIMATED ... not yet validated on hardware").  The budget the tiles
+must fit is a *measured device property*, so this pass validates against
+the calibrated config, not a guess (the same pushdown-to-where-cost-is-
+known argument the cost model follows):
+
+* **GL1201 — kernel resident bytes exceed the platform VMEM budget.**
+  For every `pl.pallas_call` whose BlockSpec block shapes resolve
+  statically (through the project layer's constant propagation: module
+  constants, arithmetic, parameter defaults, cross-module imports), the
+  per-kernel resident estimate is
+
+      pipeline_factor x sum(prod(block_shape) x dtype_width per ref)
+
+  — every ref is double-buffered by the Pallas pipeline
+  (pipeline_factor=2), dtype widths come from `out_shape` for outputs
+  and floor at 1 byte for inputs (no static dtype source; the narrowest
+  real element keeps the estimate a true lower bound).  The budget is
+  resolved in
+  order: pass config override -> `calibration.<platform>.json`'s
+  `vmem_budget_bytes` (the calibrated device constant) -> the scanned
+  `config.py`'s `SessionConfig.vmem_budget_mb` default -> a built-in
+  16 MiB/core fallback (the v5e-class figure from the Pallas guide).
+  The estimate is a LOWER bound (kernel-internal intermediates like
+  match tiles are invisible), so exceeding it is always a real finding.
+* **GL1202 — degenerate grid.**  A grid axis that const-resolves to
+  <= 0 (`G // BG` with `G < BG` is the classic): the kernel dispatches
+  zero tiles over that axis and silently aggregates nothing.
+* **GL1203 — degenerate block shape.**  A BlockSpec dimension that
+  resolves to <= 0 — an empty tile is never what a kernel author meant.
+
+All checks stay silent when a shape cannot be proven: only EXACTLY
+resolved spec sets are checked against the budget (an upper-bound guess
+would cry wolf on every dynamically-tuned kernel).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import LintPass, ModuleContext, call_name, dotted_name
+from .pallas_shape import _is_blockspec, _is_pallas_call
+
+# dtype name (last dotted segment) -> byte width
+_DTYPE_WIDTH = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+}
+# unknown dtypes (inputs have no static dtype source) count at 1 byte:
+# the narrowest real element keeps the resident estimate a TRUE lower
+# bound, so a GL1201 exceedance can never be an artifact of the guess
+_DEFAULT_WIDTH = 1
+
+
+class ResourceBudgetPass(LintPass):
+    name = "resource-budget"
+    default_config = {
+        # the deploy platform whose calibrated budget gates the tree
+        "platform": "tpu",
+        # explicit byte override (tests); None = resolve the chain below
+        "budget_bytes": None,
+        "calibration_file": "calibration.{platform}.json",
+        # fallback: the scanned session config's declared budget field
+        "config_module": "spark_druid_olap_tpu/config.py",
+        "config_class": "SessionConfig",
+        "config_field": "vmem_budget_mb",
+        # last resort: ~16 MiB/core, the v5e-class VMEM figure
+        "default_budget_bytes": 16 * 1024 * 1024,
+        # every ref is double-buffered by the Pallas pipeline
+        "pipeline_factor": 2,
+    }
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__(config)
+        self._budget: Optional[Tuple[int, str]] = None  # (bytes, source)
+
+    # -- budget resolution ----------------------------------------------------
+
+    def _resolve_budget(self) -> Tuple[int, str]:
+        """(budget_bytes, human-readable source), memoized per run."""
+        if self._budget is not None:
+            return self._budget
+        cfg = self.config
+        if cfg.get("budget_bytes"):
+            self._budget = (int(cfg["budget_bytes"]), "pass config")
+            return self._budget
+        platform = cfg["platform"]
+        fname = cfg["calibration_file"].format(platform=platform)
+        root = self.project.root if self.project is not None else "."
+        path = os.path.join(root, fname)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            v = doc.get("vmem_budget_bytes")
+            if isinstance(v, (int, float)) and v > 0:
+                self._budget = (int(v), fname)
+                return self._budget
+        except (OSError, ValueError):
+            pass
+        # scanned config.py class default (MiB), via constant propagation
+        if self.project is not None:
+            mod = self.project.modules.get(cfg["config_module"])
+            if mod is not None:
+                expr = mod.class_defaults.get(
+                    cfg["config_class"], {}
+                ).get(cfg["config_field"])
+                v = self.project.const_eval(mod, expr)
+                if isinstance(v, (int, float)) and v > 0:
+                    self._budget = (
+                        int(v * 1024 * 1024),
+                        f"{cfg['config_module']}:"
+                        f"{cfg['config_class']}.{cfg['config_field']}",
+                    )
+                    return self._budget
+        self._budget = (
+            int(cfg["default_budget_bytes"]), "built-in v5e-class default"
+        )
+        return self._budget
+
+    # -- static environment ---------------------------------------------------
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        self._fi_by_node: Dict[int, Any] = {}
+        if self.project is None:
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is not None:
+            for fi in module.functions.values():
+                self._fi_by_node[id(fi.node)] = fi
+
+    @staticmethod
+    def _own_binding_nodes(func: ast.AST):
+        """The function's OWN binding statements in source order —
+        nested function/lambda subtrees are a different scope and must
+        not leak bindings into this one."""
+        def walk(node, is_root):
+            if not is_root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return
+            if isinstance(
+                node, (ast.Assign, ast.AugAssign, ast.For, ast.AsyncFor)
+            ):
+                yield node
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, False)
+
+        yield from walk(func, True)
+
+    def _env_for_site(
+        self, ctx: ModuleContext, site: ast.AST
+    ) -> Dict[str, Any]:
+        """const_eval env at the pallas_call site: parameter defaults of
+        every enclosing function (outer first) overlaid with that
+        function's own single-assignment locals ABOVE the site — a
+        rebinding after the call, or in a sibling nested function, was
+        never in effect here and must not flip a verdict.  Names the
+        walk cannot track honestly (AugAssign-ed, loop targets,
+        tuple-unpacked, or assigned more than once above the site —
+        branch-dependent values) are POISONED as UNRESOLVED so they
+        neither guess a value nor fall through to a same-named module
+        constant (the never-guess contract)."""
+        from ..project import UNRESOLVED
+
+        site_line = getattr(site, "lineno", 0)
+        env: Dict[str, Any] = {}
+        for func in ctx.scope.func_stack:
+            fi = self._fi_by_node.get(id(func))
+            if fi is not None:
+                env.update(self.project.param_defaults(fi))
+            assigned: set = set()
+            for sub in self._own_binding_nodes(func):
+                if sub.lineno >= site_line:
+                    continue
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            env[t.id] = (
+                                UNRESOLVED if t.id in assigned
+                                else sub.value
+                            )
+                            assigned.add(t.id)
+                        else:  # tuple/list unpacking, subscripts, attrs
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    env[n.id] = UNRESOLVED
+                else:  # AugAssign / For targets mutate in place
+                    target = getattr(sub, "target", None)
+                    if target is not None:
+                        for n in ast.walk(target):
+                            if isinstance(n, ast.Name):
+                                env[n.id] = UNRESOLVED
+        return env
+
+    # -- entry ----------------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        if self.project is None:
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is None:
+            return
+        canon = self.project.canonical(module, call_name(node))
+        if not _is_pallas_call(canon):
+            return
+        env = self._env_for_site(ctx, node)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+
+        # GL1202: degenerate grid axes
+        grid = kw.get("grid")
+        if grid is not None:
+            axes = self._grid_axes(module, grid, env)
+            for i, v in enumerate(axes):
+                if isinstance(v, int) and v <= 0:
+                    self.report(
+                        ctx, node, "GL1202",
+                        f"grid axis {i} resolves to {v}: the kernel "
+                        "dispatches zero tiles over it and silently "
+                        "aggregates nothing (a floor-divided extent "
+                        "smaller than its block size is the classic "
+                        "shape)",
+                    )
+
+        # block shapes + dtypes per ref
+        out_dtypes = self._out_dtypes(module, kw.get("out_shape"), env)
+        in_blocks = self._spec_shapes(module, kw.get("in_specs"), env)
+        out_blocks = self._spec_shapes(module, kw.get("out_specs"), env)
+        if in_blocks is None and out_blocks is None:
+            return
+        refs: List[Tuple[Optional[Tuple[int, ...]], int]] = []
+        exact = in_blocks is not None and out_blocks is not None
+        for shape in in_blocks or []:
+            refs.append((shape, _DEFAULT_WIDTH))
+            exact = exact and shape is not None
+        for i, shape in enumerate(out_blocks or []):
+            width = _DTYPE_WIDTH.get(
+                out_dtypes[i].rsplit(".", 1)[-1]
+                if i < len(out_dtypes) else "", _DEFAULT_WIDTH,
+            )
+            refs.append((shape, width))
+            exact = exact and shape is not None
+
+        # GL1203: degenerate block dims (checked per resolved spec even
+        # when the full set stays unresolved)
+        for shape, _ in refs:
+            if shape is not None and any(d <= 0 for d in shape):
+                self.report(
+                    ctx, node, "GL1203",
+                    f"BlockSpec block shape {shape} has a dimension "
+                    "<= 0 — an empty tile reads/writes nothing",
+                )
+
+        # GL1201: resident-bytes estimate vs the calibrated budget —
+        # only when EVERY spec resolved (a partial estimate would be a
+        # guess, and guesses get pragma'd into uselessness)
+        if not exact or not refs:
+            return
+        factor = int(self.config["pipeline_factor"])
+        total = sum(
+            self._prod(shape) * width for shape, width in refs
+        )
+        resident = factor * total
+        budget, source = self._resolve_budget()
+        if resident > budget:
+            breakdown = " + ".join(
+                f"{'x'.join(str(d) for d in shape)}*{width}B"
+                for shape, width in refs
+            )
+            self.report(
+                ctx, node, "GL1201",
+                f"kernel resident estimate {resident} bytes "
+                f"({factor}x double-buffered: {breakdown}) exceeds the "
+                f"{budget}-byte VMEM budget from {source} — this tile "
+                "set fails Mosaic on real hardware even though CPU "
+                "interpret mode passes; shrink the block shapes or "
+                "split the refs",
+            )
+
+    # -- shape resolution -----------------------------------------------------
+
+    @staticmethod
+    def _prod(shape: Tuple[int, ...]) -> int:
+        out = 1
+        for d in shape:
+            out *= d
+        return out
+
+    def _grid_axes(self, module, grid, env) -> List[Any]:
+        if isinstance(grid, ast.Name) and isinstance(
+            env.get(grid.id), ast.AST
+        ):
+            grid = env[grid.id]
+        if isinstance(grid, (ast.Tuple, ast.List)):
+            return [
+                self.project.const_eval(module, e, dict(env))
+                for e in grid.elts
+            ]
+        v = self.project.const_eval(module, grid, dict(env))
+        if isinstance(v, tuple):
+            return list(v)
+        return [v]
+
+    def _resolve_seq(self, module, node, env) -> Optional[List[ast.AST]]:
+        """Spec-list elements: a literal tuple/list, a local name bound
+        to one, or a single BlockSpec call (the out_specs shorthand)."""
+        if isinstance(node, ast.Name) and isinstance(
+            env.get(node.id), ast.AST
+        ):
+            node = env[node.id]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return list(node.elts)
+        if isinstance(node, ast.Call):
+            return [node]
+        return None
+
+    def _spec_shapes(
+        self, module, specs, env
+    ) -> Optional[List[Optional[Tuple[int, ...]]]]:
+        """Per-spec resolved block shapes; None entries = that spec is
+        unresolved; None overall = the spec LIST itself is unresolved."""
+        if specs is None:
+            return None
+        elts = self._resolve_seq(module, specs, env)
+        if elts is None:
+            return None
+        shapes: List[Optional[Tuple[int, ...]]] = []
+        for e in elts:
+            shape = None
+            if isinstance(e, ast.Call) and _is_blockspec(
+                self.project.canonical(module, call_name(e))
+            ):
+                shape_expr = e.args[0] if e.args else None
+                for k in e.keywords:
+                    if k.arg == "block_shape":
+                        shape_expr = k.value
+                v = self.project.const_eval(
+                    module, shape_expr, dict(env)
+                )
+                if isinstance(v, tuple) and all(
+                    isinstance(d, int) for d in v
+                ):
+                    shape = v
+            shapes.append(shape)
+        return shapes
+
+    def _out_dtypes(self, module, out_shape, env) -> List[str]:
+        if out_shape is None:
+            return []
+        elts = self._resolve_seq(module, out_shape, env)
+        if elts is None:
+            return []
+        dtypes = []
+        for e in elts:
+            dt = ""
+            if isinstance(e, ast.Call) and len(e.args) > 1:
+                dt = self.project.canonical(
+                    module, dotted_name(e.args[1])
+                ) or ""
+            dtypes.append(dt)
+        return dtypes

@@ -21,9 +21,20 @@ This module gives passes the project-level facts those checks need:
   * **Call graph** — intra-project call edges per function qualname
     (best-effort: bare names, import aliases, `self.method`).
   * **Flow layer** — `reaches_call(...)`: does this statement body reach
-    a call matching a predicate, lexically or through ONE level of
-    intra-project calls?  That is the depth the checkpoint-coverage and
-    kernel passes need without whole-program dataflow.
+    a call matching a predicate, lexically or through intra-project
+    calls up to a CONFIGURABLE depth?  PR 3 hardcoded one level; the v3
+    passes (lock-order builds a whole acquisition graph, deep helpers
+    carry checkpoints) take the depth from pass config, and the
+    traversal memoizes explored functions so depth >= 2 stays linear in
+    the call graph instead of exponential in paths.
+  * **Constant propagation** — `const_eval(...)`: a mini-evaluator that
+    resolves tile/block-size-shaped expressions to concrete values:
+    literals, module constants (cross-module through imports), class
+    attribute defaults (`SessionConfig.vmem_budget_mb`), arithmetic on
+    resolved values, `min`/`max`/`len`, conditional expressions, and
+    tuple/subscript structure.  The resource-budget pass (GL12xx) feeds
+    it BlockSpec shapes and grid expressions; anything it cannot prove
+    stays unresolved (None), never guessed.
 
 Everything here is best-effort static resolution: when a name cannot be
 resolved the answer is "unknown" and passes are expected to stay silent
@@ -34,11 +45,19 @@ dynamic code gets pragma'd into uselessness.
 from __future__ import annotations
 
 import ast
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .core import ModuleContext, call_name, dotted_name
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# sentinel distinguishing "statically unresolvable" from a literal None.
+# Passes may place UNRESOLVED in a const_eval env to POISON a name they
+# know is not statically trackable (AugAssign-ed locals, loop-mutated
+# tuning knobs) — resolution stops there instead of falling through to a
+# same-named module constant.
+_UNRESOLVED = object()
+UNRESOLVED = _UNRESOLVED
 
 
 def module_name_for(relpath: str) -> str:
@@ -90,6 +109,10 @@ class ModuleInfo:
         self.functions: Dict[str, FunctionInfo] = {}
         self.classes: Dict[str, ast.ClassDef] = {}
         self.class_attrs: Dict[str, Set[str]] = {}
+        # class-BODY `name = <expr>` / `name: T = <expr>` defaults (the
+        # dataclass-field shape `SessionConfig.vmem_budget_mb` resolves
+        # through), keyed by class name then attribute
+        self.class_defaults: Dict[str, Dict[str, ast.expr]] = {}
         # every Name id and Attribute attr in the module — the cheap
         # "does this module reference symbol X at all" query wire-parity
         # style passes need
@@ -150,6 +173,18 @@ class ModuleInfo:
                 # closures are resolved lexically by reaches_call instead
             elif isinstance(stmt, ast.ClassDef):
                 self.classes[stmt.name] = c = stmt
+                defaults: Dict[str, ast.expr] = {}
+                for sub in c.body:
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                defaults[t.id] = sub.value
+                    elif isinstance(sub, ast.AnnAssign) and (
+                        sub.value is not None
+                        and isinstance(sub.target, ast.Name)
+                    ):
+                        defaults[sub.target.id] = sub.value
+                self.class_defaults[stmt.name] = defaults
                 attrs: Set[str] = set()
                 for sub in ast.walk(c):
                     if (
@@ -232,6 +267,12 @@ class Project:
             if alias and alias != dotted:
                 return self._entry_by_canonical(alias, _depth + 1)
             return None
+        # `Cls.attr` against a class defined in (or imported into) scope
+        head, _, attr = dotted.partition(".")
+        if "." not in attr and head in module.class_defaults:
+            expr = module.class_defaults[head].get(attr)
+            if expr is not None:
+                return module, expr
         return self._entry_by_canonical(
             self.canonical(module, dotted), _depth + 1
         )
@@ -249,9 +290,18 @@ class Project:
     ) -> Optional[Tuple[ModuleInfo, ast.expr]]:
         modpath, _, sym = canon.rpartition(".")
         target = self.by_name.get(modpath)
-        if target is None or not sym:
-            return None
-        return self.resolve_constant_entry(target, sym, depth)
+        if target is not None and sym:
+            return self.resolve_constant_entry(target, sym, depth)
+        # `pkg.mod.Cls.attr`: a class-body default (the dataclass-field
+        # shape budget/config constants live in)
+        if "." in modpath and sym:
+            outer, _, clsname = modpath.rpartition(".")
+            mod2 = self.by_name.get(outer)
+            if mod2 is not None:
+                expr = mod2.class_defaults.get(clsname, {}).get(sym)
+                if expr is not None:
+                    return mod2, expr
+        return None
 
     def resolve_string(
         self, module: ModuleInfo, node: ast.AST
@@ -320,12 +370,16 @@ class Project:
         pred: Callable[[str, str], bool],
         depth: int = 1,
         cls: Optional[ast.ClassDef] = None,
+        _explored: Optional[Dict[int, int]] = None,
     ) -> bool:
         """True when `body` contains a call matching `pred(raw_name,
-        canonical_name)` — lexically, or (depth permitting) inside the
-        body of an intra-project callee.  One level of call-through is
-        the contract the checkpoint-coverage pass is specified against:
-        helpers may carry the checkpoint, helpers-of-helpers may not."""
+        canonical_name)` — lexically, or inside the body of an
+        intra-project callee reached through at most `depth` levels of
+        call-through.  The depth is the PASS's contract (checkpoint
+        coverage defaults to 1: helpers may carry the checkpoint,
+        helpers-of-helpers may not; lock-order walks deeper), and the
+        traversal memoizes (function, remaining depth) so depth >= 2
+        explores each function once, not once per path."""
         for node in ast.walk(body):
             if not isinstance(node, ast.Call):
                 continue
@@ -336,6 +390,8 @@ class Project:
                 return True
         if depth <= 0:
             return False
+        if _explored is None:
+            _explored = {}
         for node in ast.walk(body):
             if not isinstance(node, ast.Call):
                 continue
@@ -343,9 +399,207 @@ class Project:
             if not name:
                 continue
             target = self.resolve_function(module, name, cls=cls)
-            if target is not None and self.reaches_call(
+            if target is None:
+                continue
+            # re-explore only with MORE remaining depth than last time
+            # (a failed shallow visit proves nothing about a deeper one;
+            # a failed deep visit covers every shallower one)
+            if _explored.get(id(target), -1) >= depth:
+                continue
+            _explored[id(target)] = depth
+            if self.reaches_call(
                 target.module, target.node, pred,
-                depth=depth - 1, cls=target.cls,
+                depth=depth - 1, cls=target.cls, _explored=_explored,
             ):
                 return True
         return False
+
+    # -- constant propagation -------------------------------------------------
+
+    _BINOPS = {
+        ast.Add: lambda a, b: a + b,
+        ast.Sub: lambda a, b: a - b,
+        ast.Mult: lambda a, b: a * b,
+        ast.Div: lambda a, b: a / b,
+        ast.FloorDiv: lambda a, b: a // b,
+        ast.Mod: lambda a, b: a % b,
+        ast.Pow: lambda a, b: a ** b,
+        ast.LShift: lambda a, b: a << b,
+        ast.RShift: lambda a, b: a >> b,
+    }
+    _CMPOPS = {
+        ast.Eq: lambda a, b: a == b,
+        ast.NotEq: lambda a, b: a != b,
+        ast.Lt: lambda a, b: a < b,
+        ast.LtE: lambda a, b: a <= b,
+        ast.Gt: lambda a, b: a > b,
+        ast.GtE: lambda a, b: a >= b,
+    }
+    _CALLS = {
+        "min": min, "max": max, "abs": abs, "len": len, "sum": sum,
+        "int": int, "float": float, "bool": bool, "round": round,
+    }
+
+    def const_eval(
+        self,
+        module: ModuleInfo,
+        expr: Optional[ast.AST],
+        env: Optional[Dict[str, Any]] = None,
+        _depth: int = 0,
+    ) -> Any:
+        """Best-effort static value of an expression: int/float/str/bool
+        literals, tuples/lists (as tuples), module constants resolved
+        cross-module, class-body defaults, arithmetic / comparisons /
+        `min`/`max`/`len`/`abs` over resolved values, conditional
+        expressions, and constant subscripts of resolved tuples.
+
+        `env` maps local names to already-known values OR to ast
+        expressions still to evaluate (how passes feed parameter
+        defaults and local assignments in).  Returns None when the value
+        cannot be proven (a literal `None` also returns None — shapes
+        and budgets, the intended domain, are never legitimately None)."""
+        v = self._eval(module, expr, env or {}, _depth)
+        return None if v is _UNRESOLVED else v
+
+    def _eval(self, module, expr, env, depth) -> Any:
+        if expr is None or depth > 40:
+            return _UNRESOLVED
+        if isinstance(expr, ast.Constant):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                bound = env[expr.id]
+                if isinstance(bound, ast.AST):
+                    # evaluate once, cache the result (also breaks
+                    # self-referential `x = x + 1` chains)
+                    env[expr.id] = _UNRESOLVED
+                    env[expr.id] = self._eval(module, bound, env, depth + 1)
+                return env[expr.id]
+            entry = self.resolve_constant_entry(module, expr.id)
+            if entry is None:
+                return _UNRESOLVED
+            owner, bound = entry
+            return self._eval(owner, bound, {}, depth + 1)
+        if isinstance(expr, ast.Attribute):
+            dn = dotted_name(expr)
+            if not dn:
+                return _UNRESOLVED
+            entry = self.resolve_constant_entry(module, dn)
+            if entry is None:
+                return _UNRESOLVED
+            owner, bound = entry
+            return self._eval(owner, bound, {}, depth + 1)
+        if isinstance(expr, ast.UnaryOp):
+            v = self._eval(module, expr.operand, env, depth + 1)
+            if v is _UNRESOLVED:
+                return _UNRESOLVED
+            try:
+                if isinstance(expr.op, ast.USub):
+                    return -v
+                if isinstance(expr.op, ast.UAdd):
+                    return +v
+                if isinstance(expr.op, ast.Not):
+                    return not v
+            except TypeError:
+                return _UNRESOLVED
+            return _UNRESOLVED
+        if isinstance(expr, ast.BinOp):
+            fn = self._BINOPS.get(type(expr.op))
+            if fn is None:
+                return _UNRESOLVED
+            a = self._eval(module, expr.left, env, depth + 1)
+            b = self._eval(module, expr.right, env, depth + 1)
+            if a is _UNRESOLVED or b is _UNRESOLVED:
+                return _UNRESOLVED
+            try:
+                return fn(a, b)
+            except (TypeError, ValueError, ZeroDivisionError,
+                    OverflowError):
+                return _UNRESOLVED
+        if isinstance(expr, ast.Compare):
+            left = self._eval(module, expr.left, env, depth + 1)
+            if left is _UNRESOLVED:
+                return _UNRESOLVED
+            for op, comparator in zip(expr.ops, expr.comparators):
+                fn = self._CMPOPS.get(type(op))
+                right = self._eval(module, comparator, env, depth + 1)
+                if fn is None or right is _UNRESOLVED:
+                    return _UNRESOLVED
+                try:
+                    if not fn(left, right):
+                        return False
+                except TypeError:
+                    return _UNRESOLVED
+                left = right
+            return True
+        if isinstance(expr, ast.BoolOp):
+            values = [
+                self._eval(module, v, env, depth + 1) for v in expr.values
+            ]
+            if any(v is _UNRESOLVED for v in values):
+                return _UNRESOLVED
+            if isinstance(expr.op, ast.And):
+                for v in values:
+                    if not v:
+                        return v
+                return values[-1]
+            for v in values:
+                if v:
+                    return v
+            return values[-1]
+        if isinstance(expr, ast.IfExp):
+            test = self._eval(module, expr.test, env, depth + 1)
+            if test is _UNRESOLVED:
+                return _UNRESOLVED
+            branch = expr.body if test else expr.orelse
+            return self._eval(module, branch, env, depth + 1)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = []
+            for e in expr.elts:
+                v = self._eval(module, e, env, depth + 1)
+                if v is _UNRESOLVED:
+                    return _UNRESOLVED
+                out.append(v)
+            return tuple(out)
+        if isinstance(expr, ast.Subscript):
+            base = self._eval(module, expr.value, env, depth + 1)
+            idx = self._eval(module, expr.slice, env, depth + 1)
+            if base is _UNRESOLVED or idx is _UNRESOLVED:
+                return _UNRESOLVED
+            try:
+                return base[idx]
+            except (TypeError, KeyError, IndexError):
+                return _UNRESOLVED
+        if isinstance(expr, ast.Call):
+            fn = self._CALLS.get(self.canonical(module, call_name(expr)))
+            if fn is None or expr.keywords:
+                return _UNRESOLVED
+            args = [
+                self._eval(module, a, env, depth + 1) for a in expr.args
+            ]
+            if any(a is _UNRESOLVED for a in args):
+                return _UNRESOLVED
+            try:
+                return fn(*args)
+            except (TypeError, ValueError):
+                return _UNRESOLVED
+        return _UNRESOLVED
+
+    def param_defaults(self, fi: FunctionInfo) -> Dict[str, Any]:
+        """Statically-evaluable parameter defaults of a function, as a
+        const_eval env: the values a call site that omits the argument
+        gets (how block_rows/block_groups-style tuning knobs resolve)."""
+        env: Dict[str, Any] = {}
+        a = fi.node.args
+        pos = a.posonlyargs + a.args
+        for arg, default in zip(pos[len(pos) - len(a.defaults):],
+                                a.defaults):
+            v = self.const_eval(fi.module, default)
+            if v is not None:
+                env[arg.arg] = v
+        for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+            if default is not None:
+                v = self.const_eval(fi.module, default)
+                if v is not None:
+                    env[arg.arg] = v
+        return env

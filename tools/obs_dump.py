@@ -3,7 +3,11 @@
 Input: a span-tree JSON produced by the obs/ tracer — either a single
 trace document ({"query_id", "total_ms", "spans": {...}}), a bench
 detail artifact (BENCH_*_detail.json; every per-query "span_tree" found
-is printed), or a raw span node.  Sources:
+is printed), or a raw span node.  Span EVENTS (`@ breaker_state ...`)
+render under their span with their trace-relative timestamp.  A
+`/status` document (or a bare registry to_dict) additionally yields a
+"histogram exemplars" table: the bucket -> trace_id links that jump
+from a hot latency bucket to its span tree in one hop.  Sources:
 
     python -m tools.obs_dump BENCH_tpu_ssb_1_detail.json
     python -m tools.obs_dump trace.json
@@ -84,6 +88,16 @@ def render_trace(trace: dict, label: str = "") -> str:
         lines.append(
             f"{name:<28} {start:>8.2f} {dur:>8.2f}ms {pct:>6.1f}%{suffix}"
         )
+        for e in node.get("events", ()):
+            eattrs = " ".join(
+                f"{k}={v}"
+                for k, v in sorted((e.get("attrs") or {}).items())
+            )
+            ename = "  " * (depth + 1) + f"@ {e.get('name', '?')}"
+            lines.append(
+                f"{ename:<28} {float(e.get('at_ms', 0.0)):>8.2f}"
+                f"{'':>10} {'':>7}  {eattrs}".rstrip()
+            )
         for c in node.get("children", ()):
             walk(c, depth + 1)
 
@@ -91,10 +105,47 @@ def render_trace(trace: dict, label: str = "") -> str:
     return "\n".join(lines)
 
 
+def _find_exemplars(doc: Any) -> List[Tuple[str, str, str, dict]]:
+    """(metric, labels, le, exemplar) rows from a `/status` metrics
+    document (the registry's to_dict shape): the one-hop link from a
+    latency bucket to the trace id that last landed in it."""
+    rows: List[Tuple[str, str, str, dict]] = []
+    if not isinstance(doc, dict):
+        return rows
+    families = doc.get("metrics", doc)  # /status doc or bare to_dict()
+    if not isinstance(families, dict):
+        return rows
+    for metric, fam in families.items():
+        if not isinstance(fam, dict) or fam.get("type") != "histogram":
+            continue
+        for labels, entry in (fam.get("values") or {}).items():
+            if not isinstance(entry, dict):
+                continue
+            for le, ex in (entry.get("exemplars") or {}).items():
+                rows.append((str(metric), str(labels), str(le), ex))
+    return rows
+
+
+def render_exemplars(rows: List[Tuple[str, str, str, dict]]) -> str:
+    lines = ["histogram exemplars (bucket -> trace)"]
+    lines.append(
+        f"{'metric':<26} {'labels':<12} {'le':>8}  trace_id / value"
+    )
+    for metric, labels, le, ex in rows:
+        lines.append(
+            f"{metric:<26} {labels:<12} {le:>8}  "
+            f"{ex.get('trace_id', '?')}  ({ex.get('value', '?')}ms)"
+        )
+    return "\n".join(lines)
+
+
 def dump(doc: Any) -> str:
     out = []
     for label, trace in _find_traces(doc):
         out.append(render_trace(trace, label))
+    exemplars = _find_exemplars(doc)
+    if exemplars:
+        out.append(render_exemplars(exemplars))
     if not out:
         return "no span trees found in input"
     return "\n\n".join(out)
