@@ -102,6 +102,20 @@ class TPUOlapContext:
         # CREATE VIEW registry: view name -> defining SELECT text; the
         # parser expands references as derived tables
         self.views: Dict[str, str] = {}
+        # real-time ingestion tier (ingest/): streamed appends -> delta
+        # segments, plus the versioned background compactor.  Retired
+        # segment uids (compaction, dictionary-extension remaps) evict
+        # from the engine's device residency immediately.
+        from .ingest import Compactor, IngestManager
+
+        self.ingest = IngestManager(self.catalog, self.config)
+        self.ingest.on_segments_dropped = self._on_segments_dropped
+        self.compactor = Compactor(
+            self.ingest,
+            rows_per_segment=self.config.compaction_rows_per_segment,
+            min_delta_rows=self.config.compaction_min_delta_rows,
+            interval_s=self.config.compaction_interval_s,
+        )
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -210,8 +224,9 @@ class TPUOlapContext:
         )
         if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
             star_schema = StarSchemaInfo.from_json(star_schema)
-        self.catalog.put(ds, star_schema)
-        return ds
+        # put() stamps the monotonic per-datasource version; return the
+        # stamped snapshot so callers observe the same object queries see
+        return self.catalog.put(ds, star_schema)
 
     def register_datasource(self, ds: DataSource, star_schema=None):
         """Register an ALREADY-BUILT DataSource (streamed/chunked ingest via
@@ -219,8 +234,50 @@ class TPUOlapContext:
         catalog.persist) under its own name."""
         if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
             star_schema = StarSchemaInfo.from_json(star_schema)
-        self.catalog.put(ds, star_schema)
-        return ds
+        return self.catalog.put(ds, star_schema)
+
+    # -- streamed ingest (the Druid realtime-node analog, ISSUE 6) ----------
+
+    def append_rows(self, name: str, rows) -> dict:
+        """Append streamed rows (list of row dicts or a column mapping) to
+        a registered datasource.  The rows are queryable by the very next
+        query — delta partials merge with historical partials through the
+        same mergeable-aggregate machinery every executor already uses.
+        Returns an ack: {"appended", "datasourceVersion", "totalRows"}."""
+        with self.tracer.query_trace(
+            query_type="ingest", slow_ms=self.config.slow_query_ms
+        ):
+            return self.ingest.append_rows(name, rows)
+
+    def compact(self, name: str) -> dict:
+        """Roll `name`'s delta segments into tiled historical segments now
+        (the background compactor does this on a sweep; this is the
+        synchronous entry point).  Query results are preserved verbatim;
+        the datasource version bumps so result caches invalidate."""
+        with self.tracer.query_trace(
+            query_type="compaction", slow_ms=self.config.slow_query_ms
+        ):
+            return self.compactor.compact(name)
+
+    def start_compaction(self):
+        """Start the background compaction sweep (daemon thread)."""
+        self.compactor.start()
+        return self
+
+    def stop_compaction(self):
+        self.compactor.stop()
+
+    def _on_segments_dropped(self, uids):
+        self.engine.evict_segments(uids)
+        # the fallback's decoded-frame cache keys on the same uids
+        from .exec.fallback import evict_decoded_segments
+
+        evict_decoded_segments(uids)
+        if self._dist_engine is not None:
+            # shard assemblies key on the full segment-uid signature, so
+            # stale entries can never be SERVED — but they still pin HBM
+            # until LRU pressure; a retired segment set clears them now
+            self._dist_engine.clear_cache()
 
     def register_lookup(self, name: str, mapping: Mapping[str, str]):
         """Register a query-time lookup table (Druid lookup extraction):
@@ -248,8 +305,7 @@ class TPUOlapContext:
         # and a star-less load over an existing starred table must not keep
         # the stale star (it describes different data)
         self.catalog.drop(ds.name)
-        self.catalog.put(ds, star)
-        return ds
+        return self.catalog.put(ds, star)
 
     def drop_table(self, name: str):
         self.catalog.drop(name)
@@ -622,10 +678,14 @@ class TPUOlapContext:
                 lp, self.catalog, max_rows=self.config.fallback_max_rows,
                 device_exec=device_subplan,
             )
+        from .exec.fallback import plan_tables
+
+        tables = sorted(plan_tables(lp))
         m = QueryMetrics(
             query_type="fallback",
             strategy="host-pandas",
             executor="device+fallback" if assists["n"] else "fallback",
+            datasource=tables[0] if len(tables) == 1 else "",
             query_id=current_query_id(),
             rows_scanned=plan_input_rows(lp, self.catalog),
             total_ms=(_time.perf_counter() - t0) * 1e3,
@@ -650,6 +710,11 @@ class TPUOlapContext:
 
         return (
             rw.to_json(),
+            # the monotonic segment-set version (catalog/cache.py): every
+            # append and every compaction bumps it, so a cached frame can
+            # never outlive the segment set that produced it — the
+            # invalidation contract the ingestion tier publishes
+            self.catalog.datasource_version(rw.datasource),
             schema_signature(ds),
             repr(rw.output_columns),
             repr(rw.grouping_sets),

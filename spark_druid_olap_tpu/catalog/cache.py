@@ -12,6 +12,7 @@ mirrors the reference's clear-metadata-cache SQL command.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Dict, Optional
 
@@ -29,6 +30,11 @@ class MetadataCache:
         # monotonically bumped on every mutation; plan caches key on it so a
         # re-registered table invalidates cached rewrites
         self.version = 0
+        # per-datasource segment-set version (ISSUE 6): monotonic across
+        # registration, delta appends, and compaction — NEVER reset by a
+        # re-register, so result caches keyed on it can't collide across a
+        # drop/re-create cycle.  `put` stamps it onto the DataSource.
+        self._ds_versions: Dict[str, int] = {}
 
     def put_lookup(self, name: str, mapping: dict):
         with self._lock:
@@ -40,11 +46,26 @@ class MetadataCache:
             return self._lookups.get(name)
 
     def put(self, ds: DataSource, star: Optional[StarSchemaInfo] = None):
+        """Publish a datasource snapshot.  The ONLY write path for tables:
+        every publish bumps the per-datasource version and stamps it on
+        the (immutable) DataSource, so downstream caches observe each
+        segment-set change — the invalidation hook compaction and the
+        result cache share.  Returns the stamped DataSource."""
         with self._lock:
+            v = self._ds_versions.get(ds.name, 0) + 1
+            self._ds_versions[ds.name] = v
+            ds = dataclasses.replace(ds, version=v)
             self._tables[ds.name] = ds
             if star is not None:
                 self._stars[ds.name] = star
             self.version += 1
+        return ds
+
+    def datasource_version(self, name: str) -> int:
+        """Monotonic segment-set version of a datasource (0 = never
+        registered).  Survives drop/re-register."""
+        with self._lock:
+            return self._ds_versions.get(name, 0)
 
     def get(self, name: str) -> Optional[DataSource]:
         with self._lock:

@@ -248,11 +248,41 @@ class Segment:
 
 
 @dataclasses.dataclass(frozen=True)
+class DeltaSegment(Segment):
+    """An append-only delta shard (ISSUE 6): rows that arrived via streamed
+    ingest and have not been compacted into tiled historical segments yet.
+
+    Same columnar layout and immutability contract as `Segment` — one
+    published DeltaSegment never mutates; each append batch publishes its
+    own delta segment(s) under the ingest lock and compaction later rolls
+    them up — so every executor (engine, sparse/adaptive tiers, mesh, host
+    fallback) merges its partials through the exact cross-segment
+    machinery historical segments use.
+    The subclass exists so compaction and observability can tell the two
+    tiers apart; `seq` orders deltas within a datasource."""
+
+    seq: int = 0
+
+
+def as_delta(seg: Segment, seq: int) -> DeltaSegment:
+    """Rewrap a built Segment as a DeltaSegment (same arrays, same uid)."""
+    return DeltaSegment(
+        **{f.name: getattr(seg, f.name) for f in dataclasses.fields(seg)},
+        seq=seq,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class DataSource:
     """A named datasource: schema + dictionaries + a list of segments.
 
     Analog of the reference's `DruidDataSource` metadata + segment list
     (SURVEY.md §2 metadata cache row, `[U]`).
+
+    `version` is the monotonic segment-set version (stamped by
+    `catalog.cache.MetadataCache.put`): every publish — registration,
+    delta append, compaction — bumps it, and result/plan caches key on it
+    so a compacted or appended datasource can never serve a stale frame.
     """
 
     name: str
@@ -260,6 +290,7 @@ class DataSource:
     dicts: Mapping[str, DimensionDict]
     segments: Tuple[Segment, ...]
     time_column: Optional[str] = None
+    version: int = 0
 
     @property
     def num_rows(self) -> int:
@@ -279,6 +310,94 @@ class DataSource:
         if not ivs:
             return None
         return (min(i[0] for i in ivs), max(i[1] for i in ivs))
+
+    def delta_segments(self) -> Tuple["DeltaSegment", ...]:
+        return tuple(
+            s for s in self.segments if isinstance(s, DeltaSegment)
+        )
+
+    def historical_segments(self) -> Tuple[Segment, ...]:
+        return tuple(
+            s for s in self.segments if not isinstance(s, DeltaSegment)
+        )
+
+    @property
+    def delta_rows(self) -> int:
+        return sum(s.num_rows for s in self.delta_segments())
+
+
+# ---------------------------------------------------------------------------
+# Dictionary extension + code remap (the streamed-ingest novel-value path)
+# ---------------------------------------------------------------------------
+
+
+def extend_dict(
+    old: DimensionDict, new_values
+) -> Tuple[DimensionDict, Optional[np.ndarray]]:
+    """Extend a sorted dictionary with `new_values` (novel values only are
+    added), returning `(new_dict, lut)` where `lut[old_code] = new_code`.
+
+    Both domains are sorted, and the old domain is a subset of the new, so
+    the LUT is STRICTLY MONOTONE — code order keeps meaning value order,
+    which is what lets (a) zone maps remap as `(lut[min], lut[max])` and
+    (b) range-filter pushdown keep translating bounds into code space.
+    `lut` is None when nothing was novel (the overwhelmingly common append:
+    dictionaries converge after the first few batches)."""
+    novel = [
+        v for v in set(new_values)
+        if not _is_null(v) and old.code_of(v) is None
+    ]
+    if not novel:
+        return old, None
+    if old.numeric_values is not None or (
+        not old.values and all(
+            isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+            for v in novel
+        )
+    ):
+        merged = sorted({int(v) for v in old.values} | {int(v) for v in novel})
+    else:
+        merged = sorted({str(v) for v in old.values} | {str(v) for v in novel})
+    new = DimensionDict(values=tuple(merged))
+    # vectorized old->new LUT via the new dictionary's own encoders (the
+    # per-value code_of loop was O(card^2) for string domains — a single
+    # novel value on a 1M-value dimension stalled the append for minutes
+    # while holding the ingest lock)
+    if not old.values:
+        lut = np.empty(1, dtype=np.int32)
+    elif new.numeric_values is not None:
+        lut = new.encode_numeric(np.asarray(old.values, dtype=np.int64))
+    else:
+        lut = new.encode(list(old.values))
+    return new, lut
+
+
+def remap_segment_codes(
+    seg: Segment,
+    luts: Mapping[str, np.ndarray],
+    cards: Mapping[str, int],
+) -> Segment:
+    """A segment with the dimension columns in `luts` re-encoded into the
+    extended code space (`new = lut[old]`, nulls stay NULL_ID) and its
+    code-space zone maps shifted through the same (monotone) LUTs.
+
+    Returns a NEW segment with a fresh uid — device-residency and program
+    caches key on uid, so stale codes can never be served from cache."""
+    dims = dict(seg.dims)
+    stats = dict(seg.stats) if seg.stats is not None else None
+    for name, lut in luts.items():
+        if name not in dims:
+            continue
+        codes = np.asarray(dims[name])
+        dtype = code_dtype(cards[name])
+        out = np.where(codes >= 0, lut[np.maximum(codes, 0)], NULL_ID)
+        dims[name] = out.astype(dtype, copy=False)
+        if stats is not None and name in stats:
+            lo, hi = stats[name]
+            stats[name] = (float(lut[int(lo)]), float(lut[int(hi)]))
+    return dataclasses.replace(
+        seg, dims=dims, stats=stats, uid=next(_SEGMENT_UIDS)
+    )
 
 
 def schema_datasource(
@@ -321,14 +440,21 @@ def compute_segment_stats(
 ) -> Dict[str, Tuple[float, float]]:
     """Per-column (min, max) zone maps over real rows; dimension columns in
     code space with nulls (code < 0) excluded."""
+    # padding is a suffix by construction (_pad_rows), so "real rows" is a
+    # slice, not a boolean gather — the gather copied every column once and
+    # was the single hottest line of bulk ingest (ISSUE 6 profile)
+    n_real = int(valid.sum())
+    sliced = bool(valid[:n_real].all())
     out: Dict[str, Tuple[float, float]] = {}
     for d, codes in dims.items():
-        c = np.asarray(codes)[valid]
+        c = np.asarray(codes)
+        c = c[:n_real] if sliced else c[valid]
         c = c[c >= 0]
         if len(c):
             out[d] = (float(c.min()), float(c.max()))
     for m, vals in metrics.items():
-        v = np.asarray(vals)[valid]
+        v = np.asarray(vals)
+        v = v[:n_real] if sliced else v[valid]
         if len(v):
             out[m] = (float(v.min()), float(v.max()))
     return out

@@ -187,6 +187,23 @@ class SessionConfig:
     retry_max_attempts: int = 2
     retry_backoff_ms: float = 25.0
 
+    # -- real-time ingestion tier (ingest/) ---------------------------------
+    # rows per published delta segment before an append batch splits; the
+    # floor is catalog.segment.ROW_PAD (padding granularity)
+    delta_seal_rows: int = 1 << 16
+    # background compaction: sweep period and the delta-row backlog below
+    # which a datasource is left alone (compacting single tiny deltas
+    # would churn versions — and result caches — for nothing)
+    compaction_interval_s: float = 5.0
+    compaction_min_delta_rows: int = 1 << 15
+    # rows per historical segment compaction emits
+    compaction_rows_per_segment: int = 1 << 19
+    # ingest admission: a SEPARATE small slot pool so streamed appends
+    # (encode + possible dictionary-extension remap) can't starve query
+    # slots, and a query burst can't starve ingest
+    max_concurrent_ingests: int = 2
+    ingest_queue_timeout_ms: int = 2000
+
     # -- observability (obs/) -----------------------------------------------
     # slow-query log: a finished query whose span-tree total exceeds this
     # logs the rendered tree at WARNING through utils/log.py; 0 disables

@@ -49,7 +49,13 @@ from ..catalog.segment import DataSource
 from ..models import query as Q
 from ..utils.log import get_logger
 from .finalize import finalize_groupby
-from .lowering import GroupByLowering, ResolvedDim, _query_key, empty_partials
+from .lowering import (
+    GroupByLowering,
+    ResolvedDim,
+    _query_key,
+    empty_partials,
+    memo_key,
+)
 
 log = get_logger("exec.adaptive")
 
@@ -385,8 +391,25 @@ class AdaptiveDomainMixin:
     ) -> Optional[List[np.ndarray]]:
         """Phase A: measure (or recall) per-dim present code sets.  Returns
         None when compaction should be declined for this query."""
-        qkey = _query_key(q, ds)
-        kept = self._adaptive_kept.get(qkey)
+        # The memo keys segment-set-independently (lowering.memo_key) so
+        # continuous streamed ingest neither forgets query shapes nor
+        # leaks one entry per published delta — but a MEASURED kept set
+        # is only valid for the exact segment set it scanned (a fresh
+        # delta may contain codes the old scan never saw; reusing the
+        # stale set would silently drop those rows), so measured entries
+        # carry their segment signature and miss-and-REPLACE when it
+        # moved.  Dictionary-DERIVED kept sets are supersets by
+        # construction and stay valid across appends (only a dictionary
+        # extension, which changes the memo key, retires them).
+        qkey = memo_key(q, ds)
+        seg_sig = tuple(s.uid for s in segs)
+        entry = self._adaptive_kept.get(qkey)
+        kept = None
+        if entry is not None:
+            if entry[0] == "derived":
+                kept = entry[1]
+            elif entry[1] == seg_sig:
+                kept = entry[2]
         if kept is None:
             # dictionary-derived shortcut: when the filter itself pins
             # every grouping dim, phase A needs NO device pass at all —
@@ -394,7 +417,7 @@ class AdaptiveDomainMixin:
             # full presence scan (and its dispatch round-trip)
             kept = filter_derived_kept(q, lowering, ds)
             if kept is not None:
-                self._adaptive_kept[qkey] = kept
+                self._adaptive_kept[qkey] = ("derived", kept)
         if kept is None:
             need = self._presence_columns(q, lowering, ds)
 
@@ -446,7 +469,7 @@ class AdaptiveDomainMixin:
                 np.nonzero(np.asarray(c) > 0)[0].astype(np.int32)
                 for c in counts
             ]
-            self._adaptive_kept[qkey] = kept
+            self._adaptive_kept[qkey] = ("measured", seg_sig, kept)
         Gc = 1
         for kd in kept:
             Gc *= len(kd)

@@ -45,6 +45,7 @@ from .lowering import (  # noqa: F401
     empty_partials,
     groupby_with_time_granularity,
     lower_groupby,
+    memo_key,
     schema_signature,
     timeseries_to_groupby,
     topn_to_groupby,
@@ -444,6 +445,15 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         self._lowering_cache.clear()
         self._query_fn_cache.clear()
 
+    def evict_segments(self, uids) -> None:
+        """Drop device residency of specific segments — the ingestion
+        tier's hook: compaction (and dictionary-extension remaps) retire
+        segment uids from the published set, and their HBM should come
+        back immediately rather than waiting for LRU pressure."""
+        uids = set(uids)
+        for k in [k for k in self._device_cache if k[0] in uids]:
+            self._device_cache.pop(k)
+
     def _segment_batches(self, segs, names):
         """Split in-scope segments into dispatch batches: each batch becomes
         ONE fused program call.  Bounded by MULTI_SEGMENT_UNROLL_MAX (compile
@@ -825,10 +835,14 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             q = groupby_with_time_granularity(q)
             lowering = self._lowering_for(q, ds)
             segs = self._segments_in_scope(q, ds)
-        qkey = _query_key(q, ds)
+        # learned-memo identity: segment-set independent (memo_key), so a
+        # streamed append neither forgets learned rungs nor grows the
+        # memo dicts per batch
+        qkey = memo_key(q, ds)
         m = self._m = QueryMetrics(
             query_type="groupBy",
             strategy=self._resolve_strategy(lowering.num_groups),
+            datasource=ds.name,
             query_id=current_query_id(),
             rows_scanned=sum(s.num_rows for s in segs),
             bytes_scanned=_bytes_scanned(segs, lowering.columns),

@@ -85,6 +85,41 @@ def fallback_agg_fn(agg: A.Aggregation) -> str:
     )
 
 
+# Per-(segment, column) decoded-array cache: the streamed-ingest tier made
+# the fallback's input INCREMENTAL (a delta append adds one small segment
+# to an otherwise unchanged set), so re-decoding every historical segment
+# per fallback query — the pre-ingest behavior — re-pays exactly the work
+# that did not change.  Keys carry the segment uid and the dictionary's
+# content_key: an append hits for historical segments and decodes only the
+# fresh deltas; a dictionary extension (new content_key) or compaction
+# (new uids) misses cleanly.  Byte-budgeted LRU; object arrays meter at
+# pointer width, which undercounts string storage — the decoded VALUES are
+# shared with the dictionary tuples, so pointers are the marginal cost.
+_DECODE_CACHE_BYTES = 1 << 30
+_decode_cache = None
+
+
+def _decoded_segment_cache():
+    global _decode_cache
+    if _decode_cache is None:
+        from ..utils.lru import ByteBudgetCache
+
+        _decode_cache = ByteBudgetCache(_DECODE_CACHE_BYTES)
+    return _decode_cache
+
+
+def evict_decoded_segments(uids) -> None:
+    """Drop decoded-frame entries for retired segment uids — called from
+    the same segment-drop hook that evicts device residency (compaction
+    and dictionary-extension remaps retire uids; their dead decode
+    entries would otherwise squat in the LRU displacing live ones)."""
+    if _decode_cache is None:
+        return
+    uids = set(uids)
+    for k in [k for k in _decode_cache if k[0] in uids]:
+        _decode_cache.pop(k)
+
+
 def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     """Real rows of a datasource as a pandas frame: dimensions decoded to
     values, metrics as float64, time as int64 ms.  `columns` restricts the
@@ -97,24 +132,33 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     # `partial` fault mode truncates every segment's decode to a fraction —
     # the deterministic torn-result shape watchdog/flush tests need
     frac = injector().partial_fraction("fallback_decode")
+    cache = _decoded_segment_cache() if frac is None else None
     out: Dict[str, np.ndarray] = {}
     with span(SPAN_FALLBACK_DECODE, datasource=ds.name):
         for c in ds.columns:
             if columns is not None and c.name not in columns:
                 continue
+            dict_key = (
+                ds.dicts[c.name].content_key if c.name in ds.dicts else None
+            )
             parts = []
             for seg in ds.segments:
                 # per-(column, segment) decode is the fallback's unit of
                 # work; checkpointing inside the segment loop keeps the
                 # deadline granularity finer than whole-column decodes
                 checkpoint("fallback.decode")
-                arr = np.asarray(seg.column(c.name))[seg.valid]
-                if c.name in ds.dicts:
-                    arr = ds.dicts[c.name].decode(arr)
-                elif arr.dtype.kind == "f":
-                    arr = arr.astype(np.float64)
-                if frac is not None:
-                    arr = arr[: int(len(arr) * frac)]
+                ckey = (seg.uid, "decoded", c.name, dict_key)
+                arr = cache.get(ckey) if cache is not None else None
+                if arr is None:
+                    arr = np.asarray(seg.column(c.name))[seg.valid]
+                    if c.name in ds.dicts:
+                        arr = ds.dicts[c.name].decode(arr)
+                    elif arr.dtype.kind == "f":
+                        arr = arr.astype(np.float64)
+                    if frac is not None:
+                        arr = arr[: int(len(arr) * frac)]
+                    if cache is not None:
+                        cache[ckey] = arr
                 parts.append(arr)
             out[c.name] = (
                 np.concatenate(parts) if parts else np.array([], dtype=object)

@@ -470,16 +470,39 @@ def schema_signature(ds: DataSource) -> Tuple:
     the cache (compiled filters bake in literal->code translations)."""
     return (
         ds.name,
-        tuple(
-            (
-                c.name,
-                c.kind,
-                c.cardinality,
-                ds.dicts[c.name].content_key if c.name in ds.dicts else None,
-            )
-            for c in ds.columns
-        ),
+        _dict_signature(ds),
         tuple(s.uid for s in ds.segments),
+    )
+
+
+def _dict_signature(ds: DataSource) -> Tuple:
+    return tuple(
+        (
+            c.name,
+            c.kind,
+            c.cardinality,
+            ds.dicts[c.name].content_key if c.name in ds.dicts else None,
+        )
+        for c in ds.columns
+    )
+
+
+def memo_key(q: Q.QuerySpec, ds: DataSource) -> Tuple:
+    """Segment-set-INDEPENDENT identity of (query, datasource schema) for
+    the engine's LEARNED memos (sparse capacity rungs, adaptive kept
+    sets, sparse-overflow pins).  Unlike `_query_key`, the segment uid
+    tuple is excluded: a streamed append publishes a new segment set
+    every batch, and keying memos on uids would (a) forget every learned
+    rung per append and (b) grow the memo dicts without bound under
+    continuous ingest.  Dictionary content stays in the key — a
+    dictionary extension changes cardinalities/code meanings, which is
+    exactly when a learned rung goes stale."""
+    import json as _json
+
+    return (
+        _json.dumps(q.to_druid(), sort_keys=True, default=str),
+        ds.name,
+        _dict_signature(ds),
     )
 
 

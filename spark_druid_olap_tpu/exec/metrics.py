@@ -37,6 +37,9 @@ from typing import Optional
 class QueryMetrics:
     query_type: str = ""
     strategy: str = ""
+    # datasource the query scanned — labels the per-datasource traffic
+    # counters (obs/registry.py, behind the label-cardinality guard)
+    datasource: str = ""
     # the query's end-to-end id (obs/trace.py): set by the server boundary
     # (Druid's context.queryId when the client sent one) or generated at
     # the api layer; correlates this snapshot with its span tree in the

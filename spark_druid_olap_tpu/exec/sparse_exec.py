@@ -24,7 +24,7 @@ from ..catalog.segment import DataSource
 from ..models import query as Q
 from ..utils.log import get_logger
 from .finalize import finalize_groupby
-from .lowering import GroupByLowering, _query_key
+from .lowering import GroupByLowering, _query_key, memo_key
 
 log = get_logger("exec.sparse")
 
@@ -191,7 +191,9 @@ class SparseExecMixin:
             ]:
                 self._query_fn_cache.pop(k)
 
-        qkey = _query_key(q, ds)
+        # learned rungs key segment-set-independently (see lowering.memo_key):
+        # appends must not forget them or leak one entry per delta publish
+        qkey = memo_key(q, ds)
         from ..ops import sparse_groupby as _sg
 
         # tier 1: filter-compacted sort.  The initial capacity rung comes

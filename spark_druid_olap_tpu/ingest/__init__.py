@@ -1,0 +1,30 @@
+"""Real-time ingestion tier (ISSUE 6 / ROADMAP direction 2).
+
+Three cooperating layers, the Druid realtime-node analog rebuilt for a
+TPU-resident catalog:
+
+* `ingest.shard` — **parallel sharded bulk ingest**: per-shard,
+  per-column workers feeding the existing dictionary encoder
+  (`catalog/segment.py`), with a deterministic sorted-union dictionary
+  merge across shards, so bulk load scales with cores AND the per-row
+  encode cost drops (factorize-once instead of per-row string
+  searchsorted).
+* `ingest.delta` — **append-only delta segments**: `append_rows` encodes
+  streamed rows into `DeltaSegment`s published through the catalog, so
+  fresh rows are queryable immediately; every executor merges delta
+  partials with historical partials through the same mergeable-aggregate
+  machinery the mesh and fallback paths already use.
+* `ingest.compact` — **versioned background compaction**: deltas roll
+  into tiled, padded historical segments; each publish bumps the
+  per-datasource segment-set version (`catalog.cache`), which result and
+  program caches key on.
+"""
+
+from .compact import Compactor  # noqa: F401
+from .delta import IngestManager  # noqa: F401
+from .shard import (  # noqa: F401
+    build_datasource_sharded,
+    encode_dimension,
+    merge_shard_values,
+    sharded_ingest_workers,
+)

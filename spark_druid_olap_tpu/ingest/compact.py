@@ -1,0 +1,219 @@
+"""Versioned background compaction (ISSUE 6 tentpole layer (c)).
+
+Delta segments are deliberately small (appends must be cheap and visible
+immediately), but a query over N tiny deltas pays N segments of dispatch
+and padding overhead.  The compactor rolls a datasource's accumulated
+`DeltaSegment`s into tiled, padded HISTORICAL segments — the same
+`rows_per_segment`-sized, zone-mapped shards bulk ingest produces — and
+publishes the swap through `MetadataCache.put`, which bumps the
+datasource's monotonic segment-set version.  Result and program caches
+key on that version / the segment uid set, so a compaction invalidates
+exactly what it must (the hook ROADMAP direction 1's result cache
+consumes), while the row set — and therefore every query answer — is
+preserved verbatim.
+
+Compaction runs under the SAME per-datasource ingest lock appends use:
+an append and a compaction can never interleave their read-modify-write
+of the segment list.  Queries never block — they hold immutable
+snapshots.  Dropped delta uids feed the engine-eviction hook so device
+residency is reclaimed promptly instead of waiting for LRU pressure.
+
+The background worker is a daemon thread with a cooperative stop event;
+every sweep honors deadline checkpoints (`resilience.checkpoint`) — the
+graftlint ingest-discipline pass (GL1502) enforces that contract on
+these loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..catalog.segment import (
+    DataSource,
+    DeltaSegment,
+    Segment,
+    build_datasource,
+)
+from ..obs import SPAN_COMPACT, record_compaction, span
+from ..resilience import checkpoint
+from ..utils.log import get_logger
+from .delta import IngestManager
+
+log = get_logger("ingest.compact")
+
+
+class Compactor:
+    """Rolls delta segments into historical segments, with an optional
+    background sweep thread."""
+
+    def __init__(
+        self,
+        ingest: IngestManager,
+        rows_per_segment: int = 1 << 19,
+        min_delta_rows: int = 0,
+        interval_s: float = 5.0,
+        min_delta_segments: int = 64,
+    ):
+        self.ingest = ingest
+        self.rows_per_segment = int(rows_per_segment)
+        self.min_delta_rows = int(min_delta_rows)
+        # a trickle of tiny appends accretes SEGMENTS (each padded to
+        # ROW_PAD) long before it accretes rows — the sweep must gate on
+        # both, or a 1-row/s feed would pile up padded deltas forever
+        # while staying under the row threshold
+        self.min_delta_segments = max(1, int(min_delta_segments))
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.compactions_total = 0
+
+    # -- one datasource ------------------------------------------------------
+
+    def compact(self, name: str) -> dict:
+        """Compact `name`'s delta segments now.  Returns a summary dict
+        ({"compacted_rows": 0, ...} when there was nothing to do)."""
+        buf = self.ingest.buffer(name)
+        with buf._lock, span(SPAN_COMPACT, datasource=name):
+            ds = self.ingest.catalog.get(name)
+            if ds is None:
+                raise KeyError(f"unknown datasource {name!r}")
+            deltas = ds.delta_segments()
+            if not deltas:
+                return {
+                    "datasource": name,
+                    "compacted_rows": 0,
+                    "delta_segments": 0,
+                    "datasourceVersion": ds.version,
+                }
+            rolled, absorbed = self._roll(ds, deltas)
+            keep = list(ds.historical_segments())
+            if absorbed:  # _roll only ever absorbs the undersized tail
+                keep = keep[: -len(absorbed)]
+            base = len(keep)
+            segments: List[Segment] = keep + [
+                dataclasses.replace(
+                    s, segment_id=f"{name}_{base + i:06d}"
+                )
+                for i, s in enumerate(rolled)
+            ]
+            published = self.ingest.catalog.put(
+                dataclasses.replace(ds, segments=tuple(segments))
+            )
+            dropped = frozenset(
+                s.uid for s in list(deltas) + list(absorbed)
+            )
+            self.ingest._dropped(dropped)
+        with self._lock:
+            self.compactions_total += 1
+        n_rows = sum(s.num_rows for s in deltas)
+        record_compaction(name, n_rows, len(deltas))
+        log.info(
+            "compacted %s: %d delta segments (%d rows) -> %d historical",
+            name, len(deltas), n_rows, len(rolled),
+        )
+        return {
+            "datasource": name,
+            "compacted_rows": n_rows,
+            "delta_segments": len(deltas),
+            "historical_segments_out": len(rolled),
+            "datasourceVersion": published.version,
+        }
+
+    def _roll(
+        self, ds: DataSource, deltas: Tuple[DeltaSegment, ...]
+    ) -> Tuple[List[Segment], List[Segment]]:
+        """Concatenate delta rows (plus an undersized historical tail, so
+        repeated append/compact cycles converge to full tiles instead of
+        accreting slivers) and re-segment them at `rows_per_segment`.
+        Codes are already global — this is pure array splicing, no
+        re-encode.  Returns (new historical segments, absorbed tail)."""
+        absorbed: List[Segment] = []
+        hist = list(ds.historical_segments())
+        if hist and hist[-1].num_rows < self.rows_per_segment // 2:
+            absorbed.append(hist[-1])
+        parts: List[Segment] = absorbed + list(deltas)
+        dim_names = [c.name for c in ds.columns if c.is_dimension]
+        met_names = [c.name for c in ds.columns if c.is_metric]
+        cols = {}
+        for name in dim_names + met_names:
+            pieces = []
+            for s in parts:
+                # O(delta rows) splice: keep the deadline honest while a
+                # large backlog drains (ingest-discipline/GL1502)
+                checkpoint("compact.splice_segment")
+                pieces.append(np.asarray(s.column(name))[s.valid])
+            cols[name] = np.concatenate(pieces)
+        if ds.time_column is not None:
+            pieces = []
+            for s in parts:
+                checkpoint("compact.splice_segment")
+                pieces.append(np.asarray(s.time)[s.valid])
+            cols[ds.time_column] = np.concatenate(pieces)
+        part = build_datasource(
+            ds.name,
+            cols,
+            dimension_cols=dim_names,
+            metric_cols=met_names,
+            time_col=ds.time_column,
+            rows_per_segment=self.rows_per_segment,
+            dicts=dict(ds.dicts),
+        )
+        return list(part.segments), absorbed
+
+    # -- background sweep ----------------------------------------------------
+
+    def run_pending(self) -> List[dict]:
+        """One sweep: compact every datasource whose delta backlog meets
+        `min_delta_rows` OR whose delta SEGMENT count meets
+        `min_delta_segments` (tiny-append trickles accrete padded
+        segments, not rows).  Safe to call concurrently with appends."""
+        out = []
+        for name in self.ingest.catalog.tables():
+            checkpoint("compact.sweep_datasource")
+            ds = self.ingest.catalog.get(name)
+            if ds is None:
+                continue
+            pending = ds.delta_rows
+            n_segs = len(ds.delta_segments())
+            if pending and (
+                pending >= self.min_delta_rows
+                or n_segs >= self.min_delta_segments
+            ):
+                try:
+                    out.append(self.compact(name))
+                except Exception:  # fault-ok: one table must not stop the sweep
+                    log.warning(
+                        "background compaction of %s failed", name,
+                        exc_info=True,
+                    )
+        return out
+
+    def start(self) -> "Compactor":
+        """Start the background sweep thread (idempotent)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="sdol-compactor", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.run_pending()
+            except Exception:  # fault-ok: the sweep must survive any table
+                log.warning("compaction sweep failed", exc_info=True)

@@ -1,0 +1,379 @@
+"""Append-only delta segments: streamed rows, queryable immediately.
+
+ISSUE 6 tentpole layer (b) — the Druid realtime-node analog.  Rows
+arrive via `TPUOlapContext.append_rows` (or the server's
+`POST /druid/v2/ingest/{datasource}` route), are dictionary-encoded
+against the datasource's GLOBAL dictionaries, and publish as
+`DeltaSegment`s in a new immutable DataSource snapshot through
+`MetadataCache.put` — so the very next `catalog.get()` (i.e. the very
+next query) sees them.  Staleness is bounded by construction: zero
+published-but-invisible rows, ever.
+
+Why this is safe by construction: every aggregate in the engine is a
+mergeable partial state (Partial Partial Aggregates, arXiv:2603.26698),
+and every executor — fused dense programs, the sparse/adaptive tiers,
+the SPMD mesh, the host fallback — already merges per-segment partials.
+A delta segment is just one more (small) segment in scope, so delta and
+historical partials merge through the same machinery with exact
+semantics, device-side (the computation-pushdown argument of
+arXiv:2312.15405: fresh rows are not punted to the host).
+
+Appended values are DOMAIN VALUES (strings for string dimensions, the
+actual numbers for numeric ones), never codes: codes are rank-assigned
+and shift when dictionaries extend, so they are not a stable wire
+currency.
+
+Novel dimension values: dictionaries are datasource-global and sorted
+(range pushdown and zone maps lean on code order), so a novel value
+extends the dictionary via `catalog.segment.extend_dict` — a sorted
+superset whose old->new LUT is strictly monotone — and historical (and
+earlier delta) segments remap their codes through the LUT
+(`remap_segment_codes`, an O(rows) int gather per affected dimension).
+Remapped segments carry fresh uids, so device residency and compiled
+programs can never serve stale codes; the dictionary's `content_key`
+change invalidates every program/result cache keyed on the schema
+signature.  Appends with known values (the steady state once
+dictionaries converge) touch nothing historical.
+
+Concurrency: one RLock per datasource buffer.  All delta mutation
+happens under it (graftlint ingest-discipline/GL1501 enforces this);
+queries are lock-free — they hold an immutable DataSource snapshot from
+the catalog, so an append mid-query is simply not visible to it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..catalog.cache import MetadataCache
+from ..catalog.segment import (
+    NULL_ID,
+    ROW_PAD,
+    DataSource,
+    DimensionDict,
+    Segment,
+    as_delta,
+    build_datasource,
+    extend_dict,
+    remap_segment_codes,
+)
+from ..obs import SPAN_INGEST, SPAN_INGEST_ENCODE, record_ingest, span
+from ..resilience import checkpoint
+from ..utils.log import get_logger
+
+log = get_logger("ingest.delta")
+
+
+class _DeltaBuffer:
+    """Per-datasource append serialization point: the RLock every delta
+    mutation (append, dictionary extension, compaction swap) runs under,
+    plus the monotonic delta sequence counter.  Fields mutate ONLY under
+    `_lock` (graftlint ingest-discipline/GL1501)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._next_seq = 0
+
+    def next_seq(self) -> int:
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
+
+
+class IngestManager:
+    """Owns streamed ingest for one context: per-datasource delta buffers,
+    the append path, and the locking surface compaction shares.
+
+    Mutation is serialized per datasource; publication goes through
+    `MetadataCache.put` exclusively, so every visible change carries a
+    bumped datasource version (graftlint ingest-discipline/GL1503)."""
+
+    def __init__(self, catalog: MetadataCache, config=None):
+        self.catalog = catalog
+        self.config = config
+        self._lock = threading.Lock()
+        self._buffers: Dict[str, _DeltaBuffer] = {}
+        # eviction hook: called with the uids of segments that left the
+        # published set (the engine drops their device residency)
+        self.on_segments_dropped = None
+
+    def _seal_rows(self) -> int:
+        return int(getattr(self.config, "delta_seal_rows", 1 << 16) or 1 << 16)
+
+    def buffer(self, name: str) -> _DeltaBuffer:
+        with self._lock:
+            buf = self._buffers.get(name)
+            if buf is None:
+                buf = self._buffers[name] = _DeltaBuffer()
+            return buf
+
+    def delta_rows(self, name: str) -> int:
+        ds = self.catalog.get(name)
+        return ds.delta_rows if ds is not None else 0
+
+    def _dropped(self, uids) -> None:
+        hook = self.on_segments_dropped
+        if hook is not None and uids:
+            try:
+                hook(frozenset(uids))
+            except Exception:  # fault-ok: eviction is advisory, never fatal
+                log.warning("segment-drop hook failed", exc_info=True)
+
+    # -- the append path -----------------------------------------------------
+
+    def append_rows(self, name: str, rows) -> dict:
+        """Append streamed rows to a registered datasource.
+
+        `rows` is a list of row dicts (the wire shape) or a mapping of
+        row-aligned columns.  Missing dimensions fill with null and
+        missing metrics with 0; unknown columns are rejected — streamed
+        rows cannot widen a schema.  Returns an ack carrying the appended
+        row count and the new datasource version."""
+        buf = self.buffer(name)
+        with buf._lock, span(SPAN_INGEST, datasource=name):
+            ds = self.catalog.get(name)
+            if ds is None:
+                raise KeyError(f"unknown datasource {name!r}")
+            cols, n = _normalize_rows(ds, rows)
+            if n == 0:
+                return {
+                    "appended": 0,
+                    "datasourceVersion": ds.version,
+                    "totalRows": ds.num_rows,
+                }
+            with span(SPAN_INGEST_ENCODE, rows=n):
+                ds2, dropped = self._append_encoded(ds, cols, buf)
+            published = self.catalog.put(ds2)
+            self._dropped(dropped)
+            record_ingest(name, n, "ok")
+            return {
+                "appended": n,
+                "datasourceVersion": published.version,
+                "totalRows": published.num_rows,
+            }
+
+    def _append_encoded(
+        self, ds: DataSource, cols: Dict[str, np.ndarray], buf: _DeltaBuffer
+    ) -> Tuple[DataSource, frozenset]:
+        """Encode one normalized batch into DeltaSegments spliced onto a
+        new snapshot.  Returns (snapshot, uids of replaced segments) —
+        the caller publishes and evicts.  Caller holds the buffer lock."""
+        dim_names = [c.name for c in ds.columns if c.is_dimension]
+        met_names = [c.name for c in ds.columns if c.is_metric]
+
+        # dictionary extension first: novel values shift the code space,
+        # and EVERY already-encoded segment (historical + delta) must
+        # remap before the new rows encode against the extended dicts
+        dicts = dict(ds.dicts)
+        luts: Dict[str, np.ndarray] = {}
+        for d in dim_names:
+            new_dict, lut = extend_dict(
+                dicts[d], _domain_values(cols[d], dicts[d])
+            )
+            if lut is not None:
+                dicts[d] = new_dict
+                luts[d] = lut
+        segments: Tuple[Segment, ...] = ds.segments
+        dropped: frozenset = frozenset()
+        if luts:
+            cards = {d: dicts[d].cardinality for d in luts}
+            log.info(
+                "append to %s extends dictionaries %s; remapping %d "
+                "segments", ds.name, sorted(luts), len(segments),
+            )
+            remapped: List[Segment] = []
+            for seg in segments:
+                # O(segments) gather passes: honor an armed deadline
+                # between segments, same as the query-side loops
+                checkpoint("ingest.remap_segment")
+                remapped.append(remap_segment_codes(seg, luts, cards))
+            dropped = frozenset(s.uid for s in segments)
+            segments = tuple(remapped)
+
+        # encode VALUES -> codes explicitly (the int-with-dict fast path
+        # in build_datasource means "already codes", which appended domain
+        # values are not), then build padded delta segments through the
+        # existing encoder's pre-encoded path
+        enc = dict(cols)
+        for d in dim_names:
+            enc[d] = _encode_values(cols[d], dicts[d])
+        part = build_datasource(
+            ds.name,
+            enc,
+            dimension_cols=dim_names,
+            metric_cols=met_names,
+            time_col=ds.time_column,
+            rows_per_segment=max(self._seal_rows(), ROW_PAD),
+            dicts=dicts,
+        )
+        fresh = []
+        # graftlint: disable=ingest-discipline -- per-segment seq stamping; the encode above is the real work
+        for s in part.segments:
+            seq = buf.next_seq()
+            fresh.append(
+                as_delta(
+                    dataclasses.replace(
+                        s, segment_id=f"{ds.name}_delta_{seq:06d}"
+                    ),
+                    seq=seq,
+                )
+            )
+        return (
+            dataclasses.replace(
+                ds, dicts=dicts, segments=segments + tuple(fresh)
+            ),
+            dropped,
+        )
+
+
+def _domain_values(col: np.ndarray, d: DimensionDict) -> list:
+    """The distinct candidate domain values of an appended column (for
+    novel-value detection): raw values for string dictionaries, int64
+    values (negatives = null, excluded) for numeric ones."""
+    if d.numeric_values is not None or (
+        not d.values and np.asarray(col).dtype.kind in "iuf"
+    ):
+        a = _as_int64(col)
+        return [int(v) for v in np.unique(a[a >= 0])]
+    import pandas as pd
+
+    arr = np.asarray(col, dtype=object)
+    return [v for v in pd.unique(arr) if not pd.isna(v)]
+
+
+def _encode_values(col: np.ndarray, d: DimensionDict) -> np.ndarray:
+    """Appended domain values -> global int32 codes."""
+    if d.numeric_values is not None or (
+        not d.values and np.asarray(col).dtype.kind in "iuf"
+    ):
+        return d.encode_numeric(_as_int64(col))
+    return d.encode(list(np.asarray(col, dtype=object)))
+
+
+def _as_int64(col) -> np.ndarray:
+    """Object/float/int column -> int64 with nulls as NULL_ID."""
+    a = np.asarray(col)
+    if a.dtype.kind == "O":
+        import pandas as pd
+
+        mask = pd.isna(a)
+        out = np.full(len(a), NULL_ID, dtype=np.int64)
+        if (~mask).any():
+            out[~mask] = np.asarray(
+                [int(v) for v in a[~mask]], dtype=np.int64
+            )
+        return out
+    if a.dtype.kind == "f":
+        out = np.where(np.isnan(a), NULL_ID, a).astype(np.int64)
+        return out
+    return a.astype(np.int64)
+
+
+def _normalize_rows(
+    ds: DataSource, rows
+) -> Tuple[Dict[str, np.ndarray], int]:
+    """Wire rows -> row-aligned columns covering the datasource schema.
+
+    Accepts a list of row dicts or a mapping of columns.  Unknown column
+    names raise (schema is fixed at registration); missing dimensions
+    fill with null, missing metrics with 0, and a missing time column is
+    an error when the datasource has one (interval pruning would
+    misplace the rows)."""
+    known = {c.name for c in ds.columns}
+    if isinstance(rows, Mapping):
+        cols_in = {k: np.asarray(v) for k, v in rows.items()}
+        lens = {len(v) for v in cols_in.values()}
+        if len(lens) > 1:
+            raise ValueError(f"ragged append columns: lengths {sorted(lens)}")
+        n = lens.pop() if lens else 0
+    elif isinstance(rows, Sequence) and not isinstance(rows, (str, bytes)):
+        keys: List[str] = []
+        for r in rows:
+            if not isinstance(r, Mapping):
+                raise ValueError("append rows must be objects")
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        n = len(rows)
+        cols_in = {
+            k: np.asarray([r.get(k) for r in rows], dtype=object)
+            for k in keys
+        }
+    else:
+        raise ValueError(
+            f"unsupported append payload type {type(rows).__name__}"
+        )
+    unknown = sorted(set(cols_in) - known)
+    if unknown:
+        raise ValueError(
+            f"append names unknown columns {unknown}; datasource "
+            f"{ds.name!r} schema is fixed at registration"
+        )
+    if n == 0:
+        return {}, 0  # empty append: an ack, not a schema error
+    out: Dict[str, np.ndarray] = {}
+    for c in ds.columns:
+        v = cols_in.get(c.name)
+        if c.kind == "time":
+            if v is None:
+                raise ValueError(
+                    f"append is missing time column {c.name!r}"
+                )
+            out[c.name] = _coerce_time(v)
+        elif c.is_metric:
+            if v is None:
+                v = np.zeros(n)
+            a = np.asarray(v)
+            if a.dtype.kind == "O":
+                a = a.astype(np.float64)
+            # match the REGISTERED metric dtype: a "long" metric appended
+            # as floats must land int32 like its historical siblings, or
+            # delta and historical partials would accumulate in different
+            # arithmetic
+            if c.dtype == "long" and a.dtype.kind == "f":
+                a = np.where(np.isnan(a), 0, a).astype(np.int64)
+            elif c.dtype == "double" and a.dtype.kind in "iu":
+                a = a.astype(np.float64)
+            out[c.name] = a
+        else:  # dimension
+            if v is None:
+                d = ds.dicts.get(c.name)
+                if d is not None and d.numeric_values is not None:
+                    v = np.full(n, NULL_ID, dtype=np.int64)
+                else:
+                    v = np.full(n, None, dtype=object)
+            out[c.name] = np.asarray(v)
+    return out, n
+
+
+def _coerce_time(v) -> np.ndarray:
+    """Time values -> int64 epoch millis (ISO strings, datetimes, or raw
+    millis — the shapes Druid ingest specs accept).  Null/unparseable
+    values RAISE: a silently-NaT row would carry INT64_MIN millis and be
+    permanently misplaced by interval pruning."""
+    a = np.asarray(v)
+    if a.dtype.kind == "O":
+        import pandas as pd
+
+        if pd.isna(a).any():
+            raise ValueError("append has null values in the time column")
+    if a.dtype.kind in ("i", "u"):
+        return a.astype(np.int64)
+    if a.dtype.kind == "f":
+        if np.isnan(a).any():
+            raise ValueError("append has null values in the time column")
+        return a.astype(np.int64)
+    if a.dtype.kind != "M":
+        try:
+            a = np.asarray(a, dtype="datetime64[ms]")
+        except Exception as e:
+            raise ValueError(f"unparseable time values in append: {e}")
+    out = a.astype("datetime64[ms]").astype(np.int64)
+    if np.isnat(a.astype("datetime64[ms]")).any():
+        raise ValueError("append has null/NaT values in the time column")
+    return out

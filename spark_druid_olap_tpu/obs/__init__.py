@@ -16,13 +16,17 @@ place — the span-discipline lint pass (GL11xx) enforces both.
 
 from .registry import (  # noqa: F401
     MetricsRegistry,
+    bounded_label,
     get_registry,
+    record_compaction,
+    record_ingest,
     record_query_metrics,
 )
 from .trace import (  # noqa: F401
     SPAN_ADAPTIVE_PROBE,
     SPAN_ADMISSION,
     SPAN_COLLECTIVE_MERGE,
+    SPAN_COMPACT,
     SPAN_DEGRADED,
     SPAN_DEVICE_FETCH,
     SPAN_EXECUTE,
@@ -30,6 +34,8 @@ from .trace import (  # noqa: F401
     SPAN_FALLBACK_DECODE,
     SPAN_FINALIZE,
     SPAN_H2D,
+    SPAN_INGEST,
+    SPAN_INGEST_ENCODE,
     SPAN_LOWER,
     SPAN_NAMES,
     SPAN_PLAN,

@@ -445,8 +445,83 @@ def get_registry() -> MetricsRegistry:
 
 
 # ---------------------------------------------------------------------------
+# Label-cardinality guard (ISSUE 6 obs satellite (b))
+# ---------------------------------------------------------------------------
+
+# free-form label values (datasource names arrive from CLIENTS on the
+# ingest route) past the cap collapse into one overflow bucket — a
+# hostile name-per-request stream can then grow the registry by at most
+# `cap` children per family instead of one per request
+LABEL_OVERFLOW = "__other__"
+
+_label_guard_lock = threading.Lock()
+_label_seen: Dict[str, set] = {}
+
+
+def bounded_label(family: str, value: str, cap: int = 64) -> str:
+    """Admit `value` as a label for `family` while the family's distinct
+    admitted set stays under `cap`; return LABEL_OVERFLOW otherwise.
+    First-come-first-admitted and process-global (series must stay
+    stable across context rebuilds, like the registry itself)."""
+    v = str(value) if value else "unknown"
+    with _label_guard_lock:
+        seen = _label_seen.get(family)
+        if seen is None:
+            seen = _label_seen[family] = set()
+        if v in seen:
+            return v
+        if len(seen) >= max(1, int(cap)):
+            return LABEL_OVERFLOW
+        seen.add(v)
+        return v
+
+
+# ---------------------------------------------------------------------------
 # The process metric catalog (engines + resilience publish through these)
 # ---------------------------------------------------------------------------
+
+
+def record_ingest(datasource: str, rows: int, outcome: str = "ok") -> None:
+    """Publish one streamed append: request count by datasource/outcome
+    plus appended rows — per-datasource labels ride through the
+    cardinality guard (a hostile datasource-name stream cannot explode
+    the registry)."""
+    reg = get_registry()
+    ds = bounded_label("ingest_datasource", datasource)
+    reg.counter(
+        "sdol_ingest_requests_total",
+        "streamed ingest appends, by datasource / outcome",
+        labels=("datasource", "outcome"),
+    ).labels(datasource=ds, outcome=outcome).inc()
+    if rows:
+        reg.counter(
+            "sdol_ingest_rows_total",
+            "rows appended through the streamed ingest tier",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(rows)
+
+
+def record_compaction(datasource: str, rows: int, delta_segments: int) -> None:
+    """Publish one delta->historical compaction."""
+    reg = get_registry()
+    ds = bounded_label("ingest_datasource", datasource)
+    reg.counter(
+        "sdol_compactions_total",
+        "delta->historical compactions, by datasource",
+        labels=("datasource",),
+    ).labels(datasource=ds).inc()
+    if rows:
+        reg.counter(
+            "sdol_compacted_rows_total",
+            "delta rows rolled into historical segments",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(rows)
+    if delta_segments:
+        reg.counter(
+            "sdol_compacted_delta_segments_total",
+            "delta segments consumed by compaction",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(delta_segments)
 
 
 def record_query_metrics(m, outcome: str = "ok") -> None:
@@ -466,6 +541,19 @@ def record_query_metrics(m, outcome: str = "ok") -> None:
         executor=m.executor or "unknown",
         outcome=outcome,
     ).inc()
+    # per-datasource traffic (obs satellite (b)): which table is hot is
+    # the first question a dashboard fleet asks; the guard caps the
+    # series a client-controlled name stream can mint
+    ds_name = getattr(m, "datasource", "") or None
+    if ds_name:
+        reg.counter(
+            "sdol_datasource_queries_total",
+            "queries executed, by datasource / wire type",
+            labels=("datasource", "query_type"),
+        ).labels(
+            datasource=bounded_label("query_datasource", ds_name),
+            query_type=m.query_type or "unknown",
+        ).inc()
     if m.retries:
         reg.counter(
             "sdol_query_retries_total",

@@ -74,6 +74,9 @@ SPAN_DEGRADED = "degraded"  # breaker/failure degradation to the fallback
 SPAN_SPARSE_DISPATCH = "sparse_dispatch"  # sort-compaction tier dispatch
 SPAN_ADAPTIVE_PROBE = "adaptive_probe"  # adaptive phase-A presence pass
 SPAN_STREAM_CHUNK = "stream_chunk"  # one streaming chunk dispatch
+SPAN_INGEST = "ingest"  # one streamed append (ingest tier, ISSUE 6)
+SPAN_INGEST_ENCODE = "ingest_encode"  # dictionary encode of an append batch
+SPAN_COMPACT = "compact"  # delta -> historical roll of one datasource
 
 SPAN_NAMES = frozenset(
     {
@@ -94,6 +97,9 @@ SPAN_NAMES = frozenset(
         SPAN_SPARSE_DISPATCH,
         SPAN_ADAPTIVE_PROBE,
         SPAN_STREAM_CHUNK,
+        SPAN_INGEST,
+        SPAN_INGEST_ENCODE,
+        SPAN_COMPACT,
     }
 )
 

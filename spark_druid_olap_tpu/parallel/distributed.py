@@ -601,7 +601,7 @@ class DistributedEngine:
         return strat
 
     def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
-        from ..exec.lowering import _query_key
+        from ..exec.lowering import memo_key
         from ..exec.metrics import QueryMetrics
 
         from ..resilience import checkpoint, fire
@@ -612,11 +612,15 @@ class DistributedEngine:
         fire("device_dispatch")
         t_total = _time.perf_counter()
         lowering = self._lowering_for(q, ds)
-        qkey = _query_key(q, ds)
+        # learned-memo identity: segment-set independent (lowering.memo_key,
+        # same contract as the local engine) so continuous streamed ingest
+        # neither forgets learned rungs nor leaks one memo entry per append
+        qkey = memo_key(q, ds)
         strategy = self._route_strategy(q, ds, lowering, qkey)
         m = QueryMetrics(
             query_type="groupBy",
             strategy=strategy,
+            datasource=ds.name,
             query_id=current_query_id(),
             distributed=True,
             mesh_shape=tuple(self.mesh.shape.values()),
@@ -875,7 +879,19 @@ class DistributedEngine:
         from ..exec.lowering import empty_partials
         from ..plan.cost import choose_kernel_strategy
 
-        kept = self._adaptive_kept.get(qkey)
+        # measured kept sets are only valid for the segment set they
+        # scanned (a fresh delta may hold codes the scan never saw —
+        # reusing a stale set would silently drop those rows); derived
+        # sets are supersets by construction and survive appends.  Same
+        # entry shapes as the local AdaptiveDomainMixin.
+        seg_sig = tuple(s.uid for s in ds.segments)
+        entry = self._adaptive_kept.get(qkey)
+        kept = None
+        if entry is not None:
+            if entry[0] == "derived":
+                kept = entry[1]
+            elif entry[1] == seg_sig:
+                kept = entry[2]
         if kept is None:
             # dictionary-derived shortcut (shared with the local engine):
             # a filter that pins every grouping dim replaces the SPMD
@@ -884,7 +900,7 @@ class DistributedEngine:
 
             kept = filter_derived_kept(q, lowering, ds)
             if kept is not None:
-                self._adaptive_kept[qkey] = kept
+                self._adaptive_kept[qkey] = ("derived", kept)
         if kept is None:
             # phase A reads only mask + dim-code columns (the shared
             # helper keeps the physical time column when intervals need it)
@@ -913,7 +929,7 @@ class DistributedEngine:
                 np.nonzero(np.asarray(c) > 0)[0].astype(np.int32)
                 for c in counts
             ]
-            self._adaptive_kept[qkey] = kept
+            self._adaptive_kept[qkey] = ("measured", seg_sig, kept)
         Gc = 1
         for kd in kept:
             Gc *= len(kd)

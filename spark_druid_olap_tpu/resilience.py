@@ -650,6 +650,16 @@ class ResilienceState:
                 config, "admission_queue_timeout_ms", 2000.0
             ),
         )
+        # streamed-ingest slot pool (ISSUE 6): separate from the query
+        # pool so appends and queries cannot starve each other; the
+        # server's ingest route gates on it with the same 503+Retry-After
+        # contract
+        self.ingest_admission = AdmissionController(
+            max_concurrent=getattr(config, "max_concurrent_ingests", 2),
+            queue_timeout_ms=getattr(
+                config, "ingest_queue_timeout_ms", 2000.0
+            ),
+        )
         self._lock = threading.Lock()
         self.degraded_total = 0
         self.deadline_exceeded_total = 0
@@ -708,6 +718,7 @@ class ResilienceState:
             "healthy": True,
             "breaker": self.breaker.to_dict(),
             "admission": self.admission.to_dict(),
+            "ingest_admission": self.ingest_admission.to_dict(),
             "counters": counters,
             "faults": injector().state(),
         }

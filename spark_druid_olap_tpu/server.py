@@ -9,6 +9,8 @@ clients/dashboards can point at this server:
 
     POST /druid/v2            native Druid query JSON -> Druid-shaped results
     POST /druid/v2/sql        {"query": "SELECT ..."} -> array of row objects
+    POST /druid/v2/ingest/{datasource}    streamed row append (realtime
+                                          ingest; rows queryable immediately)
     GET  /druid/v2/datasources            -> ["lineorder", ...]
     GET  /druid/v2/datasources/{name}     -> {"dimensions": .., "metrics": ..}
     GET  /druid/v2/trace/{query_id}       -> span tree of a recent query
@@ -61,6 +63,7 @@ def _route_label(path: str) -> str:
         "/druid/v2/trace",
         "/druid/v2/datasources",
         "/druid/v2/sql",
+        "/druid/v2/ingest",
         "/druid/v2",
         "/status/metrics",
         "/status/health",
@@ -343,6 +346,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(
                 400, "invalid JSON body", "BadJsonQueryException"
             )
+        if path.startswith("/druid/v2/ingest/"):
+            return self._ingest(path.rsplit("/", 1)[1], body)
         if path not in ("/druid/v2", "/druid/v2/sql"):
             return self._error(404, f"no route {path!r}", "NotFound")
         # A non-dict context is client noise, not a server error: ignore it.
@@ -449,6 +454,80 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             if res is not None:
                 res.admission.release()
+
+    def _ingest(self, name: str, body: dict):
+        """POST /druid/v2/ingest/{datasource}: streamed row append (the
+        realtime-node push analog).  Body: {"rows": [...row objects...]}
+        or {"columns": {name: [values...]}}.  Gated on the SEPARATE
+        ingest admission pool (503 + Retry-After when full) so appends
+        and queries cannot starve each other, and on the same per-request
+        deadline contract queries get (`context.timeout` honored)."""
+        res = self._resilience()
+        cfg = getattr(self.ctx, "config", None)
+        qctx = body.get("context")
+        qctx = qctx if isinstance(qctx, dict) else {}
+        client_qid = qctx.get("queryId")
+        self._query_id = str(client_qid) if client_qid else new_query_id()
+        rows = body.get("rows", body.get("columns"))
+        if rows is None:
+            return self._error(
+                400,
+                'body must carry "rows" (row objects) or "columns" '
+                "(column arrays)",
+                "BadQueryException",
+            )
+        with span(SPAN_ADMISSION):
+            admitted = res is None or res.ingest_admission.acquire()
+        if not admitted:
+            return self._error(
+                503,
+                "ingest capacity exceeded; retry later",
+                "QueryCapacityExceededException",
+                headers={
+                    "Retry-After": res.ingest_admission.retry_after_s()
+                },
+            )
+        try:
+            # tolerate a malformed context.timeout exactly like the query
+            # route: client noise means "no timeout", never a 500
+            if "timeout" in qctx:
+                try:
+                    timeout_ms = float(qctx["timeout"])
+                except (TypeError, ValueError):
+                    timeout_ms = 0
+            else:
+                timeout_ms = cfg.query_timeout_ms if cfg else 0
+            if timeout_ms <= 0:
+                timeout_ms = float("inf")
+            with self._tracer().query_trace(
+                query_id=self._query_id,
+                query_type="ingest",
+                slow_ms=cfg.slow_query_ms if cfg else 0.0,
+            ), deadline_scope(timeout_ms):
+                ack = self.ctx.ingest.append_rows(name, rows)
+            return self._send(200, ack)
+        except KeyError as e:
+            return self._error(
+                400, f"unknown dataSource: {e}", "BadQueryException"
+            )
+        except ValueError as e:
+            # malformed client payload (ragged columns, unknown columns,
+            # unparseable time values): 400, not a server error
+            return self._error(400, str(e), "BadQueryException")
+        except DeadlineExceeded as e:
+            if res is not None:
+                res.note_deadline_exceeded()
+            return self._error(504, str(e), "QueryTimeoutException")
+        except Exception as e:
+            log.error("ingest failed: %s", type(e).__name__, exc_info=True)
+            if res is not None:
+                res.note_server_error(e)
+            return self._error(
+                500, "ingest failed; see server logs", type(e).__name__
+            )
+        finally:
+            if res is not None:
+                res.ingest_admission.release()
 
     def _native_query(self, body: dict):
         res = self._resilience()

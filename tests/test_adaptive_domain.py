@@ -13,7 +13,7 @@ import pytest
 
 from spark_druid_olap_tpu.catalog.segment import DimensionDict, build_datasource
 from spark_druid_olap_tpu.exec.engine import Engine
-from spark_druid_olap_tpu.exec.lowering import _query_key
+from spark_druid_olap_tpu.exec.lowering import memo_key
 from spark_druid_olap_tpu.models.aggregations import (
     Count,
     DoubleMax,
@@ -110,10 +110,19 @@ def test_adaptive_parity_and_kept_cache():
     np.testing.assert_allclose(got["lo"], want["lo"], rtol=1e-6)
     np.testing.assert_allclose(got["hi"], want["hi"], rtol=1e-6)
     assert eng.last_metrics.strategy == "adaptive"
-    # kept sets cached; a repeat skips phase A and stays exact
-    qkey = _query_key(q, ds)
+    # kept sets cached; a repeat skips phase A and stays exact.  Memo
+    # entries key segment-set-independently and measured ones carry the
+    # scanned segment signature (ingest-tier contract: a delta append
+    # must re-measure, a plain repeat must not)
+    qkey = memo_key(q, ds)
     assert qkey in eng._adaptive_kept
-    kept = eng._adaptive_kept[qkey]
+    entry = eng._adaptive_kept[qkey]
+    if entry[0] == "measured":
+        _, seg_sig, kept = entry
+        assert seg_sig == tuple(s.uid for s in ds.segments)
+    else:
+        assert entry[0] == "derived"
+        kept = entry[1]
     assert len(kept[0]) <= len(keep_a) and len(kept[1]) <= len(keep_b)
     got2 = _norm(eng.execute(q, ds))
     pd.testing.assert_frame_equal(got, got2)
@@ -283,8 +292,10 @@ def test_filter_derived_kept_skips_presence_scan():
     np.testing.assert_array_equal(got["n"], want["n"])
     np.testing.assert_allclose(got["s"], want["s"], rtol=2e-5)
     assert eng.last_metrics.strategy == "adaptive"
-    # derived kept = the accepted-code sets, already cached
-    kept = eng._adaptive_kept[_query_key(q, ds)]
+    # derived kept = the accepted-code sets, already cached (derived
+    # entries are segment-set independent: supersets by construction)
+    tag, kept = eng._adaptive_kept[memo_key(q, ds)]
+    assert tag == "derived"
     assert [int(x) for x in kept[0]] == sorted(keep_a)
     assert [int(x) for x in kept[1]] == list(range(10, 31))
 

@@ -109,6 +109,51 @@ def test_production_tag_keys_scale(monkeypatch):
     assert "%s_%g" % (mode, arg) == "tpch_q1_0.1"
     mode, _, arg = bench._parse_args([])
     assert "%s_%g" % (mode, arg) == "ssb_1"
+    # ingest workload (ISSUE 6): millions-of-rows float arg
+    mode, fn, arg = bench._parse_args(["ingest", "2"])
+    assert "%s_%g" % (mode, arg) == "ingest_2"
+    assert fn is bench.bench_ingest
+
+
+def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
+    """The ingest workload's result must satisfy the same one-compact-line
+    contract, with the ingest headline fields inline and the fat span
+    trees in the detail sidecar only."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    fat_tree = {"name": "ingest", "children": [
+        {"name": "ingest_encode", "attrs": {"rows": 128}}
+    ] * 50}
+    bench._emit(
+        {
+            "metric": "ingest_sf100shape_2M_bulk_rows_per_sec",
+            "value": 4_200_000,
+            "unit": "rows/s",
+            "vs_baseline": 5.1,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 2_000_000,
+                "ingest_s": 0.47,
+                "ingest_rows_per_sec": 4_200_000,
+                "serial_seed_rows_per_sec": 820_000,
+                "append_visible_p50_ms": 12.5,
+                "span_tree_append": fat_tree,
+                "span_tree_compact": fat_tree,
+            },
+        },
+        "ingest_2",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "ingest_sf100shape_2M_bulk_rows_per_sec"
+    assert parsed["vs_baseline"] == 5.1
+    assert parsed["ingest_rows_per_sec"] == 4_200_000
+    assert "span_tree_append" not in parsed
+    detail = json.load(open(tmp_path / "BENCH_ingest_2_detail.json"))
+    assert detail["detail"]["append_visible_p50_ms"] == 12.5
+    assert detail["detail"]["span_tree_append"] == fat_tree
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

@@ -232,11 +232,13 @@ def test_mesh_sparse_slots_ladder_rungs_up():
     # the DS-level 6000 distinct overflowed the 4096-slot one-hot tier: a
     # segmented-reduce rung was remembered so repeats skip the base tier
     from spark_druid_olap_tpu.exec.lowering import (
-        _query_key,
         groupby_with_time_granularity,
+        memo_key,
     )
 
-    qkey = _query_key(groupby_with_time_granularity(q), ds)
+    # learned rungs key segment-set-independently (the ingest tier's
+    # memo contract, shared with the local engine)
+    qkey = memo_key(groupby_with_time_granularity(q), ds)
     assert dist._sparse_slots.get(qkey, 0) > 4096
     import pandas as pd
 

@@ -1,0 +1,820 @@
+"""Real-time ingestion tier (ISSUE 6): parallel sharded bulk ingest,
+append-only delta segments with query-time merge, versioned background
+compaction.
+
+The oracle contract under test everywhere: after any sequence of
+appends/compactions, a query over the live datasource equals the same
+query over a datasource re-ingested FROM SCRATCH with the full row set —
+across groupBy / topN / timeseries and the host-fallback path.  Integer
+metrics make the comparison exact (f32 sums are order-sensitive; int32
+sums are not)."""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.catalog.segment import (
+    DeltaSegment,
+    DimensionDict,
+    build_datasource,
+    extend_dict,
+    remap_segment_codes,
+)
+from spark_druid_olap_tpu.ingest import (
+    build_datasource_sharded,
+    merge_shard_values,
+)
+
+T0 = int(np.datetime64("2022-01-01", "ms").astype(np.int64))
+DAY = 86_400_000
+
+CITIES = np.array(["austin", "boston", "chicago", "denver", "el paso"],
+                  dtype=object)
+
+
+def _rows(n, rng, cities=CITIES, year_lo=1995, year_hi=1999):
+    return {
+        "city": rng.choice(cities, n),
+        "year": rng.integers(year_lo, year_hi, n).astype(np.int64),
+        "qty": rng.integers(1, 100, n).astype(np.int64),
+        "rev": (rng.random(n) * 100).astype(np.float32),
+        "ts": T0 + rng.integers(0, 365, n) * DAY,
+    }
+
+
+def _register(ctx, name, cols, rows_per_segment=2048):
+    return ctx.register_table(
+        name, cols,
+        dimensions=["city", "year"], metrics=["qty", "rev"],
+        time_column="ts", rows_per_segment=rows_per_segment,
+    )
+
+
+def _concat(*col_maps):
+    out = {}
+    for k in col_maps[0]:
+        out[k] = np.concatenate([np.asarray(c[k]) for c in col_maps])
+    return out
+
+
+QUERIES = {
+    "groupby": "SELECT city, sum(qty) AS q, count(*) AS n FROM {t} "
+               "GROUP BY city ORDER BY city",
+    "groupby2": "SELECT city, year, sum(qty) AS q FROM {t} "
+                "WHERE year >= 1996 GROUP BY city, year "
+                "ORDER BY city, year",
+    "topn": "SELECT city, sum(qty) AS q FROM {t} GROUP BY city "
+            "ORDER BY q DESC LIMIT 3",
+    "timeseries": "SELECT DATE_TRUNC('month', ts) AS m, sum(qty) AS q "
+                  "FROM {t} GROUP BY DATE_TRUNC('month', ts) ORDER BY m",
+}
+
+
+def _assert_oracle_parity(ctx, name, full_cols, queries=QUERIES):
+    """Live datasource == re-ingest-from-scratch oracle, per query."""
+    oracle = sd.TPUOlapContext()
+    _register(oracle, "oracle_t", full_cols)
+    for label, sql in queries.items():
+        got = ctx.sql(sql.format(t=name)).reset_index(drop=True)
+        want = oracle.sql(sql.format(t="oracle_t")).reset_index(drop=True)
+        want = want.rename(columns=dict(zip(want.columns, got.columns)))
+        pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                      obj=f"query {label}")
+
+
+# ---------------------------------------------------------------------------
+# sharded bulk ingest
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_build_matches_serial_exactly():
+    rng = np.random.default_rng(3)
+    cols = _rows(10_000, rng)
+    # sprinkle nulls into the string dim (object-column None handling)
+    cols["city"][rng.integers(0, 10_000, 50)] = None
+    serial = build_datasource(
+        "t", cols, ["city", "year"], ["qty", "rev"], time_col="ts",
+        rows_per_segment=2048,
+    )
+    sharded = build_datasource_sharded(
+        "t", cols, ["city", "year"], ["qty", "rev"], time_col="ts",
+        rows_per_segment=2048, workers=3,
+    )
+    assert sharded.dicts["city"].values == serial.dicts["city"].values
+    assert sharded.dicts["year"].values == serial.dicts["year"].values
+    assert len(sharded.segments) == len(serial.segments)
+    for a, b in zip(serial.segments, sharded.segments):
+        assert a.segment_id == b.segment_id
+        assert a.num_rows == b.num_rows
+        for c in ("city", "year"):
+            np.testing.assert_array_equal(a.dims[c], b.dims[c])
+        for c in ("qty", "rev"):
+            np.testing.assert_array_equal(a.metrics[c], b.metrics[c])
+        np.testing.assert_array_equal(a.time, b.time)
+        np.testing.assert_array_equal(a.valid, b.valid)
+        assert a.stats == b.stats
+        assert a.interval == b.interval
+
+
+def test_sharded_build_from_chunk_iterator_without_dicts():
+    """The capability the serial streamed path lacks: a chunk STREAM with
+    no pre-built dictionaries — phase 1 builds them with a deterministic
+    merge, and queries agree with a from-scratch oracle."""
+    rng = np.random.default_rng(4)
+    chunks = [_rows(3000, rng) for _ in range(4)]
+    # ragged chunk sizes exercise the resharder's buffering path
+    chunks.append(_rows(777, rng))
+    ds = build_datasource_sharded(
+        "t", iter(chunks), ["city", "year"], ["qty", "rev"],
+        time_col="ts", rows_per_segment=2048, workers=2,
+    )
+    full = _concat(*chunks)
+    assert ds.num_rows == len(full["ts"])
+    ctx = sd.TPUOlapContext()
+    ctx.register_datasource(ds)
+    _assert_oracle_parity(ctx, "t", full)
+
+
+def test_merge_shard_values_deterministic_under_shard_order():
+    a = np.array(["pear", "apple", None], dtype=object)
+    b = np.array(["apple", "quince"], dtype=object)
+    c = np.array([], dtype=object)
+    d1 = merge_shard_values([a, b, c])
+    d2 = merge_shard_values([c, b, a])
+    assert d1.values == d2.values == ("apple", "pear", "quince")
+    # numeric shards merge numerically sorted, negatives (nulls) excluded
+    n1 = merge_shard_values([np.array([7, 3]), np.array([3, 11])])
+    assert n1.values == (3, 7, 11)
+
+
+# ---------------------------------------------------------------------------
+# dictionary extension + code remap
+# ---------------------------------------------------------------------------
+
+
+def test_extend_dict_monotone_lut_and_remap():
+    old = DimensionDict(values=("b", "d", "f"))
+    new, lut = extend_dict(old, ["a", "d", "e"])
+    assert new.values == ("a", "b", "d", "e", "f")
+    # strictly monotone: code order keeps meaning value order
+    np.testing.assert_array_equal(lut, [1, 2, 4])
+    assert all(np.diff(lut) > 0)
+    # nothing novel -> no LUT (the steady-state append)
+    same, none_lut = extend_dict(new, ["a", "f"])
+    assert none_lut is None and same is new
+
+
+def test_remap_segment_codes_preserves_values_and_stats():
+    rng = np.random.default_rng(5)
+    cols = _rows(4000, rng)
+    ds = build_datasource(
+        "t", cols, ["city", "year"], ["qty", "rev"], time_col="ts",
+        rows_per_segment=2048,
+    )
+    old_dict = ds.dicts["city"]
+    new_dict, lut = extend_dict(old_dict, ["aachen", "miami"])
+    seg = ds.segments[0]
+    out = remap_segment_codes(
+        seg, {"city": lut}, {"city": new_dict.cardinality}
+    )
+    # same decoded values under the new dictionary, fresh uid
+    np.testing.assert_array_equal(
+        new_dict.decode(np.asarray(out.dims["city"][: seg.num_rows])),
+        old_dict.decode(np.asarray(seg.dims["city"][: seg.num_rows])),
+    )
+    assert out.uid != seg.uid
+    # zone maps shifted through the same monotone LUT
+    lo, hi = out.stats["city"]
+    olo, ohi = seg.stats["city"]
+    assert (lo, hi) == (float(lut[int(olo)]), float(lut[int(ohi)]))
+
+
+# ---------------------------------------------------------------------------
+# append-only delta segments: immediate visibility + oracle parity
+# ---------------------------------------------------------------------------
+
+
+def test_append_rows_visible_immediately_with_oracle_parity():
+    rng = np.random.default_rng(6)
+    base = _rows(9000, rng)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", base)
+
+    batches = []
+    # batch 1: wire-shaped row objects, known values
+    b1 = [
+        {"city": "austin", "year": 1997, "qty": 5, "rev": 1.5,
+         "ts": T0 + 3 * DAY},
+        {"city": "boston", "year": 1996, "qty": 7, "rev": 2.5,
+         "ts": T0 + 100 * DAY},
+    ]
+    ack = ctx.append_rows("ev", b1)
+    assert ack["appended"] == 2
+    batches.append({
+        "city": np.array(["austin", "boston"], dtype=object),
+        "year": np.array([1997, 1996], dtype=np.int64),
+        "qty": np.array([5, 7], dtype=np.int64),
+        "rev": np.array([1.5, 2.5], dtype=np.float32),
+        "ts": np.array([T0 + 3 * DAY, T0 + 100 * DAY], dtype=np.int64),
+    })
+    # batch 2: column-mapping shape, NOVEL string and numeric dim values
+    b2 = {
+        "city": np.array(["zanesville", "austin"], dtype=object),
+        "year": np.array([2001, 1995], dtype=np.int64),
+        "qty": np.array([11, 13], dtype=np.int64),
+        "rev": np.array([3.5, 4.5], dtype=np.float32),
+        "ts": np.array([T0 + 10 * DAY, T0 + 11 * DAY], dtype=np.int64),
+    }
+    v_before = ctx.catalog.datasource_version("ev")
+    ack = ctx.append_rows("ev", b2)
+    assert ack["appended"] == 2
+    assert ack["datasourceVersion"] == v_before + 1
+    batches.append(b2)
+    # batch 3: rows with MISSING columns (null dim, zero metric)
+    b3 = [{"city": "chicago", "year": 1998, "ts": T0 + 50 * DAY}]
+    ctx.append_rows("ev", b3)
+    batches.append({
+        "city": np.array(["chicago"], dtype=object),
+        "year": np.array([1998], dtype=np.int64),
+        "qty": np.array([0], dtype=np.int64),
+        "rev": np.array([0.0], dtype=np.float32),
+        "ts": np.array([T0 + 50 * DAY], dtype=np.int64),
+    })
+
+    ds = ctx.catalog.get("ev")
+    assert ds.delta_rows == 5
+    assert len(ds.delta_segments()) == 3
+    # novel values extended the (still sorted) dictionaries
+    assert "zanesville" in ds.dicts["city"].values
+    assert list(ds.dicts["city"].values) == sorted(ds.dicts["city"].values)
+    assert 2001 in ds.dicts["year"].values
+
+    full = _concat(base, *batches)
+    _assert_oracle_parity(ctx, "ev", full)
+
+    # filters that touch novel AND pre-existing values stay exact
+    got = ctx.sql("SELECT sum(qty) AS q FROM ev WHERE city = 'zanesville'")
+    assert int(got["q"][0]) == 11
+    got = ctx.sql(
+        "SELECT count(*) AS n FROM ev WHERE city = 'austin' AND year = 1997"
+    )
+    want = int(
+        ((full["city"] == "austin") & (full["year"] == 1997)).sum()
+    )
+    assert int(got["n"][0]) == want
+
+
+def test_append_parity_on_fallback_path():
+    """Delta merge through the HOST interpreter: with rewrites disabled
+    the fallback decodes the live segment set (historical + delta) and
+    must agree with the from-scratch oracle."""
+    rng = np.random.default_rng(7)
+    base = _rows(5000, rng)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", base)
+    extra = {
+        "city": np.array(["waco", "austin"], dtype=object),
+        "year": np.array([1999, 1996], dtype=np.int64),
+        "qty": np.array([21, 22], dtype=np.int64),
+        "rev": np.array([1.0, 2.0], dtype=np.float32),
+        "ts": np.array([T0, T0 + DAY], dtype=np.int64),
+    }
+    ctx.append_rows("ev", extra)
+    ctx.config.enable_rewrites = False
+    got = ctx.sql(QUERIES["groupby"].format(t="ev"))
+    assert ctx.last_metrics.executor == "fallback"
+    oracle = sd.TPUOlapContext()
+    _register(oracle, "o", _concat(base, extra))
+    oracle.config.enable_rewrites = False
+    want = oracle.sql(QUERIES["groupby"].format(t="o"))
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True), want.reset_index(drop=True),
+        check_dtype=False,
+    )
+
+
+def test_append_rejects_malformed_payloads():
+    rng = np.random.default_rng(8)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(2000, rng))
+    with pytest.raises(KeyError):
+        ctx.append_rows("nope", [{"city": "x", "ts": T0}])
+    with pytest.raises(ValueError, match="unknown columns"):
+        ctx.append_rows("ev", [{"city": "x", "bogus": 1, "ts": T0}])
+    with pytest.raises(ValueError, match="ragged"):
+        ctx.append_rows("ev", {"city": ["a", "b"], "qty": [1],
+                               "year": [1, 2], "rev": [0.5, 1.5],
+                               "ts": [T0, T0]})
+    with pytest.raises(ValueError, match="time column"):
+        ctx.append_rows("ev", [{"city": "x", "year": 1995, "qty": 1}])
+    # an empty append is an ack, not an error
+    ack = ctx.append_rows("ev", [])
+    assert ack["appended"] == 0
+
+
+def test_append_invalidates_result_cache():
+    rng = np.random.default_rng(9)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(4000, rng))
+    q = "SELECT sum(qty) AS q FROM ev"
+    first = int(ctx.sql(q)["q"][0])
+    ctx.sql(q)
+    assert ctx.last_metrics.strategy == "result-cache"  # warm
+    ctx.append_rows("ev", [{"city": "austin", "year": 1997, "qty": 1000,
+                            "rev": 0.0, "ts": T0}])
+    got = ctx.sql(q)
+    assert ctx.last_metrics.strategy != "result-cache"
+    assert int(got["q"][0]) == first + 1000
+
+
+# ---------------------------------------------------------------------------
+# compaction: equivalence + versioned invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_preserves_results_and_bumps_version():
+    rng = np.random.default_rng(10)
+    base = _rows(6000, rng)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", base)
+    batches = [_rows(500, rng) for _ in range(4)]
+    for b in batches:
+        ctx.append_rows("ev", b)
+    full = _concat(base, *batches)
+
+    before = {
+        k: ctx.sql(sql.format(t="ev")).reset_index(drop=True)
+        for k, sql in QUERIES.items()
+    }
+    ds = ctx.catalog.get("ev")
+    assert len(ds.delta_segments()) == 4
+    v_before = ctx.catalog.datasource_version("ev")
+    # prime the result cache so invalidation is observable
+    q = "SELECT sum(qty) AS q FROM ev"
+    ctx.sql(q)
+    ctx.sql(q)
+    assert ctx.last_metrics.strategy == "result-cache"
+
+    summary = ctx.compact("ev")
+    assert summary["compacted_rows"] == 2000
+    assert summary["delta_segments"] == 4
+
+    ds2 = ctx.catalog.get("ev")
+    assert ds2.delta_segments() == ()
+    assert ds2.num_rows == len(full["ts"])
+    # monotonic version observed via catalog/cache.py
+    assert ctx.catalog.datasource_version("ev") > v_before
+    assert summary["datasourceVersion"] == ctx.catalog.datasource_version(
+        "ev"
+    )
+    # the result cache did NOT serve the stale entry
+    ctx.sql(q)
+    assert ctx.last_metrics.strategy != "result-cache"
+
+    after = {
+        k: ctx.sql(sql.format(t="ev")).reset_index(drop=True)
+        for k, sql in QUERIES.items()
+    }
+    for k in QUERIES:
+        pd.testing.assert_frame_equal(before[k], after[k], obj=f"query {k}")
+    _assert_oracle_parity(ctx, "ev", full)
+
+    # compacting again is a no-op
+    assert ctx.compact("ev")["compacted_rows"] == 0
+
+
+def test_compaction_consolidates_tiny_deltas_and_evicts_residency():
+    rng = np.random.default_rng(11)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(4000, rng))
+    for _ in range(6):
+        ctx.append_rows("ev", _rows(64, rng))
+    ds = ctx.catalog.get("ev")
+    assert len(ds.delta_segments()) == 6
+    # make delta columns device-resident
+    ctx.sql("SELECT city, sum(qty) AS q FROM ev GROUP BY city")
+    delta_uids = {s.uid for s in ds.delta_segments()}
+    assert any(k[0] in delta_uids for k in ctx.engine._device_cache)
+    ctx.compact("ev")
+    # residency of retired delta segments was evicted promptly
+    assert not any(k[0] in delta_uids for k in ctx.engine._device_cache)
+    ds2 = ctx.catalog.get("ev")
+    assert len(ds2.segments) < len(ds.segments)
+
+
+def test_background_compactor_sweeps():
+    rng = np.random.default_rng(12)
+    cfg = sd.SessionConfig.load_calibrated()
+    cfg.compaction_interval_s = 0.05
+    cfg.compaction_min_delta_rows = 1
+    ctx = sd.TPUOlapContext(cfg)
+    _register(ctx, "ev", _rows(3000, rng))
+    ctx.append_rows("ev", _rows(128, rng))
+    assert ctx.catalog.get("ev").delta_rows == 128
+    ctx.start_compaction()
+    try:
+        deadline = threading.Event()
+        for _ in range(100):
+            if not ctx.catalog.get("ev").delta_segments():
+                break
+            deadline.wait(0.05)
+        assert ctx.catalog.get("ev").delta_segments() == ()
+    finally:
+        ctx.stop_compaction()
+
+
+# ---------------------------------------------------------------------------
+# concurrency: append-while-query hammer
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_append_query_hammer():
+    rng = np.random.default_rng(13)
+    base = _rows(4000, rng)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", base)
+    n_appenders, batches_per, batch_rows = 3, 8, 32
+    errors = []
+    counts = []
+
+    def appender(seed):
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(batches_per):
+                ctx.append_rows("ev", _rows(batch_rows, r))
+        except Exception as e:  # pragma: no cover - surfaced by assert
+            errors.append(e)
+
+    def querier():
+        try:
+            seen = 0
+            for _ in range(12):
+                got = ctx.sql("SELECT count(*) AS n FROM ev")
+                n = int(got["n"][0])
+                # visibility is monotone: a later query can never see
+                # fewer rows than an earlier one
+                assert n >= seen and n >= 4000
+                seen = n
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        else:
+            counts.append(seen)
+
+    threads = [
+        threading.Thread(target=appender, args=(100 + i,))
+        for i in range(n_appenders)
+    ] + [threading.Thread(target=querier) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    total = 4000 + n_appenders * batches_per * batch_rows
+    got = ctx.sql("SELECT count(*) AS n FROM ev")
+    assert int(got["n"][0]) == total
+    # a compaction after the storm preserves the exact count
+    ctx.compact("ev")
+    got = ctx.sql("SELECT count(*) AS n FROM ev")
+    assert int(got["n"][0]) == total
+
+
+def test_append_honors_deadline_checkpoints():
+    """A novel-value append remaps every segment; an expired deadline
+    cancels between segments instead of finishing the whole remap."""
+    from spark_druid_olap_tpu.resilience import (
+        DeadlineExceeded,
+        deadline_scope,
+    )
+
+    rng = np.random.default_rng(14)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(20_000, rng), rows_per_segment=1024)
+    with pytest.raises(DeadlineExceeded):
+        with deadline_scope(0.000001):
+            ctx.append_rows("ev", [{"city": "novelville", "year": 1997,
+                                    "qty": 1, "rev": 0.0, "ts": T0}])
+
+
+# ---------------------------------------------------------------------------
+# learned-memo stability across appends (exec-layer integration)
+# ---------------------------------------------------------------------------
+
+
+def test_memo_key_stable_across_appends():
+    from spark_druid_olap_tpu.exec.lowering import memo_key
+    from spark_druid_olap_tpu.models import query as Q
+    from spark_druid_olap_tpu.models.aggregations import LongSum
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+
+    rng = np.random.default_rng(15)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(3000, rng))
+    q = Q.GroupByQuery(
+        datasource="ev",
+        dimensions=(DimensionSpec("city"),),
+        aggregations=(LongSum("q", "qty"),),
+    )
+    ds1 = ctx.catalog.get("ev")
+    k1 = memo_key(q, ds1)
+    # same-domain append: memo identity stable (learned rungs survive)
+    ctx.append_rows("ev", [{"city": "austin", "year": 1997, "qty": 1,
+                            "rev": 0.0, "ts": T0}])
+    ds2 = ctx.catalog.get("ev")
+    assert memo_key(q, ds2) == k1
+    # dictionary extension: memo identity changes (rungs re-learn)
+    ctx.append_rows("ev", [{"city": "new city", "year": 1997, "qty": 1,
+                            "rev": 0.0, "ts": T0}])
+    ds3 = ctx.catalog.get("ev")
+    assert memo_key(q, ds3) != k1
+
+
+# ---------------------------------------------------------------------------
+# label-cardinality guard (obs satellite (b))
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_label_caps_hostile_name_stream():
+    from spark_druid_olap_tpu.obs.registry import LABEL_OVERFLOW, bounded_label
+
+    fam = "test_guard_family_unique"
+    admitted = set()
+    for i in range(200):
+        admitted.add(bounded_label(fam, f"ds_{i}", cap=16))
+    assert LABEL_OVERFLOW in admitted
+    assert len(admitted) == 17  # 16 admitted + the overflow bucket
+    # admitted names stay stable (series continuity)
+    assert bounded_label(fam, "ds_3", cap=16) == "ds_3"
+    assert bounded_label(fam, "ds_199", cap=16) == LABEL_OVERFLOW
+
+
+def test_ingest_counters_guarded_per_datasource():
+    from spark_druid_olap_tpu.obs import get_registry
+    from spark_druid_olap_tpu.obs.registry import record_ingest
+
+    for i in range(200):
+        record_ingest(f"hostile_{i}", rows=1, outcome="ok")
+    fam = get_registry().counter(
+        "sdol_ingest_requests_total",
+        "streamed ingest appends, by datasource / outcome",
+        labels=("datasource", "outcome"),
+    )
+    # the registry family stays bounded: cap + overflow (the guard
+    # family is process-global and shared with real ingests, so <=)
+    assert len(fam.snapshot()) <= 65
+
+
+def test_query_counter_carries_datasource_label():
+    from spark_druid_olap_tpu.obs import get_registry
+
+    rng = np.random.default_rng(18)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "labeled_ds", _rows(2000, rng))
+    ctx.sql("SELECT city, sum(qty) AS q FROM labeled_ds GROUP BY city")
+    fam = get_registry().counter(
+        "sdol_datasource_queries_total",
+        "queries executed, by datasource / wire type",
+        labels=("datasource", "query_type"),
+    )
+    assert any("labeled_ds" in k for k in fam.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# the HTTP ingest route
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def served():
+    from spark_druid_olap_tpu.server import OlapServer
+
+    rng = np.random.default_rng(16)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(3000, rng))
+    srv = OlapServer(ctx, port=0).start()
+    yield ctx, srv
+    srv.shutdown()
+
+
+def _post(srv, path, payload, expect_error=False):
+    import json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        if not expect_error:
+            raise
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_http_ingest_route_end_to_end(served):
+    ctx, srv = served
+    status, ack, headers = _post(
+        srv, "/druid/v2/ingest/ev",
+        {"rows": [
+            {"city": "austin", "year": 1997, "qty": 40, "rev": 1.0,
+             "ts": T0},
+            {"city": "brand new", "year": 1995, "qty": 2, "rev": 2.0,
+             "ts": T0 + DAY},
+        ], "context": {"queryId": "ingest-42"}},
+    )
+    assert status == 200
+    assert ack["appended"] == 2
+    assert headers.get("X-Druid-Query-Id") == "ingest-42"
+    # appended rows serve on the very next query — SQL route
+    status, rows, _ = _post(
+        srv, "/druid/v2/sql",
+        {"query": "SELECT sum(qty) AS q FROM ev WHERE city = 'austin' "
+                  "AND year = 1997"},
+    )
+    assert status == 200
+    full_q = rows[0]["q"]
+    assert full_q >= 40
+    # ... and on the NATIVE route (wire queries share the live snapshot)
+    status, res, _ = _post(
+        srv, "/druid/v2",
+        {"queryType": "groupBy", "dataSource": "ev",
+         "dimensions": ["city"],
+         "aggregations": [{"type": "longSum", "name": "q",
+                           "fieldName": "qty"}],
+         "granularity": "all"},
+    )
+    assert status == 200
+    by_city = {r["event"]["city"]: r["event"]["q"] for r in res}
+    assert by_city.get("brand new") == 2
+    # columns-shape payload
+    status, ack, _ = _post(
+        srv, "/druid/v2/ingest/ev",
+        {"columns": {"city": ["austin"], "year": [1998], "qty": [3],
+                     "rev": [0.5], "ts": [T0 + 2 * DAY]}},
+    )
+    assert status == 200 and ack["appended"] == 1
+
+
+def test_http_ingest_route_client_errors(served):
+    ctx, srv = served
+    status, err, _ = _post(
+        srv, "/druid/v2/ingest/nope", {"rows": [{"city": "x", "ts": T0}]},
+        expect_error=True,
+    )
+    assert status == 400 and "unknown dataSource" in err["error"]
+    status, err, _ = _post(
+        srv, "/druid/v2/ingest/ev", {"bogus": 1}, expect_error=True,
+    )
+    assert status == 400
+    status, err, _ = _post(
+        srv, "/druid/v2/ingest/ev",
+        {"rows": [{"city": "x", "wat": 1, "ts": T0}]}, expect_error=True,
+    )
+    assert status == 400 and "unknown columns" in err["error"]
+
+
+def test_http_ingest_admission_503(served):
+    ctx, srv = served
+    adm = ctx.resilience.ingest_admission
+    adm.queue_timeout_ms = 50.0
+    # exhaust every ingest slot, then a request must shed with 503
+    held = 0
+    while adm.acquire():
+        held += 1
+        if held >= adm.max_concurrent:
+            break
+    try:
+        status, err, headers = _post(
+            srv, "/druid/v2/ingest/ev",
+            {"rows": [{"city": "austin", "year": 1997, "qty": 1,
+                       "rev": 0.0, "ts": T0}]},
+            expect_error=True,
+        )
+        assert status == 503
+        assert "Retry-After" in headers
+        assert err["errorClass"] == "QueryCapacityExceededException"
+    finally:
+        for _ in range(held):
+            adm.release()
+
+
+def test_health_exposes_ingest_admission(served):
+    import json
+    import urllib.request
+
+    ctx, srv = served
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/status/health", timeout=30
+    ) as r:
+        health = json.loads(r.read())
+    assert "ingest_admission" in health
+    assert health["ingest_admission"]["slots_total"] == (
+        ctx.config.max_concurrent_ingests
+    )
+
+
+# ---------------------------------------------------------------------------
+# fallback decode cache stays delta-correct
+# ---------------------------------------------------------------------------
+
+
+def test_fallback_decode_cache_sees_appends():
+    """The per-segment decode cache must never serve a pre-append frame:
+    uid-keyed entries reuse historical decodes but fresh deltas decode."""
+    rng = np.random.default_rng(17)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(3000, rng))
+    ctx.config.enable_rewrites = False
+    n1 = int(ctx.sql("SELECT count(*) AS n FROM ev")["n"][0])
+    ctx.append_rows("ev", [{"city": "austin", "year": 1997, "qty": 1,
+                            "rev": 0.0, "ts": T0}])
+    n2 = int(ctx.sql("SELECT count(*) AS n FROM ev")["n"][0])
+    assert n2 == n1 + 1
+    # a novel value changes the dictionary: decoded frames must follow
+    ctx.append_rows("ev", [{"city": "xylopolis", "year": 1997, "qty": 1,
+                            "rev": 0.0, "ts": T0}])
+    got = ctx.sql("SELECT count(*) AS n FROM ev WHERE city = 'xylopolis'")
+    assert int(got["n"][0]) == 1
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions (PR 6 code review)
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_compacts_tiny_append_trickle_by_segment_count():
+    """A 1-row-per-append trickle accretes padded SEGMENTS, not rows: the
+    sweep must gate on segment count too, or memory grows 1024x the data
+    while staying under the row threshold forever."""
+    rng = np.random.default_rng(19)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(2000, rng))
+    ctx.compactor.min_delta_rows = 1 << 20  # row gate never fires
+    ctx.compactor.min_delta_segments = 8
+    for i in range(8):
+        ctx.append_rows("ev", [{"city": "austin", "year": 1997, "qty": 1,
+                                "rev": 0.0, "ts": T0 + i * DAY}])
+    assert len(ctx.catalog.get("ev").delta_segments()) == 8
+    done = ctx.compactor.run_pending()
+    assert done and done[0]["compacted_rows"] == 8
+    assert ctx.catalog.get("ev").delta_segments() == ()
+
+
+def test_append_rejects_null_time_values():
+    rng = np.random.default_rng(20)
+    ctx = sd.TPUOlapContext()
+    _register(ctx, "ev", _rows(1000, rng))
+    with pytest.raises(ValueError, match="time column"):
+        ctx.append_rows("ev", [{"city": "austin", "year": 1997, "qty": 1,
+                                "rev": 0.0, "ts": None}])
+    # one null among valid rows is equally rejected (no silent NaT row)
+    with pytest.raises(ValueError, match="time column"):
+        ctx.append_rows("ev", {"city": ["a", "b"], "year": [1995, 1996],
+                               "qty": [1, 2], "rev": [0.1, 0.2],
+                               "ts": [T0, None]})
+
+
+def test_register_datasource_returns_version_stamped_snapshot():
+    rng = np.random.default_rng(21)
+    cols = _rows(1000, rng)
+    ds = build_datasource("t", cols, ["city", "year"], ["qty", "rev"],
+                          time_col="ts")
+    ctx = sd.TPUOlapContext()
+    out = ctx.register_datasource(ds)
+    assert out.version == ctx.catalog.datasource_version("t") == 1
+    ack = ctx.append_rows("t", [{"city": "austin", "year": 1997, "qty": 1,
+                                 "rev": 0.0, "ts": T0}])
+    assert ack["datasourceVersion"] == out.version + 1
+
+
+def test_http_ingest_tolerates_malformed_timeout(served):
+    ctx, srv = served
+    status, ack, _ = _post(
+        srv, "/druid/v2/ingest/ev",
+        {"rows": [{"city": "austin", "year": 1997, "qty": 1, "rev": 0.0,
+                   "ts": T0}],
+         "context": {"timeout": None}},
+    )
+    assert status == 200 and ack["appended"] == 1
+
+
+def test_extend_dict_large_domain_is_fast_and_exact():
+    """The old->new LUT is vectorized (the per-value code_of loop was
+    O(card^2) on string domains)."""
+    import time as _time
+
+    big = DimensionDict(values=tuple("v%07d" % i for i in range(200_000)))
+    t0 = _time.perf_counter()
+    new, lut = extend_dict(big, ["a_novel_value"])
+    took = _time.perf_counter() - t0
+    assert took < 2.0, f"extend_dict took {took:.1f}s on a 200K domain"
+    assert new.cardinality == big.cardinality + 1
+    assert new.values[0] == "a_novel_value"
+    np.testing.assert_array_equal(lut, np.arange(1, 200_001))

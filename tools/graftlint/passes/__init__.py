@@ -10,7 +10,7 @@ GL2xx trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
 lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
 collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
-lock-order; GL00x are the core's own: GL001 unparseable file, GL002
+lock-order, GL15xx ingest-discipline; GL00x are the core's own: GL001 unparseable file, GL002
 malformed pragma).
 """
 
@@ -24,6 +24,7 @@ from .collective_axis import CollectiveAxisPass
 from .compat_import import CompatImportPass
 from .dtype_x64 import DtypeX64Pass
 from .error_discipline import ErrorDisciplinePass
+from .ingest_discipline import IngestDisciplinePass
 from .jit_cache import JitCachePass
 from .jit_collision import JitCollisionPass
 from .lock_discipline import LockDisciplinePass
@@ -49,6 +50,7 @@ ALL_PASSES = (
     ResourceBudgetPass,
     JitCollisionPass,
     LockOrderPass,
+    IngestDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,282 @@
+"""ingest-discipline pass: the real-time ingestion tier's contracts
+(GL15xx, ISSUE 6 satellite).
+
+The ingestion tier (spark_druid_olap_tpu/ingest/) is the one subsystem
+that MUTATES shared catalog state while queries run concurrently, so its
+discipline is narrow and checkable:
+
+* **GL1501 — delta mutation outside the owning lock.**  Appends and
+  compactions read-modify-write a datasource's segment list; two writers
+  interleaving that cycle lose one writer's segments silently.  Flagged:
+  (a) writes to registered ingest-class guarded fields outside
+  `with self.<lock>:` (same lexical rule as lock-discipline/GL501, but
+  scoped to the ingest registry), and (b) a `catalog.put(...)` publish
+  from ingest code with NO `with <x>._lock:` lexically active — the
+  publish is the commit point of the read-modify-write and must sit
+  inside the per-datasource critical section.
+* **GL1502 — ingest/compaction loop never reaches a checkpoint.**  The
+  tier's loops iterate segments/shards/datasources doing real work
+  (encode, splice, remap); a loop that cannot observe an armed deadline
+  (`resilience.checkpoint`, lexically or one call level down) makes the
+  ingest route's wall-clock budget unenforceable — the same contract
+  checkpoint-coverage/GL901 pins on the query-side loops.
+* **GL1503 — unversioned write to catalog-registered state.**  Every
+  visible segment-set change must flow through `MetadataCache.put` (it
+  stamps the monotonic datasource version result caches key on).
+  Flagged in ingest modules: direct mutation of catalog internals
+  (`._tables` / `._stars` / `._ds_versions` subscripts or attributes)
+  and `object.__setattr__(...)` (mutating a frozen Segment/DataSource in
+  place bypasses versioning entirely — build a new snapshot instead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import LintPass, ModuleContext, dotted_name, has_jit_decorator
+
+_MUTATORS = {
+    "append", "pop", "clear", "update", "popitem", "move_to_end",
+    "setdefault", "add", "discard", "remove", "extend", "insert",
+}
+
+# ingest classes whose cross-thread fields must mutate under their lock
+_DEFAULT_REGISTRY = {
+    "_DeltaBuffer": {"lock": "_lock", "fields": ["_next_seq"]},
+    "DeltaBuffer": {"lock": "_lock", "fields": ["_next_seq"]},
+    "IngestManager": {"lock": "_lock", "fields": ["_buffers"]},
+    "Compactor": {
+        "lock": "_lock",
+        "fields": ["compactions_total", "_thread"],
+    },
+}
+
+_LOOP_KEYWORDS = (
+    "seg", "chunk", "shard", "delta", "datasource", "pending", "table",
+    "batch",
+)
+
+_CATALOG_INTERNALS = ("_tables", "_stars", "_ds_versions", "_lookups")
+
+
+def _header_tokens(nodes: Iterable[ast.AST]):
+    for root in nodes:
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Name):
+                yield sub.id.lower()
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr.lower()
+            elif isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str
+            ):
+                yield sub.value.lower()
+
+
+def _is_checkpoint(name: str, canon: str) -> bool:
+    return (
+        name == "checkpoint"
+        or name.endswith(".checkpoint")
+        or canon.endswith("resilience.checkpoint")
+    )
+
+
+class IngestDisciplinePass(LintPass):
+    name = "ingest-discipline"
+    default_config = {
+        # the tier this pass polices (fixtures re-create the layout)
+        "include": ("spark_druid_olap_tpu/ingest",),
+        "registry": _DEFAULT_REGISTRY,
+        "keywords": _LOOP_KEYWORDS,
+        "call_through_depth": 1,
+    }
+
+    # -- GL1501: lock discipline on ingest state ------------------------------
+
+    def _spec(self, ctx: ModuleContext):
+        cls = ctx.scope.current_class
+        if cls is None:
+            return None
+        return self.config["registry"].get(cls.name)
+
+    def _exempt(self, ctx: ModuleContext) -> bool:
+        func = ctx.scope.current_func
+        return func is None or getattr(func, "name", "") == "__init__"
+
+    @staticmethod
+    def _self_field(node: ast.AST):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _flag_field(self, ctx, node, field, spec):
+        self.report(
+            ctx, node, "GL1501",
+            f"ingest state self.{field} mutates outside "
+            f"`with self.{spec['lock']}:` — appends/compactions "
+            "read-modify-write shared segment state; an unlocked write "
+            "interleaves with a concurrent append and loses segments",
+        )
+
+    def on_Assign(self, node: ast.Assign, ctx: ModuleContext):
+        spec = self._spec(ctx)
+        if spec is not None and not self._exempt(ctx):
+            if not ctx.scope.holds_lock(spec["lock"]):
+                for t in node.targets:
+                    f = self._self_field(t)
+                    if f in spec["fields"]:
+                        self._flag_field(ctx, node, f, spec)
+                    sub = (
+                        t.value
+                        if isinstance(t, ast.Subscript)
+                        else None
+                    )
+                    f = self._self_field(sub) if sub is not None else None
+                    if f in spec["fields"]:
+                        self._flag_field(ctx, node, f, spec)
+        self._check_catalog_internals(node.targets, node, ctx)
+
+    def on_AugAssign(self, node: ast.AugAssign, ctx: ModuleContext):
+        spec = self._spec(ctx)
+        if spec is not None and not self._exempt(ctx):
+            if not ctx.scope.holds_lock(spec["lock"]):
+                f = self._self_field(node.target)
+                if f is None and isinstance(node.target, ast.Subscript):
+                    f = self._self_field(node.target.value)
+                if f in spec["fields"]:
+                    self._flag_field(ctx, node, f, spec)
+        self._check_catalog_internals([node.target], node, ctx)
+
+    def on_Delete(self, node: ast.Delete, ctx: ModuleContext):
+        self._check_catalog_internals(node.targets, node, ctx)
+
+    def _any_ingest_lock_held(self, ctx: ModuleContext) -> bool:
+        """Is ANY `with <expr>._lock:` lexically active in an enclosing
+        frame?  The publish commit point runs under the per-datasource
+        buffer lock, which is not an attribute of `self` — so this is
+        name-shape based, not registry based."""
+        for frame in ctx.scope.frames:
+            for item in frame.with_items:
+                name = dotted_name(item.context_expr) or ""
+                if name.endswith("._lock") or name.endswith(".lock"):
+                    return True
+        return False
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        func = node.func
+        # GL1503: object.__setattr__ — in-place mutation of a frozen
+        # Segment/DataSource bypasses the versioned publish entirely
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "__setattr__"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "object"
+        ):
+            self.report(
+                ctx, node, "GL1503",
+                "object.__setattr__ on catalog state: segments and "
+                "datasources are immutable-by-construction — build a new "
+                "snapshot and publish via MetadataCache.put (which stamps "
+                "the datasource version caches key on)",
+            )
+            return
+        # GL1501(b): the catalog publish must happen inside the ingest
+        # critical section
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "put"
+            and "catalog" in (dotted_name(func.value) or "")
+        ):
+            if ctx.scope.in_function and not self._any_ingest_lock_held(ctx):
+                self.report(
+                    ctx, node, "GL1501",
+                    "catalog.put(...) outside the ingest critical section "
+                    "— the publish commits a read-modify-write of the "
+                    "segment list; without `with <buffer>._lock:` a "
+                    "concurrent append's segments are silently lost",
+                )
+        # GL1501(a): mutator-method writes to registered guarded fields
+        spec = self._spec(ctx)
+        if spec is None or self._exempt(ctx):
+            return
+        if ctx.scope.holds_lock(spec["lock"]):
+            return
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            f = self._self_field(func.value)
+            if f in spec["fields"]:
+                self._flag_field(ctx, node, f, spec)
+
+    def _check_catalog_internals(self, targets, node, ctx: ModuleContext):
+        """GL1503: any write whose target chain touches MetadataCache
+        internals — ingest code publishes through put(), full stop."""
+        for t in targets:
+            root = t
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                name = (
+                    dotted_name(root)
+                    if isinstance(root, ast.Attribute)
+                    else dotted_name(root.value)
+                )
+                if name and any(
+                    name.endswith("." + f) or name == f
+                    for f in _CATALOG_INTERNALS
+                ):
+                    self.report(
+                        ctx, node, "GL1503",
+                        f"direct write to catalog internals ({name}) "
+                        "bypasses the versioned publish — every visible "
+                        "segment-set change must flow through "
+                        "MetadataCache.put so the datasource version "
+                        "bump invalidates result/program caches",
+                    )
+                    return
+                root = root.value
+
+    # -- GL1502: checkpoint coverage of ingest loops --------------------------
+
+    def _in_traced_scope(self, ctx: ModuleContext) -> bool:
+        return any(has_jit_decorator(f) for f in ctx.scope.func_stack)
+
+    def _matches(self, header_nodes) -> bool:
+        kws = self.config["keywords"]
+        return any(
+            any(k in tok for k in kws)
+            for tok in _header_tokens(header_nodes)
+        )
+
+    def on_For(self, node: ast.For, ctx: ModuleContext):
+        self._check_loop(node, (node.target, node.iter), ctx)
+
+    def on_While(self, node: ast.While, ctx: ModuleContext):
+        self._check_loop(node, (node.test,), ctx)
+
+    def _check_loop(self, node, header_nodes, ctx: ModuleContext):
+        if self.project is None:
+            return
+        if self._in_traced_scope(ctx):
+            return
+        if not self._matches(header_nodes):
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is None:
+            return
+        covered = self.project.reaches_call(
+            module, node, _is_checkpoint,
+            depth=int(self.config["call_through_depth"]),
+            cls=ctx.scope.current_class,
+        )
+        if covered:
+            return
+        self.report(
+            ctx, node, "GL1502",
+            "ingest/compaction loop never reaches a "
+            "resilience.checkpoint(site) — the ingest route promises the "
+            "same wall-clock deadline contract queries get, and this "
+            "loop is where an oversized append or compaction backlog "
+            "would blow it (checkpoint in the body or one call down; "
+            "cheap metadata-only loops take a pragma with a reason)",
+        )

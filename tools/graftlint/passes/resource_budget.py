@@ -16,12 +16,16 @@ known argument the cost model follows):
   per-kernel resident estimate is
 
       pipeline_factor x sum(prod(block_shape) x dtype_width per ref)
+        + sum(prod(shape) x dtype_width per pltpu.VMEM scratch)
 
   — every ref is double-buffered by the Pallas pipeline
   (pipeline_factor=2), dtype widths come from `out_shape` for outputs
   and floor at 1 byte for inputs (no static dtype source; the narrowest
-  real element keeps the estimate a true lower bound).  The budget is
-  resolved in
+  real element keeps the estimate a true lower bound).  `scratch_shapes`
+  entries that resolve to `pltpu.VMEM(shape, dtype)` count at 1x (scratch
+  is a single allocation, not pipelined) — previously uncounted, so
+  kernels with big scratch accumulators under-reported their residency
+  (ISSUE 6 satellite).  The budget is resolved in
   order: pass config override -> `calibration.<platform>.json`'s
   `vmem_budget_bytes` (the calibrated device constant) -> the scanned
   `config.py`'s `SessionConfig.vmem_budget_mb` default -> a built-in
@@ -249,10 +253,18 @@ class ResourceBudgetPass(LintPass):
             )
             refs.append((shape, width))
             exact = exact and shape is not None
+        # pltpu.VMEM scratch allocations: single-instance (1x, no pipeline
+        # double-buffering) but fully VMEM-resident for the kernel's
+        # lifetime — an unresolved scratch entry degrades the set to
+        # inexact (silence) rather than under-reporting
+        scratch, scratch_exact = self._scratch_refs(
+            module, kw.get("scratch_shapes"), env
+        )
+        exact = exact and scratch_exact
 
         # GL1203: degenerate block dims (checked per resolved spec even
-        # when the full set stays unresolved)
-        for shape, _ in refs:
+        # when the full set stays unresolved); scratch shapes included
+        for shape, _ in refs + scratch:
             if shape is not None and any(d <= 0 for d in shape):
                 self.report(
                     ctx, node, "GL1203",
@@ -269,13 +281,21 @@ class ResourceBudgetPass(LintPass):
         total = sum(
             self._prod(shape) * width for shape, width in refs
         )
-        resident = factor * total
+        scratch_bytes = sum(
+            self._prod(shape) * width for shape, width in scratch
+        )
+        resident = factor * total + scratch_bytes
         budget, source = self._resolve_budget()
         if resident > budget:
             breakdown = " + ".join(
                 f"{'x'.join(str(d) for d in shape)}*{width}B"
                 for shape, width in refs
             )
+            if scratch:
+                breakdown += " + scratch " + " + ".join(
+                    f"{'x'.join(str(d) for d in shape)}*{width}B"
+                    for shape, width in scratch
+                )
             self.report(
                 ctx, node, "GL1201",
                 f"kernel resident estimate {resident} bytes "
@@ -352,6 +372,56 @@ class ResourceBudgetPass(LintPass):
                     shape = v
             shapes.append(shape)
         return shapes
+
+    def _scratch_refs(
+        self, module, scratch, env
+    ) -> Tuple[List[Tuple[Tuple[int, ...], int]], bool]:
+        """Resolved `scratch_shapes` entries as (shape, dtype_width)
+        pairs, plus whether the WHOLE scratch set resolved.  Only
+        `pltpu.VMEM(shape, dtype)` entries occupy the VMEM budget; SMEM/
+        semaphore scratch lives elsewhere and resolves as zero-byte.
+        No scratch_shapes kwarg at all is exact by definition."""
+        if scratch is None:
+            return [], True
+        elts = self._resolve_seq(module, scratch, env)
+        if elts is None:
+            return [], False
+        out: List[Tuple[Tuple[int, ...], int]] = []
+        exact = True
+        for e in elts:
+            if not isinstance(e, ast.Call):
+                exact = False
+                continue
+            canon = self.project.canonical(module, call_name(e)) or ""
+            last = canon.rsplit(".", 1)[-1]
+            if last != "VMEM":
+                # SMEM / SemaphoreType etc.: not VMEM-resident bytes
+                continue
+            shape_expr = e.args[0] if e.args else None
+            dtype_expr = e.args[1] if len(e.args) > 1 else None
+            for k in e.keywords:
+                if k.arg == "shape":
+                    shape_expr = k.value
+                elif k.arg == "dtype":
+                    dtype_expr = k.value
+            v = self.project.const_eval(module, shape_expr, dict(env))
+            if not (
+                isinstance(v, tuple)
+                and all(isinstance(d, int) for d in v)
+            ):
+                exact = False
+                continue
+            dt = (
+                self.project.canonical(module, dotted_name(dtype_expr))
+                or ""
+                if dtype_expr is not None
+                else ""
+            )
+            width = _DTYPE_WIDTH.get(
+                dt.rsplit(".", 1)[-1], _DEFAULT_WIDTH
+            )
+            out.append((v, width))
+        return out, exact
 
     def _out_dtypes(self, module, out_shape, env) -> List[str]:
         if out_shape is None:
