@@ -1443,6 +1443,126 @@ def bench_ingest(rows_m: float):
     }
 
 
+def bench_deadline(scale: float):
+    """Anytime-answers robustness artifact (ISSUE 7): the coverage-vs-
+    deadline curve.  SSB-13 runs under a sweep of wall-clock deadlines
+    (fractions of each query's measured full latency, down to ~1 ms);
+    every run must return a WELL-FORMED answer — exact when the budget
+    suffices, coverage-stamped partial when it expires mid-scan, never
+    an exception.  The artifact records, per (query, deadline): the
+    deadline, the achieved coverage, partial/exact, wall time, and
+    oracle equality for coverage=1.0 answers; the headline value is the
+    percentage of runs that answered well-formed (target: 100 — the
+    pre-ISSUE-7 engine scores 0 on any mid-scan expiry, which all
+    turned into errors)."""
+    import spark_druid_olap_tpu as sd  # noqa: F401  (bench convention)
+    from spark_druid_olap_tpu.utils.floatcmp import frames_allclose
+    from spark_druid_olap_tpu.workloads import ssb
+
+    ctx = _calibrated_ctx()
+    # every sweep point must EXECUTE: a result-cache hit would report
+    # coverage 1.0 without ever testing the deadline machinery
+    ctx.config.result_cache_entries = 0
+    tables = ssb.gen_tables(scale=scale)
+    # smaller segments than the register default so a mid-scan expiry
+    # has batch boundaries to land on at any scale
+    ssb.register(ctx, tables=tables, rows_per_segment=1 << 17)
+    n_rows = ctx.catalog.get("lineorder").num_rows
+
+    oracle, full_ms = {}, {}
+    for name, q in ssb.QUERIES.items():
+        t = _timed(lambda n=name: ctx.sql(ssb.QUERIES[n]), reps=1)
+        oracle[name] = ctx.sql(q)
+        full_ms[name] = t * 1e3
+
+    # deadline sweep: fixed 1ms floor (guaranteed mid-scan expiry on any
+    # backend), then fractions of each query's own measured full latency
+    fractions = (0.0, 0.1, 0.25, 0.5, 1.5)
+    curves = {}
+    runs = wellformed = exact_checked = 0
+    worst_tree = None
+    for name, q in ssb.QUERIES.items():
+        points = []
+        for frac in fractions:
+            deadline_ms = max(1.0, frac * full_ms[name])
+            ctx.config.query_timeout_ms = int(deadline_ms)
+            t0 = time.perf_counter()
+            point = {
+                "deadline_ms": round(deadline_ms, 2),
+                "fraction_of_full": frac,
+            }
+            runs += 1
+            try:
+                got = ctx.sql(q)
+                m = ctx.last_metrics
+                cov = (
+                    None
+                    if m is None
+                    else (m.coverage if m.partial else 1.0)
+                )
+                point.update(
+                    {
+                        "wellformed": True,
+                        "partial": bool(m.partial) if m else False,
+                        "coverage": cov,
+                        "rows_seen": m.rows_seen if m else None,
+                        "executor": m.executor if m else None,
+                        "total_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 2
+                        ),
+                    }
+                )
+                wellformed += 1
+                if cov == 1.0:
+                    ok, msg = frames_allclose(got, oracle[name])
+                    point["oracle_equal"] = bool(ok)
+                    exact_checked += 1
+                    if not ok:
+                        point["oracle_diff"] = msg[:200]
+                if frac == 0.0 and worst_tree is None:
+                    worst_tree = _span_tree(ctx)
+            except Exception as e:  # fault-ok: the artifact records the miss
+                point.update(
+                    {
+                        "wellformed": False,
+                        "error_class": type(e).__name__,
+                        "error": str(e)[:200],
+                    }
+                )
+            _note_partial("%s@%g" % (name, frac), point)
+            points.append(point)
+        curves[name] = points
+    ctx.config.query_timeout_ms = 0
+    frac_ok = wellformed / max(1, runs)
+    oracle_ok = all(
+        p.get("oracle_equal", True)
+        for pts in curves.values()
+        for p in pts
+    )
+    return {
+        "metric": "deadline_ssb_sf%g_wellformed_pct" % scale,
+        "value": round(100.0 * frac_ok, 2),
+        "unit": "%",
+        # 1.0 == every deadline-bounded run answered well-formed (the
+        # seed engine turns every mid-scan expiry into an error: 0.0)
+        "vs_baseline": round(frac_ok if oracle_ok else 0.0, 4),
+        "detail": {
+            "rows": n_rows,
+            "runs": runs,
+            "wellformed": wellformed,
+            "exact_answers_checked": exact_checked,
+            "oracle_equal_all": oracle_ok,
+            "full_latency_ms": {
+                k: round(v, 2) for k, v in full_ms.items()
+            },
+            "deadline_fractions": list(fractions),
+            "curves": curves,
+            "span_tree_tightest_deadline": worst_tree,
+            "device": _device(),
+        },
+    }
+
+
 def bench_calibrate(rows_log2: int):
     import os
 
@@ -1472,6 +1592,7 @@ MODES = {
     "timeseries": (bench_timeseries, 12),
     "cube_theta": (bench_cube_theta, 0.25),
     "ingest": (bench_ingest, 2.0),
+    "deadline": (bench_deadline, 1.0),
     "calibrate": (bench_calibrate, 23),
 }
 

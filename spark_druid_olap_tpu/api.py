@@ -37,9 +37,11 @@ from .obs import (
     SPAN_DEGRADED,
     SPAN_EXECUTE,
     SPAN_FALLBACK,
+    SPAN_PARTIAL,
     SPAN_PLAN,
     Tracer,
     current_query_id,
+    record_partial,
     record_query_metrics,
     span,
     span_event,
@@ -55,9 +57,13 @@ log = get_logger("api")
 
 def _breaker_observation(br) -> dict:
     """Small JSON-able snapshot of the circuit breaker as the routing
-    layer saw it — what degraded-path span events carry."""
+    layer saw it — what degraded-path span events carry.  Carries which
+    BACKEND's breaker was consulted (device / mesh / fallback): with
+    per-backend granularity, "why did this query degrade" needs to name
+    the breaker that said no."""
     d = br.to_dict()
     return {
+        "backend": d["backend"],
         "state": d["state"],
         "consecutive_failures": d["consecutive_failures"],
         "trips": d["trips"],
@@ -86,7 +92,10 @@ class TPUOlapContext:
         # per-query span tracing (obs/): the ring buffer behind
         # GET /druid/v2/trace/{query_id}; the metrics registry itself is
         # process-global (obs.registry.get_registry)
-        self.tracer = Tracer(capacity=self.config.trace_ring_capacity)
+        self.tracer = Tracer(
+            capacity=self.config.trace_ring_capacity,
+            otlp_path=self.config.otlp_export_path,
+        )
         # SQL-text -> Rewrite cache (the reference re-plans every Catalyst
         # round; locally a repeated dashboard query should pay parse+plan
         # once).  Keyed on catalog version + config so any re-registration
@@ -403,7 +412,7 @@ class TPUOlapContext:
         )
 
     def sql(self, sql_text: str):
-        from .resilience import deadline_scope
+        from .resilience import deadline_scope, partial_scope
         from .sql.commands import parse_command, run_command
 
         cmd = parse_command(sql_text)
@@ -413,10 +422,14 @@ class TPUOlapContext:
         # scope (the server's wire `context.timeout`) is already active.
         # The query trace joins the server's when one is active (outermost
         # wins, same contract as deadline_scope); a direct ctx.sql call
-        # gets its own generated query_id.
+        # gets its own generated query_id.  The partial-result collector
+        # arms under the same outermost-wins rule: deadline expiry then
+        # degrades to a coverage-stamped best-effort answer.
         with self.tracer.query_trace(
             query_type="sql", slow_ms=self.config.slow_query_ms
-        ), deadline_scope(self.config.query_timeout_ms):
+        ), deadline_scope(self.config.query_timeout_ms), partial_scope(
+            self.config.partial_results
+        ):
             plan_err = None
             with span(SPAN_PLAN):
                 key = self._plan_cache_key(sql_text)
@@ -441,29 +454,49 @@ class TPUOlapContext:
                     else:
                         self._plan_cache[key] = (rw, lp)
             if rw is None:
-                return self._run_fallback(lp, plan_err)
+                return self._stamp_partial(self._run_fallback(lp, plan_err))
             with span(SPAN_EXECUTE):
-                return self._execute_with_resilience(rw, lp)
+                return self._stamp_partial(
+                    self._execute_with_resilience(rw, lp)
+                )
 
-    def _sync_engine_resilience(self, engine):
-        """Point an engine at this context's shared breaker and sync the
-        retry budget from the session config (engines construct with
-        standalone defaults so direct Engine() use keeps working)."""
-        engine.breaker = self.resilience.breaker
+    def _sync_engine_resilience(self, engine, backend: str = "device"):
+        """Point an engine at this context's breaker for `backend`
+        ("device" for the local engine, "mesh" for the distributed one —
+        per-backend breakers mean a sick mesh cannot darken the
+        single-device path) and sync the retry budget from the session
+        config (engines construct with standalone defaults so direct
+        Engine() use keeps working)."""
+        engine.breaker = self.resilience.breaker_for(backend)
         engine._retry_attempts = self.config.retry_max_attempts
         engine._retry_backoff_ms = self.config.retry_backoff_ms
 
+    def _backend_for(self, rw: Rewrite) -> str:
+        """Which execution backend this rewrite will run on — the same
+        decision _engine_for makes, shared so breaker routing and engine
+        selection can never disagree."""
+        phys = rw.physical
+        if phys.distributed and phys.mesh_shape is not None:
+            import jax
+
+            if len(jax.devices()) >= phys.mesh_shape[0] * phys.mesh_shape[1]:
+                return "mesh"
+        return "device"
+
     def _execute_with_resilience(self, rw: Rewrite, lp):
-        """Device execution under the circuit breaker, degrading to the
-        host fallback on an open circuit or a transient failure that
-        survived the engine's retry budget — the runtime extension of the
-        reference's 'a failed rewrite is never an error' stance.  Static
-        errors and deadline expiry surface unchanged (retrying a timed-out
-        query would only time out slower)."""
-        from .resilience import classify_error
+        """Device execution under the target backend's circuit breaker,
+        degrading to the host fallback on an open circuit or a transient
+        failure that survived the engine's retry budget — the runtime
+        extension of the reference's 'a failed rewrite is never an error'
+        stance.  Static errors surface unchanged; deadline expiry
+        degrades to a coverage-stamped PARTIAL answer when the collector
+        is armed (config.partial_results), and surfaces otherwise
+        (retrying a timed-out query would only time out slower)."""
+        from .resilience import classify_error, current_partial
 
         res = self.resilience
-        br = res.breaker
+        backend = self._backend_for(rw)
+        br = res.breaker_for(backend)
         can_degrade = (
             lp is not None
             and self.config.fallback_execution
@@ -475,7 +508,7 @@ class TPUOlapContext:
             if hit is not None:
                 return hit
             log.warning(
-                "device circuit open; answering on the host fallback"
+                "%s circuit open; answering on the host fallback", backend
             )
             with span(SPAN_DEGRADED, reason="circuit_open"):
                 # the breaker state OBSERVED at routing time: the trace
@@ -484,15 +517,31 @@ class TPUOlapContext:
                 # follow-up (c))
                 span_event("breaker_state", **_breaker_observation(br))
                 df = self._run_fallback(
-                    lp, None, reason="device circuit open"
+                    lp, None, reason=f"{backend} circuit open"
                 )
-            self._stamp_degraded(None)
+            self._stamp_degraded(None, backend=backend)
             return df
         try:
             df = self.execute_rewrite(rw)
         except Exception as err:
             kind = classify_error(err)
             if kind == "deadline":
+                pc = current_partial()
+                if pc is not None:
+                    # the deadline expired OUTSIDE the partial-capable
+                    # loops (planning, a blocking fetch, a ladder rung):
+                    # trigger the collector and drain-rerun — every
+                    # checkpoint is now a no-op and the executor loops
+                    # stop at their first batch, so the rerun costs one
+                    # lowering + an empty finalize and yields the
+                    # well-formed zero-or-low-coverage answer instead of
+                    # a 504
+                    pc.trigger(getattr(err, "site", "") or "deadline")
+                    log.warning(
+                        "deadline expired outside a partial-capable "
+                        "loop (%s); draining a best-effort answer", err,
+                    )
+                    return self.execute_rewrite(rw)
                 res.note_deadline_exceeded()
                 err._sdol_counted = True  # the server layer must not re-count
                 m = self.last_metrics
@@ -502,9 +551,9 @@ class TPUOlapContext:
             if kind != "transient" or not can_degrade:
                 raise
             log.warning(
-                "device execution failed (%s: %s) after retries; "
+                "%s execution failed (%s: %s) after retries; "
                 "degrading to the host fallback",
-                type(err).__name__, err,
+                backend, type(err).__name__, err,
             )
             with span(SPAN_DEGRADED, reason="device_failed"):
                 span_event(
@@ -513,9 +562,9 @@ class TPUOlapContext:
                     **_breaker_observation(br),
                 )
                 df = self._run_fallback(
-                    lp, err, reason="device execution failed"
+                    lp, err, reason=f"{backend} execution failed"
                 )
-            self._stamp_degraded(err)
+            self._stamp_degraded(err, backend=backend)
             return df
         m = self.last_metrics
         # report to the breaker for EVERY query type: the GroupBy engines
@@ -531,15 +580,83 @@ class TPUOlapContext:
             m.circuit_state = br.state
         return df
 
-    def _stamp_degraded(self, err):
+    def _stamp_degraded(self, err, backend: str = "device"):
         """Mark the (fallback) metrics of a degraded answer and count it."""
         self.resilience.note_degraded()
         m = self.last_metrics
         if m is not None:
             m.degraded = True
-            m.circuit_state = self.resilience.breaker.state
+            m.circuit_state = self.resilience.breaker_for(backend).state
             if err is not None:
                 m.error_class = type(err).__name__
+
+    def _stamp_partial(self, df):
+        """Stamp a deadline-bounded PARTIAL answer: the result frame's
+        attrs carry {"partial": True, "coverage": ...} (the SQL-surface
+        contract; the server folds the same dict into
+        X-Druid-Response-Context), the metrics carry partial/coverage,
+        and the `partial` span + coverage histogram record it for the
+        trace and the fleet (partial-result discipline, GL16xx).  A
+        no-op for complete answers."""
+        from .resilience import current_partial
+
+        pc = current_partial()
+        if pc is None or not pc.is_partial:
+            return df
+        info = pc.to_dict()
+        with span(
+            SPAN_PARTIAL,
+            coverage=info["coverage"],
+            site=info["site"],
+            rows_seen=info["rows_seen"],
+            rows_total=info["rows_total"],
+        ):
+            record_partial(
+                info["coverage"], site=info["site"] or "",
+                query_id=current_query_id(),
+            )
+        m = self.last_metrics
+        if m is not None:
+            m.partial = True
+            m.coverage = info["coverage"]
+            m.rows_seen = info["rows_seen"]
+            m.delta_rows_seen = info["delta_rows_seen"]
+        try:
+            df.attrs.update(info)
+        except AttributeError:  # fault-ok: non-pandas results skip attrs
+            pass
+        return df
+
+    def execute_native_degraded(
+        self, q, err=None, reason: str = "native degradation",
+        backend: str = "device",
+    ):
+        """Answer a wire-native QuerySpec on the host fallback — the
+        degradation-matrix cell that used to 503.  The spec decodes to a
+        logical plan (exec/wire_fallback.py, riding the WIRE_AGG_FALLBACK
+        registry) and runs through the SAME `_run_fallback` gate SQL
+        queries degrade through, so policy (fallback_execution, size
+        ceiling, fallback breaker) cannot drift between surfaces.
+        Raises WireFallbackUnsupported for specs outside the
+        interpreter's coverage — the server then falls back to the old
+        fail-fast 503."""
+        from .exec.wire_fallback import native_to_logical, shape_native_result
+
+        ds = self.catalog.get(q.datasource)
+        if ds is None:
+            raise RewriteError(f"unknown table {q.datasource!r}")
+        lp = native_to_logical(q, ds)  # may raise WireFallbackUnsupported
+        with span(SPAN_DEGRADED, reason="native_" + reason):
+            span_event(
+                "breaker_state",
+                **_breaker_observation(self.resilience.breaker_for(backend)),
+            )
+            df = self._run_fallback(lp, err, reason=reason)
+        self._stamp_degraded(err, backend=backend)
+        # partial-result discipline (GL16xx): a deadline-bounded degraded
+        # answer publishes its coverage (partial span + fleet counter)
+        # exactly like the SQL surface — the server only adds the header
+        return shape_native_result(q, ds, self._stamp_partial(df))
 
     def _run_fallback(self, lp, err, reason: str = "rewrite failed"):
         """The reference's vanilla-Spark fallback: a failed rewrite runs
@@ -562,6 +679,25 @@ class TPUOlapContext:
             if err is not None:
                 raise err
             raise RewriteError("fallback execution is disabled")
+        # the FALLBACK breaker: the host interpreter is itself a backend
+        # that can be sick (torn decodes, host I/O faults) — consecutive
+        # transient failures open it, and while open a degraded query
+        # fails FAST with the original error instead of re-grinding a
+        # known-bad path; a half-open probe recovers it.  Per-backend
+        # granularity: this breaker never touches device/mesh routing.
+        fb = self.resilience.breaker_for("fallback")
+        if not fb.allow():
+            from .resilience import CircuitOpenError
+
+            log.warning(
+                "host-fallback circuit open; failing fast (%s)", reason
+            )
+            if err is not None:
+                raise err
+            raise CircuitOpenError(
+                "host-fallback circuit open and no healthier backend "
+                "remains — retry after the breaker's cooldown"
+            )
 
         log.warning(
             "%s (%s); executing on the host fallback", reason, err
@@ -673,11 +809,56 @@ class TPUOlapContext:
             assists["n"] += 1
             return out
 
-        with span(SPAN_FALLBACK, reason=reason):
-            df = execute_fallback(
-                lp, self.catalog, max_rows=self.config.fallback_max_rows,
-                device_exec=device_subplan,
-            )
+        from .resilience import DeadlineExceeded, classify_error, current_partial
+
+        pc = current_partial()
+        if pc is not None:
+            # the interpreter owns ONE accounting pass spanning every
+            # table it decodes; assist subtrees must not reset it
+            pc.begin_pass()
+            pc.in_fallback = True
+        try:
+            with span(SPAN_FALLBACK, reason=reason):
+                df = execute_fallback(
+                    lp, self.catalog,
+                    max_rows=self.config.fallback_max_rows,
+                    device_exec=device_subplan,
+                )
+        except DeadlineExceeded as dl_err:
+            # expiry at an interpretation checkpoint (decode-site expiry
+            # is absorbed inline by checkpoint_partial): trigger the
+            # collector and drain-rerun — the decoded-frame cache makes
+            # the second decode ~free, checkpoints are now no-ops, and
+            # the interpreter finishes over the full frames, so the
+            # "partial" usually drains to the complete answer
+            if pc is None:
+                raise
+            pc.trigger(dl_err.site or "fallback.interp")
+            # the rerun's own accounting is the truth about what the
+            # final answer saw: the aborted pass's scope/seen counters
+            # would double the denominator and claim rows the rerun
+            # never serves (decoded_frame in drain mode only includes
+            # warm-cached segments)
+            pc.reset_for_drain()
+            with span(SPAN_FALLBACK, reason="deadline_drain"):
+                df = execute_fallback(
+                    lp, self.catalog,
+                    max_rows=self.config.fallback_max_rows,
+                    device_exec=device_subplan,
+                )
+            fb.record_success()
+        except Exception as fb_err:
+            # only TRANSIENT failures (decode faults, host I/O) count on
+            # the fallback breaker — a static plan/shape gap is a
+            # property of the query, not of the backend's health
+            if classify_error(fb_err) == "transient":
+                fb.record_failure()
+            raise
+        else:
+            fb.record_success()
+        finally:
+            if pc is not None:
+                pc.in_fallback = False
         from .exec.fallback import plan_tables
 
         tables = sorted(plan_tables(lp))
@@ -691,11 +872,18 @@ class TPUOlapContext:
             total_ms=(_time.perf_counter() - t0) * 1e3,
             assist_subplans=assists["n"],
         )
+        if pc is not None and pc.is_partial:
+            # partial-result discipline (GL16xx): partial=True always
+            # travels with its coverage fraction
+            m.partial = True
+            m.coverage = pc.coverage()
+            m.rows_seen = pc.rows_seen
+            m.delta_rows_seen = pc.delta_rows_seen
         self._last_engine_metrics = m
         # the host interpreter publishes into the process registry like
         # the device engines do (obs/): fallback traffic must be visible
         # in the fleet-level counts, not just last_metrics
-        record_query_metrics(m, "ok")
+        record_query_metrics(m, "partial" if m.partial else "ok")
         return df
 
     def _result_key(self, rw: Rewrite, ds=None):
@@ -803,7 +991,14 @@ class TPUOlapContext:
             extra = [c for c in df.columns if c not in cols and c == "__grouping_id"]
             df = df[cols + extra]
         if rkey is not None:
-            self._result_cache[rkey] = df.copy()
+            from .resilience import current_partial
+
+            pc = current_partial()
+            # a deadline-truncated answer must NEVER enter the result
+            # cache: it would be served back as the exact answer to the
+            # next identical (undeadlined) query
+            if pc is None or not pc.triggered:
+                self._result_cache[rkey] = df.copy()
         return df
 
     def _execute_exact_distinct(self, spec, use_result_cache: bool = True):
@@ -865,23 +1060,25 @@ class TPUOlapContext:
 
     def _engine_for(self, rw: Rewrite):
         phys = rw.physical
-        if phys.distributed and phys.mesh_shape is not None:
-            import jax
+        # ONE routing decision, shared with breaker selection: branching
+        # on _backend_for here is what keeps its "can never disagree"
+        # docstring true — an edit to the mesh condition lands on both
+        if self._backend_for(rw) == "mesh":
+            if self._dist_engine is None:
+                from .parallel.distributed import DistributedEngine
+                from .parallel.mesh import make_mesh
 
-            if len(jax.devices()) >= phys.mesh_shape[0] * phys.mesh_shape[1]:
-                if self._dist_engine is None:
-                    from .parallel.distributed import DistributedEngine
-                    from .parallel.mesh import make_mesh
-
-                    self._dist_engine = DistributedEngine(
-                        mesh=make_mesh(*phys.mesh_shape)
-                    )
-                # route mesh kernels by the SESSION's cost constants, not
-                # a fresh file load — re-synced EVERY call (same as the
-                # local engine below) so a replaced ctx.config is honored
-                self._dist_engine._calibrated_cfg = self.config
-                self._sync_engine_resilience(self._dist_engine)
-                return self._dist_engine
+                self._dist_engine = DistributedEngine(
+                    mesh=make_mesh(*phys.mesh_shape)
+                )
+            # route mesh kernels by the SESSION's cost constants, not
+            # a fresh file load — re-synced EVERY call (same as the
+            # local engine below) so a replaced ctx.config is honored
+            self._dist_engine._calibrated_cfg = self.config
+            # the mesh path reports to ITS OWN breaker: a sick mesh
+            # trips only itself, single-device queries stay routed
+            self._sync_engine_resilience(self._dist_engine, "mesh")
+            return self._dist_engine
         # the engine's adaptive tier picks its compact-domain kernel from
         # the session's cost constants, not a fresh file load
         self.engine._calibrated_cfg = self.config
@@ -1133,21 +1330,27 @@ class TableQuery:
         return plan
 
     def collect(self):
-        from .resilience import deadline_scope
+        from .resilience import deadline_scope, partial_scope
 
         lp = self._logical()
         with self.ctx.tracer.query_trace(
             query_type="dataframe", slow_ms=self.ctx.config.slow_query_ms
-        ), deadline_scope(self.ctx.config.query_timeout_ms):
+        ), deadline_scope(self.ctx.config.query_timeout_ms), partial_scope(
+            self.ctx.config.partial_results
+        ):
             with span(SPAN_PLAN):
                 try:
                     rw = self.ctx._planner().plan(lp)
                 except RewriteError as err:
                     rw, plan_err = None, err
             if rw is None:
-                return self.ctx._run_fallback(lp, plan_err)
+                return self.ctx._stamp_partial(
+                    self.ctx._run_fallback(lp, plan_err)
+                )
             with span(SPAN_EXECUTE):
-                return self.ctx._execute_with_resilience(rw, lp)
+                return self.ctx._stamp_partial(
+                    self.ctx._execute_with_resilience(rw, lp)
+                )
 
     def collect_arrow(self):
         """`collect()` as a `pyarrow.Table`."""

@@ -186,6 +186,15 @@ class SessionConfig:
     # deadline's remaining budget)
     retry_max_attempts: int = 2
     retry_backoff_ms: float = 25.0
+    # deadline-bounded PARTIAL answers (ISSUE 7): when a deadline expires
+    # at an executor checkpoint, merge the per-segment partials
+    # accumulated so far and return them stamped partial=True with a
+    # coverage fraction, instead of erroring.  Every aggregate state in
+    # the engine is mergeable, so "the rows seen so far" is a safe
+    # answer (Partial Partial Aggregates).  False restores hard
+    # DeadlineExceeded errors; the wire context key `partialResults`
+    # overrides per request.
+    partial_results: bool = True
 
     # -- real-time ingestion tier (ingest/) ---------------------------------
     # rows per published delta segment before an append batch splits; the
@@ -211,6 +220,11 @@ class SessionConfig:
     # finished span trees retained for GET /druid/v2/trace/{query_id}
     # (FIFO eviction past the capacity)
     trace_ring_capacity: int = 64
+    # emit-only OTLP export (ROADMAP obs follow-up (d)): when set, every
+    # finished trace appends one OTLP/JSON ResourceSpans line to this
+    # file (obs/otlp.py) — no collector or network dependency; None
+    # disables
+    otlp_export_path: Optional[str] = None
 
     # provenance of the cost constants (set by load_calibrated): {path,
     # device, partial, applied, mismatch?} or None when never loaded from

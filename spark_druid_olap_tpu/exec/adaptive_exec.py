@@ -47,6 +47,7 @@ import numpy as np
 
 from ..catalog.segment import DataSource
 from ..models import query as Q
+from ..resilience import DeadlineExceeded, current_partial, fire
 from ..utils.log import get_logger
 from .finalize import finalize_groupby
 from .lowering import (
@@ -447,8 +448,22 @@ class AdaptiveDomainMixin:
                     )
                 return counts
 
+            # fault-injection site: phase A dispatches the presence
+            # program to the device (phase B goes through the engine's
+            # _call_segment_program, which has its own site).  OUTSIDE
+            # the try below: an injected transient must decline this
+            # dispatch (caller falls through to sparse/dense, whose
+            # sites feed the retry/breaker machinery) — not be misread
+            # as a Mosaic failure that memo-declines the query shape or
+            # pins _pallas_broken for the engine's lifetime.
+            fire("device_dispatch")
             try:
                 counts = run_presence()
+            except DeadlineExceeded:
+                # a deadline is a property of THIS request, not of the
+                # query shape: memo-declining would pin adaptive off for
+                # every later (unbudgeted) run of the same query
+                raise
             except Exception:
                 # mirror _call_segment_program: a Mosaic failure of a
                 # Pallas presence kernel downgrades to the XLA strategies
@@ -462,6 +477,8 @@ class AdaptiveDomainMixin:
                 self._pallas_broken = True
                 try:
                     counts = run_presence()
+                except DeadlineExceeded:
+                    raise
                 except Exception:
                     self._adaptive_declined.add(qkey)
                     raise
@@ -496,6 +513,17 @@ class AdaptiveDomainMixin:
             return None
         try:
             kept = self._adaptive_kept_codes(q, ds, lowering, segs)
+        except DeadlineExceeded as err:
+            # the deadline expired during phase A: there are no aggregate
+            # partials yet (presence counts are not an answer).  With a
+            # partial collector armed, trigger it and decline — the dense
+            # path then drains immediately to the well-formed
+            # zero-coverage answer; without one, expiry stays an error.
+            pc = current_partial()
+            if pc is None:
+                raise
+            pc.trigger(err.site or "adaptive.presence_loop")
+            return None
         except Exception:
             log.warning("adaptive presence pass failed", exc_info=True)
             return None
@@ -503,7 +531,17 @@ class AdaptiveDomainMixin:
             return None
         if any(len(kd) == 0 for kd in kept):
             # some grouping dim has NO present code under the filter: the
-            # exact result is the empty grouped frame
+            # exact result is the empty grouped frame.  The presence pass
+            # scanned the full scope to prove it, so account the pass as
+            # fully seen — a deadline trigger later in the lifecycle must
+            # stamp coverage 1.0, not flag the exact answer partial.
+            pc = current_partial()
+            if pc is not None:
+                from .engine import _row_counts
+
+                pc.begin_pass()
+                pc.add_scope(len(segs), *_row_counts(segs))
+                pc.add_seen(len(segs), *_row_counts(segs))
             la = lowering.la
             sums, mins, maxs, sketch_states = empty_partials(la, 0)
             df = finalize_groupby(
@@ -527,6 +565,8 @@ class AdaptiveDomainMixin:
                 q, ds, lowering=clow, key_extra=("adaptive",) + cards,
                 strategy_override=strat,
             )
+        except DeadlineExceeded:
+            raise  # partial-capable loops absorb expiry; a raise is real
         except Exception:
             log.warning("adaptive compact dispatch failed", exc_info=True)
             return None
@@ -543,6 +583,8 @@ class AdaptiveDomainMixin:
                     {k: np.asarray(v) for k, v in sketch_states.items()},
                 )
                 return df, "ok"
+            except DeadlineExceeded:
+                raise  # never demote a deadline to an adaptive decline
             except Exception:
                 log.warning("adaptive resolve failed", exc_info=True)
                 return None, "error"

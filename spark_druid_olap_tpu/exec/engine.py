@@ -69,7 +69,7 @@ from ..obs import (
     record_query_metrics,
     span,
 )
-from ..resilience import checkpoint, fire
+from ..resilience import checkpoint, checkpoint_partial, current_partial, fire
 from ..utils.log import get_logger
 from .adaptive_exec import AdaptiveDomainMixin
 from .sparse_exec import SparseExecMixin
@@ -92,6 +92,23 @@ def _bytes_scanned(segs, columns) -> int:
                 pass  # virtual columns are computed, not read
         total += row_bytes * s.num_rows
     return total
+
+
+def _row_counts(segs) -> Tuple[int, int]:
+    """(total real rows, delta-segment rows) of a segment list — the
+    partial-result coverage accounting unit.  Delta rows are reported
+    separately so a best-effort answer can say how much of it came from
+    freshly-appended data vs historicals (and the ingest hammer can
+    assert deltas are never double-counted)."""
+    from ..catalog.segment import DeltaSegment
+
+    rows = delta = 0
+    # graftlint: disable=checkpoint-coverage -- O(segments) host metadata sum, no dispatch/decode per iteration
+    for s in segs:
+        rows += s.num_rows
+        if isinstance(s, DeltaSegment):
+            delta += s.num_rows
+    return rows, delta
 
 
 def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
@@ -542,10 +559,23 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         sums = mins = maxs = None
         sketch_states: Dict[str, Any] = {}
         segs = self._segments_in_scope(q, ds)
+        pc = current_partial()
         if not segs:
-            # empty time range is a valid query: zero-row result, not an error
+            # empty time range is a valid query: zero-row result, not an
+            # error — and a COMPLETE one.  Declare the empty scope so a
+            # deadline trigger later in the lifecycle cannot flag the
+            # exact empty answer partial with an unknown denominator.
+            if pc is not None:
+                pc.begin_pass()
+                pc.add_scope(0, 0)
             sums, mins, maxs, sketch_states = empty_partials(la, G)
             return dims, la, G, sums, mins, maxs, sketch_states
+        # deadline-bounded partial answers: declare the pass's scope so a
+        # mid-scan expiry can stamp an honest coverage fraction onto the
+        # merged partials (resilience.PartialCollector)
+        if pc is not None:
+            pc.begin_pass()
+            pc.add_scope(len(segs), *_row_counts(segs))
         # segments fuse into batched programs (partial agg + cross-segment
         # merge inside): the common case is ONE dispatch + ONE fetch per
         # query; oversized scopes merge across a few batch dispatches
@@ -555,8 +585,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         )
         for bi, batch in enumerate(self._segment_batches(segs, need)):
             # cooperative deadline checkpoint: a query with a wall-clock
-            # budget cancels between batch dispatches, not at the very end
-            checkpoint("engine.segment_loop")
+            # budget cancels between batch dispatches, not at the very
+            # end — and with a partial collector armed, expiry STOPS the
+            # dispatch loop instead of erroring (the partials accumulated
+            # so far merge into a best-effort answer)
+            if checkpoint_partial("engine.segment_loop"):
+                break
             with span(SPAN_H2D, batch=bi, segments=len(batch)):
                 cols_list = [
                     self._cols_for_segment(seg, ds, need) for seg in batch
@@ -569,6 +603,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
             _merge_sketch_states(la, sketch_states, sk)
+            if pc is not None:
+                pc.add_seen(len(batch), *_row_counts(batch))
+        if sums is None:
+            # the deadline expired before the FIRST batch dispatched: the
+            # well-formed zero-coverage answer is the empty partial state
+            sums, mins, maxs, sketch_states = empty_partials(la, G)
         return dims, la, G, sums, mins, maxs, sketch_states
 
     def _call_segment_program(
@@ -867,6 +907,17 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             else:  # phase-1 failure: resolve never started
                 m.total_ms = (now - t_total) * 1e3
             m.bytes_resident = self.bytes_resident()
+            # deadline-bounded partial answer: stamp the coverage the
+            # collector accounted (partial-result discipline: a
+            # partial=True result ALWAYS carries its coverage fraction)
+            pc = current_partial()
+            if pc is not None and pc.is_partial:
+                m.partial = True
+                m.coverage = pc.coverage()
+                m.rows_seen = pc.rows_seen
+                m.delta_rows_seen = pc.delta_rows_seen
+                if outcome["v"] == "ok":
+                    outcome["v"] = "partial"
             self.last_metrics = m
             self._m = None
             # every completed execution publishes into the process metrics
@@ -929,8 +980,11 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             try:
                 # deadline checkpoint between dispatch and the blocking
                 # fetch: a budget blown during dispatch cancels before
-                # paying the device round trip
-                checkpoint("engine.resolve")
+                # paying the device round trip — unless partials are
+                # collected, in which case every batch has already been
+                # dispatched and draining the fetch yields the complete
+                # answer (is_partial stays False)
+                checkpoint_partial("engine.resolve")
                 if adaptive_resolve is not None:
                     out, reason = adaptive_resolve()
                     if out is not None:
@@ -964,7 +1018,10 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                         # deterministic: more distinct groups than slots
                         self._sparse_disabled.add(qkey)
                         pinned = True
-                    else:
+                    elif reason != "declined":
+                        # "declined" = nothing dispatched (a partial-drain
+                        # pass), not a sparse failure: never error-count it
+                        # toward the pin
                         n = self._sparse_error_counts.get(qkey, 0) + 1
                         self._sparse_error_counts[qkey] = n
                         if n >= _SPARSE_ERROR_PIN_AFTER:
@@ -1090,8 +1147,17 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             if q.order_by
             else (q.limit + q.offset if q.limit is not None else None)
         )
-        for seg in self._segments_in_scope(q, ds):
-            checkpoint("engine.scan_loop")
+        scan_segs = self._segments_in_scope(q, ds)
+        pc = current_partial()
+        if pc is not None:
+            pc.begin_pass()
+            pc.add_scope(len(scan_segs), *_row_counts(scan_segs))
+        for seg in scan_segs:
+            # partial-aware checkpoint: a scan past its deadline returns
+            # the rows fetched so far (a row subset IS the scan's natural
+            # partial) with a coverage fraction
+            if checkpoint_partial("engine.scan_loop"):
+                break
             cols = self._device_cols(seg, need)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]
@@ -1130,6 +1196,8 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                     f, Q.LimitSpec(q.limit + q.offset, q.order_by, 0)
                 )
             frames.append(f)
+            if pc is not None:
+                pc.add_seen(1, *_row_counts((seg,)))
             if remaining is not None and remaining <= 0:
                 break
         out = (
@@ -1247,10 +1315,16 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             dim: np.zeros(ds.dicts[dim].cardinality, np.int64)
             for dim in live_dims
         }
+        pc = current_partial()
+        if pc is not None:
+            pc.begin_pass()
+            pc.add_scope(len(segs), *_row_counts(segs))
         for seg in segs:
             # per-segment filter evaluation + bincount is real work on a
-            # wide segment: honor the deadline between segments
-            checkpoint("engine.search_loop")
+            # wide segment: honor the deadline between segments (partial
+            # counts over the segments seen so far are a safe answer)
+            if checkpoint_partial("engine.search_loop"):
+                break
             base = np.asarray(seg.valid)
             if q.intervals and seg.time is not None:
                 t = np.asarray(seg.time)
@@ -1270,6 +1344,8 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 counts[dim] += np.bincount(
                     sel, minlength=len(counts[dim])
                 )
+            if pc is not None:
+                pc.add_seen(1, *_row_counts((seg,)))
         rows = []
         for dim in live_dims:
             if len(rows) >= q.limit:
@@ -1287,5 +1363,181 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                     if len(rows) >= q.limit:
                         break
         return pd.DataFrame(rows, columns=["dimension", "value", "count"])
+
+    # -- progressive execution (chunked refinement, ISSUE 7 tentpole (b)) ----
+
+    def execute_progressive(self, q: Q.QuerySpec, ds: DataSource):
+        """Generator of progressively-refined results for one aggregate
+        query: after each segment-batch dispatch the running partial
+        state is fetched and finalized, yielding `(df, info)` where
+        `info` carries {"sequence", "coverage", "rows_seen", "rows_total",
+        "final"}.  The LAST emission is the exact answer (coverage 1.0)
+        — unless an armed deadline expires mid-scan, in which case the
+        last emission is the best-effort partial, flagged via
+        info["partial"]=True.
+
+        Interactive exploration over SF100 sees the first refinement
+        after ONE batch (milliseconds of scan) and watches the answer
+        converge; the per-batch device fetch + finalize is the price of
+        visibility, so this path is opt-in (`context.progressive` on the
+        wire).  Non-aggregate query types have no mergeable state to
+        refine: they execute normally and emit once."""
+        if isinstance(q, Q.TimeseriesQuery):
+            inner = timeseries_to_groupby(q)
+            shape = lambda df: finalize_timeseries(df, q, ds)  # noqa: E731
+        elif isinstance(q, Q.TopNQuery):
+            inner = topn_to_groupby(q)
+            shape = lambda df: finalize_topn(df, q)  # noqa: E731
+        elif isinstance(q, Q.GroupByQuery):
+            inner = q
+            shape = lambda df: df  # noqa: E731
+        else:
+            df = self.execute(q, ds)
+            # no mergeable state to refine, but execute() can still have
+            # drained to a deadline partial (e.g. the scan loop under an
+            # armed collector): the single emission must carry the real
+            # partial/coverage stamp, not claim exactness (GL16xx)
+            info = {
+                "sequence": 0, "coverage": 1.0, "final": True,
+                "partial": False,
+            }
+            pc = current_partial()
+            if pc is not None and pc.is_partial:
+                d = pc.to_dict()
+                info.update(
+                    partial=True, coverage=d["coverage"],
+                    rows_seen=d["rows_seen"], rows_total=d["rows_total"],
+                )
+            yield df, info
+            return
+
+        import time as _time
+
+        from .metrics import QueryMetrics
+
+        t0 = _time.perf_counter()
+        inner = groupby_with_time_granularity(inner)
+        with span(SPAN_LOWER):
+            lowering = self._lowering_for(inner, ds)
+            segs = self._segments_in_scope(inner, ds)
+        dims, la, G = lowering.dims, lowering.la, lowering.num_groups
+        need = lowering.columns
+        rows_total, delta_total = _row_counts(segs)
+        m = self._m = QueryMetrics(
+            query_type="progressive",
+            strategy=self._resolve_strategy(G),
+            datasource=ds.name,
+            query_id=current_query_id(),
+            rows_scanned=rows_total,
+            segments=len(segs),
+            num_groups=G,
+        )
+        pc = current_partial()
+        if pc is not None:
+            pc.begin_pass()
+            pc.add_scope(len(segs), rows_total, delta_total)
+        sums = mins = maxs = None
+        sketch_states: Dict[str, Any] = {}
+        rows_seen = 0
+        seen_segs = 0
+        seq = 0
+        truncated = False
+        try:
+            if segs:
+                seg_fn = self._segment_program(inner, ds, lowering)
+                batches = list(self._segment_batches(segs, need))
+                for bi, batch in enumerate(batches):
+                    if checkpoint_partial("engine.progressive_loop"):
+                        truncated = True
+                        break
+                    with span(SPAN_H2D, batch=bi, segments=len(batch)):
+                        cols_list = [
+                            self._cols_for_segment(seg, ds, need)
+                            for seg in batch
+                        ]
+                    with span(
+                        SPAN_SEGMENT_DISPATCH, batch=bi,
+                        segments=len(batch),
+                    ):
+                        (s, mn, mx, sk), seg_fn = (
+                            self._call_segment_program(
+                                inner, ds, lowering, seg_fn, cols_list
+                            )
+                        )
+                    sums = s if sums is None else sums + s
+                    mins = mn if mins is None else jnp.minimum(mins, mn)
+                    maxs = mx if maxs is None else jnp.maximum(maxs, mx)
+                    _merge_sketch_states(la, sketch_states, sk)
+                    br, bd = _row_counts(batch)
+                    rows_seen += br
+                    seen_segs += len(batch)
+                    if pc is not None:
+                        pc.add_seen(len(batch), br, bd)
+                    final = bi + 1 == len(batches)
+                    with span(SPAN_DEVICE_FETCH, batch=bi):
+                        # graftlint: disable=trace-purity -- per-batch fetch IS progressive streaming: each refinement ships the running state to the client
+                        hs, hmn, hmx, hsk = jax.device_get(
+                            (sums, mins, maxs, sketch_states)
+                        )
+                    with span(SPAN_FINALIZE, batch=bi):
+                        df = shape(finalize_groupby(
+                            inner, dims, la,
+                            np.asarray(hs), np.asarray(hmn),
+                            np.asarray(hmx),
+                            {k: np.asarray(v) for k, v in hsk.items()},
+                        ))
+                    yield df, {
+                        "sequence": seq,
+                        "coverage": (
+                            rows_seen / rows_total if rows_total else 1.0
+                        ),
+                        "rows_seen": rows_seen,
+                        "rows_total": rows_total,
+                        "segments_seen": seen_segs,
+                        "segments_total": len(segs),
+                        "final": final,
+                        "partial": False,
+                    }
+                    seq += 1
+            if not segs or truncated or sums is None:
+                # empty scope, or a deadline cut the scan short: emit the
+                # (possibly empty) merged state as the final answer with
+                # its honest coverage
+                if sums is None:
+                    sums, mins, maxs, sketch_states = empty_partials(la, G)
+                hs, hmn, hmx, hsk = jax.device_get(
+                    (sums, mins, maxs, sketch_states)
+                )
+                with span(SPAN_FINALIZE):
+                    df = shape(finalize_groupby(
+                        inner, dims, la,
+                        np.asarray(hs), np.asarray(hmn), np.asarray(hmx),
+                        {k: np.asarray(v) for k, v in hsk.items()},
+                    ))
+                cov = rows_seen / rows_total if rows_total else (
+                    None if truncated else 1.0
+                )
+                yield df, {
+                    "sequence": seq,
+                    "coverage": cov,
+                    "rows_seen": rows_seen,
+                    "rows_total": rows_total,
+                    "segments_seen": seen_segs,
+                    "segments_total": len(segs),
+                    "final": True,
+                    "partial": truncated,
+                }
+        finally:
+            m.total_ms = (_time.perf_counter() - t0) * 1e3
+            if pc is not None and pc.is_partial:
+                m.partial = True
+                m.coverage = pc.coverage()
+                m.rows_seen = pc.rows_seen
+                m.delta_rows_seen = pc.delta_rows_seen
+            self.last_metrics = m
+            self._m = None
+            record_query_metrics(
+                m, "partial" if m.partial else "ok"
+            )
 
 

@@ -124,45 +124,80 @@ def decoded_frame(ds: DataSource, columns=None) -> pd.DataFrame:
     """Real rows of a datasource as a pandas frame: dimensions decoded to
     values, metrics as float64, time as int64 ms.  `columns` restricts the
     decode to the names a plan actually references (decoding a wide
-    table's every column would dominate fallback latency)."""
+    table's every column would dominate fallback latency).
+
+    The decode iterates SEGMENT-outer so a deadline expiring mid-decode
+    can truncate to whole segments: every column then carries exactly the
+    same row prefix, and the interpreter's answer over the truncated
+    frame is a sound "rows seen so far" partial (the collector accounts
+    the seen/total split, including delta vs historical rows)."""
     from ..obs import SPAN_FALLBACK_DECODE, span
-    from ..resilience import checkpoint, fire, injector
+    from ..resilience import checkpoint_partial, current_partial, fire, injector
+    from .engine import _row_counts
 
     fire("fallback_decode")  # fault-injection site: host decode
     # `partial` fault mode truncates every segment's decode to a fraction —
     # the deterministic torn-result shape watchdog/flush tests need
     frac = injector().partial_fraction("fallback_decode")
     cache = _decoded_segment_cache() if frac is None else None
-    out: Dict[str, np.ndarray] = {}
+    names = [
+        c.name
+        for c in ds.columns
+        if columns is None or c.name in columns
+    ]
+    dict_keys = {
+        n: (ds.dicts[n].content_key if n in ds.dicts else None)
+        for n in names
+    }
+    segs = list(ds.segments)
+    pc = current_partial()
+    if pc is not None:
+        # the fallback accumulates scope ACROSS the plan's tables (a join
+        # decodes several) — never begin_pass here; _run_fallback owns it
+        pc.add_scope(len(segs), *_row_counts(segs))
+    parts: Dict[str, list] = {n: [] for n in names}
+    draining = False
     with span(SPAN_FALLBACK_DECODE, datasource=ds.name):
-        for c in ds.columns:
-            if columns is not None and c.name not in columns:
-                continue
-            dict_key = (
-                ds.dicts[c.name].content_key if c.name in ds.dicts else None
-            )
-            parts = []
-            for seg in ds.segments:
-                # per-(column, segment) decode is the fallback's unit of
-                # work; checkpointing inside the segment loop keeps the
-                # deadline granularity finer than whole-column decodes
-                checkpoint("fallback.decode")
-                ckey = (seg.uid, "decoded", c.name, dict_key)
+        for seg in segs:
+            # per-segment decode is the fallback's unit of work; on
+            # expiry the segments decoded so far (whole rows, every
+            # column aligned) become the partial input
+            if draining or checkpoint_partial("fallback.decode"):
+                draining = True
+                # drain mode (the collector already triggered — e.g.
+                # _run_fallback's interpreter-expiry rerun): a segment
+                # whose needed columns are ALL warm in the decoded-
+                # segment cache is free to serve and counts as seen;
+                # the first segment needing fresh decode work ends the
+                # pass.  Whole segments stay row-aligned either way.
+                if cache is None or any(
+                    cache.get((seg.uid, "decoded", n, dict_keys[n]))
+                    is None
+                    for n in names
+                ):
+                    break
+            for n in names:
+                ckey = (seg.uid, "decoded", n, dict_keys[n])
                 arr = cache.get(ckey) if cache is not None else None
                 if arr is None:
-                    arr = np.asarray(seg.column(c.name))[seg.valid]
-                    if c.name in ds.dicts:
-                        arr = ds.dicts[c.name].decode(arr)
+                    arr = np.asarray(seg.column(n))[seg.valid]
+                    if n in ds.dicts:
+                        arr = ds.dicts[n].decode(arr)
                     elif arr.dtype.kind == "f":
                         arr = arr.astype(np.float64)
                     if frac is not None:
                         arr = arr[: int(len(arr) * frac)]
                     if cache is not None:
                         cache[ckey] = arr
-                parts.append(arr)
-            out[c.name] = (
-                np.concatenate(parts) if parts else np.array([], dtype=object)
-            )
+                parts[n].append(arr)
+            if pc is not None:
+                pc.add_seen(1, *_row_counts((seg,)))
+    out: Dict[str, np.ndarray] = {
+        n: (
+            np.concatenate(p) if p else np.array([], dtype=object)
+        )
+        for n, p in parts.items()
+    }
     return pd.DataFrame(out)
 
 
@@ -1689,6 +1724,9 @@ def _cached_scan_frame(catalog, table: str, needed) -> pd.DataFrame:
         # injected decode faults (error/partial) must neither be masked by
         # a cached frame nor poison the cache for later healthy queries
         return decoded_frame(ds, columns=needed)
+    from ..resilience import current_partial
+    from .engine import _row_counts
+
     cache = getattr(catalog, "_fallback_frames", None)
     if cache is None:
         from ..utils.lru import CountBudgetCache
@@ -1699,11 +1737,24 @@ def _cached_scan_frame(catalog, table: str, needed) -> pd.DataFrame:
         getattr(catalog, "version", 0),
         frozenset(needed) if needed is not None else None,
     )
+    pc = current_partial()
     df = cache.get(key)
     if df is None:
         df = decoded_frame(ds, columns=needed)
-        if len(df) <= _FRAME_CACHE_MAX_ROWS:
+        # a deadline-TRUNCATED frame must never enter the cache: it
+        # would be served back as the complete table to every later
+        # fallback query at this catalog version
+        if len(df) <= _FRAME_CACHE_MAX_ROWS and (
+            pc is None or not pc.triggered
+        ):
             cache[key] = df
+    elif pc is not None:
+        # cache hit: the table was fully seen without a decode —
+        # account the full scope so the coverage fraction stays honest
+        segs = list(ds.segments)
+        rows, delta = _row_counts(segs)
+        pc.add_scope(len(segs), rows, delta)
+        pc.add_seen(len(segs), rows, delta)
     return df.copy(deep=False)
 
 

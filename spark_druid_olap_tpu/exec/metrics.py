@@ -85,6 +85,16 @@ class QueryMetrics:
     deadline_exceeded: bool = False
     circuit_state: str = ""
     error_class: Optional[str] = None
+    # deadline-bounded partial answers (ISSUE 7): True when the result is
+    # best-effort (deadline expired mid-scan and the merged partials were
+    # returned); `coverage` is the fraction of in-scope rows the answer
+    # saw (None when the denominator is unknowable, e.g. an unbounded
+    # stream), with the seen/total row counts and their delta-vs-
+    # historical split alongside
+    partial: bool = False
+    coverage: Optional[float] = None
+    rows_seen: int = 0
+    delta_rows_seen: int = 0
 
     @property
     def rows_per_sec(self) -> float:
@@ -126,6 +136,12 @@ class QueryMetrics:
             + (f" retries={self.retries}" if self.retries else "")
             + (" DEGRADED" if self.degraded else "")
             + (" DEADLINE-EXCEEDED" if self.deadline_exceeded else "")
+            + (
+                f" PARTIAL(coverage="
+                f"{'?' if self.coverage is None else round(self.coverage, 4)})"
+                if self.partial
+                else ""
+            )
             + (
                 f" circuit={self.circuit_state}"
                 if self.circuit_state and self.circuit_state != "closed"

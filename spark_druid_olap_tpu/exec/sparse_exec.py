@@ -22,6 +22,7 @@ import numpy as np
 
 from ..catalog.segment import DataSource
 from ..models import query as Q
+from ..resilience import DeadlineExceeded
 from ..utils.log import get_logger
 from .finalize import finalize_groupby
 from .lowering import GroupByLowering, _query_key, memo_key
@@ -154,19 +155,36 @@ class SparseExecMixin:
 
         def dispatch(row_capacity=None, slots=None):
             from ..obs import SPAN_SPARSE_DISPATCH, span
-            from ..resilience import checkpoint
+            from ..resilience import checkpoint_partial, current_partial, fire
+            from .engine import _row_counts
 
+            # fault-injection site: the sparse tier IS a device dispatch,
+            # so "100% device failure" (`device_dispatch` armed) must take
+            # it down exactly like the dense engine's — otherwise a
+            # breaker half-open probe routed to a sparse-strategy query
+            # succeeds and closes the breaker while the device is dead.
+            # Placed OUTSIDE resolve()'s Mosaic-downgrade retry so the
+            # injected transient declines this execution only and never
+            # pins _pallas_broken (same contract as engine.py's site).
+            fire("device_dispatch")
             seg_fn = self._sparse_program(
                 q, ds, lowering, row_capacity=row_capacity, slots=slots
             )
+            pc = current_partial()
+            if pc is not None:
+                pc.begin_pass()
+                pc.add_scope(len(segs), *_row_counts(segs))
             state = None
             for bi, batch in enumerate(
                 self._segment_batches(segs, lowering.columns)
             ):
                 # cooperative deadline checkpoint between batch
                 # dispatches — same lifecycle contract as the dense
-                # engine's segment loop (checkpoint-coverage/GL901)
-                checkpoint("sparse.segment_loop")
+                # engine's segment loop (checkpoint-coverage/GL901);
+                # with a partial collector armed, expiry stops the loop
+                # and the merged sparse state so far becomes the answer
+                if checkpoint_partial("sparse.segment_loop"):
+                    break
                 with span(SPAN_SPARSE_DISPATCH, batch=bi, segments=len(batch)):
                     cols_list = [
                         self._cols_for_segment(seg, ds, lowering.columns)
@@ -178,6 +196,8 @@ class SparseExecMixin:
                         if state is None
                         else merge_sparse_states(state, st, num_groups=G)
                     )
+                if pc is not None:
+                    pc.add_seen(len(batch), *_row_counts(batch))
             return state
 
         def evict():
@@ -237,10 +257,18 @@ class SparseExecMixin:
             # data) and remembered.  Slot overflow is handled by the
             # caller's SLOTS_LADDER loop.
             from ..obs import SPAN_DEVICE_FETCH, span
+            from ..resilience import current_partial
 
             with span(SPAN_DEVICE_FETCH):
                 host = jax.device_get(state)
             if row_capacity is not None and bool(host["row_overflow"]):
+                pc = current_partial()
+                if pc is not None and pc.triggered:
+                    # partial drain: a ladder rerun would re-dispatch an
+                    # already-stopped scope (dispatch() breaks at its
+                    # first checkpoint and returns None) — decline this
+                    # execution instead; the dense drain answers
+                    return None
                 n = int(host["n_rows"])
                 new_cap = next(
                     (
@@ -269,10 +297,14 @@ class SparseExecMixin:
             # the device path.  The kernel's exact distinct-present count
             # (`n_real`) picks the smallest adequate rung; only past the
             # ladder top does the query fall back to raw scatter.
-            from ..resilience import checkpoint
+            from ..resilience import checkpoint, current_partial
 
             host = fetch_tiered(state, row_capacity, slots)
-            while bool(host["overflow"]):
+            while host is not None and bool(host["overflow"]):
+                pc = current_partial()
+                if pc is not None and pc.triggered:
+                    # partial drain: no rung rerun (see fetch_tiered)
+                    return None, slots
                 # every ladder rung re-dispatches the whole segment
                 # scope — a deadlined query must cancel between rungs,
                 # not after the ladder converges
@@ -334,8 +366,22 @@ class SparseExecMixin:
             try:
                 if dispatch_exc is not None:
                     raise dispatch_exc
+                if state is None:
+                    # a partial drain armed BEFORE this dispatch started:
+                    # nothing was dispatched, so there is no sparse state
+                    # to answer from — decline (never error-counted) and
+                    # let the dense path produce the zero-coverage answer
+                    return None, "declined"
                 host, _ = fetch_slot_laddered(state, cap, slots0)
                 state = None  # free the device partials promptly
+            except DeadlineExceeded:
+                # partial-result discipline (GL16xx): an expiry that the
+                # partial machinery did NOT absorb (no collector armed)
+                # must propagate as a deadline, never be swallowed into
+                # the generic sparse-decline path — retrying the whole
+                # scope on the dense engine would only time out slower
+                state = None
+                raise
             except Exception:  # fault-ok: returns "error"; caller logs + falls back
                 state = None
                 evict()
@@ -357,6 +403,10 @@ class SparseExecMixin:
                         retry_cap,
                         retry_slots,
                     )
+                except DeadlineExceeded:
+                    if we_broke_it:
+                        self._pallas_broken = False
+                    raise  # a deadline is never a Pallas verdict
                 except Exception:  # fault-ok: returns "error"; caller logs + falls back
                     # only unflag if WE set the flag — an earlier query may
                     # have legitimately discovered the broken kernel
@@ -364,6 +414,11 @@ class SparseExecMixin:
                         self._pallas_broken = False
                     evict()
                     return None, "error"
+            if host is None:
+                # a partial drain stopped a ladder rerun mid-scope:
+                # decline (never error-counted) — the dense drain
+                # produces the best-effort answer
+                return None, "declined"
             if bool(host["overflow"]):
                 return None, "overflow"
             df = finalize_groupby(

@@ -238,15 +238,24 @@ class StreamExecutor:
         import time as _time
 
         from ..obs import SPAN_STREAM_CHUNK, span
-        from ..resilience import checkpoint, fire
+        from ..resilience import checkpoint_partial, current_partial, fire
 
+        pc = current_partial()
+        if pc is not None:
+            # an unbounded stream has no knowable denominator: the
+            # collector records rows seen (coverage None) so a partial
+            # answer still says HOW MUCH it aggregated
+            pc.begin_pass()
         for dev, base, nrows in self._prefetched_device_chunks(
             chunks, need, ds, chunk_rows
         ):
             # cooperative deadline checkpoint + device-dispatch fault site:
-            # a budgeted 1B-row stream cancels between chunks, and injected
-            # device faults hit the streaming path like every other executor
-            checkpoint("streaming.chunk_loop")
+            # a budgeted 1B-row stream cancels between chunks (or, with a
+            # partial collector armed, stops consuming and answers with
+            # the chunk partials merged so far), and injected device
+            # faults hit the streaming path like every other executor
+            if checkpoint_partial("streaming.chunk_loop"):
+                break
             fire("device_dispatch")
             t0 = _time.perf_counter()
             with span(SPAN_STREAM_CHUNK, chunk=self.stats.chunks):
@@ -262,6 +271,8 @@ class StreamExecutor:
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
             _merge_sketch_states(la, sketch_states, sk)
             self.stats.chunks += 1
+            if pc is not None:
+                pc.add_seen(1, int(nrows))
             t_disp += _time.perf_counter() - t0
         self.stats.dispatch_s = t_disp
 

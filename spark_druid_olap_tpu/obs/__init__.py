@@ -20,6 +20,7 @@ from .registry import (  # noqa: F401
     get_registry,
     record_compaction,
     record_ingest,
+    record_partial,
     record_query_metrics,
 )
 from .trace import (  # noqa: F401
@@ -38,12 +39,14 @@ from .trace import (  # noqa: F401
     SPAN_INGEST_ENCODE,
     SPAN_LOWER,
     SPAN_NAMES,
+    SPAN_PARTIAL,
     SPAN_PLAN,
     SPAN_QUERY,
     SPAN_RETRY,
     SPAN_SEGMENT_DISPATCH,
     SPAN_SPARSE_DISPATCH,
     SPAN_STREAM_CHUNK,
+    SPAN_STREAM_FLUSH,
     QueryTrace,
     Span,
     TraceRing,

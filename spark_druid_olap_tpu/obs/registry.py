@@ -167,20 +167,39 @@ class Gauge(_Family):
             self._fn = fn
 
     class _Child:
-        __slots__ = ("_family", "_value")
+        __slots__ = ("_family", "_value", "_fn")
 
         def __init__(self, family: "Gauge"):
             self._family = family
             self._value = 0.0
+            self._fn: Optional[Callable[[], float]] = None
 
         def set(self, value: float) -> None:
             with self._family._lock:
                 self._value = float(value)
 
+        def set_function(self, fn: Callable[[], float]) -> None:
+            """Per-series live callback (read at render time) — how the
+            per-backend breakers export `sdol_breaker_state{backend=...}`
+            without writing a gauge on every state transition.
+            Re-binding replaces the callback (a rebuilt context takes
+            over its series)."""
+            with self._family._lock:
+                self._fn = fn
+
+        def _read(self) -> float:
+            with self._family._lock:
+                fn, v = self._fn, self._value
+            if fn is None:
+                return v
+            try:
+                return float(fn())
+            except Exception:  # fault-ok: dead callback must not break a scrape
+                return v
+
         @property
         def value(self) -> float:
-            with self._family._lock:
-                return self._value
+            return self._read()
 
     def _read_fn(self) -> Optional[float]:
         with self._lock:
@@ -198,21 +217,22 @@ class Gauge(_Family):
             return [f"{self.name} {v:g}"]
         with self._lock:
             items = sorted(self._children.items())
-            return [
-                f"{self.name}{_fmt_labels(self.label_names, key)} "
-                f"{child._value:g}"
-                for key, child in items
-            ]
+        return [
+            f"{self.name}{_fmt_labels(self.label_names, key)} "
+            f"{child._read():g}"
+            for key, child in items
+        ]
 
     def snapshot(self) -> Dict[str, float]:
         v = self._read_fn()
         if v is not None:
             return {"": v}
         with self._lock:
-            return {
-                ",".join(key) if key else "": child._value
-                for key, child in self._children.items()
-            }
+            items = list(self._children.items())
+        return {
+            ",".join(key) if key else "": child._read()
+            for key, child in items
+        }
 
 
 class Histogram(_Family):
@@ -522,6 +542,27 @@ def record_compaction(datasource: str, rows: int, delta_segments: int) -> None:
             "delta segments consumed by compaction",
             labels=("datasource",),
         ).labels(datasource=ds).inc(delta_segments)
+
+
+def record_partial(coverage, site: str = "", query_id: str = "") -> None:
+    """Publish one deadline-bounded PARTIAL answer: a count by triggering
+    site plus the coverage-fraction distribution (ISSUE 7 tentpole (a)).
+    The coverage histogram is the fleet-level answer to "how much of the
+    data do deadline-bounded dashboards actually see?"; the query_id
+    rides along as the bucket exemplar, same as the latency series."""
+    reg = get_registry()
+    reg.counter(
+        "sdol_partial_results_total",
+        "queries answered with deadline-bounded partial results, by "
+        "triggering checkpoint site",
+        labels=("site",),
+    ).labels(site=bounded_label("partial_site", site or "unknown")).inc()
+    if coverage is not None:
+        reg.histogram(
+            "sdol_partial_coverage",
+            "coverage fraction of deadline-bounded partial answers",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0),
+        ).observe(float(coverage), exemplar=query_id or None)
 
 
 def record_query_metrics(m, outcome: str = "ok") -> None:

@@ -77,6 +77,8 @@ SPAN_STREAM_CHUNK = "stream_chunk"  # one streaming chunk dispatch
 SPAN_INGEST = "ingest"  # one streamed append (ingest tier, ISSUE 6)
 SPAN_INGEST_ENCODE = "ingest_encode"  # dictionary encode of an append batch
 SPAN_COMPACT = "compact"  # delta -> historical roll of one datasource
+SPAN_PARTIAL = "partial"  # deadline-bounded best-effort answer (coverage)
+SPAN_STREAM_FLUSH = "stream_flush"  # one progressive-response refinement
 
 SPAN_NAMES = frozenset(
     {
@@ -100,6 +102,8 @@ SPAN_NAMES = frozenset(
         SPAN_INGEST,
         SPAN_INGEST_ENCODE,
         SPAN_COMPACT,
+        SPAN_PARTIAL,
+        SPAN_STREAM_FLUSH,
     }
 )
 
@@ -344,10 +348,16 @@ class Tracer:
         self,
         clock: Callable[[], float] = time.perf_counter,
         capacity: int = 64,
+        otlp_path: Optional[str] = None,
     ):
         self.clock = clock
         self.ring = TraceRing(capacity)
         self.last: Optional[QueryTrace] = None
+        # ROADMAP obs follow-up (d): emit-only OTLP export behind a
+        # config flag — finished trace dicts append (OTLP/JSON
+        # ResourceSpans, one per line) to this path; no collector, no
+        # network, no tier-1 dependency
+        self.otlp_path = otlp_path
 
     @contextlib.contextmanager
     def query_trace(
@@ -377,7 +387,18 @@ class Tracer:
             _active_trace.reset(tok_t)
             tr.finish()
             self.last = tr
-            self.ring.put(tr.to_dict())
+            doc = tr.to_dict()
+            self.ring.put(doc)
+            if self.otlp_path:
+                from .otlp import append_otlp
+
+                try:
+                    append_otlp(self.otlp_path, doc)
+                except OSError:  # fault-ok: export must never fail a query
+                    log.warning(
+                        "OTLP export to %s failed", self.otlp_path,
+                        exc_info=True,
+                    )
             if slow_ms and slow_ms > 0 and tr.total_ms >= slow_ms:
                 log.warning(
                     "slow query %s: %.1fms >= %.0fms threshold\n%s",

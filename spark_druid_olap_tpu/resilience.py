@@ -73,6 +73,20 @@ class DeadlineExceeded(Exception):
         self.timeout_ms = timeout_ms
 
 
+class InjectedDeadline(DeadlineExceeded):
+    """Deterministic deadline expiry raised by an armed fault site.  A
+    DeadlineExceeded subclass so it walks the exact deadline path wall-clock
+    expiry walks (never retried, partial-collection trigger, 504 taxonomy)
+    — but constructible with the fire()-style single-message signature the
+    injector uses, and armable with `skip=K` so a test can pin expiry to
+    the K-th checkpoint of a scan instead of racing a real clock."""
+
+    def __init__(self, msg: str):
+        Exception.__init__(self, msg)
+        self.site = msg
+        self.timeout_ms = 0.0
+
+
 class InjectedFault(RuntimeError):
     """Deterministic fault raised by an armed FaultInjector site.  A
     RuntimeError subclass on purpose: injected device faults must walk the
@@ -154,10 +168,226 @@ def deadline_scope(timeout_ms: Optional[float]):
 def checkpoint(site: str) -> None:
     """Cooperative cancellation + fault-injection point.  Called from the
     engine segment loop, the streaming chunk loop, and the fallback
-    interpreter; costs one contextvar read when nothing is armed."""
+    interpreter; costs one contextvar read when nothing is armed.
+
+    Every checkpoint is ALSO a named fault site (`fire(site)`): arming
+    e.g. `engine.segment_loop` with `error_type=InjectedDeadline` and
+    `skip=K` makes "the deadline expired at exactly the K-th batch"
+    a deterministic, clock-free test fixture.
+
+    When a partial-result collector is armed and ALREADY TRIGGERED, the
+    deadline check is suppressed: the query is draining — merging the
+    partials it has and finalizing a best-effort answer — and re-raising
+    at every remaining checkpoint would turn the safe partial back into
+    an error."""
+    fire(site)
     d = _active_deadline.get()
-    if d is not None:
-        d.check(site)
+    if d is None:
+        return
+    pc = _active_partial.get()
+    if pc is not None and pc.triggered:
+        return
+    d.check(site)
+
+
+def checkpoint_partial(site: str) -> bool:
+    """Deadline checkpoint for executor loops that can answer with the
+    partials accumulated so far (Partial Partial Aggregates: every
+    aggregate state in the engine is mergeable, so "the rows seen so
+    far" is a safe answer).  Returns True when the loop must STOP
+    dispatching and merge what it has — either because the deadline just
+    expired here (the collector is triggered, and all later checkpoints
+    become no-ops so the drain completes), or because an earlier site
+    already triggered it.  Without an armed collector this is exactly
+    `checkpoint` (expiry raises)."""
+    pc = current_partial()  # None when the scope is an explicit opt-out
+    if pc is not None and pc.triggered:
+        return True
+    try:
+        checkpoint(site)
+    except DeadlineExceeded as err:
+        if pc is None:
+            raise
+        pc.trigger(err.site or site)
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Partial-result collection (deadline-bounded best-effort answers)
+# ---------------------------------------------------------------------------
+
+
+class PartialCollector:
+    """Per-query accounting for deadline-bounded partial answers.
+
+    Armed by `partial_scope` around a query; executors report the scope
+    they intend to scan (`begin_pass` + `add_scope`) and what they
+    actually merged (`add_seen`, per dispatched batch / decoded segment,
+    with the delta-vs-historical row split).  When a deadline expires at
+    a `checkpoint_partial` site the collector is `trigger`ed: the
+    executor stops dispatching, every later `checkpoint` becomes a no-op
+    (the drain must finish), and the merged partials flow through the
+    normal finalize path stamped with a coverage fraction.
+
+    Coverage is rows_seen / rows_total of the current pass (segments as
+    the fallback denominator; None when no scope was ever declared, e.g.
+    an unbounded stream).  A DECLARED zero-row scope is different from
+    an undeclared one: pruning every segment (or a presence pass proving
+    no group survives the filter) means the empty answer IS the complete
+    answer, so coverage is 1.0 and a later trigger must not flag it
+    partial.  `is_partial` is False when the trigger fired only after
+    every batch had already been dispatched — the drained answer is then
+    complete and must not be flagged down."""
+
+    __slots__ = (
+        "enabled", "triggered_site", "in_fallback", "scope_declared",
+        "segments_total", "segments_seen",
+        "rows_total", "rows_seen",
+        "delta_rows_total", "delta_rows_seen",
+        "_lock",
+    )
+
+    def __init__(self, enabled: bool = True):
+        # a DISABLED collector still occupies the scope: an explicit
+        # opt-out at the server boundary (context.partialResults=false)
+        # must not be silently re-armed by ctx.sql's session default
+        self.enabled = enabled
+        self.triggered_site: Optional[str] = None
+        # set while the host-fallback interpreter owns the pass: its
+        # device-assist subtrees run engine executors whose begin_pass
+        # would otherwise zero the interpreter's multi-table accounting
+        self.in_fallback = False
+        self.scope_declared = False
+        self.segments_total = 0
+        self.segments_seen = 0
+        self.rows_total = 0
+        self.rows_seen = 0
+        self.delta_rows_total = 0
+        self.delta_rows_seen = 0
+        self._lock = threading.Lock()
+
+    @property
+    def triggered(self) -> bool:
+        return self.triggered_site is not None
+
+    def trigger(self, site: str) -> None:
+        with self._lock:
+            if self.triggered_site is None:
+                self.triggered_site = site
+        log.warning(
+            "deadline expired at %r; answering with the partials merged "
+            "so far (best-effort)", site,
+        )
+
+    def begin_pass(self) -> None:
+        """A fresh full scan of the query's scope supersedes earlier
+        accounting (the sparse tier declining into a dense rescan must
+        not double-count).  No-op inside a fallback-owned pass: the
+        interpreter accumulates across its tables and assist subtrees."""
+        if self.in_fallback:
+            return
+        with self._lock:
+            self.scope_declared = False
+            self.segments_total = self.segments_seen = 0
+            self.rows_total = self.rows_seen = 0
+            self.delta_rows_total = self.delta_rows_seen = 0
+
+    def reset_for_drain(self) -> None:
+        """Zero the accounting for a drain-RERUN (the fallback's
+        interpreter-level expiry re-executes the plan over the warm
+        decode caches).  The rerun's own add_scope/add_seen then describe
+        exactly what the final answer saw — without this, the aborted
+        pass's counters double the denominator and claim rows the rerun
+        never served (an empty rerun frame would ship stamped
+        coverage≈0.5).  Unlike begin_pass this applies INSIDE a
+        fallback-owned pass; only the drain handler may call it."""
+        with self._lock:
+            self.scope_declared = False
+            self.segments_total = self.segments_seen = 0
+            self.rows_total = self.rows_seen = 0
+            self.delta_rows_total = self.delta_rows_seen = 0
+
+    def add_scope(self, segments: int, rows: int, delta_rows: int = 0):
+        with self._lock:
+            self.scope_declared = True
+            self.segments_total += int(segments)
+            self.rows_total += int(rows)
+            self.delta_rows_total += int(delta_rows)
+
+    def add_seen(self, segments: int, rows: int, delta_rows: int = 0):
+        with self._lock:
+            self.segments_seen += int(segments)
+            self.rows_seen += int(rows)
+            self.delta_rows_seen += int(delta_rows)
+
+    def coverage(self) -> Optional[float]:
+        with self._lock:
+            if self.rows_total > 0:
+                return min(1.0, self.rows_seen / self.rows_total)
+            if self.segments_total > 0:
+                return min(1.0, self.segments_seen / self.segments_total)
+            if self.scope_declared:
+                return 1.0  # declared empty scope: nothing to scan
+            return None
+
+    @property
+    def is_partial(self) -> bool:
+        """Triggered AND genuinely incomplete.  A trigger observed after
+        the last batch was dispatched drains to the exact answer."""
+        if not self.triggered:
+            return False
+        with self._lock:
+            if self.rows_total > 0:
+                return self.rows_seen < self.rows_total
+            if self.segments_total > 0:
+                return self.segments_seen < self.segments_total
+            if self.scope_declared:
+                return False  # declared empty scope: complete by vacuity
+            return True  # unknown denominator: claim nothing
+
+    def to_dict(self) -> dict:
+        cov = self.coverage()
+        with self._lock:
+            return {
+                "partial": True,
+                "coverage": round(cov, 6) if cov is not None else None,
+                "site": self.triggered_site,
+                "segments_seen": self.segments_seen,
+                "segments_total": self.segments_total,
+                "rows_seen": self.rows_seen,
+                "rows_total": self.rows_total,
+                "delta_rows_seen": self.delta_rows_seen,
+                "delta_rows_total": self.delta_rows_total,
+            }
+
+
+_active_partial: contextvars.ContextVar[Optional[PartialCollector]] = (
+    contextvars.ContextVar("sdol_active_partial", default=None)
+)
+
+
+def current_partial() -> Optional[PartialCollector]:
+    pc = _active_partial.get()
+    return pc if pc is not None and pc.enabled else None
+
+
+@contextlib.contextmanager
+def partial_scope(enabled: bool = True):
+    """Arm a partial-result collector for the enclosed query.  Outermost
+    scope wins (same contract as `deadline_scope`: the server's wire
+    scope must not be replaced by ctx.sql's inner one).  `enabled=False`
+    still OCCUPIES the scope with a disabled collector — an explicit
+    opt-out must hold against inner session defaults — and deadline
+    expiry stays a hard error."""
+    if _active_partial.get() is not None:
+        yield current_partial()
+        return
+    token = _active_partial.set(PartialCollector(enabled=enabled))
+    try:
+        yield current_partial()
+    finally:
+        _active_partial.reset(token)
 
 
 # ---------------------------------------------------------------------------
@@ -169,10 +399,11 @@ SITES = ("device_dispatch", "h2d", "compile", "fallback_decode")
 
 
 class _FaultSpec:
-    __slots__ = ("mode", "times", "delay_ms", "fraction", "error_type")
+    __slots__ = ("mode", "times", "delay_ms", "fraction", "error_type",
+                 "skip")
 
     def __init__(self, mode, times=None, delay_ms=0.0, fraction=1.0,
-                 error_type=InjectedFault):
+                 error_type=InjectedFault, skip=0):
         if mode not in ("error", "delay", "partial"):
             raise ValueError(f"unknown fault mode {mode!r}")
         self.mode = mode
@@ -180,6 +411,12 @@ class _FaultSpec:
         self.delay_ms = float(delay_ms)
         self.fraction = float(fraction)
         self.error_type = error_type
+        # pass through the first `skip` calls untouched before firing:
+        # with every checkpoint being a fault site, `skip=K, times=1,
+        # error_type=InjectedDeadline` pins "the deadline expired at the
+        # K-th batch" deterministically — the deadline-sweep acceptance
+        # runs on this, clock-free
+        self.skip = int(skip)
 
 
 class FaultInjector:
@@ -208,10 +445,10 @@ class FaultInjector:
 
     def arm(self, site: str, mode: str = "error", times: Optional[int] = None,
             delay_ms: float = 0.0, fraction: float = 1.0,
-            error_type=InjectedFault) -> None:
+            error_type=InjectedFault, skip: int = 0) -> None:
         with self._lock:
             self._sites[site] = _FaultSpec(
-                mode, times, delay_ms, fraction, error_type
+                mode, times, delay_ms, fraction, error_type, skip
             )
             self._fired.setdefault(site, 0)
 
@@ -247,6 +484,9 @@ class FaultInjector:
             spec = self._sites.get(site)
             if spec is None or (spec.mode == "partial") != partial:
                 return None
+            if spec.skip > 0:
+                spec.skip -= 1
+                return None
             if spec.times is not None:
                 if spec.times <= 0:
                     self._sites.pop(site, None)
@@ -258,16 +498,27 @@ class FaultInjector:
             return spec
 
     def fire(self, site: str) -> None:
-        """Raise/delay if `site` is armed; no-op (one dict lookup under a
-        lock) otherwise.  `partial` specs never raise here — sites that
-        support truncation ask `partial_fraction` instead."""
+        """Raise/delay if `site` is armed; no-op otherwise.  `partial`
+        specs never raise here — sites that support truncation ask
+        `partial_fraction` instead.  The unarmed fast path is a single
+        LOCK-FREE dict read: every `checkpoint(site)` in the per-segment
+        hot loops calls this, and once the singleton exists a per-call
+        lock would serialize all concurrent query threads on a path
+        where (in production) nothing is ever armed.  The read is safe:
+        arming happens-before the queries a test runs, and a missed
+        just-armed site costs one skipped fire, never corruption."""
+        if not self._sites:
+            return
         spec = self._take(site)
         if spec is None:
             return
         if spec.mode == "delay":
             time.sleep(spec.delay_ms / 1e3)
             return
-        raise spec.error_type(f"injected fault at site {site!r}")
+        err = spec.error_type(f"injected fault at site {site!r}")
+        if isinstance(err, DeadlineExceeded):
+            err.site = site  # partial-trigger sites must be clean names
+        raise err
 
     def partial_fraction(self, site: str) -> Optional[float]:
         spec = self._take(site, partial=True)
@@ -393,7 +644,14 @@ class CircuitBreaker:
     errors never touch the breaker."""
 
     def __init__(self, failure_threshold: int = 3, cooldown_ms: float = 2000.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 backend: str = "device"):
+        # which execution backend this breaker guards ("device" |
+        # "mesh" | "fallback"): per-backend granularity so one sick path
+        # never darkens the others — a broken mesh must not force
+        # single-device queries onto the host interpreter, and a fallback
+        # wedged on bad segments must fail fast instead of re-grinding
+        self.backend = backend
         self.failure_threshold = max(1, int(failure_threshold))
         self.cooldown_ms = float(cooldown_ms)
         self._clock = clock
@@ -457,11 +715,15 @@ class CircuitBreaker:
             self._consecutive_failures = 0
             self._probe_started_at = None
             if self._state != "closed":
-                log.info("circuit breaker closing (probe succeeded)")
+                log.info(
+                    "%s circuit breaker closing (probe succeeded)",
+                    self.backend,
+                )
                 _count(
                     "sdol_breaker_transitions_total",
                     "circuit breaker state transitions",
-                    labels=("to",), to="closed",
+                    labels=("to", "backend"),
+                    to="closed", backend=self.backend,
                 )
             self._state = "closed"
 
@@ -474,11 +736,15 @@ class CircuitBreaker:
                 self._state = "open"
                 self._opened_at = self._clock()
                 self._trips += 1
-                log.warning("circuit breaker re-opened (probe failed)")
+                log.warning(
+                    "%s circuit breaker re-opened (probe failed)",
+                    self.backend,
+                )
                 _count(
                     "sdol_breaker_transitions_total",
                     "circuit breaker state transitions",
-                    labels=("to",), to="open",
+                    labels=("to", "backend"),
+                    to="open", backend=self.backend,
                 )
             elif (
                 self._state == "closed"
@@ -488,19 +754,22 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._trips += 1
                 log.warning(
-                    "circuit breaker OPEN after %d consecutive device "
-                    "failures; queries degrade to the host fallback for "
-                    "%.0fms", self._consecutive_failures, self.cooldown_ms,
+                    "%s circuit breaker OPEN after %d consecutive "
+                    "failures; traffic routes around it for %.0fms",
+                    self.backend, self._consecutive_failures,
+                    self.cooldown_ms,
                 )
                 _count(
                     "sdol_breaker_transitions_total",
                     "circuit breaker state transitions",
-                    labels=("to",), to="open",
+                    labels=("to", "backend"),
+                    to="open", backend=self.backend,
                 )
 
     def to_dict(self) -> dict:
         with self._lock:
             return {
+                "backend": self.backend,
                 "state": self._peek_state(),
                 "consecutive_failures": self._consecutive_failures,
                 "failure_threshold": self.failure_threshold,
@@ -634,16 +903,33 @@ class AdmissionController:
 # ---------------------------------------------------------------------------
 
 
+# numeric encoding of breaker state for the `sdol_breaker_state` gauge
+# (Prometheus wants numbers; alerts compare against these)
+BREAKER_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+# the execution backends with independent breakers: a sick mesh must not
+# darken single-device queries, and a fallback wedged on bad data must
+# fail fast instead of re-grinding every degraded query through it
+BREAKER_BACKENDS = ("device", "mesh", "fallback")
+
+
 class ResilienceState:
-    """One context's resilience machinery: the breaker the engines report
-    to, the admission pool the server gates on, and failure counters the
-    health endpoint surfaces.  The fault injector is process-global."""
+    """One context's resilience machinery: the per-backend breakers the
+    engines report to, the admission pool the server gates on, and
+    failure counters the health endpoint surfaces.  The fault injector
+    is process-global."""
 
     def __init__(self, config):
-        self.breaker = CircuitBreaker(
-            failure_threshold=getattr(config, "breaker_failure_threshold", 3),
-            cooldown_ms=getattr(config, "breaker_cooldown_ms", 2000.0),
-        )
+        self.breakers: Dict[str, CircuitBreaker] = {
+            b: CircuitBreaker(
+                failure_threshold=getattr(
+                    config, "breaker_failure_threshold", 3
+                ),
+                cooldown_ms=getattr(config, "breaker_cooldown_ms", 2000.0),
+                backend=b,
+            )
+            for b in BREAKER_BACKENDS
+        }
         self.admission = AdmissionController(
             max_concurrent=getattr(config, "max_concurrent_queries", 8),
             queue_timeout_ms=getattr(
@@ -677,6 +963,28 @@ class ResilienceState:
             "sdol_admission_slots_in_use",
             "admission slots currently held by executing queries",
         ).set_function(lambda a=self.admission: a.in_use)
+        # per-backend breaker state as a labeled callback gauge (scrape
+        # reads the live breaker, the hot record paths pay nothing):
+        # 0=closed 1=half_open 2=open
+        state_gauge = reg.gauge(
+            "sdol_breaker_state",
+            "circuit breaker state by backend (0=closed 1=half_open "
+            "2=open)",
+            labels=("backend",),
+        )
+        for b, cb in self.breakers.items():
+            state_gauge.labels(backend=b).set_function(
+                lambda cb=cb: BREAKER_STATE_CODES.get(cb.state, -1)
+            )
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """The single-device breaker — the pre-split name, kept so direct
+        users (and the engines' default wiring) keep working."""
+        return self.breakers["device"]
+
+    def breaker_for(self, backend: str) -> CircuitBreaker:
+        return self.breakers.get(backend, self.breakers["device"])
 
     def note_degraded(self) -> None:
         with self._lock:
@@ -716,7 +1024,13 @@ class ResilienceState:
             }
         return {
             "healthy": True,
+            # "breaker" keeps naming the single-device breaker (the
+            # pre-split contract load balancers already read); the full
+            # per-backend matrix lives under "breakers"
             "breaker": self.breaker.to_dict(),
+            "breakers": {
+                b: cb.to_dict() for b, cb in self.breakers.items()
+            },
             "admission": self.admission.to_dict(),
             "ingest_admission": self.ingest_admission.to_dict(),
             "counters": counters,

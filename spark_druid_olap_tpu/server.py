@@ -50,7 +50,14 @@ from .obs import (
     new_query_id,
     span,
 )
-from .resilience import CircuitOpenError, DeadlineExceeded, deadline_scope
+from .resilience import (
+    CircuitOpenError,
+    DeadlineExceeded,
+    classify_error,
+    current_partial,
+    deadline_scope,
+    partial_scope,
+)
 from .utils.log import get_logger
 
 log = get_logger("server")
@@ -173,6 +180,13 @@ def druid_result_shape(q: Q.QuerySpec, df) -> Any:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # chunked transfer-encoding (the progressive streaming path) is only
+    # defined for HTTP/1.1 — the stdlib default of HTTP/1.0 would make
+    # spec-compliant clients read the hex chunk-size lines as body bytes.
+    # Safe to enable: every buffered response carries Content-Length
+    # (_begin_response) and every chunked one ends with the terminal
+    # 0-chunk, so keep-alive connections can never hang.
+    protocol_version = "HTTP/1.1"
     ctx = None  # set by OlapServer
     server_version = "sdol-tpu/0.2"
     _query_id: Optional[str] = None  # per-request; set by do_POST
@@ -203,6 +217,59 @@ class _Handler(BaseHTTPRequestHandler):
             self.command, self.path, code, self._query_id or "-", dur_ms,
         )
 
+    # -- response writer ----------------------------------------------------
+    # ONE writer serves both the buffered and the chunked (progressive)
+    # paths (ISSUE 7 ride-along): status+headers — including the
+    # X-Druid-Query-Id echo — are emitted by `_begin_response` for BOTH,
+    # and the http-requests counter fires exactly once per response via
+    # `_finish_response`, so streamed responses can never drift from the
+    # buffered contract.
+
+    def _begin_response(
+        self,
+        code: int,
+        content_type: str,
+        headers: Optional[dict] = None,
+        length: Optional[int] = None,
+    ):
+        """Status line + headers.  `length=None` switches the body to
+        chunked transfer-encoding (`_write_chunk`/`_finish_response`);
+        otherwise the caller writes exactly `length` bytes."""
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        if length is not None:
+            self.send_header("Content-Length", str(length))
+        else:
+            self.send_header("Transfer-Encoding", "chunked")
+        if self._query_id:
+            # Druid parity: every query response (success OR error, buffered
+            # OR streamed) echoes the query's id so clients can correlate
+            # logs and traces
+            self.send_header("X-Druid-Query-Id", self._query_id)
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(b"%x\r\n" % len(data))
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+    def _finish_response(self, code: int, chunked: bool = False):
+        if chunked:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        get_registry().counter(
+            "sdol_http_requests_total",
+            "HTTP responses by method/route/status",
+            labels=("method", "route", "code"),
+        ).labels(
+            method=self.command or "-",
+            route=_route_label(self.path.split("?")[0].rstrip("/")),
+            code=str(code),
+        ).inc()
+
     def _send(self, code: int, payload: Any, headers: Optional[dict] = None):
         body = json.dumps(payload, default=_jsonable).encode()
         self._send_bytes(code, body, "application/json", headers)
@@ -214,26 +281,9 @@ class _Handler(BaseHTTPRequestHandler):
         content_type: str,
         headers: Optional[dict] = None,
     ):
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        if self._query_id:
-            # Druid parity: every query response (success OR error) echoes
-            # the query's id so clients can correlate logs and traces
-            self.send_header("X-Druid-Query-Id", self._query_id)
-        for k, v in (headers or {}).items():
-            self.send_header(k, str(v))
-        self.end_headers()
+        self._begin_response(code, content_type, headers, length=len(body))
         self.wfile.write(body)
-        get_registry().counter(
-            "sdol_http_requests_total",
-            "HTTP responses by method/route/status",
-            labels=("method", "route", "code"),
-        ).labels(
-            method=self.command or "-",
-            route=_route_label(self.path.split("?")[0].rstrip("/")),
-            code=str(code),
-        ).inc()
+        self._finish_response(code)
 
     def _error(
         self,
@@ -272,6 +322,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         import time as _time
 
+        # keep-alive: clear the previous request's query id (GETs have
+        # none) so health/metrics/trace responses never echo a stale
+        # X-Druid-Query-Id from an earlier POST on this connection
+        self._query_id = None
         self._req_t0 = _time.perf_counter()
         path = self.path.split("?")[0].rstrip("/")
         if path in ("/status/health", ""):
@@ -339,6 +393,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         import time as _time
 
+        # per-request state: with HTTP/1.1 keep-alive the SAME handler
+        # instance serves every request on the connection — a stale id
+        # from the previous query must never echo on this response
+        self._query_id = None
         self._req_t0 = _time.perf_counter()
         path = self.path.split("?")[0].rstrip("/")
         body = self._body()
@@ -363,12 +421,27 @@ class _Handler(BaseHTTPRequestHandler):
         )
         cfg = getattr(self.ctx, "config", None)
         res = self._resilience()
-        with self._tracer().query_trace(
-            query_id=self._query_id,
-            query_type="native" if path == "/druid/v2" else "sql",
-            slow_ms=cfg.slow_query_ms if cfg else 0.0,
-        ):
-            return self._handle_query(path, body, qctx, res, cfg)
+        try:
+            with self._tracer().query_trace(
+                query_id=self._query_id,
+                query_type="native" if path == "/druid/v2" else "sql",
+                slow_ms=cfg.slow_query_ms if cfg else 0.0,
+            ):
+                return self._handle_query(path, body, qctx, res, cfg)
+        finally:
+            # a streamed (chunked) response defers its terminal 0-chunk
+            # to HERE — after the trace published to the ring — so a
+            # client that reads to end-of-stream and immediately fetches
+            # /druid/v2/trace/{id} can never race the publish
+            code = getattr(self, "_pending_chunked_finish", None)
+            if code is not None:
+                self._pending_chunked_finish = None
+                try:
+                    self._finish_response(code, chunked=True)
+                except OSError:
+                    # client disconnected mid-stream: the terminal
+                    # 0-chunk has no socket to land on — not an error
+                    pass
 
     def _handle_query(self, path, body, qctx, res, cfg):
         # admission control: a bounded slot pool with a queue-wait timeout
@@ -401,9 +474,18 @@ class _Handler(BaseHTTPRequestHandler):
                     timeout_ms = float("inf")
             else:
                 timeout_ms = cfg.query_timeout_ms if cfg else 0
-            with deadline_scope(timeout_ms):
+            # partial-result collection: session default, overridable per
+            # request via context.partialResults (Druid-style context
+            # flag).  The scope armed HERE is the outermost, so ctx.sql's
+            # own scope joins it and the response headers can read the
+            # collector after execution.
+            p_enabled = bool(cfg.partial_results) if cfg else False
+            pflag = qctx.get("partialResults")
+            if isinstance(pflag, bool):
+                p_enabled = pflag
+            with deadline_scope(timeout_ms), partial_scope(p_enabled):
                 if path == "/druid/v2":
-                    return self._native_query(body)
+                    return self._native_query(body, qctx)
                 return self._sql_query(body)
         except WireError as e:
             return self._error(400, str(e), "BadQueryException")
@@ -529,13 +611,31 @@ class _Handler(BaseHTTPRequestHandler):
             if res is not None:
                 res.ingest_admission.release()
 
-    def _native_query(self, body: dict):
-        res = self._resilience()
-        if res is not None and not res.breaker.allow():
-            raise CircuitOpenError(
-                "device circuit open; native queries cannot degrade to "
-                "the host fallback — retry after the breaker's cooldown"
+    def _partial_headers(self) -> Optional[dict]:
+        """X-Druid-Response-Context carrying the partial-result contract
+        (ISSUE 7): when the answer about to be sent is deadline-bounded,
+        the header holds {"partial": true, "coverage": ..., rows seen /
+        total, delta split} — Druid's own response-context header, so
+        existing clients that already parse it see the flag."""
+        pc = current_partial()
+        if pc is None or not pc.is_partial:
+            return None
+        return {
+            "X-Druid-Response-Context": json.dumps(
+                pc.to_dict(), default=_jsonable
             )
+        }
+
+    # query types that never dispatch device work: answered from catalog
+    # metadata, so breaker state is irrelevant to them
+    _METADATA_QUERIES = (
+        Q.TimeBoundaryQuery,
+        Q.DataSourceMetadataQuery,
+        Q.SegmentMetadataQuery,
+    )
+
+    def _native_query(self, body: dict, qctx: dict):
+        res = self._resilience()
         try:
             q = query_from_druid(body)
         except ValueError as e:
@@ -546,27 +646,183 @@ class _Handler(BaseHTTPRequestHandler):
         ds = self.ctx.catalog.get(q.datasource)
         if ds is None:
             return self._error(400, f"unknown dataSource {q.datasource!r}")
-        if isinstance(q, Q.GroupByQuery) and q.subtotals:
-            # wire subtotalsSpec: same grouping-set expansion the SQL path
-            # uses — the engine alone would silently run only the full set
-            from .api import execute_grouping_sets
-
-            df = execute_grouping_sets(
-                dataclasses.replace(q, subtotals=()), q.subtotals, ds,
-                self.ctx.engine,
+        needs_device = not isinstance(q, self._METADATA_QUERIES)
+        if (
+            needs_device
+            and res is not None
+            and not res.breaker_for("device").allow()
+        ):
+            # the device breaker is open: degrade the wire query through
+            # the native->logical fallback interpreter instead of the old
+            # blanket 503 (the completed degradation-matrix cell); shapes
+            # the interpreter can't cover still fail fast with 503
+            return self._native_degraded(q, None, "circuit_open")
+        progressive = (
+            bool(qctx.get("progressive"))
+            and isinstance(
+                q, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
             )
-            # internal bitmask column; real Druid events don't carry it
-            df = df.drop(columns=["__grouping_id"])
-        else:
-            df = self.ctx.engine.execute(q, ds)
-        self._send(200, druid_result_shape(q, df))
+            and not (isinstance(q, Q.GroupByQuery) and q.subtotals)
+        )
+        if progressive:
+            return self._progressive_query(q, ds)
+        def run():
+            if isinstance(q, Q.GroupByQuery) and q.subtotals:
+                # wire subtotalsSpec: same grouping-set expansion the SQL
+                # path uses — the engine alone would silently run only
+                # the full set
+                from .api import execute_grouping_sets
+
+                df = execute_grouping_sets(
+                    dataclasses.replace(q, subtotals=()), q.subtotals, ds,
+                    self.ctx.engine,
+                )
+                # internal bitmask column; real Druid events don't carry it
+                return df.drop(columns=["__grouping_id"])
+            return self.ctx.engine.execute(q, ds)
+
+        try:
+            self.ctx._sync_engine_resilience(self.ctx.engine)
+            try:
+                df = run()
+            except Exception as err:
+                # deadline expiry OUTSIDE the partial-capable loops
+                # (planning, a blocking fetch, a ladder rung): same
+                # drain-rerun the SQL surface does in
+                # api._execute_with_resilience — trigger the collector
+                # so every checkpoint no-ops, and the rerun yields the
+                # well-formed coverage-stamped answer instead of a 504
+                pc = current_partial()
+                if pc is None or classify_error(err) != "deadline":
+                    raise
+                pc.trigger(getattr(err, "site", "") or "deadline")
+                log.warning(
+                    "deadline expired outside a partial-capable loop "
+                    "(%s); draining a best-effort native answer", err,
+                )
+                df = run()
+            # partial-result discipline (GL16xx): the native surface
+            # publishes a deadline-bounded answer (partial span +
+            # sdol_partial_results_total/coverage histogram) exactly like
+            # ctx.sql's _stamp_partial path; _partial_headers below only
+            # adds the wire header
+            df = self.ctx._stamp_partial(df)
+        except Exception as err:
+            # a transient device failure that survived the engine's retry
+            # budget degrades exactly like the SQL path does; static
+            # errors and deadlines keep their taxonomy (handled above)
+            if res is None or classify_error(err) != "transient":
+                raise
+            return self._native_degraded(q, err, "device_failed")
+        self._send(
+            200, druid_result_shape(q, df),
+            headers=self._partial_headers(),
+        )
+
+    def _native_degraded(self, q, err, reason: str):
+        """Degrade one wire-native query to the host fallback via the
+        QuerySpec->logical interpreter.  Unsupported shapes keep the old
+        fail-fast contract (503 on an open circuit, the original error
+        otherwise) — a wrong degraded answer is worse than no answer."""
+        from .exec.wire_fallback import WireFallbackUnsupported
+        from .plan.transforms import RewriteError
+
+        try:
+            df = self.ctx.execute_native_degraded(q, err, reason=reason)
+        except (WireFallbackUnsupported, NotImplementedError, RewriteError) as e:
+            # RewriteError covers config.fallback_execution=False: the
+            # degraded route is administratively off, so an open breaker
+            # must keep the old fail-fast 503 + Retry-After contract
+            # (not surface as a 500 through the generic handler)
+            if err is None:
+                raise CircuitOpenError(
+                    "device circuit open and this native query cannot "
+                    f"degrade to the host fallback ({e}) — retry after "
+                    "the breaker's cooldown"
+                ) from e
+            raise err
+        self._send(
+            200, druid_result_shape(q, df),
+            headers=self._partial_headers(),
+        )
+
+    def _progressive_query(self, q, ds):
+        """Chunked progressive response (ISSUE 7 tentpole (b)): one
+        NDJSON line per refinement — {"sequence", "coverage", "partial",
+        "final", "result"} — converging to the exact answer as segment
+        batches complete.  The FIRST refinement is computed before the
+        status line commits, so pre-execution errors still produce
+        normal structured error responses; mid-stream failures emit a
+        terminal {"error": ...} line (the status is already on the
+        wire)."""
+        from .obs import SPAN_STREAM_FLUSH, span
+
+        self.ctx._sync_engine_resilience(self.ctx.engine)
+        gen = self.ctx.engine.execute_progressive(q, ds)
+        item = next(gen)  # may raise -> structured error path
+        self._begin_response(200, "application/x-ndjson")
+        try:
+            while True:
+                df, info = item
+                line = {
+                    "sequence": info["sequence"],
+                    "coverage": info["coverage"],
+                    "partial": bool(info.get("partial", False)),
+                    "final": bool(info["final"]),
+                    "rows_seen": info.get("rows_seen"),
+                    "rows_total": info.get("rows_total"),
+                    "result": druid_result_shape(q, df),
+                }
+                with span(SPAN_STREAM_FLUSH, sequence=info["sequence"]):
+                    self._write_chunk(
+                        json.dumps(line, default=_jsonable).encode()
+                        + b"\n"
+                    )
+                if info["final"]:
+                    break
+                item = next(gen)
+        except OSError as e:
+            # the CLIENT went away mid-stream (broken pipe / reset):
+            # there is no socket to write a terminal line to, and a
+            # disconnect is not a server error — swallow it here so it
+            # neither attempts a second response through _error(500) nor
+            # inflates the /status/health server-error counters
+            log.info(
+                "progressive client disconnected mid-stream: %s",
+                type(e).__name__,
+            )
+        except Exception as e:  # fault-ok: status already sent; emit a terminal error line
+            log.error(
+                "progressive stream failed: %s", type(e).__name__,
+                exc_info=True,
+            )
+            try:
+                self._write_chunk(
+                    json.dumps(
+                        {
+                            "error": "progressive stream failed; see "
+                            "server logs",
+                            "errorClass": type(e).__name__,
+                            "final": True,
+                        }
+                    ).encode()
+                    + b"\n"
+                )
+            except OSError:
+                pass  # dead socket: the log line above is the record
+        finally:
+            # the terminal 0-chunk is DEFERRED to do_POST, past the
+            # query_trace exit: the client's read() completes only on
+            # that chunk, so the finished trace is guaranteed to be in
+            # the ring before the client can ask /druid/v2/trace for it
+            self._pending_chunked_finish = 200
 
     def _sql_query(self, body: dict):
         sql = body.get("query")
         if not sql:
             return self._error(400, 'body must be {"query": "SELECT ..."}')
         df = self.ctx.sql(sql)
-        self._send(200, _rows(df))
+        self._send(200, _rows(df), headers=self._partial_headers())
 
 
 class OlapServer:

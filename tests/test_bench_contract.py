@@ -113,6 +113,10 @@ def test_production_tag_keys_scale(monkeypatch):
     mode, fn, arg = bench._parse_args(["ingest", "2"])
     assert "%s_%g" % (mode, arg) == "ingest_2"
     assert fn is bench.bench_ingest
+    # deadline sweep (ISSUE 7): SSB scale-factor float arg
+    mode, fn, arg = bench._parse_args(["deadline", "1"])
+    assert "%s_%g" % (mode, arg) == "deadline_1"
+    assert fn is bench.bench_deadline
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -154,6 +158,60 @@ def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
     detail = json.load(open(tmp_path / "BENCH_ingest_2_detail.json"))
     assert detail["detail"]["append_visible_p50_ms"] == 12.5
     assert detail["detail"]["span_tree_append"] == fat_tree
+
+
+def test_emit_deadline_result_shape(capsys, tmp_path, monkeypatch):
+    """The deadline mode's fat per-(query, deadline) curves + span tree
+    live in the detail sidecar; stdout stays one compact line."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    curves = {
+        "q%d_%d" % (i, j): [
+            {
+                "deadline_ms": 1.0 * k,
+                "fraction_of_full": 0.1 * k,
+                "wellformed": True,
+                "partial": k < 2,
+                "coverage": min(1.0, 0.5 * k),
+                "total_ms": 3.0,
+                "oracle_equal": True,
+            }
+            for k in range(5)
+        ]
+        for i in range(1, 5)
+        for j in range(1, 4)
+    }
+    bench._emit(
+        {
+            "metric": "deadline_ssb_sf1_wellformed_pct",
+            "value": 100.0,
+            "unit": "%",
+            "vs_baseline": 1.0,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 6_000_000,
+                "runs": 65,
+                "wellformed": 65,
+                "oracle_equal_all": True,
+                "curves": curves,
+                "span_tree_tightest_deadline": {
+                    "name": "query",
+                    "children": [{"name": "partial"}] * 30,
+                },
+            },
+        },
+        "deadline_1",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "deadline_ssb_sf1_wellformed_pct"
+    assert parsed["value"] == 100.0
+    assert "curves" not in parsed
+    detail = json.load(open(tmp_path / "BENCH_deadline_1_detail.json"))
+    assert detail["detail"]["curves"]["q1_1"][0]["partial"] is True
+    assert detail["detail"]["oracle_equal_all"] is True
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

@@ -1456,6 +1456,92 @@ _MATRIX = {
             """},
         ],
     },
+    "partial-discipline": {
+        "violating": [
+            # GL1601: partial=True flagged with NO coverage stamp and NO
+            # publishing call
+            (
+                {"spark_druid_olap_tpu/exec/engine.py": """
+                    class Engine:
+                        def finish(self, m, pc):
+                            if pc is not None and pc.is_partial:
+                                m.partial = True
+                            self.last_metrics = m
+                """},
+                {"GL1601"},
+            ),
+            # GL1602: except DeadlineExceeded swallowed into a generic
+            # decline (neither re-raised nor absorbed into the collector)
+            (
+                {"spark_druid_olap_tpu/exec/sparse_exec.py": """
+                    from ..resilience import DeadlineExceeded
+
+                    def resolve(state):
+                        try:
+                            return state.fetch(), "ok"
+                        except DeadlineExceeded:
+                            return None, "error"
+                """},
+                {"GL1602"},
+            ),
+            # GL1601: coverage stamped but the partial observation is
+            # never published (no record_* / span(SPAN_PARTIAL))
+            (
+                {"spark_druid_olap_tpu/api.py": """
+                    def stamp(df, m, pc):
+                        m.partial = True
+                        m.coverage = pc.coverage()
+                        return df
+                """},
+                {"GL1601"},
+            ),
+        ],
+        "clean": [
+            # partial=True + coverage + publication (record_query_metrics
+            # reached lexically): the full contract
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                from ..obs import record_query_metrics
+
+                def finish(self, m, pc, outcome):
+                    if pc is not None and pc.is_partial:
+                        m.partial = True
+                        m.coverage = pc.coverage()
+                    record_query_metrics(m, outcome)
+            """},
+            # except DeadlineExceeded that re-raises, and one that absorbs
+            # into the collector, are both disciplined
+            {"spark_druid_olap_tpu/exec/adaptive_exec.py": """
+                from ..resilience import DeadlineExceeded, current_partial
+
+                def dispatch(q):
+                    try:
+                        return q.run()
+                    except DeadlineExceeded:
+                        raise
+
+                def dispatch_soft(q):
+                    try:
+                        return q.run()
+                    except DeadlineExceeded as err:
+                        pc = current_partial()
+                        if pc is None:
+                            raise
+                        pc.trigger(err.site)
+                        return None
+            """},
+            # the same shapes OUTSIDE the executor/api scope belong to
+            # other passes (the server's 504 conversion is legitimate)
+            {"spark_druid_olap_tpu/server.py": """
+                from .resilience import DeadlineExceeded
+
+                def handle(self, body):
+                    try:
+                        return self.run(body)
+                    except DeadlineExceeded as e:
+                        return self.error(504, str(e))
+            """},
+        ],
+    },
     "ingest-discipline": {
         "violating": [
             # GL1501: unlocked publish + unlocked guarded-field mutation

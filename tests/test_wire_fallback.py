@@ -1,0 +1,633 @@
+"""Native-query degradation + progressive streaming + per-backend
+breakers (ISSUE 7 tentpole (b)/(c)).
+
+Parity contract: a wire-native query answered DEGRADED (device breaker
+open / transient device failure) through the QuerySpec->logical fallback
+interpreter must produce the same Druid-shaped response the healthy
+device path produces — for groupBy, topN, and timeseries — and must
+match the SQL fallback's answer for the equivalent SQL text.
+
+Progressive contract: `context.progressive` streams NDJSON refinements
+whose coverage grows monotonically to 1.0, with the final refinement
+exactly equal to the buffered response.
+
+Breaker contract: device / mesh / fallback breakers are independent
+(visible in /status/health and as `sdol_breaker_state{backend=...}` in
+/status/metrics), and a fallback sick enough to trip its own breaker
+fails fast instead of re-grinding.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.models.wire import query_from_druid
+from spark_druid_olap_tpu.resilience import injector
+from spark_druid_olap_tpu.server import OlapServer, druid_result_shape
+from spark_druid_olap_tpu.utils.floatcmp import frames_allclose
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injector().disarm()
+    yield
+    injector().disarm()
+
+
+DAY = 86_400_000
+
+
+def _make_ctx(**overrides):
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0
+    cfg.retry_backoff_ms = 1.0
+    cfg.prefer_distributed = False
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    ctx = sd.TPUOlapContext(cfg)
+    n = 8_000
+    rng = np.random.default_rng(3)
+    ctx.register_table(
+        "ev",
+        {
+            "city": rng.choice(
+                np.array(["NY", "SF", "LA", "CHI"], dtype=object), n
+            ),
+            "tier": rng.choice(np.array(["gold", "free"], dtype=object), n),
+            "v": rng.integers(1, 100, n).astype(np.float32),
+            "ts": (rng.integers(0, 14, n) * DAY).astype(np.int64),
+        },
+        dimensions=["city", "tier"],
+        metrics=["v"],
+        time_column="ts",
+        rows_per_segment=1 << 10,
+    )
+    return ctx
+
+
+_GROUPBY = {
+    "queryType": "groupBy",
+    "dataSource": "ev",
+    "granularity": "all",
+    "dimensions": ["city", "tier"],
+    "aggregations": [
+        {"type": "doubleSum", "name": "s", "fieldName": "v"},
+        {"type": "count", "name": "n"},
+        {
+            "type": "filtered",
+            "filter": {"type": "selector", "dimension": "tier",
+                       "value": "gold"},
+            "aggregator": {"type": "doubleSum", "name": "gold_s",
+                           "fieldName": "v"},
+        },
+    ],
+    "postAggregations": [
+        {
+            "type": "arithmetic", "name": "avg_v", "fn": "/",
+            "fields": [
+                {"type": "fieldAccess", "fieldName": "s"},
+                {"type": "fieldAccess", "fieldName": "n"},
+            ],
+        }
+    ],
+    "filter": {
+        "type": "in", "dimension": "city", "values": ["NY", "SF", "LA"],
+    },
+    "having": {"type": "greaterThan", "aggregation": "n", "value": 1},
+    "intervals": ["1970-01-01T00:00:00Z/1970-01-10T00:00:00Z"],
+    "limitSpec": {
+        "type": "default",
+        "limit": 50,
+        "columns": [{"dimension": "s", "direction": "descending"}],
+    },
+}
+
+_TOPN = {
+    "queryType": "topN",
+    "dataSource": "ev",
+    "granularity": "all",
+    "dimension": "city",
+    "metric": "s",
+    "threshold": 3,
+    "aggregations": [
+        {"type": "doubleSum", "name": "s", "fieldName": "v"}
+    ],
+    "intervals": ["1970-01-01T00:00:00Z/1970-01-15T00:00:00Z"],
+}
+
+_TIMESERIES = {
+    "queryType": "timeseries",
+    "dataSource": "ev",
+    "granularity": "day",
+    "aggregations": [
+        {"type": "doubleSum", "name": "s", "fieldName": "v"},
+        {"type": "count", "name": "n"},
+    ],
+    "filter": {"type": "selector", "dimension": "tier", "value": "gold"},
+    "intervals": ["1970-01-01T00:00:00Z/1970-01-15T00:00:00Z"],
+}
+
+
+def _shape(ctx, spec):
+    q = query_from_druid(spec)
+    ds = ctx.catalog.get(q.datasource)
+    return q, druid_result_shape(q, ctx.engine.execute(q, ds))
+
+
+def _degraded_shape(ctx, spec, err=None):
+    q = query_from_druid(spec)
+    return q, druid_result_shape(
+        q, ctx.execute_native_degraded(q, err, reason="test")
+    )
+
+
+def _canon(shaped):
+    """Order-insensitive canonical form with float rounding."""
+
+    def walk(x):
+        if isinstance(x, float):
+            return round(x, 6)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in sorted(x.items())}
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        return x
+
+    return walk(shaped)
+
+
+@pytest.mark.parametrize(
+    "spec", [_GROUPBY, _TOPN, _TIMESERIES],
+    ids=["groupBy", "topN", "timeseries"],
+)
+def test_native_fallback_parity_golden(spec):
+    """Degraded wire response == healthy wire response, byte-for-byte
+    after float rounding (groupBy rows sorted by the limitSpec metric
+    may tie-break differently; these fixtures have no exact ties)."""
+    ctx = _make_ctx()
+    _, healthy = _shape(ctx, spec)
+    _, degraded = _degraded_shape(ctx, spec)
+    assert _canon(degraded) == _canon(healthy)
+    m = ctx.last_metrics
+    assert m.executor == "fallback" and m.degraded
+
+
+def test_native_fallback_matches_sql_fallback():
+    """The same aggregation written as SQL and degraded through the SQL
+    path must agree with the native degraded answer (satellite golden:
+    the two fallback surfaces cannot drift)."""
+    ctx = _make_ctx()
+    q, degraded = _degraded_shape(ctx, _TOPN)
+    injector().arm("device_dispatch", "error")
+    sql_df = ctx.sql(
+        "SELECT city, sum(v) AS s FROM ev GROUP BY city "
+        "ORDER BY s DESC LIMIT 3"
+    )
+    assert ctx.last_metrics.executor == "fallback"
+    native_rows = degraded[0]["result"]
+    assert [r["city"] for r in native_rows] == list(sql_df["city"])
+    assert np.allclose(
+        [r["s"] for r in native_rows], np.asarray(sql_df["s"])
+    )
+
+
+def test_native_degraded_over_http_on_open_breaker():
+    ctx = _make_ctx(breaker_failure_threshold=1,
+                    breaker_cooldown_ms=600_000)
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        _, healthy = _shape(ctx, _GROUPBY)
+        dev = ctx.resilience.breaker_for("device")
+        dev.record_failure()  # threshold 1: open
+        assert dev.state == "open"
+        body = json.dumps(_GROUPBY).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            got = json.loads(r.read())
+        assert _canon(got) == _canon(healthy)
+        h = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status/health", timeout=30
+            ).read()
+        )
+        assert h["breakers"]["device"]["state"] == "open"
+        assert h["breakers"]["fallback"]["state"] == "closed"
+        assert h["breakers"]["mesh"]["state"] == "closed"
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.parametrize("qtype", ["groupBy", "topN"])
+def test_query_level_granularity_parity(qtype):
+    """Query-level granularity (Druid's implicit leading time-bucket
+    dimension on groupBy/topN) must survive degradation: collapsing all
+    time buckets into one would be a silently-wrong 200."""
+    ctx = _make_ctx()
+    if qtype == "groupBy":
+        spec = {
+            "queryType": "groupBy", "dataSource": "ev",
+            "granularity": "day", "dimensions": ["city"],
+            "aggregations": [
+                {"type": "count", "name": "n"},
+                {"type": "doubleSum", "name": "s", "fieldName": "v"},
+            ],
+            "intervals": ["1970-01-01T00:00:00Z/1970-01-08T00:00:00Z"],
+        }
+    else:
+        spec = {
+            "queryType": "topN", "dataSource": "ev",
+            "granularity": "day", "dimension": "city",
+            "metric": "s", "threshold": 2,
+            "aggregations": [
+                {"type": "doubleSum", "name": "s", "fieldName": "v"}
+            ],
+            "intervals": ["1970-01-01T00:00:00Z/1970-01-08T00:00:00Z"],
+        }
+    _, healthy = _shape(ctx, spec)
+    _, degraded = _degraded_shape(ctx, spec)
+    assert sorted(_canon(degraded), key=str) == sorted(
+        _canon(healthy), key=str
+    )
+
+
+def test_keepalive_get_never_echoes_stale_query_id():
+    """HTTP/1.1 keep-alive: the same handler instance serves every
+    request on a connection — a GET after a POST must not echo the
+    POST's X-Druid-Query-Id on the health/metrics response."""
+    import http.client
+
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port)
+        spec = dict(_TIMESERIES, context={"queryId": "sticky-q1"})
+        conn.request(
+            "POST", "/druid/v2", body=json.dumps(spec),
+            headers={"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("X-Druid-Query-Id") == "sticky-q1"
+        r.read()
+        conn.request("GET", "/status/health")  # same connection
+        r2 = conn.getresponse()
+        assert r2.status == 200
+        assert r2.getheader("X-Druid-Query-Id") != "sticky-q1"
+        r2.read()
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_progressive_client_disconnect_is_not_a_server_error(monkeypatch):
+    """A client dropping a progressive stream mid-flight must not count
+    as a server error or wedge the connection handler — the dead socket
+    is swallowed and the next query serves normally."""
+    from spark_druid_olap_tpu import server as server_mod
+
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        orig = server_mod._Handler._write_chunk
+        calls = {"n": 0}
+
+        def dying_socket(self, data):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise BrokenPipeError("client went away")
+            return orig(self, data)
+
+        monkeypatch.setattr(
+            server_mod._Handler, "_write_chunk", dying_socket
+        )
+        before = ctx.resilience.server_errors_total
+        spec = dict(_GROUPBY, context={"progressive": True})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2",
+            data=json.dumps(spec).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60).read()
+        except Exception:
+            pass  # the truncated stream may or may not parse client-side
+        assert calls["n"] >= 2  # the injected disconnect fired
+        assert ctx.resilience.server_errors_total == before
+        monkeypatch.setattr(server_mod._Handler, "_write_chunk", orig)
+        # the server still answers normally afterwards
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2",
+            data=json.dumps(_GROUPBY).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2, timeout=60) as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()
+
+
+def test_scan_order_by_time_degrades():
+    """Scan order-by __time must resolve against the PROJECTED column
+    names (the projection renames the raw time column to __time); the
+    degraded rows must match the healthy device scan."""
+    ctx = _make_ctx()
+    spec = {
+        "queryType": "scan",
+        "dataSource": "ev",
+        "columns": ["__time", "city", "v"],
+        "intervals": ["1970-01-01T00:00:00Z/1970-01-15T00:00:00Z"],
+        "order": "ascending",
+        "limit": 7,
+    }
+    _, healthy = _shape(ctx, spec)
+    _, degraded = _degraded_shape(ctx, spec)
+    assert _canon(degraded) == _canon(healthy)
+
+
+def test_groupby_bare_time_dimension_parity():
+    """A groupBy time dimension at granularity 'all' is a single
+    all-time bucket — the device path emits the column, so the degraded
+    path must too (shape parity), not silently drop it."""
+    ctx = _make_ctx()
+    spec = {
+        "queryType": "groupBy",
+        "dataSource": "ev",
+        "granularity": "all",
+        "dimensions": [
+            "city",
+            {"type": "default", "dimension": "__time",
+             "outputName": "t"},
+        ],
+        "aggregations": [{"type": "count", "name": "n"}],
+        "intervals": ["1970-01-01T00:00:00Z/1970-01-15T00:00:00Z"],
+    }
+    _, healthy = _shape(ctx, spec)
+    _, degraded = _degraded_shape(ctx, spec)
+    # no limitSpec: groupBy row order is unspecified — compare as sets
+    assert sorted(_canon(degraded), key=str) == sorted(
+        _canon(healthy), key=str
+    )
+    assert all("t" in r["event"] for r in degraded)
+
+
+def test_native_deadline_outside_partial_loops_drains_to_200():
+    """A deadline first observed at a NON-partial checkpoint (here:
+    the device_dispatch fault site, outside every checkpoint_partial
+    loop) must drain-rerun on the native surface exactly like
+    api._execute_with_resilience does for SQL — a coverage-stamped 200,
+    not a 504."""
+    from spark_druid_olap_tpu.resilience import InjectedDeadline
+
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        body = json.dumps(_TIMESERIES).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=60).read()  # warm
+        injector().arm(
+            "device_dispatch", "error", times=1,
+            error_type=InjectedDeadline,
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            rctx = json.loads(r.headers["X-Druid-Response-Context"])
+        assert rctx["partial"] is True
+        assert rctx["coverage"] is not None
+    finally:
+        srv.shutdown()
+
+
+def test_native_partial_publishes_counter_and_header():
+    """A deadline-bounded answer on the NATIVE surface publishes exactly
+    like the SQL surface (partial-result discipline, GL16xx): the wire
+    header carries the coverage contract AND the fleet counter/histogram
+    record it — not just the header."""
+    from spark_druid_olap_tpu.obs import get_registry
+    from spark_druid_olap_tpu.resilience import InjectedDeadline
+
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        before = get_registry().counter(
+            "sdol_partial_results_total", labels=("site",)
+        ).snapshot()
+        injector().arm(
+            "engine.segment_loop", "error", times=1, skip=1,
+            error_type=InjectedDeadline,
+        )
+        body = json.dumps(_GROUPBY).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            rctx = json.loads(r.headers["X-Druid-Response-Context"])
+        assert rctx["partial"] is True
+        assert 0.0 <= rctx["coverage"] < 1.0
+        after = get_registry().counter(
+            "sdol_partial_results_total", labels=("site",)
+        ).snapshot()
+        assert sum(after.values()) == sum(before.values()) + 1
+    finally:
+        srv.shutdown()
+
+
+def test_native_unsupported_shape_keeps_503_on_open_breaker():
+    """Shapes the interpreter can't cover keep the fail-fast 503: a
+    wrong degraded answer would be worse than no answer."""
+    ctx = _make_ctx(breaker_failure_threshold=1,
+                    breaker_cooldown_ms=600_000)
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        ctx.resilience.breaker_for("device").record_failure()
+        spec = dict(_GROUPBY)
+        spec["dimensions"] = [
+            {
+                "type": "extraction",
+                "dimension": "city",
+                "outputName": "c0",
+                "extractionFn": {"type": "substring", "index": 0,
+                                 "length": 1},
+            }
+        ]
+        body = json.dumps(spec).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+    finally:
+        srv.shutdown()
+
+
+def test_metadata_queries_served_through_open_breaker():
+    """timeBoundary/segmentMetadata never dispatch device work: an open
+    breaker must not block them (per-backend granularity in action)."""
+    ctx = _make_ctx(breaker_failure_threshold=1,
+                    breaker_cooldown_ms=600_000)
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        ctx.resilience.breaker_for("device").record_failure()
+        body = json.dumps(
+            {"queryType": "timeBoundary", "dataSource": "ev"}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            out = json.loads(r.read())
+        assert out and "minTime" in out[0]["result"]
+    finally:
+        srv.shutdown()
+
+
+def test_fallback_breaker_trips_and_fails_fast():
+    """Consecutive TRANSIENT fallback failures open the fallback
+    breaker; while open, a degraded query fails fast with the original
+    device error instead of re-grinding the sick interpreter."""
+    ctx = _make_ctx(breaker_failure_threshold=2,
+                    breaker_cooldown_ms=600_000)
+    injector().arm("device_dispatch", "error")
+    injector().arm("fallback_decode", "error")  # the decode fault site
+    q = "SELECT city, sum(v) AS s FROM ev GROUP BY city"
+    for _ in range(2):
+        with pytest.raises(Exception):
+            ctx.sql(q)
+    fb = ctx.resilience.breaker_for("fallback")
+    assert fb.state == "open"
+    injector().disarm("fallback_decode")  # the fallback is healthy again
+    # ... but its breaker is still open: fail fast, no decode attempt
+    fired = injector().state()["fired"].get("fallback_decode", 0)
+    with pytest.raises(Exception):
+        ctx.sql(q)
+    assert injector().state()["fired"].get("fallback_decode", 0) == fired
+    # after the cooldown, a half-open probe recovers the backend
+    fb.cooldown_ms = 0.0
+    injector().disarm()
+    injector().arm("device_dispatch", "error")
+    df = ctx.sql(q)
+    assert ctx.last_metrics.executor == "fallback"
+    assert len(df) == 4 and fb.state == "closed"
+
+
+def test_breaker_state_gauges_in_prometheus():
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        ctx.resilience.breaker_for("mesh").record_failure()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/status/metrics", timeout=30
+        ).read().decode()
+        for backend in ("device", "mesh", "fallback"):
+            assert f'sdol_breaker_state{{backend="{backend}"}}' in text
+        # closed == 0 for the untouched backends
+        assert 'sdol_breaker_state{backend="device"} 0' in text
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# progressive streaming
+# ---------------------------------------------------------------------------
+
+
+def _post_progressive(port, spec, timeout=120):
+    body = dict(spec)
+    body["context"] = {**body.get("context", {}), "progressive": True}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/druid/v2",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        qid = r.headers.get("X-Druid-Query-Id")
+        lines = [
+            json.loads(x) for x in r.read().decode().strip().splitlines()
+        ]
+    return qid, lines
+
+
+@pytest.mark.parametrize(
+    "spec", [_GROUPBY, _TOPN, _TIMESERIES],
+    ids=["groupBy", "topN", "timeseries"],
+)
+def test_progressive_refinements_converge_to_exact(spec):
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        _, healthy = _shape(ctx, spec)
+        qid, lines = _post_progressive(srv.port, spec)
+        assert qid
+        assert len(lines) >= 2, "multiple refinements expected"
+        covs = [l["coverage"] for l in lines]
+        assert all(a <= b + 1e-9 for a, b in zip(covs, covs[1:]))
+        assert lines[-1]["final"] is True
+        assert lines[-1]["coverage"] == 1.0
+        assert lines[-1]["partial"] is False
+        assert _canon(lines[-1]["result"]) == _canon(healthy)
+        # every refinement is well-formed druid shape
+        for l in lines:
+            assert isinstance(l["result"], list)
+    finally:
+        srv.shutdown()
+
+
+def test_progressive_stream_flush_spans_in_trace():
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        qid, lines = _post_progressive(srv.port, _TOPN)
+        tr = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/druid/v2/trace/{qid}",
+                timeout=30,
+            ).read()
+        )
+
+        def count(node, name):
+            n = 1 if node["name"] == name else 0
+            return n + sum(
+                count(c, name) for c in node.get("children", ())
+            )
+
+        assert count(tr["spans"], "stream_flush") == len(lines)
+    finally:
+        srv.shutdown()
+
+
+def test_progressive_falls_back_to_buffered_for_non_aggregates():
+    """Scan has no mergeable state to refine: context.progressive on a
+    non-aggregate type emits one final chunk (never an error)."""
+    ctx = _make_ctx()
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        spec = {
+            "queryType": "scan",
+            "dataSource": "ev",
+            "columns": ["city", "v"],
+            "limit": 5,
+            "intervals": ["1970-01-01T00:00:00Z/1970-01-15T00:00:00Z"],
+        }
+        qid, lines = _post_progressive(srv.port, spec)
+        # non-aggregate types answer buffered (no NDJSON refinement)
+        assert len(lines) >= 1
+    finally:
+        srv.shutdown()

@@ -10,7 +10,8 @@ GL2xx trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
 lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
 collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
-lock-order, GL15xx ingest-discipline; GL00x are the core's own: GL001 unparseable file, GL002
+lock-order, GL15xx ingest-discipline, GL16xx partial-discipline; GL00x
+are the core's own: GL001 unparseable file, GL002
 malformed pragma).
 """
 
@@ -30,6 +31,7 @@ from .jit_collision import JitCollisionPass
 from .lock_discipline import LockDisciplinePass
 from .lock_order import LockOrderPass
 from .pallas_shape import PallasShapePass
+from .partial_discipline import PartialDisciplinePass
 from .resource_budget import ResourceBudgetPass
 from .span_discipline import SpanDisciplinePass
 from .trace_purity import TracePurityPass
@@ -51,6 +53,7 @@ ALL_PASSES = (
     JitCollisionPass,
     LockOrderPass,
     IngestDisciplinePass,
+    PartialDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,170 @@
+"""partial-result discipline pass (GL16xx).
+
+ISSUE 7 turned deadline expiry into graded answers: executors stop at a
+`checkpoint_partial` site, merge the partials they hold, and return them
+stamped `partial=True` with a coverage fraction.  Two ways that contract
+rots silently:
+
+* a code path starts flagging results `partial = True` without stamping
+  the coverage fraction or publishing the partial observation — clients
+  then see a best-effort answer they cannot size (the wire contract
+  REQUIRES coverage next to the flag);
+* an executor grows an `except DeadlineExceeded` handler that swallows
+  the expiry into a generic decline/fallback path — the query then
+  re-pays the whole scan on another executor (or silently loses its
+  deadline semantics) instead of producing the partial the machinery
+  exists for.
+
+Checks (over the executor + api modules):
+
+* **GL1601** — a function that assigns `<obj>.partial = True` must, in
+  the same function, (a) assign `<obj>.coverage` and (b) reach a
+  publishing call — `record_partial`, `record_query_metrics`, or a
+  `span(SPAN_PARTIAL, ...)` — lexically or one call level down.
+* **GL1602** — an `except DeadlineExceeded` handler whose body neither
+  re-raises nor touches the partial machinery (`.trigger(...)`,
+  `checkpoint_partial(...)`, `current_partial(...)`) swallows the
+  deadline without producing partials.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, ModuleContext
+
+_PUBLISH_NAMES = ("record_partial", "record_query_metrics")
+_ABSORB_NAMES = ("trigger", "checkpoint_partial", "current_partial")
+
+
+def _is_publish(name: str, canon: str) -> bool:
+    short = name.rsplit(".", 1)[-1]
+    return short in _PUBLISH_NAMES or any(
+        canon.endswith("." + p) for p in _PUBLISH_NAMES
+    )
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_partial_span_call(node: ast.Call) -> bool:
+    if _call_name(node) != "span" or not node.args:
+        return False
+    a = node.args[0]
+    return (isinstance(a, ast.Name) and a.id == "SPAN_PARTIAL") or (
+        isinstance(a, ast.Attribute) and a.attr == "SPAN_PARTIAL"
+    )
+
+
+def _names_deadline(node: ast.AST) -> bool:
+    """Does an except-clause type expression name DeadlineExceeded (or a
+    subclass spelled as such), directly or inside a tuple?"""
+    if node is None:
+        return False
+    if isinstance(node, ast.Tuple):
+        return any(_names_deadline(x) for x in node.elts)
+    if isinstance(node, ast.Name):
+        return node.id in ("DeadlineExceeded", "InjectedDeadline")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("DeadlineExceeded", "InjectedDeadline")
+    return False
+
+
+class PartialDisciplinePass(LintPass):
+    name = "partial-discipline"
+    default_config = {
+        # where partial answers are produced/stamped; server.py is
+        # deliberately OUT of scope — its except DeadlineExceeded
+        # legitimately converts an opted-out expiry to a 504
+        "include": (
+            "spark_druid_olap_tpu/exec/",
+            "spark_druid_olap_tpu/api.py",
+            "spark_druid_olap_tpu/parallel/",
+        ),
+        "call_through_depth": 1,
+    }
+
+    # -- GL1601: partial=True must travel with coverage + publication --------
+
+    def on_FunctionDef(self, node: ast.FunctionDef, ctx: ModuleContext):
+        self._check_partial_stamp(node, ctx)
+
+    def on_AsyncFunctionDef(self, node, ctx: ModuleContext):
+        self._check_partial_stamp(node, ctx)
+
+    def _attr_stores(self, fn: ast.AST, attr: str):
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.Assign, ast.AugAssign)):
+                continue
+            targets = (
+                sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == attr:
+                    yield sub
+
+    def _check_partial_stamp(self, fn, ctx: ModuleContext):
+        partial_sets = [
+            s
+            for s in self._attr_stores(fn, "partial")
+            if isinstance(s, ast.Assign)
+            and isinstance(s.value, ast.Constant)
+            and s.value.value is True
+        ]
+        if not partial_sets:
+            return
+        has_coverage = any(True for _ in self._attr_stores(fn, "coverage"))
+        publishes = any(
+            isinstance(sub, ast.Call) and _is_partial_span_call(sub)
+            for sub in ast.walk(fn)
+        )
+        if not publishes and self.project is not None:
+            module = self.project.modules.get(ctx.relpath)
+            if module is not None:
+                publishes = self.project.reaches_call(
+                    module, fn, _is_publish,
+                    depth=int(self.config["call_through_depth"]),
+                    cls=ctx.scope.current_class,
+                )
+        if has_coverage and publishes:
+            return
+        missing = []
+        if not has_coverage:
+            missing.append("a `.coverage` stamp")
+        if not publishes:
+            missing.append(
+                "a publishing call (record_partial / "
+                "record_query_metrics / span(SPAN_PARTIAL, ...))"
+            )
+        self.report(
+            ctx, partial_sets[0], "GL1601",
+            "this function flags a result `partial = True` without "
+            + " or ".join(missing)
+            + " — a best-effort answer MUST carry its coverage fraction "
+            "and be observable (ISSUE 7 wire contract)",
+        )
+
+    # -- GL1602: swallowed DeadlineExceeded ----------------------------------
+
+    def on_ExceptHandler(self, node: ast.ExceptHandler, ctx: ModuleContext):
+        if not _names_deadline(node.type):
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return  # re-raised (possibly conditionally): fine
+            if isinstance(sub, ast.Call) and _call_name(sub) in _ABSORB_NAMES:
+                return  # absorbed INTO the partial machinery: fine
+        self.report(
+            ctx, node, "GL1602",
+            "this handler swallows DeadlineExceeded without producing "
+            "partials: re-raise it, or absorb it into the partial "
+            "machinery (collector.trigger / checkpoint_partial) — a "
+            "silently-dropped deadline re-pays the scan elsewhere and "
+            "loses the best-effort answer",
+        )
