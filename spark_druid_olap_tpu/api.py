@@ -103,11 +103,15 @@ class TPUOlapContext:
         from .utils.lru import CountBudgetCache
 
         self._plan_cache = CountBudgetCache(256)
-        # result-level cache (Druid broker result cache analog): identical
-        # (query, schema) pairs skip execution entirely
-        self._result_cache = CountBudgetCache(
-            max(self.config.result_cache_entries, 1)
-        )
+        # async serving core (serve/, ISSUE 8): micro-batch query fusion,
+        # the delta-aware version-keyed result cache, and SQL lane
+        # classification.  The result cache (Druid broker result cache
+        # analog) lives inside it: identical (query, schema) pairs skip
+        # execution entirely, and on append it serves (cached historical
+        # partial) ⊕ (fresh delta partials) instead of invalidating.
+        from .serve import ServingCore
+
+        self.serve = ServingCore(self)
         # CREATE VIEW registry: view name -> defining SELECT text; the
         # parser expands references as derived tables
         self.views: Dict[str, str] = {}
@@ -319,12 +323,19 @@ class TPUOlapContext:
     def drop_table(self, name: str):
         self.catalog.drop(name)
 
+    @property
+    def _result_cache(self):
+        """The serving core's result cache under its pre-serve name —
+        `SET result_cache_entries` (sql/commands.py) resizes through
+        this, and existing callers keep working."""
+        return self.serve.result_cache
+
     def clear_cache(self):
         """Reference's clear-metadata-cache command + HBM residency drop."""
         self.catalog.clear()
         self.engine.clear_cache()
         self._plan_cache.clear()
-        self._result_cache.clear()
+        self.serve.result_cache.clear()
         if self._dist_engine is not None:
             self._dist_engine.clear_cache()
 
@@ -460,6 +471,65 @@ class TPUOlapContext:
                     self._execute_with_resilience(rw, lp)
                 )
 
+    def sql_progressive(self, sql_text: str):
+        """Progressive execution of one SQL statement (ROADMAP 3(b)): a
+        generator of `(df, info)` refinements converging to the exact
+        answer — the SQL-surface twin of the native route's
+        `context.progressive`.  Returns None when the statement cannot
+        stream (commands, EXPLAIN, unplannable/fallback shapes, grouping
+        sets, exact-distinct, mesh-routed) — the caller then answers
+        buffered.  Each refinement passes through the SAME host post-
+        processing (`_post_process`) the buffered path applies, so a
+        stream's final frame is exactly `ctx.sql`'s answer."""
+        from .sql.commands import parse_command
+
+        if parse_command(sql_text) is not None:
+            return None
+        key = self._plan_cache_key(sql_text)
+        cached = self._plan_cache.get(key)
+        if cached is not None:
+            rw, _lp = cached
+        else:
+            lp, explain, _ = parse_sql(sql_text, views=self.views)
+            if explain:
+                return None
+            try:
+                rw = self._planner().plan(lp)
+            except RewriteError:
+                return None  # fallback shapes answer buffered
+            self._plan_cache[key] = (rw, lp)
+        if rw.exact_distinct is not None or rw.grouping_sets:
+            return None
+        q = rw.query
+        if not isinstance(
+            q, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
+        ):
+            return None
+        if isinstance(q, Q.GroupByQuery) and q.subtotals:
+            return None
+        if self._backend_for(rw) == "mesh":
+            return None  # mesh execution has no per-batch refinement yet
+        # an open device breaker must not be bypassed just because the
+        # client asked for a stream: decline here and the buffered path
+        # (ctx.sql -> _execute_with_resilience) degrades properly
+        if not self.resilience.breaker_for(
+            self._backend_for(rw)
+        ).allow():
+            return None
+        ds = self.catalog.get(rw.datasource)
+        if ds is None:
+            return None
+        engine = self._engine_for(rw)
+
+        def refinements():
+            for df, info in engine.execute_progressive(q, ds):
+                yield self._post_process(rw, ds, df), info
+            self._last_engine_metrics = getattr(
+                engine, "last_metrics", None
+            )
+
+        return refinements()
+
     def _sync_engine_resilience(self, engine, backend: str = "device"):
         """Point an engine at this context's breaker for `backend`
         ("device" for the local engine, "mesh" for the distributed one —
@@ -504,7 +574,9 @@ class TPUOlapContext:
         if can_degrade and not br.allow():
             # an open circuit must not cost a cached answer: the result
             # cache holds exact device-quality frames that need NO device
-            hit = self._cached_result(rw)
+            # allow_delta=False: a delta refresh dispatches device work,
+            # and the breaker just said the device is sick
+            hit = self._cached_result(rw, allow_delta=False)
             if hit is not None:
                 return hit
             log.warning(
@@ -528,6 +600,15 @@ class TPUOlapContext:
             if kind == "deadline":
                 pc = current_partial()
                 if pc is not None:
+                    # partial-aware caching (ROADMAP 3(d)): before
+                    # draining a low-coverage best-effort answer, serve
+                    # a cached EXACT one if a concurrent identical query
+                    # populated the cache since this one started — a
+                    # complete cached frame beats any partial, and a
+                    # cached-exact hit is never stamped partial
+                    hit = self._cached_result(rw, allow_delta=False)
+                    if hit is not None:
+                        return hit
                     # the deadline expired OUTSIDE the partial-capable
                     # loops (planning, a blocking fetch, a ladder rung):
                     # trigger the collector and drain-rerun — every
@@ -602,6 +683,15 @@ class TPUOlapContext:
 
         pc = current_partial()
         if pc is None or not pc.is_partial:
+            return df
+        # partial-aware caching (ROADMAP 3(d)): a result served FROM the
+        # cache is an exact answer computed before the deadline existed —
+        # a triggered collector describes the aborted execution, not the
+        # cached frame, and must never stamp it down to partial
+        m_hit = self._last_engine_metrics
+        if m_hit is not None and str(
+            getattr(m_hit, "strategy", "")
+        ).startswith("result-cache"):
             return df
         info = pc.to_dict()
         with span(
@@ -888,22 +978,24 @@ class TPUOlapContext:
 
     def _result_key(self, rw: Rewrite, ds=None):
         """Result-cache key of a rewrite, or None when it isn't cacheable
-        (unknown table / exact-distinct outer shape)."""
+        (unknown table / exact-distinct outer shape).  Deliberately
+        EXCLUDES the segment uid set and the datasource version: entries
+        carry the version they were computed at (serve/result_cache.py),
+        which is what lets an append REUSE the cached historical partial
+        instead of missing outright.  Dictionary content stays in the
+        key — a dictionary extension remaps code spaces, and a stale
+        state under extended dictionaries would decode wrong groups."""
         if rw.exact_distinct is not None:
             return None
         ds = ds or self.catalog.get(rw.datasource)
         if ds is None:
             return None
-        from .exec.lowering import schema_signature
+        from .exec.lowering import _dict_signature
 
         return (
             rw.to_json(),
-            # the monotonic segment-set version (catalog/cache.py): every
-            # append and every compaction bumps it, so a cached frame can
-            # never outlive the segment set that produced it — the
-            # invalidation contract the ingestion tier publishes
-            self.catalog.datasource_version(rw.datasource),
-            schema_signature(ds),
+            ds.name,
+            _dict_signature(ds),
             repr(rw.output_columns),
             repr(rw.grouping_sets),
             repr(rw.host_post_exprs),
@@ -911,38 +1003,64 @@ class TPUOlapContext:
             repr(self.config),
         )
 
-    def _cached_result(self, rw: Rewrite, rkey=None):
-        """Serve a result-cache hit (restamping last_metrics so they
-        describe THIS query — a prior fallback would otherwise leave
-        executor="fallback" pinned on a cached device hit), or None."""
+    def _cached_result(self, rw: Rewrite, rkey=None, allow_delta=True):
+        """Serve a result-cache hit — version-exact, or delta-aware when
+        only appends separate the cached snapshot from the live one
+        (serve/result_cache.py).  The serving core restamps last_metrics
+        so they describe THIS query (a prior fallback would otherwise
+        leave executor="fallback" pinned on a cached device hit).
+        Returns None on a miss."""
         if self.config.result_cache_entries <= 0:
             return None
-        rkey = rkey or self._result_key(rw)
+        ds = self.catalog.get(rw.datasource)
+        if ds is None:
+            return None
+        rkey = rkey or self._result_key(rw, ds)
         if rkey is None:
             return None
-        hit = self._result_cache.get(rkey)
-        if hit is None:
-            return None
-        from .exec.metrics import QueryMetrics
-
-        # wire-style query_type (the vocabulary the engines stamp and the
-        # registry labels by): a cache hit for a groupBy must land on the
-        # same metric series as its executed siblings
-        try:
-            qt = rw.query.to_druid().get(
-                "queryType", type(rw.query).__name__
-            )
-        except Exception:  # fault-ok: metrics labeling must not fail a hit
-            qt = type(rw.query).__name__
-        m = QueryMetrics(
-            query_type=qt,
-            strategy="result-cache",
-            executor="device",
-            query_id=current_query_id(),
+        return self.serve.cached_result(
+            rw, ds, rkey, allow_delta=allow_delta
         )
-        self._last_engine_metrics = m
-        record_query_metrics(m, "ok")
-        return hit.copy()
+
+    def _post_process(self, rw: Rewrite, ds, df):
+        """Host-side result shaping every engine answer passes through —
+        shared by live execution, the delta-aware cache refresh, and the
+        progressive SQL surface so the three can never drift."""
+        # FD grouping pruning: decode the hidden max-over-codes carriers
+        # back into the pruned columns BEFORE residuals/projection, so
+        # downstream expressions see the restored values
+        for out_name, hidden, dim_col in rw.fd_restores:
+            raw = np.asarray(df[hidden], dtype=np.float64)
+            codes = np.where(np.isnan(raw), -1, raw).astype(np.int64)
+            df[out_name] = ds.dicts[dim_col].decode(codes)
+            df = df.drop(columns=[hidden])
+
+        # host-side residuals (the DruidStrategy projection-fixup analog)
+        for name, e in rw.host_post_exprs:
+            df[name] = _eval_host(e, df)
+        if rw.residual_having is not None:
+            mask = np.asarray(_eval_host(rw.residual_having, df), dtype=bool)
+            df = df[mask].reset_index(drop=True)
+        if rw.output_columns:
+            cols = [c for c in rw.output_columns if c in df.columns]
+            extra = [c for c in df.columns if c not in cols and c == "__grouping_id"]
+            df = df[cols + extra]
+        return df
+
+    def _fusable(self, rw: Rewrite, ds) -> bool:
+        """May this rewrite ride the micro-batch fusion / state-capture
+        path?  Single-device GroupBy-family only, no grouping sets (their
+        expansion already batches), and the engine's own gate (sparse/
+        adaptive tiers decline fusion)."""
+        if rw.grouping_sets or rw.exact_distinct is not None:
+            return False
+        if not isinstance(
+            rw.query, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
+        ):
+            return False
+        if self._backend_for(rw) != "device":
+            return False
+        return self.engine.fusable(rw.query, ds)
 
     def execute_rewrite(self, rw: Rewrite, use_result_cache: bool = True):
         import pandas as pd
@@ -963,33 +1081,43 @@ class TPUOlapContext:
                 return hit
 
         engine = self._engine_for(rw)
-        if rw.grouping_sets and isinstance(rw.query, Q.GroupByQuery):
+        state = None
+        fusable = engine is self.engine and self._fusable(rw, ds)
+        fused = (
+            self.serve.fused_execute(rw.query, ds) if fusable else None
+        )
+        if fused is not None:
+            df, state, m = fused
+            self._last_engine_metrics = m
+        elif rw.grouping_sets and isinstance(rw.query, Q.GroupByQuery):
             df = execute_grouping_sets(
                 rw.query, rw.grouping_sets, ds, engine
             )
+            self._last_engine_metrics = getattr(
+                engine, "last_metrics", None
+            )
+        elif (
+            fusable
+            and rkey is not None
+            and self.config.result_cache_delta_reuse
+        ):
+            # capture the merged host partial state alongside the normal
+            # execution: the delta-aware result cache stores it so the
+            # NEXT append refreshes this answer by scanning only the
+            # delta (serve/result_cache.py)
+            with engine.state_capture() as cap:
+                df = engine.execute(rw.query, ds)
+            state = cap["state"]
+            self._last_engine_metrics = getattr(
+                engine, "last_metrics", None
+            )
         else:
             df = engine.execute(rw.query, ds)
-        self._last_engine_metrics = getattr(engine, "last_metrics", None)
+            self._last_engine_metrics = getattr(
+                engine, "last_metrics", None
+            )
 
-        # FD grouping pruning: decode the hidden max-over-codes carriers
-        # back into the pruned columns BEFORE residuals/projection, so
-        # downstream expressions see the restored values
-        for out_name, hidden, dim_col in rw.fd_restores:
-            raw = np.asarray(df[hidden], dtype=np.float64)
-            codes = np.where(np.isnan(raw), -1, raw).astype(np.int64)
-            df[out_name] = ds.dicts[dim_col].decode(codes)
-            df = df.drop(columns=[hidden])
-
-        # host-side residuals (the DruidStrategy projection-fixup analog)
-        for name, e in rw.host_post_exprs:
-            df[name] = _eval_host(e, df)
-        if rw.residual_having is not None:
-            mask = np.asarray(_eval_host(rw.residual_having, df), dtype=bool)
-            df = df[mask].reset_index(drop=True)
-        if rw.output_columns:
-            cols = [c for c in rw.output_columns if c in df.columns]
-            extra = [c for c in df.columns if c not in cols and c == "__grouping_id"]
-            df = df[cols + extra]
+        df = self._post_process(rw, ds, df)
         if rkey is not None:
             from .resilience import current_partial
 
@@ -998,7 +1126,7 @@ class TPUOlapContext:
             # cache: it would be served back as the exact answer to the
             # next identical (undeadlined) query
             if pc is None or not pc.triggered:
-                self._result_cache[rkey] = df.copy()
+                self.serve.store_result(rw, ds, rkey, df, state=state)
         return df
 
     def _execute_exact_distinct(self, spec, use_result_cache: bool = True):

@@ -165,6 +165,34 @@ class SessionConfig:
     # + datasource schema signature, so re-ingestion can never serve stale
     # rows.  0 disables.
     result_cache_entries: int = 64
+    # delta-aware result-cache reuse (serve/result_cache.py, ISSUE 8): on
+    # a streamed append the cache serves `(cached historical partial) ⊕
+    # (fresh delta partials)` instead of invalidating outright — the
+    # refresh scans ONLY the appended segments.  Requires the cached
+    # entry's dictionaries to be unchanged (a dictionary extension remaps
+    # code spaces and is a full miss).  False restores version-exact
+    # hits only.
+    result_cache_delta_reuse: bool = True
+
+    # -- async serving core (serve/, ISSUE 8) -------------------------------
+    # micro-batch query fusion: compatible concurrent queries (same
+    # datasource + segment-set signature) queue for this many ms and
+    # execute as ONE fused device program, amortizing the per-dispatch
+    # round trip N ways.  0 disables (every query dispatches solo —
+    # the right default for single-client sessions; the server/bench
+    # enable it for concurrent dashboard traffic).
+    fusion_window_ms: float = 0.0
+    # ceiling on queries fused into one device program (compile time and
+    # demux cost grow with the batch)
+    fusion_max_batch: int = 16
+    # priority lanes (serve/lanes.py): separate admission slot pools so
+    # cheap dashboard queries (TopN/timeseries/small groupBys) are never
+    # queued behind SF100-scale scans.  A query routes to the heavy lane
+    # when its in-scope row count exceeds lane_heavy_rows (scans and
+    # groupBys); TopN/timeseries/metadata queries stay interactive.
+    lane_interactive_slots: int = 6
+    lane_heavy_slots: int = 2
+    lane_heavy_rows: int = 4 << 20
 
     # -- query-lifecycle resilience (resilience.py) -------------------------
     # wall-clock budget per query; 0 = unbounded.  The wire path's

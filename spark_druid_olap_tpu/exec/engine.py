@@ -19,6 +19,7 @@ distributed, and streaming executors and re-exported here.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -28,6 +29,9 @@ import numpy as np
 from ..catalog.segment import DataSource, Segment
 from ..models import aggregations as A
 from ..models import query as Q
+from ..ops import hll as hll_ops
+from ..ops import quantiles as quantiles_ops
+from ..ops import theta as theta_ops
 from ..ops.filters import compile_filter
 from ..ops.groupby import partial_aggregate
 
@@ -278,6 +282,46 @@ def _platform_unroll_max() -> int:
 # the accelerator (transient blips recover; deterministic failures stop
 # re-paying doomed trace+compiles).
 _SPARSE_ERROR_PIN_AFTER = 2
+
+
+def _segment_partials(lowering: "GroupByLowering", strategy: str, cols):
+    """Partial-aggregate one segment's columns under one query lowering —
+    the traced body shared by the single-query fused program and the
+    multi-query fused-batch program (serve/ micro-batch fusion): virtual
+    columns, row pipeline, dense partial aggregation, sketch partials.
+
+    This function runs DURING jit tracing: the sketch-op modules it
+    needs are imported at engine module scope (below), never here — a
+    first import inside a trace would create their module-level jnp
+    constants (theta.SENTINEL) as tracers that leak into later traces."""
+    la, G = lowering.la, lowering.num_groups
+    cols = lowering.add_virtual(dict(cols))  # sketches read virtuals
+    gid, mask, sv, mmv, mmm = lowering.row_arrays(cols)
+    s, mn, mx = partial_aggregate(
+        gid, mask, sv, mmv, mmm,
+        num_groups=G,
+        num_min=len(la.min_names),
+        num_max=len(la.max_names),
+        strategy=strategy,
+    )
+    sk = {}
+    for agg in la.sketch_aggs:
+        # per-agg FILTER mask (SQL `agg(...) FILTER (WHERE ...)`)
+        # composes with the row mask — sketches must honor it the
+        # same way sum/min/max columns do
+        mfn = la.mask_fns.get(agg.name)
+        amask = mask & mfn(cols) if mfn is not None else mask
+        if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
+            sk[agg.name] = hll_ops.partial_hll(agg, cols, gid, amask, G)
+        elif isinstance(agg, A.QuantilesSketch):
+            sk[agg.name] = quantiles_ops.partial_quantiles(
+                agg, cols, gid, amask, G
+            )
+        else:
+            sk[agg.name] = theta_ops.partial_theta(
+                agg, cols, gid, amask, G
+            )
+    return s, mn, mx, sk
 
 
 def _default_device_budget() -> int:
@@ -543,12 +587,15 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         lowering=None,
         key_extra=(),
         strategy_override=None,
+        segs=None,
     ):
         """Compute merged partial state across local segments.
 
         `key_extra` disambiguates the program cache when the SAME query runs
         over a rewritten lowering (adaptive domain compaction passes the
-        compacted cardinalities).
+        compacted cardinalities).  `segs` overrides the scanned segment
+        list (already scope-pruned) — the delta-aware result cache passes
+        just the freshly-appended segments.
 
         Returns (dims, la, G, sums[G, Ms], mins, maxs, sketch_states)."""
         if lowering is None:
@@ -558,7 +605,8 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
         sums = mins = maxs = None
         sketch_states: Dict[str, Any] = {}
-        segs = self._segments_in_scope(q, ds)
+        if segs is None:
+            segs = self._segments_in_scope(q, ds)
         pc = current_partial()
         if not segs:
             # empty time range is a valid query: zero-row result, not an
@@ -727,48 +775,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             return cached
         fire("compile")  # fault-injection site: new program build
 
-        from ..ops import hll as hll_ops
-        from ..ops import theta as theta_ops
-
-        def one_segment(cols):
-            cols = lowering.add_virtual(dict(cols))  # sketches read virtuals
-            gid, mask, sv, mmv, mmm = lowering.row_arrays(cols)
-            s, mn, mx = partial_aggregate(
-                gid, mask, sv, mmv, mmm,
-                num_groups=G,
-                num_min=len(la.min_names),
-                num_max=len(la.max_names),
-                strategy=strategy,
-            )
-            sk = {}
-            for agg in la.sketch_aggs:
-                # per-agg FILTER mask (SQL `agg(...) FILTER (WHERE ...)`)
-                # composes with the row mask — sketches must honor it the
-                # same way sum/min/max columns do
-                mfn = la.mask_fns.get(agg.name)
-                amask = mask & mfn(cols) if mfn is not None else mask
-                if isinstance(agg, (A.HyperUnique, A.CardinalityAgg)):
-                    sk[agg.name] = hll_ops.partial_hll(
-                        agg, cols, gid, amask, G
-                    )
-                elif isinstance(agg, A.QuantilesSketch):
-                    from ..ops import quantiles as quantiles_ops
-
-                    sk[agg.name] = quantiles_ops.partial_quantiles(
-                        agg, cols, gid, amask, G
-                    )
-                else:
-                    sk[agg.name] = theta_ops.partial_theta(
-                        agg, cols, gid, amask, G
-                    )
-            return s, mn, mx, sk
-
         @jax.jit
         def seg_fn(cols_list):
             sums = mins = maxs = None
             sketch_states: Dict[str, Any] = {}
             for cols in cols_list:
-                s, mn, mx, sk = one_segment(cols)
+                s, mn, mx, sk = _segment_partials(lowering, strategy, cols)
                 sums = s if sums is None else sums + s
                 mins = mn if mins is None else jnp.minimum(mins, mn)
                 maxs = mx if maxs is None else jnp.maximum(maxs, mx)
@@ -777,6 +789,343 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
         self._query_fn_cache[key] = seg_fn
         return seg_fn
+
+    # -- micro-batch fusion (serve/, ISSUE 8) --------------------------------
+
+    def _groupby_family(self, q: Q.QuerySpec, ds: DataSource):
+        """Normalize a GroupBy-family query to its inner GroupBy plus the
+        per-type result shaper — the one mapping execute_progressive,
+        execute_fused, and the state-capture paths all share."""
+        if isinstance(q, Q.TimeseriesQuery):
+            return (
+                timeseries_to_groupby(q),
+                lambda df: finalize_timeseries(df, q, ds),
+            )
+        if isinstance(q, Q.TopNQuery):
+            return topn_to_groupby(q), lambda df: finalize_topn(df, q)
+        if isinstance(q, Q.GroupByQuery):
+            return q, lambda df: df
+        return None, None
+
+    def fusable(self, q: Q.QuerySpec, ds: DataSource) -> bool:
+        """May this query join a fused micro-batch / the state-capturing
+        dense path?  GroupBy-family only (mergeable partial state), no
+        wire subtotals, and neither the sparse nor the adaptive
+        accelerator would engage (those tiers have their own dispatch
+        protocols a fused program cannot host)."""
+        inner, _ = self._groupby_family(q, ds)
+        if inner is None or inner.subtotals:
+            return False
+        try:
+            lowering = self._lowering_for(
+                groupby_with_time_granularity(inner), ds
+            )
+        except Exception:  # fault-ok: an unlowerable query declines fusion
+            return False
+        return not (
+            self._sparse_eligible(lowering)
+            or self._adaptive_eligible(lowering)
+        )
+
+    def execute_fused(self, queries, ds: DataSource, query_ids=None):
+        """Execute N compatible GroupBy-family queries as ONE fused device
+        program per segment batch: the union of the members' in-scope
+        segments moves host->device once (shared residency), every
+        member's partial aggregation runs inside the same dispatch, and
+        ONE host fetch returns all members' states — the 66 ms dispatch
+        round trip is paid once for the batch instead of once per query.
+
+        Returns a list of (df, state, metrics) per member, in order:
+        `df` is the finalized per-query result (identical to a serial
+        `execute`), `state` the merged HOST partial state (the delta-aware
+        result cache stores it), `metrics` the member's own QueryMetrics
+        (query_id stamped per member — serving-discipline GL1702)."""
+        import time as _time
+
+        from .metrics import QueryMetrics
+
+        t0 = _time.perf_counter()
+        n = len(queries)
+        query_ids = list(query_ids or [""] * n)
+        members = []
+        for q in queries:
+            inner, shape = self._groupby_family(q, ds)
+            if inner is None:
+                raise ValueError(
+                    f"{type(q).__name__} is not fusable (GroupBy-family "
+                    "queries only)"
+                )
+            inner = groupby_with_time_granularity(inner)
+            lowering = self._lowering_for(inner, ds)
+            segs = self._segments_in_scope(inner, ds)
+            members.append((q, inner, shape, lowering, segs))
+        # union of member scopes, in datasource segment order; each member
+        # aggregates ONLY its own in-scope subset inside the program
+        member_uids = [frozenset(s.uid for s in m[4]) for m in members]
+        union_segs = [
+            s
+            for s in ds.segments
+            if any(s.uid in u for u in member_uids)
+        ]
+        names = dict.fromkeys(
+            c for m in members for c in m[3].columns
+        )
+        strategies = tuple(
+            self._resolve_strategy(m[3].num_groups) for m in members
+        )
+        batch_m = QueryMetrics(query_type="fused")  # h2d/compile accumulator
+        self._m = batch_m
+        acc: List[Any] = [None] * n
+        acc_sk: List[Dict[str, Any]] = [{} for _ in range(n)]
+        try:
+            for bi, batch in enumerate(self._segment_batches(
+                union_segs, list(names)
+            )):
+                # deadline checkpoint between fused batch dispatches; an
+                # expiry here surfaces to the scheduler, which re-routes
+                # every member to its own serial (partial-capable) path
+                checkpoint("engine.fused_loop")
+                sel = tuple(
+                    tuple(
+                        j
+                        for j, seg in enumerate(batch)
+                        if seg.uid in member_uids[i]
+                    )
+                    for i in range(n)
+                )
+                with span(SPAN_H2D, batch=bi, segments=len(batch)):
+                    cols_list = [
+                        self._cols_for_segment(seg, ds, list(names))
+                        for seg in batch
+                    ]
+                fn = self._fused_program(members, ds, strategies, sel)
+                with span(
+                    SPAN_SEGMENT_DISPATCH, batch=bi, segments=len(batch),
+                    fused=n,
+                ):
+                    t_c = (
+                        _time.perf_counter()
+                        if not batch_m.program_cache_hit
+                        and batch_m.compile_ms == 0
+                        else None
+                    )
+                    outs = fn(cols_list)
+                    if t_c is not None:
+                        batch_m.compile_ms = (
+                            (_time.perf_counter() - t_c) * 1e3
+                        )
+                for i, (s, mn, mx, sk) in enumerate(outs):
+                    if s is None:
+                        continue
+                    if acc[i] is None:
+                        acc[i] = (s, mn, mx)
+                    else:
+                        ps, pmn, pmx = acc[i]
+                        acc[i] = (
+                            ps + s,
+                            jnp.minimum(pmn, mn),
+                            jnp.maximum(pmx, mx),
+                        )
+                    _merge_sketch_states(members[i][3].la, acc_sk[i], sk)
+        finally:
+            self._m = None
+        # members whose whole scope was pruned hold no accumulated state:
+        # fill with empty partials, fetched in the SAME single round trip
+        # as the live states (a per-member fetch would re-pay the device
+        # round trip the fused batch exists to amortize)
+        empties = {
+            i: empty_partials(m[3].la, m[3].num_groups)
+            for i, m in enumerate(members)
+            if acc[i] is None
+        }
+        with span(SPAN_DEVICE_FETCH, fused=n):
+            host = jax.device_get((acc, acc_sk, empties))
+        acc_h, sk_h, empties_h = host
+        out = []
+        elapsed_ms = (_time.perf_counter() - t0) * 1e3
+        # graftlint: disable=checkpoint-coverage -- demux loop: all device states are already fetched; discarding finished answers at expiry would re-pay the whole batch
+        for i, (q, inner, shape, lowering, segs) in enumerate(members):
+            la, G = lowering.la, lowering.num_groups
+            if acc_h[i] is None:
+                sums, mins, maxs, sk = empties_h[i]
+            else:
+                sums, mins, maxs = acc_h[i]
+                sk = sk_h[i]
+            state = {
+                "sums": np.asarray(sums),
+                "mins": np.asarray(mins),
+                "maxs": np.asarray(maxs),
+                "sketches": {k: np.asarray(v) for k, v in sk.items()},
+            }
+            with span(SPAN_FINALIZE, member=i):
+                df = shape(finalize_groupby(
+                    inner, lowering.dims, la,
+                    state["sums"], state["mins"], state["maxs"],
+                    state["sketches"],
+                ))
+            try:
+                qt = q.to_druid().get("queryType", type(q).__name__)
+            except Exception:  # fault-ok: metrics labeling only
+                qt = type(q).__name__
+            rows, _delta = _row_counts(segs)
+            m = QueryMetrics(
+                query_type=qt,
+                strategy=strategies[i],
+                datasource=ds.name,
+                query_id=query_ids[i],
+                rows_scanned=rows,
+                bytes_scanned=_bytes_scanned(segs, lowering.columns),
+                segments=len(segs),
+                num_groups=G,
+                # the batch's shared h2d/compile split evenly: the fused
+                # program moved ONE column set for all members
+                h2d_bytes=batch_m.h2d_bytes // n,
+                h2d_ms=batch_m.h2d_ms / n,
+                compile_ms=batch_m.compile_ms,
+                total_ms=elapsed_ms,
+                fused_batch=n,
+                program_cache_hit=batch_m.program_cache_hit,
+            )
+            record_query_metrics(m, "ok")
+            out.append((df, state, m))
+        self.last_metrics = out[-1][2] if out else None
+        return out
+
+    def _fused_program(self, members, ds, strategies, sel) -> Callable:
+        """One jitted program computing EVERY member's partial state over
+        one segment batch.  Cached in the engine's program cache under the
+        `("fused-batch", ...)` family — anchored on the first member's
+        `_query_key` plus the remaining members' query identities, the
+        resolved strategies, and the batch's member->segment selection, so
+        no other key family can spell the same tuple (jit-collision
+        GL1301)."""
+        import json as _json
+
+        key = _query_key(members[0][1], ds) + (
+            "fused-batch",
+            tuple(
+                _json.dumps(m[1].to_druid(), sort_keys=True, default=str)
+                for m in members[1:]
+            ),
+            strategies,
+            sel,
+        )
+        cached = self._query_fn_cache.get(key)
+        if cached is not None:
+            if self._m is not None:
+                self._m.program_cache_hit = True
+            return cached
+        fire("compile")  # fault-injection site: new program build
+        lowerings = [m[3] for m in members]
+
+        @jax.jit
+        def fused_fn(cols_list):
+            outs = []
+            for i, lowering in enumerate(lowerings):
+                sums = mins = maxs = None
+                sk: Dict[str, Any] = {}
+                for j in sel[i]:
+                    s, mn, mx, skj = _segment_partials(
+                        lowering, strategies[i], cols_list[j]
+                    )
+                    sums = s if sums is None else sums + s
+                    mins = mn if mins is None else jnp.minimum(mins, mn)
+                    maxs = mx if maxs is None else jnp.maximum(maxs, mx)
+                    _merge_sketch_states(lowering.la, sk, skj)
+                outs.append((sums, mins, maxs, sk))
+            return outs
+
+        self._query_fn_cache[key] = fused_fn
+        return fused_fn
+
+    # -- host partial-state surface (delta-aware result cache, ISSUE 8) -----
+
+    @contextlib.contextmanager
+    def state_capture(self):
+        """Capture the merged HOST partial state of the next execution on
+        this thread (the dense resolve path stashes it just before
+        finalize).  Yields a dict whose "state" key holds the capture —
+        None when the execution took a path with no dense state (sparse/
+        adaptive/fallback) or was deadline-truncated (a partial state
+        must never seed the delta-aware result cache)."""
+        holder = {"state": None}
+        self._m_local.capture = holder
+        try:
+            yield holder
+        finally:
+            self._m_local.capture = None
+
+    def groupby_partials_host(
+        self, q: Q.QuerySpec, ds: DataSource, within_uids=None
+    ):
+        """Merged HOST partial state of a GroupBy-family query, restricted
+        to in-scope segments whose uid is in `within_uids` (None = the
+        full scope).  The delta-aware result cache calls this with the
+        freshly-appended uids so a dashboard refresh after an append
+        scans ONLY the delta.  Returns (state, rows_scanned)."""
+        inner, _ = self._groupby_family(q, ds)
+        if inner is None:
+            raise ValueError(f"{type(q).__name__} has no partial state")
+        inner = groupby_with_time_granularity(inner)
+        lowering = self._lowering_for(inner, ds)
+        segs = self._segments_in_scope(inner, ds)
+        if within_uids is not None:
+            within_uids = frozenset(within_uids)
+            segs = [s for s in segs if s.uid in within_uids]
+        dims, la, G, sums, mins, maxs, sk = self._partials_for_query(
+            inner, ds, lowering=lowering, segs=segs
+        )
+        sums, mins, maxs, sk = jax.device_get((sums, mins, maxs, sk))
+        state = {
+            "sums": np.asarray(sums),
+            "mins": np.asarray(mins),
+            "maxs": np.asarray(maxs),
+            "sketches": {k: np.asarray(v) for k, v in sk.items()},
+        }
+        return state, sum(s.num_rows for s in segs)
+
+    def merge_groupby_states(self, q: Q.QuerySpec, ds: DataSource, a, b):
+        """⊕ of two host partial states of the SAME query over the same
+        dictionary domain (the partial-aggregate-state algebra): sums
+        add, mins/maxs fold, sketches merge by type.  Raises ValueError
+        on a shape mismatch (a dictionary change reshapes G — callers
+        treat that as a cache miss)."""
+        if a["sums"].shape != b["sums"].shape:
+            raise ValueError(
+                f"partial-state shape mismatch {a['sums'].shape} vs "
+                f"{b['sums'].shape} (dictionary domain changed)"
+            )
+        inner, _ = self._groupby_family(q, ds)
+        lowering = self._lowering_for(
+            groupby_with_time_granularity(inner), ds
+        )
+        merged = {
+            "sums": a["sums"] + b["sums"],
+            "mins": np.minimum(a["mins"], b["mins"]),
+            "maxs": np.maximum(a["maxs"], b["maxs"]),
+            "sketches": dict(a["sketches"]),
+        }
+        _merge_sketch_states(lowering.la, merged["sketches"], b["sketches"])
+        merged["sketches"] = {
+            k: np.asarray(v) for k, v in merged["sketches"].items()
+        }
+        return merged
+
+    def finalize_groupby_state(self, q: Q.QuerySpec, ds: DataSource, state):
+        """Host partial state -> the query's final result frame (the same
+        finalize the live execution path runs)."""
+        inner, shape = self._groupby_family(q, ds)
+        inner = groupby_with_time_granularity(inner)
+        lowering = self._lowering_for(inner, ds)
+        with span(SPAN_FINALIZE):
+            df = finalize_groupby(
+                inner, lowering.dims, lowering.la,
+                np.asarray(state["sums"]),
+                np.asarray(state["mins"]),
+                np.asarray(state["maxs"]),
+                {k: np.asarray(v) for k, v in state["sketches"].items()},
+            )
+        return shape(df)
 
     def _execute_groupby(self, q: Q.GroupByQuery, ds: DataSource):
         """GroupBy with idempotent re-dispatch on transient device failure
@@ -1050,6 +1399,25 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                     sums, mins, maxs, sketch_states = jax.device_get(
                         (sums, mins, maxs, sketch_states)
                     )
+                # state capture (serve/result_cache.py delta-aware reuse):
+                # stash the merged HOST state for the caller — only on
+                # this dense path (sparse/adaptive returned above) and
+                # only when the scan was NOT deadline-truncated (a
+                # partial state must never seed the cache)
+                holder = getattr(self._m_local, "capture", None)
+                pc_cap = current_partial()
+                if holder is not None and (
+                    pc_cap is None or not pc_cap.triggered
+                ):
+                    holder["state"] = {
+                        "sums": np.asarray(sums),
+                        "mins": np.asarray(mins),
+                        "maxs": np.asarray(maxs),
+                        "sketches": {
+                            k: np.asarray(v)
+                            for k, v in sketch_states.items()
+                        },
+                    }
                 # the phase-1 dispatch share (minus its h2d/compile) plus
                 # this query's own fetch wait is the device time; overlap
                 # hidden behind other queries' resolves is deliberately NOT

@@ -95,6 +95,12 @@ class QueryMetrics:
     coverage: Optional[float] = None
     rows_seen: int = 0
     delta_rows_seen: int = 0
+    # micro-batch fusion (serve/, ISSUE 8): when > 0, this query executed
+    # as one member of an N-query fused device program — its dispatch
+    # round trip was amortized N ways.  h2d/compile on a fused member are
+    # the batch totals split evenly across members (the batch moves one
+    # shared column set).
+    fused_batch: int = 0
 
     @property
     def rows_per_sec(self) -> float:

@@ -34,9 +34,11 @@ from .trace import (  # noqa: F401
     SPAN_FALLBACK,
     SPAN_FALLBACK_DECODE,
     SPAN_FINALIZE,
+    SPAN_FUSED_BATCH,
     SPAN_H2D,
     SPAN_INGEST,
     SPAN_INGEST_ENCODE,
+    SPAN_LANE,
     SPAN_LOWER,
     SPAN_NAMES,
     SPAN_PARTIAL,

@@ -79,6 +79,8 @@ SPAN_INGEST_ENCODE = "ingest_encode"  # dictionary encode of an append batch
 SPAN_COMPACT = "compact"  # delta -> historical roll of one datasource
 SPAN_PARTIAL = "partial"  # deadline-bounded best-effort answer (coverage)
 SPAN_STREAM_FLUSH = "stream_flush"  # one progressive-response refinement
+SPAN_FUSED_BATCH = "fused_batch"  # one micro-batch fused execution (serve/)
+SPAN_LANE = "lane"  # waiting for a priority-lane slot (serve/lanes.py)
 
 SPAN_NAMES = frozenset(
     {
@@ -104,6 +106,8 @@ SPAN_NAMES = frozenset(
         SPAN_COMPACT,
         SPAN_PARTIAL,
         SPAN_STREAM_FLUSH,
+        SPAN_FUSED_BATCH,
+        SPAN_LANE,
     }
 )
 

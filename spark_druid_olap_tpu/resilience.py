@@ -809,9 +809,15 @@ class AdmissionController:
 
     def __init__(self, max_concurrent: int = 8,
                  queue_timeout_ms: float = 2000.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 lane: str = ""):
         self.max_concurrent = max(1, int(max_concurrent))
         self.queue_timeout_ms = float(queue_timeout_ms)
+        # when set, this pool is one PRIORITY LANE of the serving core
+        # (serve/lanes.py): decisions also publish under the
+        # lane-labeled `sdol_lane_*` series so per-lane starvation is
+        # visible on a dashboard
+        self.lane = lane
         self._clock = clock
         self._sem = threading.BoundedSemaphore(self.max_concurrent)
         self._lock = threading.Lock()
@@ -840,6 +846,14 @@ class AdmissionController:
             "admission-pool outcomes (admitted vs 503-rejected)",
             labels=("outcome",), outcome="admitted" if ok else "rejected",
         )
+        if self.lane:
+            _count(
+                "sdol_lane_decisions_total",
+                "per-lane admission outcomes (serve/lanes.py)",
+                labels=("lane", "outcome"),
+                lane=self.lane,
+                outcome="admitted" if ok else "rejected",
+            )
         return ok
 
     def release(self) -> None:
@@ -946,6 +960,28 @@ class ResilienceState:
                 config, "ingest_queue_timeout_ms", 2000.0
             ),
         )
+        # priority lanes (serve/lanes.py, ISSUE 8): separate slot pools
+        # so cheap dashboard queries are never queued behind SF100-scale
+        # scans — the server classifies each query and gates it on its
+        # lane's pool, with per-lane Retry-After and sdol_lane_* metrics
+        self.lanes: Dict[str, AdmissionController] = {
+            "interactive": AdmissionController(
+                max_concurrent=getattr(
+                    config, "lane_interactive_slots", 6
+                ),
+                queue_timeout_ms=getattr(
+                    config, "admission_queue_timeout_ms", 2000.0
+                ),
+                lane="interactive",
+            ),
+            "heavy": AdmissionController(
+                max_concurrent=getattr(config, "lane_heavy_slots", 2),
+                queue_timeout_ms=getattr(
+                    config, "admission_queue_timeout_ms", 2000.0
+                ),
+                lane="heavy",
+            ),
+        }
         self._lock = threading.Lock()
         self.degraded_total = 0
         self.deadline_exceeded_total = 0
@@ -976,6 +1012,25 @@ class ResilienceState:
             state_gauge.labels(backend=b).set_function(
                 lambda cb=cb: BREAKER_STATE_CODES.get(cb.state, -1)
             )
+        # live per-lane gauges: callback-read at scrape time so the hot
+        # acquire/release path pays nothing extra
+        lane_depth = reg.gauge(
+            "sdol_lane_queue_depth",
+            "callers blocked waiting for a lane slot, by lane",
+            labels=("lane",),
+        )
+        lane_in_use = reg.gauge(
+            "sdol_lane_slots_in_use",
+            "lane slots currently held by executing queries, by lane",
+            labels=("lane",),
+        )
+        for name, pool in self.lanes.items():
+            lane_depth.labels(lane=name).set_function(
+                lambda p=pool: p.queue_depth
+            )
+            lane_in_use.labels(lane=name).set_function(
+                lambda p=pool: p.in_use
+            )
 
     @property
     def breaker(self) -> CircuitBreaker:
@@ -985,6 +1040,11 @@ class ResilienceState:
 
     def breaker_for(self, backend: str) -> CircuitBreaker:
         return self.breakers.get(backend, self.breakers["device"])
+
+    def lane(self, name: str) -> AdmissionController:
+        """The slot pool of one priority lane; unknown names gate on the
+        interactive lane (never a KeyError on the serving path)."""
+        return self.lanes.get(name, self.lanes["interactive"])
 
     def note_degraded(self) -> None:
         with self._lock:
@@ -1033,6 +1093,12 @@ class ResilienceState:
             },
             "admission": self.admission.to_dict(),
             "ingest_admission": self.ingest_admission.to_dict(),
+            # per-lane pools (serve/lanes.py): a load balancer reads
+            # which lane is saturated, not just "the server is busy"
+            "lanes": {
+                name: pool.to_dict()
+                for name, pool in self.lanes.items()
+            },
             "counters": counters,
             "faults": injector().state(),
         }

@@ -1,0 +1,30 @@
+"""Async serving core (ISSUE 8): the layer between the HTTP server /
+api surface and the execution engines, built for thousands of small
+concurrent dashboard queries over a few hot datasources.
+
+Three cooperating pieces:
+
+  * `serve.fusion` — micro-batch query fusion: compatible concurrent
+    queries (same datasource + segment-set signature) queue for a
+    configurable few-ms window and execute as ONE fused device program
+    (`Engine.execute_fused`), amortizing the per-dispatch round trip N
+    ways; results demultiplex per query with individually-stamped
+    QueryMetrics and a `fused_batch` span linking member query ids.
+  * `serve.lanes` — priority lanes on admission: cheap TopN/timeseries
+    dashboard queries take an interactive slot pool an SF100-scale scan
+    cannot starve; each lane has its own depth, Retry-After, and
+    `sdol_lane_*` metrics (the pools live on `ResilienceState.lanes`).
+  * `serve.result_cache` — a result cache keyed on the monotonic
+    per-datasource version (catalog/cache.py), upgraded to DELTA-AWARE
+    reuse: on a streamed append the cache serves `(cached historical
+    partial) ⊕ (fresh delta partials)` instead of invalidating, so
+    identical dashboard refreshes never reach the device and appends
+    only cost the delta.
+
+`ServingCore` (serve/core.py) owns all three for one TPUOlapContext.
+"""
+
+from .core import ServingCore  # noqa: F401
+from .fusion import FusionScheduler  # noqa: F401
+from .lanes import LANE_HEAVY, LANE_INTERACTIVE, classify_native  # noqa: F401
+from .result_cache import ResultCache  # noqa: F401

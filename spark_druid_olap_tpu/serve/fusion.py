@@ -1,0 +1,256 @@
+"""Micro-batch query fusion: amortize the device dispatch across
+concurrent compatible queries.
+
+The re-anchor numbers frame the problem: SF1 TPU p50 is 102 ms against
+a 66 ms device round trip — the dispatch floor IS the latency budget,
+and at dashboard scale the workload is many small concurrent queries
+over the same hot datasource.  Computation-pushdown economics
+(arXiv:2312.15405) say to amortize the boundary across queries:
+
+  * The FIRST query to arrive for a (datasource, segment-set signature)
+    becomes the batch LEADER: it holds the batch open for
+    `SessionConfig.fusion_window_ms`, collecting compatible queries
+    (GroupBy-family, same signature) up to `fusion_max_batch`.
+  * The leader executes the whole batch as ONE fused device program
+    (`Engine.execute_fused`): the union of the members' in-scope
+    segments moves host->device once, every member's partial aggregation
+    runs inside the same dispatch, one fetch returns all states.
+  * Results demultiplex per member: each waiter receives its own
+    finalized frame, host partial state (the delta-aware result cache
+    stores it), and QueryMetrics stamped with ITS query_id and the batch
+    size (`fused_batch`) — serving-discipline GL1702.
+
+Compatibility is the segment-set signature (`lowering.schema_signature`:
+name + dictionary content + segment uids).  An append between enqueue
+and dispatch bumps the signature; the leader detects the mismatch at
+dispatch time and INVALIDATES the batch — every member re-executes
+individually on its own thread, against the current snapshot and under
+its own deadline/partial scopes (fused execution cannot honor N
+different deadline budgets, so an invalidated batch must not be run by
+the leader on the members' behalf).
+
+A batch of one (no concurrency materialized inside the window) is also
+re-routed to the member's serial path: the fused program brings only
+demux overhead when there is nothing to amortize.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import SPAN_FUSED_BATCH, current_query_id, span, span_event
+from ..utils.log import get_logger
+
+log = get_logger("serve.fusion")
+
+# a member blocked on its batch leader must never hang the request
+# thread forever if the leader dies mid-delivery; past this it falls
+# back to its own serial execution
+_MEMBER_WAIT_S = 300.0
+
+# delivery verdicts
+_OK = "ok"
+_RETRY = "retry"  # re-execute individually on the member's own thread
+
+
+class _Member:
+    __slots__ = ("query", "query_id", "event", "verdict", "payload")
+
+    def __init__(self, query, query_id: str):
+        self.query = query
+        self.query_id = query_id
+        self.event = threading.Event()
+        self.verdict: Optional[str] = None
+        self.payload = None
+
+    def deliver(self, verdict: str, payload=None) -> None:
+        self.verdict = verdict
+        self.payload = payload
+        self.event.set()
+
+
+class _Batch:
+    __slots__ = ("batch_id", "signature", "members", "closed")
+
+    def __init__(self, batch_id: int, signature):
+        self.batch_id = batch_id
+        self.signature = signature
+        self.members: List[_Member] = []
+        self.closed = False
+
+
+class FusionScheduler:
+    """Leader-based micro-batcher over one context's local engine.
+
+    `execute` returns `(df, state, metrics)` when the query ran fused,
+    or None when the caller must execute it on the normal serial path
+    (fusion disabled, batch of one, batch invalidated by a concurrent
+    append, or the fused dispatch failed)."""
+
+    def __init__(self, window_ms: float = 0.0, max_batch: int = 16):
+        self.window_ms = float(window_ms)
+        self.max_batch = max(2, int(max_batch))
+        self._lock = threading.Lock()
+        self._open: Dict[Tuple, _Batch] = {}
+        self._ids = itertools.count(1)
+        # observability: fused batches executed / member outcomes
+        self.batches_fused = 0
+        self.members_fused = 0
+        self.invalidated = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.window_ms > 0
+
+    def execute(self, ctx, q, ds):
+        """Join (or lead) the micro-batch for `q` over the `ds`
+        snapshot.  Returns (df, state, metrics) or None (serial path)."""
+        if not self.enabled:
+            return None
+        from ..exec.lowering import schema_signature
+
+        sig = (ds.name, schema_signature(ds))
+        me = _Member(q, current_query_id())
+        with self._lock:
+            batch = self._open.get(sig)
+            if (
+                batch is None
+                or batch.closed
+                or len(batch.members) >= self.max_batch
+            ):
+                batch = _Batch(next(self._ids), sig)
+                self._open[sig] = batch
+                leader = True
+            else:
+                leader = False
+            batch.members.append(me)
+        if leader:
+            self._lead(ctx, batch, ds)
+        else:
+            if not me.event.wait(_MEMBER_WAIT_S):
+                log.warning(
+                    "fused-batch member timed out waiting for its "
+                    "leader; executing serially"
+                )
+                return None
+        if me.verdict != _OK:
+            return None
+        df, state, m = me.payload
+        if not leader:
+            # a NON-leader member's trace records that this query rode a
+            # fused batch (the leader's trace already holds the real
+            # fused_batch span around the execution — a second marker
+            # there would double-count batches per trace); the batch id
+            # + member query ids link the two traces
+            with span(
+                SPAN_FUSED_BATCH,
+                batch=batch.batch_id,
+                members=len(batch.members),
+            ):
+                span_event(
+                    "fused_members",
+                    query_ids=",".join(
+                        x.query_id for x in batch.members
+                    ),
+                )
+        return df, state, m
+
+    def _lead(self, ctx, batch: _Batch, ds) -> None:
+        """Leader protocol: hold the window open, close the batch, and
+        either execute it fused or invalidate it (every member then
+        re-executes individually on its own thread)."""
+        from ..exec.lowering import schema_signature
+
+        time.sleep(self.window_ms / 1e3)
+        with self._lock:
+            batch.closed = True
+            if self._open.get(batch.signature) is batch:
+                del self._open[batch.signature]
+            members = list(batch.members)
+        # canonical member order: thread arrival order varies per wave,
+        # and the fused program cache keys on the member sequence — an
+        # order-sensitive key would recompile the SAME dashboard set on
+        # every permutation (members are independent, so order is free)
+        import json as _json
+
+        members.sort(
+            key=lambda m: _json.dumps(
+                m.query.to_druid(), sort_keys=True, default=str
+            )
+        )
+        try:
+            if len(members) == 1:
+                # nothing joined: the fused program would only add demux
+                # overhead — run the member's normal serial path
+                members[0].deliver(_RETRY)
+                return
+            current = ctx.catalog.get(ds.name)
+            if current is None or (
+                (ds.name, schema_signature(current)) != batch.signature
+            ):
+                # an append/compaction published a new segment set
+                # between enqueue and dispatch: the batch's snapshot is
+                # stale — split it, each member re-executes against the
+                # CURRENT snapshot under its own scopes
+                with self._lock:
+                    self.invalidated += 1
+                log.info(
+                    "fused batch %d invalidated by a segment-set version "
+                    "bump on %r; %d members re-execute individually",
+                    batch.batch_id, ds.name, len(members),
+                )
+                for m in members:
+                    m.deliver(_RETRY)
+                return
+            with span(
+                SPAN_FUSED_BATCH,
+                batch=batch.batch_id,
+                members=len(members),
+            ):
+                span_event(
+                    "fused_members",
+                    query_ids=",".join(m.query_id for m in members),
+                )
+                results = ctx.engine.execute_fused(
+                    [m.query for m in members],
+                    current,
+                    query_ids=[m.query_id for m in members],
+                )
+            with self._lock:
+                self.batches_fused += 1
+                self.members_fused += len(members)
+            for m, payload in zip(members, results):
+                m.deliver(_OK, payload)
+        except Exception as err:
+            # ANY fused-path failure (transient device fault, deadline,
+            # compile error) re-routes every member to its own serial
+            # execution — the serial path owns retries, breaker
+            # accounting, and partial-result semantics per query, which
+            # a shared fused dispatch cannot honor per member
+            log.warning(
+                "fused batch %d failed (%s: %s); %d members re-execute "
+                "individually",
+                batch.batch_id, type(err).__name__, err, len(members),
+            )
+            for m in members:
+                if not m.event.is_set():
+                    m.deliver(_RETRY)
+        finally:
+            # defensive: no member may ever be left waiting
+            for m in members:
+                if not m.event.is_set():
+                    m.deliver(_RETRY)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "window_ms": self.window_ms,
+                "max_batch": self.max_batch,
+                "batches_fused": self.batches_fused,
+                "members_fused": self.members_fused,
+                "invalidated": self.invalidated,
+            }

@@ -45,6 +45,7 @@ from .models.filters import _ms_to_iso
 from .models.wire import WireError, query_from_druid
 from .obs import (
     SPAN_ADMISSION,
+    SPAN_LANE,
     default_tracer,
     get_registry,
     new_query_id,
@@ -362,6 +363,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "datasources": sorted(self.ctx.catalog.tables()),
                     "last_query_metrics": m.to_dict() if m else None,
                     "resilience": res.health() if res else None,
+                    # serving core (serve/): fusion + result-cache stats
+                    "serving": (
+                        self.ctx.serve.to_dict()
+                        if getattr(self.ctx, "serve", None) is not None
+                        else None
+                    ),
                     # registry summary: counter/gauge values + histogram
                     # p50/p95/p99 (full series live at /status/metrics)
                     "metrics": get_registry().to_dict(),
@@ -444,18 +451,11 @@ class _Handler(BaseHTTPRequestHandler):
                     pass
 
     def _handle_query(self, path, body, qctx, res, cfg):
-        # admission control: a bounded slot pool with a queue-wait timeout
-        # answers 503 + Retry-After instead of piling handler threads
-        # behind a slow device until the process wedges
-        with span(SPAN_ADMISSION):
-            admitted = res is None or res.admission.acquire()
-        if not admitted:
-            return self._error(
-                503,
-                "query capacity exceeded; retry later",
-                "QueryCapacityExceededException",
-                headers={"Retry-After": res.admission.retry_after_s()},
-            )
+        # admission is per-route and LANE-FIRST (serve/lanes.py): the
+        # query takes its priority lane's slot before the global pool,
+        # so a heavy query queued on a full heavy lane never sits on a
+        # global slot while waiting — that ordering is what keeps the
+        # interactive lane's capacity reachable under a heavy storm
         try:
             # Druid-native per-query deadline: `context.timeout` (ms)
             # overrides the session default — including `timeout: 0`,
@@ -486,7 +486,7 @@ class _Handler(BaseHTTPRequestHandler):
             with deadline_scope(timeout_ms), partial_scope(p_enabled):
                 if path == "/druid/v2":
                     return self._native_query(body, qctx)
-                return self._sql_query(body)
+                return self._sql_query(body, qctx)
         except WireError as e:
             return self._error(400, str(e), "BadQueryException")
         except KeyError as e:
@@ -533,9 +533,23 @@ class _Handler(BaseHTTPRequestHandler):
                 "query execution failed; see server logs",
                 type(e).__name__,
             )
-        finally:
-            if res is not None:
-                res.admission.release()
+
+    def _admit(self, res) -> bool:
+        """The GLOBAL admission pool — acquired AFTER the lane slot (a
+        query waiting out a full lane must not hold global capacity
+        while it waits).  A bounded slot pool with a queue-wait timeout
+        answers 503 + Retry-After instead of piling handler threads
+        behind a slow device until the process wedges."""
+        with span(SPAN_ADMISSION):
+            admitted = res is None or res.admission.acquire()
+        if not admitted:
+            self._error(
+                503,
+                "query capacity exceeded; retry later",
+                "QueryCapacityExceededException",
+                headers={"Retry-After": res.admission.retry_after_s()},
+            )
+        return admitted
 
     def _ingest(self, name: str, body: dict):
         """POST /druid/v2/ingest/{datasource}: streamed row append (the
@@ -634,6 +648,31 @@ class _Handler(BaseHTTPRequestHandler):
         Q.SegmentMetadataQuery,
     )
 
+    def _acquire_lane(self, lane_name: str):
+        """Gate one query on its priority lane's slot pool (serve/lanes):
+        returns True when admitted, or sends the 503 (naming the lane,
+        with the lane's OWN observed-load Retry-After) and returns False.
+        A context without resilience state admits everything."""
+        res = self._resilience()
+        if res is None or not getattr(res, "lanes", None):
+            return True
+        pool = res.lane(lane_name)
+        with span(SPAN_LANE, lane=lane_name):
+            admitted = pool.acquire()
+        if not admitted:
+            self._error(
+                503,
+                f"{lane_name} lane capacity exceeded; retry later",
+                "QueryCapacityExceededException",
+                headers={"Retry-After": pool.retry_after_s()},
+            )
+        return admitted
+
+    def _release_lane(self, lane_name: Optional[str]):
+        res = self._resilience()
+        if lane_name and res is not None and getattr(res, "lanes", None):
+            res.lane(lane_name).release()
+
     def _native_query(self, body: dict, qctx: dict):
         res = self._resilience()
         try:
@@ -646,12 +685,45 @@ class _Handler(BaseHTTPRequestHandler):
         ds = self.ctx.catalog.get(q.datasource)
         if ds is None:
             return self._error(400, f"unknown dataSource {q.datasource!r}")
+        # priority lanes (serve/lanes.py): a cheap dashboard query takes
+        # an interactive slot an SF100-scale scan cannot starve; heavy
+        # work gates on its own small pool with a per-lane Retry-After
+        from .serve.lanes import classify_native
+
+        lane_name = classify_native(
+            q, ds, getattr(self.ctx, "config", None)
+        )
+        if not self._acquire_lane(lane_name):
+            return None
+        try:
+            if not self._admit(res):
+                return None
+            try:
+                return self._native_query_admitted(q, ds, body, qctx, res)
+            finally:
+                if res is not None:
+                    res.admission.release()
+        finally:
+            self._release_lane(lane_name)
+
+    def _native_query_admitted(self, q, ds, body: dict, qctx: dict, res):
         needs_device = not isinstance(q, self._METADATA_QUERIES)
+        serve = getattr(self.ctx, "serve", None)
         if (
             needs_device
             and res is not None
             and not res.breaker_for("device").allow()
         ):
+            # an open circuit must not cost a cached answer (same stance
+            # as the SQL path): exact hits need no device — but a delta
+            # refresh WOULD dispatch, so allow_delta=False
+            if serve is not None:
+                hit = serve.cached_native(q, ds, allow_delta=False)
+                if hit is not None:
+                    return self._send(
+                        200, druid_result_shape(q, hit),
+                        headers=self._partial_headers(),
+                    )
             # the device breaker is open: degrade the wire query through
             # the native->logical fallback interpreter instead of the old
             # blanket 503 (the completed degradation-matrix cell); shapes
@@ -679,7 +751,49 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 # internal bitmask column; real Druid events don't carry it
                 return df.drop(columns=["__grouping_id"])
-            return self.ctx.engine.execute(q, ds)
+            # the serving core's native path (serve/): result cache
+            # (exact hit = zero device dispatch; delta-aware after an
+            # append) -> micro-batch fusion -> serial state-capturing
+            # execution, with the computed answer published back
+            if serve is None:
+                return self.ctx.engine.execute(q, ds)
+            # ONE key computation per request (it JSON-serializes the
+            # spec), shared by lookup and store
+            rkey = serve.native_key(q, ds)
+            hit = serve.cached_native(q, ds, key=rkey)
+            if hit is not None:
+                return hit
+            fusable = self.ctx.engine.fusable(q, ds)
+            if fusable:
+                fused = serve.fused_execute(q, ds)
+                if fused is not None:
+                    df, state, m = fused
+                    self.ctx._last_engine_metrics = m
+                    serve.store_native(q, ds, df, state=state, key=rkey)
+                    return df
+            if fusable and rkey is not None:
+                # capture the merged host state alongside the serial
+                # execution so the next append refreshes this entry by
+                # scanning only the delta
+                with self.ctx.engine.state_capture() as cap:
+                    df = self.ctx.engine.execute(q, ds)
+                # stamp the context's most-recent metrics: an earlier
+                # cache hit left its own object pinned there, and
+                # ctx.last_metrics prefers it over the engine's — a
+                # stale "result-cache" would misattribute THIS execution
+                self.ctx._last_engine_metrics = (
+                    self.ctx.engine.last_metrics
+                )
+                serve.store_native(q, ds, df, state=cap["state"], key=rkey)
+                return df
+            df = self.ctx.engine.execute(q, ds)
+            self.ctx._last_engine_metrics = self.ctx.engine.last_metrics
+            if rkey is not None:
+                # non-fusable GroupBy-family shapes (sparse/adaptive
+                # tiers hold no dense state) still cache frame-only:
+                # identical refreshes hit version-exact, appends miss
+                serve.store_native(q, ds, df, key=rkey)
+            return df
 
         try:
             self.ctx._sync_engine_resilience(self.ctx.engine)
@@ -755,10 +869,18 @@ class _Handler(BaseHTTPRequestHandler):
         normal structured error responses; mid-stream failures emit a
         terminal {"error": ...} line (the status is already on the
         wire)."""
-        from .obs import SPAN_STREAM_FLUSH, span
-
         self.ctx._sync_engine_resilience(self.ctx.engine)
         gen = self.ctx.engine.execute_progressive(q, ds)
+        return self._stream_refinements(gen, lambda df: druid_result_shape(q, df))
+
+    def _stream_refinements(self, gen, shape):
+        """Drive one refinement generator onto the wire as chunked
+        NDJSON — shared by the native route and the SQL route (ROADMAP
+        3(b)) so the line protocol, error handling, and the deferred
+        terminal chunk cannot drift between surfaces.  `shape` renders a
+        refinement frame into the route's result payload."""
+        from .obs import SPAN_STREAM_FLUSH, span
+
         item = next(gen)  # may raise -> structured error path
         self._begin_response(200, "application/x-ndjson")
         try:
@@ -771,7 +893,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "final": bool(info["final"]),
                     "rows_seen": info.get("rows_seen"),
                     "rows_total": info.get("rows_total"),
-                    "result": druid_result_shape(q, df),
+                    "result": shape(df),
                 }
                 with span(SPAN_STREAM_FLUSH, sequence=info["sequence"]):
                     self._write_chunk(
@@ -817,12 +939,48 @@ class _Handler(BaseHTTPRequestHandler):
             # the ring before the client can ask /druid/v2/trace for it
             self._pending_chunked_finish = 200
 
-    def _sql_query(self, body: dict):
+    def _sql_query(self, body: dict, qctx: dict):
         sql = body.get("query")
         if not sql:
             return self._error(400, 'body must be {"query": "SELECT ..."}')
-        df = self.ctx.sql(sql)
-        self._send(200, _rows(df), headers=self._partial_headers())
+        # priority lanes: SQL classifies from its planned rewrite (via
+        # the plan cache, so repeated dashboard statements pay planning
+        # once); anything unplannable gates interactive
+        serve = getattr(self.ctx, "serve", None)
+        lane_name = serve.lane_for_sql(sql) if serve is not None else None
+        if lane_name is not None and not self._acquire_lane(lane_name):
+            return None
+        res = self._resilience()
+        try:
+            if not self._admit(res):
+                return None
+            try:
+                if qctx.get("progressive"):
+                    # progressive SQL surface (ROADMAP 3(b)): chunked
+                    # NDJSON refinements converging to the exact answer,
+                    # same line protocol as the native route; shapes that
+                    # cannot stream fall through to the buffered response
+                    gen = self.ctx.sql_progressive(sql)
+                    if gen is not None:
+                        return self._stream_refinements(gen, _rows)
+                df = self.ctx.sql(sql)
+                self._send(
+                    200, _rows(df), headers=self._partial_headers()
+                )
+            finally:
+                if res is not None:
+                    res.admission.release()
+        finally:
+            self._release_lane(lane_name)
+
+
+class _OlapHTTPServer(ThreadingHTTPServer):
+    # the stdlib listen backlog is 5: a burst of concurrent dashboard
+    # connections (the workload the serving core exists for) overflows
+    # it, the kernel drops the SYN, and the client retries after ~1 s —
+    # a full second of invisible latency the handler never sees.  128
+    # accommodates hammer-scale connection bursts.
+    request_queue_size = 128
 
 
 class OlapServer:
@@ -835,7 +993,7 @@ class OlapServer:
 
     def __init__(self, ctx, host: str = "127.0.0.1", port: int = 8082):
         handler = type("BoundHandler", (_Handler,), {"ctx": ctx})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = _OlapHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
     @property

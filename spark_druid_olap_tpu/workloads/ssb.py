@@ -322,9 +322,6 @@ def fact_chunks(scale: float, seed: int, chunk_rows: int, tables):
 _FACT_STREAM = 90_001  # spawn-key tag separating fact chunks from dim draws
 
 
-_PAR_STATE: dict = {}
-
-
 def _sorted_flat_chunk(ci, scale, seed, chunk_rows, tables, ad):
     """Chunk ci: generate -> flat-encode -> time-sort.  The one body both
     the serial and the parallel ingest paths run."""
@@ -342,138 +339,42 @@ def _sorted_flat_chunk(ci, scale, seed, chunk_rows, tables, ad):
     return {k: np.asarray(v)[order] for k, v in c.items()}
 
 
-def _parallel_chunk_worker(args):
-    """One chunk in a worker process.  Chunk streams are independent
-    deterministic rngs (gen_fact_chunk), so any chunk can be produced
-    anywhere; the fork start-method shares `tables`/attr dicts
-    copy-on-write via _PAR_STATE (workers are numpy-only — they never
-    touch jax)."""
-    ci, scale, seed, chunk_rows = args
-    return _sorted_flat_chunk(
-        ci, scale, seed, chunk_rows, _PAR_STATE["tables"], _PAR_STATE["ad"]
-    )
-
-
-def _parallel_sorted_chunks(tables, ad, scale, seed, chunk_rows, workers):
-    """Ordered iterator of time-sorted flat chunks produced by a fork pool.
-
-    In-flight results are semaphore-bounded: multiprocessing's imap buffers
-    every finished result regardless of consumer pace, which would rebuild
-    the full flat fact in host RAM exactly when the consumer (segment
-    encode) is the slow side — the opposite of the one-chunk-peak-memory
-    contract this path exists for."""
-    import multiprocessing as mp
-
-    n_chunks = n_fact_chunks(scale, chunk_rows)
-    _PAR_STATE["tables"] = tables
-    _PAR_STATE["ad"] = ad
-    ctx = mp.get_context("fork")
-    max_inflight = workers + 2
-    with ctx.Pool(processes=workers) as pool:
-        try:
-            pending = []
-            ci = 0
-            while ci < n_chunks or pending:
-                while ci < n_chunks and len(pending) < max_inflight:
-                    pending.append(
-                        pool.apply_async(
-                            _parallel_chunk_worker,
-                            ((ci, scale, seed, chunk_rows),),
-                        )
-                    )
-                    ci += 1
-                yield pending.pop(0).get()
-        finally:
-            _PAR_STATE.clear()
-
-
-def _jax_backend_live() -> bool:
-    """True when an XLA backend has already initialized in this process —
-    the state in which forking is the documented deadlock hazard.  Checked
-    WITHOUT initializing a backend (that would defeat the point)."""
-    import sys
-
-    if "jax" not in sys.modules:
-        return False
-    try:
-        from jax._src import xla_bridge
-
-        return bool(xla_bridge._backends)
-    except Exception:
-        return True  # unknown internals: assume live (the safe side)
-
-
-def ingest_workers() -> int:
-    """Worker count for parallel ingest — OPT-IN via SD_INGEST_WORKERS.
-
-    Serial by default: the pool uses the fork start method (spawn would
-    hang re-importing jax through a wedged accelerator tunnel), and
-    forking a process whose JAX runtime threads are already live is a
-    documented deadlock hazard.  Even with SD_INGEST_WORKERS>0, a live
-    backend downgrades to serial with a warning (ADVICE r4: bench's
-    calibrated-context load touches the backend before ingest, so the
-    'runs before initialization' assumption cannot be trusted here)."""
-    import os
-
-    env = os.environ.get("SD_INGEST_WORKERS")
-    n = max(0, int(env)) if env is not None else 0
-    if n > 0 and _jax_backend_live():
-        from ..utils.log import get_logger
-
-        get_logger("workloads.ssb").warning(
-            "SD_INGEST_WORKERS=%d requested but the JAX backend is already "
-            "initialized in this process; forking now risks deadlock — "
-            "falling back to serial ingest", n,
-        )
-        return 0
-    return n
-
-
 def register_streamed(ctx, scale: float, seed: int = 7,
                       rows_per_segment: int = 1 << 19,
                       chunk_rows: int = 1 << 22,
                       workers: int | None = None):
     """Register the SSB star at a LARGE scale factor: the fact is
-    generated, encoded, and segmented chunk-by-chunk
-    (catalog.segment.build_datasource_streamed), never materialized whole.
-    Chunks are date-sliced (fact_chunks) and time-sorted before
-    segmenting, so a segment spans roughly 1/(8 x n_chunks) of the date
-    range — date-derived predicates prune via zone maps across the whole
-    stream.  `workers` > 0 produces chunks on a fork pool (independent
-    deterministic chunk streams make this order-preserving and exact);
-    the default is SERIAL unless SD_INGEST_WORKERS opts in — see
-    ingest_workers() for the fork-safety contract.  Returns the dimension
-    tables (for oracle use)."""
-    from ..catalog.segment import build_datasource_streamed
+    generated, encoded, and segmented chunk-by-chunk through the SHARDED
+    ingest pipeline (`ingest.shard.build_datasource_sharded`, ISSUE 8
+    follow-up 2(a)) — never materialized whole.  Chunks are date-sliced
+    (fact_chunks) and time-sorted before segmenting, so a segment spans
+    roughly 1/(8 x n_chunks) of the date range — date-derived predicates
+    prune via zone maps across the whole stream.
 
-    if workers is None:
-        workers = ingest_workers()
-    elif workers > 0 and _jax_backend_live():
-        from ..utils.log import get_logger
+    Workers are THREADS (the sharded pipeline's contract): the old fork
+    pool — and its fork-vs-live-JAX deadlock hazard plus the
+    SD_INGEST_WORKERS opt-in gate — is retired.  `workers=None` resolves
+    via `ingest.shard.sharded_ingest_workers` (SD_INGEST_WORKERS env >
+    cpu count); `workers=0` forces the single-threaded inline pipeline.
+    Output segments are row/code/stats-identical to the retired streamed
+    path (per-shard encode through the same `build_datasource`, ordered
+    reassembly).  Returns the dimension tables (for oracle use)."""
+    from ..ingest.shard import build_datasource_sharded
 
-        get_logger("workloads.ssb").warning(
-            "register_streamed(workers=%d) with a live JAX backend; "
-            "forking now risks deadlock — running serial", workers,
-        )
-        workers = 0
     tables = gen_dim_tables(scale, np.random.default_rng(seed))
     ad = _attr_dicts(tables)
     dicts = {attr: d for attr, (d, _) in ad.items()}
 
-    if workers > 0:
-        chunks = _parallel_sorted_chunks(
-            tables, ad, scale, seed, chunk_rows, workers
-        )
-    else:
-        chunks = (
-            _sorted_flat_chunk(ci, scale, seed, chunk_rows, tables, ad)
-            for ci in range(n_fact_chunks(scale, chunk_rows))
-        )
-    ds = build_datasource_streamed(
+    chunks = (
+        _sorted_flat_chunk(ci, scale, seed, chunk_rows, tables, ad)
+        for ci in range(n_fact_chunks(scale, chunk_rows))
+    )
+    ds = build_datasource_sharded(
         "lineorder", chunks,
         dimension_cols=FLAT_DIMS, metric_cols=FLAT_METRICS,
         time_col="lo_orderdate",
         rows_per_segment=rows_per_segment, dicts=dicts,
+        workers=1 if workers == 0 else workers,
     )
     ctx.register_datasource(ds, star_schema=STAR_SCHEMA)
     ctx.register_table("dwdate", tables["dwdate"], time_column="d_datekey")

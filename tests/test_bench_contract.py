@@ -117,6 +117,10 @@ def test_production_tag_keys_scale(monkeypatch):
     mode, fn, arg = bench._parse_args(["deadline", "1"])
     assert "%s_%g" % (mode, arg) == "deadline_1"
     assert fn is bench.bench_deadline
+    # serving-core hammer (ISSUE 8): SSB scale-factor float arg
+    mode, fn, arg = bench._parse_args(["hammer", "0.1"])
+    assert "%s_%g" % (mode, arg) == "hammer_0.1"
+    assert fn is bench.bench_hammer
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -212,6 +216,56 @@ def test_emit_deadline_result_shape(capsys, tmp_path, monkeypatch):
     detail = json.load(open(tmp_path / "BENCH_deadline_1_detail.json"))
     assert detail["detail"]["curves"]["q1_1"][0]["partial"] is True
     assert detail["detail"]["oracle_equal_all"] is True
+
+
+def test_emit_hammer_result_shape(capsys, tmp_path, monkeypatch):
+    """The serving-core hammer's fat sections (per-lane percentiles,
+    the cache-hit span tree, scheduler stats) live in the detail
+    sidecar; stdout stays one compact driver-parseable line."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    hit_tree = {"name": "query", "children": [
+        {"name": "plan"}, {"name": "execute"}
+    ] * 40}
+    bench._emit(
+        {
+            "metric": "hammer_fast_lane_p95_under_heavy_storm_ms",
+            "value": 42.5,
+            "unit": "ms",
+            "vs_baseline": 9.3,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 600_000,
+                "fusion": {
+                    "serial_dispatches_wall_ms": 404.4,
+                    "fused_batch_wall_ms": 391.0,
+                    "fused_speedup": 1.03,
+                },
+                "result_cache": {
+                    "hit_zero_device_dispatch": True,
+                    "hit_span_names": ["query", "plan", "execute"],
+                    "delta_refresh_rows_scanned": 3,
+                    "hit_span_tree": hit_tree,
+                },
+                "lanes": {
+                    "fast_with_heavy_storm_lanes_on": {"p95_ms": 42.5},
+                    "fast_with_heavy_storm_lanes_off": {"p95_ms": 395.0},
+                },
+                "mixed_hammer": {"total_queries": 240},
+            },
+        },
+        "hammer_0.1",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "hammer_fast_lane_p95_under_heavy_storm_ms"
+    assert parsed["vs_baseline"] == 9.3
+    assert "result_cache" not in parsed  # fat maps stay in the sidecar
+    detail = json.load(open(tmp_path / "BENCH_hammer_0.1_detail.json"))
+    assert detail["detail"]["result_cache"]["hit_span_tree"] == hit_tree
+    assert detail["detail"]["fusion"]["fused_speedup"] == 1.03
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

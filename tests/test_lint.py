@@ -1137,8 +1137,101 @@ _MATRIX = {
                 """},
                 {"GL1201"},
             ),
+            # GL1204 upper-bound mode (the carried-over dynamically-
+            # tuned gap): the block row count is runtime data, but
+            # min(g, 4096) PROVES a 4096 bound — worst case
+            # 2x(4096x2048x1B + 4096x2048x4B) = 80 MiB > 16 MiB, so the
+            # tuning allows an over-budget tile even though GL1201's
+            # exact resolution fails
+            (
+                {"pkg/kern.py": """
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.experimental import pallas as pl
+
+                    def _k(x_ref, o_ref):
+                        o_ref[:] = x_ref[:]
+
+                    def run(x, g):
+                        br = min(g, 4096)
+                        return pl.pallas_call(
+                            _k,
+                            grid=(4,),
+                            in_specs=[
+                                pl.BlockSpec(
+                                    (br, 2048), lambda i: (i, 0)
+                                ),
+                            ],
+                            out_specs=pl.BlockSpec(
+                                (br, 2048), lambda i: (i, 0)
+                            ),
+                            out_shape=jax.ShapeDtypeStruct(
+                                (16384, 2048), jnp.float32
+                            ),
+                        )(x)
+                """},
+                {"GL1204"},
+            ),
+            # GL1204 through a min() with the bound as a module constant
+            (
+                {"pkg/kern.py": """
+                    import jax
+                    import jax.numpy as jnp
+                    from jax.experimental import pallas as pl
+
+                    MAX_BLOCK = 8192
+
+                    def _k(x_ref, o_ref):
+                        o_ref[:] = x_ref[:]
+
+                    def run(x, rows):
+                        return pl.pallas_call(
+                            _k,
+                            grid=(2,),
+                            in_specs=[
+                                pl.BlockSpec(
+                                    (min(rows, MAX_BLOCK), 1024),
+                                    lambda i: (i, 0),
+                                ),
+                            ],
+                            out_specs=pl.BlockSpec(
+                                (min(rows, MAX_BLOCK), 1024),
+                                lambda i: (i, 0),
+                            ),
+                            out_shape=jax.ShapeDtypeStruct(
+                                (16384, 1024), jnp.float32
+                            ),
+                        )(x)
+                """},
+                {"GL1204"},
+            ),
         ],
         "clean": [
+            # a dynamically-tuned kernel whose min() bound PROVABLY fits
+            # the budget is clean in upper-bound mode: worst case
+            # 2x(128x128x1B + 128x128x4B) is far under 16 MiB
+            {"pkg/kern.py": """
+                import jax
+                import jax.numpy as jnp
+                from jax.experimental import pallas as pl
+
+                def _k(x_ref, o_ref):
+                    o_ref[:] = x_ref[:]
+
+                def run(x, g):
+                    br = min(g, 128)
+                    return pl.pallas_call(
+                        _k,
+                        grid=(8,),
+                        in_specs=[
+                            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+                        ],
+                        out_specs=pl.BlockSpec((br, 128), lambda i: (i, 0)),
+                        out_shape=jax.ShapeDtypeStruct(
+                            (1024, 128), jnp.float32
+                        ),
+                    )(x)
+            """},
             # modest tiles through min()/conditional arithmetic: the
             # evaluator proves them under budget
             {"pkg/kern.py": """
@@ -1625,6 +1718,103 @@ _MATRIX = {
                         for seg in ds.segments:
                             pass
                         catalog.put(ds)
+            """},
+        ],
+    },
+    "serving-discipline": {
+        "violating": [
+            # GL1701: raw subscript write into a result cache bypasses
+            # the datasource-version stamp
+            (
+                {"spark_druid_olap_tpu/api.py": """
+                    def execute(self, rw, df, rkey):
+                        self._result_cache[rkey] = df.copy()
+                        return df
+                """},
+                {"GL1701"},
+            ),
+            # GL1701: put() without the version keyword
+            (
+                {"spark_druid_olap_tpu/serve/core.py": """
+                    def store(self, key, df, ds):
+                        self.result_cache.put(key, df)
+                """},
+                {"GL1701"},
+            ),
+            # GL1702: fused demux publishes a member metrics object with
+            # no query_id (assigned form)
+            (
+                {"spark_druid_olap_tpu/exec/engine.py": """
+                    from ..obs import record_query_metrics
+                    from .metrics import QueryMetrics
+
+                    def execute_fused(self, queries, ds):
+                        out = []
+                        for q in queries:
+                            m = QueryMetrics(query_type="groupBy")
+                            record_query_metrics(m, "ok")
+                            out.append(m)
+                        return out
+                """},
+                {"GL1702"},
+            ),
+            # GL1702: inline construction published without query_id
+            (
+                {"spark_druid_olap_tpu/serve/fusion.py": """
+                    from ..obs import record_query_metrics
+                    from ..exec.metrics import QueryMetrics
+
+                    def demux_fused(self, members):
+                        for q in members:
+                            record_query_metrics(
+                                QueryMetrics(query_type="topN"), "ok"
+                            )
+                """},
+                {"GL1702"},
+            ),
+        ],
+        "clean": [
+            # versioned put + query_id-stamped fused demux: the full
+            # contract
+            {"spark_druid_olap_tpu/serve/core.py": """
+                def store(self, key, df, ds):
+                    self.result_cache.put(
+                        key, df, version=ds.version,
+                        uids=frozenset(s.uid for s in ds.segments),
+                    )
+            """},
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                from ..obs import record_query_metrics
+                from .metrics import QueryMetrics
+
+                def execute_fused(self, queries, ds, query_ids):
+                    out = []
+                    for q, qid in zip(queries, query_ids):
+                        m = QueryMetrics(
+                            query_type="groupBy", query_id=qid,
+                        )
+                        record_query_metrics(m, "ok")
+                        out.append(m)
+                    # an UNPUBLISHED scratch accumulator needs no id
+                    batch_m = QueryMetrics(query_type="fused")
+                    return out, batch_m
+            """},
+            # cache reads and non-cache subscripts are not writes; a
+            # QueryMetrics outside fused scope belongs to other passes
+            {"spark_druid_olap_tpu/serve/result_cache.py": """
+                from ..obs import record_query_metrics
+                from ..exec.metrics import QueryMetrics
+
+                def lookup(self, key):
+                    entry = self.result_cache.get(key)
+                    self._stats["lookups"] = self._stats.get(
+                        "lookups", 0
+                    ) + 1
+                    return entry
+
+                def stamp_hit(self):
+                    m = QueryMetrics(query_type="groupBy")
+                    record_query_metrics(m, "ok")
             """},
         ],
     },

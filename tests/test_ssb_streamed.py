@@ -100,11 +100,11 @@ def test_gen_tables_unchanged_by_refactor():
 
 
 def test_parallel_ingest_matches_serial(tmp_path):
-    """workers>0 (fork pool) must register a byte-identical datasource to
-    the serial path.  The parallel side runs in a FRESH python child:
-    forking inside this pytest process — whose JAX backend earlier tests
-    initialized — is the documented deadlock hazard ingest_workers()
-    warns about, and would reproduce only intermittently here."""
+    """workers>0 (sharded THREAD pipeline, ISSUE 8 follow-up 2(a)) must
+    register a byte-identical datasource to the single-worker path — the
+    sharded dictionary merge and ordered shard reassembly are pure
+    functions of the row set.  Each side runs in a fresh python child so
+    the hashes cover a cold end-to-end register_streamed."""
     import hashlib
     import os
     import subprocess

@@ -10,9 +10,9 @@ GL2xx trace-purity, GL3xx dtype-x64, GL4xx compat-import, GL5xx
 lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
 collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
-lock-order, GL15xx ingest-discipline, GL16xx partial-discipline; GL00x
-are the core's own: GL001 unparseable file, GL002
-malformed pragma).
+lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
+serving-discipline; GL00x are the core's own: GL001 unparseable file,
+GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -33,6 +33,7 @@ from .lock_order import LockOrderPass
 from .pallas_shape import PallasShapePass
 from .partial_discipline import PartialDisciplinePass
 from .resource_budget import ResourceBudgetPass
+from .serving_discipline import ServingDisciplinePass
 from .span_discipline import SpanDisciplinePass
 from .trace_purity import TracePurityPass
 from .wire_parity import WireParityPass
@@ -54,6 +55,7 @@ ALL_PASSES = (
     LockOrderPass,
     IngestDisciplinePass,
     PartialDisciplinePass,
+    ServingDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

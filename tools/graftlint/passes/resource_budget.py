@@ -38,9 +38,23 @@ known argument the cost model follows):
 * **GL1203 — degenerate block shape.**  A BlockSpec dimension that
   resolves to <= 0 — an empty tile is never what a kernel author meant.
 
-All checks stay silent when a shape cannot be proven: only EXACTLY
-resolved spec sets are checked against the budget (an upper-bound guess
-would cry wolf on every dynamically-tuned kernel).
+* **GL1204 — upper-bound mode for dynamically-tuned kernels.**  A tile
+  set tuned as `block = min(G, BOUND)` never resolves exactly (G is
+  runtime data), so GL1201 stays silent — which left dynamically-tuned
+  kernels entirely unchecked (the carried-over ROADMAP gap).  In
+  upper-bound mode, an unresolved dimension spelled `min(...)` resolves
+  to the smallest of its PROVABLE arguments (`min(a, b) <= a` always),
+  and the resident estimate recomputed with those bounds is the
+  worst-case footprint the tuning ALLOWS.  When that upper bound
+  exceeds the budget, the finding says "clamp the tuning bound": the
+  kernel may fit on today's data and still Mosaic-fail the first time G
+  crosses the bound.  Dimensions with no provable bound keep the set
+  silent — never a guess.
+
+GL1201 stays exact-only: only EXACTLY resolved spec sets are checked
+against the budget directly (an upper-bound guess there would cry wolf);
+GL1204 exists precisely for the dynamically-tuned remainder, and says
+so in its message.
 """
 
 from __future__ import annotations
@@ -82,6 +96,10 @@ class ResourceBudgetPass(LintPass):
         "default_budget_bytes": 16 * 1024 * 1024,
         # every ref is double-buffered by the Pallas pipeline
         "pipeline_factor": 2,
+        # GL1204: when exact resolution fails, bound `min(...)`-tuned
+        # dimensions by their provable arguments and budget-check the
+        # worst case the tuning allows
+        "upper_bound": True,
     }
 
     def __init__(self, config: Optional[dict] = None):
@@ -276,6 +294,8 @@ class ResourceBudgetPass(LintPass):
         # only when EVERY spec resolved (a partial estimate would be a
         # guess, and guesses get pragma'd into uselessness)
         if not exact or not refs:
+            if self.config.get("upper_bound"):
+                self._check_upper_bound(ctx, node, module, kw, env)
             return
         factor = int(self.config["pipeline_factor"])
         total = sum(
@@ -304,6 +324,134 @@ class ResourceBudgetPass(LintPass):
                 "set fails Mosaic on real hardware even though CPU "
                 "interpret mode passes; shrink the block shapes or "
                 "split the refs",
+            )
+
+    # -- GL1204: upper-bound mode (dynamically-tuned kernels) -----------------
+
+    def _upper_dim(self, module, e, env) -> Tuple[Optional[int], bool]:
+        """(dimension upper bound, was_bounded): an exactly-resolved int
+        is its own bound; a `min(...)` call bounds to the smallest of
+        its PROVABLE arguments (min(a, b) <= a always); anything else is
+        unprovable (None)."""
+        v = self.project.const_eval(module, e, dict(env))
+        if isinstance(v, int):
+            return v, False
+        if isinstance(e, ast.Name) and isinstance(env.get(e.id), ast.AST):
+            return self._upper_dim(module, env[e.id], env)
+        if isinstance(e, ast.Call):
+            fname = (call_name(e) or "").rsplit(".", 1)[-1]
+            if fname == "min" and e.args:
+                cands = [
+                    self.project.const_eval(module, a, dict(env))
+                    for a in e.args
+                ]
+                ints = [c for c in cands if isinstance(c, int)]
+                if ints:
+                    return min(ints), True
+        return None, False
+
+    def _upper_shape(
+        self, module, e, env
+    ) -> Tuple[Optional[Tuple[int, ...]], bool]:
+        """(block-shape upper bound, any dimension used a min() bound)."""
+        if isinstance(e, ast.Name) and isinstance(env.get(e.id), ast.AST):
+            return self._upper_shape(module, env[e.id], env)
+        if not isinstance(e, (ast.Tuple, ast.List)):
+            v = self.project.const_eval(module, e, dict(env))
+            if isinstance(v, tuple) and all(
+                isinstance(d, int) for d in v
+            ):
+                return v, False
+            return None, False
+        dims: List[int] = []
+        bounded = False
+        for el in e.elts:
+            d, b = self._upper_dim(module, el, env)
+            if d is None:
+                return None, False
+            dims.append(d)
+            bounded = bounded or b
+        return tuple(dims), bounded
+
+    def _upper_spec_shapes(
+        self, module, specs, env
+    ) -> Tuple[Optional[List[Tuple[int, ...]]], bool]:
+        if specs is None:
+            return [], False
+        elts = self._resolve_seq(module, specs, env)
+        if elts is None:
+            return None, False
+        shapes: List[Tuple[int, ...]] = []
+        bounded_any = False
+        for e in elts:
+            if not (
+                isinstance(e, ast.Call)
+                and _is_blockspec(
+                    self.project.canonical(module, call_name(e))
+                )
+            ):
+                return None, False
+            shape_expr = e.args[0] if e.args else None
+            for k in e.keywords:
+                if k.arg == "block_shape":
+                    shape_expr = k.value
+            shape, bounded = self._upper_shape(module, shape_expr, env)
+            if shape is None:
+                return None, False
+            shapes.append(shape)
+            bounded_any = bounded_any or bounded
+        return shapes, bounded_any
+
+    def _check_upper_bound(self, ctx, node, module, kw, env):
+        """Exact resolution failed: budget-check the WORST CASE the
+        dynamic tuning allows, when every dimension is at least
+        min()-boundable.  Anything unprovable keeps the set silent."""
+        out_dtypes = self._out_dtypes(module, kw.get("out_shape"), env)
+        in_shapes, b_in = self._upper_spec_shapes(
+            module, kw.get("in_specs"), env
+        )
+        out_shapes, b_out = self._upper_spec_shapes(
+            module, kw.get("out_specs"), env
+        )
+        if in_shapes is None or out_shapes is None:
+            return
+        if not (b_in or b_out):
+            return  # nothing was dynamically tuned: GL1201's territory
+        scratch, scratch_exact = self._scratch_refs(
+            module, kw.get("scratch_shapes"), env
+        )
+        if not scratch_exact:
+            return  # an unprovable scratch would make the bound a guess
+        refs: List[Tuple[Tuple[int, ...], int]] = [
+            (shape, _DEFAULT_WIDTH) for shape in in_shapes
+        ]
+        for i, shape in enumerate(out_shapes):
+            width = _DTYPE_WIDTH.get(
+                out_dtypes[i].rsplit(".", 1)[-1]
+                if i < len(out_dtypes) else "", _DEFAULT_WIDTH,
+            )
+            refs.append((shape, width))
+        if not refs:
+            return
+        factor = int(self.config["pipeline_factor"])
+        resident = factor * sum(
+            self._prod(shape) * width for shape, width in refs
+        ) + sum(self._prod(shape) * width for shape, width in scratch)
+        budget, source = self._resolve_budget()
+        if resident > budget:
+            breakdown = " + ".join(
+                f"{'x'.join(str(d) for d in shape)}*{width}B"
+                for shape, width in refs
+            )
+            self.report(
+                ctx, node, "GL1204",
+                f"dynamically-tuned tile set's UPPER BOUND "
+                f"({resident} bytes, {factor}x double-buffered: "
+                f"{breakdown}) exceeds the {budget}-byte VMEM budget "
+                f"from {source} — the kernel may fit today's data and "
+                "still Mosaic-fail the first time the tuned extent "
+                "reaches its min() bound; clamp the tuning bound below "
+                "the budget",
             )
 
     # -- shape resolution -----------------------------------------------------

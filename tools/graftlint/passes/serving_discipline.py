@@ -1,0 +1,160 @@
+"""serving-discipline pass: the async serving core's contracts
+(GL17xx, ISSUE 8 satellite).
+
+The serving core (spark_druid_olap_tpu/serve/) introduced two contracts
+that rot silently:
+
+* **GL1701 — result-cache writes must carry a datasource version.**
+  The delta-aware result cache keys entries on query identity and
+  stamps each entry with the monotonic per-datasource version
+  (catalog/cache.py); an UNVERSIONED write is exactly the
+  stale-dashboard bug the cache exists to prevent — after an append it
+  would serve rows the datasource no longer has.  Flagged: (a) a
+  subscript STORE into any receiver named `*result_cache*` (raw dict
+  writes bypass the version stamp entirely — go through `.put(...)`),
+  and (b) a `.put(...)` call on such a receiver without a `version`
+  keyword.
+* **GL1702 — fused-batch demux must stamp every member query_id.**
+  A fused device program answers N queries with one dispatch; the demux
+  publishes one QueryMetrics per member.  A member metrics object
+  published WITHOUT its own query_id unlinks the query from its span
+  tree, its histogram exemplar, and the slow-query log — N queries
+  collapse into one anonymous observation.  Flagged: inside any
+  function whose name contains `fused`, a `record_query_metrics(m, ..)`
+  whose `m` resolves to a local `QueryMetrics(...)` construction that
+  carries no `query_id` keyword (an inline construction is checked the
+  same way).  Unpublished scratch metrics (batch-level h2d
+  accumulators) are not findings — only what gets PUBLISHED must be
+  attributable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from ..core import LintPass, ModuleContext
+
+_CACHE_FRAGMENT = "result_cache"
+
+
+def _recv_name(expr: ast.AST) -> str:
+    """Final name component of a receiver expression:
+    `self.serve.result_cache` -> "result_cache", `result_cache` ->
+    "result_cache"."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _call_short_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+class ServingDisciplinePass(LintPass):
+    name = "serving-discipline"
+    default_config = {
+        # the package the serving contracts apply to (fixtures re-create
+        # the layout); tests/tools constructing ad-hoc caches are out of
+        # scope
+        "include": ("spark_druid_olap_tpu/",),
+        "cache_fragment": _CACHE_FRAGMENT,
+    }
+
+    # -- GL1701: versioned result-cache writes -------------------------------
+
+    def _is_cache_recv(self, expr: ast.AST) -> bool:
+        return self.config["cache_fragment"] in _recv_name(expr)
+
+    def on_Assign(self, node: ast.Assign, ctx: ModuleContext):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) and self._is_cache_recv(
+                t.value
+            ):
+                self.report(
+                    ctx, node, "GL1701",
+                    "raw subscript write into a result cache bypasses "
+                    "the datasource-version stamp — go through "
+                    "`.put(key, df, version=..., ...)` so an append can "
+                    "never be served a stale frame as fresh",
+                )
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "put"
+            and self._is_cache_recv(f.value)
+        ):
+            if not any(k.arg == "version" for k in node.keywords):
+                self.report(
+                    ctx, node, "GL1701",
+                    "result-cache put() without a `version` keyword — "
+                    "every cached answer must carry the monotonic "
+                    "datasource version it was computed against "
+                    "(catalog/cache.py), or appends serve stale frames",
+                )
+        self._check_fused_publish(node, ctx)
+
+    # -- GL1702: fused demux stamps member query ids -------------------------
+
+    def _enclosing_fused_func(self, ctx: ModuleContext):
+        for func in reversed(ctx.scope.func_stack):
+            if "fused" in getattr(func, "name", ""):
+                return func
+        return None
+
+    @staticmethod
+    def _local_metric_ctors(func: ast.AST) -> Dict[str, ast.Call]:
+        """name -> the QueryMetrics(...) call it was last assigned."""
+        out: Dict[str, ast.Call] = {}
+        for sub in ast.walk(func):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if (
+                isinstance(sub.value, ast.Call)
+                and _call_short_name(sub.value) == "QueryMetrics"
+            ):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = sub.value
+        return out
+
+    @staticmethod
+    def _has_query_id(ctor: ast.Call) -> bool:
+        return any(
+            k.arg == "query_id" or k.arg is None  # **kwargs: can't prove
+            for k in ctor.keywords
+        )
+
+    def _check_fused_publish(self, node: ast.Call, ctx: ModuleContext):
+        if _call_short_name(node) != "record_query_metrics":
+            return
+        func = self._enclosing_fused_func(ctx)
+        if func is None or not node.args:
+            return
+        arg = node.args[0]
+        ctor: Optional[ast.Call] = None
+        if isinstance(arg, ast.Call) and _call_short_name(arg) == (
+            "QueryMetrics"
+        ):
+            ctor = arg
+        elif isinstance(arg, ast.Name):
+            ctor = self._local_metric_ctors(func).get(arg.id)
+        if ctor is None:
+            return  # unresolvable receiver: never guess
+        if not self._has_query_id(ctor):
+            self.report(
+                ctx, node, "GL1702",
+                "fused-batch demux publishes a member QueryMetrics with "
+                "no `query_id` — N fused queries then collapse into one "
+                "anonymous observation, unlinked from their span trees "
+                "and exemplars; stamp each member's own id",
+            )
