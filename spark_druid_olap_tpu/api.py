@@ -95,6 +95,7 @@ class TPUOlapContext:
         self.tracer = Tracer(
             capacity=self.config.trace_ring_capacity,
             otlp_path=self.config.otlp_export_path,
+            prof_sample_rate=self.config.prof_sample_rate,
         )
         # SQL-text -> Rewrite cache (the reference re-plans every Catalyst
         # round; locally a repeated dashboard query should pay parse+plan
@@ -465,11 +466,16 @@ class TPUOlapContext:
                     else:
                         self._plan_cache[key] = (rw, lp)
             if rw is None:
-                return self._stamp_partial(self._run_fallback(lp, plan_err))
+                return self._stamp_receipt(
+                    self._stamp_partial(self._run_fallback(lp, plan_err))
+                )
             with span(SPAN_EXECUTE):
-                return self._stamp_partial(
+                df = self._stamp_partial(
                     self._execute_with_resilience(rw, lp)
                 )
+            # receipt stamped OUTSIDE the execute span so its live
+            # snapshot sees the span closed (device/host split complete)
+            return self._stamp_receipt(df)
 
     def sql_progressive(self, sql_text: str):
         """Progressive execution of one SQL statement (ROADMAP 3(b)): a
@@ -717,6 +723,26 @@ class TPUOlapContext:
             pass
         return df
 
+    def _stamp_receipt(self, df):
+        """Stamp the query's cost receipt (obs/prof.py, ISSUE 9) onto
+        the answer: `df.attrs["receipt"]` (the SQL-surface contract) and
+        `QueryMetrics.receipt` — the live snapshot; the trace doc gets
+        the final recomputation at trace close.  A no-op outside a
+        trace (direct engine use without a context)."""
+        from .obs.prof import live_receipt
+
+        rc = live_receipt()
+        if rc is None:
+            return df
+        m = self.last_metrics
+        if m is not None:
+            m.receipt = rc
+        try:
+            df.attrs["receipt"] = rc
+        except AttributeError:  # fault-ok: non-pandas results skip attrs
+            pass
+        return df
+
     def execute_native_degraded(
         self, q, err=None, reason: str = "native degradation",
         backend: str = "device",
@@ -745,8 +771,12 @@ class TPUOlapContext:
         self._stamp_degraded(err, backend=backend)
         # partial-result discipline (GL16xx): a deadline-bounded degraded
         # answer publishes its coverage (partial span + fleet counter)
-        # exactly like the SQL surface — the server only adds the header
-        return shape_native_result(q, ds, self._stamp_partial(df))
+        # exactly like the SQL surface — the server only adds the header.
+        # Receipts survive the degraded path too (ISSUE 9 satellite):
+        # the fallback's host time is attributed like any other query's.
+        return shape_native_result(
+            q, ds, self._stamp_receipt(self._stamp_partial(df))
+        )
 
     def _run_fallback(self, lp, err, reason: str = "rewrite failed"):
         """The reference's vanilla-Spark fallback: a failed rewrite runs
@@ -1250,6 +1280,8 @@ def execute_grouping_sets(q: Q.GroupByQuery, grouping_sets, ds, engine):
     groupBy's subtotalsSpec, server.py) — the two must not drift."""
     import pandas as pd
 
+    from .resilience import current_partial
+
     all_dims = q.dimensions
     frames = []
     k = len(all_dims)
@@ -1264,13 +1296,35 @@ def execute_grouping_sets(q: Q.GroupByQuery, grouping_sets, ds, engine):
         )
         for s in grouping_sets
     ]
+    # per-grouping-set coverage attribution (ROADMAP 3(c)): each set's
+    # scan is its OWN accounting pass; the collector archives every pass
+    # (labeled with the set's dimension list) instead of letting the
+    # last subquery's begin_pass erase its predecessors — coverage and
+    # the partial histogram then describe the WHOLE expansion, and
+    # df.attrs carries the per-set breakdown
+    pc = current_partial()
+    set_labels = None
+    if pc is not None:
+        pc.collect_sets = True
+        set_labels = [
+            ",".join(all_dims[i].name for i in s) or "()"
+            for s in grouping_sets
+        ]
     # dispatch every set's device program before fetching any result:
     # N sequential executions behind a network-tunneled TPU pay N full
     # round trips; the batch path overlaps them
     if hasattr(engine, "execute_groupby_batch"):
-        results = engine.execute_groupby_batch(subs, ds)
+        results = engine.execute_groupby_batch(
+            subs, ds, set_labels=set_labels
+        )
     else:
-        results = [engine.execute(sub, ds) for sub in subs]
+        results = []
+        for i, sub in enumerate(subs):
+            if pc is not None and set_labels is not None:
+                pc.set_label = set_labels[i]
+            results.append(engine.execute(sub, ds))
+    if pc is not None:
+        pc.finish_sets()
     for s, f in zip(grouping_sets, results):
         gid = 0
         present = set(s)
@@ -1472,13 +1526,16 @@ class TableQuery:
                 except RewriteError as err:
                     rw, plan_err = None, err
             if rw is None:
-                return self.ctx._stamp_partial(
-                    self.ctx._run_fallback(lp, plan_err)
+                return self.ctx._stamp_receipt(
+                    self.ctx._stamp_partial(
+                        self.ctx._run_fallback(lp, plan_err)
+                    )
                 )
             with span(SPAN_EXECUTE):
-                return self.ctx._stamp_partial(
+                df = self.ctx._stamp_partial(
                     self.ctx._execute_with_resilience(rw, lp)
                 )
+            return self.ctx._stamp_receipt(df)
 
     def collect_arrow(self):
         """`collect()` as a `pyarrow.Table`."""

@@ -254,6 +254,31 @@ class SessionConfig:
     # disables
     otlp_export_path: Optional[str] = None
 
+    # -- performance attribution (obs/prof.py, ISSUE 9) ---------------------
+    # fraction of queries sampled for HONEST device timing: a sampled
+    # query pays sync points (block_until_ready) at its dispatch/fetch
+    # sites so the segment_dispatch/device_fetch spans split into
+    # enqueue vs device-complete time.  0 (default) adds ZERO syncs —
+    # the dispatch overlap the executors engineered is never destroyed
+    # by default; 1.0 profiles every query (bench receipt reps).
+    prof_sample_rate: float = 0.0
+    # GET /status/profile rolling window + top-K size
+    profile_window_s: float = 300.0
+    profile_top_k: int = 10
+    # per-lane latency targets the profiler burns SLO against: the
+    # fraction of a lane's queries whose wall exceeded its target is
+    # that lane's burn rate.  0 disables the burn computation for a lane.
+    lane_interactive_slo_ms: float = 250.0
+    lane_heavy_slo_ms: float = 30_000.0
+    # adaptive micro-batch fusion window (ROADMAP 1(b)): when True the
+    # scheduler arms the window from the observed arrival rate — no wait
+    # on an idle queue, up to fusion_window_max_ms under bursts — and
+    # records the decision as a `fusion_window` span event.  False keeps
+    # the static fusion_window_ms.
+    fusion_adaptive_window: bool = False
+    # burst ceiling for the adaptive window; 0 = 4x fusion_window_ms
+    fusion_window_max_ms: float = 0.0
+
     # provenance of the cost constants (set by load_calibrated): {path,
     # device, partial, applied, mismatch?} or None when never loaded from
     # a file — artifacts record it so "which platform routed this" is

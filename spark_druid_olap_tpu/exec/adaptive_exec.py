@@ -342,9 +342,13 @@ class AdaptiveDomainMixin:
         # _pallas_broken, the rebuilt program must not reuse the cached one
         # with Pallas strategies baked in
         key = _query_key(q, ds) + ("adaptive-presence", pallas_ok)
+        from ..obs import prof
+
         cached = self._query_fn_cache.get(key)
         if cached is not None:
+            prof.note_program_cache("adaptive-presence", hit=True)
             return cached
+        prof.note_program_cache("adaptive-presence", hit=False)
 
         # same inner convention as the sparse tier: one-hot kernels on a
         # TPU backend (within the one-hot domain cap), scatter everywhere
@@ -436,11 +440,17 @@ class AdaptiveDomainMixin:
                     # (checkpoint-coverage/GL901)
                     checkpoint("adaptive.presence_loop")
                     with span(SPAN_ADAPTIVE_PROBE, batch=bi):
+                        import time as _time
+
+                        from ..obs import prof
+
                         cols_list = [
                             self._cols_for_segment(seg, ds, need)
                             for seg in batch
                         ]
+                        t_call = _time.perf_counter()
                         out = seg_fn(cols_list)
+                        out = prof.dispatch_sync(out, t_call)
                     counts = (
                         out
                         if counts is None

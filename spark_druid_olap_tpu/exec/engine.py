@@ -70,6 +70,7 @@ from ..obs import (
     SPAN_LOWER,
     SPAN_SEGMENT_DISPATCH,
     current_query_id,
+    prof,
     record_query_metrics,
     span,
 )
@@ -428,6 +429,16 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # unbounded caches OOMed HBM over long sessions).  4 GiB default
         # leaves headroom on a 16 GiB v5e chip for kernel workspace.
         self._device_cache = ByteBudgetCache(device_cache_bytes)
+        # residency attribution (obs/prof.py, ISSUE 9): per-datasource
+        # resident-bytes gauges + budget-pressure eviction counters need
+        # a key -> (datasource, bytes) side table (cache keys carry only
+        # segment uids)
+        self._resident_lock = _threading.Lock()
+        self._resident_meta: Dict = {}
+        self._resident_by_ds: Dict[str, int] = {}
+        self._device_cache.on_evict = (
+            lambda key, arr: self._note_resident_drop(key, evicted=True)
+        )
         # (query-json, datasource, strategy) -> jitted per-segment program.
         # One fused XLA program per query shape: without this, every eager op
         # in the row pipeline is a separate device dispatch — ruinous when the
@@ -452,19 +463,61 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
     # -- segment residency ---------------------------------------------------
 
-    def _device_cols(self, seg: Segment, names) -> Dict[str, jnp.ndarray]:
+    def _note_resident_add(self, key, ds_name: str, nbytes: int) -> None:
+        with self._resident_lock:
+            prev = self._resident_meta.get(key)
+            if prev is not None:  # re-put of a live key: replace, not add
+                self._resident_by_ds[prev[0]] = max(
+                    0, self._resident_by_ds.get(prev[0], 0) - prev[1]
+                )
+            self._resident_meta[key] = (ds_name, nbytes)
+            self._resident_by_ds[ds_name] = (
+                self._resident_by_ds.get(ds_name, 0) + nbytes
+            )
+            now = self._resident_by_ds[ds_name]
+        prof.record_resident(ds_name, now)
+
+    def _note_resident_drop(self, key, evicted: bool = False) -> None:
+        with self._resident_lock:
+            meta = self._resident_meta.pop(key, None)
+            if meta is None:
+                return
+            ds_name, nbytes = meta
+            now = max(0, self._resident_by_ds.get(ds_name, 0) - nbytes)
+            self._resident_by_ds[ds_name] = now
+        prof.record_resident(ds_name, now)
+        if evicted:
+            prof.record_eviction(ds_name)
+
+    def _device_cols(
+        self, seg: Segment, names, ds_name: str = ""
+    ) -> Dict[str, jnp.ndarray]:
         import time as _time
 
         cols: Dict[str, jnp.ndarray] = {}
 
         def put(key, host):
             fire("h2d")  # fault-injection site: host->device transfer
+            prof.note_residency(hit=False)
             t0 = _time.perf_counter()
             arr = jnp.asarray(host)
+            # sampled query: block so the measured window is the real
+            # link time, not the enqueue (obs/prof.py; no-op otherwise)
+            arr = prof.transfer_sync(arr)
+            dt = _time.perf_counter() - t0
+            nbytes = int(np.asarray(host).nbytes)
+            # residency meta registers BEFORE the cache insert: a
+            # concurrent put() can budget-evict this key the instant it
+            # lands, and on_evict must find the meta to drop — the
+            # reverse order leaked phantom resident bytes
+            self._note_resident_add(key, ds_name or "unknown", nbytes)
             self._device_cache[key] = arr
+            # link-utilization accounting: bytes + effective MB/s into
+            # the scrapeable histogram (the 45 MB/s h2d floor claim)
+            prof.record_h2d(nbytes, dt)
             if self._m is not None:  # streamed-bytes metric (cache misses only)
-                self._m.h2d_bytes += int(np.asarray(host).nbytes)
-                self._m.h2d_ms += (_time.perf_counter() - t0) * 1e3
+                self._m.h2d_bytes += nbytes
+                self._m.h2d_ms += dt * 1e3
             return arr
 
         # "col"/"valid" tags: a user column literally named "__valid"
@@ -472,10 +525,18 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         for n in names:
             key = (seg.uid, "col", n)
             arr = self._device_cache.get(key)
-            cols[n] = arr if arr is not None else put(key, seg.column(n))
+            if arr is not None:
+                prof.note_residency(hit=True)
+                cols[n] = arr
+            else:
+                cols[n] = put(key, seg.column(n))
         key = (seg.uid, "valid")
         arr = self._device_cache.get(key)
-        cols["__valid"] = arr if arr is not None else put(key, seg.valid)
+        if arr is not None:
+            prof.note_residency(hit=True)
+            cols["__valid"] = arr
+        else:
+            cols["__valid"] = put(key, seg.valid)
         return cols
 
     def bytes_resident(self) -> int:
@@ -505,6 +566,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         self._device_cache.clear()
         self._lowering_cache.clear()
         self._query_fn_cache.clear()
+        with self._resident_lock:
+            self._resident_meta.clear()
+            dropped = list(self._resident_by_ds)
+            self._resident_by_ds.clear()
+        for name in dropped:
+            prof.record_resident(name, 0)
 
     def evict_segments(self, uids) -> None:
         """Drop device residency of specific segments — the ingestion
@@ -514,6 +581,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         uids = set(uids)
         for k in [k for k in self._device_cache if k[0] in uids]:
             self._device_cache.pop(k)
+            self._note_resident_drop(k)
 
     def _segment_batches(self, segs, names):
         """Split in-scope segments into dispatch batches: each batch becomes
@@ -547,7 +615,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         return cached_lowering(self._lowering_cache, q, ds)
 
     def _cols_for_segment(self, seg: Segment, ds: DataSource, names):
-        cols = self._device_cols(seg, names)
+        cols = self._device_cols(seg, names, ds_name=ds.name)
         if ds.time_column and ds.time_column in cols:
             cols["__time"] = cols[ds.time_column]
         return cols
@@ -683,9 +751,17 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 and m.compile_ms == 0
                 else None
             )
+            t_call = _time.perf_counter()
             result = seg_fn(cols_list)
+            # sampled query: block here so the enclosing dispatch span
+            # splits into enqueue vs device-complete time (obs/prof.py);
+            # a literal no-op at the default sample rate of 0
+            result = prof.dispatch_sync(result, t_call)
             if t0 is not None:
                 m.compile_ms = (_time.perf_counter() - t0) * 1e3
+                # first-trace/compile attributed to the tagged program
+                # family whose cache miss built this program
+                prof.note_compile(m.compile_ms)
             return result, seg_fn
         except Exception:
             # Auto-selected Pallas may fail to Mosaic-compile on exotic
@@ -768,11 +844,16 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # nothing stops `strategy` + key_extra from ever spelling another
         # family's tuple (graftlint jit-collision/GL1301)
         key = _query_key(q, ds) + ("fused", strategy) + tuple(key_extra)
+        family = (
+            "fused" if not key_extra else f"fused/{key_extra[0]}"
+        )
         cached = self._query_fn_cache.get(key)
         if cached is not None:
             if self._m is not None:
                 self._m.program_cache_hit = True
+            prof.note_program_cache(family, hit=True)
             return cached
+        prof.note_program_cache(family, hit=False)
         fire("compile")  # fault-injection site: new program build
 
         @jax.jit
@@ -846,6 +927,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
         t0 = _time.perf_counter()
         n = len(queries)
+        prof.note_fusion(n)  # the leader's receipt records the batch size
         query_ids = list(query_ids or [""] * n)
         members = []
         for q in queries:
@@ -909,11 +991,14 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                         and batch_m.compile_ms == 0
                         else None
                     )
+                    t_call = _time.perf_counter()
                     outs = fn(cols_list)
+                    outs = prof.dispatch_sync(outs, t_call)
                     if t_c is not None:
                         batch_m.compile_ms = (
                             (_time.perf_counter() - t_c) * 1e3
                         )
+                        prof.note_compile(batch_m.compile_ms)
                 for i, (s, mn, mx, sk) in enumerate(outs):
                     if s is None:
                         continue
@@ -939,6 +1024,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             if acc[i] is None
         }
         with span(SPAN_DEVICE_FETCH, fused=n):
+            prof.fetch_sync(acc)
             host = jax.device_get((acc, acc_sk, empties))
         acc_h, sk_h, empties_h = host
         out = []
@@ -1014,7 +1100,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         if cached is not None:
             if self._m is not None:
                 self._m.program_cache_hit = True
+            prof.note_program_cache("fused-batch", hit=True)
             return cached
+        prof.note_program_cache("fused-batch", hit=False)
         fire("compile")  # fault-injection site: new program build
         lowerings = [m[3] for m in members]
 
@@ -1160,20 +1248,32 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         uids = {seg.uid for seg in ds.segments}
         for k in [k for k in self._device_cache if k[0] in uids]:
             self._device_cache.pop(k)
+            self._note_resident_drop(k)
 
     def _execute_groupby_once(self, q: Q.GroupByQuery, ds: DataSource):
         return self._dispatch_groupby_once(q, ds)()
 
-    def execute_groupby_batch(self, queries, ds: DataSource):
+    def execute_groupby_batch(self, queries, ds: DataSource, set_labels=None):
         """Execute N GroupBy queries with overlapped device round trips:
         dispatch every query's program first (async), then resolve in
         order, so the fetch latency of query i hides the compute of i+1..N.
         This is what a grouping-set (CUBE/ROLLUP) expansion calls — behind
         a network-tunneled TPU, N sequential executions would pay N full
         round trips.  Per-query transient failures fall back to the normal
-        retrying execution path, serially (rare; correctness first)."""
+        retrying execution path, serially (rare; correctness first).
+
+        `set_labels` (ROADMAP 3(c)): per-query labels for the partial
+        collector's per-grouping-set accounting — each sub-query's pass
+        archives under its own set instead of erasing its predecessor."""
+        pc = current_partial()
+
+        def _label(i):
+            if pc is not None and set_labels is not None:
+                pc.set_label = set_labels[i]
+
         resolves = []
-        for q in queries:
+        for i, q in enumerate(queries):
+            _label(i)
             try:
                 resolves.append(self._dispatch_groupby_once(q, ds))
             except NotImplementedError:
@@ -1189,6 +1289,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 resolves.append(None)
         out = []
         for i, (q, resolve) in enumerate(zip(queries, resolves)):
+            _label(i)  # sparse/adaptive re-passes attribute to their set
             resolves[i] = None  # release the closure (and its device state)
             if resolve is None:
                 out.append(self._execute_groupby(q, ds))
@@ -1396,6 +1497,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 # when the TPU sits behind a network tunnel); a single
                 # pytree fetch pays one.
                 with span(SPAN_DEVICE_FETCH):
+                    # sampled query: separate device-wait from the host
+                    # copy inside the fetch span (obs/prof.py)
+                    prof.fetch_sync((sums, mins, maxs, sketch_states))
                     sums, mins, maxs, sketch_states = jax.device_get(
                         (sums, mins, maxs, sketch_states)
                     )
@@ -1526,7 +1630,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             # partial) with a coverage fraction
             if checkpoint_partial("engine.scan_loop"):
                 break
-            cols = self._device_cols(seg, need)
+            cols = self._device_cols(seg, need, ds_name=ds.name)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]
             for name, fn in vcol_fns.items():

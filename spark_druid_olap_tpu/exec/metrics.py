@@ -95,6 +95,12 @@ class QueryMetrics:
     coverage: Optional[float] = None
     rows_seen: int = 0
     delta_rows_seen: int = 0
+    # performance attribution (obs/prof.py, ISSUE 9): the per-query cost
+    # receipt — device/host/transfer split from the span tree, transfer
+    # bytes, compile counts, and cache-tier outcomes (result cache,
+    # fusion, residency, program cache).  Stamped by the api layer from
+    # the live trace; None for direct engine use outside a trace.
+    receipt: Optional[dict] = None
     # micro-batch fusion (serve/, ISSUE 8): when > 0, this query executed
     # as one member of an N-query fused device program — its dispatch
     # round trip was amortized N ways.  h2d/compile on a fused member are

@@ -92,11 +92,15 @@ class SparseExecMixin:
         # identities (None vs "None") and the pallas-eviction scan matches
         # on the rendered tuple (graftlint jit-cache/GL103)
         key = _query_key(q, ds) + ("sparse", inner, row_capacity, slots)
+        from ..obs import prof
+
         cached = self._query_fn_cache.get(key)
         if cached is not None:
             if self._m is not None:
                 self._m.program_cache_hit = True
+            prof.note_program_cache("sparse", hit=True)
             return cached
+        prof.note_program_cache("sparse", hit=False)
 
         from ..ops.sparse_groupby import merge_sparse_states
 
@@ -186,11 +190,19 @@ class SparseExecMixin:
                 if checkpoint_partial("sparse.segment_loop"):
                     break
                 with span(SPAN_SPARSE_DISPATCH, batch=bi, segments=len(batch)):
+                    import time as _time
+
+                    from ..obs import prof
+
                     cols_list = [
                         self._cols_for_segment(seg, ds, lowering.columns)
                         for seg in batch
                     ]
+                    t_call = _time.perf_counter()
                     st = seg_fn(cols_list)
+                    # sampled query: honest enqueue-vs-device split on
+                    # the sparse dispatch span (obs/prof.py; no-op off)
+                    st = prof.dispatch_sync(st, t_call)
                     state = (
                         st
                         if state is None

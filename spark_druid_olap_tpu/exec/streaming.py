@@ -259,6 +259,8 @@ class StreamExecutor:
             fire("device_dispatch")
             t0 = _time.perf_counter()
             with span(SPAN_STREAM_CHUNK, chunk=self.stats.chunks):
+                from ..obs import prof
+
                 try:
                     s, mn, mx, sk = run(dev, base, nrows)
                 except Exception:  # fault-ok: _downgrade_pallas re-raises non-Pallas errors
@@ -266,6 +268,9 @@ class StreamExecutor:
                         q, ds, lowering, prep, build_mesh_run, strat
                     )
                     s, mn, mx, sk = run(dev, base, nrows)
+                # sampled query: honest device split on the chunk span
+                # (obs/prof.py; a strict no-op at the default rate)
+                s = prof.dispatch_sync(s, t0)
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
@@ -338,9 +343,13 @@ class StreamExecutor:
             prep,  # carries (time_col, chunk_rows) identity
             strat or eng._resolve_strategy(lowering.num_groups),
         )
+        from ..obs import prof
+
         cached = eng._query_fn_cache.get(key)
         if cached is not None:
+            prof.note_program_cache("stream-fused", hit=True)
             return cached
+        prof.note_program_cache("stream-fused", hit=False)
         seg_fn = eng._segment_program(q, ds, lowering, strategy_override=strat)
 
         @jax.jit

@@ -23,6 +23,7 @@ from .registry import (  # noqa: F401
     record_partial,
     record_query_metrics,
 )
+from . import prof  # noqa: F401  (performance attribution, ISSUE 9)
 from .trace import (  # noqa: F401
     SPAN_ADAPTIVE_PROBE,
     SPAN_ADMISSION,

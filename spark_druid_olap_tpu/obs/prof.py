@@ -1,0 +1,607 @@
+"""Performance attribution layer (ISSUE 9 tentpole).
+
+The span tree (obs/trace.py) records WHEN phases ran; this module makes
+the numbers HONEST and turns them into per-query cost receipts:
+
+  * **Honest device timing** — JAX dispatch is asynchronous, so a
+    wall-clock span around `segment_dispatch` measures enqueue time,
+    not device time.  `dispatch_sync`/`fetch_sync` are sampling-gated
+    sync points (`SessionConfig.prof_sample_rate`): on a SAMPLED query
+    they `block_until_ready` the dispatched state and split the
+    enclosing span into `enqueue_ms` vs `device_ms` attrs; on an
+    unsampled query they are a single contextvar read — ZERO added
+    syncs, so the overlap the executors engineered is never destroyed
+    by default.
+  * **Transfer + residency accounting** — every h2d move records bytes
+    and effective MB/s into `sdol_h2d_link_mbps` (the link-bound claim
+    becomes a scrapeable histogram); the engine's residency cache
+    exports per-datasource resident-bytes gauges and eviction counters.
+  * **Program-cache family attribution** — hit/miss counters and
+    compile-time totals per tagged program family (`fused`,
+    `fused-batch`, `sparse`, `adaptive-presence`, ...), so "what is
+    recompiling and why" is a registry query, not archaeology.
+  * **Per-query cost receipts** — `build_receipt` folds a finished span
+    tree into {device_ms, host_ms, transfer_ms, unattributed_ms, ...}
+    by summing each span's EXCLUSIVE time (duration minus children)
+    into a bucket by span name.  Only the root `query` span's exclusive
+    time is unattributed, so `device + host + transfer` vs `wall` is a
+    real claim about lifecycle coverage, not an identity.  Receipts are
+    stamped into the trace doc (served at `/druid/v2/trace/{id}`),
+    `QueryMetrics.receipt`, `df.attrs["receipt"]`, and — on sampled
+    queries — the `X-Druid-Response-Context` header.
+  * **Workload profiler** — a process-wide rolling window of finished
+    queries behind `GET /status/profile`: top-K by device time,
+    per-family compile totals, per-lane SLO burn-rate against the
+    `lane_*_slo_ms` latency targets.
+
+Accounting convention: compile time happens INSIDE the first dispatch
+span, so `device_ms` includes it; the receipt reports `compile_ms`
+separately as attribution detail, never as an additive term.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..utils.log import get_logger
+from .registry import bounded_label, get_registry
+from .trace import current_query_id, current_span, current_trace
+
+log = get_logger("obs.prof")
+
+# effective host->device MB/s per transfer: spans the 45 MB/s tunnel
+# floor the re-anchor note names up through PCIe-class links
+LINK_MBPS_BUCKETS = (
+    1.0, 5.0, 10.0, 25.0, 45.0, 75.0, 150.0, 500.0,
+    1000.0, 5000.0, 20000.0,
+)
+
+# span-name -> receipt bucket.  Device spans either block on device work
+# (device_fetch, collective_merge) or — on a sampled query — are split
+# honestly by the sync helpers; h2d is the transfer bucket; every OTHER
+# span's exclusive time is host work.  The root `query` span's exclusive
+# time stays unattributed (the coverage-claim denominator).
+DEVICE_SPANS = frozenset(
+    {
+        "segment_dispatch",
+        "device_fetch",
+        "sparse_dispatch",
+        "adaptive_probe",
+        "stream_chunk",
+        "collective_merge",
+    }
+)
+TRANSFER_SPANS = frozenset({"h2d"})
+ROOT_SPAN = "query"
+
+
+class ProfScope:
+    """Per-query attribution accumulators, armed by the tracer for the
+    lifetime of one query trace.  `sampled` gates the sync helpers;
+    the cheap counters (cache outcomes, transfer bytes) collect on
+    EVERY traced query.  Contextvar-confined like the trace itself
+    (fresh threads see no scope), so the mutators need no lock."""
+
+    __slots__ = (
+        "sampled",
+        "lane",
+        "syncs",
+        "transfer_ms",
+        "transfer_bytes",
+        "compiles",
+        "compile_ms",
+        "residency_hits",
+        "residency_misses",
+        "program_cache",
+        "result_cache",
+        "fused_batch",
+        "pending_family",
+    )
+
+    def __init__(self, sampled: bool = False):
+        self.sampled = bool(sampled)
+        self.lane = ""
+        self.syncs = 0
+        self.transfer_ms = 0.0
+        self.transfer_bytes = 0
+        self.compiles = 0
+        self.compile_ms = 0.0
+        self.residency_hits = 0
+        self.residency_misses = 0
+        # family -> [hits, misses]
+        self.program_cache: Dict[str, List[int]] = {}
+        self.result_cache: Optional[str] = None  # "hit"/"delta" when served
+        self.fused_batch = 0
+        self.pending_family: Optional[str] = None
+
+
+_active: contextvars.ContextVar[Optional[ProfScope]] = contextvars.ContextVar(
+    "sdol_active_prof", default=None
+)
+
+
+def current_scope() -> Optional[ProfScope]:
+    return _active.get()
+
+
+def activate(scope: ProfScope):
+    """INTERNAL (tracer lifecycle): arm `scope` for this context."""
+    return _active.set(scope)
+
+
+def deactivate(token) -> None:
+    _active.reset(token)
+
+
+def profiled() -> bool:
+    """Is the CURRENT query sampled for honest device timing?"""
+    ps = _active.get()
+    return ps is not None and ps.sampled
+
+
+class RateSampler:
+    """Deterministic rate sampler: an accumulator advances by `rate`
+    per query and fires on integer crossings — rate 1.0 samples every
+    query, 0.25 every fourth, 0 never.  Deterministic (no wall-clock or
+    RNG) so tests and benches can reason about exactly which queries
+    paid a sync."""
+
+    def __init__(self, rate: float = 0.0):
+        self.rate = float(rate)
+        self._acc = 0.0
+        self._force = False
+        self._lock = threading.Lock()
+
+    def force_next(self) -> None:
+        with self._lock:
+            self._force = True
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._force:
+                self._force = False
+                return True
+            r = self.rate
+            if r <= 0:
+                return False
+            if r >= 1.0:
+                return True
+            self._acc += r
+            if self._acc >= 1.0:
+                self._acc -= 1.0
+                return True
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Sampling-gated sync points (honest device timing)
+# ---------------------------------------------------------------------------
+
+
+def dispatch_sync(result, t_enqueue: float):
+    """Called by an executor right after an async program dispatch, with
+    the pre-dispatch clock reading.  Sampled query: block until the
+    dispatched state is device-complete and split the enclosing span
+    into `enqueue_ms` vs `device_ms`.  Unsampled: return `result`
+    untouched — one contextvar read, no sync, overlap preserved."""
+    ps = _active.get()
+    if ps is None or not ps.sampled:
+        return result
+    import jax
+
+    t1 = time.perf_counter()
+    jax.block_until_ready(result)
+    t2 = time.perf_counter()
+    ps.syncs += 1
+    s = current_span()
+    if s is not None:
+        s.attrs["enqueue_ms"] = round((t1 - t_enqueue) * 1e3, 3)
+        s.attrs["device_ms"] = round((t2 - t1) * 1e3, 3)
+    return result
+
+
+def fetch_sync(tree):
+    """Called just before a blocking `device_get`: on a sampled query,
+    block first so the fetch span separates device-wait from the host
+    copy (`device_wait_ms` attr).  No-op otherwise."""
+    ps = _active.get()
+    if ps is None or not ps.sampled:
+        return tree
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(tree)
+    ps.syncs += 1
+    s = current_span()
+    if s is not None:
+        s.attrs["device_wait_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 3
+        )
+    return tree
+
+
+def transfer_sync(arr):
+    """On a sampled query, block on a just-issued h2d placement so the
+    caller's elapsed measurement is the real link time, not the enqueue.
+    No-op otherwise (the unsampled measurement is the enqueue-observed
+    'effective' rate — still recorded, labeled by the sampling bit in
+    the receipt)."""
+    ps = _active.get()
+    if ps is None or not ps.sampled:
+        return arr
+    import jax
+
+    jax.block_until_ready(arr)
+    ps.syncs += 1
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Transfer / residency / program-cache accounting
+# ---------------------------------------------------------------------------
+
+
+def record_h2d(nbytes: int, seconds: float) -> None:
+    """One host->device move: effective MB/s into the link-utilization
+    histogram (exemplared with the query id) + the scope's transfer
+    accumulators.  This is what turns 'the rollup is link-bound at
+    45 MB/s' from a postmortem into a scrapeable fact."""
+    mbps = nbytes / max(seconds, 1e-9) / 1e6
+    get_registry().histogram(
+        "sdol_h2d_link_mbps",
+        "effective host->device link utilization per transfer (MB/s)",
+        buckets=LINK_MBPS_BUCKETS,
+    ).observe(mbps, exemplar=current_query_id() or None)
+    ps = _active.get()
+    if ps is not None:
+        ps.transfer_ms += seconds * 1e3
+        ps.transfer_bytes += int(nbytes)
+
+
+def record_resident(datasource: str, bytes_now: int) -> None:
+    """Publish a datasource's current resident-bytes (direction 4's
+    residency-aware scheduling needs this denominator)."""
+    ds = bounded_label("residency_datasource", datasource or "unknown")
+    get_registry().gauge(
+        "sdol_resident_bytes",
+        "device-resident segment bytes, by datasource",
+        labels=("datasource",),
+    ).labels(datasource=ds).set(bytes_now)
+
+
+def record_eviction(datasource: str, n: int = 1) -> None:
+    ds = bounded_label("residency_datasource", datasource or "unknown")
+    get_registry().counter(
+        "sdol_residency_evictions_total",
+        "residency-cache evictions under byte-budget pressure, "
+        "by datasource",
+        labels=("datasource",),
+    ).labels(datasource=ds).inc(n)
+
+
+def note_residency(hit: bool) -> None:
+    ps = _active.get()
+    if ps is None:
+        return
+    if hit:
+        ps.residency_hits += 1
+    else:
+        ps.residency_misses += 1
+
+
+def note_program_cache(family: str, hit: bool) -> None:
+    """One program-cache lookup under its tagged key family."""
+    fam = bounded_label("program_family", family or "unknown")
+    get_registry().counter(
+        "sdol_program_cache_total",
+        "compiled-program cache lookups, by tagged key family / outcome",
+        labels=("family", "outcome"),
+    ).labels(family=fam, outcome="hit" if hit else "miss").inc()
+    ps = _active.get()
+    if ps is not None:
+        c = ps.program_cache.setdefault(family, [0, 0])
+        c[0 if hit else 1] += 1
+        if not hit:
+            ps.pending_family = family
+
+
+def note_compile(ms: float, family: Optional[str] = None) -> None:
+    """First-trace/compile cost of one program build, attributed to the
+    family whose cache miss triggered it (the scope remembers the last
+    missed family when the caller cannot name it)."""
+    ps = _active.get()
+    if family is None and ps is not None:
+        family = ps.pending_family
+    fam = bounded_label("program_family", family or "unknown")
+    reg = get_registry()
+    reg.counter(
+        "sdol_compiles_total",
+        "program trace+compile events, by program-cache family",
+        labels=("family",),
+    ).labels(family=fam).inc()
+    reg.counter(
+        "sdol_compile_ms_total",
+        "cumulative trace+compile milliseconds, by program-cache family",
+        labels=("family",),
+    ).labels(family=fam).inc(max(0.0, float(ms)))
+    if ps is not None:
+        ps.compiles += 1
+        ps.compile_ms += max(0.0, float(ms))
+
+
+def note_result_cache(outcome: str) -> None:
+    ps = _active.get()
+    if ps is not None:
+        ps.result_cache = outcome
+
+
+def note_fusion(batch: int) -> None:
+    ps = _active.get()
+    if ps is not None:
+        ps.fused_batch = max(ps.fused_batch, int(batch))
+
+
+def note_lane(lane: str) -> None:
+    ps = _active.get()
+    if ps is not None and lane:
+        ps.lane = str(lane)
+
+
+# ---------------------------------------------------------------------------
+# Receipts
+# ---------------------------------------------------------------------------
+
+
+def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
+    dur = float(node.get("duration_ms", 0.0))
+    children = node.get("children") or ()
+    child_sum = sum(float(c.get("duration_ms", 0.0)) for c in children)
+    excl = max(0.0, dur - child_sum)
+    name = str(node.get("name", ""))
+    if depth == 0 and name == ROOT_SPAN:
+        acc["unattributed"] += excl
+    elif name in DEVICE_SPANS:
+        acc["device"] += excl
+    elif name in TRANSFER_SPANS:
+        acc["transfer"] += excl
+    else:
+        acc["host"] += excl
+    for c in children:
+        _walk_exclusive(c, acc, depth + 1)
+
+
+def build_receipt(
+    trace_doc: dict, scope: Optional[ProfScope] = None
+) -> dict:
+    """Fold one trace document (obs.trace.QueryTrace.to_dict shape) into
+    a cost receipt.  Pure function of the doc + scope counters, so it
+    can run live (mid-query, provisional span ends) or at trace close."""
+    acc = {"device": 0.0, "transfer": 0.0, "host": 0.0, "unattributed": 0.0}
+    root = trace_doc.get("spans")
+    if isinstance(root, dict):
+        _walk_exclusive(root, acc, 0)
+    wall = float(trace_doc.get("total_ms") or 0.0)
+    receipt: Dict[str, Any] = {
+        "query_id": trace_doc.get("query_id", ""),
+        "wall_ms": round(wall, 3),
+        "device_ms": round(acc["device"], 3),
+        "host_ms": round(acc["host"], 3),
+        "transfer_ms": round(acc["transfer"], 3),
+        "unattributed_ms": round(acc["unattributed"], 3),
+        "sampled": bool(scope.sampled) if scope is not None else False,
+    }
+    if scope is not None:
+        cache: Dict[str, Any] = {
+            "result_cache": scope.result_cache,
+            "fused_batch": scope.fused_batch,
+            "residency": {
+                "hits": scope.residency_hits,
+                "misses": scope.residency_misses,
+            },
+            "program_cache": {
+                fam: {"hits": c[0], "misses": c[1]}
+                for fam, c in sorted(scope.program_cache.items())
+            },
+        }
+        receipt.update(
+            transfer_bytes=scope.transfer_bytes,
+            transfer_mb_per_s=(
+                round(
+                    scope.transfer_bytes
+                    / max(scope.transfer_ms, 1e-9)
+                    / 1e3,
+                    1,
+                )
+                if scope.transfer_bytes
+                else 0.0
+            ),
+            compiles=scope.compiles,
+            compile_ms=round(scope.compile_ms, 3),
+            syncs=scope.syncs,
+            lane=scope.lane,
+            cache=cache,
+        )
+    return receipt
+
+
+def live_receipt() -> Optional[dict]:
+    """Receipt of the ACTIVE query so far (unfinished spans measured to
+    'now' under the tracer's own clock) — what df.attrs, QueryMetrics,
+    and the response-context header carry; the trace doc gets the final
+    recomputation at close.  None outside a trace."""
+    tr = current_trace()
+    if tr is None:
+        return None
+    try:
+        return build_receipt(tr.to_dict_live(), _active.get())
+    except Exception:  # fault-ok: attribution must never fail a query
+        log.warning("live receipt build failed", exc_info=True)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Workload profiler (GET /status/profile)
+# ---------------------------------------------------------------------------
+
+
+class WorkloadProfiler:
+    """Process-wide rolling window of finished-query observations.
+    Like the metrics registry it survives context rebuilds; the tracer
+    feeds it one observation per finished trace."""
+
+    def __init__(self, capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._entries: deque = deque(maxlen=max(16, int(capacity)))
+
+    def observe(self, trace_doc: dict, scope: Optional[ProfScope]) -> None:
+        rc = trace_doc.get("receipt") or {}
+        entry = {
+            "t": time.monotonic(),
+            "query_id": trace_doc.get("query_id", ""),
+            "query_type": trace_doc.get("query_type", ""),
+            "lane": (scope.lane if scope is not None else "") or "",
+            "wall_ms": float(rc.get("wall_ms", trace_doc.get("total_ms", 0.0)) or 0.0),
+            "device_ms": float(rc.get("device_ms", 0.0) or 0.0),
+            "transfer_ms": float(rc.get("transfer_ms", 0.0) or 0.0),
+            "compiles": int(rc.get("compiles", 0) or 0),
+            "sampled": bool(rc.get("sampled", False)),
+        }
+        with self._lock:
+            self._entries.append(entry)
+
+    def window(self, window_s: float) -> List[dict]:
+        cutoff = time.monotonic() - max(1e-3, float(window_s))
+        with self._lock:
+            return [e for e in self._entries if e["t"] >= cutoff]
+
+    def profile(
+        self,
+        window_s: float = 300.0,
+        top_k: int = 10,
+        slo_ms: Optional[Dict[str, float]] = None,
+    ) -> dict:
+        """Rolling-window workload profile: top-K queries by device
+        time, per-lane SLO burn-rate (fraction of the lane's queries
+        whose wall exceeded its latency target), and window totals."""
+        now = time.monotonic()
+        entries = self.window(window_s)
+        top = sorted(
+            entries, key=lambda e: e["device_ms"], reverse=True
+        )[: max(1, int(top_k))]
+        lanes: Dict[str, dict] = {}
+        for e in entries:
+            lane = e["lane"] or "unclassified"
+            d = lanes.setdefault(
+                lane, {"queries": 0, "over_slo": 0, "wall_ms_sum": 0.0}
+            )
+            d["queries"] += 1
+            d["wall_ms_sum"] += e["wall_ms"]
+            target = (slo_ms or {}).get(lane)
+            if target is not None and target > 0 and e["wall_ms"] > target:
+                d["over_slo"] += 1
+        for lane, d in lanes.items():
+            target = (slo_ms or {}).get(lane)
+            d["slo_ms"] = target
+            d["burn_rate"] = (
+                round(d["over_slo"] / d["queries"], 4)
+                if d["queries"] and target
+                else 0.0
+            )
+            d["mean_wall_ms"] = round(
+                d["wall_ms_sum"] / max(1, d["queries"]), 3
+            )
+            del d["wall_ms_sum"]
+        return {
+            "window_s": float(window_s),
+            "queries_observed": len(entries),
+            "lanes": lanes,
+            "top_device": [
+                {
+                    "query_id": e["query_id"],
+                    "query_type": e["query_type"],
+                    "lane": e["lane"] or "unclassified",
+                    "device_ms": round(e["device_ms"], 3),
+                    "wall_ms": round(e["wall_ms"], 3),
+                    "sampled": e["sampled"],
+                    "age_s": round(now - e["t"], 1),
+                }
+                for e in top
+            ],
+        }
+
+
+_profiler: Optional[WorkloadProfiler] = None
+_profiler_lock = threading.Lock()
+
+
+def workload_profiler() -> WorkloadProfiler:
+    global _profiler
+    if _profiler is None:
+        with _profiler_lock:
+            if _profiler is None:
+                _profiler = WorkloadProfiler()
+    return _profiler
+
+
+def _family_totals() -> Dict[str, dict]:
+    """Per-program-family compile totals + hit/miss counts from the
+    process registry (the /status/profile 'what is recompiling' table)."""
+    reg = get_registry()
+    out: Dict[str, dict] = {}
+    for key, v in reg.counter(
+        "sdol_program_cache_total",
+        "compiled-program cache lookups, by tagged key family / outcome",
+        labels=("family", "outcome"),
+    ).snapshot().items():
+        fam, _, outcome = key.partition(",")
+        d = out.setdefault(
+            fam, {"hits": 0, "misses": 0, "compiles": 0, "compile_ms": 0.0}
+        )
+        d["hits" if outcome == "hit" else "misses"] += int(v)
+    for key, v in reg.counter(
+        "sdol_compiles_total",
+        "program trace+compile events, by program-cache family",
+        labels=("family",),
+    ).snapshot().items():
+        out.setdefault(
+            key, {"hits": 0, "misses": 0, "compiles": 0, "compile_ms": 0.0}
+        )["compiles"] = int(v)
+    for key, v in reg.counter(
+        "sdol_compile_ms_total",
+        "cumulative trace+compile milliseconds, by program-cache family",
+        labels=("family",),
+    ).snapshot().items():
+        out.setdefault(
+            key, {"hits": 0, "misses": 0, "compiles": 0, "compile_ms": 0.0}
+        )["compile_ms"] = round(float(v), 3)
+    return out
+
+
+def profile_doc(
+    config=None,
+    top_k: Optional[int] = None,
+    window_s: Optional[float] = None,
+) -> dict:
+    """The `GET /status/profile` document."""
+    cfg = config
+    k = int(top_k or getattr(cfg, "profile_top_k", 10) or 10)
+    win = float(window_s or getattr(cfg, "profile_window_s", 300.0) or 300.0)
+    slo = {
+        "interactive": float(
+            getattr(cfg, "lane_interactive_slo_ms", 0.0) or 0.0
+        ),
+        "heavy": float(getattr(cfg, "lane_heavy_slo_ms", 0.0) or 0.0),
+    }
+    doc = workload_profiler().profile(window_s=win, top_k=k, slo_ms=slo)
+    doc["compile_families"] = _family_totals()
+    plan = get_registry().counter(
+        "sdol_plan_cache_total",
+        "decoded-QuerySpec plan cache on the wire path, by outcome",
+        labels=("outcome",),
+    ).snapshot()
+    doc["plan_cache"] = {k2 or "none": int(v) for k2, v in plan.items()}
+    return doc

@@ -145,11 +145,17 @@ class Span:
             return 0.0
         return (self.end - self.start) * 1e3
 
-    def to_dict(self, origin: float) -> dict:
+    def to_dict(self, origin: float, now: Optional[float] = None) -> dict:
+        # `now` supports LIVE snapshots (obs/prof.py receipt builds
+        # mid-query): an unfinished span measures to the provisional
+        # clock reading instead of reporting zero
+        dur = self.duration_ms
+        if self.end is None and now is not None:
+            dur = (now - self.start) * 1e3
         d: Dict[str, Any] = {
             "name": self.name,
             "start_ms": round((self.start - origin) * 1e3, 3),
-            "duration_ms": round(self.duration_ms, 3),
+            "duration_ms": round(dur, 3),
         }
         if self.attrs:
             d["attrs"] = dict(self.attrs)
@@ -165,7 +171,7 @@ class Span:
                 for e in self.events
             ]
         if self.children:
-            d["children"] = [c.to_dict(origin) for c in self.children]
+            d["children"] = [c.to_dict(origin, now) for c in self.children]
         return d
 
 
@@ -183,6 +189,10 @@ class QueryTrace:
         self._clock = clock
         self._lock = threading.Lock()
         self.root = Span(SPAN_QUERY, clock())
+        # per-query cost receipt (obs/prof.py), stamped at trace close;
+        # rides every to_dict so the ring doc, bench detail artifacts,
+        # and /druid/v2/trace/{id} all carry it
+        self.receipt: Optional[dict] = None
 
     def start_span(
         self, name: str, parent: Optional[Span], attrs: Optional[dict] = None
@@ -216,11 +226,28 @@ class QueryTrace:
         return self.root.duration_ms
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "query_id": self.query_id,
             "query_type": self.query_type,
             "total_ms": round(self.total_ms, 3),
             "spans": self.root.to_dict(self.root.start),
+        }
+        if self.receipt is not None:
+            d["receipt"] = self.receipt
+        return d
+
+    def to_dict_live(self) -> dict:
+        """Provisional snapshot of a trace still in flight: unfinished
+        spans (including the root) measure to 'now' under the tracer's
+        own clock — what obs.prof.live_receipt folds into the receipt
+        the response headers and df.attrs carry."""
+        now = self._clock()
+        root_end = self.root.end if self.root.end is not None else now
+        return {
+            "query_id": self.query_id,
+            "query_type": self.query_type,
+            "total_ms": round((root_end - self.root.start) * 1e3, 3),
+            "spans": self.root.to_dict(self.root.start, now),
         }
 
     def render(self) -> str:
@@ -270,6 +297,12 @@ def current_trace() -> Optional[QueryTrace]:
 def current_query_id() -> str:
     tr = _active_trace.get()
     return tr.query_id if tr is not None else ""
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span of the active trace (None without one) —
+    how the prof sync helpers annotate the span they fired inside."""
+    return _active_span.get()
 
 
 @contextlib.contextmanager
@@ -353,6 +386,7 @@ class Tracer:
         clock: Callable[[], float] = time.perf_counter,
         capacity: int = 64,
         otlp_path: Optional[str] = None,
+        prof_sample_rate: float = 0.0,
     ):
         self.clock = clock
         self.ring = TraceRing(capacity)
@@ -362,6 +396,19 @@ class Tracer:
         # ResourceSpans, one per line) to this path; no collector, no
         # network, no tier-1 dependency
         self.otlp_path = otlp_path
+        # performance attribution (obs/prof.py, ISSUE 9): every owned
+        # trace arms a ProfScope; the sampler decides which queries pay
+        # the honest-device-timing sync points.  Deterministic (no RNG)
+        # and force-armable (`force_sample_next`) so a bench can collect
+        # one honest receipt per query without perturbing its timed reps.
+        from .prof import RateSampler
+
+        self.sampler = RateSampler(prof_sample_rate)
+
+    def force_sample_next(self) -> None:
+        """Arm honest device timing for the NEXT owned trace regardless
+        of the configured sample rate."""
+        self.sampler.force_next()
 
     @contextlib.contextmanager
     def query_trace(
@@ -378,12 +425,16 @@ class Tracer:
         if existing is not None:
             yield existing
             return
+        from . import prof as _prof
+
         tr = QueryTrace(
             query_id or new_query_id(), clock=self.clock,
             query_type=query_type,
         )
         tok_t = _active_trace.set(tr)
         tok_s = _active_span.set(tr.root)
+        ps = _prof.ProfScope(sampled=self.sampler.take())
+        tok_p = _prof.activate(ps)
         try:
             yield tr
         finally:
@@ -392,6 +443,17 @@ class Tracer:
             tr.finish()
             self.last = tr
             doc = tr.to_dict()
+            # per-query cost receipt (ISSUE 9): fold the finished span
+            # tree + the prof scope's counters into the attribution doc
+            # and feed the rolling workload profiler — both must never
+            # fail a query
+            try:
+                tr.receipt = _prof.build_receipt(doc, ps)
+                doc["receipt"] = tr.receipt
+                _prof.workload_profiler().observe(doc, ps)
+            except Exception:  # fault-ok: attribution must not fail queries
+                log.warning("receipt build failed", exc_info=True)
+            _prof.deactivate(tok_p)
             self.ring.put(doc)
             if self.otlp_path:
                 from .otlp import append_otlp

@@ -286,8 +286,12 @@ class DistributedEngine:
             "dense-state",
             strategy,
         ) + tuple(key_extra)
+        from ..obs import prof
+
         if cache_key in self._spmd_cache:
+            prof.note_program_cache("dense-state", hit=True)
             return self._spmd_cache[cache_key]
+        prof.note_program_cache("dense-state", hit=False)
         G = lowering.num_groups
         la = lowering.la
         ng, Gl = self._groups_split(G)
@@ -717,12 +721,20 @@ class DistributedEngine:
         # under the collective-merge span: the fetch blocks on the SPMD
         # program, so this is where the ICI merge's wall time is paid
         with span(SPAN_COLLECTIVE_MERGE):
-            sums, mins, maxs, sk = jax.device_get(run(cols))
+            from ..obs import prof
+
+            t_call = _time.perf_counter()
+            out_state = run(cols)
+            # sampled query: split the collective span into enqueue vs
+            # device-complete time before the blocking fetch (obs/prof.py)
+            out_state = prof.dispatch_sync(out_state, t_call)
+            sums, mins, maxs, sk = jax.device_get(out_state)
         dt = (_time.perf_counter() - t0) * 1e3
         if m.program_cache_hit:
             m.device_ms = dt
         else:  # first call: trace+compile dominates (metrics.py semantics)
             m.compile_ms = dt
+            prof.note_compile(dt, family="dense-state")
         t0 = _time.perf_counter()
         with span(SPAN_FINALIZE):
             out = finalize_groupby(

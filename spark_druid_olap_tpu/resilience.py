@@ -245,6 +245,7 @@ class PartialCollector:
         "segments_total", "segments_seen",
         "rows_total", "rows_seen",
         "delta_rows_total", "delta_rows_seen",
+        "collect_sets", "set_label", "set_records", "_pass_label",
         "_lock",
     )
 
@@ -265,6 +266,21 @@ class PartialCollector:
         self.rows_seen = 0
         self.delta_rows_total = 0
         self.delta_rows_seen = 0
+        # per-grouping-set coverage attribution (ROADMAP 3(c)): a CUBE
+        # expansion runs one pass per grouping set; with collect_sets
+        # armed, begin_pass ARCHIVES the superseded pass (labeled by
+        # set_label) instead of erasing it, and coverage/is_partial/
+        # to_dict aggregate across every archived set plus the live one
+        # — the expansion's coverage describes ALL sets, not whichever
+        # subquery ran last
+        self.collect_sets = False
+        self.set_label: Optional[str] = None
+        # the label the LIVE pass started under: the expansion updates
+        # set_label before each sub-query's begin_pass, so archiving
+        # must use the label captured at the pass's START, not the one
+        # already pointing at the next set
+        self._pass_label: Optional[str] = None
+        self.set_records: list = []
         self._lock = threading.Lock()
 
     @property
@@ -284,14 +300,82 @@ class PartialCollector:
         """A fresh full scan of the query's scope supersedes earlier
         accounting (the sparse tier declining into a dense rescan must
         not double-count).  No-op inside a fallback-owned pass: the
-        interpreter accumulates across its tables and assist subtrees."""
+        interpreter accumulates across its tables and assist subtrees.
+        With `collect_sets` armed (a grouping-set expansion) the
+        superseded pass is ARCHIVED under its set label first — a repeat
+        pass for the SAME label (sparse decline -> dense rescan)
+        replaces its record rather than double-counting."""
         if self.in_fallback:
             return
         with self._lock:
+            if self.collect_sets and self.scope_declared:
+                self._archive_pass_locked()
             self.scope_declared = False
             self.segments_total = self.segments_seen = 0
             self.rows_total = self.rows_seen = 0
             self.delta_rows_total = self.delta_rows_seen = 0
+            self._pass_label = self.set_label
+
+    def _archive_pass_locked(self) -> None:
+        cov = None
+        if self.rows_total > 0:
+            cov = min(1.0, self.rows_seen / self.rows_total)
+        elif self.segments_total > 0:
+            cov = min(1.0, self.segments_seen / self.segments_total)
+        elif self.scope_declared:
+            cov = 1.0
+        rec = {
+            "set": self._pass_label,
+            "coverage": round(cov, 6) if cov is not None else None,
+            "segments_seen": self.segments_seen,
+            "segments_total": self.segments_total,
+            "rows_seen": self.rows_seen,
+            "rows_total": self.rows_total,
+            "delta_rows_seen": self.delta_rows_seen,
+            "delta_rows_total": self.delta_rows_total,
+        }
+        # a same-label rescan SUPERSEDES its earlier record wherever it
+        # sits (labels are unique per set): adjacent for a sparse
+        # decline -> dense rescan, non-adjacent when a batch-dispatch
+        # failure re-runs one set serially after later sets archived —
+        # appending would double-count the set's rows in the aggregate
+        for i, old in enumerate(self.set_records):
+            if old.get("set") == rec["set"]:
+                self.set_records[i] = rec
+                return
+        self.set_records.append(rec)
+
+    def finish_sets(self) -> list:
+        """Close grouping-set collection: archive the live pass and zero
+        the live counters so the aggregate (coverage / to_dict) reads
+        purely from the per-set records.  Returns the records."""
+        with self._lock:
+            if self.scope_declared:
+                self._archive_pass_locked()
+            self.collect_sets = False
+            self.scope_declared = False
+            self.segments_total = self.segments_seen = 0
+            self.rows_total = self.rows_seen = 0
+            self.delta_rows_total = self.delta_rows_seen = 0
+            return list(self.set_records)
+
+    def _agg_locked(self):
+        """(segments_total, segments_seen, rows_total, rows_seen,
+        delta_total, delta_seen, any_scope) aggregated across archived
+        set records plus the live pass."""
+        st, ss = self.segments_total, self.segments_seen
+        rt, rs = self.rows_total, self.rows_seen
+        dt, dsn = self.delta_rows_total, self.delta_rows_seen
+        declared = self.scope_declared
+        for r in self.set_records:
+            st += r["segments_total"]
+            ss += r["segments_seen"]
+            rt += r["rows_total"]
+            rs += r["rows_seen"]
+            dt += r["delta_rows_total"]
+            dsn += r["delta_rows_seen"]
+            declared = True
+        return st, ss, rt, rs, dt, dsn, declared
 
     def reset_for_drain(self) -> None:
         """Zero the accounting for a drain-RERUN (the fallback's
@@ -323,11 +407,12 @@ class PartialCollector:
 
     def coverage(self) -> Optional[float]:
         with self._lock:
-            if self.rows_total > 0:
-                return min(1.0, self.rows_seen / self.rows_total)
-            if self.segments_total > 0:
-                return min(1.0, self.segments_seen / self.segments_total)
-            if self.scope_declared:
+            st, ss, rt, rs, _dt, _ds, declared = self._agg_locked()
+            if rt > 0:
+                return min(1.0, rs / rt)
+            if st > 0:
+                return min(1.0, ss / st)
+            if declared:
                 return 1.0  # declared empty scope: nothing to scan
             return None
 
@@ -338,28 +423,35 @@ class PartialCollector:
         if not self.triggered:
             return False
         with self._lock:
-            if self.rows_total > 0:
-                return self.rows_seen < self.rows_total
-            if self.segments_total > 0:
-                return self.segments_seen < self.segments_total
-            if self.scope_declared:
+            st, ss, rt, rs, _dt, _ds, declared = self._agg_locked()
+            if rt > 0:
+                return rs < rt
+            if st > 0:
+                return ss < st
+            if declared:
                 return False  # declared empty scope: complete by vacuity
             return True  # unknown denominator: claim nothing
 
     def to_dict(self) -> dict:
         cov = self.coverage()
         with self._lock:
-            return {
+            st, ss, rt, rs, dt, dsn, _declared = self._agg_locked()
+            d = {
                 "partial": True,
                 "coverage": round(cov, 6) if cov is not None else None,
                 "site": self.triggered_site,
-                "segments_seen": self.segments_seen,
-                "segments_total": self.segments_total,
-                "rows_seen": self.rows_seen,
-                "rows_total": self.rows_total,
-                "delta_rows_seen": self.delta_rows_seen,
-                "delta_rows_total": self.delta_rows_total,
+                "segments_seen": ss,
+                "segments_total": st,
+                "rows_seen": rs,
+                "rows_total": rt,
+                "delta_rows_seen": dsn,
+                "delta_rows_total": dt,
             }
+            if self.set_records:
+                # per-grouping-set attribution: which set the deadline
+                # actually truncated, not just the blended fraction
+                d["sets"] = [dict(r) for r in self.set_records]
+            return d
 
 
 _active_partial: contextvars.ContextVar[Optional[PartialCollector]] = (

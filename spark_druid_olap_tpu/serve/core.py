@@ -24,7 +24,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from ..obs import current_query_id, record_query_metrics
+from ..obs import current_query_id, get_registry, prof, record_query_metrics
 from ..utils.log import get_logger
 from .fusion import FusionScheduler
 from .lanes import LANE_INTERACTIVE, classify_rewrite
@@ -40,11 +40,69 @@ class ServingCore:
         self.fusion = FusionScheduler(
             window_ms=getattr(cfg, "fusion_window_ms", 0.0),
             max_batch=getattr(cfg, "fusion_max_batch", 16),
+            adaptive=getattr(cfg, "fusion_adaptive_window", False),
+            max_window_ms=getattr(cfg, "fusion_window_max_ms", 0.0),
         )
         self.result_cache = ResultCache(
             entries=getattr(cfg, "result_cache_entries", 64),
             delta_reuse=getattr(cfg, "result_cache_delta_reuse", True),
         )
+        # cross-request decoded-QuerySpec plan cache on the wire path
+        # (ROADMAP 1(c)): native queries re-decode JSON per request even
+        # though dashboards POST the identical body every refresh — key
+        # on the context-stripped body and skip `query_from_druid`
+        # entirely on a hit, shaving the fast lane's floor.  Decode is a
+        # pure function of the body (no catalog input), so entries never
+        # need invalidation.
+        from ..utils.lru import CountBudgetCache
+
+        self.wire_plan_cache = CountBudgetCache(256)
+
+    # -- wire plan cache (ROADMAP 1(c)) --------------------------------------
+
+    def decode_native(self, body: dict):
+        """Decode one native-query body into its QuerySpec through the
+        body-hash plan cache.  `sdol_plan_cache_total{outcome}` makes
+        the fast-lane floor shave visible in `/status/profile`."""
+        import hashlib
+        import json as _json
+
+        from ..models.wire import query_from_druid
+
+        ctr = get_registry().counter(
+            "sdol_plan_cache_total",
+            "decoded-QuerySpec plan cache on the wire path, by outcome",
+            labels=("outcome",),
+        )
+        try:
+            # context carries per-request noise (queryId, timeout, ...)
+            # the SERVER consumes outside the decode — strip exactly
+            # those keys so every dashboard refresh of the same query
+            # hits.  Everything else in context STAYS in the key:
+            # skipEmptyBuckets/outputName shape the decoded timeseries
+            # spec (models/wire.py), and unknown keys are kept
+            # conservatively (a miss is cheap; a false hit serves the
+            # wrong QuerySpec).
+            noise = ("queryId", "timeout", "progressive", "partialResults")
+            qctx = body.get("context")
+            canon_body = {k: v for k, v in body.items() if k != "context"}
+            if isinstance(qctx, dict):
+                kept = {k: v for k, v in qctx.items() if k not in noise}
+                if kept:
+                    canon_body["context"] = kept
+            canon = _json.dumps(canon_body, sort_keys=True)
+        except (TypeError, ValueError):
+            ctr.labels(outcome="uncacheable").inc()
+            return query_from_druid(body)
+        key = hashlib.sha1(canon.encode()).digest()
+        hit = self.wire_plan_cache.get(key)
+        if hit is not None:
+            ctr.labels(outcome="hit").inc()
+            return hit
+        q = query_from_druid(body)  # decode errors keep their 400 path
+        self.wire_plan_cache[key] = q
+        ctr.labels(outcome="miss").inc()
+        return q
 
     # -- result cache --------------------------------------------------------
 
@@ -204,6 +262,11 @@ class ServingCore:
         )
         self.ctx._last_engine_metrics = m
         record_query_metrics(m, "ok")
+        # cost-receipt cache attribution (obs/prof.py): the receipt's
+        # result_cache outcome — "hit" (zero dispatch) vs "delta"
+        prof.note_result_cache(
+            "delta" if strategy == "result-cache-delta" else "hit"
+        )
         return m
 
     def store_result(self, rw, ds, key, df, state=None) -> None:
@@ -287,4 +350,5 @@ class ServingCore:
         return {
             "fusion": self.fusion.to_dict(),
             "result_cache": self.result_cache.to_dict(),
+            "wire_plan_cache_entries": len(self.wire_plan_cache),
         }

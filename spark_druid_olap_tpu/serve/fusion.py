@@ -41,7 +41,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..obs import SPAN_FUSED_BATCH, current_query_id, span, span_event
+from collections import deque
+
+from ..obs import SPAN_FUSED_BATCH, current_query_id, prof, span, span_event
 from ..utils.log import get_logger
 
 log = get_logger("serve.fusion")
@@ -90,9 +92,27 @@ class FusionScheduler:
     (fusion disabled, batch of one, batch invalidated by a concurrent
     append, or the fused dispatch failed)."""
 
-    def __init__(self, window_ms: float = 0.0, max_batch: int = 16):
+    def __init__(
+        self,
+        window_ms: float = 0.0,
+        max_batch: int = 16,
+        adaptive: bool = False,
+        max_window_ms: float = 0.0,
+    ):
         self.window_ms = float(window_ms)
         self.max_batch = max(2, int(max_batch))
+        # adaptive window (ROADMAP 1(b)): arm the hold window from the
+        # OBSERVED arrival rate — an idle queue pays no wait at all (the
+        # static window taxes every solo query the full window for
+        # nothing), a burst holds up to max_window_ms so more members
+        # amortize the dispatch.  The decision is recorded as a
+        # `fusion_window` span event on the leader's trace.
+        self.adaptive = bool(adaptive)
+        self.max_window_ms = (
+            float(max_window_ms) if max_window_ms else 4.0 * float(window_ms)
+        )
+        self._arrivals: deque = deque(maxlen=64)
+        self.window_decisions: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._open: Dict[Tuple, _Batch] = {}
         self._ids = itertools.count(1)
@@ -105,6 +125,33 @@ class FusionScheduler:
     def enabled(self) -> bool:
         return self.window_ms > 0
 
+    def _decide_window_ms(self, now: float) -> Tuple[float, str, int]:
+        """(window_ms, mode, recent_arrivals) for a leader arriving at
+        `now` — BEFORE its own arrival is recorded, so the decision
+        reads only the queue's recent history.  idle: no arrival within
+        8 windows -> no wait; burst: >=3 arrivals within 2 windows ->
+        hold up to max_window_ms; base: the configured window."""
+        if not self.adaptive:
+            return self.window_ms, "static", 0
+        horizon = 8.0 * self.window_ms / 1e3
+        burst_horizon = 2.0 * self.window_ms / 1e3
+        with self._lock:
+            recent = [t for t in self._arrivals if now - t <= horizon]
+        if not recent:
+            return 0.0, "idle", 0
+        burst = sum(1 for t in recent if now - t <= burst_horizon)
+        if burst >= 3:
+            return (
+                min(self.max_window_ms, 2.0 * self.window_ms),
+                "burst",
+                len(recent),
+            )
+        return self.window_ms, "base", len(recent)
+
+    def _note_arrival(self, now: float) -> None:
+        with self._lock:
+            self._arrivals.append(now)
+
     def execute(self, ctx, q, ds):
         """Join (or lead) the micro-batch for `q` over the `ds`
         snapshot.  Returns (df, state, metrics) or None (serial path)."""
@@ -112,6 +159,9 @@ class FusionScheduler:
             return None
         from ..exec.lowering import schema_signature
 
+        now = time.monotonic()
+        window_ms, mode, n_recent = self._decide_window_ms(now)
+        self._note_arrival(now)
         sig = (ds.name, schema_signature(ds))
         me = _Member(q, current_query_id())
         with self._lock:
@@ -128,7 +178,21 @@ class FusionScheduler:
                 leader = False
             batch.members.append(me)
         if leader:
-            self._lead(ctx, batch, ds)
+            # record the arrival-rate decision (ROADMAP 1(b)): the span
+            # event says what the scheduler chose and why, so "why did
+            # my solo query not wait" / "why did the burst hold longer"
+            # reads off the trace
+            with self._lock:
+                self.window_decisions[mode] = (
+                    self.window_decisions.get(mode, 0) + 1
+                )
+            span_event(
+                "fusion_window",
+                window_ms=round(window_ms, 3),
+                mode=mode,
+                recent_arrivals=n_recent,
+            )
+            self._lead(ctx, batch, ds, window_ms=window_ms)
         else:
             if not me.event.wait(_MEMBER_WAIT_S):
                 log.warning(
@@ -139,6 +203,9 @@ class FusionScheduler:
         if me.verdict != _OK:
             return None
         df, state, m = me.payload
+        # receipt attribution: every member's scope records the batch
+        # size it rode (the leader's was stamped inside execute_fused)
+        prof.note_fusion(len(batch.members))
         if not leader:
             # a NON-leader member's trace records that this query rode a
             # fused batch (the leader's trace already holds the real
@@ -158,13 +225,16 @@ class FusionScheduler:
                 )
         return df, state, m
 
-    def _lead(self, ctx, batch: _Batch, ds) -> None:
-        """Leader protocol: hold the window open, close the batch, and
-        either execute it fused or invalidate it (every member then
-        re-executes individually on its own thread)."""
+    def _lead(self, ctx, batch: _Batch, ds, window_ms: Optional[float] = None) -> None:
+        """Leader protocol: hold the window open (the adaptive decision
+        when one was made), close the batch, and either execute it fused
+        or invalidate it (every member then re-executes individually on
+        its own thread)."""
         from ..exec.lowering import schema_signature
 
-        time.sleep(self.window_ms / 1e3)
+        hold_ms = self.window_ms if window_ms is None else window_ms
+        if hold_ms > 0:
+            time.sleep(hold_ms / 1e3)
         with self._lock:
             batch.closed = True
             if self._open.get(batch.signature) is batch:
@@ -249,6 +319,9 @@ class FusionScheduler:
             return {
                 "enabled": self.enabled,
                 "window_ms": self.window_ms,
+                "adaptive": self.adaptive,
+                "max_window_ms": self.max_window_ms,
+                "window_decisions": dict(self.window_decisions),
                 "max_batch": self.max_batch,
                 "batches_fused": self.batches_fused,
                 "members_fused": self.members_fused,

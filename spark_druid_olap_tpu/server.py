@@ -75,6 +75,7 @@ def _route_label(path: str) -> str:
         "/druid/v2",
         "/status/metrics",
         "/status/health",
+        "/status/profile",
         "/status",
     ):
         if path == prefix or path.startswith(prefix + "/"):
@@ -343,6 +344,31 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 get_registry().render_prometheus().encode(),
                 "text/plain; version=0.0.4; charset=utf-8",
+            )
+        if path == "/status/profile":
+            # workload profiler (obs/prof.py, ISSUE 9): rolling-window
+            # top-K queries by device time, per-family compile totals,
+            # per-lane SLO burn-rate.  ?k= and ?window_s= override the
+            # configured defaults per request.
+            from urllib.parse import parse_qs, urlparse
+
+            from .obs.prof import profile_doc
+
+            qs = parse_qs(urlparse(self.path).query)
+
+            def _num(name, cast):
+                try:
+                    return cast(qs[name][0])
+                except (KeyError, IndexError, TypeError, ValueError):
+                    return None
+
+            return self._send(
+                200,
+                profile_doc(
+                    config=getattr(self.ctx, "config", None),
+                    top_k=_num("k", int),
+                    window_s=_num("window_s", float),
+                ),
             )
         if path.startswith("/druid/v2/trace/"):
             qid = path.rsplit("/", 1)[1]
@@ -630,14 +656,27 @@ class _Handler(BaseHTTPRequestHandler):
         (ISSUE 7): when the answer about to be sent is deadline-bounded,
         the header holds {"partial": true, "coverage": ..., rows seen /
         total, delta split} — Druid's own response-context header, so
-        existing clients that already parse it see the flag."""
+        existing clients that already parse it see the flag.
+
+        A SAMPLED query (obs/prof.py, ISSUE 9) additionally carries its
+        cost receipt under a "receipt" key — the per-query device/host/
+        transfer split and cache-tier outcomes on the wire.  Unsampled
+        queries keep the exact historical header behavior (absent unless
+        partial)."""
+        from .obs.prof import live_receipt, profiled
+
+        rctx = {}
         pc = current_partial()
-        if pc is None or not pc.is_partial:
+        if pc is not None and pc.is_partial:
+            rctx.update(pc.to_dict())
+        if profiled():
+            rc = live_receipt()
+            if rc is not None:
+                rctx["receipt"] = rc
+        if not rctx:
             return None
         return {
-            "X-Druid-Response-Context": json.dumps(
-                pc.to_dict(), default=_jsonable
-            )
+            "X-Druid-Response-Context": json.dumps(rctx, default=_jsonable)
         }
 
     # query types that never dispatch device work: answered from catalog
@@ -675,8 +714,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _native_query(self, body: dict, qctx: dict):
         res = self._resilience()
+        serve = getattr(self.ctx, "serve", None)
         try:
-            q = query_from_druid(body)
+            # cross-request decoded-QuerySpec plan cache (ROADMAP 1(c)):
+            # dashboards POST the identical body every refresh — a hit
+            # skips the wire decode entirely, shaving the fast lane's
+            # per-request floor
+            if serve is not None:
+                q = serve.decode_native(body)
+            else:
+                q = query_from_druid(body)
         except ValueError as e:
             # decode-time ValueErrors (unsupported filter type, malformed
             # interval timestamps) are malformed CLIENT input — 400, same
@@ -688,11 +735,13 @@ class _Handler(BaseHTTPRequestHandler):
         # priority lanes (serve/lanes.py): a cheap dashboard query takes
         # an interactive slot an SF100-scale scan cannot starve; heavy
         # work gates on its own small pool with a per-lane Retry-After
+        from .obs.prof import note_lane
         from .serve.lanes import classify_native
 
         lane_name = classify_native(
             q, ds, getattr(self.ctx, "config", None)
         )
+        note_lane(lane_name)  # the workload profiler's SLO burn key
         if not self._acquire_lane(lane_name):
             return None
         try:
@@ -819,8 +868,9 @@ class _Handler(BaseHTTPRequestHandler):
             # publishes a deadline-bounded answer (partial span +
             # sdol_partial_results_total/coverage histogram) exactly like
             # ctx.sql's _stamp_partial path; _partial_headers below only
-            # adds the wire header
-            df = self.ctx._stamp_partial(df)
+            # adds the wire header.  The cost receipt (ISSUE 9) rides the
+            # same stamp point.
+            df = self.ctx._stamp_receipt(self.ctx._stamp_partial(df))
         except Exception as err:
             # a transient device failure that survived the engine's retry
             # budget degrades exactly like the SQL path does; static
@@ -895,6 +945,16 @@ class _Handler(BaseHTTPRequestHandler):
                     "rows_total": info.get("rows_total"),
                     "result": shape(df),
                 }
+                if line["final"]:
+                    # the FINAL refinement carries the stream's cost
+                    # receipt (ISSUE 9 satellite): progressive clients
+                    # get the same attribution a buffered response puts
+                    # in df.attrs / the response-context header
+                    from .obs.prof import live_receipt
+
+                    rc = live_receipt()
+                    if rc is not None:
+                        line["receipt"] = rc
                 with span(SPAN_STREAM_FLUSH, sequence=info["sequence"]):
                     self._write_chunk(
                         json.dumps(line, default=_jsonable).encode()
@@ -948,6 +1008,10 @@ class _Handler(BaseHTTPRequestHandler):
         # once); anything unplannable gates interactive
         serve = getattr(self.ctx, "serve", None)
         lane_name = serve.lane_for_sql(sql) if serve is not None else None
+        if lane_name is not None:
+            from .obs.prof import note_lane
+
+            note_lane(lane_name)
         if lane_name is not None and not self._acquire_lane(lane_name):
             return None
         res = self._resilience()

@@ -35,6 +35,11 @@ class ByteBudgetCache:
         self._od: "OrderedDict[Any, Any]" = OrderedDict()
         self._bytes = 0
         self._lock = threading.RLock()
+        # observability hook (obs/prof.py residency accounting): called
+        # as on_evict(key, value) for every BUDGET-PRESSURE eviction —
+        # explicit pops/clears are the caller's own bookkeeping.  Must
+        # be cheap and non-raising (it runs under the cache lock).
+        self.on_evict = None
 
     @property
     def bytes_used(self) -> int:
@@ -107,8 +112,13 @@ class ByteBudgetCache:
         # (graftlint lock-discipline/GL501)
         with self._lock:
             while self._bytes > self.budget_bytes and len(self._od) > 1:
-                _, old = self._od.popitem(last=False)
+                key, old = self._od.popitem(last=False)
                 self._bytes -= int(old.nbytes)
+                if self.on_evict is not None:
+                    try:
+                        self.on_evict(key, old)
+                    except Exception:  # fault-ok: hooks never break eviction
+                        pass
 
 
 class CountBudgetCache:

@@ -1818,6 +1818,95 @@ _MATRIX = {
             """},
         ],
     },
+    "obs-discipline": {
+        "violating": [
+            # GL1801: bare block_until_ready in an executor module adds
+            # an unconditional sync on every query
+            (
+                {"spark_druid_olap_tpu/exec/engine.py": """
+                    import time
+                    import jax
+
+                    def dispatch(self, seg_fn, cols_list, m):
+                        t0 = time.perf_counter()
+                        out = seg_fn(cols_list)
+                        jax.block_until_ready(out)
+                        m.device_ms = (time.perf_counter() - t0) * 1e3
+                        return out
+                """},
+                {"GL1801"},
+            ),
+            # GL1801: method-style sync on the result object, in the
+            # mesh path
+            (
+                {"spark_druid_olap_tpu/parallel/distributed.py": """
+                    def merge(self, run, cols):
+                        state = run(cols)
+                        state.block_until_ready()
+                        return state
+                """},
+                {"GL1801"},
+            ),
+            # GL1802: a free-form datasource label published without the
+            # cardinality guard
+            (
+                {"spark_druid_olap_tpu/obs/registry.py": """
+                    def record_ingest(reg, datasource, rows):
+                        reg.counter(
+                            "x_total", "", labels=("datasource",)
+                        ).labels(datasource=datasource).inc(rows)
+                """},
+                {"GL1802"},
+            ),
+            # GL1802: program family label from a raw variable
+            (
+                {"spark_druid_olap_tpu/obs/prof.py": """
+                    def note(reg, family):
+                        reg.counter(
+                            "x_total", "", labels=("family",)
+                        ).labels(family=family).inc()
+                """},
+                {"GL1802"},
+            ),
+        ],
+        "clean": [
+            # the sampling-gated helper is the one legitimate home of
+            # block_until_ready — obs/ is outside the sync scope
+            {"spark_druid_olap_tpu/obs/prof.py": """
+                import jax
+
+                def dispatch_sync(result, scope):
+                    if scope is None or not scope.sampled:
+                        return result
+                    jax.block_until_ready(result)
+                    return result
+            """},
+            # executors route through the helper; labels ride
+            # bounded_label inline or via a same-function binding
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                import time
+
+                from ..obs import prof
+
+                def dispatch(self, seg_fn, cols_list):
+                    t0 = time.perf_counter()
+                    out = seg_fn(cols_list)
+                    return prof.dispatch_sync(out, t0)
+            """},
+            {"spark_druid_olap_tpu/obs/registry.py": """
+                def record_ingest(reg, bounded_label, datasource, rows):
+                    ds = bounded_label("ingest_datasource", datasource)
+                    reg.counter(
+                        "x_total", "", labels=("datasource", "outcome")
+                    ).labels(datasource=ds, outcome="ok").inc(rows)
+                    reg.counter(
+                        "y_total", "", labels=("site",)
+                    ).labels(
+                        site=bounded_label("site", "engine.loop")
+                    ).inc()
+            """},
+        ],
+    },
 }
 
 

@@ -11,8 +11,8 @@ lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
 collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
 lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
-serving-discipline; GL00x are the core's own: GL001 unparseable file,
-GL002 malformed pragma).
+serving-discipline, GL18xx obs-discipline; GL00x are the core's own:
+GL001 unparseable file, GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -30,6 +30,7 @@ from .jit_cache import JitCachePass
 from .jit_collision import JitCollisionPass
 from .lock_discipline import LockDisciplinePass
 from .lock_order import LockOrderPass
+from .obs_discipline import ObsDisciplinePass
 from .pallas_shape import PallasShapePass
 from .partial_discipline import PartialDisciplinePass
 from .resource_budget import ResourceBudgetPass
@@ -56,6 +57,7 @@ ALL_PASSES = (
     IngestDisciplinePass,
     PartialDisciplinePass,
     ServingDisciplinePass,
+    ObsDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

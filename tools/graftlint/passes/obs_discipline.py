@@ -1,0 +1,142 @@
+"""obs-discipline pass: the performance-attribution contracts (GL18xx,
+ISSUE 9 satellite).
+
+The attribution layer (spark_druid_olap_tpu/obs/prof.py) made two
+promises that rot silently:
+
+* **GL1801 — no bare device syncs in the executors.**  Honest device
+  timing is SAMPLING-GATED: `prof.dispatch_sync`/`fetch_sync`/
+  `transfer_sync` add a `block_until_ready` only on sampled queries, so
+  the default configuration adds ZERO syncs and never destroys the
+  dispatch overlap the executors engineered.  A bare
+  `jax.block_until_ready(...)` (or `<x>.block_until_ready()`) landing
+  directly in exec/ or parallel/ re-introduces an unconditional sync on
+  EVERY query — exactly the overhead the gate exists to prevent — and
+  its measurement bypasses the receipt accounting besides.  Route the
+  timing through the prof helpers.
+* **GL1802 — free-form metric labels must ride `bounded_label`.**  The
+  registry's label-cardinality guard (obs/registry.py) caps the series
+  a client-controlled name stream can mint — but only for values that
+  pass through `bounded_label(...)`.  A `.labels(datasource=name)` /
+  `.labels(family=fam)` / `.labels(site=s)` call whose value is a raw
+  variable skips the guard: a hostile datasource-name-per-request
+  stream then grows the registry without bound.  Flagged unless the
+  value is (a) a direct `bounded_label(...)` call, (b) a name assigned
+  from `bounded_label(...)` earlier in the same function, or (c) a
+  string literal (fixed label sets cannot explode).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from ..core import LintPass, ModuleContext
+
+# label names whose values arrive from outside the process (client
+# datasource names, tagged program families, checkpoint sites) — the
+# free-form set the cardinality guard exists for.  Closed sets (lane,
+# outcome, phase, route, code) are spelled as literals at every call
+# site and need no guard.
+_FREE_LABELS = ("datasource", "family", "site")
+
+
+def _call_short_name(node: ast.AST) -> str:
+    if not isinstance(node, ast.Call):
+        return ""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+class ObsDisciplinePass(LintPass):
+    name = "obs-discipline"
+    default_config = {
+        # GL1801 scope: the executor tree, where a bare sync destroys
+        # engineered dispatch overlap; obs/prof.py (outside this set)
+        # is the one legitimate home of block_until_ready
+        "sync_include": (
+            "spark_druid_olap_tpu/exec/",
+            "spark_druid_olap_tpu/parallel/",
+        ),
+        # GL1802 scope: the whole package publishes metrics
+        "include": ("spark_druid_olap_tpu/",),
+        "free_labels": _FREE_LABELS,
+    }
+
+    # -- GL1801: bare device syncs in executors ------------------------------
+
+    def _in_sync_scope(self, ctx: ModuleContext) -> bool:
+        return any(
+            ctx.relpath.startswith(p)
+            for p in self.config["sync_include"]
+        )
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "block_until_ready"
+            and self._in_sync_scope(ctx)
+        ):
+            self.report(
+                ctx, node, "GL1801",
+                "bare block_until_ready in an executor adds an "
+                "UNCONDITIONAL device sync on every query — honest "
+                "timing must ride the sampling-gated helpers "
+                "(obs.prof.dispatch_sync / fetch_sync / transfer_sync) "
+                "so the default configuration keeps zero added syncs "
+                "and the measurement lands in the cost receipt",
+            )
+        self._check_labels(node, ctx)
+
+    # -- GL1802: free-form labels ride bounded_label -------------------------
+
+    def _bounded_names(self, ctx: ModuleContext) -> Dict[str, bool]:
+        """Names assigned from a bounded_label(...) call anywhere in the
+        enclosing function (order-insensitive on purpose: the guard is a
+        hygiene check, not a dataflow prover — a same-function binding
+        is accepted)."""
+        func = ctx.scope.current_func
+        out: Dict[str, bool] = {}
+        if func is None:
+            return out
+        for sub in ast.walk(func):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if _call_short_name(sub.value) == "bounded_label":
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = True
+        return out
+
+    def _check_labels(self, node: ast.Call, ctx: ModuleContext):
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "labels"):
+            return
+        free = tuple(self.config["free_labels"])
+        bounded = None  # built lazily: most .labels calls have no free kw
+        for kw in node.keywords:
+            if kw.arg not in free:
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                continue  # literal: a fixed label set cannot explode
+            if _call_short_name(v) == "bounded_label":
+                continue  # guarded inline
+            if isinstance(v, ast.Name):
+                if bounded is None:
+                    bounded = self._bounded_names(ctx)
+                if v.id in bounded:
+                    continue  # guarded via a same-function binding
+            self.report(
+                ctx, node, "GL1802",
+                f"free-form metric label {kw.arg!r} does not ride "
+                "bounded_label(...) — a client-controlled name stream "
+                "can then mint unbounded registry series; wrap the "
+                "value (obs.registry.bounded_label) so the cardinality "
+                "guard caps it",
+            )

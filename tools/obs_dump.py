@@ -139,10 +139,87 @@ def render_exemplars(rows: List[Tuple[str, str, str, dict]]) -> str:
     return "\n".join(lines)
 
 
+def _is_receipt(node: Any) -> bool:
+    return (
+        isinstance(node, dict)
+        and "device_ms" in node
+        and "host_ms" in node
+        and "wall_ms" in node
+    )
+
+
+def _find_receipts(doc: Any, label: str = "") -> Iterator[Tuple[str, dict]]:
+    """Yield (label, receipt) for every cost receipt (obs/prof.py) in a
+    document: trace docs carry one under "receipt"; bench details carry
+    one per query."""
+    if isinstance(doc, dict):
+        if _is_receipt(doc):
+            yield label, doc
+            return
+        for k, v in doc.items():
+            sub = f"{label}.{k}" if label else str(k)
+            if isinstance(v, (dict, list)):
+                yield from _find_receipts(v, sub)
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            yield from _find_receipts(v, f"{label}[{i}]")
+
+
+def render_receipts(rows: List[Tuple[str, dict]]) -> str:
+    """Cost receipts as a device/host/transfer attribution table with
+    the cache-tier outcomes alongside."""
+    lines = ["cost receipts (device/host/transfer attribution)"]
+    lines.append(
+        f"{'receipt':<34} {'wall':>9} {'device':>9} {'host':>9} "
+        f"{'xfer':>8} {'unattr':>8} {'cmp':>4}  cache"
+    )
+    for label, rc in rows:
+        cache = rc.get("cache") or {}
+        res = cache.get("residency") or {}
+        bits = []
+        if cache.get("result_cache"):
+            bits.append(f"rc={cache['result_cache']}")
+        if cache.get("fused_batch"):
+            bits.append(f"fused={cache['fused_batch']}")
+        if res:
+            bits.append(f"resid={res.get('hits', 0)}h/{res.get('misses', 0)}m")
+        pch = cache.get("program_cache") or {}
+        if pch:
+            bits.append(
+                "prog="
+                + ",".join(
+                    f"{fam}:{d.get('hits', 0)}h/{d.get('misses', 0)}m"
+                    for fam, d in sorted(pch.items())
+                )
+            )
+        if rc.get("sampled"):
+            bits.append("sampled")
+        name = label or rc.get("query_id", "receipt")
+        lines.append(
+            f"{name[:34]:<34} {rc.get('wall_ms', 0):>8.2f} "
+            f"{rc.get('device_ms', 0):>8.2f} {rc.get('host_ms', 0):>8.2f} "
+            f"{rc.get('transfer_ms', 0):>7.2f} "
+            f"{rc.get('unattributed_ms', 0):>7.2f} "
+            f"{rc.get('compiles', 0):>4}  {' '.join(bits)}".rstrip()
+        )
+    return "\n".join(lines)
+
+
 def dump(doc: Any) -> str:
     out = []
     for label, trace in _find_traces(doc):
         out.append(render_trace(trace, label))
+    # dedupe: bench details carry the same receipt at the query level
+    # AND inside its span_tree — one row each, not two identical ones
+    receipts, seen = [], set()
+    for label, rc in _find_receipts(doc):
+        ident = json.dumps(rc, sort_keys=True, default=str)
+        if ident in seen:
+            continue
+        seen.add(ident)
+        receipts.append((label, rc))
+    if receipts:
+        out.append(render_receipts(receipts))
     exemplars = _find_exemplars(doc)
     if exemplars:
         out.append(render_exemplars(exemplars))
