@@ -2024,6 +2024,158 @@ def bench_hammer(scale: float):
     }
 
 
+def bench_overlap(scale: float):
+    """Transfer-pipeline artifact (ISSUE 10): the pipeline-on vs
+    pipeline-off counterfactual on the two link-bound paths.
+
+    Section A — the STREAMING ROLLUP (the workload the re-anchor note
+    calls link-bound at 45 MB/s): hourly rollup over staged event
+    chunks, identical data both modes, receipts from a forced-sample
+    trace.  With the pipeline on, chunk k+1's h2d issue precedes chunk
+    k's compute dispatch (double buffering) and lands in the receipt's
+    `prefetch` bucket; off, every put is a foreground stall in the
+    `h2d`/transfer bucket.  Section B — SSB-13 scans, programs warm but
+    residency dropped before each measured rep, so every column
+    re-crosses the link: per-query receipts give transfer-stall /
+    prefetch / overlap-efficiency both modes, and pipeline-on frames
+    must be BYTE-identical to pipeline-off (the fold-order contract).
+
+    Headline: mean pipeline-on overlap efficiency across SSB-13
+    (device-busy over device-busy + transfer-stall, ROADMAP direction
+    4's success metric); vs_baseline is the total transfer-stall ratio
+    off/on (how many times less link time sits in front of compute)."""
+    import spark_druid_olap_tpu as sd  # noqa: F401  (bench convention)
+    from spark_druid_olap_tpu.exec.engine import Engine
+    from spark_druid_olap_tpu.exec.streaming import StreamExecutor
+    from spark_druid_olap_tpu.models.aggregations import (
+        Count,
+        DoubleMax,
+        DoubleSum,
+    )
+    from spark_druid_olap_tpu.models.query import TimeseriesQuery
+    from spark_druid_olap_tpu.utils import datagen
+    from spark_druid_olap_tpu.workloads import ssb
+
+    ctx = _calibrated_ctx()
+    # every measured rep must EXECUTE (a result-cache hit moves nothing)
+    ctx.config.result_cache_entries = 0
+
+    # -- section A: streaming rollup -----------------------------------------
+    chunk = 1 << 19
+    n_chunks = max(4, int(round(8 * scale)))
+    tsq = TimeseriesQuery(
+        datasource="events",
+        granularity="hour",
+        aggregations=(
+            Count("n"),
+            DoubleSum("v", "value"),
+            DoubleMax("mx", "latency"),
+        ),
+        intervals=(datagen.event_stream_interval(),),
+    )
+    events = datagen.event_stream_schema()
+    staged = [datagen.gen_event_chunk(i, chunk) for i in range(n_chunks)]
+    warm_sink = 0.0  # touch every staged page before timing (see ssb bench)
+    for c in staged:
+        for a in c.values():
+            warm_sink += float(a.sum())
+    stream = {}
+    stream_frames = {}
+    for pipe_mode in ("off", "on"):
+        eng = Engine()
+        eng._pipeline.enabled = pipe_mode == "on"
+        ex = StreamExecutor(engine=eng)
+        ex.execute(tsq, events, iter(staged[:1]), chunk)  # compile warmup
+        ctx.tracer.force_sample_next()
+        t0 = time.perf_counter()
+        with ctx.tracer.query_trace(query_type="stream_overlap"):
+            stream_frames[pipe_mode] = ex.execute(
+                tsq, events, iter(staged), chunk
+            )
+        wall_s = time.perf_counter() - t0
+        doc = ctx.tracer.last_trace_dict() or {}
+        rc = doc.get("receipt") or {}
+        stream[pipe_mode] = {
+            "wall_s": round(wall_s, 3),
+            "rows": ex.stats.rows,
+            "rows_per_sec": round(ex.stats.rows / max(wall_s, 1e-9)),
+            "pipeline_stages": ex.stats.to_dict(),
+            "transfer_stall_ms": rc.get("transfer_ms"),
+            "prefetch_ms": rc.get("prefetch_ms"),
+            "overlap_efficiency": rc.get("overlap_efficiency"),
+        }
+        _note_partial("stream_%s" % pipe_mode, stream[pipe_mode])
+    stream_identical = stream_frames["on"].equals(stream_frames["off"])
+
+    # -- section B: SSB-13 scans ---------------------------------------------
+    tables = ssb.gen_tables(scale=scale)
+    ssb.register(ctx, tables=tables, rows_per_segment=1 << 17)
+    n_rows = ctx.catalog.get("lineorder").num_rows
+    queries = {}
+    stall_total = {"on": 0.0, "off": 0.0}
+    eff = []
+    identical_all = True
+    frames = {}
+    for name, sql_q in ssb.QUERIES.items():
+        per = {}
+        for pipe_mode in ("off", "on"):
+            ctx.engine._pipeline.enabled = pipe_mode == "on"
+            ctx.sql(sql_q)  # program/lowering warm
+            ctx.engine.drop_residency()  # link re-cold: columns move again
+            rc, wall_ms = _receipt_rep(
+                ctx,
+                lambda n=name, m=pipe_mode, q=sql_q: frames.__setitem__(
+                    (n, m), ctx.sql(q)
+                ),
+            )
+            rc = rc or {}
+            per[pipe_mode] = {
+                "wall_ms": wall_ms,
+                "transfer_stall_ms": rc.get("transfer_ms"),
+                "prefetch_ms": rc.get("prefetch_ms"),
+                "prefetch_bytes": rc.get("prefetch_bytes"),
+                "transfer_bytes": rc.get("transfer_bytes"),
+                "device_ms": rc.get("device_ms"),
+                "overlap_efficiency": rc.get("overlap_efficiency"),
+            }
+            stall_total[pipe_mode] += float(rc.get("transfer_ms") or 0.0)
+        got_on, got_off = frames.pop((name, "on")), frames.pop((name, "off"))
+        per["identical"] = bool(
+            got_on.reset_index(drop=True).equals(
+                got_off.reset_index(drop=True)
+            )
+        )
+        identical_all = identical_all and per["identical"]
+        if per["on"]["overlap_efficiency"] is not None:
+            eff.append(per["on"]["overlap_efficiency"])
+        queries[name] = per
+        _note_partial(name, per)
+    ctx.engine._pipeline.enabled = True
+    mean_eff = sum(eff) / max(1, len(eff))
+    stall_ratio = stall_total["off"] / max(stall_total["on"], 1e-9)
+    return {
+        "metric": "overlap_ssb_sf%g_pipeline_on_efficiency" % scale,
+        "value": round(mean_eff, 4),
+        "unit": "ratio",
+        # how many times less transfer stall sits in front of compute
+        # with the pipeline on (identical data, programs warm both ways)
+        "vs_baseline": round(stall_ratio, 2),
+        "identical": identical_all and stream_identical,
+        "detail": {
+            "rows": n_rows,
+            "stream_rows": stream["on"]["rows"],
+            "transfer_stall_ms_on": round(stall_total["on"], 2),
+            "transfer_stall_ms_off": round(stall_total["off"], 2),
+            "results_identical_on_vs_off": identical_all,
+            "stream_identical_on_vs_off": stream_identical,
+            "streaming_rollup": stream,
+            "queries": queries,
+            "pipeline": ctx.engine._pipeline.to_dict(),
+            "device": _device(),
+        },
+    }
+
+
 def bench_calibrate(rows_log2: int):
     import os
 
@@ -2055,6 +2207,7 @@ MODES = {
     "ingest": (bench_ingest, 2.0),
     "deadline": (bench_deadline, 1.0),
     "hammer": (bench_hammer, 0.1),
+    "overlap": (bench_overlap, 1.0),
     "calibrate": (bench_calibrate, 23),
 }
 

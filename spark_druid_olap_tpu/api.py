@@ -80,6 +80,9 @@ class TPUOlapContext:
         self.config = config or SessionConfig.load_calibrated()
         self.catalog = MetadataCache()
         self.engine = Engine()
+        # overlapped h2d transfer pipeline (exec/pipeline.py, ISSUE 10):
+        # prefetch depth / speculation byte cap / on-off come from config
+        self.engine.configure_pipeline(self.config)
         self._dist_engine = None
         self._last_engine_metrics = None  # metrics of the engine that last ran
         # query-lifecycle resilience (resilience.py): the breaker every

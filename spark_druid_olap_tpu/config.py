@@ -270,6 +270,23 @@ class SessionConfig:
     # that lane's burn rate.  0 disables the burn computation for a lane.
     lane_interactive_slo_ms: float = 250.0
     lane_heavy_slo_ms: float = 30_000.0
+    # -- overlapped h2d transfer pipeline (exec/pipeline.py, ISSUE 10) ------
+    # double-buffered segment streaming: the engine issues async
+    # device placement of the NEXT dispatch batches' cold columns while
+    # the current batch's program runs, and dispatches already-resident
+    # batches first so cold segments stream behind live compute instead
+    # of in front of it.  Results are byte-identical either way (the
+    # partial-state fold order is pinned); False restores fully
+    # synchronous per-batch transfers.
+    transfer_pipeline: bool = True
+    # prefetch lookahead, in dispatch batches
+    prefetch_depth: int = 2
+    # byte cap (MiB) for SPECULATIVE prefetch of next-interval segments
+    # OUTSIDE the query's pruned scope (a dashboard scanning [t0, t1)
+    # usually asks for the adjacent interval next).  0 disables
+    # speculation; in-scope prefetch is unaffected.
+    prefetch_speculative_mb: int = 0
+
     # adaptive micro-batch fusion window (ROADMAP 1(b)): when True the
     # scheduler arms the window from the observed arrival rate — no wait
     # on an idle queue, up to fusion_window_max_ms under bursts — and

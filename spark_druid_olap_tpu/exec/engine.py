@@ -285,11 +285,19 @@ def _platform_unroll_max() -> int:
 _SPARSE_ERROR_PIN_AFTER = 2
 
 
-def _segment_partials(lowering: "GroupByLowering", strategy: str, cols):
+def _segment_partials(
+    lowering: "GroupByLowering", strategy: str, cols, memo=None, share=None
+):
     """Partial-aggregate one segment's columns under one query lowering —
     the traced body shared by the single-query fused program and the
     multi-query fused-batch program (serve/ micro-batch fusion): virtual
     columns, row pipeline, dense partial aggregation, sketch partials.
+
+    `memo`/`share` power the fused-batch common-subexpression dedup
+    (serve/fusion.shared_row_plan, ROADMAP 1(a)): `share` is
+    `(mask_group, gid_group, segment_index)` and `memo` a per-trace dict
+    — members whose filter/dimension sub-lowerings are identical reuse
+    ONE traced mask / gid per segment instead of re-tracing them.
 
     This function runs DURING jit tracing: the sketch-op modules it
     needs are imported at engine module scope (below), never here — a
@@ -297,7 +305,15 @@ def _segment_partials(lowering: "GroupByLowering", strategy: str, cols):
     constants (theta.SENTINEL) as tracers that leak into later traces."""
     la, G = lowering.la, lowering.num_groups
     cols = lowering.add_virtual(dict(cols))  # sketches read virtuals
-    gid, mask, sv, mmv, mmm = lowering.row_arrays(cols)
+    mask0 = gid0 = None
+    if memo is not None and share is not None:
+        mg, gg, j = share
+        mask0 = memo.get(("mask", mg, j))
+        gid0 = memo.get(("gid", gg, j))
+    gid, mask, sv, mmv, mmm = lowering.row_arrays(cols, mask=mask0, gid=gid0)
+    if memo is not None and share is not None:
+        memo.setdefault(("mask", mg, j), mask)
+        memo.setdefault(("gid", gg, j), gid)
     s, mn, mx = partial_aggregate(
         gid, mask, sv, mmv, mmm,
         num_groups=G,
@@ -449,6 +465,14 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # filter literal sets); rebuilding it per execution pays one blocking
         # H2D transfer per constant — the warm-path killer over a tunnel.
         self._lowering_cache = CountBudgetCache(program_cache_entries)
+        # overlapped h2d transfer pipeline (exec/pipeline.py, ISSUE 10):
+        # prefetches the next dispatch batches' cold columns behind the
+        # current batch's compute and orders dispatch resident-first.
+        # TPUOlapContext re-configures it from SessionConfig
+        # (configure_pipeline); a bare Engine() gets the defaults.
+        from .pipeline import TransferPipeline
+
+        self._pipeline = TransferPipeline(self)
 
     @property
     def _m(self):
@@ -489,54 +513,78 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         if evicted:
             prof.record_eviction(ds_name)
 
-    def _device_cols(
-        self, seg: Segment, names, ds_name: str = ""
-    ) -> Dict[str, jnp.ndarray]:
+    def _put_device_col(
+        self, key, host, ds_name: str, prefetched: bool = False
+    ) -> jnp.ndarray:
+        """The ONE sanctioned host->device placement of a segment column
+        into the residency cache (graftlint transfer-discipline/GL19xx):
+        fires the `h2d` fault site, issues the (async) placement,
+        registers residency meta, and records link accounting.  Both the
+        foreground miss path (`_device_cols`) and the transfer
+        pipeline's prefetch issue (exec/pipeline.py) ride it —
+        `prefetched` puts never sync (blocking a prefetch would destroy
+        the overlap it exists to create) and account into the prefetch
+        bucket instead of transfer stall."""
         import time as _time
 
-        cols: Dict[str, jnp.ndarray] = {}
-
-        def put(key, host):
-            fire("h2d")  # fault-injection site: host->device transfer
-            prof.note_residency(hit=False)
-            t0 = _time.perf_counter()
-            arr = jnp.asarray(host)
+        fire("h2d")  # fault-injection site: host->device transfer
+        t0 = _time.perf_counter()
+        arr = jnp.asarray(host)
+        if not prefetched:
             # sampled query: block so the measured window is the real
             # link time, not the enqueue (obs/prof.py; no-op otherwise)
             arr = prof.transfer_sync(arr)
-            dt = _time.perf_counter() - t0
-            nbytes = int(np.asarray(host).nbytes)
-            # residency meta registers BEFORE the cache insert: a
-            # concurrent put() can budget-evict this key the instant it
-            # lands, and on_evict must find the meta to drop — the
-            # reverse order leaked phantom resident bytes
-            self._note_resident_add(key, ds_name or "unknown", nbytes)
-            self._device_cache[key] = arr
-            # link-utilization accounting: bytes + effective MB/s into
-            # the scrapeable histogram (the 45 MB/s h2d floor claim)
-            prof.record_h2d(nbytes, dt)
-            if self._m is not None:  # streamed-bytes metric (cache misses only)
-                self._m.h2d_bytes += nbytes
-                self._m.h2d_ms += dt * 1e3
-            return arr
+        dt = _time.perf_counter() - t0
+        nbytes = int(np.asarray(host).nbytes)
+        # residency meta registers BEFORE the cache insert: a
+        # concurrent put can budget-evict this key the instant it
+        # lands, and on_evict must find the meta to drop — the
+        # reverse order leaked phantom resident bytes
+        self._note_resident_add(key, ds_name or "unknown", nbytes)
+        self._device_cache[key] = arr
+        # a successful landing supersedes any STALE poison for this key
+        # (a failed prefetch whose owning query was truncated before
+        # consuming it must not resurface on a future cache miss)
+        self._pipeline.clear_poison(key)
+        # link-utilization accounting: bytes + effective MB/s into
+        # the scrapeable histogram (the 45 MB/s h2d floor claim)
+        prof.record_h2d(nbytes, dt, prefetched=prefetched)
+        if self._m is not None:  # streamed-bytes metric (cache misses only)
+            self._m.h2d_bytes += nbytes
+            self._m.h2d_ms += dt * 1e3
+        return arr
+
+    def configure_pipeline(self, config) -> None:
+        """Apply SessionConfig's transfer-pipeline knobs (api context)."""
+        self._pipeline.configure(config)
+
+    def _device_cols(
+        self, seg: Segment, names, ds_name: str = ""
+    ) -> Dict[str, jnp.ndarray]:
+        cols: Dict[str, jnp.ndarray] = {}
+
+        def lookup(key, host_fn):
+            arr = self._device_cache.get(key)
+            if arr is not None:
+                prof.note_residency(hit=True)
+                return arr
+            # a prefetched put that FAILED (injected h2d fault, real
+            # backend error) poisoned this key: re-raise in query
+            # context so the retry/breaker machinery sees the failure
+            # exactly as if the foreground transfer had raised
+            exc = self._pipeline.take_poison(key)
+            if exc is not None:
+                raise exc
+            prof.note_residency(hit=False)
+            return self._put_device_col(key, host_fn(), ds_name)
 
         # "col"/"valid" tags: a user column literally named "__valid"
         # must not alias the validity-mask entry (jit-collision/GL1301)
         for n in names:
-            key = (seg.uid, "col", n)
-            arr = self._device_cache.get(key)
-            if arr is not None:
-                prof.note_residency(hit=True)
-                cols[n] = arr
-            else:
-                cols[n] = put(key, seg.column(n))
-        key = (seg.uid, "valid")
-        arr = self._device_cache.get(key)
-        if arr is not None:
-            prof.note_residency(hit=True)
-            cols["__valid"] = arr
-        else:
-            cols["__valid"] = put(key, seg.valid)
+            cols[n] = lookup(
+                (seg.uid, "col", n), lambda n=n: seg.column(n)
+            )
+        cols["__valid"] = lookup((seg.uid, "valid"), lambda: seg.valid)
         return cols
 
     def bytes_resident(self) -> int:
@@ -573,12 +621,25 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         for name in dropped:
             prof.record_resident(name, 0)
 
+    def drop_residency(self) -> None:
+        """Evict EVERY device-resident segment column while keeping the
+        compiled programs and lowerings (unlike `clear_cache`) and
+        WITHOUT retiring uids (unlike `evict_segments` — the segments
+        stay live and prefetchable).  The overlap bench uses it to
+        re-cold the link between pipeline-on/off counterfactual runs."""
+        for k in list(self._device_cache):
+            self._device_cache.pop(k)
+            self._note_resident_drop(k)
+
     def evict_segments(self, uids) -> None:
         """Drop device residency of specific segments — the ingestion
         tier's hook: compaction (and dictionary-extension remaps) retire
         segment uids from the published set, and their HBM should come
         back immediately rather than waiting for LRU pressure."""
         uids = set(uids)
+        # queued-but-unissued prefetches for these uids must never land:
+        # a put issued after this evict would re-resident a dead segment
+        self._pipeline.note_retired(uids)
         for k in [k for k in self._device_cache if k[0] in uids]:
             self._device_cache.pop(k)
             self._note_resident_drop(k)
@@ -699,28 +760,57 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             q, ds, lowering, key_extra=key_extra,
             strategy_override=strategy_override,
         )
-        for bi, batch in enumerate(self._segment_batches(segs, need)):
-            # cooperative deadline checkpoint: a query with a wall-clock
-            # budget cancels between batch dispatches, not at the very
-            # end — and with a partial collector armed, expiry STOPS the
-            # dispatch loop instead of erroring (the partials accumulated
-            # so far merge into a best-effort answer)
-            if checkpoint_partial("engine.segment_loop"):
-                break
-            with span(SPAN_H2D, batch=bi, segments=len(batch)):
-                cols_list = [
-                    self._cols_for_segment(seg, ds, need) for seg in batch
-                ]
-            with span(SPAN_SEGMENT_DISPATCH, batch=bi, segments=len(batch)):
-                (s, mn, mx, sk), seg_fn = self._call_segment_program(
-                    q, ds, lowering, seg_fn, cols_list, key_extra=key_extra
-                )
+
+        def fold(st):
+            nonlocal sums, mins, maxs
+            s, mn, mx, sk = st
             sums = s if sums is None else sums + s
             mins = mn if mins is None else jnp.minimum(mins, mn)
             maxs = mx if maxs is None else jnp.maximum(maxs, mx)
             _merge_sketch_states(la, sketch_states, sk)
+
+        batches = list(self._segment_batches(segs, need))
+        # transfer pipeline (exec/pipeline.py): residency-aware dispatch
+        # order + async prefetch of the next batches' cold columns behind
+        # this batch's compute; speculative next-interval segments trail
+        # the plan under their own byte cap.  CanonicalFold pins the
+        # merge order to canonical batch order (f32 partial sums and the
+        # sketch scatter merges are not reassociation-safe) so
+        # pipeline-on results stay byte-identical to pipeline-off.
+        from .pipeline import CanonicalFold
+
+        run = self._pipeline.start(
+            ds, batches, need,
+            speculative=self._pipeline.speculative_candidates(q, ds, segs),
+        )
+        folder = CanonicalFold(fold)
+        for pos, bi in enumerate(run.order):
+            # cooperative deadline checkpoint: a query with a wall-clock
+            # budget cancels between batch dispatches, not at the very
+            # end — and with a partial collector armed, expiry STOPS the
+            # dispatch loop instead of erroring (the partials accumulated
+            # so far merge into a best-effort answer).  Any pending
+            # prefetch cancels with it.
+            if checkpoint_partial("engine.segment_loop"):
+                run.cancel()
+                break
+            batch = batches[bi]
+            with span(SPAN_H2D, batch=bi, segments=len(batch)):
+                cols_list = [
+                    self._cols_for_segment(seg, ds, need) for seg in batch
+                ]
+            run.advance(pos)
+            with span(SPAN_SEGMENT_DISPATCH, batch=bi, segments=len(batch)):
+                (s, mn, mx, sk), seg_fn = self._call_segment_program(
+                    q, ds, lowering, seg_fn, cols_list, key_extra=key_extra
+                )
+            folder.add(bi, (s, mn, mx, sk))
             if pc is not None:
                 pc.add_seen(len(batch), *_row_counts(batch))
+        # a truncation can leave batches dispatched AHEAD of canonical
+        # order un-folded: drain them (still canonical) so every batch
+        # pc accounted merges
+        folder.drain()
         if sums is None:
             # the deadline expired before the FIRST batch dispatched: the
             # well-formed zero-coverage answer is the empty partial state
@@ -959,14 +1049,42 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         self._m = batch_m
         acc: List[Any] = [None] * n
         acc_sk: List[Dict[str, Any]] = [{} for _ in range(n)]
+
+        def fold(outs):
+            for i, (s, mn, mx, sk) in enumerate(outs):
+                if s is None:
+                    continue
+                if acc[i] is None:
+                    acc[i] = (s, mn, mx)
+                else:
+                    ps, pmn, pmx = acc[i]
+                    acc[i] = (
+                        ps + s,
+                        jnp.minimum(pmn, mn),
+                        jnp.maximum(pmx, mx),
+                    )
+                _merge_sketch_states(members[i][3].la, acc_sk[i], sk)
+
         try:
-            for bi, batch in enumerate(self._segment_batches(
-                union_segs, list(names)
-            )):
+            from .pipeline import CanonicalFold
+
+            batches = list(self._segment_batches(union_segs, list(names)))
+            # transfer pipeline: resident batches dispatch first, cold
+            # batches' columns stream behind the fused compute; the
+            # per-member fold stays pinned to canonical batch order
+            # (byte-identical to the serial path)
+            run = self._pipeline.start(ds, batches, list(names))
+            folder = CanonicalFold(fold)
+            for pos, bi in enumerate(run.order):
                 # deadline checkpoint between fused batch dispatches; an
                 # expiry here surfaces to the scheduler, which re-routes
                 # every member to its own serial (partial-capable) path
-                checkpoint("engine.fused_loop")
+                try:
+                    checkpoint("engine.fused_loop")
+                except BaseException:
+                    run.cancel()
+                    raise
+                batch = batches[bi]
                 sel = tuple(
                     tuple(
                         j
@@ -980,6 +1098,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                         self._cols_for_segment(seg, ds, list(names))
                         for seg in batch
                     ]
+                run.advance(pos)
                 fn = self._fused_program(members, ds, strategies, sel)
                 with span(
                     SPAN_SEGMENT_DISPATCH, batch=bi, segments=len(batch),
@@ -999,19 +1118,8 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                             (_time.perf_counter() - t_c) * 1e3
                         )
                         prof.note_compile(batch_m.compile_ms)
-                for i, (s, mn, mx, sk) in enumerate(outs):
-                    if s is None:
-                        continue
-                    if acc[i] is None:
-                        acc[i] = (s, mn, mx)
-                    else:
-                        ps, pmn, pmx = acc[i]
-                        acc[i] = (
-                            ps + s,
-                            jnp.minimum(pmn, mn),
-                            jnp.maximum(pmx, mx),
-                        )
-                    _merge_sketch_states(members[i][3].la, acc_sk[i], sk)
+                folder.add(bi, outs)
+            folder.drain()
         finally:
             self._m = None
         # members whose whole scope was pruned hold no accumulated state:
@@ -1104,17 +1212,29 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             return cached
         prof.note_program_cache("fused-batch", hit=False)
         fire("compile")  # fault-injection site: new program build
+        # common-subexpression plan over the member lowerings (ROADMAP
+        # 1(a)): members sharing filter/dimension sub-lowerings reuse one
+        # traced mask/gid per segment inside the program.  A pure
+        # function of the member JSONs already serialized into the key,
+        # so it is computed ONLY on a miss (the hot serving path's cache
+        # hits skip the per-member to_druid + json passes).  Lazy
+        # import: serve/ imports exec/ at module load, not the reverse.
+        from ..serve.fusion import shared_row_plan
+
+        share = shared_row_plan([m[1] for m in members])
         lowerings = [m[3] for m in members]
 
         @jax.jit
         def fused_fn(cols_list):
             outs = []
+            memo: Dict[Any, Any] = {}  # per-trace CSE memo (mask/gid)
             for i, lowering in enumerate(lowerings):
                 sums = mins = maxs = None
                 sk: Dict[str, Any] = {}
                 for j in sel[i]:
                     s, mn, mx, skj = _segment_partials(
-                        lowering, strategies[i], cols_list[j]
+                        lowering, strategies[i], cols_list[j],
+                        memo=memo, share=share[i] + (j,),
                     )
                     sums = s if sums is None else sums + s
                     mins = mn if mins is None else jnp.minimum(mins, mn)
@@ -1624,13 +1744,21 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         if pc is not None:
             pc.begin_pass()
             pc.add_scope(len(scan_segs), *_row_counts(scan_segs))
-        for seg in scan_segs:
+        # prefetch-only pipeline (reorder=False): scan row order is part
+        # of the result contract, so dispatch order stays canonical and
+        # only the NEXT segments' columns stream behind the current fetch
+        run = self._pipeline.start(
+            ds, [[s] for s in scan_segs], list(need), reorder=False
+        )
+        for pos, seg in enumerate(scan_segs):
             # partial-aware checkpoint: a scan past its deadline returns
             # the rows fetched so far (a row subset IS the scan's natural
             # partial) with a coverage fraction
             if checkpoint_partial("engine.scan_loop"):
+                run.cancel()
                 break
             cols = self._device_cols(seg, need, ds_name=ds.name)
+            run.advance(pos)
             if ds.time_column and ds.time_column in cols:
                 cols["__time"] = cols[ds.time_column]
             for name, fn in vcol_fns.items():
@@ -1671,6 +1799,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             if pc is not None:
                 pc.add_seen(1, *_row_counts((seg,)))
             if remaining is not None and remaining <= 0:
+                run.cancel()  # LIMIT satisfied: stop prefetch issue too
                 break
         out = (
             pd.concat(frames, ignore_index=True)
@@ -1805,10 +1934,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                     im |= (t >= a) & (t < b)
                 base = base & im
             if fmask_fn is not None:
-                cols = {
-                    n: jnp.asarray(seg.column(n))
-                    for n in _filter_columns(q.filter)
-                }
+                # ride the residency cache (transfer-discipline/GL19xx):
+                # repeated searches hit instead of re-moving the filter
+                # columns every time
+                cols = self._device_cols(
+                    seg, list(_filter_columns(q.filter)), ds_name=ds.name
+                )
                 base = base & np.asarray(fmask_fn(cols))
             for dim in live_dims:
                 sel = np.asarray(seg.dims[dim])[base]
@@ -1918,8 +2049,13 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             if segs:
                 seg_fn = self._segment_program(inner, ds, lowering)
                 batches = list(self._segment_batches(segs, need))
+                # prefetch-only (reorder=False): the refinement sequence
+                # is user-visible, so batches dispatch in canonical order
+                # while the next batches' columns stream behind compute
+                run = self._pipeline.start(ds, batches, need, reorder=False)
                 for bi, batch in enumerate(batches):
                     if checkpoint_partial("engine.progressive_loop"):
+                        run.cancel()
                         truncated = True
                         break
                     with span(SPAN_H2D, batch=bi, segments=len(batch)):
@@ -1927,6 +2063,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                             self._cols_for_segment(seg, ds, need)
                             for seg in batch
                         ]
+                    run.advance(bi)
                     with span(
                         SPAN_SEGMENT_DISPATCH, batch=bi,
                         segments=len(batch),

@@ -409,20 +409,34 @@ class GroupByLowering:
             mask = mask & self.filter_fn(cols)
         return mask
 
-    def row_arrays(self, cols: Dict[str, jnp.ndarray]):
+    def row_arrays(
+        self,
+        cols: Dict[str, jnp.ndarray],
+        mask: Optional[jnp.ndarray] = None,
+        gid: Optional[jnp.ndarray] = None,
+    ):
         """cols: name -> row-aligned device array (must include "__valid",
         and "__time" when the query touches time).  Returns the kernel ABI
-        tuple for ops/groupby.py."""
+        tuple for ops/groupby.py.
+
+        `mask`/`gid` accept PRECOMPUTED row pipelines: the fused-batch
+        common-subexpression pass (serve/fusion.shared_row_plan) computes
+        the filter mask / group-id pipeline once per segment for members
+        whose (virtualColumns, filter, intervals) / (virtualColumns,
+        dimensions) sub-lowerings are identical, instead of re-tracing
+        them per member inside the fused program."""
         cols = dict(cols)
         self.add_virtual(cols)
-        mask = self.row_mask(cols)
+        if mask is None:
+            mask = self.row_mask(cols)
         la = self.la
-        gid, _ = combine_group_ids(
-            [d.codes_fn(cols) for d in self.dims],
-            [d.cardinality for d in self.dims],
-        )
-        if not self.dims:
-            gid = jnp.zeros(mask.shape, jnp.int32)
+        if gid is None:
+            gid, _ = combine_group_ids(
+                [d.codes_fn(cols) for d in self.dims],
+                [d.cardinality for d in self.dims],
+            )
+            if not self.dims:
+                gid = jnp.zeros(mask.shape, jnp.int32)
         R = mask.shape[0]
         maskf = mask.astype(jnp.float32)
         sum_cols = []

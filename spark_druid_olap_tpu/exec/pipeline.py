@@ -1,0 +1,464 @@
+"""Overlapped host->device transfer pipeline (ISSUE 10 tentpole).
+
+The re-anchor numbers name the problem: the 25M-row streaming rollup is
+LINK-bound (0.24x vs pandas at 45 MB/s h2d) and every single-device
+executor still moved cold segment columns synchronously inside its
+dispatch loop — transfer time serialized IN FRONT of compute instead of
+streaming behind it.  This module is the one sanctioned home of segment
+h2d issue (graftlint transfer-discipline/GL19xx):
+
+* **Prefetch plan** — `TransferPipeline.start` takes the dispatch
+  batches the planner's interval/zone-map pruning produced (in-scope
+  segments, dispatch order, head of the queue) plus optional SPECULATIVE
+  next-interval segments that trail it under a separate byte cap
+  (`SessionConfig.prefetch_speculative_mb`).  After each batch's columns
+  are consumed, `PlanRun.advance` issues **async `jnp.asarray` puts**
+  for the next `prefetch_depth` batches' missing columns — JAX's async
+  dispatch queue runs those transfers while the current batch's
+  partial-aggregation program occupies the device.
+* **Residency-aware dispatch order** — `PlanRun.order` runs
+  already-resident batches first so cold batches stream BEHIND live
+  compute instead of in front of it.  Reordering happens within bounded
+  windows (`2*depth`, floor 4) so executors that fold partial states in
+  canonical batch order (float32 sums are not reassociation-safe; the
+  fold order is pinned for byte-identical results) hold at most a
+  window's worth of un-folded states.
+* **Lifecycle discipline** — the pipeline respects the existing
+  machinery end to end: prefetched entries land in the engine's
+  byte-budgeted residency cache (evictable, never exceeding the cap;
+  residency meta registers before the insert so a racing budget
+  eviction of a just-landed prefetch cannot leak phantom resident
+  bytes), `resilience.prefetch_pressed()` stops issue cleanly on
+  deadline expiry / partial drain (the owning loop's checkpoint stays
+  where the expiry surfaces), segments retired by append/compaction
+  (`TransferPipeline.note_retired`) are skipped, and the existing `h2d`
+  fault-injection site fires per prefetched put — an injected (or real)
+  prefetch failure is POISONED per key and re-raised when the query
+  consumes that column, so it reaches the retry/breaker machinery in
+  query context exactly like a foreground transfer failure.
+* **Attribution** — prefetched puts record into the `prefetch` span and
+  `ProfScope.prefetch_ms/bytes` (obs/prof.py), never into transfer
+  stall: the cost receipt's `overlap_efficiency` = device-busy /
+  (device-busy + transfer-stall) counts only foreground h2d waits,
+  which is the metric ROADMAP direction 4 defines as success.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import SPAN_PREFETCH, prof, span
+from ..resilience import fire, prefetch_pressed
+from ..utils.log import get_logger
+
+log = get_logger("exec.pipeline")
+
+# default prefetch lookahead, in dispatch batches
+DEFAULT_DEPTH = 2
+
+# the PlanRun whose prefetches THIS context is consuming.  Poisoned
+# prefetch failures are scoped to their owning run: the run that issued
+# a failed put is the one whose consume re-raises it (same thread, same
+# executor loop), and a run abandoned mid-flight (deadline truncation,
+# scan LIMIT) takes its poisons with it — a later query's cache miss
+# attempts a FRESH transfer instead of inheriting a stale failure.
+_active_run: contextvars.ContextVar[Optional["PlanRun"]] = (
+    contextvars.ContextVar("sdol_active_plan_run", default=None)
+)
+
+
+class CanonicalFold:
+    """Drain per-batch results into `fold` in CANONICAL batch order
+    while the pipeline dispatches them residency-first.  Partial-state
+    merges are not reassociation-safe (f32 sums, scatter-based
+    sparse/sketch merges), so the fold order is pinned — this is what
+    keeps pipeline-on results byte-identical to pipeline-off.  Shared
+    by the dense, fused, and sparse loops."""
+
+    __slots__ = ("_fold", "_pending", "_next")
+
+    def __init__(self, fold):
+        self._fold = fold
+        self._pending: Dict[int, Any] = {}
+        self._next = 0
+
+    def add(self, bi: int, value) -> None:
+        self._pending[bi] = value
+        while self._next in self._pending:
+            self._fold(self._pending.pop(self._next))
+            self._next += 1
+
+    def drain(self) -> None:
+        """Fold whatever was dispatched AHEAD of canonical order before
+        a truncation (or the loop end) — still in canonical order."""
+        for bi in sorted(self._pending):
+            self._fold(self._pending.pop(bi))
+
+
+def pipelined_put(host, sharding=None, prefetched: bool = True):
+    """One sanctioned async host->device placement OUTSIDE the engine's
+    residency cache (the streaming executor's chunk path): fires the
+    `h2d` fault site, issues the (async) `jax.device_put`, and records
+    link accounting.  Returns the device array immediately — callers
+    that need the honest link time on a sampled query wrap the result
+    in `prof.transfer_sync` themselves."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    fire("h2d")
+    t0 = _time.perf_counter()
+    arr = jax.device_put(host, sharding)
+    if not prefetched:
+        # sampled query: block so a FOREGROUND put's measured window is
+        # the real link time, not the async enqueue — without this the
+        # pipeline-off counterfactual under-reports its own stall
+        # (obs/prof.py; a strict no-op at the default sample rate)
+        arr = prof.transfer_sync(arr)
+    dt = _time.perf_counter() - t0
+    nbytes = int(np.asarray(host).nbytes) if hasattr(host, "nbytes") else 0
+    prof.record_h2d(nbytes, dt, prefetched=prefetched)
+    return arr, dt, nbytes
+
+
+def _batch_keys(batch, names) -> List[Tuple]:
+    """Residency-cache keys one dispatch batch needs: per-segment column
+    entries plus the validity buffer — the SAME tagged scheme
+    `Engine._device_cols` owns (jit-collision/GL1301)."""
+    keys = []
+    for seg in batch:
+        for n in names:
+            keys.append((seg.uid, "col", n))
+        keys.append((seg.uid, "valid"))
+    return keys
+
+
+class PlanRun:
+    """One execution's prefetch plan: dispatch order + issue cursor.
+
+    Confined to the executing thread (one PlanRun per executor loop);
+    only the pipeline-level retire/poison state is shared."""
+
+    __slots__ = (
+        "pipeline", "engine", "ds", "batches", "names", "order",
+        "speculative", "poison", "_issued", "_cancelled", "_spec_budget",
+    )
+
+    def __init__(
+        self, pipeline, ds, batches, names, speculative=(), reorder=True
+    ):
+        self.pipeline = pipeline
+        self.engine = pipeline.engine
+        self.ds = ds
+        self.batches = list(batches)
+        self.names = list(names)
+        self.speculative = list(speculative)
+        # key -> exception of a FAILED prefetch put, re-raised when THIS
+        # run consumes the key (run-scoped: an abandoned run's poisons
+        # die with it — see _active_run)
+        self.poison: Dict[Tuple, BaseException] = {}
+        self._issued = 0  # dispatch positions whose prefetch was issued
+        self._cancelled = False
+        self._spec_budget = int(pipeline.speculative_bytes)
+        self.order = (
+            self._order() if reorder else list(range(len(self.batches)))
+        )
+        _active_run.set(self)
+
+    # -- residency-aware dispatch order --------------------------------------
+
+    def _resident_fraction(self, bi: int) -> float:
+        cache = self.engine._device_cache
+        keys = _batch_keys(self.batches[bi], self.names)
+        if not keys:
+            return 1.0
+        return sum(1 for k in keys if k in cache) / len(keys)
+
+    def _order(self) -> List[int]:
+        n = len(self.batches)
+        if not self.pipeline.enabled or n <= 1:
+            return list(range(n))
+        # windowed resident-first partition: within each window of
+        # 2*depth batches (floor 4), batches sort by resident fraction
+        # (stable: ties keep canonical order).  Bounded windows cap how
+        # many out-of-canonical-order partial states the caller's
+        # pinned-order fold has to hold live.
+        w = max(2 * self.pipeline.depth, 4)
+        order: List[int] = []
+        for w0 in range(0, n, w):
+            idx = list(range(w0, min(w0 + w, n)))
+            idx.sort(key=lambda i: -self._resident_fraction(i))
+            order.extend(idx)
+        return order
+
+    # -- prefetch issue -------------------------------------------------------
+
+    def _missing_entries(self, batch):
+        cache = self.engine._device_cache
+        retired = self.pipeline._retired
+        out = []
+        for seg in batch:
+            if seg.uid in retired:
+                # append/compaction retired this uid between plan build
+                # and issue: prefetching it would re-resident a dead
+                # segment the evict hook just dropped
+                self.pipeline.skipped_retired += 1
+                continue
+            for n in self.names:
+                key = (seg.uid, "col", n)
+                if key not in cache:
+                    out.append((key, seg, n))
+            key = (seg.uid, "valid")
+            if key not in cache:
+                out.append((key, seg, None))
+        return out
+
+    def _issue(self, entries, speculative: bool = False) -> int:
+        """Issue async puts for missing (key, seg, col) entries.  Returns
+        bytes issued.  A failing put (injected h2d fault, real backend
+        error) poisons its key: the failure re-raises in query context
+        when `_device_cols` consumes that column."""
+        eng = self.engine
+        issued_bytes = 0
+        for key, seg, n in entries:
+            if self._cancelled or prefetch_pressed():
+                self._cancelled = True
+                self.pipeline.cancelled += 1
+                break
+            host = seg.valid if n is None else seg.column(n)
+            if speculative and (
+                issued_bytes + int(host.nbytes) > self._spec_budget
+            ):
+                # per-entry PRE-check: one oversized column must not
+                # blow past the configured speculation cap
+                break
+            try:
+                eng._put_device_col(
+                    key, host, self.ds.name, prefetched=True
+                )
+            except BaseException as exc:  # fault-ok: re-raised at consume
+                self.poison[key] = exc
+                continue
+            issued_bytes += int(host.nbytes)
+            self.pipeline.issued += 1
+            if speculative:
+                self.pipeline.speculative_issued += 1
+        return issued_bytes
+
+    def advance(self, pos: int) -> None:
+        """Called by the executor right after batch at dispatch position
+        `pos` had its columns consumed (and before its program
+        dispatches): issue prefetch for the next `depth` batches in
+        dispatch order, then — once the whole plan is issued — the
+        speculative tail under its byte cap."""
+        if not self.pipeline.enabled or self._cancelled:
+            return
+        hi = min(len(self.order), pos + 1 + self.pipeline.depth)
+        work = []
+        while self._issued < hi:
+            # positions <= pos were consumed by the foreground loop
+            # already; issuing them would be a wasted pass over the cache
+            if self._issued > pos:
+                work.extend(
+                    self._missing_entries(
+                        self.batches[self.order[self._issued]]
+                    )
+                )
+            self._issued += 1
+        spec = []
+        if (
+            self._issued >= len(self.order)
+            and self.speculative
+            and self._spec_budget > 0
+        ):
+            spec = self._missing_entries(self.speculative)
+            self.speculative = []
+        if not work and not spec:
+            return
+        with span(
+            SPAN_PREFETCH, entries=len(work) + len(spec),
+            speculative=len(spec),
+        ):
+            self._issue(work)
+            if spec and not self._cancelled:
+                self._spec_budget -= self._issue(spec, speculative=True)
+
+    def cancel(self) -> None:
+        """Stop issuing (deadline expiry, partial drain, executor error).
+        Already-issued async puts are just residency-cache entries — the
+        byte budget evicts them like any other cold column."""
+        if not self._cancelled:
+            self._cancelled = True
+            self.pipeline.cancelled += 1
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+
+class _NullRun:
+    """Plan for a disabled pipeline: canonical order, no-op advance —
+    the executor loops stay shape-identical either way."""
+
+    __slots__ = ("order",)
+
+    def __init__(self, n: int):
+        self.order = list(range(n))
+
+    def advance(self, pos: int) -> None:
+        pass
+
+    def cancel(self) -> None:
+        pass
+
+    @property
+    def cancelled(self) -> bool:
+        return False
+
+
+class TransferPipeline:
+    """Per-engine prefetch state shared across executions: the retired
+    uid set (append/compaction), poisoned prefetch keys, and counters.
+    PlanRuns are per-execution and thread-confined."""
+
+    # retired-uid memory bound: uids are process-unique (never
+    # re-published), so entries only matter while a PlanRun built before
+    # the retirement is still running — far less than this.  Without a
+    # bound, weeks of compaction churn grow the set forever.
+    RETIRED_CAP = 16384
+
+    def __init__(
+        self,
+        engine,
+        enabled: bool = True,
+        depth: int = DEFAULT_DEPTH,
+        speculative_bytes: int = 0,
+    ):
+        from collections import OrderedDict
+
+        self.engine = engine
+        self.enabled = bool(enabled)
+        self.depth = max(1, int(depth))
+        self.speculative_bytes = max(0, int(speculative_bytes))
+        self._lock = threading.Lock()
+        # insertion-ordered so the bound evicts the OLDEST retirements
+        self._retired: "OrderedDict[Any, None]" = OrderedDict()
+        # counters (tests + /status introspection)
+        self.issued = 0
+        self.speculative_issued = 0
+        self.skipped_retired = 0
+        self.cancelled = 0
+
+    def configure(self, config) -> None:
+        """Apply SessionConfig knobs (api.TPUOlapContext wires this)."""
+        self.enabled = bool(getattr(config, "transfer_pipeline", True))
+        self.depth = max(1, int(getattr(config, "prefetch_depth", DEFAULT_DEPTH)))
+        self.speculative_bytes = max(
+            0, int(getattr(config, "prefetch_speculative_mb", 0)) << 20
+        )
+
+    # -- lifecycle hooks ------------------------------------------------------
+
+    def note_retired(self, uids) -> None:
+        """Append/compaction retired these segment uids: queued (not yet
+        issued) prefetches for them must never land — a prefetch issued
+        after the evict would re-resident a dead segment."""
+        with self._lock:
+            for u in uids:
+                self._retired[u] = None
+            while len(self._retired) > self.RETIRED_CAP:
+                self._retired.popitem(last=False)
+
+    def clear_poison(self, key) -> None:
+        """A put for `key` landed successfully: any failure the ACTIVE
+        run recorded for it is superseded.  Engine._put_device_col calls
+        this on every landing."""
+        run = _active_run.get()
+        if run is not None and run.poison:
+            run.poison.pop(key, None)
+
+    def take_poison(self, key) -> Optional[BaseException]:
+        """Pop the failure the ACTIVE run's prefetch recorded for `key`,
+        if any — `Engine._device_cols` re-raises it in query context so
+        injected h2d faults keep reaching the retry/breaker machinery
+        with the pipeline on.  Run-scoped on purpose: a run abandoned
+        before consuming its failed prefetch (deadline truncation, scan
+        LIMIT) must not leak that failure into a LATER query's cache
+        miss — the later query just attempts a fresh transfer.  Runs are
+        context-confined (one executor loop each), so no lock."""
+        run = _active_run.get()
+        if run is None or run.cancelled or not run.poison:
+            # a CANCELLED run is draining/abandoned: its unconsumed
+            # prefetch failures are moot (nothing depends on those
+            # columns anymore) and must not fail a later consumer
+            return None
+        return run.poison.pop(key, None)
+
+    # -- plan construction ----------------------------------------------------
+
+    def start(
+        self,
+        ds,
+        batches: Sequence,
+        names: Sequence[str],
+        speculative: Sequence = (),
+        reorder: bool = True,
+    ):
+        """Build one execution's PlanRun over already-batched segments.
+        `speculative` are out-of-scope (next-interval) segments that
+        trail the plan under the speculative byte cap.  `reorder=False`
+        pins the dispatch order to canonical (scan row order and
+        progressive refinement sequence are user-visible; only prefetch
+        applies there)."""
+        if not self.enabled:
+            # clear the active-run slot too: a PREVIOUS execution's
+            # abandoned run (and its poisons) must not be consultable
+            # from this pipeline-off execution's cache misses
+            _active_run.set(None)
+            return _NullRun(len(batches))
+        return PlanRun(
+            self, ds, batches, names,
+            speculative=speculative if self.speculative_bytes else (),
+            reorder=reorder,
+        )
+
+    def speculative_candidates(self, q, ds, segs_in_scope) -> List:
+        """Out-of-scope segments worth speculating on, next-interval
+        first: a dashboard that just scanned [t0, t1) most often asks
+        for the adjacent interval next, so segments starting at or after
+        the scope's end head the tail (then the rest in catalog order).
+        Empty when speculation is disabled or the scope is unpruned."""
+        if not self.enabled or not self.speculative_bytes:
+            return []
+        in_scope = {s.uid for s in segs_in_scope}
+        rest = [s for s in ds.segments if s.uid not in in_scope]
+        if not rest:
+            return []
+        scope_end = max(
+            (s.interval[1] for s in segs_in_scope if s.interval is not None),
+            default=None,
+        )
+        if scope_end is None:
+            return rest
+        return sorted(
+            rest,
+            key=lambda s: (
+                0 if s.interval is not None and s.interval[0] >= scope_end
+                else 1,
+                s.interval[0] if s.interval is not None else 0,
+            ),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "depth": self.depth,
+            "speculative_bytes": self.speculative_bytes,
+            "issued": self.issued,
+            "speculative_issued": self.speculative_issued,
+            "skipped_retired": self.skipped_retired,
+            "cancelled": self.cancelled,
+        }

@@ -179,16 +179,37 @@ class SparseExecMixin:
                 pc.begin_pass()
                 pc.add_scope(len(segs), *_row_counts(segs))
             state = None
-            for bi, batch in enumerate(
-                self._segment_batches(segs, lowering.columns)
-            ):
+            from .pipeline import CanonicalFold
+
+            batches = list(self._segment_batches(segs, lowering.columns))
+            # transfer pipeline (exec/pipeline.py): resident batches
+            # dispatch first, the next cold batches' columns stream
+            # behind the sparse compute.  The merge below is a scatter
+            # (order-sensitive in float), so CanonicalFold pins it to
+            # canonical batch order regardless of dispatch order —
+            # pipeline-on stays byte-identical to pipeline-off.
+            run = self._pipeline.start(ds, batches, lowering.columns)
+
+            def fold_one(st):
+                nonlocal state
+                state = (
+                    st
+                    if state is None
+                    else merge_sparse_states(state, st, num_groups=G)
+                )
+
+            folder = CanonicalFold(fold_one)
+            for pos, bi in enumerate(run.order):
                 # cooperative deadline checkpoint between batch
                 # dispatches — same lifecycle contract as the dense
                 # engine's segment loop (checkpoint-coverage/GL901);
                 # with a partial collector armed, expiry stops the loop
-                # and the merged sparse state so far becomes the answer
+                # (and any pending prefetch) and the merged sparse state
+                # so far becomes the answer
                 if checkpoint_partial("sparse.segment_loop"):
+                    run.cancel()
                     break
+                batch = batches[bi]
                 with span(SPAN_SPARSE_DISPATCH, batch=bi, segments=len(batch)):
                     import time as _time
 
@@ -198,18 +219,16 @@ class SparseExecMixin:
                         self._cols_for_segment(seg, ds, lowering.columns)
                         for seg in batch
                     ]
+                    run.advance(pos)
                     t_call = _time.perf_counter()
                     st = seg_fn(cols_list)
                     # sampled query: honest enqueue-vs-device split on
                     # the sparse dispatch span (obs/prof.py; no-op off)
                     st = prof.dispatch_sync(st, t_call)
-                    state = (
-                        st
-                        if state is None
-                        else merge_sparse_states(state, st, num_groups=G)
-                    )
+                    folder.add(bi, st)
                 if pc is not None:
                     pc.add_seen(len(batch), *_row_counts(batch))
+            folder.drain()
             return state
 
         def evict():

@@ -489,6 +489,16 @@ class StreamExecutor:
 
             sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
+        from ..obs import SPAN_PREFETCH, span
+        from .pipeline import pipelined_put
+
+        # double buffering (exec/pipeline.py, ISSUE 10): hold ONE chunk
+        # back so chunk k+1's h2d issue lands in the dispatch queue
+        # BEFORE chunk k's compute program — the link streams behind the
+        # device instead of serializing in front of it.  Disabled with
+        # the engine's transfer pipeline (the bench's off-counterfactual).
+        double_buffer = self.engine._pipeline.enabled
+        held = None
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         try:
@@ -501,15 +511,45 @@ class StreamExecutor:
                 rows = item.pop("__rows")
                 base = item.pop("__time_base", np.int64(0))
                 t0 = _time.perf_counter()
-                dev = {
-                    k: jax.device_put(v, sharding) for k, v in item.items()
-                }
+                dev: Dict[str, jnp.ndarray] = {}
+                nbytes = 0
+
+                def put_all(item=item, dev=dev):
+                    n = 0
+                    for k, v in item.items():
+                        dev[k], _dt, nb = pipelined_put(
+                            v, sharding, prefetched=double_buffer
+                        )
+                        n += nb
+                    return n
+
+                if double_buffer:
+                    # issue overlapped behind the previous chunk's compute
+                    with span(
+                        SPAN_PREFETCH, chunk=self.stats.chunks,
+                        rows=int(rows),
+                    ):
+                        nbytes = put_all()
+                else:
+                    # pipeline off: this put is a foreground transfer the
+                    # dispatch waits behind — honest receipt bucket is h2d
+                    from ..obs import SPAN_H2D
+
+                    with span(
+                        SPAN_H2D, chunk=self.stats.chunks, rows=int(rows)
+                    ):
+                        nbytes = put_all()
                 self.stats.put_s += _time.perf_counter() - t0
-                self.stats.h2d_bytes += sum(
-                    v.nbytes for v in item.values() if hasattr(v, "nbytes")
-                )
+                self.stats.h2d_bytes += nbytes
                 self.stats.rows += int(rows)
-                yield dev, base, np.int32(rows)
+                if not double_buffer:
+                    yield dev, base, np.int32(rows)
+                    continue
+                held, out = (dev, base, np.int32(rows)), held
+                if out is not None:
+                    yield out
+            if held is not None:
+                yield held
         finally:
             cancelled.set()
             while True:  # unblock a producer stuck on a full queue

@@ -44,6 +44,7 @@ from .trace import (  # noqa: F401
     SPAN_NAMES,
     SPAN_PARTIAL,
     SPAN_PLAN,
+    SPAN_PREFETCH,
     SPAN_QUERY,
     SPAN_RETRY,
     SPAN_SEGMENT_DISPATCH,

@@ -76,6 +76,11 @@ DEVICE_SPANS = frozenset(
     }
 )
 TRANSFER_SPANS = frozenset({"h2d"})
+# prefetch spans measure ISSUE time of transfers overlapped behind live
+# compute (exec/pipeline.py): they are deliberately NOT transfer stall —
+# the overlap-efficiency denominator counts only foreground h2d time the
+# dispatch loop actually waited behind
+PREFETCH_SPANS = frozenset({"prefetch"})
 ROOT_SPAN = "query"
 
 
@@ -92,6 +97,8 @@ class ProfScope:
         "syncs",
         "transfer_ms",
         "transfer_bytes",
+        "prefetch_ms",
+        "prefetch_bytes",
         "compiles",
         "compile_ms",
         "residency_hits",
@@ -108,6 +115,8 @@ class ProfScope:
         self.syncs = 0
         self.transfer_ms = 0.0
         self.transfer_bytes = 0
+        self.prefetch_ms = 0.0
+        self.prefetch_bytes = 0
         self.compiles = 0
         self.compile_ms = 0.0
         self.residency_hits = 0
@@ -245,21 +254,36 @@ def transfer_sync(arr):
 # ---------------------------------------------------------------------------
 
 
-def record_h2d(nbytes: int, seconds: float) -> None:
+def record_h2d(nbytes: int, seconds: float, prefetched: bool = False) -> None:
     """One host->device move: effective MB/s into the link-utilization
     histogram (exemplared with the query id) + the scope's transfer
     accumulators.  This is what turns 'the rollup is link-bound at
-    45 MB/s' from a postmortem into a scrapeable fact."""
-    mbps = nbytes / max(seconds, 1e-9) / 1e6
-    get_registry().histogram(
-        "sdol_h2d_link_mbps",
-        "effective host->device link utilization per transfer (MB/s)",
-        buckets=LINK_MBPS_BUCKETS,
-    ).observe(mbps, exemplar=current_query_id() or None)
+    45 MB/s' from a postmortem into a scrapeable fact.
+
+    `prefetched` moves were issued by the transfer pipeline (exec/
+    pipeline.py) BEHIND live compute: they accumulate into the scope's
+    prefetch counters, never into transfer stall — the
+    overlap-efficiency denominator counts only foreground waits.  They
+    are also EXCLUDED from the link histogram: a prefetched put is
+    never synced, so its measured window is the async enqueue
+    (~microseconds) and nbytes/dt would observe absurd multi-GB/s
+    samples — with the pipeline on by default, the documented '45 MB/s
+    floor' fact would drown in enqueue noise."""
     ps = _active.get()
+    if not prefetched:
+        mbps = nbytes / max(seconds, 1e-9) / 1e6
+        get_registry().histogram(
+            "sdol_h2d_link_mbps",
+            "effective host->device link utilization per transfer (MB/s)",
+            buckets=LINK_MBPS_BUCKETS,
+        ).observe(mbps, exemplar=current_query_id() or None)
     if ps is not None:
-        ps.transfer_ms += seconds * 1e3
-        ps.transfer_bytes += int(nbytes)
+        if prefetched:
+            ps.prefetch_ms += seconds * 1e3
+            ps.prefetch_bytes += int(nbytes)
+        else:
+            ps.transfer_ms += seconds * 1e3
+            ps.transfer_bytes += int(nbytes)
 
 
 def record_resident(datasource: str, bytes_now: int) -> None:
@@ -368,6 +392,8 @@ def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
         acc["device"] += excl
     elif name in TRANSFER_SPANS:
         acc["transfer"] += excl
+    elif name in PREFETCH_SPANS:
+        acc["prefetch"] += excl
     else:
         acc["host"] += excl
     for c in children:
@@ -380,18 +406,32 @@ def build_receipt(
     """Fold one trace document (obs.trace.QueryTrace.to_dict shape) into
     a cost receipt.  Pure function of the doc + scope counters, so it
     can run live (mid-query, provisional span ends) or at trace close."""
-    acc = {"device": 0.0, "transfer": 0.0, "host": 0.0, "unattributed": 0.0}
+    acc = {
+        "device": 0.0, "transfer": 0.0, "prefetch": 0.0, "host": 0.0,
+        "unattributed": 0.0,
+    }
     root = trace_doc.get("spans")
     if isinstance(root, dict):
         _walk_exclusive(root, acc, 0)
     wall = float(trace_doc.get("total_ms") or 0.0)
+    # overlap efficiency (ROADMAP direction 4's success metric):
+    # device-busy time over (device-busy + transfer-stall).  Stall is the
+    # FOREGROUND h2d time the dispatch loop waited behind; prefetch issue
+    # time is excluded — those transfers ran behind live compute, which
+    # is exactly what the metric credits.  1.0 when nothing was measured
+    # (a fully-resident or dispatch-free query has no stall to hide).
+    busy_stall = acc["device"] + acc["transfer"]
     receipt: Dict[str, Any] = {
         "query_id": trace_doc.get("query_id", ""),
         "wall_ms": round(wall, 3),
         "device_ms": round(acc["device"], 3),
         "host_ms": round(acc["host"], 3),
         "transfer_ms": round(acc["transfer"], 3),
+        "prefetch_ms": round(acc["prefetch"], 3),
         "unattributed_ms": round(acc["unattributed"], 3),
+        "overlap_efficiency": (
+            round(acc["device"] / busy_stall, 4) if busy_stall > 0 else 1.0
+        ),
         "sampled": bool(scope.sampled) if scope is not None else False,
     }
     if scope is not None:
@@ -409,6 +449,7 @@ def build_receipt(
         }
         receipt.update(
             transfer_bytes=scope.transfer_bytes,
+            prefetch_bytes=scope.prefetch_bytes,
             transfer_mb_per_s=(
                 round(
                     scope.transfer_bytes

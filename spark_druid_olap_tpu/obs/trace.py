@@ -81,6 +81,7 @@ SPAN_PARTIAL = "partial"  # deadline-bounded best-effort answer (coverage)
 SPAN_STREAM_FLUSH = "stream_flush"  # one progressive-response refinement
 SPAN_FUSED_BATCH = "fused_batch"  # one micro-batch fused execution (serve/)
 SPAN_LANE = "lane"  # waiting for a priority-lane slot (serve/lanes.py)
+SPAN_PREFETCH = "prefetch"  # async h2d issue overlapped behind compute
 
 SPAN_NAMES = frozenset(
     {
@@ -108,6 +109,7 @@ SPAN_NAMES = frozenset(
         SPAN_STREAM_FLUSH,
         SPAN_FUSED_BATCH,
         SPAN_LANE,
+        SPAN_PREFETCH,
     }
 )
 

@@ -482,6 +482,20 @@ def partial_scope(enabled: bool = True):
         _active_partial.reset(token)
 
 
+def prefetch_pressed() -> bool:
+    """True when SPECULATIVE work must stop issuing: the active deadline
+    has expired, or a partial drain is already triggered.  Unlike
+    `checkpoint`, this never raises — the transfer pipeline (exec/
+    pipeline.py) consults it before issuing each prefetch so a pending
+    prefetch cancels cleanly on expiry, while the owning executor loop's
+    own checkpoint stays the single place the expiry SURFACES."""
+    pc = _active_partial.get()
+    if pc is not None and pc.enabled and pc.triggered:
+        return True
+    d = _active_deadline.get()
+    return d is not None and d.expired()
+
+
 # ---------------------------------------------------------------------------
 # Fault injection
 # ---------------------------------------------------------------------------

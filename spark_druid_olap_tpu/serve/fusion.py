@@ -53,6 +53,59 @@ log = get_logger("serve.fusion")
 # back to its own serial execution
 _MEMBER_WAIT_S = 300.0
 
+
+def shared_row_plan(inners) -> tuple:
+    """Common-subexpression dedup over member lowerings (ROADMAP 1(a)).
+
+    Dashboard members of one fused batch routinely share the expensive
+    row-pipeline prefixes: the FILTER MASK (intervals + filter over the
+    same virtual columns) and the GROUP-ID pipeline (same dimensions +
+    granularity).  Without dedup the fused program re-traces both per
+    member — N identical filter evaluations over the same segment
+    columns in one kernel.
+
+    Returns one `(mask_group, gid_group)` pair per member, where each
+    group id is the index of the FIRST member with an identical
+    sub-lowering signature: inside the fused program, later members
+    reuse that member's computed mask / gid for each segment instead of
+    recomputing it (engine._segment_partials threads a per-segment memo
+    through `GroupByLowering.row_arrays`).  Signatures come from the
+    canonical wire JSON of the rewritten inner GroupBy — the same
+    identity the program cache keys on — so two members share a group
+    ONLY when the traced subexpression is value-identical."""
+    import json as _json
+
+    def _sig(val):
+        return _json.dumps(val, sort_keys=True, default=str)
+
+    mask_groups: Dict[tuple, int] = {}
+    gid_groups: Dict[tuple, int] = {}
+    plan = []
+    for i, q in enumerate(inners):
+        d = q.to_druid()
+        vsig = _sig(d.get("virtualColumns") or [])
+        isig = _sig(d.get("intervals"))
+        msig = (vsig, _sig(d.get("filter")), isig)
+        # intervals belong in the gid signature too: a time-bucketed
+        # dimension's codes_fn closes over the query's interval span
+        # (bucket origin + cardinality), so two members with identical
+        # dimensions but shifted intervals compute DIFFERENT gids —
+        # sharing them returned silently wrong aggregates for the
+        # second member (review finding, regression-tested)
+        gsig = (
+            vsig,
+            _sig(d.get("dimensions") or []),
+            _sig(d.get("granularity")),
+            isig,
+        )
+        plan.append(
+            (
+                mask_groups.setdefault(msig, i),
+                gid_groups.setdefault(gsig, i),
+            )
+        )
+    return tuple(plan)
+
 # delivery verdicts
 _OK = "ok"
 _RETRY = "retry"  # re-execute individually on the member's own thread

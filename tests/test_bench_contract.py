@@ -121,6 +121,10 @@ def test_production_tag_keys_scale(monkeypatch):
     mode, fn, arg = bench._parse_args(["hammer", "0.1"])
     assert "%s_%g" % (mode, arg) == "hammer_0.1"
     assert fn is bench.bench_hammer
+    # transfer-pipeline counterfactual (ISSUE 10): SSB scale-factor arg
+    mode, fn, arg = bench._parse_args(["overlap", "1"])
+    assert "%s_%g" % (mode, arg) == "overlap_1"
+    assert fn is bench.bench_overlap
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -266,6 +270,73 @@ def test_emit_hammer_result_shape(capsys, tmp_path, monkeypatch):
     detail = json.load(open(tmp_path / "BENCH_hammer_0.1_detail.json"))
     assert detail["detail"]["result_cache"]["hit_span_tree"] == hit_tree
     assert detail["detail"]["fusion"]["fused_speedup"] == 1.03
+
+
+def test_emit_overlap_result_shape(capsys, tmp_path, monkeypatch):
+    """The overlap mode's fat per-(query, mode) receipt maps and the
+    streaming-rollup section live in the detail sidecar; stdout stays
+    one compact driver-parseable line with the headline efficiency and
+    the stall-ratio baseline inline."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    per_q = {
+        "q%d_%d" % (i, j): {
+            "off": {
+                "wall_ms": 25.0, "transfer_stall_ms": 3.7,
+                "prefetch_ms": 0.0, "overlap_efficiency": 0.84,
+                "device_ms": 20.0, "transfer_bytes": 2_700_288,
+                "prefetch_bytes": 0,
+            },
+            "on": {
+                "wall_ms": 24.1, "transfer_stall_ms": 1.9,
+                "prefetch_ms": 0.8, "overlap_efficiency": 0.92,
+                "device_ms": 20.1, "transfer_bytes": 2_359_296,
+                "prefetch_bytes": 340_992,
+            },
+            "identical": True,
+        }
+        for i in range(1, 5)
+        for j in range(1, 4)
+    }
+    bench._emit(
+        {
+            "metric": "overlap_ssb_sf1_pipeline_on_efficiency",
+            "value": 0.91,
+            "unit": "ratio",
+            "vs_baseline": 1.7,
+            "identical": True,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 6_000_000,
+                "transfer_stall_ms_on": 28.7,
+                "transfer_stall_ms_off": 48.9,
+                "results_identical_on_vs_off": True,
+                "stream_identical_on_vs_off": True,
+                "streaming_rollup": {
+                    "off": {"wall_s": 0.34, "transfer_stall_ms": 10.8},
+                    "on": {"wall_s": 0.29, "transfer_stall_ms": 0.0,
+                           "prefetch_ms": 8.2},
+                },
+                "queries": per_q,
+            },
+        },
+        "overlap_1",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "overlap_ssb_sf1_pipeline_on_efficiency"
+    assert parsed["value"] == 0.91
+    assert parsed["vs_baseline"] == 1.7
+    assert "queries" not in parsed and "streaming_rollup" not in parsed
+    detail = json.load(open(tmp_path / "BENCH_overlap_1_detail.json"))
+    assert detail["detail"]["queries"]["q1_1"]["identical"] is True
+    assert (
+        detail["detail"]["streaming_rollup"]["on"]["transfer_stall_ms"]
+        == 0.0
+    )
+    assert detail["detail"]["results_identical_on_vs_off"] is True
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

@@ -818,3 +818,124 @@ def test_extend_dict_large_domain_is_fast_and_exact():
     assert new.cardinality == big.cardinality + 1
     assert new.values[0] == "a_novel_value"
     np.testing.assert_array_equal(lut, np.arange(1, 200_001))
+
+
+# ---------------------------------------------------------------------------
+# per-file CSV shard source (ISSUE 10 satellite: ROADMAP 2(a) remainder)
+# ---------------------------------------------------------------------------
+
+
+def _write_csv_files(tmp_path, n_files=3, rows=400, seed=5):
+    rng = np.random.default_rng(seed)
+    frames = []
+    paths = []
+    base = 0
+    for i in range(n_files):
+        df = pd.DataFrame(
+            {
+                "ts": (base + np.arange(rows)) * 1_000,
+                "city": rng.choice(
+                    ["austin", "boston", f"only_in_{i}", "dallas"], rows
+                ),
+                "qty": rng.integers(1, 9, rows),
+                "rev": np.round(rng.random(rows), 3),
+            }
+        )
+        base += rows
+        p = tmp_path / f"part_{i}.csv"
+        df.to_csv(p, index=False)
+        frames.append(df)
+        paths.append(str(p))
+    return paths, pd.concat(frames, ignore_index=True)
+
+
+def test_csv_per_file_shard_source_matches_serial(tmp_path):
+    """build_datasource_from_csv: each file's native decode IS a phase-1
+    factorize shard — merged dictionaries, remapped codes, and segment
+    rows must equal the one-big-frame serial build exactly."""
+    from spark_druid_olap_tpu.ingest.shard import (
+        build_datasource_from_csv,
+        build_datasource_sharded,
+    )
+
+    paths, merged = _write_csv_files(tmp_path)
+    ds = build_datasource_from_csv(
+        "csvsrc", paths, ["city"], ["qty", "rev"],
+        time_col="ts", rows_per_segment=256,
+    )
+    want = build_datasource_sharded(
+        "csvser",
+        {c: merged[c].values for c in merged.columns},
+        ["city"], ["qty", "rev"],
+        time_col="ts", rows_per_segment=256, workers=1,
+    )
+    assert ds.dicts["city"].values == want.dicts["city"].values
+    assert len(ds.segments) == len(want.segments)
+    for a, b in zip(ds.segments, want.segments):
+        assert a.num_rows == b.num_rows
+        np.testing.assert_array_equal(
+            np.asarray(a.dims["city"]), np.asarray(b.dims["city"])
+        )
+        for m in ("qty", "rev"):
+            np.testing.assert_array_equal(
+                np.asarray(a.column(m)), np.asarray(b.column(m))
+            )
+
+
+def test_csv_shard_source_queryable_with_oracle_parity(tmp_path):
+    from spark_druid_olap_tpu.ingest.shard import build_datasource_from_csv
+
+    paths, merged = _write_csv_files(tmp_path, n_files=2, rows=300)
+    ds = build_datasource_from_csv(
+        "csvq", paths, ["city"], ["qty", "rev"],
+        time_col="ts", rows_per_segment=128,
+    )
+    ctx = sd.TPUOlapContext()
+    ctx.catalog.put(ds)
+    got = ctx.sql(
+        "SELECT city, SUM(qty) AS q, COUNT(*) AS n FROM csvq "
+        "GROUP BY city"
+    ).sort_values("city").reset_index(drop=True)
+    want = (
+        merged.groupby("city")
+        .agg(q=("qty", "sum"), n=("qty", "count"))
+        .reset_index()
+        .sort_values("city")
+        .reset_index(drop=True)
+    )
+    assert list(got["city"]) == list(want["city"])
+    np.testing.assert_array_equal(
+        got["q"].astype(np.int64), want["q"].astype(np.int64)
+    )
+    np.testing.assert_array_equal(
+        got["n"].astype(np.int64), want["n"].astype(np.int64)
+    )
+
+
+def test_csv_shard_source_caller_dict_reencodes(tmp_path):
+    """A caller-supplied dictionary wins: native per-file rank codes are
+    decoded back to values and re-encoded under the caller's domain
+    (codes are ranks over the FILE's domain, never reinterpretable)."""
+    from spark_druid_olap_tpu.ingest.shard import build_datasource_from_csv
+
+    paths, merged = _write_csv_files(tmp_path, n_files=2, rows=200)
+    domain = tuple(
+        sorted(set(map(str, merged["city"])) | {"zz_unused"})
+    )
+    ds = build_datasource_from_csv(
+        "csvd", paths, ["city"], ["qty"],
+        time_col="ts", rows_per_segment=128,
+        dicts={"city": DimensionDict(values=domain)},
+    )
+    assert ds.dicts["city"].values == domain
+    decoded = np.concatenate(
+        [
+            ds.dicts["city"].decode(
+                np.asarray(s.dims["city"])[: s.num_rows]
+            )
+            for s in ds.segments
+        ]
+    )
+    np.testing.assert_array_equal(
+        decoded, merged["city"].astype(str).values
+    )

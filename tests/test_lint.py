@@ -1907,6 +1907,85 @@ _MATRIX = {
             """},
         ],
     },
+    "transfer-discipline": {
+        "violating": [
+            # GL1901: bare device_put in the serving layer bypasses the
+            # pipeline (no residency budget, fault site, or accounting)
+            (
+                {"spark_druid_olap_tpu/serve/fusion.py": """
+                    import jax
+
+                    def stage(self, seg, sharding):
+                        return jax.device_put(seg.columns, sharding)
+                """},
+                {"GL1901"},
+            ),
+            # GL1902: jnp.asarray of host segment columns — direct call
+            # args AND a same-function name binding
+            (
+                {"spark_druid_olap_tpu/exec/engine.py": """
+                    import jax.numpy as jnp
+
+                    def cols_for(self, seg, names):
+                        out = {}
+                        for n in names:
+                            out[n] = jnp.asarray(seg.column(n))
+                        out["__valid"] = jnp.asarray(seg.valid)
+                        return out
+                """},
+                {"GL1902"},
+            ),
+            (
+                {"spark_druid_olap_tpu/exec/streaming.py": """
+                    import jax.numpy as jnp
+
+                    def move(self, seg):
+                        host = seg.column("v")
+                        return jnp.asarray(host)
+                """},
+                {"GL1902"},
+            ),
+        ],
+        "clean": [
+            # the pipeline module is the sanctioned home of device_put
+            {"spark_druid_olap_tpu/exec/pipeline.py": """
+                import jax
+
+                def pipelined_put(host, sharding=None):
+                    return jax.device_put(host, sharding)
+            """},
+            # _put_device_col is the engine's sanctioned placement; other
+            # code fetches THROUGH it, and jnp.asarray of computed device
+            # values / staged constants stays legal
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                import jax.numpy as jnp
+
+                def _put_device_col(self, key, host, ds_name):
+                    arr = jnp.asarray(host)
+                    self._device_cache[key] = arr
+                    return arr
+
+                def vcols(self, fns, cols):
+                    for name, fn in fns.items():
+                        cols[name] = jnp.asarray(fn(cols))
+                    return cols
+            """},
+            # np.asarray of a host column is host-side work, not an h2d
+            # move; parallel/ keeps its own sharded-placement contract
+            {"spark_druid_olap_tpu/exec/fallback.py": """
+                import numpy as np
+
+                def decode(self, seg):
+                    return np.asarray(seg.valid)
+            """,
+             "spark_druid_olap_tpu/parallel/distributed.py": """
+                import jax
+
+                def shard(self, host, sharding):
+                    return jax.device_put(host, sharding)
+            """},
+        ],
+    },
 }
 
 

@@ -1,0 +1,502 @@
+"""Overlapped h2d transfer pipeline (ISSUE 10 tentpole).
+
+Layers under test:
+
+1. Oracle parity: pipeline-on results are BYTE-identical to
+   pipeline-off across the dense, sparse, fused, scan, and streaming
+   executors (the fold order is pinned to canonical batch order, so
+   residency-aware dispatch reordering cannot reassociate f32 sums).
+2. Prefetch mechanics: the plan issues async puts for upcoming batches,
+   orders resident batches first, and speculates on next-interval
+   segments under the separate byte cap.
+3. Lifecycle edges: a pending prefetch cancels cleanly on deadline
+   expiry mid-stream, an append/compaction retiring a queued uid stops
+   its issue, a budget eviction racing a landing prefetch leaks no
+   phantom resident bytes, and an injected `h2d` fault on a PREFETCHED
+   put is re-raised at consume — reaching the retry machinery exactly
+   like a foreground transfer failure.
+4. Attribution: sampled cost receipts carry `overlap_efficiency` and
+   the prefetch bucket; the fused-batch CSE plan (serve/fusion.
+   shared_row_plan) groups identical sub-lowerings.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.catalog.segment import build_datasource
+from spark_druid_olap_tpu.config import SessionConfig
+from spark_druid_olap_tpu.exec.engine import Engine, segments_in_scope
+from spark_druid_olap_tpu.models.aggregations import Count, DoubleSum
+from spark_druid_olap_tpu.models.dimensions import DimensionSpec
+from spark_druid_olap_tpu.models.query import GroupByQuery, ScanQuery
+from spark_druid_olap_tpu.resilience import (
+    InjectedDeadline,
+    InjectedFault,
+    deadline_scope,
+    injector,
+    partial_scope,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injector().disarm()
+    yield
+    injector().disarm()
+
+
+def _ctx(**overrides):
+    cfg = SessionConfig.load_calibrated()
+    cfg.result_cache_entries = 0
+    cfg.retry_backoff_ms = 1.0
+    cfg.prefer_distributed = False
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return sd.TPUOlapContext(cfg)
+
+
+def _flat_ds(n=8_192, seg_rows=512, name="pl", card=4, seed=3):
+    """Multi-segment datasource: small segments so the CPU unroll cap
+    (2) yields MANY dispatch batches — the shape the pipeline reorders
+    and prefetches across."""
+    rng = np.random.default_rng(seed)
+    cols = {
+        "d": np.array(
+            [f"k{i}" for i in rng.integers(0, card, size=n)], dtype=object
+        ),
+        "v": rng.random(n).astype(np.float32),
+        "t": (np.arange(n) * 1_000).astype(np.int64),
+    }
+    ds = build_datasource(
+        name, cols, dimension_cols=["d"], metric_cols=["v"],
+        time_col="t", rows_per_segment=seg_rows,
+    )
+    return ds, cols
+
+
+def _gb(ds_name="pl", filt=None, intervals=()):
+    return GroupByQuery(
+        datasource=ds_name,
+        dimensions=(DimensionSpec("d"),),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+        filter=filt,
+        intervals=tuple(intervals),
+    )
+
+
+def _exact_equal(a, b):
+    pd.testing.assert_frame_equal(
+        a.reset_index(drop=True), b.reset_index(drop=True), check_exact=True
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. oracle parity: pipeline-on == pipeline-off, byte-identical
+# ---------------------------------------------------------------------------
+
+
+def test_dense_parity_on_vs_off():
+    ds, _ = _flat_ds()
+    q = _gb()
+    on = Engine()
+    off = Engine()
+    off._pipeline.enabled = False
+    _exact_equal(on.execute(q, ds), off.execute(q, ds))
+    # warm repeat (fully resident) stays identical too
+    _exact_equal(on.execute(q, ds), off.execute(q, ds))
+
+
+def test_dense_parity_after_partial_residency():
+    """A prewarmed subset flips the dispatch order (resident batches
+    first) — results must stay byte-identical to the cold canonical
+    order."""
+    ds, _ = _flat_ds(name="pl2")
+    q = _gb("pl2")
+    off = Engine()
+    off._pipeline.enabled = False
+    want = off.execute(q, ds)
+    on = Engine()
+    # prewarm a LATE interval slice so canonical order starts cold
+    warm = _gb("pl2", intervals=[(6_000_000, 8_192_000)])
+    on.execute(warm, ds)
+    _exact_equal(on.execute(q, ds), want)
+
+
+def test_sparse_parity_on_vs_off():
+    rng = np.random.default_rng(11)
+    n = 40_000
+    cols = {
+        "a": rng.integers(0, 300, size=n),
+        "b": rng.integers(0, 300, size=n),
+        "v": np.ones(n, np.float32),
+    }
+    from spark_druid_olap_tpu.catalog.segment import DimensionDict
+
+    ds = build_datasource(
+        "plsp", cols, dimension_cols=["a", "b"], metric_cols=["v"],
+        rows_per_segment=1 << 13,
+        dicts={
+            "a": DimensionDict(values=tuple(range(300))),
+            "b": DimensionDict(values=tuple(range(300))),
+        },
+    )
+    q = GroupByQuery(
+        datasource="plsp",
+        dimensions=(DimensionSpec("a"), DimensionSpec("b")),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+    )
+    on = Engine(strategy="sparse")
+    off = Engine(strategy="sparse")
+    off._pipeline.enabled = False
+    _exact_equal(on.execute(q, ds), off.execute(q, ds))
+
+
+def test_fused_parity_on_vs_off():
+    ds, _ = _flat_ds(name="plf")
+    from spark_druid_olap_tpu.models.filters import Selector
+
+    queries = [_gb("plf"), _gb("plf", filt=Selector("d", "k1")), _gb("plf")]
+    on = Engine()
+    off = Engine()
+    off._pipeline.enabled = False
+    got = on.execute_fused(queries, ds)
+    want = off.execute_fused(queries, ds)
+    for (df_on, _, _), (df_off, _, _) in zip(got, want):
+        _exact_equal(df_on, df_off)
+    # fused members must also equal their own serial executions
+    for (df_on, _, _), q in zip(got, queries):
+        _exact_equal(df_on, off.execute(q, ds))
+
+
+def test_scan_parity_and_row_order_on_vs_off():
+    ds, _ = _flat_ds(name="plsc")
+    q = ScanQuery(datasource="plsc", columns=("d", "v"), limit=700)
+    on = Engine()
+    off = Engine()
+    off._pipeline.enabled = False
+    # scan dispatch stays canonical (reorder=False): LIMIT semantics and
+    # row order are part of the result contract
+    _exact_equal(on.execute(q, ds), off.execute(q, ds))
+
+
+def test_streaming_parity_on_vs_off():
+    from spark_druid_olap_tpu.exec.streaming import StreamExecutor
+    from spark_druid_olap_tpu.utils import datagen
+
+    q_inner = GroupByQuery(
+        datasource="events",
+        dimensions=(),
+        aggregations=(Count("n"), DoubleSum("s", "value")),
+        intervals=(datagen.event_stream_interval(),),
+    )
+    ds = datagen.event_stream_schema()
+    chunk = 1 << 12
+    staged = [datagen.gen_event_chunk(i, chunk) for i in range(5)]
+    eng_on = Engine()
+    eng_off = Engine()
+    eng_off._pipeline.enabled = False
+    got = StreamExecutor(engine=eng_on).execute(
+        q_inner, ds, iter(staged), chunk
+    )
+    want = StreamExecutor(engine=eng_off).execute(
+        q_inner, ds, iter(staged), chunk
+    )
+    _exact_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# 2. prefetch mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_issues_and_scope_lands_resident():
+    ds, _ = _flat_ds(name="plm")
+    eng = Engine()
+    eng.execute(_gb("plm"), ds)
+    assert eng._pipeline.issued > 0
+    # every in-scope column landed in the residency cache
+    for seg in ds.segments:
+        assert (seg.uid, "col", "d") in eng._device_cache
+        assert (seg.uid, "valid") in eng._device_cache
+
+
+def test_residency_aware_order_runs_resident_batches_first():
+    ds, _ = _flat_ds(name="plo")
+    eng = Engine()
+    need = ["d", "v"]
+    batches = list(eng._segment_batches(list(ds.segments), need))
+    assert len(batches) >= 4
+    # warm exactly the SECOND batch's columns
+    for seg in batches[1]:
+        eng._device_cols(seg, need, ds_name=ds.name)
+    run = eng._pipeline.start(ds, batches, need)
+    # within the first reorder window, the resident batch dispatches
+    # first; canonical order is preserved among equally-cold batches
+    assert run.order[0] == 1
+    assert run.order[1] == 0
+    # disabled pipeline keeps canonical order
+    eng2 = Engine()
+    eng2._pipeline.enabled = False
+    run2 = eng2._pipeline.start(ds, batches, need)
+    assert run2.order == list(range(len(batches)))
+
+
+def test_speculative_prefetch_respects_byte_cap():
+    ds, _ = _flat_ds(name="plsv")
+    # scope = the first quarter of the time range; the rest of the
+    # segments are speculative candidates
+    q = _gb("plsv", intervals=[(0, 2_048_000)])
+    eng = Engine()
+    eng._pipeline.speculative_bytes = 64 << 20
+    segs = segments_in_scope(q, ds)
+    assert 0 < len(segs) < len(ds.segments)
+    eng.execute(q, ds)
+    assert eng._pipeline.speculative_issued > 0
+    out_of_scope = [
+        s for s in ds.segments if s.uid not in {x.uid for x in segs}
+    ]
+    assert any(
+        (s.uid, "col", "d") in eng._device_cache for s in out_of_scope
+    )
+    # a tiny cap stops speculation almost immediately
+    eng2 = Engine()
+    eng2._pipeline.speculative_bytes = 1  # 1 byte: first entry exceeds it
+    eng2.execute(q, ds)
+    assert eng2._pipeline.speculative_issued <= 1
+
+
+def test_speculative_candidates_next_interval_first():
+    ds, _ = _flat_ds(name="plnx")
+    q = _gb("plnx", intervals=[(2_048_000, 4_096_000)])
+    eng = Engine()
+    eng._pipeline.speculative_bytes = 1 << 20
+    segs = segments_in_scope(q, ds)
+    cands = eng._pipeline.speculative_candidates(q, ds, segs)
+    assert cands, "out-of-scope segments should be candidates"
+    scope_end = max(s.interval[1] for s in segs)
+    # the first candidates are the NEXT intervals, not the earlier ones
+    assert cands[0].interval[0] >= scope_end
+
+
+# ---------------------------------------------------------------------------
+# 3. lifecycle edges
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expiry_cancels_pending_prefetch():
+    ctx = _ctx()
+    n = 20_000
+    ctx.register_table(
+        "t",
+        {
+            "d": np.array(["a", "b", "c", "d"] * (n // 4), dtype=object),
+            "v": np.ones(n, dtype=np.float32),
+        },
+        dimensions=["d"],
+        metrics=["v"],
+        rows_per_segment=1 << 10,
+    )
+    injector().arm(
+        "engine.segment_loop", "error", times=1, skip=2,
+        error_type=InjectedDeadline,
+    )
+    before = ctx.engine._pipeline.cancelled
+    with deadline_scope(60_000), partial_scope(True):
+        df = ctx.sql("SELECT d, COUNT(*) AS n FROM t GROUP BY d")
+    assert df.attrs["partial"] is True
+    assert 0 < df.attrs["coverage"] < 1.0
+    assert ctx.engine._pipeline.cancelled > before
+
+
+def test_retired_uid_skips_queued_prefetch():
+    ds, _ = _flat_ds(name="plr")
+    eng = Engine()
+    need = ["d", "v"]
+    batches = list(eng._segment_batches(list(ds.segments), need))
+    assert len(batches) >= 3
+    run = eng._pipeline.start(ds, batches, need)
+    # an append/compaction retires the segments of the 2nd + 3rd batch
+    # AFTER the plan was built but BEFORE their prefetch issues
+    retired = {s.uid for b in batches[1:3] for s in b}
+    eng.evict_segments(retired)
+    run.advance(0)  # would have prefetched batches 1..2
+    assert eng._pipeline.skipped_retired > 0
+    for uid in retired:
+        assert (uid, "valid") not in eng._device_cache
+        assert (uid, "col", "d") not in eng._device_cache
+
+
+def test_budget_eviction_racing_landing_prefetch_leaks_no_bytes():
+    ds, cols = _flat_ds(name="plb")
+    one_seg_bytes = int(ds.segments[0].valid.nbytes) + sum(
+        int(ds.segments[0].column(c).nbytes) for c in ("d", "v")
+    )
+    # budget only ~1.5 batches: prefetched entries are budget-evicted
+    # almost as soon as they land
+    eng = Engine(device_cache_bytes=3 * one_seg_bytes)
+    df = eng.execute(_gb("plb"), ds)
+    assert int(df["n"].sum()) == len(cols["v"])
+    # phantom-byte check: per-datasource residency accounting must agree
+    # with the cache's own byte count after all the eviction churn
+    assert sum(eng._resident_by_ds.values()) == eng._device_cache.bytes_used
+    assert eng._device_cache.bytes_used <= 3 * one_seg_bytes
+
+
+def test_injected_h2d_fault_on_prefetched_put_reaches_retry():
+    ds, cols = _flat_ds(name="plh")
+    eng = Engine()
+    need_keys_per_batch = sum(
+        2 + 1 for _ in range(2)
+    )  # 2 cols + valid, 2 segs/batch on CPU
+    # skip past batch 0's foreground puts so the fault fires on a
+    # PREFETCHED put (issued by run.advance), then is re-raised at
+    # consume and absorbed by the engine's transient retry
+    injector().arm("h2d", "error", times=1, skip=need_keys_per_batch)
+    df = eng.execute(_gb("plh"), ds)
+    assert int(df["n"].sum()) == len(cols["v"])
+    assert eng.last_metrics.retries == 1
+
+
+def test_injected_h2d_fault_without_retries_surfaces():
+    ds, _ = _flat_ds(name="plh2")
+    eng = Engine()
+    eng._retry_attempts = 1  # no retry budget
+    injector().arm("h2d", "error", times=1, skip=6)
+    with pytest.raises(InjectedFault):
+        eng.execute(_gb("plh2"), ds)
+
+
+# ---------------------------------------------------------------------------
+# 4. attribution + CSE plan
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_receipt_carries_overlap_fields():
+    ctx = _ctx(prof_sample_rate=0.0)
+    ds, _ = _flat_ds(name="plrc")
+    ctx.register_table(
+        "plrc",
+        {
+            "d": np.array(["a", "b"] * 2048, dtype=object),
+            "v": np.ones(4096, np.float32),
+        },
+        dimensions=["d"],
+        metrics=["v"],
+        rows_per_segment=512,
+    )
+    ctx.tracer.force_sample_next()
+    df = ctx.sql("SELECT d, SUM(v) FROM plrc GROUP BY d")
+    rc = df.attrs.get("receipt")
+    assert rc is not None
+    assert "overlap_efficiency" in rc
+    assert 0.0 <= rc["overlap_efficiency"] <= 1.0
+    assert "prefetch_ms" in rc and "prefetch_bytes" in rc
+    assert rc["sampled"] is True
+
+
+def test_shared_row_plan_groups_identical_sublowerings():
+    from spark_druid_olap_tpu.models.filters import Selector
+    from spark_druid_olap_tpu.serve.fusion import shared_row_plan
+
+    a = _gb(filt=Selector("d", "k1"))
+    b = _gb(filt=Selector("d", "k1"))  # same filter + dims as a
+    c = _gb(filt=Selector("d", "k2"))  # different filter, same dims
+    plan = shared_row_plan([a, b, c])
+    assert plan[0] == (0, 0)
+    assert plan[1] == (0, 0)  # mask AND gid shared with a
+    assert plan[2][0] == 2  # its own mask group
+    assert plan[2][1] == 0  # gid still shared (same dimensions)
+
+
+def test_fused_cse_traces_shared_filter_once():
+    """Two members with an identical filter must evaluate it ONCE per
+    segment inside the fused program (ROADMAP 1(a)): count filter_fn
+    invocations at trace time."""
+    from spark_druid_olap_tpu.models.filters import Selector
+
+    ds, _ = _flat_ds(name="plcse")
+    eng = Engine()
+    queries = [
+        _gb("plcse", filt=Selector("d", "k1")),
+        GroupByQuery(
+            datasource="plcse",
+            dimensions=(DimensionSpec("d"),),
+            aggregations=(DoubleSum("s2", "v"),),
+            filter=Selector("d", "k1"),
+        ),
+    ]
+    calls = {"n": 0}
+    lowerings = [eng._lowering_for(q, ds) for q in queries]
+    for lo in lowerings:
+        orig = lo.filter_fn
+
+        def counting(cols, _orig=orig):
+            calls["n"] += 1
+            return _orig(cols)
+
+        lo.filter_fn = counting
+    out = eng.execute_fused(queries, ds)
+    batches = list(eng._segment_batches(list(ds.segments), ["d", "v"]))
+    # every batch has the same member->segment selection, so ONE program
+    # traces (and is reused across batches): the shared filter evaluates
+    # once per segment IN THE TRACE — not once per (member, segment),
+    # which would be 2x
+    assert calls["n"] == len(batches[0]), (calls["n"], len(batches[0]))
+    # and the answers are still each member's own
+    off = Engine()
+    off._pipeline.enabled = False
+    for (df, _, _), q in zip(out, queries):
+        _exact_equal(df, off.execute(q, ds))
+
+
+def test_fused_time_bucketed_members_with_shifted_intervals():
+    """Review regression: the CSE gid signature must include intervals.
+    Two members with the SAME time-bucket dimension over SHIFTED
+    intervals compute different gids (the bucket origin/cardinality
+    close over the interval span); sharing them returned silently wrong
+    aggregates for the second member."""
+    from spark_druid_olap_tpu.models.dimensions import DimensionSpec as D
+
+    ds, _ = _flat_ds(name="plti", n=8_192, seg_rows=512)
+
+    def bucketed(lo, hi):
+        return GroupByQuery(
+            datasource="plti",
+            dimensions=(D("__time", granularity="minute"),),
+            aggregations=(Count("n"), DoubleSum("s", "v")),
+            intervals=((lo, hi),),
+        )
+
+    a = bucketed(0, 2_048_000)
+    b = bucketed(1_024_000, 3_072_000)  # same dims, shifted interval
+    eng = Engine()
+    out = eng.execute_fused([a, b], ds)
+    serial = Engine()
+    serial._pipeline.enabled = False
+    for (df, _, _), q in zip(out, (a, b)):
+        _exact_equal(df, serial.execute(q, ds))
+
+
+def test_stale_poison_dies_with_its_truncated_owner():
+    """Review regression: poisons are RUN-scoped.  A prefetch that fails
+    inside a query which then truncates before consuming it (here: a
+    scan satisfying its LIMIT after one segment) must NOT leak the
+    failure into a later query's cache miss — the later query attempts
+    a fresh transfer and succeeds with ZERO retries."""
+    ds, cols = _flat_ds(name="plps")
+    eng = Engine()
+    # scan fetches d, v, t (+ valid) = 4 puts for segment 0, then
+    # advance(0) prefetches segment 1: skip past the foreground puts so
+    # the fault lands on segment 1's FIRST prefetched put
+    injector().arm("h2d", "error", times=1, skip=4)
+    q = ScanQuery(datasource="plps", columns=("d", "v"), limit=10)
+    df = eng.execute(q, ds)  # LIMIT met on segment 0: run cancelled
+    assert len(df) == 10
+    injector().disarm()
+    # the poisoned column never got consumed by its owner; a later
+    # query must not inherit the failure
+    got = eng.execute(_gb("plps"), ds)
+    assert int(got["n"].sum()) == len(cols["v"])
+    assert eng.last_metrics.retries == 0, "stale poison leaked"

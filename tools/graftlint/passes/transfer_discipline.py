@@ -1,0 +1,158 @@
+"""transfer-discipline pass: h2d moves ride the pipeline (GL19xx,
+ISSUE 10 satellite).
+
+The overlapped transfer pipeline (spark_druid_olap_tpu/exec/pipeline.py)
+made the executors' host->device moves a DISCIPLINE, not a convention:
+every segment-column placement goes through `Engine._put_device_col`
+(residency cache + byte budget + h2d fault site + link accounting +
+prefetch poisoning) or the pipeline module's `pipelined_put` (the
+streaming chunk path).  A bare placement landing back in exec/ or
+serve/ silently forfeits all of it: the column pins HBM outside the
+byte budget, the 45 MB/s link histogram and the cost receipt's
+transfer/prefetch split go blind to it, injected `h2d` faults skip it,
+and the prefetcher can never overlap it.
+
+* **GL1901 — bare `jax.device_put` in exec//serve/.**  The pipeline
+  module is the one sanctioned home of device_put; everything else
+  routes through its helpers.
+* **GL1902 — `jnp.asarray` of a host segment column.**  Flagged when
+  the placed value is `<seg>.column(...)`, `<seg>.valid`, or a name
+  assigned from either in the same function.  `jnp.asarray` of staged
+  lowering constants / computed device values stays legal — the pass
+  targets exactly the row-scale host buffers whose transfer time the
+  pipeline exists to hide.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from ..core import LintPass, ModuleContext
+
+
+def _short(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+class TransferDisciplinePass(LintPass):
+    name = "transfer-discipline"
+    default_config = {
+        # the executor + serving trees, where a bare move forfeits the
+        # residency budget / accounting / fault machinery.  parallel/ is
+        # excluded: mesh shard placement has its own sharding contract.
+        "include": (
+            "spark_druid_olap_tpu/exec/",
+            "spark_druid_olap_tpu/serve/",
+        ),
+        # the sanctioned homes of raw placement
+        "allow_files": ("spark_druid_olap_tpu/exec/pipeline.py",),
+        "allow_funcs": ("_put_device_col",),
+        # attribute names whose reads ARE host segment buffers
+        "host_attrs": ("valid",),
+    }
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        if any(
+            ctx.relpath.startswith(p) for p in self.config["allow_files"]
+        ):
+            return False
+        if not any(
+            ctx.relpath.startswith(p) for p in self.config["include"]
+        ):
+            return False
+        func = ctx.scope.current_func
+        return not (
+            func is not None and func.name in self.config["allow_funcs"]
+        )
+
+    # -- host-column shape detection -----------------------------------------
+
+    def _host_column_names(self, ctx: ModuleContext) -> Dict[str, bool]:
+        """Names assigned from `<x>.column(...)` / `<x>.valid` anywhere
+        in the enclosing function (same order-insensitive hygiene-check
+        contract as the obs-discipline label binding scan).  Memoized
+        per function node: without the memo every `asarray(name)` call
+        site re-walks the whole enclosing function — O(n^2) in large
+        executor bodies."""
+        func = ctx.scope.current_func
+        if func is None:
+            return {}
+        cache = getattr(self, "_name_cache", None)
+        if cache is None:
+            cache = self._name_cache = {}
+        out = cache.get(id(func))
+        if out is not None:
+            return out
+        out = {}
+        for sub in ast.walk(func):
+            if not isinstance(sub, ast.Assign):
+                continue
+            if self._is_host_column(sub.value, ctx, follow_names=False):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = True
+        cache[id(func)] = out
+        return out
+
+    def _is_host_column(
+        self, node: ast.AST, ctx: ModuleContext, follow_names: bool = True
+    ) -> bool:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "column"
+        ):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in tuple(
+            self.config["host_attrs"]
+        ):
+            return True
+        if follow_names and isinstance(node, ast.Name):
+            return node.id in self._host_column_names(ctx)
+        return False
+
+    # -- handlers -------------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        name = _short(node.func)
+        if name == "device_put":
+            if self._in_scope(ctx):
+                self.report(
+                    ctx, node, "GL1901",
+                    "bare jax.device_put in exec//serve/ bypasses the "
+                    "transfer pipeline: no residency byte budget, no h2d "
+                    "fault site, no link/receipt accounting, and the "
+                    "prefetcher cannot overlap it — route the move "
+                    "through Engine._put_device_col / _device_cols or "
+                    "exec.pipeline.pipelined_put",
+                )
+            return
+        if name != "asarray" or not node.args:
+            return
+        # jnp.asarray only: np.asarray of a host column is host-side
+        # work (zero-copy view), not a device placement
+        base = node.func.value if isinstance(node.func, ast.Attribute) else None
+        if not (
+            isinstance(base, ast.Name) and base.id in ("jnp",)
+            or (
+                isinstance(base, ast.Attribute)
+                and base.attr == "numpy"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "jax"
+            )
+        ):
+            return
+        if self._is_host_column(node.args[0], ctx) and self._in_scope(ctx):
+            self.report(
+                ctx, node, "GL1902",
+                "jnp.asarray of a host segment column is a bare h2d move "
+                "outside the transfer pipeline — it skips the residency "
+                "cache/budget, the h2d fault site, and the cost "
+                "receipt's transfer accounting; fetch the column through "
+                "Engine._device_cols (or _put_device_col) instead",
+            )
