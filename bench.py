@@ -2176,6 +2176,148 @@ def bench_overlap(scale: float):
     }
 
 
+def bench_boot(scale: float):
+    """Durable-storage boot benchmark (ISSUE 13 satellite): cold-boot
+    re-encode vs mmap snapshot restore over the same SSB SF`scale`
+    lineorder datasource, WAL replay throughput, and byte-identical
+    query results across a kill-and-restart.
+
+    Three measured paths:
+      * re-encode — what a storage-less process pays EVERY boot:
+        dictionary-encode + segment the raw flat columns from scratch.
+      * restore — a context built over the persisted snapshot: per-column
+        .npy files open as np.memmap (catalog/persist.LazyColumnMap);
+        no row is re-encoded, columns page in lazily on first query.
+      * restore+replay — same, with a WAL tail of streamed appends past
+        the snapshot watermark replayed through the live append path
+        (the crash-recovery shape; yields rows/s replay throughput)."""
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+    import time as _t
+
+    import numpy as np
+
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.catalog.persist import LazyColumnMap
+    from spark_druid_olap_tpu.config import SessionConfig
+    from spark_druid_olap_tpu.workloads import ssb
+
+    def _cfg(storage_dir=None):
+        cfg = SessionConfig.load_calibrated()
+        cfg.result_cache_entries = 0  # measure boots, not cache hits
+        cfg.storage_dir = storage_dir
+        return cfg
+
+    def _register(ctx):
+        if scale >= 4:
+            # the full flat host frame does not survive large SFs
+            ssb.register_streamed(ctx, scale=scale)
+        else:
+            ssb.register(ctx, tables=ssb.gen_tables(scale=scale))
+        return ctx.catalog.get("lineorder").num_rows
+
+    # -- (a) the storage-less baseline: re-encode at every boot --------------
+    t0 = _t.perf_counter()
+    ctx_cold = sd.TPUOlapContext(_cfg())
+    n_rows = _register(ctx_cold)
+    t_reencode = _t.perf_counter() - t0
+    del ctx_cold
+
+    # -- (b) one durable registration, then a timed snapshot restore --------
+    root = tempfile.mkdtemp(prefix="sdol_boot_bench_")
+    try:
+        ctx = sd.TPUOlapContext(_cfg(root))
+        _register(ctx)  # snapshot flush commits before this returns
+        disk_bytes = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _, fs in os.walk(root)
+            for f in fs
+        )
+        t0 = _t.perf_counter()
+        ctx_restore = sd.TPUOlapContext(_cfg(root))
+        t_restore = _t.perf_counter() - t0
+        ds = ctx_restore.catalog.get("lineorder")
+        disk_backed = all(
+            isinstance(s.dims, LazyColumnMap)
+            for s in ds.historical_segments()
+        )
+
+        # -- (c) WAL tail: streamed appends past the watermark, then a
+        # restore that must replay them ---------------------------------
+        # domain-value rows (decoded attrs, original int metrics) — the
+        # wire shape POST /druid/v2/ingest presents; frame rows align
+        # with the raw fact arrays (flat_frame preserves fact order)
+        small = ssb.gen_tables(scale=0.01)
+        frame = ssb.flat_frame(small)
+        batch = 512
+        append_cols = {}
+        for c in ssb.FLAT_DIMS:
+            v = frame[c].to_numpy()[:batch]
+            append_cols[c] = (
+                v.astype(object) if v.dtype.kind in "UO" else v
+            )
+        for m in ssb.FLAT_METRICS:
+            append_cols[m] = np.asarray(
+                small["lineorder"][m]
+            )[:batch]
+        append_cols["lo_orderdate"] = frame["lo_orderdate"].to_numpy()[
+            :batch
+        ]
+        n_batches = 16
+        for _i in range(n_batches):
+            ctx_restore.append_rows("lineorder", dict(append_cols))
+        queries = list(ssb.QUERIES)[:4]
+        pre = {q: ctx_restore.sql(ssb.QUERIES[q]) for q in queries}
+
+        t0 = _t.perf_counter()
+        ctx_replayed = sd.TPUOlapContext(_cfg(root))
+        t_restore_replay = _t.perf_counter() - t0
+        recovery = dict(ctx_replayed.storage.last_recovery or {})
+        replay_rows = int(recovery.get("replayed_rows", 0))
+        replay_s = max(t_restore_replay - t_restore, 1e-9)
+
+        # byte-identical answers across the restart (the acceptance bar)
+        identical = all(
+            pre[q].equals(ctx_replayed.sql(ssb.QUERIES[q]))
+            for q in queries
+        )
+        assert identical, "restart changed query results"
+        assert replay_rows == n_batches * batch, (
+            "WAL replay lost rows: %d != %d"
+            % (replay_rows, n_batches * batch)
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    speedup = t_reencode / max(t_restore, 1e-9)
+    return {
+        "metric": "boot_ssb_sf%g_restore_speedup" % scale,
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup, 2),
+        "detail": {
+            "rows": n_rows,
+            "reencode_boot_s": round(t_reencode, 3),
+            "restore_boot_s": round(t_restore, 3),
+            "restore_replay_boot_s": round(t_restore_replay, 3),
+            "restore_speedup": round(speedup, 2),
+            "snapshot_disk_bytes": disk_bytes,
+            "restored_disk_backed": disk_backed,
+            "wal_replayed_records": int(
+                recovery.get("replayed_records", 0)
+            ),
+            "wal_replayed_rows": replay_rows,
+            "wal_replay_rows_per_sec": round(replay_rows / replay_s),
+            "queries_identical_across_restart": identical,
+            "queries_checked": queries,
+            "oracle": "byte-identical DataFrames across kill-and-restart "
+                      "asserted; replayed row count asserted exact",
+            "device": _device(),
+        },
+    }
+
+
 def bench_calibrate(rows_log2: int):
     import os
 
@@ -2208,6 +2350,7 @@ MODES = {
     "deadline": (bench_deadline, 1.0),
     "hammer": (bench_hammer, 0.1),
     "overlap": (bench_overlap, 1.0),
+    "boot": (bench_boot, 1.0),
     "calibrate": (bench_calibrate, 23),
 }
 

@@ -133,6 +133,24 @@ class TPUOlapContext:
             min_delta_rows=self.config.compaction_min_delta_rows,
             interval_s=self.config.compaction_interval_s,
         )
+        # durable storage tier (storage.py, ISSUE 13): append WAL +
+        # crash-safe persistent segment snapshots.  Opt-in via
+        # config.storage_dir; recovery runs NOW, before the context is
+        # handed to callers — a restarted process serves the pre-crash
+        # state (snapshot mmap + WAL replay) from its first query.
+        self.storage = None
+        if self.config.storage_dir:
+            from .storage import DurableStorage
+
+            self.storage = DurableStorage(
+                self.config.storage_dir,
+                self.catalog,
+                self.ingest,
+                fsync=self.config.storage_fsync,
+            )
+            self.ingest.storage = self.storage
+            self.compactor.storage = self.storage
+            self.storage.recover(self.resilience)
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -148,6 +166,7 @@ class TPUOlapContext:
         rows_per_segment: int = 1 << 22,
         dicts: Optional[Mapping] = None,
         sort_by: Sequence[str] = (),
+        rollup_granularity: Optional[str] = None,
     ) -> DataSource:
         """Register a datasource from a pandas DataFrame, a dict of numpy
         columns, or a parquet/csv path (catalog/ingest.py).  `dicts` supplies
@@ -155,7 +174,15 @@ class TPUOlapContext:
 
         `sort_by` orders rows by the named columns before segmenting (the
         Druid secondary-partitioning analog): filters on those columns then
-        prune whole segments via zone maps instead of masking rows."""
+        prune whole segments via zone maps instead of masking rows.
+
+        `rollup_granularity` opts the datasource into Druid-style
+        ingest-time rollup: streamed appends pre-aggregate under the
+        declared granularity (time truncated to the bucket, metrics
+        summed per distinct dimension tuple) BEFORE journaling/publish.
+        Fixed-period granularities only ('second' .. 'week'); requires a
+        time column.  Changes count(*) semantics to "rolled-up rows" —
+        the documented Druid rollup trade."""
         from .catalog.ingest import to_columns_encoded
 
         cols, native_dicts = to_columns_encoded(source)
@@ -239,11 +266,32 @@ class TPUOlapContext:
             rows_per_segment=rows_per_segment,
             dicts=dicts,
         )
+        if rollup_granularity is not None:
+            from .utils.granularity import granularity_period_ms
+
+            if time_column is None:
+                raise ValueError(
+                    "rollup_granularity requires a time column"
+                )
+            if granularity_period_ms(rollup_granularity) is None:
+                raise ValueError(
+                    f"rollup_granularity {rollup_granularity!r} has no "
+                    "fixed period; use second/minute/.../week"
+                )
+            ds = dataclasses.replace(
+                ds, rollup_granularity=str(rollup_granularity).lower()
+            )
         if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
             star_schema = StarSchemaInfo.from_json(star_schema)
         # put() stamps the monotonic per-datasource version; return the
         # stamped snapshot so callers observe the same object queries see
-        return self.catalog.put(ds, star_schema)
+        published = self.catalog.put(ds, star_schema)
+        if self.storage is not None:
+            # registration is durable too: the snapshot commits before
+            # the call returns, so a post-registration crash restores
+            # the table by mmap instead of demanding a re-ingest
+            self.storage.flush(name)
+        return published
 
     def register_datasource(self, ds: DataSource, star_schema=None):
         """Register an ALREADY-BUILT DataSource (streamed/chunked ingest via
@@ -251,7 +299,10 @@ class TPUOlapContext:
         catalog.persist) under its own name."""
         if star_schema is not None and not isinstance(star_schema, StarSchemaInfo):
             star_schema = StarSchemaInfo.from_json(star_schema)
-        return self.catalog.put(ds, star_schema)
+        published = self.catalog.put(ds, star_schema)
+        if self.storage is not None:
+            self.storage.flush(ds.name)
+        return published
 
     # -- streamed ingest (the Druid realtime-node analog, ISSUE 6) ----------
 

@@ -67,6 +67,17 @@ class MetadataCache:
         with self._lock:
             return self._ds_versions.get(name, 0)
 
+    def seed_version(self, name: str, version: int) -> None:
+        """Raise the per-datasource version floor (never lowers it).
+        Boot recovery (storage.DurableStorage) seeds the floor from the
+        persisted snapshot BEFORE republishing, so versions stay
+        monotonic ACROSS process restarts — a result cached against
+        pre-crash version N can never collide with a different
+        post-restart segment set stamped N again."""
+        with self._lock:
+            cur = self._ds_versions.get(name, 0)
+            self._ds_versions[name] = max(cur, int(version))
+
     def get(self, name: str) -> Optional[DataSource]:
         with self._lock:
             return self._tables.get(name)

@@ -291,6 +291,15 @@ class DataSource:
     segments: Tuple[Segment, ...]
     time_column: Optional[str] = None
     version: int = 0
+    # ingest-time rollup (ISSUE 13 tentpole (d), the Druid `rollup` spec
+    # analog): a fixed-period granularity name ("minute", "hour", ...)
+    # declared at registration.  Appends pre-aggregate under it — time
+    # truncates to the bucket, rows group by (all dimensions, bucket),
+    # metrics SUM — before the batch is journaled or encoded, shrinking
+    # WAL volume and query-time delta scans.  Like Druid, opting in
+    # changes count(*) semantics: it counts ROLLED rows; declare an
+    # explicit count metric to preserve event counts.  None = exact rows.
+    rollup_granularity: Optional[str] = None
 
     @property
     def num_rows(self) -> int:

@@ -241,6 +241,18 @@ class SessionConfig:
     max_concurrent_ingests: int = 2
     ingest_queue_timeout_ms: int = 2000
 
+    # -- durable storage tier (storage.py / ingest/wal.py, ISSUE 13) --------
+    # root directory of the persistent tier: per-datasource append WALs +
+    # versioned columnar snapshots.  None (default) keeps the catalog
+    # purely in-process — nothing survives a restart, exactly the
+    # pre-ISSUE-13 behavior.  When set, a context constructor RECOVERS:
+    # snapshot mmap-load + WAL replay, zero re-ingest/re-encode.
+    storage_dir: Optional[str] = None
+    # fsync each WAL record before the publish/ack (the durability
+    # contract).  False trades the acked-append-survives-crash guarantee
+    # for append latency — tests and bulk loads only.
+    storage_fsync: bool = True
+
     # -- observability (obs/) -----------------------------------------------
     # slow-query log: a finished query whose span-tree total exceeds this
     # logs the rendered tree at WARNING through utils/log.py; 0 disables

@@ -527,6 +527,18 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         bucket instead of transfer stall."""
         import time as _time
 
+        # DISK rung of the residency ladder (ISSUE 13): snapshot-restored
+        # columns arrive as np.memmap views over the persisted .npy files
+        # (catalog/persist.LazyColumnMap).  Materialize to host RAM HERE —
+        # the one chokepoint both the foreground miss path and the
+        # prefetch pipeline ride — so page-fault time lands inside the
+        # measured transfer window (prefetched puts thus overlap the DISK
+        # read behind compute too, not just the link), and the device
+        # never holds a buffer aliasing a file that compaction may retire.
+        from ..catalog.persist import is_disk_backed, materialize
+
+        if is_disk_backed(host):
+            host = materialize(host)
         fire("h2d")  # fault-injection site: host->device transfer
         t0 = _time.perf_counter()
         arr = jnp.asarray(host)

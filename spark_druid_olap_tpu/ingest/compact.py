@@ -71,6 +71,12 @@ class Compactor:
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self.compactions_total = 0
+        # durable-storage hook (storage.DurableStorage, ISSUE 13): when
+        # attached, a compaction flushes the folded snapshot to disk
+        # (atomic rename), GCs retired segment files strictly AFTER the
+        # rename commits, and truncates the WAL through the folded
+        # watermark — all under the same per-datasource ingest lock.
+        self.storage = None
 
     # -- one datasource ------------------------------------------------------
 
@@ -108,6 +114,11 @@ class Compactor:
                 s.uid for s in list(deltas) + list(absorbed)
             )
             self.ingest._dropped(dropped)
+            if self.storage is not None:
+                # still under the buffer lock: no append can extend the
+                # WAL between "every delta is folded into `published`"
+                # and the watermark the flush truncates through
+                self.storage.flush_locked(name, published)
         with self._lock:
             self.compactions_total += 1
         n_rows = sum(s.num_rows for s in deltas)

@@ -61,8 +61,16 @@ from ..catalog.segment import (
     extend_dict,
     remap_segment_codes,
 )
-from ..obs import SPAN_INGEST, SPAN_INGEST_ENCODE, record_ingest, span
+from ..obs import (
+    SPAN_INGEST,
+    SPAN_INGEST_ENCODE,
+    SPAN_ROLLUP,
+    record_ingest,
+    record_rollup,
+    span,
+)
 from ..resilience import checkpoint
+from ..utils.granularity import granularity_period_ms
 from ..utils.log import get_logger
 
 log = get_logger("ingest.delta")
@@ -101,6 +109,12 @@ class IngestManager:
         # eviction hook: called with the uids of segments that left the
         # published set (the engine drops their device residency)
         self.on_segments_dropped = None
+        # durable-storage hook (storage.DurableStorage, ISSUE 13): when
+        # attached, every append journals its normalized batch to the
+        # per-datasource WAL — fsync'd — BEFORE the publish below, so an
+        # ack implies durability.  None = the pre-ISSUE-13 in-process
+        # tier (nothing survives a restart).
+        self.storage = None
 
     def _seal_rows(self) -> int:
         return int(getattr(self.config, "delta_seal_rows", 1 << 16) or 1 << 16)
@@ -146,7 +160,15 @@ class IngestManager:
                     "datasourceVersion": ds.version,
                     "totalRows": ds.num_rows,
                 }
-            with span(SPAN_INGEST_ENCODE, rows=n):
+            # ingest-time rollup BEFORE the journal point: the WAL stores
+            # (and boot replays) the already-rolled batch, so the rollup
+            # shrinks durable volume too, not just the delta scan
+            cols, n_stored = rollup_batch(ds, cols, n)
+            # journal-before-publish (storage-discipline/GL2001): once
+            # this returns, the batch is fsync-durable — a crash at any
+            # later point replays it; a crash before it never acked
+            self._journal(name, cols, n_stored)
+            with span(SPAN_INGEST_ENCODE, rows=n_stored):
                 ds2, dropped = self._append_encoded(ds, cols, buf)
             published = self.catalog.put(ds2)
             self._dropped(dropped)
@@ -156,6 +178,34 @@ class IngestManager:
                 "datasourceVersion": published.version,
                 "totalRows": published.num_rows,
             }
+
+    def _journal(self, name: str, cols: Dict[str, np.ndarray],
+                 n: int) -> Optional[int]:
+        """WAL journal point of the append path (no-op without an
+        attached durable-storage tier).  Caller holds the buffer lock."""
+        storage = self.storage
+        if storage is None:
+            return None
+        return storage.journal_append(name, cols, n)
+
+    def replay_batch(
+        self, name: str, cols: Dict[str, np.ndarray]
+    ) -> DataSource:
+        """Boot-time WAL replay of one journaled batch: the exact
+        `_append_encoded` path appends use — dictionary extension,
+        remap, encode, seq stamping — WITHOUT re-journaling (the record
+        is already durable) and without an ack.  Replayed state is
+        therefore code-identical to what the pre-crash process
+        published."""
+        buf = self.buffer(name)
+        with buf._lock:
+            ds = self.catalog.get(name)
+            if ds is None:
+                raise KeyError(f"unknown datasource {name!r}")
+            ds2, dropped = self._append_encoded(ds, cols, buf)
+            published = self.catalog.put(ds2)
+            self._dropped(dropped)
+            return published
 
     def _append_encoded(
         self, ds: DataSource, cols: Dict[str, np.ndarray], buf: _DeltaBuffer
@@ -229,6 +279,70 @@ class IngestManager:
             ),
             dropped,
         )
+
+
+def rollup_batch(
+    ds: DataSource, cols: Dict[str, np.ndarray], n: int
+) -> Tuple[Dict[str, np.ndarray], int]:
+    """Pre-aggregate one normalized append batch under the datasource's
+    declared rollup granularity (ISSUE 13 tentpole (d)).
+
+    Time truncates to its granularity bucket; rows group by (every
+    dimension, bucket); metrics SUM — the Druid ingest-spec `rollup`
+    contract.  Runs BEFORE the WAL journal point, so durable volume and
+    query-time delta scans both shrink.  Identity when no granularity is
+    declared.  Deterministic (sorted group order), so a replayed WAL
+    batch — journaled post-rollup — re-encodes byte-identically."""
+    gran = getattr(ds, "rollup_granularity", None)
+    if not gran or n == 0:
+        return cols, n
+    period = granularity_period_ms(gran)
+    if period is None or ds.time_column is None:
+        # calendar granularities and timeless tables are rejected at
+        # registration; reaching here means the snapshot predates the
+        # check — fail safe by storing exact rows
+        return cols, n
+    import pandas as pd
+
+    with span(SPAN_ROLLUP, datasource=ds.name, rows_in=n):
+        bucket = (
+            np.asarray(cols[ds.time_column], dtype=np.int64) // period
+        ) * period
+        dim_names = [c.name for c in ds.columns if c.is_dimension]
+        met_names = [c.name for c in ds.columns if c.is_metric]
+        frame = {d: cols[d] for d in dim_names}
+        frame["__bucket__"] = bucket
+        mets = pd.DataFrame({m: cols[m] for m in met_names})
+        keyed = pd.concat([pd.DataFrame(frame), mets], axis=1)
+        grouped = keyed.groupby(
+            dim_names + ["__bucket__"], dropna=False, sort=True,
+            as_index=False,
+        )[met_names].sum()
+        out: Dict[str, np.ndarray] = {}
+        for d in dim_names:
+            a = grouped[d].to_numpy()
+            if a.dtype.kind in "Of":
+                src = np.asarray(cols[d])
+                if src.dtype.kind == "O":
+                    # groupby surfaces nulls as NaN; the encode path
+                    # expects object columns with None
+                    a = np.asarray(
+                        [None if pd.isna(v) else v for v in a],
+                        dtype=object,
+                    )
+                elif src.dtype.kind in "iu" and a.dtype.kind == "f":
+                    a = a.astype(src.dtype)
+            out[d] = a
+        out[ds.time_column] = grouped["__bucket__"].to_numpy(np.int64)
+        for m in met_names:
+            a = grouped[m].to_numpy()
+            src = np.asarray(cols[m])
+            if a.dtype != src.dtype:
+                a = a.astype(src.dtype)
+            out[m] = a
+        n_out = len(grouped)
+        record_rollup(ds.name, n, n_out)
+    return out, n_out
 
 
 def _domain_values(col: np.ndarray, d: DimensionDict) -> list:

@@ -22,6 +22,11 @@ from .registry import (  # noqa: F401
     record_ingest,
     record_partial,
     record_query_metrics,
+    record_rollup,
+    record_snapshot_flush,
+    record_storage_load,
+    record_wal_append,
+    record_wal_replay,
 )
 from . import prof  # noqa: F401  (performance attribution, ISSUE 9)
 from .trace import (  # noqa: F401
@@ -47,10 +52,14 @@ from .trace import (  # noqa: F401
     SPAN_PREFETCH,
     SPAN_QUERY,
     SPAN_RETRY,
+    SPAN_ROLLUP,
     SPAN_SEGMENT_DISPATCH,
+    SPAN_SNAPSHOT_FLUSH,
     SPAN_SPARSE_DISPATCH,
     SPAN_STREAM_CHUNK,
     SPAN_STREAM_FLUSH,
+    SPAN_WAL_APPEND,
+    SPAN_WAL_REPLAY,
     QueryTrace,
     Span,
     TraceRing,

@@ -544,6 +544,103 @@ def record_compaction(datasource: str, rows: int, delta_segments: int) -> None:
         ).labels(datasource=ds).inc(delta_segments)
 
 
+def record_wal_append(datasource: str, rows: int) -> None:
+    """Publish one durable WAL journal write (storage tier, ISSUE 13):
+    acked appends are exactly the journaled ones, so this series is the
+    durability-side mirror of `sdol_ingest_rows_total`."""
+    reg = get_registry()
+    ds = bounded_label("ingest_datasource", datasource)
+    reg.counter(
+        "sdol_wal_appends_total",
+        "fsync'd WAL journal writes, by datasource",
+        labels=("datasource",),
+    ).labels(datasource=ds).inc()
+    if rows:
+        reg.counter(
+            "sdol_wal_rows_total",
+            "rows journaled to the append WAL",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(rows)
+
+
+def record_wal_replay(datasource: str, records: int, rows: int) -> None:
+    """Publish one boot-time WAL replay (records past the snapshot
+    watermark re-applied through the live append path)."""
+    reg = get_registry()
+    ds = bounded_label("ingest_datasource", datasource)
+    reg.counter(
+        "sdol_wal_replays_total",
+        "boot-time WAL replay passes, by datasource",
+        labels=("datasource",),
+    ).labels(datasource=ds).inc()
+    if records:
+        reg.counter(
+            "sdol_wal_replayed_records_total",
+            "WAL records replayed at boot",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(records)
+    if rows:
+        reg.counter(
+            "sdol_wal_replayed_rows_total",
+            "rows re-applied from the WAL at boot",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(rows)
+
+
+def record_snapshot_flush(datasource: str, segments: int) -> None:
+    """Publish one persistent-snapshot commit (atomic rename landed)."""
+    reg = get_registry()
+    ds = bounded_label("ingest_datasource", datasource)
+    reg.counter(
+        "sdol_snapshot_flushes_total",
+        "persistent segment snapshot commits, by datasource",
+        labels=("datasource",),
+    ).labels(datasource=ds).inc()
+    if segments:
+        reg.counter(
+            "sdol_snapshot_segments_total",
+            "segments written by snapshot flushes",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(segments)
+
+
+def record_rollup(datasource: str, rows_in: int, rows_out: int) -> None:
+    """Publish one ingest-time rollup: input vs surviving rows.  The
+    ratio is the fleet-level answer to "what does rollup actually buy"
+    — Druid's own rollup-ratio metric."""
+    reg = get_registry()
+    ds = bounded_label("ingest_datasource", datasource)
+    if rows_in:
+        reg.counter(
+            "sdol_rollup_input_rows_total",
+            "append rows entering ingest-time rollup",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(rows_in)
+    if rows_out:
+        reg.counter(
+            "sdol_rollup_output_rows_total",
+            "pre-aggregated rows surviving ingest-time rollup",
+            labels=("datasource",),
+        ).labels(datasource=ds).inc(rows_out)
+
+
+def record_storage_load(nbytes: int) -> None:
+    """Publish one disk-tier column open (np.load mmap of a persisted
+    column file): the DISK rung of the residency ladder, next to the
+    h2d byte counters the device tiers publish."""
+    reg = get_registry()
+    reg.counter(
+        "sdol_storage_column_opens_total",
+        "lazy opens of persisted column files (disk residency tier)",
+    ).inc()
+    if nbytes:
+        reg.counter(
+            "sdol_storage_column_bytes_total",
+            "logical bytes of persisted columns opened from disk "
+            "(mmap-backed; pages fault in lazily on first touch)",
+        ).inc(nbytes)
+
+
 def record_partial(coverage, site: str = "", query_id: str = "") -> None:
     """Publish one deadline-bounded PARTIAL answer: a count by triggering
     site plus the coverage-fraction distribution (ISSUE 7 tentpole (a)).

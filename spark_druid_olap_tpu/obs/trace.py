@@ -82,6 +82,10 @@ SPAN_STREAM_FLUSH = "stream_flush"  # one progressive-response refinement
 SPAN_FUSED_BATCH = "fused_batch"  # one micro-batch fused execution (serve/)
 SPAN_LANE = "lane"  # waiting for a priority-lane slot (serve/lanes.py)
 SPAN_PREFETCH = "prefetch"  # async h2d issue overlapped behind compute
+SPAN_WAL_APPEND = "wal_append"  # fsync'd journal write of one append batch
+SPAN_WAL_REPLAY = "wal_replay"  # boot-time WAL replay of one datasource
+SPAN_SNAPSHOT_FLUSH = "snapshot_flush"  # persistent segment snapshot commit
+SPAN_ROLLUP = "rollup"  # ingest-time pre-aggregation of an append batch
 
 SPAN_NAMES = frozenset(
     {
@@ -110,6 +114,10 @@ SPAN_NAMES = frozenset(
         SPAN_FUSED_BATCH,
         SPAN_LANE,
         SPAN_PREFETCH,
+        SPAN_WAL_APPEND,
+        SPAN_WAL_REPLAY,
+        SPAN_SNAPSHOT_FLUSH,
+        SPAN_ROLLUP,
     }
 )
 

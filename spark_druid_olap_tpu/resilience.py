@@ -501,7 +501,23 @@ def prefetch_pressed() -> bool:
 # ---------------------------------------------------------------------------
 
 # the named instrumentation points the runtime actually fires
-SITES = ("device_dispatch", "h2d", "compile", "fallback_decode")
+SITES = (
+    "device_dispatch",
+    "h2d",
+    "compile",
+    "fallback_decode",
+    # durable storage tier (ISSUE 13): every stage of the WAL/publish
+    # pipeline is a kill point the crash-safety harness arms — the
+    # ordering contract (journal -> fsync -> publish -> snapshot rename
+    # -> retire -> truncate) is PROVEN by killing at each one
+    "wal.journal_write",  # before any journal byte lands
+    "wal.pre_fsync",  # bytes written, not yet durable (torn-tail zone)
+    "wal.post_fsync_pre_publish",  # durable but unpublished (un-acked)
+    "wal.replay_record",  # between replayed records at boot
+    "persist.snapshot_rename",  # before the snapshot.json commit rename
+    "compact.retire",  # before retired segment files are deleted
+    "storage.replay_batch",  # before a replayed batch is re-applied
+)
 
 
 class _FaultSpec:

@@ -336,7 +336,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, True)
             # breaker state + slots in use: a load balancer (or the
             # concurrent-serving test) reads degradation from here
-            return self._send(200, res.health())
+            doc = res.health()
+            storage = getattr(self.ctx, "storage", None)
+            # durable-tier state (ISSUE 13): WAL sequence, last snapshot
+            # version, replay-in-progress, dirty-delta counts — what an
+            # operator needs to answer "what would a restart lose" (zero)
+            # and "is this node still replaying"
+            doc["storage"] = (
+                storage.state() if storage is not None
+                else {"enabled": False}
+            )
+            return self._send(200, doc)
         if path == "/status/metrics":
             # Prometheus text exposition of the process registry (engines,
             # resilience, http counters, per-phase latency histograms)
@@ -477,6 +487,23 @@ class _Handler(BaseHTTPRequestHandler):
                     pass
 
     def _handle_query(self, path, body, qctx, res, cfg):
+        # a recovering node is BUSY, not wedged: while boot WAL replay is
+        # still applying journaled appends, answering queries would serve
+        # a state mid-way between the snapshot and the pre-crash tail —
+        # 503 + Retry-After tells the balancer to come back, exactly like
+        # an exhausted admission pool does
+        storage = getattr(self.ctx, "storage", None)
+        if storage is not None and storage.replay_in_progress:
+            return self._error(
+                503,
+                "node is recovering (WAL replay in progress); retry later",
+                "QueryUnavailableException",
+                headers={
+                    "Retry-After": res.admission.retry_after_s()
+                    if res is not None
+                    else 1
+                },
+            )
         # admission is per-route and LANE-FIRST (serve/lanes.py): the
         # query takes its priority lane's slot before the global pool,
         # so a heavy query queued on a full heavy lane never sits on a

@@ -125,6 +125,11 @@ def test_production_tag_keys_scale(monkeypatch):
     mode, fn, arg = bench._parse_args(["overlap", "1"])
     assert "%s_%g" % (mode, arg) == "overlap_1"
     assert fn is bench.bench_overlap
+    # cold-boot restore vs re-encode (ISSUE 13): SSB scale-factor arg
+    mode, fn, arg = bench._parse_args(["boot", "10"])
+    assert "%s_%g" % (mode, arg) == "boot_10"
+    assert fn is bench.bench_boot
+    assert isinstance(bench.MODES["boot"][1], float)
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -337,6 +342,51 @@ def test_emit_overlap_result_shape(capsys, tmp_path, monkeypatch):
         == 0.0
     )
     assert detail["detail"]["results_identical_on_vs_off"] is True
+
+
+def test_emit_boot_result_shape(capsys, tmp_path, monkeypatch):
+    """The boot mode's headline (restore speedup vs cold re-encode) stays
+    one compact line; the per-phase timings and recovery counters live in
+    the detail sidecar."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    bench._emit(
+        {
+            "metric": "boot_ssb_sf10_restore_speedup",
+            "value": 118.4,
+            "unit": "x",
+            "vs_baseline": 118.4,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 59_986_052,
+                "reencode_boot_s": 212.4,
+                "restore_boot_s": 1.79,
+                "restore_replay_boot_s": 2.31,
+                "restore_speedup": 118.4,
+                "snapshot_disk_bytes": 3_221_225_472,
+                "restored_disk_backed": True,
+                "wal_replayed_records": 16,
+                "wal_replayed_rows": 8192,
+                "wal_replay_rows_per_sec": 81_331,
+                "queries_identical_across_restart": True,
+                "queries_checked": ["q1_1", "q1_2", "q1_3", "q2_1"],
+                "oracle": "byte-identical DataFrames across "
+                          "kill-and-restart asserted",
+            },
+        },
+        "boot_10",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "boot_ssb_sf10_restore_speedup"
+    assert parsed["value"] == 118.4
+    assert parsed["vs_baseline"] == 118.4
+    detail = json.load(open(tmp_path / "BENCH_boot_10_detail.json"))
+    assert detail["detail"]["restored_disk_backed"] is True
+    assert detail["detail"]["queries_identical_across_restart"] is True
+    assert detail["detail"]["wal_replayed_rows"] == 8192
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

@@ -1986,6 +1986,97 @@ _MATRIX = {
             """},
         ],
     },
+    "storage-discipline": {
+        "violating": [
+            # GL2001: append path publishes without journaling — an
+            # acked append a restart silently forgets
+            (
+                {"spark_druid_olap_tpu/ingest/delta.py": """
+                    class IngestManager:
+                        def append_rows(self, name, rows):
+                            ds = self.catalog.get(name)
+                            return self.catalog.put(ds)
+                """},
+                {"GL2001"},
+            ),
+            # GL2002: snapshot written straight to its final name — a
+            # crash mid-write leaves a torn file the next boot loads
+            (
+                {"spark_druid_olap_tpu/storage.py": """
+                    import json
+
+                    def save_snapshot(snap, path):
+                        with open(path, "w") as f:
+                            json.dump(snap, f)
+                """},
+                {"GL2002"},
+            ),
+            # GL2003: WAL replay loop with no checkpoint — invisible to
+            # the deadline budget AND the crash-injection matrix
+            (
+                {"spark_druid_olap_tpu/ingest/wal.py": """
+                    class WriteAheadLog:
+                        def replay(self, apply):
+                            for rec in self.scan_wal():
+                                apply(rec)
+                """},
+                {"GL2003"},
+            ),
+        ],
+        "clean": [
+            # journaled publish, atomic snapshot commit, checkpointed
+            # replay loop — the real tier's shapes
+            {"spark_druid_olap_tpu/ingest/delta.py": """
+                class IngestManager:
+                    def append_rows(self, name, rows):
+                        cols = self._normalize(rows)
+                        self._journal(name, cols)
+                        ds = self.catalog.get(name)
+                        return self.catalog.put(ds)
+            """,
+             "spark_druid_olap_tpu/storage.py": """
+                import json
+                import os
+
+                from .resilience import checkpoint
+
+                def save_snapshot(snap, path):
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(snap, f)
+                    os.replace(tmp, path)
+
+                def recover(wal, ingest):
+                    for rec in wal.replay_after(-1):
+                        checkpoint("storage.replay_batch")
+                        ingest.replay_batch(rec)
+            """},
+            # the append-mode journal write is the sanctioned non-atomic
+            # exception; the same write shapes OUTSIDE the storage tier
+            # are other passes' business
+            {"spark_druid_olap_tpu/ingest/wal.py": """
+                import io
+
+                import numpy as np
+
+                class WriteAheadLog:
+                    def _handle(self):
+                        return open(self.path, "ab")
+
+                def atomic_write_array(path, arr):
+                    buf = io.BytesIO()
+                    np.save(buf, arr)
+                    atomic_write_bytes(path, buf.getvalue())
+            """,
+             "spark_druid_olap_tpu/exec/engine.py": """
+                import json
+
+                def dump_debug(doc, path):
+                    with open(path, "w") as f:
+                        json.dump(doc, f)
+            """},
+        ],
+    },
 }
 
 

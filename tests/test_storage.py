@@ -1,0 +1,473 @@
+"""Durable storage tier (ISSUE 13): append WAL, crash-safe persistent
+segments, kill-and-restart recovery.
+
+The contract under test, everywhere: a "kill" is a fresh
+`TPUOlapContext(SessionConfig(storage_dir=d))` over the same directory
+with NO shutdown of the old context — exactly what a SIGKILL leaves
+behind.  After any kill at any armed fault site, the restarted node
+must serve answers equal to a from-scratch oracle over the rows whose
+appends were ACKED (un-acked batches may surface fully or not at all,
+never partially), with zero re-ingest: historical segments come back
+memmap-backed off the snapshot, and only the WAL tail replays.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.catalog.persist import (
+    LazyColumnMap,
+    SNAPSHOT_NAME,
+)
+from spark_druid_olap_tpu.ingest.wal import (
+    MAGIC,
+    WriteAheadLog,
+    decode_batch,
+    encode_batch,
+)
+from spark_druid_olap_tpu.resilience import InjectedFault, injector
+
+T0 = int(np.datetime64("2023-01-01", "ms").astype(np.int64))
+DAY = 86_400_000
+
+Q = (
+    "SELECT city, sum(qty) AS q, count(*) AS n "
+    "FROM ev GROUP BY city ORDER BY city"
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    injector().disarm()
+    yield
+    injector().disarm()
+
+
+def _base_cols(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(
+            np.array(["austin", "boston", "chicago"], dtype=object), n
+        ),
+        "qty": rng.integers(1, 100, n).astype(np.int64),
+        "ts": T0 + rng.integers(0, 30, n) * DAY,
+    }
+
+
+def _append_cols(n=40, seed=7):
+    return _base_cols(n, seed)
+
+
+def _ctx(d, **kw):
+    return sd.TPUOlapContext(sd.SessionConfig(storage_dir=str(d), **kw))
+
+
+def _register(ctx, cols=None, **kw):
+    return ctx.register_table(
+        "ev", cols if cols is not None else _base_cols(),
+        dimensions=["city"], metrics=["qty"], time_column="ts", **kw
+    )
+
+
+def _oracle(*col_maps):
+    """Query result for the concatenation of `col_maps`, re-ingested
+    from scratch in a non-durable context."""
+    cat = {
+        k: np.concatenate([np.asarray(c[k]) for c in col_maps])
+        for k in col_maps[0]
+    }
+    ctx = sd.TPUOlapContext()
+    ctx.register_table(
+        "ev", cat, dimensions=["city"], metrics=["qty"], time_column="ts"
+    )
+    return ctx.sql(Q)
+
+
+# -- WAL unit level ----------------------------------------------------------
+
+
+def test_encode_decode_roundtrip():
+    cols = {
+        "city": np.asarray(["a", None, "c"], dtype=object),
+        "qty": np.asarray([1, 2, 3], dtype=np.int64),
+        "rev": np.asarray([0.5, 1.5, 2.5], dtype=np.float32),
+    }
+    ds, out, n = decode_batch(encode_batch("ev", cols, 3))
+    assert ds == "ev" and n == 3
+    assert list(out["city"]) == ["a", None, "c"]
+    assert out["qty"].dtype == np.int64
+    assert np.array_equal(out["qty"], cols["qty"])
+    assert out["rev"].dtype == np.float32
+    assert np.array_equal(out["rev"], cols["rev"])
+
+
+def test_wal_seq_monotone_and_reopen_seeds(tmp_path):
+    p = str(tmp_path / "wal.log")
+    w = WriteAheadLog(p)
+    cols = {"x": np.arange(4, dtype=np.int64)}
+    assert w.last_seq == -1
+    assert [w.append("ev", cols, 4) for _ in range(3)] == [0, 1, 2]
+    assert w.last_seq == 2
+    w.close()
+    # a restarted process must never reuse a seq
+    w2 = WriteAheadLog(p)
+    assert w2.last_seq == 2
+    assert w2.append("ev", cols, 4) == 3
+    w2.close()
+
+
+def test_wal_truncate_through_keeps_tail(tmp_path):
+    p = str(tmp_path / "wal.log")
+    w = WriteAheadLog(p)
+    for i in range(5):
+        w.append("ev", {"x": np.asarray([i], dtype=np.int64)}, 1)
+    assert w.truncate_through(2) == 2
+    got = list(w.scan())
+    assert [seq for seq, _, _, _ in got] == [3, 4]
+    assert [int(c["x"][0]) for _, _, c, _ in got] == [3, 4]
+    w.close()
+    assert WriteAheadLog(p).last_seq == 4
+
+
+def test_wal_torn_tail_every_byte_boundary(tmp_path):
+    """ISSUE 13 satellite 4: truncate the log at EVERY byte boundary of
+    the final record.  Replay must return the two whole records intact
+    and drop the torn third cleanly — full restore or full drop of the
+    tail, never a partial batch."""
+    p = str(tmp_path / "wal.log")
+    w = WriteAheadLog(p)
+    batches = [
+        {"city": np.asarray(["a", "b"], dtype=object),
+         "qty": np.asarray([i, i + 1], dtype=np.int64)}
+        for i in range(3)
+    ]
+    for b in batches:
+        w.append("ev", b, 2)
+    w.close()
+    blob = open(p, "rb").read()
+    # offset where record 2 (the final one) begins
+    w2 = WriteAheadLog(p)
+    sizes = []
+    off = 0
+    import struct
+    import zlib
+    head = struct.Struct("<4sIQI")
+    for _ in range(3):
+        _, plen, _, _ = head.unpack_from(blob, off)
+        sizes.append(head.size + plen)
+        off += head.size + plen
+    assert off == len(blob)
+    w2.close()
+    tail_start = sizes[0] + sizes[1]
+
+    torn = str(tmp_path / "torn.log")
+    for cut in range(tail_start, len(blob)):
+        with open(torn, "wb") as fh:
+            fh.write(blob[:cut])
+        got = list(WriteAheadLog(torn).scan())
+        assert len(got) == 2, f"cut at byte {cut}: {len(got)} records"
+        for i, (seq, ds, cols, n) in enumerate(got):
+            assert seq == i and ds == "ev" and n == 2
+            assert np.array_equal(cols["qty"], batches[i]["qty"])
+    # the untruncated log replays all three
+    assert len(list(WriteAheadLog(p).scan())) == 3
+
+
+def test_wal_corrupt_record_stops_scan(tmp_path):
+    p = str(tmp_path / "wal.log")
+    w = WriteAheadLog(p)
+    for i in range(2):
+        w.append("ev", {"x": np.asarray([i], dtype=np.int64)}, 1)
+    w.close()
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # flip a byte mid-log
+    with open(p, "wb") as fh:
+        fh.write(bytes(blob))
+    got = list(WriteAheadLog(p).scan())
+    # everything from the corrupt record onward is dropped whole
+    assert all(np.array_equal(c["x"], [s]) for s, _, c, _ in got)
+    assert len(got) < 2
+
+
+def test_wal_bad_magic_stops_scan(tmp_path):
+    p = str(tmp_path / "wal.log")
+    w = WriteAheadLog(p)
+    w.append("ev", {"x": np.asarray([1], dtype=np.int64)}, 1)
+    w.close()
+    with open(p, "ab") as fh:
+        fh.write(b"XXXX" + b"\x00" * 20)
+    assert len(list(WriteAheadLog(p).scan())) == 1
+    assert MAGIC == b"SDW1"
+
+
+# -- kill-free restart (the post-ack crash) ----------------------------------
+
+
+def test_restart_serves_identical_and_disk_backed(tmp_path):
+    """Acked appends survive a kill: fresh context over the same dir,
+    byte-identical answers, snapshot restored as memmaps (zero
+    re-ingest), and only the WAL tail replayed."""
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    ack = ctx.append_rows("ev", extra)
+    assert ack["appended"] == 40
+    want = ctx.sql(Q)
+
+    ctx2 = _ctx(tmp_path)
+    assert ctx2.sql(Q).equals(want)
+    assert ctx2.sql(Q).equals(_oracle(base, extra))
+    ds = ctx2.catalog.get("ev")
+    assert all(
+        isinstance(s.dims, LazyColumnMap) for s in ds.historical_segments()
+    ), "snapshot restore must be mmap-backed, not re-encoded"
+    rec = ctx2.storage.last_recovery
+    assert rec["replayed_rows"] == 40 and rec["datasources"] == 1
+
+
+def test_compaction_flushes_and_truncates_wal(tmp_path):
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    ctx.append_rows("ev", extra)
+    ctx.compact("ev")
+    want = ctx.sql(Q)
+    # the flush folded the WAL into the snapshot: nothing to replay
+    ctx2 = _ctx(tmp_path)
+    assert ctx2.storage.last_recovery["replayed_rows"] == 0
+    assert ctx2.sql(Q).equals(want)
+    assert ctx2.sql(Q).equals(_oracle(base, extra))
+
+
+def test_version_monotone_across_restart(tmp_path):
+    ctx = _ctx(tmp_path)
+    _register(ctx)
+    v1 = ctx.append_rows("ev", _append_cols())["datasourceVersion"]
+    ctx2 = _ctx(tmp_path)
+    v2 = ctx2.append_rows("ev", _append_cols(seed=9))["datasourceVersion"]
+    assert v2 > v1, "restart must not regress the version stamp"
+
+
+# -- kill-and-restart at every injected site ---------------------------------
+
+
+@pytest.mark.parametrize(
+    "site",
+    ["wal.journal_write", "wal.pre_fsync", "wal.post_fsync_pre_publish"],
+)
+def test_kill_mid_append(tmp_path, site):
+    """Un-acked appends surface fully or not at all, never partially;
+    before the first journal byte they must be absent."""
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    injector().arm(site, mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        ctx.append_rows("ev", extra)
+    injector().disarm()
+
+    got = _ctx(tmp_path).sql(Q)
+    without, with_ = _oracle(base), _oracle(base, extra)
+    if site == "wal.journal_write":
+        assert got.equals(without), "no journal byte landed: batch absent"
+    else:
+        # whole-or-absent: the record was mid-journal when the process
+        # died — either truncation drops it whole or replay applies it
+        # whole; any other answer is a partial batch
+        assert got.equals(without) or got.equals(with_)
+
+
+@pytest.mark.parametrize("site", ["persist.snapshot_rename", "compact.retire"])
+def test_kill_mid_compaction(tmp_path, site):
+    """Every acked row survives a kill at either side of the snapshot
+    commit point, exactly."""
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    ctx.append_rows("ev", extra)
+    want = _oracle(base, extra)
+    assert ctx.sql(Q).equals(want)
+
+    injector().arm(site, mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        ctx.compact("ev")
+    injector().disarm()
+
+    ctx2 = _ctx(tmp_path)
+    assert ctx2.sql(Q).equals(want)
+    # and the node is fully live again: append + compact + restart
+    more = _append_cols(seed=11)
+    ctx2.append_rows("ev", more)
+    ctx2.compact("ev")
+    assert _ctx(tmp_path).sql(Q).equals(_oracle(base, extra, more))
+
+
+def test_retired_files_deleted_only_after_rename(tmp_path):
+    """ISSUE 13 satellite 6 regression: a crash between writing the new
+    snapshot and its rename must leave every file the OLD snapshot
+    references on disk — retirement strictly follows the commit."""
+    ctx = _ctx(tmp_path)
+    _register(ctx)
+    ctx.append_rows("ev", _append_cols())
+    want = ctx.sql(Q)
+    d = ctx.storage.dir_for("ev")
+    old_snapshot = open(os.path.join(d, SNAPSHOT_NAME), "rb").read()
+    old_refs = {f for f in os.listdir(d) if f.endswith(".npy")}
+    assert old_refs, "registration flush should have persisted columns"
+
+    injector().arm("persist.snapshot_rename", mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        ctx.compact("ev")
+    injector().disarm()
+
+    # commit point untouched, every old column file still present
+    assert open(os.path.join(d, SNAPSHOT_NAME), "rb").read() == old_snapshot
+    assert old_refs <= set(os.listdir(d))
+    assert _ctx(tmp_path).sql(Q).equals(want)
+
+
+def test_kill_mid_replay_then_clean_restart(tmp_path):
+    """A crash DURING boot replay is just another kill: the next boot
+    starts from the unchanged snapshot + full WAL tail and recovers
+    everything (the crashed boot published only to memory)."""
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    ctx.append_rows("ev", extra)
+    want = _oracle(base, extra)
+
+    injector().arm("storage.replay_batch", mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        _ctx(tmp_path)
+    injector().disarm()
+    assert _ctx(tmp_path).sql(Q).equals(want)
+
+
+def test_kill_during_wal_replay_record_site(tmp_path):
+    ctx = _ctx(tmp_path)
+    _register(ctx)
+    ctx.append_rows("ev", _append_cols())
+    want = ctx.sql(Q)
+    injector().arm("wal.replay_record", mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        _ctx(tmp_path)
+    injector().disarm()
+    assert _ctx(tmp_path).sql(Q).equals(want)
+
+
+# -- ingest-time rollup ------------------------------------------------------
+
+
+def test_rollup_preaggregates_under_granularity(tmp_path):
+    ctx = _ctx(tmp_path)
+    _register(ctx, rollup_granularity="day")
+    rows = {
+        "city": np.asarray(["austin"] * 4 + ["boston"] * 2, dtype=object),
+        "qty": np.asarray([1, 2, 3, 4, 10, 20], dtype=np.int64),
+        "ts": np.asarray(
+            [T0, T0 + 1, T0 + 2, T0 + DAY, T0, T0 + 3], dtype=np.int64
+        ),
+    }
+    base_total = int(ctx.sql("SELECT count(*) AS n FROM ev")["n"][0])
+    ack = ctx.append_rows("ev", rows)
+    # austin day0 (3 rows) + austin day1 + boston day0 -> 3 rolled rows
+    assert ack["appended"] == 6
+    assert ack["totalRows"] == base_total + 3
+    s = ctx.sql(
+        "SELECT city, sum(qty) AS q FROM ev GROUP BY city ORDER BY city"
+    )
+    base = _oracle(_base_cols())
+    base_q = {c: int(q) for c, q in zip(base["city"], base["q"])}
+    got = {c: int(q) for c, q in zip(s["city"], s["q"])}
+    assert got["austin"] == base_q["austin"] + 10
+    assert got["boston"] == base_q["boston"] + 30
+    # rolled rows are what the WAL journals: a restart replays them and
+    # answers identically
+    want = ctx.sql(Q)
+    assert _ctx(tmp_path).sql(Q).equals(want)
+
+
+def test_rollup_rejects_calendar_granularity(tmp_path):
+    ctx = _ctx(tmp_path)
+    with pytest.raises(ValueError):
+        _register(ctx, rollup_granularity="month")
+
+
+def test_rollup_requires_time_column():
+    ctx = sd.TPUOlapContext()
+    with pytest.raises(ValueError):
+        ctx.register_table(
+            "flat", {"city": np.asarray(["a"], dtype=object),
+                     "qty": np.asarray([1], dtype=np.int64)},
+            dimensions=["city"], metrics=["qty"],
+            rollup_granularity="hour",
+        )
+
+
+# -- health / serving surface ------------------------------------------------
+
+
+def test_health_storage_state_shape(tmp_path):
+    ctx = _ctx(tmp_path)
+    _register(ctx)
+    ctx.append_rows("ev", _append_cols())
+    st = ctx.storage.state()
+    assert st["enabled"] is True
+    assert st["root"] == str(tmp_path)
+    assert st["replay_in_progress"] is False
+    ev = st["datasources"]["ev"]
+    assert ev["wal_last_seq"] >= 0
+    assert ev["snapshot_version"] >= 1
+    assert ev["dirty_delta_segments"] >= 1
+    assert ev["dirty_delta_rows"] == 40
+    # after a restart the recovery ledger is populated
+    st2 = _ctx(tmp_path).storage.state()
+    assert st2["last_recovery"]["replayed_rows"] == 40
+
+
+def test_server_health_and_503_during_replay(tmp_path):
+    from spark_druid_olap_tpu.server import OlapServer
+
+    ctx = _ctx(tmp_path)
+    _register(ctx)
+    srv = OlapServer(ctx, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/status/health", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["storage"]["enabled"] is True
+        assert "ev" in doc["storage"]["datasources"]
+
+        payload = json.dumps(
+            {"query": "SELECT count(*) AS n FROM ev"}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2/sql", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        # a recovering node 503s queries with Retry-After
+        ctx.storage.replay_in_progress = True
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+            body = json.loads(ei.value.read())
+            assert body["errorClass"] == "QueryUnavailableException"
+        finally:
+            ctx.storage.replay_in_progress = False
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()
+
+
+def test_non_durable_context_has_no_storage():
+    ctx = sd.TPUOlapContext()
+    assert ctx.storage is None

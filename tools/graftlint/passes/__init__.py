@@ -11,8 +11,8 @@ lock-discipline, GL6xx error-discipline, GL7xx pallas-shape, GL8xx
 collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
 lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
-serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline;
-GL00x are the core's own: GL001 unparseable file, GL002 malformed
+serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
+GL20xx storage-discipline; GL00x are the core's own: GL001 unparseable file, GL002 malformed
 pragma).
 """
 
@@ -37,6 +37,7 @@ from .partial_discipline import PartialDisciplinePass
 from .resource_budget import ResourceBudgetPass
 from .serving_discipline import ServingDisciplinePass
 from .span_discipline import SpanDisciplinePass
+from .storage_discipline import StorageDisciplinePass
 from .trace_purity import TracePurityPass
 from .transfer_discipline import TransferDisciplinePass
 from .wire_parity import WireParityPass
@@ -61,6 +62,7 @@ ALL_PASSES = (
     ServingDisciplinePass,
     ObsDisciplinePass,
     TransferDisciplinePass,
+    StorageDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

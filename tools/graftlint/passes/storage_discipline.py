@@ -1,0 +1,242 @@
+"""storage-discipline pass: the durable storage tier's contracts
+(GL20xx, ISSUE 13 satellite).
+
+The storage tier (ingest/wal.py, catalog/persist.py, storage.py) is
+where a crash turns a code-path ordering bug into silent data loss, so
+its three load-bearing invariants are lint-checkable:
+
+* **GL2001 — publish bypassing the WAL journal.**  The append path's
+  durability proof is an ORDERING: journal (fsync'd) strictly before
+  `catalog.put`.  An append-shaped function in the ingest tier that
+  publishes without any journal call is exactly the bug the
+  kill-and-restart matrix exists to catch — an acked append a restart
+  forgets.  Replay functions are exempt by name (they re-apply records
+  that are already journaled; re-journaling would double them).
+* **GL2002 — segment/snapshot writes outside the atomic tmp+rename
+  helper.**  Every persistent file in the storage tier must become
+  visible atomically: write a tmp, fsync, `os.replace`.  A function
+  that opens a file for writing (or `np.save`s to a path) without
+  reaching `os.replace` / an `atomic_write_*` helper can leave a
+  half-written file under the final name — which a restart will happily
+  load.  Append-mode opens (`"a"`/`"ab"`) are exempt: the WAL journal
+  is the tier's one legitimate non-atomic write (torn tails are handled
+  structurally by its framing).
+* **GL2003 — replay/scan loop never reaches a checkpoint.**  WAL replay
+  and truncation iterate arbitrarily large logs; a loop that cannot
+  observe `resilience.checkpoint` (lexically or one call down) is
+  invisible to both the deadline budget and the fault-injection
+  harness — the crash-safety matrix arms `wal.replay_record` /
+  `storage.replay_batch` and expects every replay loop to pass through
+  them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import LintPass, ModuleContext, dotted_name
+
+# write-intent open() modes that demand the atomic helper; append modes
+# are the sanctioned journal exception
+_WRITE_MODES = ("w", "wb", "w+", "wb+", "w+b", "x", "xb")
+
+_LOOP_KEYWORDS = ("replay", "wal", "journal", "scan")
+
+
+def _is_checkpoint(name: str, canon: str) -> bool:
+    return (
+        name == "checkpoint"
+        or name.endswith(".checkpoint")
+        or canon.endswith("resilience.checkpoint")
+    )
+
+
+def _call_name(node: ast.Call) -> str:
+    return dotted_name(node.func) or ""
+
+
+def _open_write_mode(node: ast.Call) -> Optional[str]:
+    """The literal mode string of an `open(...)` call when it implies
+    write intent, else None.  A non-literal mode is treated as write
+    intent (the lint can't prove it safe)."""
+    name = _call_name(node)
+    if not (name == "open" or name.endswith(".open")):
+        return None
+    mode_node: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode_node = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None  # default "r": read-only
+    if isinstance(mode_node, ast.Constant) and isinstance(
+        mode_node.value, str
+    ):
+        mode = mode_node.value
+        if mode.replace("t", "").replace("b", "").startswith("a"):
+            return None  # append journal: the sanctioned exception
+        if any(m in mode for m in ("w", "x", "+")):
+            return mode
+        return None
+    return "<dynamic>"
+
+
+def _is_np_save(node: ast.Call) -> bool:
+    name = _call_name(node)
+    return name in ("np.save", "np.savez", "np.savez_compressed") or (
+        name.startswith("numpy.") and ".save" in name
+    )
+
+
+class StorageDisciplinePass(LintPass):
+    name = "storage-discipline"
+    default_config = {
+        # the durable tier this pass polices (fixtures re-create the
+        # layout); GL2001 additionally needs the append path's module
+        "include": (
+            "spark_druid_olap_tpu/ingest",
+            "spark_druid_olap_tpu/catalog/persist.py",
+            "spark_druid_olap_tpu/storage.py",
+        ),
+        "keywords": _LOOP_KEYWORDS,
+        "call_through_depth": 1,
+    }
+
+    # -- GL2001: journal-before-publish on append-shaped functions ------------
+
+    @staticmethod
+    def _is_append_fn(func: Optional[ast.AST]) -> bool:
+        name = getattr(func, "name", "")
+        return name.startswith("append") or name.startswith("_append_rows")
+
+    @staticmethod
+    def _is_replay_fn(func: Optional[ast.AST]) -> bool:
+        name = getattr(func, "name", "")
+        return "replay" in name or "recover" in name
+
+    def on_FunctionDef(self, node: ast.FunctionDef, ctx: ModuleContext):
+        self._check_append_journals(node, ctx)
+
+    def on_AsyncFunctionDef(self, node, ctx: ModuleContext):
+        self._check_append_journals(node, ctx)
+
+    def _check_append_journals(self, node, ctx: ModuleContext):
+        if not self._is_append_fn(node) or self._is_replay_fn(node):
+            return
+        publish = None
+        journaled = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_name(sub)
+            if name.endswith(".put") and "catalog" in name:
+                publish = publish or sub
+            leaf = name.rsplit(".", 1)[-1]
+            if "journal" in leaf or leaf == "append" and "wal" in name:
+                journaled = True
+        if publish is not None and not journaled:
+            self.report(
+                ctx, publish, "GL2001",
+                f"append path `{node.name}` publishes via catalog.put "
+                "without journaling — durability is an ORDERING (WAL "
+                "journal, fsync'd, strictly before the publish); an "
+                "unjournaled publish is an acked append a restart "
+                "silently forgets",
+            )
+
+    # -- GL2002: atomic publish of persistent files ---------------------------
+
+    @staticmethod
+    def _fn_has_atomic_commit(func: ast.AST) -> bool:
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub)
+                leaf = name.rsplit(".", 1)[-1]
+                if name.endswith("os.replace") or leaf == "replace":
+                    return True
+                if leaf.startswith("atomic_write"):
+                    return True
+        return False
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        func = ctx.scope.current_func
+        if func is None:
+            return
+        mode = _open_write_mode(node)
+        flagged = None
+        if mode is not None:
+            flagged = f"open(..., {mode!r})"
+        elif _is_np_save(node):
+            # np.save to a file-like object (BytesIO staging inside an
+            # atomic helper) is fine; a literal/joined PATH argument is
+            # the direct-to-final-name shape
+            if node.args and isinstance(
+                node.args[0], (ast.Constant, ast.JoinedStr)
+            ):
+                flagged = _call_name(node) + "(<path>)"
+            elif node.args and isinstance(node.args[0], ast.Call) and (
+                _call_name(node.args[0]).endswith("path.join")
+            ):
+                flagged = _call_name(node) + "(<path>)"
+        if flagged is None:
+            return
+        if self._fn_has_atomic_commit(func):
+            return
+        self.report(
+            ctx, node, "GL2002",
+            f"storage-tier file write {flagged} in `{func.name}` never "
+            "reaches os.replace / an atomic_write_* helper — a crash "
+            "mid-write leaves a torn file under its FINAL name, and the "
+            "next boot loads it; write tmp + fsync + os.replace "
+            "(append-mode journal writes are the one sanctioned "
+            "exception)",
+        )
+
+    # -- GL2003: checkpoint coverage of replay/scan loops ---------------------
+
+    def _matches(self, header_nodes) -> bool:
+        kws = self.config["keywords"]
+        for root in header_nodes:
+            for sub in ast.walk(root):
+                tok = None
+                if isinstance(sub, ast.Name):
+                    tok = sub.id.lower()
+                elif isinstance(sub, ast.Attribute):
+                    tok = sub.attr.lower()
+                if tok and any(k in tok for k in kws):
+                    return True
+        return False
+
+    def on_For(self, node: ast.For, ctx: ModuleContext):
+        self._check_loop(node, (node.target, node.iter), ctx)
+
+    def on_While(self, node: ast.While, ctx: ModuleContext):
+        self._check_loop(node, (node.test,), ctx)
+
+    def _check_loop(self, node, header_nodes, ctx: ModuleContext):
+        if self.project is None:
+            return
+        if not self._matches(header_nodes):
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is None:
+            return
+        covered = self.project.reaches_call(
+            module, node, _is_checkpoint,
+            depth=int(self.config["call_through_depth"]),
+            cls=ctx.scope.current_class,
+        )
+        if covered:
+            return
+        self.report(
+            ctx, node, "GL2003",
+            "WAL replay/scan loop never reaches a "
+            "resilience.checkpoint(site) — boot replay iterates "
+            "arbitrarily large logs, and the crash-safety matrix arms "
+            "`wal.replay_record` / `storage.replay_batch` expecting "
+            "every replay loop to pass through a site (checkpoint in "
+            "the body or one call down; metadata-only loops take a "
+            "pragma with a reason)",
+        )
