@@ -2318,6 +2318,98 @@ def bench_boot(scale: float):
     }
 
 
+def bench_arena(scale: float):
+    """One-dispatch arena artifact (ISSUE 14): the loop-vs-arena
+    counterfactual on SSB-13.
+
+    Identical data, programs warm, residency dropped before every
+    measured rep (the arena re-pays its stack build each time — the
+    honest comparison).  Per query both modes report the receipt's
+    `dispatch_count` (the collapse the arena exists for: O(covered
+    batches) -> O(1)), the arena_build bucket, device time, and wall;
+    arena-on frames must be BYTE-identical to the loop path (the
+    scan-carry fold replays the loop's select/fold tree op-for-op).
+
+    Headline: total dispatch collapse ratio off/on across SSB-13;
+    vs_baseline is the loop-path p50 wall over the arena p50 wall
+    (how much one traced program beats the per-batch dispatch loop)."""
+    import spark_druid_olap_tpu as sd  # noqa: F401  (bench convention)
+    from spark_druid_olap_tpu.workloads import ssb
+
+    ctx = _calibrated_ctx()
+    # every measured rep must EXECUTE (a result-cache hit moves nothing)
+    ctx.config.result_cache_entries = 0
+    tables = ssb.gen_tables(scale=scale)
+    ssb.register(ctx, tables=tables, rows_per_segment=1 << 17)
+    n_rows = ctx.catalog.get("lineorder").num_rows
+
+    queries = {}
+    disp_total = {"on": 0, "off": 0}
+    walls = {"on": [], "off": []}
+    build_ms = []
+    identical_all = True
+    frames = {}
+    for name, sql_q in ssb.QUERIES.items():
+        per = {}
+        for arena_mode in ("off", "on"):
+            ctx.engine.arena_execution = arena_mode == "on"
+            ctx.sql(sql_q)  # program/lowering warm
+            ctx.engine.drop_residency()  # stack build re-paid every rep
+            rc, wall_ms = _receipt_rep(
+                ctx,
+                lambda n=name, m=arena_mode, q=sql_q: frames.__setitem__(
+                    (n, m), ctx.sql(q)
+                ),
+            )
+            rc = rc or {}
+            per[arena_mode] = {
+                "wall_ms": wall_ms,
+                "dispatch_count": rc.get("dispatch_count"),
+                "arena_build_ms": rc.get("arena_build_ms"),
+                "device_ms": rc.get("device_ms"),
+                "transfer_ms": rc.get("transfer_ms"),
+            }
+            disp_total[arena_mode] += int(rc.get("dispatch_count") or 0)
+            walls[arena_mode].append(wall_ms)
+            if arena_mode == "on" and rc.get("arena_build_ms"):
+                build_ms.append(float(rc["arena_build_ms"]))
+        got_on, got_off = frames.pop((name, "on")), frames.pop((name, "off"))
+        per["identical"] = bool(
+            got_on.reset_index(drop=True).equals(
+                got_off.reset_index(drop=True)
+            )
+        )
+        identical_all = identical_all and per["identical"]
+        queries[name] = per
+        _note_partial(name, per)
+    ctx.engine.arena_execution = True
+    p50_on = statistics.median(walls["on"])
+    p50_off = statistics.median(walls["off"])
+    collapse = disp_total["off"] / max(disp_total["on"], 1)
+    return {
+        "metric": "arena_ssb_sf%g_dispatch_collapse" % scale,
+        "value": round(collapse, 2),
+        "unit": "ratio",
+        # loop-path p50 wall over arena p50 wall: identical data,
+        # programs warm, residency cold both ways
+        "vs_baseline": round(p50_off / max(p50_on, 1e-9), 2),
+        "identical": identical_all,
+        "detail": {
+            "rows": n_rows,
+            "p50_wall_ms_arena": round(p50_on, 2),
+            "p50_wall_ms_loop": round(p50_off, 2),
+            "dispatches_arena": disp_total["on"],
+            "dispatches_loop": disp_total["off"],
+            "arena_build_ms_mean": round(
+                sum(build_ms) / max(1, len(build_ms)), 3
+            ),
+            "results_identical_on_vs_off": identical_all,
+            "queries": queries,
+            "device": _device(),
+        },
+    }
+
+
 def bench_calibrate(rows_log2: int):
     import os
 
@@ -2351,6 +2443,7 @@ MODES = {
     "hammer": (bench_hammer, 0.1),
     "overlap": (bench_overlap, 1.0),
     "boot": (bench_boot, 1.0),
+    "arena": (bench_arena, 1.0),
     "calibrate": (bench_calibrate, 23),
 }
 

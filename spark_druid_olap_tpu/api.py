@@ -151,6 +151,10 @@ class TPUOlapContext:
             self.ingest.storage = self.storage
             self.compactor.storage = self.storage
             self.storage.recover(self.resilience)
+            if self.config.snapshot_flush_s > 0:
+                self.storage.start_flush_sweep(
+                    self.config.snapshot_flush_s
+                )
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 

@@ -252,6 +252,14 @@ class SessionConfig:
     # contract).  False trades the acked-append-survives-crash guarantee
     # for append latency — tests and bulk loads only.
     storage_fsync: bool = True
+    # background snapshot-flush sweep (ISSUE 14 satellite): every
+    # `snapshot_flush_s` seconds a daemon thread flushes any datasource
+    # whose published version moved past its on-disk snapshot, so dirty
+    # delta segments reach disk without waiting for the next
+    # registration or compaction (a restart then mmaps instead of
+    # replaying them from the WAL).  0 (default) disables the timer;
+    # appends stay durable either way via the WAL.
+    snapshot_flush_s: float = 0.0
 
     # -- observability (obs/) -----------------------------------------------
     # slow-query log: a finished query whose span-tree total exceeds this
@@ -298,6 +306,17 @@ class SessionConfig:
     # usually asks for the adjacent interval next).  0 disables
     # speculation; in-scope prefetch is unaffected.
     prefetch_speculative_mb: int = 0
+    # -- one-dispatch arena execution (exec/arena.py, ISSUE 14) -------------
+    # segment-stacked resident arena: in-scope segments of equal padded
+    # shape stack into one device-resident [B, R] layout and the whole
+    # scope lowers as ONE lax.scan program (partial fold inside the trace
+    # in canonical batch order, donated fold-state carry, one fetch) —
+    # dispatches-per-query drop from O(segments) to O(1).  Results are
+    # byte-identical to the per-batch dispatch loop (the scan replicates
+    # the exact f32 fold association); scopes the arena cannot host
+    # (sketch aggs, non-uniform segment shapes, sparse/adaptive routes)
+    # fall back to the loop path per query.  False disables globally.
+    arena_execution: bool = True
 
     # adaptive micro-batch fusion window (ROADMAP 1(b)): when True the
     # scheduler arms the window from the observed arrival rate — no wait

@@ -473,6 +473,13 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         from .pipeline import TransferPipeline
 
         self._pipeline = TransferPipeline(self)
+        # one-dispatch arena execution (exec/arena.py, ISSUE 14): stack
+        # the uniform-shape prefix of a scope and fold it inside ONE
+        # scanned program instead of one dispatch per segment batch.
+        # TPUOlapContext syncs this from SessionConfig.arena_execution
+        # (configure_pipeline); per-query opt-out via
+        # arena.arena_disabled().
+        self.arena_execution = True
 
     @property
     def _m(self):
@@ -567,8 +574,12 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         return arr
 
     def configure_pipeline(self, config) -> None:
-        """Apply SessionConfig's transfer-pipeline knobs (api context)."""
+        """Apply SessionConfig's execution knobs (api context): the
+        transfer-pipeline tunables plus the arena-execution gate."""
         self._pipeline.configure(config)
+        self.arena_execution = bool(
+            getattr(config, "arena_execution", True)
+        )
 
     def _device_cols(
         self, seg: Segment, names, ds_name: str = ""
@@ -652,7 +663,17 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # queued-but-unissued prefetches for these uids must never land:
         # a put issued after this evict would re-resident a dead segment
         self._pipeline.note_retired(uids)
-        for k in [k for k in self._device_cache if k[0] in uids]:
+        from . import arena as _arena
+
+        # arena slices stack MANY uids under one ("arena", *uids) key:
+        # any intersection with the retired set invalidates the whole
+        # stack (a later query re-plans and re-stacks the live segments)
+        for k in [
+            k
+            for k in self._device_cache
+            if k[0] in uids
+            or (_arena.is_arena_key(k) and uids.intersection(k[0][1:]))
+        ]:
             self._device_cache.pop(k)
             self._note_resident_drop(k)
 
@@ -765,13 +786,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         if pc is not None:
             pc.begin_pass()
             pc.add_scope(len(segs), *_row_counts(segs))
-        # segments fuse into batched programs (partial agg + cross-segment
-        # merge inside): the common case is ONE dispatch + ONE fetch per
-        # query; oversized scopes merge across a few batch dispatches
-        seg_fn = self._segment_program(
-            q, ds, lowering, key_extra=key_extra,
-            strategy_override=strategy_override,
-        )
+        # remainder segments fuse into batched programs (partial agg +
+        # cross-segment merge inside; built lazily below — the arena may
+        # cover the whole scope, needing no per-batch program at all)
 
         def fold(st):
             nonlocal sums, mins, maxs
@@ -791,12 +808,88 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         # pipeline-on results stay byte-identical to pipeline-off.
         from .pipeline import CanonicalFold
 
-        run = self._pipeline.start(
-            ds, batches, need,
-            speculative=self._pipeline.speculative_candidates(q, ds, segs),
-        )
+        # one-dispatch arena (exec/arena.py, ISSUE 14): the uniform-shape
+        # whole-batch PREFIX of the scope stacks into resident [B, R]
+        # columns and folds inside ONE scanned program — one dispatch
+        # where this loop pays one per batch.  Remainder batches (shape
+        # -change tail, deltas, budget overflow) fall through to the loop
+        # below with the fold continuing in canonical order, so results
+        # stay byte-identical arena-on vs arena-off.  Sketch aggs decline
+        # (their merge states carry no exact in-scan fold identity).
+        plan = run = None
+        if self.arena_execution and not la.sketch_aggs:
+            from . import arena as _arena
+
+            if not _arena.query_disabled():
+                plan = _arena.plan_for(self, batches, need)
+        if plan is not None:
+            strategy = strategy_override or self._resolve_strategy(G)
+            run = self._pipeline.start(
+                ds, plan.remainder, need,
+                speculative=self._pipeline.speculative_candidates(
+                    q, ds, segs
+                ),
+            )
+            # remainder prefetch issues BEFORE the arena dispatch: the
+            # async puts land behind the scanned program's compute
+            run.advance(-1)
+            try:
+                program = self._arena_program(
+                    q, ds, lowering, strategy, key_extra=key_extra
+                )
+                # the arena IS the segment loop, scanned: it checkpoints
+                # under the same site name, so deadline tests and armed
+                # injections drive its chunked truncation exactly like
+                # the dispatch loop's
+                carries, _done = _arena.run_plan(
+                    self, ds, plan, need, program, [lowering], pc=pc,
+                    checkpoint_site="engine.segment_loop",
+                )
+            except Exception:
+                if (
+                    plan.folded
+                    or self.strategy not in ("auto", "dense")
+                    or self._pallas_broken
+                    or strategy != "pallas"
+                ):
+                    raise
+                # Mosaic declined the scanned kernel before anything
+                # folded: pin the XLA path (same contract as
+                # _call_segment_program) and rerun the whole scope
+                # through the dispatch loop below
+                self._pallas_broken = True
+                for k in [
+                    k
+                    for k in self._query_fn_cache
+                    if any("pallas" in str(p) for p in k[2:])
+                ]:
+                    self._query_fn_cache.pop(k)
+                run.cancel()
+                run = None
+            else:
+                batches = plan.remainder
+                if plan.folded:
+                    s, mn, mx, _live = _arena.finish_member(carries[0])
+                    sums, mins, maxs = s, mn, mx
+                if plan.folded < len(plan.batches):
+                    # truncated mid-arena: the remainder must not run
+                    # (and its pending prefetch cancels with it)
+                    run.cancel()
+        if run is None:
+            run = self._pipeline.start(
+                ds, batches, need,
+                speculative=self._pipeline.speculative_candidates(
+                    q, ds, segs
+                ),
+            )
+        seg_fn = None
+        if batches and not run.cancelled:
+            seg_fn = self._segment_program(
+                q, ds, lowering, key_extra=key_extra,
+                strategy_override=strategy_override,
+            )
         folder = CanonicalFold(fold)
-        for pos, bi in enumerate(run.order):
+        for pos, bi in enumerate(run.order if seg_fn is not None else ()):
             # cooperative deadline checkpoint: a query with a wall-clock
             # budget cancels between batch dispatches, not at the very
             # end — and with a partial collector armed, expiry STOPS the
@@ -973,6 +1066,32 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
         self._query_fn_cache[key] = seg_fn
         return seg_fn
 
+    def _arena_program(
+        self, q, ds, lowering, strategy: str, key_extra=()
+    ) -> Callable:
+        """The one-dispatch arena program for one query (exec/arena.py):
+        a single traced `lax.scan` over the stacked segment blocks with
+        the cross-batch fold inside the trace.  Cached under its own
+        "arena"-tagged key family: the tag keeps it disjoint from the
+        per-batch "fused" programs sharing this cache, and the strategy
+        component lets the Pallas-fallback eviction sweep find it
+        (jit-collision/GL1301)."""
+        from . import arena as _arena
+
+        key = _query_key(q, ds) + ("arena", strategy) + tuple(key_extra)
+        family = "arena" if not key_extra else f"arena/{key_extra[0]}"
+        cached = self._query_fn_cache.get(key)
+        if cached is not None:
+            if self._m is not None:
+                self._m.program_cache_hit = True
+            prof.note_program_cache(family, hit=True)
+            return cached
+        prof.note_program_cache(family, hit=False)
+        fire("compile")  # fault-injection site: new program build
+        fn = _arena.build_arena_program([lowering], [strategy])
+        self._query_fn_cache[key] = fn
+        return fn
+
     # -- micro-batch fusion (serve/, ISSUE 8) --------------------------------
 
     def _groupby_family(self, q: Q.QuerySpec, ds: DataSource):
@@ -1081,11 +1200,62 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             from .pipeline import CanonicalFold
 
             batches = list(self._segment_batches(union_segs, list(names)))
+            # one-dispatch arena (exec/arena.py, ISSUE 14): the fused
+            # micro-batch executes against ONE shared arena — every
+            # member's fold runs inside the same scanned program, with
+            # per-block membership flags as DATA (one compiled program
+            # serves any member->segment mapping).  Remainder batches
+            # fall through to the per-batch fused loop below.
+            plan = run = None
+            if self.arena_execution and not any(
+                m[3].la.sketch_aggs for m in members
+            ):
+                from . import arena as _arena
+
+                if not _arena.query_disabled():
+                    plan = _arena.plan_for(self, batches, list(names))
+            if plan is not None:
+                # the fused deadline contract, checked once up front: an
+                # expiry re-routes every member to its own serial
+                # (partial-capable) path — exactly what the loop's
+                # per-batch checkpoint would do
+                checkpoint("engine.fused_loop")
+                run = self._pipeline.start(ds, plan.remainder, list(names))
+                run.advance(-1)
+                memb = np.array(
+                    [
+                        [s.uid in u for u in member_uids]
+                        for s in plan.segs
+                    ],
+                    dtype=bool,
+                )
+                fn = self._arena_fused_program(members, ds, strategies)
+                try:
+                    # run_plan stamps batch_m's compile attribution on
+                    # the first (trace+compile) dispatch
+                    carries, _done = _arena.run_plan(
+                        self, ds, plan, list(names), fn,
+                        [m[3] for m in members], memb=memb,
+                        single_chunk=True,
+                    )
+                except BaseException:
+                    run.cancel()
+                    raise
+                for i in range(n):
+                    # membership is host-known: a member with no covered
+                    # block keeps acc[i] = None (the loop's None-skip)
+                    if len(plan.segs) and memb[:, i].any():
+                        s, mn, mx, _live = _arena.finish_member(
+                            carries[i]
+                        )
+                        acc[i] = (s, mn, mx)
+                batches = plan.remainder
             # transfer pipeline: resident batches dispatch first, cold
             # batches' columns stream behind the fused compute; the
             # per-member fold stays pinned to canonical batch order
             # (byte-identical to the serial path)
-            run = self._pipeline.start(ds, batches, list(names))
+            if run is None:
+                run = self._pipeline.start(ds, batches, list(names))
             folder = CanonicalFold(fold)
             for pos, bi in enumerate(run.order):
                 # deadline checkpoint between fused batch dispatches; an
@@ -1257,6 +1427,42 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
 
         self._query_fn_cache[key] = fused_fn
         return fused_fn
+
+    def _arena_fused_program(self, members, ds, strategies) -> Callable:
+        """The one-dispatch arena program for a fused micro-batch (exec/
+        arena.py): every member's fold over the stacked scope inside one
+        scanned program.  Unlike `_fused_program`, the member->segment
+        selection is NOT in the key — membership rides as data, so one
+        compiled program serves every batch shape of the same member
+        set."""
+        import json as _json
+
+        from . import arena as _arena
+
+        key = _query_key(members[0][1], ds) + (
+            "arena-fused",
+            tuple(
+                _json.dumps(m[1].to_druid(), sort_keys=True, default=str)
+                for m in members[1:]
+            ),
+            strategies,
+        )
+        cached = self._query_fn_cache.get(key)
+        if cached is not None:
+            if self._m is not None:
+                self._m.program_cache_hit = True
+            prof.note_program_cache("arena-fused", hit=True)
+            return cached
+        prof.note_program_cache("arena-fused", hit=False)
+        fire("compile")  # fault-injection site: new program build
+        from ..serve.fusion import shared_row_plan
+
+        share = shared_row_plan([m[1] for m in members])
+        fn = _arena.build_arena_program(
+            [m[3] for m in members], strategies, share=share
+        )
+        self._query_fn_cache[key] = fn
+        return fn
 
     # -- host partial-state surface (delta-aware result cache, ISSUE 8) -----
 

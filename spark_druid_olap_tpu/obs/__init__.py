@@ -24,6 +24,7 @@ from .registry import (  # noqa: F401
     record_query_metrics,
     record_rollup,
     record_snapshot_flush,
+    record_snapshot_sweep,
     record_storage_load,
     record_wal_append,
     record_wal_replay,
@@ -32,6 +33,7 @@ from . import prof  # noqa: F401  (performance attribution, ISSUE 9)
 from .trace import (  # noqa: F401
     SPAN_ADAPTIVE_PROBE,
     SPAN_ADMISSION,
+    SPAN_ARENA_BUILD,
     SPAN_COLLECTIVE_MERGE,
     SPAN_COMPACT,
     SPAN_DEGRADED,

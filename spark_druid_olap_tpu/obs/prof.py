@@ -81,7 +81,26 @@ TRANSFER_SPANS = frozenset({"h2d"})
 # the overlap-efficiency denominator counts only foreground h2d time the
 # dispatch loop actually waited behind
 PREFETCH_SPANS = frozenset({"prefetch"})
+# arena assembly (exec/arena.py): host-side stacking + placement issue of
+# the segment-stacked layout — its own receipt bucket so the one-dispatch
+# path's build cost is visible apart from generic host work (its child
+# h2d spans still land in the transfer bucket)
+ARENA_SPANS = frozenset({"arena_build"})
 ROOT_SPAN = "query"
+
+# device LAUNCH spans — the receipt's `dispatch_count` (ISSUE 14): how
+# many host->device program launches served this query.  The arena path's
+# whole point is driving this from O(segments) to O(1); device_fetch is a
+# read-back, not a launch, so it does not count.
+DISPATCH_SPANS = frozenset(
+    {
+        "segment_dispatch",
+        "sparse_dispatch",
+        "adaptive_probe",
+        "stream_chunk",
+        "collective_merge",
+    }
+)
 
 
 class ProfScope:
@@ -386,6 +405,8 @@ def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
     child_sum = sum(float(c.get("duration_ms", 0.0)) for c in children)
     excl = max(0.0, dur - child_sum)
     name = str(node.get("name", ""))
+    if name in DISPATCH_SPANS:
+        acc["dispatch_count"] += 1
     if depth == 0 and name == ROOT_SPAN:
         acc["unattributed"] += excl
     elif name in DEVICE_SPANS:
@@ -394,6 +415,8 @@ def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
         acc["transfer"] += excl
     elif name in PREFETCH_SPANS:
         acc["prefetch"] += excl
+    elif name in ARENA_SPANS:
+        acc["arena_build"] += excl
     else:
         acc["host"] += excl
     for c in children:
@@ -408,7 +431,7 @@ def build_receipt(
     can run live (mid-query, provisional span ends) or at trace close."""
     acc = {
         "device": 0.0, "transfer": 0.0, "prefetch": 0.0, "host": 0.0,
-        "unattributed": 0.0,
+        "arena_build": 0.0, "unattributed": 0.0, "dispatch_count": 0,
     }
     root = trace_doc.get("spans")
     if isinstance(root, dict):
@@ -428,7 +451,11 @@ def build_receipt(
         "host_ms": round(acc["host"], 3),
         "transfer_ms": round(acc["transfer"], 3),
         "prefetch_ms": round(acc["prefetch"], 3),
+        "arena_build_ms": round(acc["arena_build"], 3),
         "unattributed_ms": round(acc["unattributed"], 3),
+        # device program launches this query paid (DISPATCH_SPANS): the
+        # number the one-dispatch arena acceptance criterion reads
+        "dispatch_count": int(acc["dispatch_count"]),
         "overlap_efficiency": (
             round(acc["device"] / busy_stall, 4) if busy_stall > 0 else 1.0
         ),

@@ -604,6 +604,23 @@ def record_snapshot_flush(datasource: str, segments: int) -> None:
         ).labels(datasource=ds).inc(segments)
 
 
+def record_snapshot_sweep(flushed: int) -> None:
+    """Publish one background snapshot-flush sweep pass (the timer
+    fired and scanned for dirty datasources).  Per-datasource flush
+    volume is already on `sdol_snapshot_flushes_total`; this counts the
+    sweep itself plus how many tables it found dirty."""
+    reg = get_registry()
+    reg.counter(
+        "sdol_snapshot_sweeps_total",
+        "background snapshot-flush sweep passes",
+    ).inc()
+    if flushed:
+        reg.counter(
+            "sdol_snapshot_sweep_flushes_total",
+            "datasources flushed by the background snapshot sweep",
+        ).inc(flushed)
+
+
 def record_rollup(datasource: str, rows_in: int, rows_out: int) -> None:
     """Publish one ingest-time rollup: input vs surviving rows.  The
     ratio is the fleet-level answer to "what does rollup actually buy"

@@ -86,6 +86,7 @@ SPAN_WAL_APPEND = "wal_append"  # fsync'd journal write of one append batch
 SPAN_WAL_REPLAY = "wal_replay"  # boot-time WAL replay of one datasource
 SPAN_SNAPSHOT_FLUSH = "snapshot_flush"  # persistent segment snapshot commit
 SPAN_ROLLUP = "rollup"  # ingest-time pre-aggregation of an append batch
+SPAN_ARENA_BUILD = "arena_build"  # segment-stacked arena assembly (exec/arena.py)
 
 SPAN_NAMES = frozenset(
     {
@@ -118,6 +119,7 @@ SPAN_NAMES = frozenset(
         SPAN_WAL_REPLAY,
         SPAN_SNAPSHOT_FLUSH,
         SPAN_ROLLUP,
+        SPAN_ARENA_BUILD,
     }
 )
 

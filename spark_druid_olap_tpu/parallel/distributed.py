@@ -177,17 +177,26 @@ class DistributedEngine:
 
     # -- host-side row-shard assembly ---------------------------------------
 
-    def _global_columns(self, ds: DataSource, names):
-        """Assemble (or reuse) sharded columns over the FULL segment set.
+    def _global_columns(self, ds: DataSource, names, segs=None):
+        """Assemble (or reuse) sharded columns over a segment scope.
 
-        Durable residency: the key has no query component, so every query
-        against this datasource version reuses the same placed shards —
-        `shard_assembly_ms` is paid once per (datasource, column), the
+        Durable residency: the key has no query component beyond the
+        segment scope, so every query sharing a scope against this
+        datasource version reuses the same placed shards —
+        `shard_assembly_ms` is paid once per (scope, column), the
         analog of historicals owning segments across queries (SURVEY.md §2
-        data-parallelism row; VERDICT r4 #3).  A fixed layout also keeps
-        `local_rows` constant, so SPMD programs cache across queries."""
+        data-parallelism row; VERDICT r4 #3).  A fixed per-scope layout
+        also keeps `local_rows` constant, so SPMD programs cache across
+        queries with the same scope.
+
+        `segs` is the interval/zone-map PRUNED scope (the r5->r6 mesh
+        regression fix: the mesh used to shard the FULL set for every
+        query and pay a full-scope scan where the single-device engine
+        pruned — profiled at SF1, ~100% of the flat ~400 ms/query floor
+        was device time over rows pruning would have skipped).  None
+        means the full set (streaming / scope-free callers)."""
         nd = self.mesh.shape[DATA_AXIS]
-        segs = list(ds.segments)
+        segs = list(ds.segments) if segs is None else list(segs)
         total = sum(s.num_rows_padded for s in segs)
         chunk = nd * ROW_PAD
         padded = -(-max(total, 1) // chunk) * chunk
@@ -234,9 +243,10 @@ class DistributedEngine:
         return cols, padded
 
     def _scope_for_metrics(self, q, ds: DataSource):
-        """Interval + zone-map pruned segment scope — METRICS ONLY (the
-        shards always span the full set; the row mask does the excluding).
-        Shares the local engine's exact pruning policy."""
+        """Interval + zone-map pruned segment scope — shared with the
+        local engine's exact pruning policy.  Both the metrics AND the
+        shard layout read it: `_place_shards` assembles only the pruned
+        scope (the row mask still excludes within surviving segments)."""
         from ..exec.engine import segments_in_scope
 
         return segments_in_scope(q, ds)
@@ -678,14 +688,17 @@ class DistributedEngine:
         log.info("%s", m.describe())
         return out
 
-    def _place_shards(self, ds, columns, m):
+    def _place_shards(self, ds, columns, m, q=None):
+        """Place (or reuse) the sharded column set for `q`'s pruned scope
+        — `q=None` spans the full set (scope-free callers only)."""
         from ..resilience import fire
 
         fire("h2d")  # fault-injection site: shard placement
         t0 = _time.perf_counter()
         known = len(self._shard_cache)
         before_bytes = self._shard_cache.bytes_used
-        cols, padded = self._global_columns(ds, columns)
+        segs = self._scope_for_metrics(q, ds) if q is not None else None
+        cols, padded = self._global_columns(ds, columns, segs=segs)
         if len(self._shard_cache) > known:  # new shards were placed
             m.h2d_ms += (_time.perf_counter() - t0) * 1e3
             m.h2d_bytes += max(
@@ -700,7 +713,7 @@ class DistributedEngine:
         share it — only the per-shard kernel differs)."""
         from ..plan.cost import groupby_state_bytes
 
-        cols, padded = self._place_shards(ds, lowering.columns, m)
+        cols, padded = self._place_shards(ds, lowering.columns, m, q=q)
         local_rows = padded // self.mesh.shape[DATA_AXIS]
         compiled = self._spmd_cache
         key_count = len(compiled)
@@ -792,7 +805,7 @@ class DistributedEngine:
             # strategy="sparse" on such a query falls through to scatter
             self._sparse_declined.add(qkey)
             return None
-        cols, padded = self._place_shards(ds, lowering.columns, m)
+        cols, padded = self._place_shards(ds, lowering.columns, m, q=q)
         local_rows = padded // self.mesh.shape[DATA_AXIS]
         cap = self._initial_row_capacity(q, ds, lowering, qkey, local_rows)
         slots = self._sparse_slots.get(qkey, _sg.SPARSE_SLOTS)
@@ -920,7 +933,7 @@ class DistributedEngine:
 
             need = presence_columns(q, lowering, ds)
             try:
-                cols, padded = self._place_shards(ds, need, m)
+                cols, padded = self._place_shards(ds, need, m, q=q)
                 local_rows = padded // self.mesh.shape[DATA_AXIS]
                 run = self._presence_fn(
                     lowering, local_rows, ds, tuple(cols.keys())

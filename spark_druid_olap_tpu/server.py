@@ -193,6 +193,11 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "sdol-tpu/0.2"
     _query_id: Optional[str] = None  # per-request; set by do_POST
     _req_t0: Optional[float] = None
+    # trace-before-response contract (see do_POST): while a query trace
+    # is open, buffered responses are captured here and written only
+    # after the trace publishes to the ring
+    _defer_buffered = False
+    _buffered_response: Optional[tuple] = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -283,6 +288,12 @@ class _Handler(BaseHTTPRequestHandler):
         content_type: str,
         headers: Optional[dict] = None,
     ):
+        if self._defer_buffered:
+            # a query trace is open: capture the response; do_POST writes
+            # it after the trace publishes so /druid/v2/trace/{id} can
+            # never 404 on a query whose response was already read
+            self._buffered_response = (code, body, content_type, headers)
+            return
         self._begin_response(code, content_type, headers, length=len(body))
         self.wfile.write(body)
         self._finish_response(code)
@@ -464,6 +475,8 @@ class _Handler(BaseHTTPRequestHandler):
         )
         cfg = getattr(self.ctx, "config", None)
         res = self._resilience()
+        self._buffered_response = None
+        self._defer_buffered = True
         try:
             with self._tracer().query_trace(
                 query_id=self._query_id,
@@ -472,10 +485,22 @@ class _Handler(BaseHTTPRequestHandler):
             ):
                 return self._handle_query(path, body, qctx, res, cfg)
         finally:
-            # a streamed (chunked) response defers its terminal 0-chunk
-            # to HERE — after the trace published to the ring — so a
-            # client that reads to end-of-stream and immediately fetches
+            # trace-before-response contract: the buffered response was
+            # CAPTURED by _send_bytes during the query scope and is
+            # written HERE — after the trace published to the ring — so
+            # a client that reads it and immediately fetches
             # /druid/v2/trace/{id} can never race the publish
+            self._defer_buffered = False
+            pending = self._buffered_response
+            if pending is not None:
+                self._buffered_response = None
+                try:
+                    self._send_bytes(*pending)
+                except OSError:
+                    pass  # client disconnected before the body landed
+            # a streamed (chunked) response gets the same guarantee from
+            # its terminal 0-chunk, deferred to HERE — the client's read
+            # completes only on that chunk
             code = getattr(self, "_pending_chunked_finish", None)
             if code is not None:
                 self._pending_chunked_finish = None

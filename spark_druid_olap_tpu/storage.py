@@ -53,6 +53,7 @@ from .obs import (
     SPAN_WAL_APPEND,
     SPAN_WAL_REPLAY,
     record_snapshot_flush,
+    record_snapshot_sweep,
     record_wal_append,
     record_wal_replay,
     span,
@@ -84,6 +85,13 @@ class DurableStorage:
         self._snap_versions: Dict[str, int] = {}
         self.replay_in_progress = False
         self.last_recovery: Optional[dict] = None
+        # background flush sweep (ISSUE 14 satellite): a timer thread
+        # that flushes dirty deltas so durability doesn't wait for the
+        # next registration/compaction
+        self._sweep_stop = threading.Event()
+        self._sweep_thread: Optional[threading.Thread] = None
+        self._sweep_interval_s = 0.0
+        self.sweeps_total = 0
         os.makedirs(root, exist_ok=True)
 
     # -- paths / handles -----------------------------------------------------
@@ -152,6 +160,71 @@ class DurableStorage:
             watermark, len(removed),
         )
         return snap
+
+    # -- background flush sweep ----------------------------------------------
+
+    def _dirty(self, name: str) -> bool:
+        """A datasource is dirty when a restart would have to REPLAY:
+        its published version moved past the on-disk snapshot (delta
+        appends, or a registration that raced the last flush)."""
+        ds = self.catalog.get(name)
+        if ds is None:
+            return False
+        with self._lock:
+            snap = self._snap_versions.get(name)
+        return snap is None or ds.version > snap
+
+    def sweep_once(self) -> dict:
+        """One deterministic sweep pass: flush every dirty datasource.
+        The timer loop calls this; tests and tools can call it directly
+        for a no-thread, no-sleep check of the same code path."""
+        flushed: List[str] = []
+        for name in list(self.catalog.tables()):
+            if not self._dirty(name):
+                continue
+            try:
+                self.flush(name)
+                flushed.append(name)
+            except Exception:  # fault-ok: one table must not stop the sweep
+                log.warning(
+                    "snapshot sweep flush of %s failed", name,
+                    exc_info=True,
+                )
+        with self._lock:
+            self.sweeps_total += 1
+        record_snapshot_sweep(len(flushed))
+        return {"flushed": flushed}
+
+    def start_flush_sweep(self, interval_s: float) -> "DurableStorage":
+        """Start the background snapshot-flush thread (idempotent)."""
+        self._sweep_interval_s = float(interval_s)
+        with self._lock:
+            if (
+                self._sweep_thread is not None
+                and self._sweep_thread.is_alive()
+            ):
+                return self
+            self._sweep_stop.clear()
+            self._sweep_thread = threading.Thread(
+                target=self._sweep_loop,
+                name="sdol-snapshot-flush",
+                daemon=True,
+            )
+            self._sweep_thread.start()
+        return self
+
+    def stop_flush_sweep(self) -> None:
+        self._sweep_stop.set()
+        t = self._sweep_thread
+        if t is not None:
+            t.join(timeout=10)
+
+    def _sweep_loop(self) -> None:
+        while not self._sweep_stop.wait(self._sweep_interval_s):
+            try:
+                self.sweep_once()
+            except Exception:  # fault-ok: the sweep must survive any table
+                log.warning("snapshot flush sweep failed", exc_info=True)
 
     # -- boot recovery -------------------------------------------------------
 
@@ -261,9 +334,20 @@ class DurableStorage:
             "replay_in_progress": self.replay_in_progress,
             "datasources": datasources,
             "last_recovery": self.last_recovery,
+            "flush_sweep": {
+                "running": (
+                    self._sweep_thread is not None
+                    and self._sweep_thread.is_alive()
+                ),
+                "interval_s": self._sweep_interval_s,
+                "sweeps_total": self.sweeps_total,
+            },
         }
 
     def close(self) -> None:
+        # join the sweep BEFORE taking the lock: a mid-flush sweep pass
+        # needs `self._lock` to stamp the snapshot version
+        self.stop_flush_sweep()
         with self._lock:
             # graftlint: disable=storage-discipline -- metadata-only: closes O(datasources) file handles
             for w in self._wals.values():

@@ -130,6 +130,10 @@ def test_production_tag_keys_scale(monkeypatch):
     assert "%s_%g" % (mode, arg) == "boot_10"
     assert fn is bench.bench_boot
     assert isinstance(bench.MODES["boot"][1], float)
+    # one-dispatch arena counterfactual (ISSUE 14): SSB scale-factor arg
+    mode, fn, arg = bench._parse_args(["arena", "1"])
+    assert "%s_%g" % (mode, arg) == "arena_1"
+    assert fn is bench.bench_arena
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -387,6 +391,66 @@ def test_emit_boot_result_shape(capsys, tmp_path, monkeypatch):
     assert detail["detail"]["restored_disk_backed"] is True
     assert detail["detail"]["queries_identical_across_restart"] is True
     assert detail["detail"]["wal_replayed_rows"] == 8192
+
+
+def test_emit_arena_result_shape(capsys, tmp_path, monkeypatch):
+    """The arena mode's per-(query, mode) dispatch/receipt maps live in
+    the detail sidecar; stdout stays one compact driver-parseable line
+    with the headline dispatch-collapse ratio and the loop-vs-arena
+    p50 wall ratio inline."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    per_q = {
+        "q%d_%d" % (i, j): {
+            "off": {
+                "wall_ms": 25.0, "dispatch_count": 8,
+                "arena_build_ms": None, "device_ms": 20.0,
+                "transfer_ms": 3.7,
+            },
+            "on": {
+                "wall_ms": 14.1, "dispatch_count": 1,
+                "arena_build_ms": 2.4, "device_ms": 9.8,
+                "transfer_ms": 3.6,
+            },
+            "identical": True,
+        }
+        for i in range(1, 5)
+        for j in range(1, 4)
+    }
+    bench._emit(
+        {
+            "metric": "arena_ssb_sf1_dispatch_collapse",
+            "value": 8.0,
+            "unit": "ratio",
+            "vs_baseline": 1.6,
+            "identical": True,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 6_000_000,
+                "p50_wall_ms_arena": 14.1,
+                "p50_wall_ms_loop": 25.0,
+                "dispatches_arena": 12,
+                "dispatches_loop": 96,
+                "arena_build_ms_mean": 2.4,
+                "results_identical_on_vs_off": True,
+                "queries": per_q,
+            },
+        },
+        "arena_1",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "arena_ssb_sf1_dispatch_collapse"
+    assert parsed["value"] == 8.0
+    assert parsed["vs_baseline"] == 1.6
+    assert "queries" not in parsed
+    detail = json.load(open(tmp_path / "BENCH_arena_1_detail.json"))
+    assert detail["detail"]["queries"]["q1_1"]["identical"] is True
+    assert detail["detail"]["queries"]["q1_1"]["on"]["dispatch_count"] == 1
+    assert detail["detail"]["dispatches_loop"] == 96
+    assert detail["detail"]["results_identical_on_vs_off"] is True
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

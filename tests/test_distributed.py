@@ -507,3 +507,47 @@ def test_mesh_sparse_filtered_aggs_and_minmax():
         np.nan_to_num(got["s1"].to_numpy(np.float64)), s1, rtol=2e-5,
         atol=1e-9,
     )
+
+
+def test_mesh_shards_only_pruned_scope(dist8):
+    """r5->r6 mesh regression guard: a scoped query shards ONLY the
+    interval-pruned segments.  The regression sharded (and scanned) the
+    FULL segment set for every query — ~400 ms/query of device time
+    over rows the single-device engine pruned — so the shard cache must
+    key exactly the pruned scope's uid signature."""
+    from spark_druid_olap_tpu.catalog.segment import build_datasource
+    from spark_druid_olap_tpu.exec.engine import segments_in_scope
+
+    n = 16_384
+    rng = np.random.default_rng(5)
+    cols = {
+        "d": np.array(
+            [f"k{i}" for i in rng.integers(0, 4, size=n)], dtype=object
+        ),
+        "v": rng.random(n).astype(np.float32),
+        "t": (np.arange(n) * 1_000).astype(np.int64),
+    }
+    ds = build_datasource(
+        "mesh_scope", cols, dimension_cols=["d"], metric_cols=["v"],
+        time_col="t", rows_per_segment=2_048,
+    )
+    q = GroupByQuery(
+        datasource="mesh_scope",
+        dimensions=(DimensionSpec("d"),),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+        intervals=((0, 4_096_000),),
+    )
+    scope = segments_in_scope(q, ds)
+    assert 0 < len(scope) < len(ds.segments)
+    want_sig = tuple(s.uid for s in scope)
+    dist8.clear_cache()
+    got = dist8.execute(q, ds)
+    # every shard placed for this query keys the PRUNED scope signature
+    sigs = {k[-1] for k in dist8._shard_cache if k[0] == "mesh_scope"}
+    assert sigs == {want_sig}
+    # and the scoped mesh result still matches the local engine exactly
+    want = Engine().execute(q, ds)
+    got = got.sort_values(["d"]).reset_index(drop=True)
+    want = want.sort_values(["d"]).reset_index(drop=True)
+    np.testing.assert_array_equal(np.asarray(got["n"]), np.asarray(want["n"]))
+    np.testing.assert_allclose(got["s"], want["s"], rtol=1e-5)

@@ -2077,6 +2077,97 @@ _MATRIX = {
             """},
         ],
     },
+    "dispatch-discipline": {
+        "violating": [
+            # GL2101: a dispatch span inside a host loop is the
+            # per-segment round-trip the one-dispatch arena collapsed
+            (
+                {"spark_druid_olap_tpu/exec/custom_exec.py": """
+                    from ..obs import SPAN_SEGMENT_DISPATCH, span
+
+                    def scan_all(self, fn, batches):
+                        out = []
+                        for bi, batch in enumerate(batches):
+                            with span(SPAN_SEGMENT_DISPATCH, batch=bi):
+                                out.append(fn(batch))
+                        return out
+                """},
+                {"GL2101"},
+            ),
+            # GL2101 also matches the runtime string span name, and the
+            # serving tree is in scope too
+            (
+                {"spark_druid_olap_tpu/serve/drain.py": """
+                    from ..obs import span
+
+                    def drain(self, fn, queue):
+                        while queue:
+                            with span("sparse_dispatch"):
+                                fn(queue.pop())
+                """},
+                {"GL2101"},
+            ),
+            # GL2102: jax.jit built per iteration retraces/recompiles
+            # every pass and never hits the program cache
+            (
+                {"spark_druid_olap_tpu/exec/retrace.py": """
+                    import jax
+
+                    def per_segment(self, build, segs):
+                        acc = []
+                        for seg in segs:
+                            fn = jax.jit(build(seg))
+                            acc.append(fn(seg.cols))
+                        return acc
+                """},
+                {"GL2102"},
+            ),
+        ],
+        "clean": [
+            # the engine's remainder loop and the arena's chunk loop are
+            # the sanctioned dispatch-loop owners
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                from ..obs import SPAN_SEGMENT_DISPATCH, span
+
+                def _partials_for_query(self, q, ds, seg_fn, batches):
+                    for bi, batch in enumerate(batches):
+                        with span(SPAN_SEGMENT_DISPATCH, batch=bi):
+                            seg_fn(batch)
+            """,
+             "spark_druid_olap_tpu/exec/arena.py": """
+                from ..obs import SPAN_SEGMENT_DISPATCH, span
+
+                def run_plan(engine, program, chunks):
+                    for ci, (lo, hi) in enumerate(chunks):
+                        with span(SPAN_SEGMENT_DISPATCH, chunk=ci):
+                            program(lo, hi)
+            """},
+            # program built ONCE then called in the loop; non-dispatch
+            # spans (h2d staging) in loops stay legal
+            {"spark_druid_olap_tpu/exec/engine.py": """
+                import jax
+
+                from ..obs import SPAN_H2D, span
+
+                def warm(self, build, batches):
+                    fn = jax.jit(build())
+                    out = []
+                    for bi, batch in enumerate(batches):
+                        with span(SPAN_H2D, batch=bi):
+                            out.append(fn(batch))
+                    return out
+            """},
+            # parallel/ keeps its own sharded-dispatch contract
+            {"spark_druid_olap_tpu/parallel/distributed.py": """
+                from ..obs import SPAN_COLLECTIVE_MERGE, span
+
+                def merge(self, fn, shards):
+                    for s in shards:
+                        with span(SPAN_COLLECTIVE_MERGE):
+                            fn(s)
+            """},
+        ],
+    },
 }
 
 

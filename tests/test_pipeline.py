@@ -212,9 +212,14 @@ def test_streaming_parity_on_vs_off():
 
 
 def test_prefetch_issues_and_scope_lands_resident():
+    from spark_druid_olap_tpu.exec.arena import arena_disabled
+
     ds, _ = _flat_ds(name="plm")
     eng = Engine()
-    eng.execute(_gb("plm"), ds)
+    # loop-path mechanics under test: the arena would stack the scope
+    # into one resident buffer instead of per-segment columns
+    with arena_disabled():
+        eng.execute(_gb("plm"), ds)
     assert eng._pipeline.issued > 0
     # every in-scope column landed in the residency cache
     for seg in ds.segments:
@@ -352,19 +357,25 @@ def test_injected_h2d_fault_on_prefetched_put_reaches_retry():
     )  # 2 cols + valid, 2 segs/batch on CPU
     # skip past batch 0's foreground puts so the fault fires on a
     # PREFETCHED put (issued by run.advance), then is re-raised at
-    # consume and absorbed by the engine's transient retry
+    # consume and absorbed by the engine's transient retry.  Loop-path
+    # machinery under test (the arena path has its own put cadence).
+    from spark_druid_olap_tpu.exec.arena import arena_disabled
+
     injector().arm("h2d", "error", times=1, skip=need_keys_per_batch)
-    df = eng.execute(_gb("plh"), ds)
+    with arena_disabled():
+        df = eng.execute(_gb("plh"), ds)
     assert int(df["n"].sum()) == len(cols["v"])
     assert eng.last_metrics.retries == 1
 
 
 def test_injected_h2d_fault_without_retries_surfaces():
+    from spark_druid_olap_tpu.exec.arena import arena_disabled
+
     ds, _ = _flat_ds(name="plh2")
     eng = Engine()
     eng._retry_attempts = 1  # no retry budget
     injector().arm("h2d", "error", times=1, skip=6)
-    with pytest.raises(InjectedFault):
+    with pytest.raises(InjectedFault), arena_disabled():
         eng.execute(_gb("plh2"), ds)
 
 
@@ -437,7 +448,12 @@ def test_fused_cse_traces_shared_filter_once():
             return _orig(cols)
 
         lo.filter_fn = counting
-    out = eng.execute_fused(queries, ds)
+    from spark_druid_olap_tpu.exec.arena import arena_disabled
+
+    # loop-path CSE under test: the arena program traces each shared
+    # sub-lowering once per SCAN BODY (one block), not once per segment
+    with arena_disabled():
+        out = eng.execute_fused(queries, ds)
     batches = list(eng._segment_batches(list(ds.segments), ["d", "v"]))
     # every batch has the same member->segment selection, so ONE program
     # traces (and is reused across batches): the shared filter evaluates

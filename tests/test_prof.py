@@ -208,8 +208,10 @@ def test_receipt_cache_tiers_cold_vs_warm():
     assert cold["residency"]["misses"] > 0
     assert warm["residency"]["misses"] == 0
     assert warm["residency"]["hits"] > 0
-    assert cold["program_cache"]["fused"]["misses"] == 1
-    assert warm["program_cache"]["fused"]["hits"] == 1
+    # default path is the one-dispatch arena; its program family carries
+    # the cold-miss / warm-hit attribution
+    assert cold["program_cache"]["arena"]["misses"] == 1
+    assert warm["program_cache"]["arena"]["hits"] == 1
     assert rc_cold["compiles"] == 1 and rc_warm["compiles"] == 0
 
 
@@ -231,9 +233,9 @@ def test_program_family_counters_and_compile_totals():
     ctx.sql(_SQL)
     ctx.sql(_SQL)
     snap = fam.snapshot()
-    assert snap.get("fused,miss", 0) - base.get("fused,miss", 0) == 1
-    assert snap.get("fused,hit", 0) - base.get("fused,hit", 0) == 1
-    assert comp.snapshot().get("fused", 0) > 0
+    assert snap.get("arena,miss", 0) - base.get("arena,miss", 0) == 1
+    assert snap.get("arena,hit", 0) - base.get("arena,hit", 0) == 1
+    assert comp.snapshot().get("arena", 0) > 0
 
 
 def test_h2d_link_histogram_and_residency_gauges():
@@ -308,9 +310,9 @@ def test_status_profile_over_http():
         # k is respected
         code, small = _get_json(srv.port, "/status/profile?k=2")
         assert len(small["top_device"]) <= 2
-        # per-family compile totals: the SQL path's fused family showed up
-        assert "fused" in doc["compile_families"]
-        assert doc["compile_families"]["fused"]["compile_ms"] > 0
+        # per-family compile totals: the SQL path's arena family showed up
+        assert "arena" in doc["compile_families"]
+        assert doc["compile_families"]["arena"]["compile_ms"] > 0
         # per-lane SLO burn against the configured targets
         assert "interactive" in doc["lanes"]
         lane = doc["lanes"]["interactive"]

@@ -471,3 +471,69 @@ def test_server_health_and_503_during_replay(tmp_path):
 def test_non_durable_context_has_no_storage():
     ctx = sd.TPUOlapContext()
     assert ctx.storage is None
+
+
+# -- background snapshot-flush sweep (ISSUE 14 satellite) ---------------------
+
+
+def test_sweep_once_flushes_dirty_deltas(tmp_path):
+    from spark_druid_olap_tpu.obs import get_registry
+
+    ctx = _ctx(tmp_path)
+    _register(ctx)
+    # registration flushed; a clean table is not re-flushed
+    assert ctx.storage._dirty("ev") is False
+    assert ctx.storage.sweep_once() == {"flushed": []}
+
+    ctx.append_rows("ev", _append_cols())
+    assert ctx.storage._dirty("ev") is True
+    sweeps0 = get_registry().counter("sdol_snapshot_sweeps_total").value
+    assert ctx.storage.sweep_once() == {"flushed": ["ev"]}
+    assert ctx.storage._dirty("ev") is False
+    assert (
+        get_registry().counter("sdol_snapshot_sweeps_total").value
+        == sweeps0 + 1
+    )
+    assert (
+        get_registry()
+        .counter("sdol_snapshot_sweep_flushes_total")
+        .value
+        >= 1
+    )
+    assert (
+        ctx.storage.state()["flush_sweep"]["sweeps_total"]
+        == ctx.storage.sweeps_total
+        >= 2
+    )
+
+    # the sweep's flush covered the deltas: a restart mmaps the
+    # snapshot and replays NOTHING, yet serves base + appended rows
+    ctx2 = _ctx(tmp_path)
+    assert ctx2.storage.last_recovery["replayed_rows"] == 0
+    assert ctx2.sql(Q).equals(_oracle(_base_cols(), _append_cols()))
+
+
+def test_flush_sweep_timer_thread(tmp_path):
+    import time
+
+    ctx = _ctx(tmp_path, snapshot_flush_s=0.05)
+    try:
+        assert ctx.storage.state()["flush_sweep"]["running"] is True
+        assert ctx.storage.state()["flush_sweep"]["interval_s"] == 0.05
+        _register(ctx)
+        ctx.append_rows("ev", _append_cols())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not ctx.storage._dirty("ev"):
+                break
+            time.sleep(0.02)
+        assert ctx.storage._dirty("ev") is False
+        assert ctx.storage.sweeps_total >= 1
+    finally:
+        ctx.storage.close()
+    assert ctx.storage.state()["flush_sweep"]["running"] is False
+    # close() is idempotent wrt the sweep; a fresh context over the same
+    # dir with the timer off never starts the thread
+    ctx.storage.stop_flush_sweep()
+    ctx3 = _ctx(tmp_path)
+    assert ctx3.storage.state()["flush_sweep"]["running"] is False

@@ -12,8 +12,8 @@ collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
 lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
 serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
-GL20xx storage-discipline; GL00x are the core's own: GL001 unparseable file, GL002 malformed
-pragma).
+GL20xx storage-discipline, GL21xx dispatch-discipline; GL00x are the
+core's own: GL001 unparseable file, GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -24,6 +24,7 @@ from ..core import LintConfigError, LintPass
 from .checkpoint_coverage import CheckpointCoveragePass
 from .collective_axis import CollectiveAxisPass
 from .compat_import import CompatImportPass
+from .dispatch_discipline import DispatchDisciplinePass
 from .dtype_x64 import DtypeX64Pass
 from .error_discipline import ErrorDisciplinePass
 from .ingest_discipline import IngestDisciplinePass
@@ -63,6 +64,7 @@ ALL_PASSES = (
     ObsDisciplinePass,
     TransferDisciplinePass,
     StorageDisciplinePass,
+    DispatchDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

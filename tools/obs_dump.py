@@ -171,12 +171,14 @@ def render_receipts(rows: List[Tuple[str, dict]]) -> str:
     lines = ["cost receipts (device/host/transfer attribution)"]
     lines.append(
         f"{'receipt':<34} {'wall':>9} {'device':>9} {'host':>9} "
-        f"{'xfer':>8} {'unattr':>8} {'cmp':>4}  cache"
+        f"{'xfer':>8} {'unattr':>8} {'disp':>4} {'cmp':>4}  cache"
     )
     for label, rc in rows:
         cache = rc.get("cache") or {}
         res = cache.get("residency") or {}
         bits = []
+        if rc.get("arena_build_ms"):
+            bits.append(f"arena={rc['arena_build_ms']:.2f}ms")
         if cache.get("result_cache"):
             bits.append(f"rc={cache['result_cache']}")
         if cache.get("fused_batch"):
@@ -200,6 +202,7 @@ def render_receipts(rows: List[Tuple[str, dict]]) -> str:
             f"{rc.get('device_ms', 0):>8.2f} {rc.get('host_ms', 0):>8.2f} "
             f"{rc.get('transfer_ms', 0):>7.2f} "
             f"{rc.get('unattributed_ms', 0):>7.2f} "
+            f"{rc.get('dispatch_count', 0):>4} "
             f"{rc.get('compiles', 0):>4}  {' '.join(bits)}".rstrip()
         )
     return "\n".join(lines)
