@@ -1137,18 +1137,19 @@ class TPUOlapContext:
 
     def _fusable(self, rw: Rewrite, ds) -> bool:
         """May this rewrite ride the micro-batch fusion / state-capture
-        path?  Single-device GroupBy-family only, no grouping sets (their
-        expansion already batches), and the engine's own gate (sparse/
-        adaptive tiers decline fusion)."""
+        path?  GroupBy-family only, no grouping sets (their expansion
+        already batches), and the executing backend's own gate — both
+        the single-device engine and the mesh's unified SPMD arena
+        (parallel/distributed.py) implement `fusable`, so mesh-routed
+        dashboards batch exactly like local ones (sparse/adaptive tiers
+        and arena-ineligible layouts decline on either backend)."""
         if rw.grouping_sets or rw.exact_distinct is not None:
             return False
         if not isinstance(
             rw.query, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
         ):
             return False
-        if self._backend_for(rw) != "device":
-            return False
-        return self.engine.fusable(rw.query, ds)
+        return self._engine_for(rw).fusable(rw.query, ds)
 
     def execute_rewrite(self, rw: Rewrite, use_result_cache: bool = True):
         import pandas as pd
@@ -1170,9 +1171,10 @@ class TPUOlapContext:
 
         engine = self._engine_for(rw)
         state = None
-        fusable = engine is self.engine and self._fusable(rw, ds)
+        fusable = self._fusable(rw, ds)
         fused = (
-            self.serve.fused_execute(rw.query, ds) if fusable else None
+            self.serve.fused_execute(rw.query, ds, engine=engine)
+            if fusable else None
         )
         if fused is not None:
             df, state, m = fused

@@ -139,6 +139,12 @@ class SessionConfig:
     cost_per_group_state: float = 2e-5
     # merge-collective throughput, bytes per us (ICI ring allreduce)
     collective_bytes_per_us: float = 40_000.0
+    # cross-slice merge throughput, bytes per us (DCN allreduce between
+    # slices).  ~25 GB/s per-host DCN vs ~100+ GB/s ICI: the gap is what
+    # makes the hierarchical merge tree (slice-local psum first, then one
+    # small state over DCN) win once state_bytes is large enough —
+    # plan/cost.choose_merge_tree prices both trees with this constant
+    dcn_bytes_per_us: float = 25_000.0
     # fixed overhead of one SPMD dispatch + multi-device host gather, us
     cost_dispatch_us: float = 300.0
     # host->device transfer bandwidth, bytes/s.  Default is PCIe-class;
@@ -447,6 +453,7 @@ class SessionConfig:
                 "cost_per_row_compact",
                 "cost_per_group_state",
                 "collective_bytes_per_us",
+                "dcn_bytes_per_us",
                 "cost_dispatch_us",
                 "h2d_bytes_per_s",
             ):
@@ -499,6 +506,10 @@ class SessionConfig:
         # dispatch is function-call cheap — the ICI/RPC-flavoured defaults
         # would misprice the distributed-vs-local choice
         self.collective_bytes_per_us = 10_000.0
+        # a virtual slice boundary on CPU is still shared memory, but the
+        # modelled DCN gap must survive so the merge-tree choice exercises
+        # the same decision the pod makes
+        self.dcn_bytes_per_us = 2_500.0
         self.cost_dispatch_us = 100.0
         # "h2d" on CPU is a memcpy into the runtime's buffer
         self.h2d_bytes_per_s = 2e10

@@ -42,6 +42,12 @@ is that program.
   the same dispatch, with per-block membership flags as DATA (not trace
   constants — one compiled program serves any member->segment mapping of
   the same shape).
+* **Unified executor core** — `parallel/spmd_arena.py` shard_maps this
+  exact fold (`_member_init` / `_fold_block` / `finish_member`) over a
+  device-major permutation of the same stacked layout, with a psum/pmin/
+  pmax boundary merge, so the single-device and mesh paths lower the ONE
+  program; changes to the fold semantics here propagate to the SPMD path
+  by construction.
 """
 
 from __future__ import annotations

@@ -116,6 +116,22 @@ def _row_counts(segs) -> Tuple[int, int]:
     return rows, delta
 
 
+def _pack_host_state(sums, mins, maxs, sketches=None) -> dict:
+    """Canonical HOST partial-state dict — the interchange currency of
+    the unified executor core: the result cache stores it,
+    merge_groupby_states ⊕'s it, finalize_groupby_state renders it, and
+    the mesh executor (parallel/distributed) returns the identical shape
+    from its collective merge, so every consumer stays backend-agnostic."""
+    return {
+        "sums": np.asarray(sums),
+        "mins": np.asarray(mins),
+        "maxs": np.asarray(maxs),
+        "sketches": {
+            k: np.asarray(v) for k, v in (sketches or {}).items()
+        },
+    }
+
+
 def _prune_by_stats(segs, filt, ds: DataSource, vcol_names=frozenset()):
     """Zone-map pruning on a CONSERVATIVE filter subset: top-level AND
     conjuncts that are Selector/In over dictionary columns (matched in code
@@ -1327,12 +1343,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             else:
                 sums, mins, maxs = acc_h[i]
                 sk = sk_h[i]
-            state = {
-                "sums": np.asarray(sums),
-                "mins": np.asarray(mins),
-                "maxs": np.asarray(maxs),
-                "sketches": {k: np.asarray(v) for k, v in sk.items()},
-            }
+            state = _pack_host_state(sums, mins, maxs, sk)
             with span(SPAN_FINALIZE, member=i):
                 df = shape(finalize_groupby(
                     inner, lowering.dims, la,
@@ -1502,12 +1513,7 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
             inner, ds, lowering=lowering, segs=segs
         )
         sums, mins, maxs, sk = jax.device_get((sums, mins, maxs, sk))
-        state = {
-            "sums": np.asarray(sums),
-            "mins": np.asarray(mins),
-            "maxs": np.asarray(maxs),
-            "sketches": {k: np.asarray(v) for k, v in sk.items()},
-        }
+        state = _pack_host_state(sums, mins, maxs, sk)
         return state, sum(s.num_rows for s in segs)
 
     def merge_groupby_states(self, q: Q.QuerySpec, ds: DataSource, a, b):
@@ -1851,15 +1857,9 @@ class Engine(AdaptiveDomainMixin, SparseExecMixin):
                 if holder is not None and (
                     pc_cap is None or not pc_cap.triggered
                 ):
-                    holder["state"] = {
-                        "sums": np.asarray(sums),
-                        "mins": np.asarray(mins),
-                        "maxs": np.asarray(maxs),
-                        "sketches": {
-                            k: np.asarray(v)
-                            for k, v in sketch_states.items()
-                        },
-                    }
+                    holder["state"] = _pack_host_state(
+                        sums, mins, maxs, sketch_states
+                    )
                 # the phase-1 dispatch share (minus its h2d/compile) plus
                 # this query's own fetch wait is the device time; overlap
                 # hidden behind other queries' resolves is deliberately NOT

@@ -124,6 +124,21 @@ def pipelined_put(host, sharding=None, prefetched: bool = True):
     return arr, dt, nbytes
 
 
+def placement_order(names, is_resident, size_of) -> List[str]:
+    """Residency-aware placement order for a sharded column set — the
+    prefetch plan's scheduling rule applied per-device on the mesh
+    (parallel/distributed._place_arena): resident columns first (cache
+    hits cost nothing and unblock program-argument assembly), then cold
+    columns largest-first so the longest transfer issues earliest and
+    overlaps the remaining host-side stacking work.  Pure and
+    deterministic: ties keep the caller's order."""
+    names = list(dict.fromkeys(names))
+    resident = [n for n in names if is_resident(n)]
+    cold = [n for n in names if not is_resident(n)]
+    cold.sort(key=lambda n: -int(size_of(n)))
+    return resident + cold
+
+
 def _batch_keys(batch, names) -> List[Tuple]:
     """Residency-cache keys one dispatch batch needs: per-segment column
     entries plus the validity buffer — the SAME tagged scheme

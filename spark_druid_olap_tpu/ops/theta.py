@@ -30,7 +30,12 @@ import numpy as np
 
 from ..utils.hashing import hash_column
 
-SENTINEL = jnp.uint32(0xFFFFFFFF)
+# np scalar, not jnp: a module-level jnp constant executes a device
+# computation at IMPORT time, instantiating the XLA backend before
+# multihost rendezvous can run (jax.distributed.initialize refuses once
+# the backend exists — parallel/multihost.py).  Inside traces a numpy
+# uint32 scalar converts identically.
+SENTINEL = np.uint32(0xFFFFFFFF)
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "k"))

@@ -54,6 +54,8 @@ group-by past one chip's HBM without materializing anything global.
 
 from __future__ import annotations
 
+import contextlib
+import threading as _threading
 import time as _time
 from typing import Dict, List, Optional, Tuple
 
@@ -79,11 +81,16 @@ from ..ops import hll as hll_ops
 from ..ops import quantiles as quantiles_ops
 from ..ops import theta as theta_ops
 from ..obs import (
+    SPAN_ADAPTIVE_PROBE,
+    SPAN_ARENA_BUILD,
     SPAN_COLLECTIVE_MERGE,
     SPAN_FINALIZE,
+    SPAN_SEGMENT_DISPATCH,
+    SPAN_SPARSE_DISPATCH,
     current_query_id,
     record_query_metrics,
     span,
+    span_event,
 )
 from ..ops.groupby import (
     SCATTER_CUTOVER,
@@ -93,8 +100,16 @@ from ..ops.groupby import (
     scatter_partial_aggregate,
 )
 from ..utils.log import get_logger
-from .mesh import DATA_AXIS, GROUPS_AXIS, make_mesh, shard_map_compat
-from .multihost import put_sharded
+from . import spmd_arena
+from .mesh import (
+    DATA_AXIS,
+    GROUPS_AXIS,
+    SLICE_AXIS,
+    make_mesh,
+    row_axes,
+    shard_map_compat,
+)
+from .multihost import initialize as multihost_initialize, put_sharded
 
 log = get_logger("parallel.distributed")
 
@@ -119,7 +134,23 @@ class DistributedEngine:
     ):
         from ..utils.lru import ByteBudgetCache, CountBudgetCache
 
-        self.mesh = mesh if mesh is not None else make_mesh()
+        # multi-host runtime formation (parallel/multihost.py) rides the
+        # unified core's construction: a no-op single-process, it resolves
+        # the jax.distributed cluster from env markers on real pods so the
+        # mesh below spans every host's devices (ISSUE 15 satellite)
+        multihost_initialize()
+        if mesh is not None and SLICE_AXIS in mesh.shape:
+            # virtual multi-slice topology: the slice mesh drives ONLY the
+            # unified arena path (placement + merge tree).  Legacy SPMD
+            # programs keep their (data, groups) contract by flattening
+            # the slice x data product onto the data axis — the mesh is a
+            # placement strategy, not a fork of the executor.
+            self.slice_mesh = mesh
+            devs = list(mesh.devices.flat)
+            self.mesh = make_mesh(n_data=len(devs), n_groups=1, devices=devs)
+        else:
+            self.slice_mesh = None
+            self.mesh = mesh if mesh is not None else make_mesh()
         # "auto" routes by the calibrated cost model; an explicit kernel
         # class is honored as such, same contract as
         # exec.engine.Engine(strategy=...).  Validated here: an unknown
@@ -162,6 +193,14 @@ class DistributedEngine:
         self.breaker = CircuitBreaker()
         self._retry_attempts = 2
         self._retry_backoff_ms = 25.0
+        # unified SPMD-arena core (ISSUE 15): the stacked [B, R] layout
+        # shared with exec/arena.py, sharded over the row devices.
+        # TPUOlapContext syncs this from SessionConfig.arena_execution,
+        # same contract as the local engine's toggle.
+        self.arena_execution = True
+        # per-thread state-capture holder (delta-aware result cache):
+        # mirrors exec.engine.Engine._m_local
+        self._m_local = _threading.local()
 
     def _cfg(self):
         if self._calibrated_cfg is None:
@@ -618,11 +657,20 @@ class DistributedEngine:
         from ..exec.lowering import memo_key
         from ..exec.metrics import QueryMetrics
 
-        from ..resilience import checkpoint, fire
+        from ..resilience import (
+            checkpoint, checkpoint_partial, current_partial, fire,
+        )
 
         # deadline checkpoint + device-dispatch fault site: the SPMD path
-        # honors the same lifecycle contract as the single-device engine
-        checkpoint("mesh.dispatch")
+        # honors the same lifecycle contract as the single-device engine.
+        # With a partial collector armed, an expiry here must degrade to a
+        # coverage-stamped best-effort answer (the arena path's chunk loop
+        # stops before its first dispatch), not an error — the engine's
+        # anytime-answer contract, now on the mesh.
+        if current_partial() is None:
+            checkpoint("mesh.dispatch")
+        else:
+            checkpoint_partial("mesh.dispatch")
         fire("device_dispatch")
         t_total = _time.perf_counter()
         lowering = self._lowering_for(q, ds)
@@ -663,6 +711,14 @@ class DistributedEngine:
                 if out is None:  # ladder exhausted: dense-state scatter
                     strategy = "segment"
                     m.strategy = strategy
+            if out is None and strategy in (
+                "dense", "pallas", "segment", "scatter",
+            ):
+                # unified SPMD-arena core (ISSUE 15): the stacked-layout
+                # program with scope as data.  None => ineligible (layout
+                # declined / sketch aggs / groups axis) — fall through to
+                # the legacy dense-state path unchanged.
+                out = self._execute_arena_spmd(q, ds, lowering, m, strategy)
             if out is None:
                 out = self._execute_dense_state(q, ds, lowering, m, strategy)
         except BaseException as err:
@@ -700,9 +756,21 @@ class DistributedEngine:
         segs = self._scope_for_metrics(q, ds) if q is not None else None
         cols, padded = self._global_columns(ds, columns, segs=segs)
         if len(self._shard_cache) > known:  # new shards were placed
-            m.h2d_ms += (_time.perf_counter() - t0) * 1e3
-            m.h2d_bytes += max(
-                0, self._shard_cache.bytes_used - before_bytes
+            from ..obs import prof
+
+            dt = _time.perf_counter() - t0
+            new_bytes = max(0, self._shard_cache.bytes_used - before_bytes)
+            m.h2d_ms += dt * 1e3
+            m.h2d_bytes += new_bytes
+            # receipts parity with the single-device engine: the transfer
+            # reaches the profiling scope's h2d accumulators, and the
+            # per-shard split is recorded as a span event so mesh bench
+            # artifacts are attribution-honest (ISSUE 15 satellite)
+            prof.record_h2d(new_bytes, dt)
+            nd = self.mesh.shape[DATA_AXIS]
+            span_event(
+                "shard_h2d", datasource=ds.name, bytes=new_bytes,
+                per_shard_bytes=new_bytes // max(1, nd), shards=nd,
             )
         return cols, padded
 
@@ -816,7 +884,10 @@ class DistributedEngine:
             run = self._spmd_sparse_fn(
                 lowering, local_rows, ds, tuple(cols.keys()), slots, cap
             )
-            state, flags = jax.device_get(run(cols))
+            # dispatch span: the mesh receipt's dispatch_count must count
+            # sparse rungs like the single-device ladder does
+            with span(SPAN_SPARSE_DISPATCH, slots=slots):
+                state, flags = jax.device_get(run(cols))
             if cap is not None and bool(flags["row_overflow"].any()):
                 n = int(flags["n_rows"].max())
                 new_cap = next(
@@ -938,7 +1009,8 @@ class DistributedEngine:
                 run = self._presence_fn(
                     lowering, local_rows, ds, tuple(cols.keys())
                 )
-                counts = jax.device_get(run(cols))
+                with span(SPAN_ADAPTIVE_PROBE):
+                    counts = jax.device_get(run(cols))
             except RuntimeError:
                 # transient device failures belong to execute()'s
                 # evict-and-retry path, NOT a permanent decline (review r5)
@@ -994,3 +1066,704 @@ class DistributedEngine:
         return self._execute_dense_state(
             q, ds, clow, m, strat, key_extra=("adaptive",) + cards
         )
+
+    # -- unified SPMD-arena core (ISSUE 15) ----------------------------------
+    #
+    # The stacked [B, R] arena layout (exec/arena.py) sharded over the row
+    # devices is the ONE program both paths lower; the mesh contributes a
+    # placement strategy (device-major permuted stacking) and a boundary
+    # collective merge.  The scope rides as DATA (membership + window
+    # start), so one compiled program serves every same-window-size scope.
+
+    def _arena_mesh(self) -> Mesh:
+        """The mesh the arena path shards rows over: the virtual
+        multi-slice mesh when one was given, the flat data mesh
+        otherwise."""
+        return self.slice_mesh if self.slice_mesh is not None else self.mesh
+
+    def _arena_mesh_key(self) -> Tuple:
+        return tuple(sorted(self._arena_mesh().shape.items()))
+
+    def _row_device_count(self) -> int:
+        mesh = self._arena_mesh()
+        return int(np.prod([mesh.shape[a] for a in row_axes(mesh)]))
+
+    def _arena_layout(self, ds: DataSource):
+        """The scope-independent stacked layout for `ds`, or None when
+        the arena path must decline (toggle off, per-query disable,
+        group-domain sharding, <2 segments, or non-uniform padded row
+        counts)."""
+        if not self.arena_execution:
+            return None
+        from ..exec import arena as _arena_mod
+
+        if _arena_mod.query_disabled():
+            return None
+        if self.mesh.shape[GROUPS_AXIS] > 1:
+            # the groups axis shards the gid domain — the arena program
+            # folds full-domain states, so the legacy paths own that mesh
+            return None
+        return spmd_arena.plan_spmd_layout(ds, self._row_device_count())
+
+    def _merge_tree_for(self, q, lowering) -> Tuple[str, float, float]:
+        """(tree, flat_us, hier_us): the calibrated cost model's merge
+        tree for this query's state size on this topology.  On the flat
+        data mesh both trees coincide at ICI pricing and "flat" wins the
+        tie — the single-program default."""
+        from ..plan.cost import choose_merge_tree, groupby_state_bytes
+
+        sbytes = groupby_state_bytes(q, lowering.num_groups, None)
+        if self.slice_mesh is not None:
+            ns = self.slice_mesh.shape[SLICE_AXIS]
+            nd = self.slice_mesh.shape[DATA_AXIS]
+        else:
+            ns, nd = 1, self.mesh.shape[DATA_AXIS]
+        return choose_merge_tree(sbytes, ns, nd, self._cfg())
+
+    def _place_arena(self, ds: DataSource, layout, names, m):
+        """Place (or reuse) the permuted [B_pad, R] column stacks.
+
+        Keys carry the FULL segment signature and the row-device count —
+        never a query's scope — so residency is durable across every
+        query of the datasource version (the r4 #3 contract, now with
+        program-cache generality on top).  Placement order is the PR 10
+        prefetch plan ported per-device: resident stacks first (free
+        cache hits), then cold stacks largest-first so the longest
+        transfer issues earliest."""
+        from ..exec.pipeline import placement_order
+        from ..obs import prof
+        from ..resilience import fire
+
+        fire("h2d")  # fault-injection site: shard placement
+        mesh = self._arena_mesh()
+        row_el = spmd_arena._row_spec_axes(mesh)
+        sharding = NamedSharding(mesh, P(row_el, None))
+        base = (ds.name, "spmd_arena", layout.ndt, layout.uids)
+
+        def ckey(name: str) -> Tuple:
+            # "col"/"valid" tags: a user column literally named
+            # "__valid" must not alias the validity stack (GL1301)
+            if name == "__valid":
+                return base + ("valid",)
+            return base + ("col", name)
+
+        def est_bytes(name: str) -> int:
+            if name == "__valid":
+                return layout.B_pad * layout.R  # bool stack
+            proto = np.asarray(layout.segs[0].column(name))
+            return layout.B_pad * layout.R * proto.dtype.itemsize
+
+        want = list(dict.fromkeys(list(names) + ["__valid"]))
+        order = placement_order(
+            want, lambda n: self._shard_cache.get(ckey(n)) is not None,
+            est_bytes,
+        )
+        t0 = _time.perf_counter()
+        before = self._shard_cache.bytes_used
+        cols: Dict[str, jax.Array] = {}
+        placed = 0
+        with span(
+            SPAN_ARENA_BUILD, datasource=ds.name, blocks=layout.B,
+            shards=layout.ndt,
+        ):
+            for name in order:
+                key = ckey(name)
+                hit = self._shard_cache.get(key)
+                if hit is None:
+                    host = spmd_arena.stack_column(layout, name)
+                    hit = put_sharded(host, sharding)
+                    self._shard_cache[key] = hit
+                    placed += 1
+                cols[name] = hit
+        prof.note_residency(hit=placed == 0)
+        if ds.time_column and ds.time_column in cols:
+            cols["__time"] = cols[ds.time_column]
+        if placed:
+            dt = _time.perf_counter() - t0
+            new_bytes = max(0, self._shard_cache.bytes_used - before)
+            m.h2d_ms += dt * 1e3
+            m.h2d_bytes += new_bytes
+            prof.record_h2d(new_bytes, dt)
+            span_event(
+                "shard_h2d", datasource=ds.name, bytes=new_bytes,
+                per_shard_bytes=new_bytes // max(1, layout.ndt),
+                shards=layout.ndt, columns=placed,
+            )
+        return cols
+
+    def prefetch(self, q: Q.QuerySpec, ds: DataSource) -> bool:
+        """Warm the arena placement for `q` ahead of execution (the PR 10
+        prefetch plan surfaced on the mesh): places the stacked column
+        set in residency-aware order so a following execute() pays zero
+        h2d.  Returns False when the query/datasource is not
+        arena-eligible (nothing to warm)."""
+        inner, _ = self._groupby_family(q, ds)
+        if inner is None:
+            return False
+        inner = groupby_with_time_granularity(inner)
+        lowering = self._lowering_for(inner, ds)
+        layout = self._arena_layout(ds)
+        if layout is None:
+            return False
+        from ..exec.metrics import QueryMetrics
+
+        scratch = QueryMetrics(query_type="prefetch")
+        self._place_arena(ds, layout, lowering.columns, scratch)
+        return True
+
+    def _arena_spmd_fn(self, lowering, ds, layout, Lk, strategy, tree):
+        """The cached single-dispatch unified program.  The key carries
+        the window LENGTH `Lk` but never the scope itself — two disjoint
+        scopes of equal rounded size share one compiled program."""
+        from ..exec.lowering import _query_key
+        from ..obs import prof
+
+        # literal tag at the same tuple position as the legacy families
+        # ("dense-state"/"sparse"/...) so no key can alias across
+        # families sharing _spmd_cache (GL1301)
+        cache_key = _query_key(lowering.query, ds) + (
+            layout.L,
+            self._arena_mesh_key(),
+            "arena-spmd", layout.R, Lk, strategy, tree,
+        )
+        if cache_key in self._spmd_cache:
+            prof.note_program_cache("arena-spmd", hit=True)
+            return self._spmd_cache[cache_key]
+        prof.note_program_cache("arena-spmd", hit=False)
+        run = spmd_arena.build_spmd_arena_program(
+            self._arena_mesh(), [lowering], [strategy], Lk, tree=tree
+        )
+        self._spmd_cache[cache_key] = run
+        return run
+
+    def _arena_chunk_fn(self, lowering, ds, layout, strategy):
+        from ..exec.lowering import _query_key
+        from ..obs import prof
+
+        cache_key = _query_key(lowering.query, ds) + (
+            layout.L,
+            self._arena_mesh_key(),
+            "arena-spmd-chunk", layout.R, strategy,
+        )
+        if cache_key in self._spmd_cache:
+            prof.note_program_cache("arena-spmd-chunk", hit=True)
+            return self._spmd_cache[cache_key]
+        prof.note_program_cache("arena-spmd-chunk", hit=False)
+        run = spmd_arena.build_spmd_chunk_program(
+            self._arena_mesh(), [lowering], [strategy]
+        )
+        self._spmd_cache[cache_key] = run
+        return run
+
+    def _arena_merge_fn(self, lowering, ds, tree):
+        from ..exec.lowering import _query_key
+        from ..obs import prof
+
+        cache_key = _query_key(lowering.query, ds) + (
+            0,
+            self._arena_mesh_key(),
+            "arena-spmd-merge", tree,
+        )
+        if cache_key in self._spmd_cache:
+            prof.note_program_cache("arena-spmd-merge", hit=True)
+            return self._spmd_cache[cache_key]
+        prof.note_program_cache("arena-spmd-merge", hit=False)
+        run = spmd_arena.build_spmd_merge_program(
+            self._arena_mesh(), [lowering], tree=tree
+        )
+        self._spmd_cache[cache_key] = run
+        return run
+
+    def _slice_count(self) -> int:
+        return (
+            self.slice_mesh.shape[SLICE_AXIS]
+            if self.slice_mesh is not None
+            else 1
+        )
+
+    def _execute_arena_spmd(self, q, ds, lowering, m, strategy):
+        """The unified executor core on the mesh: ONE dispatch folds the
+        scope inside the trace and merges at the boundary.  Returns None
+        to decline (caller falls through to the legacy dense-state
+        path)."""
+        layout = self._arena_layout(ds)
+        if layout is None or lowering.la.sketch_aggs:
+            return None
+        from ..exec.engine import _row_counts
+        from ..exec.lowering import empty_partials
+        from ..obs import prof
+        from ..resilience import current_deadline, current_partial
+
+        la, G = lowering.la, lowering.num_groups
+        pc = current_partial()
+        scope = self._scope_for_metrics(q, ds)
+        if not scope:
+            if pc is not None:
+                pc.begin_pass()
+                pc.add_scope(0, 0)
+            sums, mins, maxs, _sk = jax.device_get(empty_partials(la, G))
+        else:
+            canonical = sorted(layout.index[s.uid] for s in scope)
+            j_lo, Lk = spmd_arena.scope_window(layout, canonical)
+            memb = spmd_arena.membership_matrix(layout, [canonical])
+            tree, flat_us, hier_us = self._merge_tree_for(q, lowering)
+            m.est_collective_ms = min(flat_us, hier_us) / 1e3
+            cols = self._place_arena(ds, layout, lowering.columns, m)
+            rows, delta = _row_counts(scope)
+            if pc is not None:
+                pc.begin_pass()
+                pc.add_scope(len(scope), rows, delta)
+            if current_deadline() is None:
+                compiled = self._spmd_cache
+                key_count = len(compiled)
+                run = self._arena_spmd_fn(
+                    lowering, ds, layout, Lk, strategy, tree
+                )
+                m.program_cache_hit = len(compiled) == key_count
+                t0 = _time.perf_counter()
+                # single dispatch + single fetch under the collective-
+                # merge span: the receipt's dispatch_count is 1 per query
+                with span(
+                    SPAN_COLLECTIVE_MERGE, merge_tree=tree,
+                    shards=layout.ndt, window=Lk,
+                ):
+                    span_event(
+                        "merge_tree", tree=tree,
+                        flat_us=round(flat_us, 3),
+                        hier_us=round(hier_us, 3),
+                        shards=layout.ndt, slices=self._slice_count(),
+                    )
+                    t_call = _time.perf_counter()
+                    out_state = run(cols, np.int32(j_lo), memb)
+                    out_state = prof.dispatch_sync(out_state, t_call)
+                    sums, mins, maxs, _live = jax.device_get(out_state[0])
+                dt = (_time.perf_counter() - t0) * 1e3
+                if m.program_cache_hit:
+                    m.device_ms = dt
+                else:
+                    m.compile_ms = dt
+                    prof.note_compile(dt, family="arena-spmd")
+                if pc is not None:
+                    pc.add_seen(len(scope), rows, delta)
+            else:
+                sums, mins, maxs = self._arena_spmd_deadline(
+                    ds, lowering, m, strategy, layout, cols, memb,
+                    canonical, j_lo, Lk, tree, pc,
+                )
+        # result-cache state capture: the merged host partial state from
+        # the collective — never a deadline-truncated one
+        holder = getattr(self._m_local, "capture", None)
+        if holder is not None and (pc is None or not pc.triggered):
+            holder["state"] = self._pack_state(sums, mins, maxs)
+        t0 = _time.perf_counter()
+        with span(SPAN_FINALIZE):
+            out = finalize_groupby(
+                q, lowering.dims, la,
+                np.asarray(sums), np.asarray(mins), np.asarray(maxs), {},
+            )
+        m.finalize_ms += (_time.perf_counter() - t0) * 1e3
+        return out
+
+    def _arena_spmd_deadline(
+        self, ds, lowering, m, strategy, layout, cols, memb, canonical,
+        j_lo, Lk, tree, pc,
+    ):
+        """Deadline partials on the unified core: per-shard stop-and-merge.
+        The chunk loop folds one local step per dispatch into a
+        row-sharded carry; a truncation lands on a step boundary, the
+        merge program runs the boundary collectives over whatever was
+        folded, and coverage is accounted host-side — local step `j`
+        covers exactly the canonical blocks {j*ndt + d}, summed across
+        shards."""
+        from ..exec.engine import _row_counts
+        from ..obs import prof
+        from ..resilience import checkpoint_partial, fire
+
+        ndt = layout.ndt
+        compiled = self._spmd_cache
+        key_count = len(compiled)
+        step_fn = self._arena_chunk_fn(lowering, ds, layout, strategy)
+        merge_fn = self._arena_merge_fn(lowering, ds, tree)
+        m.program_cache_hit = len(compiled) == key_count
+        carry = spmd_arena.init_carry_stacked(self._arena_mesh(), [lowering])
+        by_step: Dict[int, List] = {}
+        for b in canonical:
+            by_step.setdefault(b // ndt, []).append(layout.segs[b])
+        t0 = _time.perf_counter()
+        for j in range(j_lo, j_lo + Lk):
+            if checkpoint_partial("mesh.segment_loop"):
+                break
+            fire("device_dispatch")
+            with span(
+                SPAN_SEGMENT_DISPATCH, arena=1, chunk=j - j_lo,
+                shards=ndt,
+            ):
+                t_call = _time.perf_counter()
+                carry = step_fn(carry, cols, np.int32(j), memb)
+                carry = prof.dispatch_sync(carry, t_call)
+            if pc is not None:
+                segs_j = by_step.get(j, [])
+                rows_j, delta_j = _row_counts(segs_j)
+                pc.add_seen(len(segs_j), rows_j, delta_j)
+        with span(SPAN_COLLECTIVE_MERGE, merge_tree=tree, shards=ndt):
+            sums, mins, maxs, _live = jax.device_get(merge_fn(carry)[0])
+        dt = (_time.perf_counter() - t0) * 1e3
+        if m.program_cache_hit:
+            m.device_ms = dt
+        else:
+            m.compile_ms = dt
+            prof.note_compile(dt, family="arena-spmd-chunk")
+        return sums, mins, maxs
+
+    @staticmethod
+    def _pack_state(sums, mins, maxs, sketches=None) -> Dict:
+        """Host partial-state dict in the result cache's schema — the
+        engine's canonical packing, so mesh- and single-device-produced
+        states are interchangeable under merge/finalize."""
+        from ..exec.engine import _pack_host_state
+
+        return _pack_host_state(sums, mins, maxs, sketches)
+
+    # -- host partial-state surface (delta-aware result cache) ---------------
+
+    def _groupby_family(self, q: Q.QuerySpec, ds: DataSource):
+        """GroupBy-family normalization, shared shape with the local
+        engine (exec.engine.Engine._groupby_family)."""
+        if isinstance(q, Q.TimeseriesQuery):
+            return (
+                timeseries_to_groupby(q),
+                lambda df: finalize_timeseries(df, q, ds),
+            )
+        if isinstance(q, Q.TopNQuery):
+            return topn_to_groupby(q), lambda df: finalize_topn(df, q)
+        if isinstance(q, Q.GroupByQuery):
+            return q, lambda df: df
+        return None, None
+
+    @contextlib.contextmanager
+    def state_capture(self):
+        """Capture the merged HOST partial state of the next execution on
+        this thread (the arena path stashes it just before finalize).
+        Yields a dict whose "state" key holds the capture — None when the
+        execution declined to the legacy paths or was deadline-truncated
+        (a partial state must never seed the delta-aware result
+        cache)."""
+        holder = {"state": None}
+        self._m_local.capture = holder
+        try:
+            yield holder
+        finally:
+            self._m_local.capture = None
+
+    def groupby_partials_host(
+        self, q: Q.QuerySpec, ds: DataSource, within_uids=None
+    ):
+        """Merged HOST partial state over the in-scope segments whose uid
+        is in `within_uids` (None = the full scope) — the delta-reuse
+        entry point, same contract as the local engine's.  Membership is
+        data, so the delta scan is the SAME compiled program folding only
+        the fresh blocks.  Raises ValueError when the query/datasource
+        cannot produce mesh partial state (callers treat it as a cache
+        decline)."""
+        from ..exec.lowering import empty_partials, memo_key
+
+        inner, _ = self._groupby_family(q, ds)
+        if inner is None:
+            raise ValueError(f"{type(q).__name__} has no partial state")
+        inner = groupby_with_time_granularity(inner)
+        lowering = self._lowering_for(inner, ds)
+        layout = self._arena_layout(ds)
+        if layout is None or lowering.la.sketch_aggs:
+            raise ValueError(
+                "query/datasource is not SPMD-arena eligible on the mesh"
+            )
+        strategy = self._route_strategy(
+            inner, ds, lowering, memo_key(inner, ds)
+        )
+        if strategy in ("sparse", "adaptive"):
+            raise ValueError(
+                f"{strategy} tier has no mergeable mesh partial state"
+            )
+        segs = self._scope_for_metrics(inner, ds)
+        if within_uids is not None:
+            w = frozenset(within_uids)
+            segs = [s for s in segs if s.uid in w]
+        la, G = lowering.la, lowering.num_groups
+        if not segs:
+            sums, mins, maxs, _sk = jax.device_get(empty_partials(la, G))
+        else:
+            from ..exec.metrics import QueryMetrics
+
+            scratch = QueryMetrics(query_type="partials")
+            canonical = sorted(layout.index[s.uid] for s in segs)
+            j_lo, Lk = spmd_arena.scope_window(layout, canonical)
+            memb = spmd_arena.membership_matrix(layout, [canonical])
+            tree, _f, _h = self._merge_tree_for(inner, lowering)
+            cols = self._place_arena(ds, layout, lowering.columns, scratch)
+            run = self._arena_spmd_fn(lowering, ds, layout, Lk, strategy, tree)
+            with span(SPAN_COLLECTIVE_MERGE, merge_tree=tree, partials=1):
+                sums, mins, maxs, _live = jax.device_get(
+                    run(cols, np.int32(j_lo), memb)[0]
+                )
+        state = self._pack_state(sums, mins, maxs)
+        return state, sum(s.num_rows for s in segs)
+
+    def merge_groupby_states(self, q: Q.QuerySpec, ds: DataSource, a, b):
+        """⊕ of two host partial states of the SAME query (the
+        partial-aggregate-state algebra, identical to the local
+        engine's).  Raises ValueError on a shape mismatch (dictionary
+        domain changed — callers treat it as a cache miss)."""
+        from ..exec.engine import _merge_sketch_states
+
+        if a["sums"].shape != b["sums"].shape:
+            raise ValueError(
+                f"partial-state shape mismatch {a['sums'].shape} vs "
+                f"{b['sums'].shape} (dictionary domain changed)"
+            )
+        inner, _ = self._groupby_family(q, ds)
+        lowering = self._lowering_for(
+            groupby_with_time_granularity(inner), ds
+        )
+        merged = {
+            "sums": a["sums"] + b["sums"],
+            "mins": np.minimum(a["mins"], b["mins"]),
+            "maxs": np.maximum(a["maxs"], b["maxs"]),
+            "sketches": dict(a["sketches"]),
+        }
+        _merge_sketch_states(lowering.la, merged["sketches"], b["sketches"])
+        merged["sketches"] = {
+            k: np.asarray(v) for k, v in merged["sketches"].items()
+        }
+        return merged
+
+    def finalize_groupby_state(self, q: Q.QuerySpec, ds: DataSource, state):
+        """Host partial state -> the query's final result frame (the same
+        finalize the live mesh execution runs)."""
+        inner, shape = self._groupby_family(q, ds)
+        inner = groupby_with_time_granularity(inner)
+        lowering = self._lowering_for(inner, ds)
+        with span(SPAN_FINALIZE):
+            df = finalize_groupby(
+                inner, lowering.dims, lowering.la,
+                np.asarray(state["sums"]),
+                np.asarray(state["mins"]),
+                np.asarray(state["maxs"]),
+                {k: np.asarray(v) for k, v in state["sketches"].items()},
+            )
+        return shape(df)
+
+    # -- micro-batch fusion on the shared arena ------------------------------
+
+    def fusable(self, q: Q.QuerySpec, ds: DataSource) -> bool:
+        """May this query join a fused micro-batch on the mesh?  Same
+        surface as the local engine's: GroupBy-family, no wire subtotals,
+        and the unified arena program can host it (no sketches, no
+        sparse/adaptive tier, layout eligible)."""
+        inner, _ = self._groupby_family(q, ds)
+        if inner is None or inner.subtotals:
+            return False
+        try:
+            inner = groupby_with_time_granularity(inner)
+            lowering = self._lowering_for(inner, ds)
+        except Exception:  # fault-ok: an unlowerable query declines fusion
+            return False
+        if lowering.la.sketch_aggs:
+            return False
+        from ..exec.lowering import memo_key
+
+        strategy = self._route_strategy(
+            inner, ds, lowering, memo_key(inner, ds)
+        )
+        if strategy in ("sparse", "adaptive"):
+            return False
+        return self._arena_layout(ds) is not None
+
+    def _arena_spmd_fused_fn(self, members, ds, layout, Lk, strategies, tree):
+        """The fused unified program: every member's fold inside ONE
+        sharded scan, membership as data (one compiled program serves
+        any member->scope mapping of the same window size)."""
+        import json as _json
+
+        from ..exec.lowering import _query_key
+        from ..obs import prof
+
+        cache_key = _query_key(members[0][1], ds) + (
+            layout.L,
+            self._arena_mesh_key(),
+            "arena-spmd-fused",
+            tuple(
+                _json.dumps(mm[1].to_druid(), sort_keys=True, default=str)
+                for mm in members[1:]
+            ),
+            strategies, layout.R, Lk, tree,
+        )
+        if cache_key in self._spmd_cache:
+            prof.note_program_cache("arena-spmd-fused", hit=True)
+            return self._spmd_cache[cache_key]
+        prof.note_program_cache("arena-spmd-fused", hit=False)
+        from ..serve.fusion import shared_row_plan
+
+        share = shared_row_plan([mm[1] for mm in members])
+        run = spmd_arena.build_spmd_arena_program(
+            self._arena_mesh(), [mm[3] for mm in members], list(strategies),
+            Lk, tree=tree, share=share,
+        )
+        self._spmd_cache[cache_key] = run
+        return run
+
+    def execute_fused(self, queries, ds: DataSource, query_ids=None):
+        """Execute N compatible GroupBy-family queries as ONE unified
+        arena dispatch: members share the sharded arena via the
+        membership scan input, every member's fold runs inside the same
+        program, and ONE host fetch returns all merged states.  Same
+        (df, state, metrics) contract as the local engine's
+        execute_fused; an ineligible batch falls back to serial
+        per-member execution (state still captured)."""
+        from ..exec.lowering import empty_partials, memo_key
+        from ..exec.metrics import QueryMetrics
+        from ..obs import prof
+        from ..resilience import checkpoint, fire
+
+        t0_all = _time.perf_counter()
+        n = len(queries)
+        query_ids = list(query_ids or [""] * n)
+        members = []
+        for q in queries:
+            inner, shape = self._groupby_family(q, ds)
+            if inner is None:
+                raise ValueError(
+                    f"{type(q).__name__} is not fusable (GroupBy-family "
+                    "queries only)"
+                )
+            inner = groupby_with_time_granularity(inner)
+            lowering = self._lowering_for(inner, ds)
+            segs = self._scope_for_metrics(inner, ds)
+            members.append((q, inner, shape, lowering, segs))
+        layout = self._arena_layout(ds)
+        strategies = tuple(
+            self._route_strategy(mm[1], ds, mm[3], memo_key(mm[1], ds))
+            for mm in members
+        )
+        if (
+            layout is None
+            or any(mm[3].la.sketch_aggs for mm in members)
+            or any(s in ("sparse", "adaptive") for s in strategies)
+        ):
+            return self._execute_fused_serial(queries, ds, query_ids)
+        prof.note_fusion(n)
+        checkpoint("engine.fused_loop")  # fused deadline contract
+        fire("device_dispatch")
+        member_scopes = [
+            sorted(layout.index[s.uid] for s in mm[4]) for mm in members
+        ]
+        all_blocks = sorted({b for sc in member_scopes for b in sc})
+        batch_m = QueryMetrics(query_type="fused")
+        states = None
+        tree = "flat"
+        if all_blocks:
+            j_lo, Lk = spmd_arena.scope_window(layout, all_blocks)
+            memb = spmd_arena.membership_matrix(layout, member_scopes)
+            tree, flat_us, hier_us = self._merge_tree_for(
+                members[0][1], members[0][3]
+            )
+            names = list(
+                dict.fromkeys(c for mm in members for c in mm[3].columns)
+            )
+            cols = self._place_arena(ds, layout, names, batch_m)
+            compiled = self._spmd_cache
+            key_count = len(compiled)
+            fn = self._arena_spmd_fused_fn(
+                members, ds, layout, Lk, strategies, tree
+            )
+            batch_m.program_cache_hit = len(compiled) == key_count
+            t0 = _time.perf_counter()
+            with span(
+                SPAN_COLLECTIVE_MERGE, merge_tree=tree, fused=n,
+                shards=layout.ndt, window=Lk,
+            ):
+                span_event(
+                    "merge_tree", tree=tree, flat_us=round(flat_us, 3),
+                    hier_us=round(hier_us, 3), shards=layout.ndt,
+                    slices=self._slice_count(), fused=n,
+                )
+                t_call = _time.perf_counter()
+                outs = fn(cols, np.int32(j_lo), memb)
+                outs = prof.dispatch_sync(outs, t_call)
+                # ONE fetch for the whole batch — the round trip the
+                # fused dispatch exists to amortize
+                states = jax.device_get(outs)
+            dt = (_time.perf_counter() - t0) * 1e3
+            if batch_m.program_cache_hit:
+                batch_m.device_ms = dt
+            else:
+                batch_m.compile_ms = dt
+                prof.note_compile(dt, family="arena-spmd-fused")
+        # empty-scope members in ONE host fetch before the demux loop
+        # (GL204: no per-member device round trips while demuxing)
+        empties = jax.device_get({
+            i: empty_partials(mm[3].la, mm[3].num_groups)
+            for i, mm in enumerate(members)
+            if states is None or not member_scopes[i]
+        })
+        out = []
+        elapsed_ms = (_time.perf_counter() - t0_all) * 1e3
+        from ..exec.engine import _bytes_scanned, _row_counts
+
+        for i, (q, inner, shape, lowering, segs) in enumerate(members):
+            la, G = lowering.la, lowering.num_groups
+            if i in empties:
+                # empty scope: dead-shard identities ARE empty_partials,
+                # but skip the device state entirely when nothing ran
+                sums, mins, maxs, _sk = empties[i]
+            else:
+                sums, mins, maxs, _live = states[i]
+            state = self._pack_state(sums, mins, maxs)
+            with span(SPAN_FINALIZE, member=i):
+                df = shape(finalize_groupby(
+                    inner, lowering.dims, la,
+                    state["sums"], state["mins"], state["maxs"],
+                    state["sketches"],
+                ))
+            try:
+                qt = q.to_druid().get("queryType", type(q).__name__)
+            except Exception:  # fault-ok: metrics labeling only
+                qt = type(q).__name__
+            rows, _delta = _row_counts(segs)
+            mm = QueryMetrics(
+                query_type=qt,
+                strategy=strategies[i],
+                datasource=ds.name,
+                query_id=query_ids[i],
+                distributed=True,
+                mesh_shape=tuple(self.mesh.shape.values()),
+                rows_scanned=rows,
+                bytes_scanned=_bytes_scanned(segs, lowering.columns),
+                segments=len(segs),
+                num_groups=G,
+                # the batch's shared h2d/compile split evenly: ONE
+                # stacked column set moved for all members
+                h2d_bytes=batch_m.h2d_bytes // n,
+                h2d_ms=batch_m.h2d_ms / n,
+                compile_ms=batch_m.compile_ms,
+                total_ms=elapsed_ms,
+                fused_batch=n,
+                program_cache_hit=batch_m.program_cache_hit,
+            )
+            record_query_metrics(mm, "ok")
+            out.append((df, state, mm))
+        self.last_metrics = out[-1][2] if out else None
+        return out
+
+    def _execute_fused_serial(self, queries, ds, query_ids):
+        """Fallback for an arena-ineligible batch: serial per-member
+        execution under state capture — the same (df, state, metrics)
+        tuple contract, minus the shared dispatch."""
+        out = []
+        for q, qid in zip(queries, query_ids):
+            with self.state_capture() as cap:
+                df = self.execute(q, ds)
+            mm = self.last_metrics
+            if mm is not None and qid:
+                mm.query_id = qid
+            out.append((df, cap["state"], mm))
+        return out

@@ -83,6 +83,20 @@ def initialize(
         ):
             return False
     try:
+        # CPU backend: cross-process collectives need the Gloo transport
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend" otherwise) — must be set BEFORE the runtime forms.
+        # Real TPU/GPU pods ignore it; a jax build without the flag (or
+        # without Gloo) keeps the old failure mode at dispatch time.
+        import os as _os
+
+        if _os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:  # fault-ok: older/newer flagless builds
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

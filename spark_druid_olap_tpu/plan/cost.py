@@ -71,6 +71,41 @@ def groupby_state_bytes(q: Q.QuerySpec, num_groups: int, cfg: SessionConfig) -> 
     return (per_group + 4) * num_groups  # +4: hidden __rows counter
 
 
+def choose_merge_tree(
+    state_bytes: int,
+    n_slices: int,
+    nd_per_slice: int,
+    cfg: SessionConfig,
+) -> Tuple[str, float, float]:
+    """Pick the collective merge tree for a multi-slice partial-state
+    merge (the arXiv:2603.26698 playbook priced with this platform's
+    calibrated constants).  Returns (tree, flat_us, hier_us) where tree
+    is "flat" or "hierarchical":
+
+    * flat — one allreduce over slice x data.  Ring cost is
+      2(N-1)/N * bytes, but every hop is priced at DCN speed because the
+      ring crosses the slice boundary.
+    * hierarchical — slice-local allreduce over ICI (2(nd-1)/nd * bytes
+      at ICI speed), then one allreduce of the already-merged state over
+      the slice axis only (2(ns-1)/ns * bytes at DCN speed).
+
+    With one slice the ring never leaves ICI (flat is priced at ICI
+    speed and the trees coincide); flat wins ties so the single-program
+    path stays the default."""
+    n = max(1, n_slices * nd_per_slice)
+    flat_bw = (
+        cfg.dcn_bytes_per_us if n_slices > 1 else cfg.collective_bytes_per_us
+    )
+    flat_us = 2.0 * (n - 1) / n * state_bytes / max(1.0, flat_bw)
+    hier_us = 2.0 * (nd_per_slice - 1) / max(1, nd_per_slice) * (
+        state_bytes / max(1.0, cfg.collective_bytes_per_us)
+    ) + 2.0 * (n_slices - 1) / max(1, n_slices) * (
+        state_bytes / max(1.0, cfg.dcn_bytes_per_us)
+    )
+    tree = "hierarchical" if hier_us < flat_us else "flat"
+    return tree, flat_us, hier_us
+
+
 def _g_tiles(num_groups: int) -> int:
     """128-wide vector-lane tiles the one-hot block spans."""
     return max(1, -(-num_groups // 128))

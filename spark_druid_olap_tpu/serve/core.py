@@ -309,11 +309,14 @@ class ServingCore:
 
     # -- fusion --------------------------------------------------------------
 
-    def fused_execute(self, q, ds) -> Optional[tuple]:
-        """Micro-batch fusion entry: (df, state, metrics) or None."""
+    def fused_execute(self, q, ds, engine=None) -> Optional[tuple]:
+        """Micro-batch fusion entry: (df, state, metrics) or None.
+        `engine` selects the executing backend (None = the context's
+        local engine; the mesh's DistributedEngine batches through its
+        unified SPMD arena) — backends never share a batch."""
         if not self.fusion.enabled:
             return None
-        return self.fusion.execute(self.ctx, q, ds)
+        return self.fusion.execute(self.ctx, q, ds, engine=engine)
 
     # -- lanes ---------------------------------------------------------------
 

@@ -128,13 +128,17 @@ class _Member:
 
 
 class _Batch:
-    __slots__ = ("batch_id", "signature", "members", "closed")
+    __slots__ = ("batch_id", "signature", "members", "closed", "engine")
 
-    def __init__(self, batch_id: int, signature):
+    def __init__(self, batch_id: int, signature, engine=None):
         self.batch_id = batch_id
         self.signature = signature
         self.members: List[_Member] = []
         self.closed = False
+        # executing backend (None = the context's local engine); the
+        # signature carries a backend label so a mesh-routed query and a
+        # single-device one never land in the same batch
+        self.engine = engine
 
 
 class FusionScheduler:
@@ -205,17 +209,24 @@ class FusionScheduler:
         with self._lock:
             self._arrivals.append(now)
 
-    def execute(self, ctx, q, ds):
+    def execute(self, ctx, q, ds, engine=None):
         """Join (or lead) the micro-batch for `q` over the `ds`
-        snapshot.  Returns (df, state, metrics) or None (serial path)."""
+        snapshot.  Returns (df, state, metrics) or None (serial path).
+        `engine` is the executing backend (None = ctx.engine); distinct
+        backends hash to distinct signatures, so a batch is always
+        dispatched by the engine every one of its members routed to."""
         if not self.enabled:
             return None
         from ..exec.lowering import schema_signature
 
+        if engine is None or engine is ctx.engine:
+            engine, backend = None, "device"
+        else:
+            backend = "mesh"
         now = time.monotonic()
         window_ms, mode, n_recent = self._decide_window_ms(now)
         self._note_arrival(now)
-        sig = (ds.name, schema_signature(ds))
+        sig = (ds.name, backend, schema_signature(ds))
         me = _Member(q, current_query_id())
         with self._lock:
             batch = self._open.get(sig)
@@ -224,7 +235,7 @@ class FusionScheduler:
                 or batch.closed
                 or len(batch.members) >= self.max_batch
             ):
-                batch = _Batch(next(self._ids), sig)
+                batch = _Batch(next(self._ids), sig, engine=engine)
                 self._open[sig] = batch
                 leader = True
             else:
@@ -312,7 +323,8 @@ class FusionScheduler:
                 return
             current = ctx.catalog.get(ds.name)
             if current is None or (
-                (ds.name, schema_signature(current)) != batch.signature
+                (ds.name, batch.signature[1], schema_signature(current))
+                != batch.signature
             ):
                 # an append/compaction published a new segment set
                 # between enqueue and dispatch: the batch's snapshot is
@@ -337,7 +349,7 @@ class FusionScheduler:
                     "fused_members",
                     query_ids=",".join(m.query_id for m in members),
                 )
-                results = ctx.engine.execute_fused(
+                results = (batch.engine or ctx.engine).execute_fused(
                     [m.query for m in members],
                     current,
                     query_ids=[m.query_id for m in members],

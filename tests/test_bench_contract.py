@@ -134,6 +134,10 @@ def test_production_tag_keys_scale(monkeypatch):
     mode, fn, arg = bench._parse_args(["arena", "1"])
     assert "%s_%g" % (mode, arg) == "arena_1"
     assert fn is bench.bench_arena
+    # unified-executor mesh counterfactual (ISSUE 15): SSB scale arg
+    mode, fn, arg = bench._parse_args(["mesh_unified", "10"])
+    assert "%s_%g" % (mode, arg) == "mesh_unified_10"
+    assert fn is bench.bench_mesh_unified
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -451,6 +455,94 @@ def test_emit_arena_result_shape(capsys, tmp_path, monkeypatch):
     assert detail["detail"]["queries"]["q1_1"]["on"]["dispatch_count"] == 1
     assert detail["detail"]["dispatches_loop"] == 96
     assert detail["detail"]["results_identical_on_vs_off"] is True
+
+
+def test_emit_mesh_unified_result_shape(capsys, tmp_path, monkeypatch):
+    """The unified-executor mesh mode (ISSUE 15): stdout stays one
+    compact line whose vs_baseline is the single-over-mesh-arena p50
+    ratio (>=1 is the SF10 acceptance bar); the detail sidecar carries
+    the three-arm per-query maps, the receipt-verified per-query
+    dispatch ceiling, and the multi-slice point with the cost-model's
+    merge-tree span event."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    per_q = {
+        "q%d_%d" % (i, j): {
+            "single_ms": 20.0,
+            "mesh_loop_ms": 21.5,
+            "mesh_loop_dispatch_count": 1,
+            "mesh_loop_device_ms": 17.0,
+            "mesh_loop_transfer_ms": 0.0,
+            "mesh_arena_ms": 18.4,
+            "mesh_arena_dispatch_count": 1,
+            "mesh_arena_device_ms": 15.2,
+            "mesh_arena_transfer_ms": 0.0,
+            "max_rel_err_vs_single": 0.0,
+            "mesh_over_single": 0.92,
+        }
+        for i in range(1, 5)
+        for j in range(1, 4)
+    }
+    bench._emit(
+        {
+            "metric": "mesh_unified_sf10_mesh8_p50_latency",
+            "value": 18.4,
+            "unit": "ms",
+            "vs_baseline": 1.09,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 60_000_000,
+                "n_devices": 8,
+                "p50_ms_single": 20.0,
+                "p50_ms_mesh_loop": 21.5,
+                "p50_ms_mesh_arena": 18.4,
+                "dispatches_mesh_loop": 12,
+                "dispatches_mesh_arena": 12,
+                "arena_dispatches_per_query_max": 1,
+                "arena_vs_loop_speedup": 1.17,
+                "max_rel_err_vs_single": 0.0,
+                "multi_slice": {
+                    "n_slices": 2,
+                    "n_devices_per_slice": 4,
+                    "p50_ms": 17.9,
+                    "slice_equivalents": 1.12,
+                    "merge_trees_chosen": ["hierarchical"],
+                    "merge_tree_event": {
+                        "name": "merge_tree",
+                        "at_ms": 1.2,
+                        "attrs": {
+                            "tree": "hierarchical",
+                            "flat_us": 44.8,
+                            "hier_us": 35.2,
+                            "shards": 8,
+                            "slices": 2,
+                        },
+                    },
+                },
+                "queries": per_q,
+            },
+        },
+        "mesh_unified_10",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "mesh_unified_sf10_mesh8_p50_latency"
+    assert parsed["value"] == 18.4
+    assert parsed["vs_baseline"] == 1.09
+    assert "queries" not in parsed
+    detail = json.load(
+        open(tmp_path / "BENCH_mesh_unified_10_detail.json")
+    )
+    d = detail["detail"]
+    assert d["arena_dispatches_per_query_max"] == 1
+    assert d["queries"]["q1_1"]["mesh_arena_dispatch_count"] == 1
+    assert d["multi_slice"]["merge_tree_event"]["attrs"]["tree"] == (
+        "hierarchical"
+    )
+    assert d["multi_slice"]["slice_equivalents"] > 1
+    assert d["p50_ms_mesh_arena"] <= d["p50_ms_single"]
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

@@ -114,6 +114,9 @@ def test_distributed_transient_retry(lineitem_ds):
     """A transient RuntimeError in the SPMD path evicts shards/programs and
     re-dispatches once (mirror of the local engine's retry)."""
     dist = DistributedEngine(mesh=make_mesh(n_data=8))
+    # pin the legacy per-shard path: this test poisons its builder
+    # (`_spmd_fn`); the arena path's retry is covered separately below
+    dist.arena_execution = False
     q = _q1()
     # make the SPMD program fail exactly once via the builder
     calls = {"n": 0}
@@ -510,11 +513,14 @@ def test_mesh_sparse_filtered_aggs_and_minmax():
 
 
 def test_mesh_shards_only_pruned_scope(dist8):
-    """r5->r6 mesh regression guard: a scoped query shards ONLY the
-    interval-pruned segments.  The regression sharded (and scanned) the
-    FULL segment set for every query — ~400 ms/query of device time
-    over rows the single-device engine pruned — so the shard cache must
-    key exactly the pruned scope's uid signature."""
+    """r5->r6 mesh regression guard, restated for the unified executor
+    core: the regression was re-placing and re-scanning the FULL segment
+    set per query.  The SPMD arena inverts the old fix — placement is
+    scope-INDEPENDENT (one durable stacked layout keyed on the full
+    segment signature, never a query's scope) and the pruned scope rides
+    as DATA (membership + window), so a second, disjoint-scope query
+    must place ZERO new shards and both scoped results must still match
+    the local engine exactly."""
     from spark_druid_olap_tpu.catalog.segment import build_datasource
     from spark_druid_olap_tpu.exec.engine import segments_in_scope
 
@@ -524,30 +530,246 @@ def test_mesh_shards_only_pruned_scope(dist8):
         "d": np.array(
             [f"k{i}" for i in rng.integers(0, 4, size=n)], dtype=object
         ),
-        "v": rng.random(n).astype(np.float32),
+        # integer-valued f32 keeps the psum merge bit-exact
+        "v": rng.integers(0, 1000, size=n).astype(np.float32),
         "t": (np.arange(n) * 1_000).astype(np.int64),
     }
     ds = build_datasource(
         "mesh_scope", cols, dimension_cols=["d"], metric_cols=["v"],
         time_col="t", rows_per_segment=2_048,
     )
-    q = GroupByQuery(
-        datasource="mesh_scope",
-        dimensions=(DimensionSpec("d"),),
-        aggregations=(Count("n"), DoubleSum("s", "v")),
-        intervals=((0, 4_096_000),),
-    )
-    scope = segments_in_scope(q, ds)
-    assert 0 < len(scope) < len(ds.segments)
-    want_sig = tuple(s.uid for s in scope)
+
+    def scoped(lo, hi):
+        return GroupByQuery(
+            datasource="mesh_scope",
+            dimensions=(DimensionSpec("d"),),
+            aggregations=(Count("n"), DoubleSum("s", "v")),
+            intervals=((lo, hi),),
+        )
+
+    q1 = scoped(0, 4_096_000)
+    q2 = scoped(8_192_000, 12_288_000)  # disjoint from q1's segments
+    s1 = {s.uid for s in segments_in_scope(q1, ds)}
+    s2 = {s.uid for s in segments_in_scope(q2, ds)}
+    assert 0 < len(s1) < len(ds.segments)
+    assert s1.isdisjoint(s2) and s2
+
     dist8.clear_cache()
-    got = dist8.execute(q, ds)
-    # every shard placed for this query keys the PRUNED scope signature
-    sigs = {k[-1] for k in dist8._shard_cache if k[0] == "mesh_scope"}
-    assert sigs == {want_sig}
-    # and the scoped mesh result still matches the local engine exactly
-    want = Engine().execute(q, ds)
-    got = got.sort_values(["d"]).reset_index(drop=True)
-    want = want.sort_values(["d"]).reset_index(drop=True)
-    np.testing.assert_array_equal(np.asarray(got["n"]), np.asarray(want["n"]))
-    np.testing.assert_allclose(got["s"], want["s"], rtol=1e-5)
+    got1 = dist8.execute(q1, ds)
+    keys1 = {k for k in dist8._shard_cache if k[0] == "mesh_scope"}
+    # the arena's keys carry the FULL segment signature, never a scope:
+    # one "spmd_arena"-tagged stack per column (+ validity)
+    assert keys1 and all(k[1] == "spmd_arena" for k in keys1)
+    all_uids = tuple(s.uid for s in ds.segments)
+    assert all(k[3] == all_uids for k in keys1)
+    got2 = dist8.execute(q2, ds)
+    keys2 = {k for k in dist8._shard_cache if k[0] == "mesh_scope"}
+    # disjoint scope, zero new placements: scope is data, not placement
+    assert keys2 == keys1
+    for q, got in ((q1, got1), (q2, got2)):
+        want = Engine().execute(q, ds)
+        got = got.sort_values(["d"]).reset_index(drop=True)
+        want = want.sort_values(["d"]).reset_index(drop=True)
+        np.testing.assert_array_equal(
+            np.asarray(got["n"]), np.asarray(want["n"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got["s"]), np.asarray(want["s"])
+        )
+
+
+# -- unified executor core (ISSUE 15) ---------------------------------------
+#
+# The mesh is a PLACEMENT STRATEGY over the segment-stacked arena: both
+# backends lower the one fold program, so every serving feature must
+# produce byte-identical answers on the virtual mesh.  Integer-valued
+# float32 metrics keep the psum boundary merge bit-exact (sums of
+# integers inside the f32 exact range), making assert_array_equal the
+# right oracle — not allclose.
+
+
+def _unified_ds(name="unified", n=32_768, rows_per_segment=2_048):
+    from spark_druid_olap_tpu.catalog.segment import build_datasource
+
+    rng = np.random.default_rng(0)
+    cols = {
+        "d": rng.integers(0, 7, n),
+        "e": rng.integers(0, 5, n),
+        "v": rng.integers(0, 100, n).astype(np.float32),
+        "t": (np.arange(n) * 100).astype(np.int64),
+    }
+    return build_datasource(
+        name, cols, dimension_cols=["d", "e"], metric_cols=["v"],
+        time_col="t", rows_per_segment=rows_per_segment,
+    )
+
+
+def _unified_queries(name="unified"):
+    q1 = GroupByQuery(
+        datasource=name, dimensions=(DimensionSpec("d"),),
+        aggregations=(
+            Count("n"), DoubleSum("s", "v"),
+            DoubleMin("lo", "v"), DoubleMax("hi", "v"),
+        ),
+    )
+    q2 = GroupByQuery(
+        datasource=name, dimensions=(DimensionSpec("e"),),
+        aggregations=(Count("n"), DoubleSum("s", "v")),
+    )
+    q3 = TopNQuery(
+        datasource=name, dimension=DimensionSpec("d"), metric="s",
+        threshold=3, aggregations=(DoubleSum("s", "v"),),
+    )
+    return q1, q2, q3
+
+
+def _frames_identical(got, want, key=None):
+    if key:
+        got = got.sort_values(key).reset_index(drop=True)
+        want = want.sort_values(key).reset_index(drop=True)
+    assert list(got.columns) == list(want.columns)
+    for c in got.columns:
+        np.testing.assert_array_equal(np.asarray(got[c]), np.asarray(want[c]))
+
+
+def test_distributed_transient_retry_arena():
+    """The arena path's mirror of the transient-retry contract: a
+    poisoned SPMD-arena program fails once, the shared retry policy
+    evicts this query's programs + shards, and the re-dispatch answers
+    exactly."""
+    ds = _unified_ds("retry_arena")
+    q1, _, _ = _unified_queries("retry_arena")
+    dist = DistributedEngine(mesh=make_mesh(n_data=8))
+    calls = {"n": 0}
+    orig = DistributedEngine._arena_spmd_fn
+
+    def flaky(self, lowering, dsrc, layout, Lk, strategy, tree):
+        fn = orig(self, lowering, dsrc, layout, Lk, strategy, tree)
+        if calls["n"] == 0:
+            def poisoned(cols, j_lo, memb):
+                calls["n"] += 1
+                raise RuntimeError("injected transient SPMD failure")
+
+            return poisoned
+        return fn
+
+    dist._arena_spmd_fn = flaky.__get__(dist)
+    got = dist.execute(q1, ds)
+    assert calls["n"] == 1  # poisoned program ran exactly once
+    _frames_identical(got, Engine().execute(q1, ds), key=["d"])
+
+
+@pytest.fixture(scope="module")
+def unified_ds():
+    return _unified_ds()
+
+
+@pytest.fixture(
+    scope="module", params=["flat8", "slice2x4"],
+    ids=["mesh8", "slice2x4"],
+)
+def unified_dist(request):
+    from spark_druid_olap_tpu.parallel.mesh import make_slice_mesh
+
+    if request.param == "flat8":
+        return DistributedEngine(mesh=make_mesh(n_data=8))
+    return DistributedEngine(mesh=make_slice_mesh(2, 4))
+
+
+def test_unified_matrix_exact_and_fused(unified_dist, unified_ds):
+    """Feature-parity matrix rows 1-2: plain execution and micro-batch
+    fusion are byte-identical to the single-device engine, on both the
+    flat mesh and the 2-slice topology (whose merge tree the cost model
+    picks)."""
+    eng = Engine()
+    q1, q2, q3 = _unified_queries()
+    _frames_identical(
+        unified_dist.execute(q1, unified_ds), eng.execute(q1, unified_ds),
+        key=["d"],
+    )
+    assert all(unified_dist.fusable(q, unified_ds) for q in (q1, q2, q3))
+    got = unified_dist.execute_fused(
+        [q1, q2, q3], unified_ds, query_ids=["a", "b", "c"]
+    )
+    want = eng.execute_fused(
+        [q1, q2, q3], unified_ds, query_ids=["a", "b", "c"]
+    )
+    for (gdf, gst, gm), (wdf, wst, wm) in zip(got, want):
+        assert gm.distributed and gm.fused_batch == 3
+        for k in ("sums", "mins", "maxs"):
+            np.testing.assert_array_equal(gst[k], wst[k])
+        _frames_identical(
+            gdf.reset_index(drop=True), wdf.reset_index(drop=True)
+        )
+
+
+def test_unified_matrix_result_cache_states(unified_dist, unified_ds):
+    """Matrix row 3: the result cache's currency — captured state, delta
+    partials, ⊕-merge, finalize — is byte-identical across backends, and
+    delta scans of one query share ONE compiled program (scope is data,
+    not a program key)."""
+    eng = Engine()
+    q1, _, _ = _unified_queries()
+    with unified_dist.state_capture() as cap_d:
+        unified_dist.execute(q1, unified_ds)
+    with eng.state_capture() as cap_e:
+        eng.execute(q1, unified_ds)
+    assert cap_d["state"] is not None
+    for k in ("sums", "mins", "maxs"):
+        np.testing.assert_array_equal(cap_d["state"][k], cap_e["state"][k])
+
+    uids = [s.uid for s in unified_ds.segments]
+    sa, ra = unified_dist.groupby_partials_host(
+        q1, unified_ds, within_uids=uids[:5]
+    )
+    wa, wr = eng.groupby_partials_host(q1, unified_ds, within_uids=uids[:5])
+    assert ra == wr
+    for k in ("sums", "mins", "maxs"):
+        np.testing.assert_array_equal(sa[k], wa[k])
+    sb, _ = unified_dist.groupby_partials_host(
+        q1, unified_ds, within_uids=uids[5:]
+    )
+    merged = unified_dist.merge_groupby_states(q1, unified_ds, sa, sb)
+    full = unified_dist.finalize_groupby_state(q1, unified_ds, merged)
+    _frames_identical(full, eng.execute(q1, unified_ds), key=["d"])
+    # delta reuse: an equal-width window of the SAME query compiles no
+    # new program — membership/window ride as data
+    before = len(unified_dist._spmd_cache)
+    unified_dist.groupby_partials_host(q1, unified_ds, within_uids=uids[2:7])
+    assert len(unified_dist._spmd_cache) == before
+
+
+def test_unified_matrix_deadline_partials(unified_dist, unified_ds):
+    """Matrix row 4: deadline partials.  A roomy deadline answers in
+    full (coverage 1.0) byte-identically; an expired-at-entry deadline
+    degrades to the SAME best-effort empty answer the local engine
+    gives (partial, coverage 0.0, zero rows) instead of raising."""
+    from spark_druid_olap_tpu.resilience import deadline_scope, partial_scope
+
+    eng = Engine()
+    q1, _, _ = _unified_queries()
+    with partial_scope(True) as pc, deadline_scope(60_000):
+        got = unified_dist.execute(q1, unified_ds)
+    assert not pc.is_partial and pc.coverage() == 1.0
+    _frames_identical(got, eng.execute(q1, unified_ds), key=["d"])
+
+    with partial_scope(True) as pc_d, deadline_scope(0.001):
+        got_d = unified_dist.execute(q1, unified_ds)
+    with partial_scope(True) as pc_e, deadline_scope(0.001):
+        got_e = eng.execute(q1, unified_ds)
+    assert pc_d.is_partial and pc_e.is_partial
+    assert pc_d.coverage() == pc_e.coverage() == 0.0
+    assert len(got_d) == len(got_e) == 0
+
+
+def test_unified_matrix_prefetch_residency(unified_ds):
+    """Matrix row 5: the PR 10 prefetch plan feeds the arena placement —
+    a prefetched query pays ZERO foreground h2d bytes, and residency is
+    durable across queries (no re-placement on re-execution)."""
+    q1, _, _ = _unified_queries()
+    dist = DistributedEngine(mesh=make_mesh(n_data=8))
+    assert dist.prefetch(q1, unified_ds)
+    dist.execute(q1, unified_ds)
+    assert dist.last_metrics.h2d_bytes == 0
+    dist.execute(q1, unified_ds)
+    assert dist.last_metrics.h2d_bytes == 0

@@ -2168,6 +2168,81 @@ _MATRIX = {
             """},
         ],
     },
+    "mesh-discipline": {
+        "violating": [
+            # GL2201: a string-literal collective axis bypasses the
+            # single-declaration *_AXIS contract — it keeps "working"
+            # after an axis-layout change while merging the wrong scope
+            (
+                {"spark_druid_olap_tpu/exec/custom_merge.py": """
+                    from jax import lax
+
+                    def merge(state):
+                        return lax.psum(state, "data")
+                """},
+                {"GL2201"},
+            ),
+            # GL2202: sharded placement in parallel/ outside a
+            # sanctioned owner bypasses residency keys, the h2d fault
+            # site, link accounting, and the multi-process shim
+            (
+                {"spark_druid_olap_tpu/parallel/warm.py": """
+                    import jax
+
+                    def warm_column(host, sharding):
+                        return jax.device_put(host, sharding)
+                """},
+                {"GL2202"},
+            ),
+            # GL2203: a dispatch span in a host loop on the SPMD path
+            # is the per-shard round trip the sharded arena collapsed
+            (
+                {"spark_druid_olap_tpu/parallel/looper.py": """
+                    from ..obs import SPAN_COLLECTIVE_MERGE, span
+
+                    def merge_each(self, fn, shards):
+                        for s in shards:
+                            with span(SPAN_COLLECTIVE_MERGE):
+                                fn(s)
+                """},
+                {"GL2203"},
+            ),
+        ],
+        "clean": [
+            # declared-constant axes, and placement inside the owners
+            {"spark_druid_olap_tpu/parallel/mesh.py": """
+                DATA_AXIS = "data"
+            """,
+             "spark_druid_olap_tpu/parallel/distributed.py": """
+                import jax
+                from jax import lax
+
+                from .mesh import DATA_AXIS
+
+                def _place_shards(self, host, sharding):
+                    return jax.device_put(host, sharding)
+
+                def merged(state):
+                    return lax.psum(state, DATA_AXIS)
+            """},
+            # the chunked anytime loop is the sanctioned dispatch-loop
+            # owner (one iteration per deadline checkpoint, not per
+            # shard); bare default-device puts are out of scope here
+            {"spark_druid_olap_tpu/parallel/spmd_arena.py": """
+                import jax
+
+                from ..obs import SPAN_SEGMENT_DISPATCH, span
+
+                def _arena_spmd_deadline(self, chunk, steps):
+                    for j in steps:
+                        with span(SPAN_SEGMENT_DISPATCH, chunk=j):
+                            chunk(j)
+
+                def stage(host):
+                    return jax.device_put(host)
+            """},
+        ],
+    },
 }
 
 

@@ -12,8 +12,9 @@ collective-axis, GL9xx checkpoint-coverage, GL10xx wire-parity, GL11xx
 span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
 lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
 serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
-GL20xx storage-discipline, GL21xx dispatch-discipline; GL00x are the
-core's own: GL001 unparseable file, GL002 malformed pragma).
+GL20xx storage-discipline, GL21xx dispatch-discipline, GL22xx
+mesh-discipline; GL00x are the core's own: GL001 unparseable file,
+GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -32,6 +33,7 @@ from .jit_cache import JitCachePass
 from .jit_collision import JitCollisionPass
 from .lock_discipline import LockDisciplinePass
 from .lock_order import LockOrderPass
+from .mesh_discipline import MeshDisciplinePass
 from .obs_discipline import ObsDisciplinePass
 from .pallas_shape import PallasShapePass
 from .partial_discipline import PartialDisciplinePass
@@ -65,6 +67,7 @@ ALL_PASSES = (
     TransferDisciplinePass,
     StorageDisciplinePass,
     DispatchDisciplinePass,
+    MeshDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}
