@@ -133,6 +133,11 @@ class TPUOlapContext:
             min_delta_rows=self.config.compaction_min_delta_rows,
             interval_s=self.config.compaction_interval_s,
         )
+        # cluster tier (cluster/, ISSUE 16): set by ClusterClient.attach
+        # when this context runs as a BROKER — the serving paths scatter
+        # covered queries to historicals instead of executing locally.
+        # None (default) keeps every query in-process.
+        self.cluster = None
         # durable storage tier (storage.py, ISSUE 13): append WAL +
         # crash-safe persistent segment snapshots.  Opt-in via
         # config.storage_dir; recovery runs NOW, before the context is
@@ -1168,6 +1173,24 @@ class TPUOlapContext:
             hit = self._cached_result(rw, rkey)
             if hit is not None:
                 return hit
+
+        # broker mode (cluster/, ISSUE 16): a covered SQL query scatters
+        # to the historicals and gathers through the merge tree; the
+        # result cache above rides the broker (exact hits never leave
+        # this process), fusion below stays local-only.  Partial answers
+        # never enter the cache (the pc.triggered guard at the bottom).
+        if self.cluster is not None and self.cluster.covers(rw.query, ds):
+            if not rw.grouping_sets and rw.exact_distinct is None:
+                df = self.cluster.execute(rw.query, ds)
+                self._last_engine_metrics = self.cluster.last_metrics
+                df = self._post_process(rw, ds, df)
+                if rkey is not None:
+                    from .resilience import current_partial
+
+                    pc = current_partial()
+                    if pc is None or not pc.triggered:
+                        self.serve.store_result(rw, ds, rkey, df)
+                return df
 
         engine = self._engine_for(rw)
         state = None

@@ -514,3 +514,30 @@ def gc_snapshot_files(directory: str) -> List[str]:
         except OSError:  # fault-ok: GC is reclamation, never correctness
             pass
     return removed
+
+
+# ---------------------------------------------------------------------------
+# Cluster assignment manifest (cluster/assignment.py, ISSUE 16) — the
+# broker's segment -> historical replica map, committed next to the
+# snapshots it indexes so a broker restart resumes the SAME epoch (and
+# rebalance history) instead of reshuffling every segment.
+# ---------------------------------------------------------------------------
+
+ASSIGNMENT_MANIFEST_NAME = "cluster_assignment.json"
+
+
+def save_assignment_manifest(directory: str, doc: dict) -> str:
+    """Atomically commit the assignment manifest (same torn-write
+    contract as the snapshot commit point)."""
+    os.makedirs(directory, exist_ok=True)
+    return atomic_write_json(
+        os.path.join(directory, ASSIGNMENT_MANIFEST_NAME), doc
+    )
+
+
+def load_assignment_manifest(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, ASSIGNMENT_MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

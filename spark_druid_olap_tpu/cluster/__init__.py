@@ -1,0 +1,46 @@
+"""Cluster tier: broker + N historicals over a shared snapshot store
+(ISSUE 16).
+
+Topology: one BROKER (a normal `TPUOlapContext` with a `ClusterClient`
+attached — it owns the write path and answers anything not covered by
+the scatter surface locally) and N HISTORICALS (read-only
+`HistoricalNode` processes mmap-booting the same `storage_dir`, each
+serving partial-state RPCs for its assigned replica subset).
+
+  * `assignment` — rendezvous-hashed segment -> replica-chain maps,
+    epoch-bumped on membership change, manifest-persisted.
+  * `wire` — the dense groupby partial-state codec (base64 + dtype +
+    shape, strictly validated on decode).
+  * `historical` — the serving replica (in-process for tests,
+    `python -m spark_druid_olap_tpu.cluster.historical` for real
+    processes).
+  * `broker` — scatter/retry/hedge/breaker + merge-tree gather with
+    coverage accounting.
+"""
+
+from .assignment import (
+    Assignment,
+    build_assignment,
+    load_assignment,
+    rebalance,
+    replicas_for,
+    save_assignment,
+)
+from .broker import ClusterClient, ReplicaSetLost
+from .historical import HistoricalNode
+from .wire import WireDecodeError, decode_state, encode_state
+
+__all__ = [
+    "Assignment",
+    "ClusterClient",
+    "HistoricalNode",
+    "ReplicaSetLost",
+    "WireDecodeError",
+    "build_assignment",
+    "decode_state",
+    "encode_state",
+    "load_assignment",
+    "rebalance",
+    "replicas_for",
+    "save_assignment",
+]

@@ -1,0 +1,620 @@
+"""Broker: scatter covered queries to historicals, gather through the
+merge tree (cluster/, ISSUE 16 tentpole).
+
+`ClusterClient` rides a normal `TPUOlapContext` (attach() sets
+`ctx.cluster`, and the api/server query paths divert covered queries
+here).  The execution contract:
+
+* **Assignment** — rendezvous-hashed segment -> replica-chain map
+  (assignment.py) with a replication factor, epoch-bumped and
+  manifest-persisted on every membership change.  Broker-local delta
+  segments (appended after the map was built) are RESIDUAL: executed
+  in-process and ⊕'d into the gather, so fresh appends never wait for
+  a rebalance.
+* **Scatter** — one RPC per replica group over the existing wire
+  surface (`POST /druid/v2/cluster/partial`), on a thread pool, with a
+  per-replica timeout, failover across the chain, optional hedging
+  past `cluster_hedge_ms`, and a per-historical `CircuitBreaker`
+  (generalizing `ResilienceState.breakers`) — an open node is skipped,
+  not waited on.
+* **Gather** — replica states ⊕ through the SAME
+  `merge_groupby_states` algebra the mesh slices use, guarded by the
+  assignment-epoch version check (GL2301): a state computed against a
+  different catalog version (or a reshaped dictionary domain) is a
+  replica failure, never a wrong merge.
+* **Degradation ladder** — a failed replica fails over to the next in
+  its chain; a LOST replica group (every replica down) triggers the
+  partial collector so the answer ships coverage-stamped through the
+  existing partial machinery instead of erroring; metadata/health
+  queries never route here at all, so they serve through any breaker
+  state.
+
+Tracing: ONE scatter span in the query thread carries a per-reply
+`rpc` event (node, ms, outcome, segments) — RPCs run on pool threads,
+which by design cannot open spans on the query's contextvar-confined
+trace — and gather/cluster_merge spans wrap the fold; obs/prof.py
+folds these into scatter/gather/merge receipt buckets plus the
+per-historical `cluster.nodes` section.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Dict, List, Optional, Tuple
+
+from ..catalog.segment import DeltaSegment
+from ..exec.metrics import QueryMetrics
+from ..models import query as Q
+from ..obs import (
+    SPAN_CLUSTER_MERGE,
+    SPAN_GATHER,
+    SPAN_SCATTER,
+    current_query_id,
+    record_cluster_health,
+    record_cluster_rpc,
+    record_query_metrics,
+    span,
+    span_event,
+)
+from ..resilience import (
+    CircuitBreaker,
+    checkpoint,
+    classify_error,
+    current_partial,
+    injector,
+)
+from ..utils.log import get_logger
+from .assignment import (
+    Assignment,
+    build_assignment,
+    load_assignment,
+    save_assignment,
+)
+from .wire import WireDecodeError, decode_state
+
+log = get_logger("cluster.broker")
+
+__all__ = ["ClusterClient", "ReplicaSetLost"]
+
+
+class ReplicaSetLost(RuntimeError):
+    """Every replica of one scatter group failed — the group's segments
+    are lost from this answer (coverage-stamped, never a 500)."""
+
+
+class ClusterClient:
+    """The broker half: membership, assignment, scatter/gather."""
+
+    def __init__(self, ctx, nodes: Optional[Dict[str, str]] = None,
+                 replication: Optional[int] = None):
+        cfg = ctx.config
+        self.ctx = ctx
+        self.replication = int(replication or cfg.cluster_replication)
+        self.rpc_timeout_s = float(cfg.cluster_rpc_timeout_ms) / 1e3
+        self.retries = max(0, int(cfg.cluster_rpc_retries))
+        self.hedge_s = float(cfg.cluster_hedge_ms) / 1e3
+        self._lock = threading.Lock()
+        # node_id -> base url ("http://host:port")
+        self._nodes: Dict[str, str] = dict(nodes or {})
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._last_ok: Dict[str, float] = {}
+        self.assignment: Optional[Assignment] = None
+        self.last_metrics: Optional[QueryMetrics] = None
+        # resume the epoch sequence from a persisted manifest so a
+        # broker restart continues, never rewinds, the epoch clock
+        self._epoch_floor = 0
+        if getattr(ctx, "storage", None) is not None:
+            prev = load_assignment(ctx.storage.root)
+            if prev is not None:
+                self._epoch_floor = prev.epoch
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="sdol-scatter"
+        )
+        if self._nodes:
+            self.rebalance()
+
+    # -- membership / assignment --------------------------------------------
+
+    def attach(self) -> "ClusterClient":
+        self.ctx.cluster = self
+        return self
+
+    def detach(self) -> None:
+        if self.ctx.cluster is self:
+            self.ctx.cluster = None
+
+    def close(self) -> None:
+        self.detach()
+        self._pool.shutdown(wait=False)
+
+    def nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes)
+
+    def add_node(self, node_id: str, url: str) -> Assignment:
+        with self._lock:
+            self._nodes[node_id] = url.rstrip("/")
+        return self.rebalance()
+
+    def remove_node(self, node_id: str) -> Assignment:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+        return self.rebalance()
+
+    def set_node_url(self, node_id: str, url: str) -> None:
+        """Same member, new address (a restarted node on an ephemeral
+        port): no epoch bump — the assignment keys on node ids, so the
+        map is unchanged."""
+        with self._lock:
+            if node_id not in self._nodes:
+                raise KeyError(f"unknown node {node_id!r}")
+            self._nodes[node_id] = url.rstrip("/")
+
+    def _assignable(self) -> Tuple[Dict[str, List[str]], Dict[str, int]]:
+        """{datasource: [segment_id...]} of PERSISTED segments (the ones
+        every historical's snapshot boot can serve) + the catalog
+        versions the map is computed at.  Delta segments stay residual:
+        only this process has them until a flush."""
+        seg_ids: Dict[str, List[str]] = {}
+        versions: Dict[str, int] = {}
+        storage = getattr(self.ctx, "storage", None)
+        for name in sorted(self.ctx.catalog.tables()):
+            ds = self.ctx.catalog.get(name)
+            if ds is None:
+                continue
+            # pin the SNAPSHOT version (stable across processes booting
+            # the same store generation), not the process-local live
+            # version — see DurableStorage.snapshot_version
+            snap = (
+                storage.snapshot_version(name)
+                if storage is not None else None
+            )
+            versions[name] = int(ds.version) if snap is None else snap
+            seg_ids[name] = [
+                s.segment_id for s in ds.segments
+                if not isinstance(s, DeltaSegment)
+            ]
+        return seg_ids, versions
+
+    def rebalance(self) -> Assignment:
+        """Recompute the map over the CURRENT membership and catalog at
+        the next epoch; deterministic (rendezvous), minimal-movement,
+        manifest-persisted.  Called on every membership change and on
+        node rejoin after a restart."""
+        with self._lock:
+            seg_ids, versions = self._assignable()
+            epoch = max(
+                self._epoch_floor,
+                self.assignment.epoch if self.assignment else 0,
+            ) + 1
+            asg = build_assignment(
+                seg_ids, self._nodes, self.replication,
+                epoch=epoch, versions=versions,
+            )
+            self.assignment = asg
+            for nid in self._nodes:
+                if nid not in self._breakers:
+                    cfg = self.ctx.config
+                    self._breakers[nid] = CircuitBreaker(
+                        failure_threshold=cfg.cluster_breaker_failures,
+                        cooldown_ms=cfg.cluster_breaker_cooldown_ms,
+                        backend=f"historical:{nid}",
+                    )
+            for nid in list(self._breakers):
+                if nid not in self._nodes:
+                    del self._breakers[nid]
+            if getattr(self.ctx, "storage", None) is not None:
+                save_assignment(self.ctx.storage.root, asg)
+        log.info(
+            "assignment epoch %d: %d nodes, %d segments, replication %d",
+            asg.epoch, len(asg.nodes), len(asg.segment_map),
+            asg.replication,
+        )
+        self._publish_health()
+        return asg
+
+    def _breaker(self, node_id: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(node_id)
+            if br is None:
+                cfg = self.ctx.config
+                br = self._breakers[node_id] = CircuitBreaker(
+                    failure_threshold=cfg.cluster_breaker_failures,
+                    cooldown_ms=cfg.cluster_breaker_cooldown_ms,
+                    backend=f"historical:{node_id}",
+                )
+            return br
+
+    # -- health ---------------------------------------------------------------
+
+    def _live_nodes(self) -> List[str]:
+        with self._lock:
+            ids = list(self._nodes)
+        return [n for n in ids if self._breaker(n).state != "open"]
+
+    def state(self) -> dict:
+        """The /status/health cluster section: per-historical liveness
+        (breaker state + last successful contact), the assignment
+        epoch, and the replication deficit.  Reads breaker state only
+        through the public accessors — never the guarded internals
+        (GL2303)."""
+        asg = self.assignment
+        live = self._live_nodes()
+        under, lost = asg.deficit(live) if asg else (0, 0)
+        with self._lock:
+            nodes = {
+                nid: {
+                    "url": url,
+                    "live": nid in live,
+                    "breaker": self._breakers[nid].to_dict()
+                    if nid in self._breakers else None,
+                    "last_ok_ms_ago": (
+                        round((time.monotonic() - self._last_ok[nid]) * 1e3)
+                        if nid in self._last_ok else None
+                    ),
+                    "assigned_segments": (
+                        len(asg.segments_for(nid)) if asg else 0
+                    ),
+                }
+                for nid, url in sorted(self._nodes.items())
+            }
+        doc = {
+            "nodes": nodes,
+            "live": len(live),
+            "epoch": asg.epoch if asg else 0,
+            "replication": self.replication,
+            "replication_deficit": under,
+            "segments_lost": lost,
+        }
+        self._publish_health(live=len(live), under=under, lost=lost)
+        return doc
+
+    def _publish_health(self, live=None, under=None, lost=None) -> None:
+        asg = self.assignment
+        if live is None or under is None or lost is None:
+            lv = self._live_nodes()
+            live = len(lv)
+            under, lost = asg.deficit(lv) if asg else (0, 0)
+        record_cluster_health(
+            live=live, total=len(self.nodes()),
+            epoch=asg.epoch if asg else 0, deficit=under, lost=lost,
+        )
+
+    # -- coverage -------------------------------------------------------------
+
+    def covers(self, q, ds) -> bool:
+        """Does the broker serve this query?  GroupBy-family with
+        mergeable dense state (the engine's own fusable gate), no wire
+        subtotals, and at least one historical to scatter to.  Anything
+        else — metadata queries, sparse/adaptive-tier shapes, grouping
+        sets — executes locally exactly as before."""
+        if not self._nodes or self.assignment is None:
+            return False
+        if not isinstance(
+            q, (Q.GroupByQuery, Q.TimeseriesQuery, Q.TopNQuery)
+        ):
+            return False
+        if isinstance(q, Q.GroupByQuery) and q.subtotals:
+            return False
+        try:
+            return bool(self.ctx.engine.fusable(q, ds))
+        except Exception:  # fault-ok: an ungateable query stays local
+            return False
+
+    # -- scatter --------------------------------------------------------------
+
+    def _rpc(self, url: str, payload: bytes) -> dict:
+        req = urllib.request.Request(
+            url + "/druid/v2/cluster/partial",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(
+            req, timeout=self.rpc_timeout_s
+        ) as resp:
+            raw = resp.read()
+        # torn-response chaos site: partial mode truncates the body the
+        # broker sees, exactly a connection dying mid-transfer — the
+        # strict decode below must fail over, never merge garbage
+        frac = injector().partial_fraction("cluster.torn_response")
+        if frac is not None:
+            raw = raw[: int(len(raw) * frac)]
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            raise WireDecodeError(f"torn response body: {e}") from e
+
+    def _attempt(self, node: str, payload: bytes, expect_version: int,
+                 attempts: list) -> dict:
+        """One replica attempt: breaker-gated RPC + strict decode +
+        version guard.  Appends (node, ms, outcome) to `attempts` and
+        raises on any failure."""
+        br = self._breaker(node)
+        if not br.allow():
+            attempts.append((node, 0.0, "breaker_open"))
+            record_cluster_rpc(node, "breaker_open")
+            raise ReplicaSetLost(f"breaker open for {node}")
+        url = self.nodes().get(node)
+        if url is None:
+            attempts.append((node, 0.0, "removed"))
+            raise ReplicaSetLost(f"node {node} left the membership")
+        t0 = time.perf_counter()
+        try:
+            # per-RPC chaos site: error mode IS a timed-out/refused
+            # connection; delay mode is a slow network path
+            checkpoint("cluster.rpc")
+            doc = self._rpc(url, payload)
+            ver = int(doc.get("version", -1))
+            if expect_version and ver != expect_version:
+                raise WireDecodeError(
+                    f"version skew: replica at {ver}, assignment epoch "
+                    f"expects {expect_version}"
+                )
+            state = decode_state(doc.get("state"))
+        except Exception as e:
+            ms = (time.perf_counter() - t0) * 1e3
+            br.record_failure()
+            outcome = type(e).__name__
+            attempts.append((node, ms, outcome))
+            record_cluster_rpc(
+                node, classify_error(e), ms,
+                query_id=current_query_id() or "", failover=True,
+            )
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        br.record_success()
+        with self._lock:
+            self._last_ok[node] = time.monotonic()
+        record_cluster_rpc(
+            node, "ok", ms, query_id=current_query_id() or ""
+        )
+        return {
+            "node": node, "ms": ms, "version": ver, "state": state,
+            "rows": int(doc.get("rows", 0)),
+            "segments": list(doc.get("segments") or ()),
+            "receipt": doc.get("receipt"),
+        }
+
+    def _fetch_group(self, chain: Tuple[str, ...], payload: bytes,
+                     expect_version: int) -> dict:
+        """Fetch one replica group's partial state: walk the chain with
+        failover (plus `cluster_rpc_retries` re-walks), hedging the
+        primary past `cluster_hedge_ms`.  Runs on a pool thread — no
+        spans here (the trace is contextvar-confined to the query
+        thread); the caller turns the returned attempt log into span
+        events."""
+        attempts: list = []
+        if self.hedge_s > 0 and len(chain) > 1:
+            r = self._fetch_hedged(chain, payload, expect_version,
+                                   attempts)
+            if r is not None:
+                r["attempts"] = attempts
+                return r
+            walk = list(chain[2:]) + list(chain) * self.retries
+        else:
+            walk = list(chain) * (1 + self.retries)
+        last: Optional[Exception] = None
+        for node in walk:
+            # scatter checkpoint (GL2302): the injection point the
+            # chaos matrix arms, and the deadline check when the query
+            # thread runs this inline
+            checkpoint("cluster.scatter")
+            try:
+                r = self._attempt(node, payload, expect_version, attempts)
+                r["attempts"] = attempts
+                return r
+            except Exception as e:
+                last = e
+        raise ReplicaSetLost(
+            f"every replica of chain {chain} failed: "
+            f"{[a[2] for a in attempts]}"
+        ) from last
+
+    def _fetch_hedged(self, chain, payload, expect_version, attempts):
+        """First-of-two hedge: issue to the primary, wait
+        `cluster_hedge_ms`, then issue to the secondary and take
+        whichever succeeds first.  Returns None when both hedged
+        attempts fail (the caller falls back to the sequential walk)."""
+        import queue as queue_mod
+
+        results: "queue_mod.Queue" = queue_mod.Queue()
+
+        def run(node):
+            try:
+                results.put(
+                    ("ok", self._attempt(node, payload, expect_version,
+                                         attempts))
+                )
+            except Exception as e:  # fault-ok: collected, not raised
+                results.put(("err", e))
+
+        threading.Thread(
+            target=run, args=(chain[0],), daemon=True
+        ).start()
+        launched = 1
+        try:
+            kind, val = results.get(timeout=self.hedge_s)
+        except queue_mod.Empty:
+            record_cluster_rpc(chain[0], "hedged", hedged=True)
+            threading.Thread(
+                target=run, args=(chain[1],), daemon=True
+            ).start()
+            launched = 2
+            kind, val = results.get(timeout=self.rpc_timeout_s * 2 + 1)
+        got = 1
+        while kind != "ok" and got < launched:
+            kind, val = results.get(timeout=self.rpc_timeout_s * 2 + 1)
+            got += 1
+        return val if kind == "ok" else None
+
+    # -- execute (scatter -> gather -> finalize) ------------------------------
+
+    def execute(self, q, ds):
+        """Answer one covered query through the cluster.  Assigned
+        segments scatter to their replica chains; residual segments
+        (deltas / anything the assignment epoch predates) execute
+        in-process; everything ⊕'s through the merge tree and
+        finalizes exactly like a local dense execution."""
+        from ..exec.engine import segments_in_scope
+
+        t0 = time.perf_counter()
+        engine = self.ctx.engine
+        asg = self.assignment
+        segs = segments_in_scope(q, ds)
+        groups: Dict[Tuple[str, ...], list] = {}
+        residual: list = []
+        for s in segs:
+            chain = asg.replicas(s.segment_id) if asg is not None else ()
+            if chain:
+                groups.setdefault(chain, []).append(s)
+            else:
+                residual.append(s)
+        expect_version = int(asg.versions.get(ds.name, 0)) if asg else 0
+
+        # residual FIRST: the engine's partial accounting begins the
+        # pass (begin_pass resets the collector), so the broker's own
+        # scope additions must come after
+        res_uids = frozenset(s.uid for s in residual)
+        state, rows_local = engine.groupby_partials_host(
+            q, ds, within_uids=res_uids
+        )
+        pc = current_partial()
+        if pc is not None and groups:
+            a_segs = sum(len(g) for g in groups.values())
+            a_rows = sum(
+                s.num_rows for g in groups.values() for s in g
+            )
+            a_delta = sum(
+                s.num_rows for g in groups.values() for s in g
+                if isinstance(s, DeltaSegment)
+            )
+            pc.add_scope(a_segs, a_rows, a_delta)
+
+        qdoc = q.to_druid()
+        qid = current_query_id() or ""
+
+        def _payload(g):
+            # per-group scope: the historical computes its partial over
+            # EXACTLY these segment ids, so two replica groups never
+            # overlap and the ⊕ never double-counts
+            return json.dumps(
+                {
+                    "query": qdoc,
+                    "segments": [s.segment_id for s in g],
+                    "version": expect_version or None,
+                    "context": {"queryId": qid},
+                }
+            ).encode()
+
+        results: list = []
+        lost: list = []
+        with span(
+            SPAN_SCATTER, groups=len(groups), nodes=len(self.nodes())
+        ):
+            futs = {
+                self._pool.submit(
+                    self._fetch_group, chain, _payload(g), expect_version
+                ): (chain, g)
+                for chain, g in sorted(groups.items())
+            }
+            for fut in as_completed(futs):
+                chain, g = futs[fut]
+                try:
+                    r = fut.result()
+                except Exception as e:
+                    lost.append((chain, g, e))
+                    span_event(
+                        "rpc", node="|".join(chain), ms=0.0,
+                        outcome="lost", segments=len(g),
+                    )
+                    continue
+                for node, ms, outcome in r["attempts"]:
+                    span_event(
+                        "rpc", node=node, ms=round(ms, 3),
+                        outcome=outcome, segments=0,
+                    )
+                span_event(
+                    "rpc", node=r["node"], ms=round(r["ms"], 3),
+                    outcome="ok", segments=len(r["segments"]),
+                )
+                results.append((chain, r, g))
+
+        node_receipts: Dict[str, Optional[dict]] = {}
+        gathered_rows = 0
+        with span(SPAN_GATHER, groups=len(results), lost=len(lost)):
+            # fold in assignment (chain) order, never arrival or
+            # serving-node order: a failover then changes WHO computed a
+            # group's state but not where it lands in the float fold, so
+            # answers stay byte-identical through replica changes
+            for chain, r, g in sorted(results, key=lambda t: t[0]):
+                checkpoint("cluster.gather")
+                # GL2301 merge guard: the fetch already pinned the
+                # replica's catalog version to the assignment epoch's;
+                # re-assert before the fold so a future refactor cannot
+                # silently drop the check, and let the ⊕'s own shape
+                # guard catch a reshaped dictionary domain
+                if expect_version and int(r["version"]) != expect_version:
+                    lost.append(
+                        (chain, g,
+                         ReplicaSetLost("version skew at gather"))
+                    )
+                    continue
+                try:
+                    with span(SPAN_CLUSTER_MERGE):
+                        state = engine.merge_groupby_states(
+                            q, ds, state, r["state"]
+                        )
+                except ValueError as e:
+                    # dictionary-domain drift: the replica's state does
+                    # not ⊕ with ours — a lost group, never a bad merge
+                    lost.append((("merge",), g, e))
+                    continue
+                gathered_rows += int(r["rows"])
+                node_receipts[r["node"]] = r.get("receipt")
+                if pc is not None:
+                    rows, drows = _group_rows(g)
+                    pc.add_seen(len(g), rows, drows)
+
+        if lost:
+            for chain, g, e in lost:
+                log.warning(
+                    "replica group %s lost (%d segments): %s",
+                    chain, len(g), e,
+                )
+            if pc is not None:
+                # a lost replica SET degrades to a stamped partial
+                # through the existing machinery — the trigger marks
+                # the answer best-effort; coverage already reflects the
+                # unseen rows
+                pc.trigger("cluster.scatter")
+
+        df = engine.finalize_groupby_state(q, ds, state)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        m = QueryMetrics(
+            query_type=type(q).__name__,
+            strategy="cluster",
+            datasource=ds.name,
+            query_id=current_query_id() or "",
+            executor="cluster",
+            distributed=True,
+            rows_scanned=rows_local + gathered_rows,
+            segments=len(segs),
+            total_ms=total_ms,
+        )
+        if pc is not None and pc.is_partial:
+            m.partial = True
+            m.coverage = pc.coverage()
+        self.last_metrics = m
+        record_query_metrics(m, outcome="partial" if m.partial else "ok")
+        return df
+
+
+def _group_rows(g) -> Tuple[int, int]:
+    rows = sum(s.num_rows for s in g)
+    drows = sum(s.num_rows for s in g if isinstance(s, DeltaSegment))
+    return rows, drows

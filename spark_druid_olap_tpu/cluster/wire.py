@@ -1,0 +1,95 @@
+"""Cluster wire codec: the host partial-state currency over HTTP.
+
+The broker/historical RPC moves exactly the interchange currency the
+unified executor core already defines (exec/engine.py):
+
+    {"sums": f64[G, A], "mins": f64[G, M], "maxs": f64[G, M],
+     "sketches": {name: i8/u8[G, W]}}
+
+encoded as JSON — per-array dtype + shape + base64 payload — because
+the historical surface is the existing stdlib HTTP server and JSON is
+its wire format.  Decode is STRICT: a torn body (the
+`cluster.torn_response` fault site truncates mid-payload), a missing
+key, or a byte count that disagrees with dtype x shape raises
+`WireDecodeError`, which the broker's scatter loop treats as a replica
+failure and fails over — a corrupt replica answer must never ⊕ into
+the merge.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["WireDecodeError", "encode_state", "decode_state"]
+
+_STATE_KEYS = ("sums", "mins", "maxs")
+
+
+class WireDecodeError(ValueError):
+    """A replica response that cannot be decoded into a valid partial
+    state (torn payload, missing key, shape/byte mismatch)."""
+
+
+def _encode_array(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _decode_array(doc) -> np.ndarray:
+    if not isinstance(doc, dict):
+        raise WireDecodeError(f"array doc is {type(doc).__name__}, not dict")
+    try:
+        dtype = np.dtype(doc["dtype"])
+        shape = tuple(int(x) for x in doc["shape"])
+        raw = base64.b64decode(str(doc["data"]).encode("ascii"),
+                               validate=True)
+    except WireDecodeError:
+        raise
+    except Exception as e:
+        raise WireDecodeError(f"malformed array doc: {e}") from e
+    want = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+    if len(raw) != want:
+        raise WireDecodeError(
+            f"torn array payload: {len(raw)} bytes for "
+            f"{dtype}{list(shape)} (want {want})"
+        )
+    # copy: frombuffer views are read-only and the merge fold must own
+    # writable arrays
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+def encode_state(state: dict) -> dict:
+    """Host partial-state dict -> JSON-safe document."""
+    doc = {k: _encode_array(state[k]) for k in _STATE_KEYS}
+    doc["sketches"] = {
+        str(name): _encode_array(arr)
+        for name, arr in (state.get("sketches") or {}).items()
+    }
+    return doc
+
+
+def decode_state(doc) -> Dict[str, object]:
+    """JSON document -> host partial-state dict (strict; raises
+    `WireDecodeError` on anything short of a complete valid state)."""
+    if not isinstance(doc, dict):
+        raise WireDecodeError(
+            f"state doc is {type(doc).__name__}, not dict"
+        )
+    missing = [k for k in _STATE_KEYS if k not in doc]
+    if missing:
+        raise WireDecodeError(f"state doc missing keys {missing}")
+    state = {k: _decode_array(doc[k]) for k in _STATE_KEYS}
+    sk = doc.get("sketches")
+    if sk is not None and not isinstance(sk, dict):
+        raise WireDecodeError("sketches member is not a dict")
+    state["sketches"] = {
+        str(name): _decode_array(arr) for name, arr in (sk or {}).items()
+    }
+    return state

@@ -267,6 +267,26 @@ class SessionConfig:
     # appends stay durable either way via the WAL.
     snapshot_flush_s: float = 0.0
 
+    # -- cluster tier (cluster/, ISSUE 16) ----------------------------------
+    # replicas per segment in the broker's assignment map (rendezvous
+    # hashing over historical node ids); clamped to the live node count
+    cluster_replication: int = 2
+    # per-replica RPC budget: one scatter attempt must answer within
+    # this or the broker fails over to the next replica in the chain
+    cluster_rpc_timeout_ms: float = 5000.0
+    # extra attempts across the replica chain after the first failure
+    # (the chain is bounded by replication anyway; this caps re-walks)
+    cluster_rpc_retries: int = 1
+    # tail-latency hedging: if the primary replica hasn't answered
+    # within this, the broker issues the same fetch to the next replica
+    # and takes whichever returns first.  0 disables hedging.
+    cluster_hedge_ms: float = 0.0
+    # per-historical circuit breaker (generalizes the device/mesh
+    # breakers): consecutive scatter failures to one node before its
+    # breaker opens, and how long it cools before a probe
+    cluster_breaker_failures: int = 3
+    cluster_breaker_cooldown_ms: float = 2000.0
+
     # -- observability (obs/) -----------------------------------------------
     # slow-query log: a finished query whose span-tree total exceeds this
     # logs the rendered tree at WARNING through utils/log.py; 0 disables

@@ -21,6 +21,8 @@ from .registry import (  # noqa: F401
     record_compaction,
     record_ingest,
     record_partial,
+    record_cluster_health,
+    record_cluster_rpc,
     record_query_metrics,
     record_rollup,
     record_snapshot_flush,
@@ -34,6 +36,7 @@ from .trace import (  # noqa: F401
     SPAN_ADAPTIVE_PROBE,
     SPAN_ADMISSION,
     SPAN_ARENA_BUILD,
+    SPAN_CLUSTER_MERGE,
     SPAN_COLLECTIVE_MERGE,
     SPAN_COMPACT,
     SPAN_DEGRADED,
@@ -43,6 +46,7 @@ from .trace import (  # noqa: F401
     SPAN_FALLBACK_DECODE,
     SPAN_FINALIZE,
     SPAN_FUSED_BATCH,
+    SPAN_GATHER,
     SPAN_H2D,
     SPAN_INGEST,
     SPAN_INGEST_ENCODE,
@@ -55,6 +59,7 @@ from .trace import (  # noqa: F401
     SPAN_QUERY,
     SPAN_RETRY,
     SPAN_ROLLUP,
+    SPAN_SCATTER,
     SPAN_SEGMENT_DISPATCH,
     SPAN_SNAPSHOT_FLUSH,
     SPAN_SPARSE_DISPATCH,

@@ -86,6 +86,16 @@ PREFETCH_SPANS = frozenset({"prefetch"})
 # path's build cost is visible apart from generic host work (its child
 # h2d spans still land in the transfer bucket)
 ARENA_SPANS = frozenset({"arena_build"})
+# cluster tier (cluster/, ISSUE 16): the broker's scatter span measures
+# replica RPCs in flight (its per-reply `rpc` events carry the
+# per-historical latency the receipt's cluster section aggregates);
+# gather is decode + coverage accounting; cluster_merge is the ⊕ fold of
+# replica states.  Each gets its own receipt bucket so a slow cluster
+# query attributes to the wire, the decode, or the merge — not to
+# generic host time.
+SCATTER_SPANS = frozenset({"scatter"})
+GATHER_SPANS = frozenset({"gather"})
+CLUSTER_MERGE_SPANS = frozenset({"cluster_merge"})
 ROOT_SPAN = "query"
 
 # device LAUNCH spans — the receipt's `dispatch_count` (ISSUE 14): how
@@ -417,10 +427,42 @@ def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
         acc["prefetch"] += excl
     elif name in ARENA_SPANS:
         acc["arena_build"] += excl
+    elif name in SCATTER_SPANS:
+        acc["scatter"] += excl
+    elif name in GATHER_SPANS:
+        acc["gather"] += excl
+    elif name in CLUSTER_MERGE_SPANS:
+        acc["cluster_merge"] += excl
     else:
         acc["host"] += excl
     for c in children:
         _walk_exclusive(c, acc, depth + 1)
+
+
+def _walk_cluster_nodes(node: dict, nodes: Dict[str, Dict[str, Any]]):
+    """Aggregate the scatter span's per-reply `rpc` events into
+    per-historical receipt buckets: {node -> {ms, rpcs, ok, failed,
+    segments}}.  One bucket per historical the query touched — the
+    obs_dump table renders these as the per-node attribution row."""
+    if str(node.get("name", "")) in SCATTER_SPANS:
+        for e in node.get("events") or ():
+            if e.get("name") != "rpc":
+                continue
+            attrs = e.get("attrs") or {}
+            nid = str(attrs.get("node", "?"))
+            b = nodes.setdefault(
+                nid, {"ms": 0.0, "rpcs": 0, "ok": 0, "failed": 0,
+                      "segments": 0},
+            )
+            b["rpcs"] += 1
+            b["ms"] = round(b["ms"] + float(attrs.get("ms", 0.0)), 3)
+            if attrs.get("outcome") == "ok":
+                b["ok"] += 1
+                b["segments"] += int(attrs.get("segments", 0))
+            else:
+                b["failed"] += 1
+    for c in node.get("children") or ():
+        _walk_cluster_nodes(c, nodes)
 
 
 def build_receipt(
@@ -432,10 +474,13 @@ def build_receipt(
     acc = {
         "device": 0.0, "transfer": 0.0, "prefetch": 0.0, "host": 0.0,
         "arena_build": 0.0, "unattributed": 0.0, "dispatch_count": 0,
+        "scatter": 0.0, "gather": 0.0, "cluster_merge": 0.0,
     }
+    cluster_nodes: Dict[str, Dict[str, Any]] = {}
     root = trace_doc.get("spans")
     if isinstance(root, dict):
         _walk_exclusive(root, acc, 0)
+        _walk_cluster_nodes(root, cluster_nodes)
     wall = float(trace_doc.get("total_ms") or 0.0)
     # overlap efficiency (ROADMAP direction 4's success metric):
     # device-busy time over (device-busy + transfer-stall).  Stall is the
@@ -461,6 +506,16 @@ def build_receipt(
         ),
         "sampled": bool(scope.sampled) if scope is not None else False,
     }
+    # cluster queries only: scatter/gather/merge attribution + the
+    # per-historical buckets.  Absent on single-process receipts so the
+    # existing lean shape is unchanged.
+    if cluster_nodes or acc["scatter"] or acc["gather"] or (
+        acc["cluster_merge"]
+    ):
+        receipt["scatter_ms"] = round(acc["scatter"], 3)
+        receipt["gather_ms"] = round(acc["gather"], 3)
+        receipt["cluster_merge_ms"] = round(acc["cluster_merge"], 3)
+        receipt["cluster"] = {"nodes": cluster_nodes}
     if scope is not None:
         cache: Dict[str, Any] = {
             "result_cache": scope.result_cache,

@@ -741,3 +741,74 @@ def record_query_metrics(m, outcome: str = "ok") -> None:
     ):
         if value > 0 or phase == "total":
             hist.labels(phase=phase).observe(value, exemplar=qid)
+
+
+def record_cluster_rpc(
+    node: str, outcome: str, ms: float = 0.0, query_id: str = "",
+    hedged: bool = False, failover: bool = False,
+) -> None:
+    """Publish one broker->historical scatter RPC (cluster/, ISSUE 16):
+    a per-node/per-outcome count, the RPC latency distribution, and the
+    failover/hedge counters the chaos matrix reads.  Node ids pass the
+    label-cardinality guard — a runaway membership churn collapses into
+    `__other__` instead of exploding the registry."""
+    reg = get_registry()
+    labels = {
+        "node": bounded_label("cluster_node", node or "unknown"),
+        "outcome": bounded_label("cluster_outcome", outcome or "unknown"),
+    }
+    reg.counter(
+        "sdol_cluster_scatter_total",
+        "broker scatter RPCs to historicals, by node and outcome",
+        labels=("node", "outcome"),
+    ).labels(**labels).inc()
+    if ms > 0:
+        reg.histogram(
+            "sdol_cluster_rpc_ms",
+            "broker->historical RPC latency (one replica attempt)",
+            buckets=(1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                     1000.0, 5000.0),
+        ).observe(float(ms), exemplar=query_id or None)
+    if failover:
+        reg.counter(
+            "sdol_cluster_failover_total",
+            "scatter attempts that failed over to another replica",
+            labels=("node",),
+        ).labels(node=labels["node"]).inc()
+    if hedged:
+        reg.counter(
+            "sdol_cluster_hedge_total",
+            "scatter fetches hedged to a second replica past the "
+            "hedge threshold",
+            labels=("node",),
+        ).labels(node=labels["node"]).inc()
+
+
+def record_cluster_health(
+    live: int, total: int, epoch: int, deficit: int, lost: int = 0,
+) -> None:
+    """Publish the broker's cluster-health gauges: live historicals,
+    the assignment epoch, and the replication deficit (segments below
+    their replication factor; `lost` = segments with NO live replica,
+    the coverage-stamped-partial zone)."""
+    reg = get_registry()
+    reg.gauge(
+        "sdol_cluster_historicals_live",
+        "historicals whose breaker admits traffic",
+    ).set(int(live))
+    reg.gauge(
+        "sdol_cluster_historicals_total",
+        "historicals in the broker's membership",
+    ).set(int(total))
+    reg.gauge(
+        "sdol_cluster_assignment_epoch",
+        "monotonic assignment epoch (bumps on membership change)",
+    ).set(int(epoch))
+    reg.gauge(
+        "sdol_cluster_replication_deficit",
+        "segments currently below their replication factor",
+    ).set(int(deficit))
+    reg.gauge(
+        "sdol_cluster_segments_lost",
+        "segments with zero live replicas (served as stamped partials)",
+    ).set(int(lost))

@@ -87,6 +87,9 @@ SPAN_WAL_REPLAY = "wal_replay"  # boot-time WAL replay of one datasource
 SPAN_SNAPSHOT_FLUSH = "snapshot_flush"  # persistent segment snapshot commit
 SPAN_ROLLUP = "rollup"  # ingest-time pre-aggregation of an append batch
 SPAN_ARENA_BUILD = "arena_build"  # segment-stacked arena assembly (exec/arena.py)
+SPAN_SCATTER = "scatter"  # broker: replica fetches in flight (cluster/)
+SPAN_GATHER = "gather"  # broker: decode + coverage of gathered replies
+SPAN_CLUSTER_MERGE = "cluster_merge"  # broker: ⊕ fold of replica states
 
 SPAN_NAMES = frozenset(
     {
@@ -120,6 +123,9 @@ SPAN_NAMES = frozenset(
         SPAN_SNAPSHOT_FLUSH,
         SPAN_ROLLUP,
         SPAN_ARENA_BUILD,
+        SPAN_SCATTER,
+        SPAN_GATHER,
+        SPAN_CLUSTER_MERGE,
     }
 )
 

@@ -517,6 +517,15 @@ SITES = (
     "persist.snapshot_rename",  # before the snapshot.json commit rename
     "compact.retire",  # before retired segment files are deleted
     "storage.replay_batch",  # before a replayed batch is re-applied
+    # cluster tier (ISSUE 16): process-level chaos sites.  The broker
+    # fires the first two in its scatter/gather loops (deadline +
+    # injection checkpoints); the per-RPC sites simulate the network
+    # and remote-process failure modes the chaos matrix proves against.
+    "cluster.scatter",  # broker: before each replica fetch attempt
+    "cluster.gather",  # broker: between merged replica responses
+    "cluster.rpc",  # broker: inside one RPC (error=timeout, delay=slow)
+    "cluster.torn_response",  # broker: partial mode truncates the body
+    "cluster.historical_kill",  # historical: dies serving a partial
 )
 
 

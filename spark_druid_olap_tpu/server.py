@@ -57,6 +57,7 @@ from .resilience import (
     classify_error,
     current_partial,
     deadline_scope,
+    fire,
     partial_scope,
 )
 from .utils.log import get_logger
@@ -357,6 +358,13 @@ class _Handler(BaseHTTPRequestHandler):
                 storage.state() if storage is not None
                 else {"enabled": False}
             )
+            # cluster tier (ISSUE 16): per-historical liveness/breaker
+            # state, the assignment epoch, and the replication deficit.
+            # Served through ANY breaker state — health must stay
+            # readable exactly when the cluster is degraded.
+            cluster = getattr(self.ctx, "cluster", None)
+            if cluster is not None:
+                doc["cluster"] = cluster.state()
             return self._send(200, doc)
         if path == "/status/metrics":
             # Prometheus text exposition of the process registry (engines,
@@ -460,6 +468,9 @@ class _Handler(BaseHTTPRequestHandler):
             )
         if path.startswith("/druid/v2/ingest/"):
             return self._ingest(path.rsplit("/", 1)[1], body)
+        if path == "/druid/v2/cluster/partial":
+            # the historical's scatter surface (cluster/, ISSUE 16)
+            return self._cluster_partial(body)
         if path not in ("/druid/v2", "/druid/v2/sql"):
             return self._error(404, f"no route {path!r}", "NotFound")
         # A non-dict context is client noise, not a server error: ignore it.
@@ -703,6 +714,140 @@ class _Handler(BaseHTTPRequestHandler):
             if res is not None:
                 res.ingest_admission.release()
 
+    def _cluster_partial(self, body: dict):
+        """POST /druid/v2/cluster/partial: the historical's scatter
+        surface (cluster/, ISSUE 16).  Body: {"query": native query
+        object, "segments": [segment_id, ...] | null (full scope),
+        "version": broker's expected datasource version, "context":
+        {...}}.  Executes the query's HOST partial state over exactly
+        the requested segments and returns it wire-encoded with the
+        datasource version, the served segment ids, and this node's
+        per-query cost receipt — the broker ⊕'s the states through the
+        same merge tree the mesh slices use.
+
+        Contract edges: a node still replaying its WAL answers 503 +
+        Retry-After (its replicas carry the traffic; the replay-while-
+        serving test pins this); a segment id or version this catalog
+        cannot satisfy answers 409 (assignment skew — the broker treats
+        the replica as failed and rebalances), never a wrong merge."""
+        res = self._resilience()
+        cfg = getattr(self.ctx, "config", None)
+        qctx = body.get("context")
+        qctx = qctx if isinstance(qctx, dict) else {}
+        client_qid = qctx.get("queryId")
+        self._query_id = str(client_qid) if client_qid else new_query_id()
+        storage = getattr(self.ctx, "storage", None)
+        if storage is not None and storage.replay_in_progress:
+            return self._error(
+                503,
+                "node is recovering (WAL replay in progress); retry later",
+                "QueryUnavailableException",
+                headers={
+                    "Retry-After": res.admission.retry_after_s()
+                    if res is not None
+                    else 1
+                },
+            )
+        qdoc = body.get("query")
+        if not isinstance(qdoc, dict):
+            return self._error(
+                400, 'body must carry a native "query" object',
+                "BadQueryException",
+            )
+        if not self._admit(res):
+            return None
+        try:
+            # chaos site: an armed error IS this historical dying while
+            # serving (the broker sees the failure and fails over to a
+            # replica); delay mode is the slow-replica cell
+            fire("cluster.historical_kill")
+            from .cluster.wire import encode_state
+
+            q = query_from_druid(qdoc)
+            ds = self.ctx.catalog.get(q.datasource)
+            if ds is None:
+                return self._error(
+                    400, f"unknown dataSource {q.datasource!r}",
+                    "BadQueryException",
+                )
+            # snapshot-generation check (GL2301): the LIVE catalog
+            # version is process-local (every republish bumps it), so
+            # replicas compare the SNAPSHOT version they booted — the
+            # one number identical across processes sharing the store
+            have = (
+                storage.snapshot_version(q.datasource)
+                if storage is not None else None
+            )
+            if have is None:
+                have = int(ds.version)
+            expect = body.get("version")
+            if expect is not None and have != int(expect):
+                return self._error(
+                    409,
+                    f"datasource {q.datasource!r} at snapshot version "
+                    f"{have}, broker's assignment expects {int(expect)} "
+                    "— replica/assignment skew; rebalance and retry",
+                    "VersionMismatchException",
+                )
+            want = body.get("segments")
+            by_id = {s.segment_id: s.uid for s in ds.segments}
+            if want is None:
+                uids = None
+                served = sorted(by_id)
+            else:
+                missing = [sid for sid in want if sid not in by_id]
+                if missing:
+                    return self._error(
+                        409,
+                        f"unknown segments {missing[:4]} (assignment vs "
+                        "catalog skew) — rebalance and retry",
+                        "VersionMismatchException",
+                    )
+                uids = frozenset(by_id[sid] for sid in want)
+                served = [str(sid) for sid in want]
+            with self._tracer().query_trace(
+                query_id=self._query_id,
+                query_type="cluster_partial",
+                slow_ms=cfg.slow_query_ms if cfg else 0.0,
+            ) as tr:
+                self.ctx._sync_engine_resilience(self.ctx.engine)
+                state, rows = self.ctx.engine.groupby_partials_host(
+                    q, ds, within_uids=uids
+                )
+            doc = {
+                "node": getattr(self.ctx, "cluster_node_id", ""),
+                "version": int(have),
+                "rows": int(rows),
+                "segments": served,
+                "state": encode_state(state),
+            }
+            if tr is not None and tr.receipt:
+                # per-historical receipt (ISSUE 16 obs satellite): the
+                # broker folds this into its own receipt's cluster
+                # section, so one query attributes across processes
+                doc["receipt"] = tr.receipt
+            return self._send(200, doc)
+        except (WireError, ValueError) as e:
+            return self._error(400, str(e), "BadQueryException")
+        except DeadlineExceeded as e:
+            if res is not None:
+                res.note_deadline_exceeded()
+            return self._error(504, str(e), "QueryTimeoutException")
+        except Exception as e:
+            log.error(
+                "cluster partial failed: %s", type(e).__name__,
+                exc_info=True,
+            )
+            if res is not None:
+                res.note_server_error(e)
+            return self._error(
+                500, "cluster partial failed; see server logs",
+                type(e).__name__,
+            )
+        finally:
+            if res is not None:
+                res.admission.release()
+
     def _partial_headers(self) -> Optional[dict]:
         """X-Druid-Response-Context carrying the partial-result contract
         (ISSUE 7): when the answer about to be sent is deadline-bounded,
@@ -864,6 +1009,24 @@ class _Handler(BaseHTTPRequestHandler):
             hit = serve.cached_native(q, ds, key=rkey)
             if hit is not None:
                 return hit
+            # broker mode (cluster/, ISSUE 16): scatter the query's
+            # assigned segments to historicals and ⊕ their states — the
+            # result cache above rides the broker (an exact hit never
+            # scatters) and fusion stays local-only below, so coverage
+            # of the two tiers composes instead of competing
+            cluster = getattr(self.ctx, "cluster", None)
+            if cluster is not None and cluster.covers(q, ds):
+                df = cluster.execute(q, ds)
+                self.ctx._last_engine_metrics = cluster.last_metrics
+                pc = current_partial()
+                if rkey is not None and not (
+                    pc is not None and pc.triggered
+                ):
+                    # frame-only: a gathered answer has no LOCAL state
+                    # to delta-refresh, and a coverage-stamped partial
+                    # must never seed the cache
+                    serve.store_native(q, ds, df, key=rkey)
+                return df
             fusable = self.ctx.engine.fusable(q, ds)
             if fusable:
                 fused = serve.fused_execute(q, ds)

@@ -161,6 +161,17 @@ class DurableStorage:
         )
         return snap
 
+    def snapshot_version(self, name: str) -> Optional[int]:
+        """The datasource version of the LAST snapshot generation this
+        process flushed or booted.  Unlike the live catalog version
+        (which every republish bumps process-locally), this number is
+        identical in every process sharing the directory at the same
+        snapshot generation — it is the version the cluster tier pins
+        in the assignment manifest and checks on scatter (GL2301)."""
+        with self._lock:
+            v = self._snap_versions.get(name)
+        return int(v) if v is not None else None
+
     # -- background flush sweep ----------------------------------------------
 
     def _dirty(self, name: str) -> bool:

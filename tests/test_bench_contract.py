@@ -138,6 +138,11 @@ def test_production_tag_keys_scale(monkeypatch):
     mode, fn, arg = bench._parse_args(["mesh_unified", "10"])
     assert "%s_%g" % (mode, arg) == "mesh_unified_10"
     assert fn is bench.bench_mesh_unified
+    # cluster-tier QPS scaling (ISSUE 16): SSB scale-factor arg
+    mode, fn, arg = bench._parse_args(["cluster", "1"])
+    assert "%s_%g" % (mode, arg) == "cluster_1"
+    assert fn is bench.bench_cluster
+    assert isinstance(bench.MODES["cluster"][1], float)
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):
@@ -543,6 +548,89 @@ def test_emit_mesh_unified_result_shape(capsys, tmp_path, monkeypatch):
     )
     assert d["multi_slice"]["slice_equivalents"] > 1
     assert d["p50_ms_mesh_arena"] <= d["p50_ms_single"]
+
+
+def test_emit_cluster_result_shape(capsys, tmp_path, monkeypatch):
+    """The cluster-tier mode (ISSUE 16): stdout stays one compact line
+    whose value is the 1->4-historical QPS scaling factor; the detail
+    sidecar carries the per-phase qps + latency percentiles, the
+    kill-and-recover per-query timeline with its event markers, the
+    rolling-restart zero-failure count, and the sampled broker receipt
+    with per-historical RPC buckets."""
+    bench = _load_bench()
+    monkeypatch.setenv("SD_BENCH_DETAIL_DIR", str(tmp_path))
+    timeline = [
+        {"t_ms": 100.0 * i, "ms": 45.0, "ok": True, "partial": False}
+        for i in range(30)
+    ]
+    bench._emit(
+        {
+            "metric": "cluster_ssb_sf1_qps_scaling_1to4",
+            "value": 3.4,
+            "unit": "x",
+            "vs_baseline": 3.4,
+            "degraded": False,
+            "device": "TFRT_CPU_0",
+            "detail": {
+                "rows": 6_000_000,
+                "n_historicals": 4,
+                "boot_s": {"h0": 8.1, "h1": 8.3, "h2": 8.2, "h3": 8.4},
+                "phases": [
+                    {"nodes": 1, "replication": 1, "queries": 32,
+                     "qps": 4.1, "errors": 0, "partials": 0,
+                     "segments_scattered": 12, "p50_ms": 230.0,
+                     "p95_ms": 280.0, "p99_ms": 301.0},
+                    {"nodes": 2, "replication": 2, "queries": 32,
+                     "qps": 7.9, "errors": 0, "partials": 0,
+                     "segments_scattered": 12, "p50_ms": 121.0,
+                     "p95_ms": 150.0, "p99_ms": 166.0},
+                    {"nodes": 4, "replication": 2, "queries": 32,
+                     "qps": 13.9, "errors": 0, "partials": 0,
+                     "segments_scattered": 12, "p50_ms": 66.0,
+                     "p95_ms": 84.0, "p99_ms": 92.0},
+                ],
+                "receipt": {
+                    "scatter_ms": 61.0, "gather_ms": 2.1,
+                    "cluster_merge_ms": 0.8,
+                    "nodes": {
+                        "h0": {"ms": 58.0, "rpcs": 1, "ok": 1,
+                               "failed": 0, "segments": 3},
+                    },
+                },
+                "kill_recover": {
+                    "events": [
+                        {"t_ms": 1000.0, "event": "SIGKILL h3"},
+                        {"t_ms": 1800.0, "event": "respawn h3"},
+                        {"t_ms": 9800.0, "event": "rejoin h3"},
+                    ],
+                    "timeline": timeline,
+                    "errors": 0,
+                    "partials": 0,
+                },
+                "rolling_restart": {"queries": 16, "failed": 0},
+            },
+        },
+        "cluster_1",
+    )
+    line = capsys.readouterr().out.strip()
+    assert len(line) < 2000
+    parsed = json.loads(line)
+    assert parsed["metric"] == "cluster_ssb_sf1_qps_scaling_1to4"
+    assert parsed["value"] == 3.4
+    assert "timeline" not in line  # the stream stays in the sidecar
+    detail = json.load(open(tmp_path / "BENCH_cluster_1_detail.json"))
+    d = detail["detail"]
+    assert [p["nodes"] for p in d["phases"]] == [1, 2, 4]
+    assert all(p["errors"] == 0 for p in d["phases"])
+    assert d["phases"][-1]["qps"] > d["phases"][0]["qps"]
+    assert d["kill_recover"]["errors"] == 0
+    assert len(d["kill_recover"]["timeline"]) == 30
+    assert any(
+        e["event"].startswith("SIGKILL")
+        for e in d["kill_recover"]["events"]
+    )
+    assert d["rolling_restart"]["failed"] == 0
+    assert d["receipt"]["nodes"]["h0"]["ok"] == 1
 
 
 def test_emit_error_shape(capsys, tmp_path, monkeypatch):

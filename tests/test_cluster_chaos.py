@@ -1,0 +1,316 @@
+"""Cluster chaos matrix (ISSUE 16): robustness is PROVEN, not assumed.
+
+Every cell runs the same shape: a broker + in-process historicals over
+one shared snapshot store, a fault armed at a process-level site (or a
+real node shutdown), one or more queries through the loss, and an
+assertion about the ANSWER — exact through a replica, coverage-stamped
+partial when a whole replica set is gone, never a 500.  The cells:
+
+* kill-a-historical mid-query -> exact answer via its replica
+* torn response / RPC failure / slow replica -> failover, exact
+* every replica of a segment lost -> coverage-stamped partial
+* rolling restart of every historical -> zero failed queries
+* WAL-replaying node answers 503 + Retry-After while replicas carry
+  traffic, then rejoins with byte-identical answers
+* metadata + health serve through any breaker state
+
+The FaultInjector is process-global and the historicals here are
+in-process, so `cluster.historical_kill` (fired only inside the
+historical's scatter handler) injects into the serving replica while
+`cluster.rpc` / `cluster.torn_response` (fired only broker-side)
+inject into the broker's RPC path — per-site isolation without
+subprocesses.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_druid_olap_tpu as sd
+from spark_druid_olap_tpu.cluster import ClusterClient, HistoricalNode
+from spark_druid_olap_tpu.resilience import injector
+
+T0 = int(np.datetime64("2023-01-01", "ms").astype(np.int64))
+DAY = 86_400_000
+
+Q = (
+    "SELECT city, sum(qty) AS q, count(*) AS n "
+    "FROM ev GROUP BY city ORDER BY city"
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    injector().disarm()
+    yield
+    injector().disarm()
+
+
+def _cols(n=3000, seed=5):
+    rng = np.random.default_rng(seed)
+    return {
+        "city": rng.choice(
+            np.array(["austin", "boston", "chicago"], dtype=object), n
+        ),
+        "qty": rng.integers(1, 100, n).astype(np.int64),
+        "ts": T0 + rng.integers(0, 30, n) * DAY,
+    }
+
+
+class _Cluster:
+    def __init__(self, d, n_nodes=2, replication=2, n=3000, **cfg_kw):
+        cfg_kw.setdefault("cluster_breaker_cooldown_ms", 50.0)
+        self.d = str(d)
+        self.broker = sd.TPUOlapContext(
+            sd.SessionConfig(storage_dir=self.d, **cfg_kw)
+        )
+        self.broker.register_table(
+            "ev", _cols(n), dimensions=["city"], metrics=["qty"],
+            time_column="ts", rows_per_segment=800,
+        )
+        self.nodes = {}
+        for i in range(n_nodes):
+            h = HistoricalNode(f"h{i}", self.d).start()
+            self.nodes[h.node_id] = h
+        self.client = ClusterClient(
+            self.broker,
+            nodes={nid: h.url for nid, h in self.nodes.items()},
+            replication=replication,
+        ).attach()
+        self.client.detach()
+        self.oracle = self.broker.sql(Q)
+        self.client.attach()
+        self._qn = 0
+
+    def query(self):
+        """One clustered query, result-cache-proof (distinct no-op
+        LIMIT per call)."""
+        self._qn += 1
+        before = self.client.last_metrics
+        df = self.broker.sql(Q + f" LIMIT {200 + self._qn}")
+        assert self.client.last_metrics is not before, (
+            "query did not scatter"
+        )
+        return df
+
+    def restart(self, node_id):
+        """Kill + reboot one historical (fresh context, fresh port —
+        a real process restart re-runs snapshot mmap + WAL replay)."""
+        self.nodes[node_id].shutdown()
+        h = HistoricalNode(node_id, self.d).start()
+        self.nodes[node_id] = h
+        self.client.set_node_url(node_id, h.url)
+        return h
+
+    def close(self):
+        self.client.close()
+        for h in self.nodes.values():
+            h.shutdown()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = _Cluster(tmp_path)
+    yield c
+    c.close()
+
+
+# -- single-fault cells -------------------------------------------------------
+
+
+def test_kill_historical_mid_query_exact_via_replica(cluster):
+    from spark_druid_olap_tpu.obs.registry import get_registry
+
+    fo = get_registry().counter(
+        "sdol_cluster_failover_total", labels=("node",)
+    )
+    base = sum(fo.snapshot().values())
+    # the serving replica dies INSIDE its handler; the broker must
+    # serve the exact answer through the segment's other replica
+    injector().arm("cluster.historical_kill", mode="error", times=1)
+    df = cluster.query()
+    assert cluster.oracle.equals(df)
+    assert not df.attrs.get("partial", False)
+    assert sum(fo.snapshot().values()) - base >= 1
+
+
+def test_torn_response_fails_over_exact(cluster):
+    # the broker sees half a response body — the strict wire decode
+    # must reject it and fail over, never merge garbage
+    injector().arm("cluster.torn_response", mode="partial",
+                   fraction=0.5, times=1)
+    df = cluster.query()
+    assert cluster.oracle.equals(df)
+    assert not df.attrs.get("partial", False)
+
+
+def test_rpc_failures_retry_and_fail_over_exact(cluster):
+    injector().arm("cluster.rpc", mode="error", times=2)
+    df = cluster.query()
+    assert cluster.oracle.equals(df)
+    assert not df.attrs.get("partial", False)
+
+
+def test_slow_replica_still_exact(cluster):
+    injector().arm("cluster.rpc", mode="delay", delay_ms=80.0, times=1)
+    df = cluster.query()
+    assert cluster.oracle.equals(df)
+    assert not df.attrs.get("partial", False)
+
+
+# -- replica-set loss ---------------------------------------------------------
+
+
+def test_all_replicas_lost_serves_coverage_stamped_partial(tmp_path):
+    c = _Cluster(tmp_path, n_nodes=2, replication=1)
+    try:
+        # replication=1: each segment has exactly one home; killing one
+        # node loses its replica SETS outright.  The answer must be a
+        # stamped partial over the surviving segments — never an error.
+        victim = next(iter(c.client.assignment.segment_map.values()))[0]
+        c.nodes[victim].shutdown()
+        df = c.query()
+        assert df.attrs.get("partial") is True
+        assert 0.0 <= df.attrs["coverage"] < 1.0
+        m = c.broker.last_metrics
+        assert m.partial and m.coverage == df.attrs["coverage"]
+        # the survivors' rows are still exact: every (city, q, n) row
+        # served must match the oracle's row for that city upper-bounded
+        merged = df.merge(c.oracle, on="city", suffixes=("", "_full"))
+        assert (merged["q"] <= merged["q_full"]).all()
+    finally:
+        c.close()
+
+
+def test_every_node_down_partial_not_500(tmp_path):
+    c = _Cluster(tmp_path, n_nodes=2, replication=2)
+    try:
+        for h in c.nodes.values():
+            h.shutdown()
+        df = c.query()  # no exception: fully degraded, stamped
+        assert df.attrs.get("partial") is True
+        assert df.attrs["coverage"] == 0.0
+    finally:
+        c.close()
+
+
+def test_health_and_metadata_serve_through_open_breakers(tmp_path):
+    from spark_druid_olap_tpu.server import OlapServer
+
+    c = _Cluster(tmp_path, n_nodes=2, replication=2)
+    srv = OlapServer(c.broker, port=0).start()
+    try:
+        for h in c.nodes.values():
+            h.shutdown()
+        for _ in range(3):  # drive both breakers past the threshold
+            c.query()
+        st = c.client.state()
+        assert any(
+            n["breaker"]["state"] == "open" for n in st["nodes"].values()
+        )
+        assert st["segments_lost"] > 0
+        # health and metadata keep serving through ANY breaker state
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/status/health", timeout=30
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["cluster"]["live"] < 2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/druid/v2/datasources", timeout=30
+        ) as r:
+            assert "ev" in json.loads(r.read())
+    finally:
+        srv.shutdown()
+        c.close()
+
+
+# -- rolling restart ----------------------------------------------------------
+
+
+def test_rolling_restart_every_historical_zero_failed_queries(cluster):
+    """The acceptance cell: restart EVERY historical, one at a time,
+    with queries flowing across each step — all exact, none failed,
+    none partial."""
+    served = 0
+    for node_id in sorted(cluster.nodes):
+        cluster.nodes[node_id].shutdown()
+        for _ in range(2):  # queries through the downtime window
+            df = cluster.query()
+            assert cluster.oracle.equals(df)
+            assert not df.attrs.get("partial", False)
+            served += 1
+        cluster.restart(node_id)
+        time.sleep(0.08)  # let the down-node's breaker cooldown lapse
+        for _ in range(2):  # queries after rejoin
+            df = cluster.query()
+            assert cluster.oracle.equals(df)
+            assert not df.attrs.get("partial", False)
+            served += 1
+    assert served == 4 * len(cluster.nodes)
+
+
+# -- replay-while-serving (satellite) -----------------------------------------
+
+
+def test_replaying_node_503s_replicas_carry_then_rejoins_identical(
+    cluster,
+):
+    c = cluster
+    h0 = c.nodes["h0"]
+    # simulate the WAL-replay boot window: the node is up but its
+    # storage is mid-recovery — the scatter surface must refuse with
+    # 503 + Retry-After (the broker treats it as a failed replica)
+    h0.ctx.storage.replay_in_progress = True
+    try:
+        req = urllib.request.Request(
+            h0.url + "/druid/v2/cluster/partial",
+            data=json.dumps({"query": {}}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) > 0
+        # its replicas carry the traffic meanwhile: exact, not partial
+        df = c.query()
+        assert c.oracle.equals(df)
+        assert not df.attrs.get("partial", False)
+    finally:
+        h0.ctx.storage.replay_in_progress = False
+
+    # real rejoin: kill + reboot (snapshot mmap + WAL replay) and
+    # rebalance — answers must come back byte-identical
+    c.restart("h0")
+    c.client.rebalance()
+    time.sleep(0.08)
+    df = c.query()
+    assert c.oracle.to_json() == df.to_json()  # byte-identical
+    assert not df.attrs.get("partial", False)
+
+
+def test_restarted_node_serves_replayed_wal_rows(tmp_path):
+    """A historical restarted AFTER the broker flushed new rows boots
+    the newer snapshot generation and rejoins at the new version."""
+    c = _Cluster(tmp_path, n_nodes=2, replication=2)
+    try:
+        c.broker.append_rows("ev", _cols(n=400, seed=9))
+        c.broker.storage.flush("ev")  # new snapshot generation
+        # restart both nodes onto the new generation, then rebalance so
+        # the assignment pins the new version + segment set
+        for nid in sorted(c.nodes):
+            c.restart(nid)
+        c.client.rebalance()
+        time.sleep(0.08)
+        c.client.detach()
+        oracle2 = c.broker.sql(Q + " LIMIT 151")
+        c.client.attach()
+        df = c.query()
+        assert oracle2.equals(df)
+        assert not df.attrs.get("partial", False)
+    finally:
+        c.close()

@@ -2243,6 +2243,118 @@ _MATRIX = {
             """},
         ],
     },
+    "broker-discipline": {
+        "violating": [
+            # GL2301: replica states folded with no version reference
+            # anywhere in the enclosing function — a cross-generation
+            # merge with agreeing shapes is silently wrong
+            (
+                {"spark_druid_olap_tpu/cluster/gatherer.py": """
+                    def fold(engine, q, ds, state, replies):
+                        for r in replies:
+                            state = engine.merge_groupby_states(
+                                q, ds, state, r["state"]
+                            )
+                        return state
+                """},
+                {"GL2301"},
+            ),
+            # GL2302: a failover/retry loop issuing RPCs with no
+            # resilience checkpoint — uninjectable and unbounded
+            (
+                {"spark_druid_olap_tpu/cluster/scatterer.py": """
+                    import urllib.request
+
+                    def walk_chain(chain, payload):
+                        for node_url in chain:
+                            try:
+                                return urllib.request.urlopen(
+                                    node_url, payload
+                                )
+                            except OSError:
+                                continue
+                """},
+                {"GL2302"},
+            ),
+            # GL2303: routing on a breaker's raw _state races the
+            # half-open probe bookkeeping under the breaker's lock
+            (
+                {"spark_druid_olap_tpu/cluster/router.py": """
+                    def pick(nodes, breakers):
+                        return [
+                            n for n in nodes
+                            if breakers[n]._state == "closed"
+                        ]
+                """},
+                {"GL2303"},
+            ),
+            # GL2303 also fires on the distinctive fields through any
+            # receiver, including self outside CircuitBreaker
+            (
+                {"spark_druid_olap_tpu/serve/probe.py": """
+                    class Router:
+                        def healthy(self, br):
+                            return br._consecutive_failures == 0
+                """},
+                {"GL2303"},
+            ),
+        ],
+        "clean": [
+            # version-checked gather + checkpointed scatter loop +
+            # public breaker accessors: the whole contract held
+            {"spark_druid_olap_tpu/cluster/gatherer.py": """
+                import urllib.request
+
+                from ..resilience import checkpoint
+
+                def fold(engine, q, ds, state, replies, expect_version):
+                    for r in replies:
+                        if r["version"] != expect_version:
+                            continue
+                        state = engine.merge_groupby_states(
+                            q, ds, state, r["state"]
+                        )
+                    return state
+
+                def walk_chain(chain, payload):
+                    for node_url in chain:
+                        checkpoint("cluster.scatter")
+                        try:
+                            return urllib.request.urlopen(node_url, payload)
+                        except OSError:
+                            continue
+
+                def live(nodes, breakers):
+                    return [n for n in nodes if breakers[n].state != "open"]
+            """},
+            # CircuitBreaker owns its fields; other classes own their
+            # own self._state; external code reads the public surface
+            {"spark_druid_olap_tpu/resilience.py": """
+                import threading
+
+                class CircuitBreaker:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._state = "closed"
+
+                    @property
+                    def state(self):
+                        with self._lock:
+                            return self._state
+            """,
+             "spark_druid_olap_tpu/serve/drainer.py": """
+                class Drainer:
+                    def __init__(self):
+                        self._state = "idle"
+
+                    def snapshot(self, breaker):
+                        return {
+                            "drain": self._state,
+                            "breaker": breaker.to_dict(),
+                        }
+            """},
+        ],
+    },
 }
 
 

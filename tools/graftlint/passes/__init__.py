@@ -13,8 +13,8 @@ span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
 lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
 serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
 GL20xx storage-discipline, GL21xx dispatch-discipline, GL22xx
-mesh-discipline; GL00x are the core's own: GL001 unparseable file,
-GL002 malformed pragma).
+mesh-discipline, GL23xx broker-discipline; GL00x are the core's own:
+GL001 unparseable file, GL002 malformed pragma).
 """
 
 from __future__ import annotations
@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from ..core import LintConfigError, LintPass
+from .broker_discipline import BrokerDisciplinePass
 from .checkpoint_coverage import CheckpointCoveragePass
 from .collective_axis import CollectiveAxisPass
 from .compat_import import CompatImportPass
@@ -68,6 +69,7 @@ ALL_PASSES = (
     StorageDisciplinePass,
     DispatchDisciplinePass,
     MeshDisciplinePass,
+    BrokerDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

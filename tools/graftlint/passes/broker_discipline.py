@@ -1,0 +1,180 @@
+"""broker-discipline pass: the cluster tier's scatter/gather contracts
+(GL23xx, ISSUE 16 satellite).
+
+The broker (cluster/broker.py) holds three disciplines that keep a
+replica failure a FAILURE — degraded, stamped, retried — and never a
+silently wrong answer:
+
+* **GL2301 — replica states merged without a version check.**  The ⊕
+  (`merge_groupby_states`) is only sound between states computed over
+  the same catalog snapshot generation: dictionary domains (and so the
+  dense [G, A] layout) can differ across generations, and a mismatched
+  merge that happens to agree on shape adds apples to oranges with no
+  error.  The contract: every function that folds a replica state must
+  consult the assignment's pinned version (any `*version*` identifier
+  suffices — the pass checks the discipline is PRESENT, the chaos
+  matrix checks it is correct).  A merge-calling function with no
+  version reference anywhere in it has dropped the guard.
+* **GL2302 — scatter/retry loop that never reaches a resilience
+  checkpoint.**  Every loop that issues RPCs (failover walks, retry
+  chains, hedged re-issues) must call `resilience.checkpoint(...)`
+  inside the loop body: that is both the fault-injection point the
+  chaos matrix arms (a scatter loop you cannot kill is a scatter loop
+  you cannot test) and the deadline check that turns a hung replica
+  chain into a stamped partial instead of an unbounded stall.
+* **GL2303 — breaker state read outside the owning lock.**  A
+  `CircuitBreaker`'s `_state` / `_consecutive_failures` / `_opened_at`
+  / `_probe_started_at` are guarded by its internal `_lock`; the
+  public accessors (`.state`, `.allow()`, `.to_dict()`) take it.  An
+  external read of the raw fields sees torn half-open transitions —
+  e.g. a broker routing on `br._state == "closed"` races the probe
+  bookkeeping and can double-admit through a half-open breaker.
+  Scope: the whole runtime package; only `CircuitBreaker` itself may
+  touch its own fields.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, ModuleContext, dotted_name
+
+# breaker fields guarded by CircuitBreaker._lock (resilience.py); the
+# distinctive names fire on any receiver, the generic `_state` only on
+# a non-self receiver (other classes own their own `self._state`)
+_BREAKER_FIELDS = frozenset({
+    "_state", "_consecutive_failures", "_opened_at", "_probe_started_at",
+})
+_CHECKPOINTS = frozenset({"checkpoint", "checkpoint_partial"})
+
+
+def _short(expr) -> str:
+    """Final dotted component of a call target / attribute chain."""
+    return dotted_name(expr).rsplit(".", 1)[-1]
+
+
+def _mentions_version(func_node: ast.AST) -> bool:
+    """Does any identifier, attribute, or string in `func_node` name a
+    version?  Deliberately loose: the pass enforces that the discipline
+    exists, not that it is correct."""
+    for n in ast.walk(func_node):
+        if isinstance(n, ast.Name) and "version" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "version" in n.attr.lower():
+            return True
+        if (
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and "version" in n.value.lower()
+        ):
+            return True
+    return False
+
+
+class BrokerDisciplinePass(LintPass):
+    name = "broker-discipline"
+    default_config = {
+        # GL2301 + GL2302: the cluster tier and its wire surface
+        "include": (
+            "spark_druid_olap_tpu/cluster/",
+            "spark_druid_olap_tpu/server.py",
+        ),
+        # GL2303: the whole runtime package — an unlocked breaker read
+        # is wrong wherever it appears
+        "breaker_include": ("spark_druid_olap_tpu/",),
+        "allow_files": (),
+        "merge_funcs": ("merge_groupby_states",),
+        # call-name fragments that mark a loop as RPC-issuing
+        "rpc_markers": ("urlopen", "rpc", "attempt", "fetch_group"),
+        # the one class allowed to touch the guarded fields (on self)
+        "breaker_owner": "CircuitBreaker",
+    }
+
+    def _in_tree(self, ctx: ModuleContext, key: str) -> bool:
+        if any(
+            ctx.relpath.startswith(p) for p in self.config["allow_files"]
+        ):
+            return False
+        return any(ctx.relpath.startswith(p) for p in self.config[key])
+
+    # each rule scopes itself (GL2303 is package-wide, the others
+    # cluster-tree only)
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    # -- GL2301 ---------------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        if not self._in_tree(ctx, "include"):
+            return
+        if _short(node.func) not in self.config["merge_funcs"]:
+            return
+        scope = ctx.scope.current_func
+        if scope is not None and _mentions_version(scope):
+            return
+        self.report(
+            ctx, node, "GL2301",
+            "replica state merged with no version check in the "
+            "enclosing function: ⊕ is only sound between states from "
+            "the same snapshot generation (dictionary domains differ "
+            "across generations, and a same-shape mismatch merges "
+            "silently wrong) — compare the replica's version against "
+            "the assignment's pinned version before folding",
+        )
+
+    # -- GL2302 ---------------------------------------------------------------
+
+    def _check_rpc_loop(self, node, ctx: ModuleContext):
+        if not self._in_tree(ctx, "include"):
+            return
+        markers = tuple(self.config["rpc_markers"])
+        rpc = None
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            short = _short(n.func).lstrip("_").lower()
+            if short in _CHECKPOINTS:
+                return  # checkpointed: the loop is killable + bounded
+            if rpc is None and any(m in short for m in markers):
+                rpc = n
+        if rpc is not None:
+            self.report(
+                ctx, node, "GL2302",
+                f"RPC-issuing loop ({_short(rpc.func)!r}) never reaches "
+                "resilience.checkpoint: the chaos matrix cannot inject "
+                "into it and a hung replica chain stalls unboundedly "
+                "instead of degrading to a stamped partial — call "
+                "checkpoint(<site>) inside the loop body",
+            )
+
+    def on_For(self, node: ast.For, ctx: ModuleContext):
+        self._check_rpc_loop(node, ctx)
+
+    def on_While(self, node: ast.While, ctx: ModuleContext):
+        self._check_rpc_loop(node, ctx)
+
+    # -- GL2303 ---------------------------------------------------------------
+
+    def on_Attribute(self, node: ast.Attribute, ctx: ModuleContext):
+        if node.attr not in _BREAKER_FIELDS:
+            return
+        if not self._in_tree(ctx, "breaker_include"):
+            return
+        recv = dotted_name(node.value)
+        if recv == "self":
+            cls = ctx.scope.current_class
+            if cls is not None and cls.name == self.config["breaker_owner"]:
+                return
+            # `self._state` in an unrelated class is that class's own
+            # field, not a breaker's
+            if node.attr == "_state":
+                return
+        self.report(
+            ctx, node, "GL2303",
+            f"breaker field {node.attr!r} read outside "
+            "CircuitBreaker's own lock: the raw fields are guarded by "
+            "the breaker's _lock and only coherent through the public "
+            "accessors (.state / .allow() / .to_dict()) — an external "
+            "read sees torn half-open transitions and can route through "
+            "a breaker mid-probe",
+        )

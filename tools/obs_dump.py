@@ -205,6 +205,23 @@ def render_receipts(rows: List[Tuple[str, dict]]) -> str:
             f"{rc.get('dispatch_count', 0):>4} "
             f"{rc.get('compiles', 0):>4}  {' '.join(bits)}".rstrip()
         )
+        cluster = (rc.get("cluster") or {}).get("nodes") or {}
+        if cluster:
+            # broker receipts (ISSUE 16): scatter/gather/merge wall
+            # attribution plus the per-historical RPC buckets the
+            # scatter span's rpc events aggregated
+            lines.append(
+                f"  cluster: scatter={rc.get('scatter_ms', 0):.2f}ms "
+                f"gather={rc.get('gather_ms', 0):.2f}ms "
+                f"merge={rc.get('cluster_merge_ms', 0):.2f}ms"
+            )
+            for node, b in sorted(cluster.items()):
+                lines.append(
+                    f"    {node[:24]:<24} {b.get('ms', 0):>8.2f}ms "
+                    f"rpcs={b.get('rpcs', 0)} ok={b.get('ok', 0)} "
+                    f"failed={b.get('failed', 0)} "
+                    f"segments={b.get('segments', 0)}"
+                )
     return "\n".join(lines)
 
 
