@@ -1,0 +1,88 @@
+"""Shared scaffolding for the graftlint test suites.
+
+`test_lint.py` (fixture matrix + repo gate + CLI contract) and
+`test_lint_engine.py` (interprocedural engine units) used to each grow
+their own make-temp-project helpers; this module is the single copy.
+Everything takes explicit paths — no pytest fixtures here — so helpers
+compose under sub-directories of one `tmp_path` (the matrix runs every
+fixture of a pass in its own subtree).
+"""
+
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.graftlint import run_lint  # noqa: E402
+from tools.graftlint.core import ModuleContext  # noqa: E402
+from tools.graftlint.engine import DataflowEngine  # noqa: E402
+from tools.graftlint.project import Project  # noqa: E402
+
+# the repo-gate target set: what tier-1 lints
+TARGETS = ["spark_druid_olap_tpu", "tests", "tools", "bench.py"]
+
+
+def write_tree(base, files):
+    """Materialize {relpath: dedented source} under `base`."""
+    for rel, src in files.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+
+def run_on(base, files, passes=None, config_overrides=None):
+    """Write a fixture tree and lint it whole."""
+    write_tree(base, files)
+    return run_lint(
+        str(base), ["."], pass_names=passes,
+        config_overrides=config_overrides,
+    )
+
+
+def cli(args, cwd):
+    """Invoke `python -m tools.graftlint` as a subprocess from `cwd`,
+    with the repo root importable."""
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        capture_output=True, text=True, cwd=cwd,
+        env={**os.environ, "PYTHONPATH": ROOT},
+    )
+
+
+def git_in(cwd, *args):
+    return subprocess.run(
+        ["git", *args], cwd=cwd, capture_output=True, text=True,
+    )
+
+
+def project_of(base, files):
+    """Write a fixture tree and build a finalized Project over it (the
+    unit-test entry to the symbol/call-graph layer, bypassing passes)."""
+    write_tree(base, files)
+    project = Project(str(base))
+    for rel in sorted(files):
+        path = str(base / rel)
+        with open(path) as f:
+            src = f.read()
+        project.add_module(ModuleContext(path, rel, src, ast.parse(src)))
+    project.finalize()
+    return project
+
+
+def engine_of(base, files):
+    """`project_of` plus the interprocedural engine on top."""
+    project = project_of(base, files)
+    return project, DataflowEngine(project)
+
+
+def eval_in(project, relpath, source_expr, env=None):
+    """const_eval an expression in a module's namespace."""
+    module = project.modules[relpath]
+    return project.const_eval(
+        module, ast.parse(source_expr, mode="eval").body, env
+    )

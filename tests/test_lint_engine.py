@@ -1,0 +1,592 @@
+"""Unit tests for tools/graftlint/engine.py — the interprocedural layer.
+
+test_lint.py exercises the GL24xx/GL25xx passes end-to-end through the
+fixture matrix; this file tests the DataflowEngine primitives those
+passes (and `--changed`'s reverse-dependency closure) are built on:
+
+- the canonical function index and module dependency graph,
+- reverse closure (what a changed file can affect),
+- thread-entry detection and reachability, including method calls
+  through typed receivers (module singletons, annotated parameters),
+- majority-rule lock-ownership inference,
+- the forward order-taint lattice: sources, sanitizers (including the
+  in-place `.sort()` form), comprehension absorption, and taint flowing
+  interprocedurally through returns and keyword arguments.
+
+The final section anchors the analyses against the shipped tree's real
+idioms: the broker's sort-before-fold gather is reproduced as a CLEAN
+fixture (the exemplar the GL24xx pass exists to protect) and its
+arrival-order mutation as the VIOLATING twin — the regression pair for
+the cluster/ fold-determinism audit this pass now automates.
+"""
+
+from lint_harness import engine_of, project_of, run_on
+
+
+def _fn(project, relpath, qualname):
+    return project.modules[relpath].functions[qualname]
+
+
+# ---------------------------------------------------------------------------
+# symbol table + module dependency graph
+# ---------------------------------------------------------------------------
+
+
+def test_fn_by_canonical_indexes_functions_and_methods(tmp_path):
+    _, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """
+            def top():
+                pass
+
+            class C:
+                def meth(self):
+                    pass
+        """,
+    })
+    idx = engine.fn_by_canonical
+    assert "pkg.a.top" in idx
+    assert "pkg.a.C.meth" in idx
+    assert idx["pkg.a.C.meth"].qualname == "C.meth"
+
+
+def test_import_graph_sees_alias_and_call_edges(tmp_path):
+    _, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/leaf.py": "def helper():\n    return 1\n",
+        # alias edge: from-import binds pkg.leaf.helper
+        "pkg/mid.py": """
+            from .leaf import helper
+
+            def use():
+                return helper()
+        """,
+        # call edge without a leading from-import of the symbol itself
+        "pkg/top.py": """
+            from . import mid
+
+            def drive():
+                return mid.use()
+        """,
+        "pkg/island.py": "x = 1\n",
+    })
+    g = engine.import_graph
+    assert "pkg/leaf.py" in g["pkg/mid.py"]
+    assert "pkg/mid.py" in g["pkg/top.py"]
+    assert g["pkg/island.py"] == set()
+
+
+def test_reverse_closure_is_transitive_and_scoped(tmp_path):
+    _, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/leaf.py": "VALUE = 1\n",
+        "pkg/mid.py": "from .leaf import VALUE\n\nM = VALUE\n",
+        "pkg/top.py": "from .mid import M\n\nT = M\n",
+        "pkg/island.py": "x = 1\n",
+    })
+    closure = engine.reverse_closure(["pkg/leaf.py"])
+    assert closure == {"pkg/leaf.py", "pkg/mid.py", "pkg/top.py"}
+    # nothing imports top: its closure is itself
+    assert engine.reverse_closure(["pkg/top.py"]) == {"pkg/top.py"}
+    # unknown paths pass through silently (files outside the tree)
+    assert engine.reverse_closure(["nope.py"]) == set()
+
+
+# ---------------------------------------------------------------------------
+# thread roots + reachability
+# ---------------------------------------------------------------------------
+
+_THREADED = {
+    "pkg/__init__.py": "",
+    "pkg/workers.py": """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def worker():
+            _shared_step()
+
+        def _shared_step():
+            pass
+
+        def pool_task(x):
+            return x
+
+        def untouched():
+            pass
+
+        def spawn():
+            threading.Thread(target=worker).start()
+            with ThreadPoolExecutor() as ex:
+                ex.submit(pool_task, 1)
+
+        class Loop(threading.Thread):
+            def run(self):
+                self.tick()
+
+            def tick(self):
+                pass
+
+        class Handler:
+            def do_GET(self):
+                pass
+    """,
+}
+
+
+def test_thread_roots_cover_targets_submits_run_and_handlers(tmp_path):
+    _, engine = engine_of(tmp_path, _THREADED)
+    roots = engine.thread_roots
+    assert ("pkg/workers.py", "worker") in roots
+    assert ("pkg/workers.py", "pool_task") in roots
+    assert ("pkg/workers.py", "Loop.run") in roots
+    assert ("pkg/workers.py", "Handler.do_GET") in roots
+    assert ("pkg/workers.py", "untouched") not in roots
+    assert ("pkg/workers.py", "spawn") not in roots
+
+
+def test_thread_reachability_closes_over_calls(tmp_path):
+    project, engine = engine_of(tmp_path, _THREADED)
+    assert engine.is_thread_reachable(
+        _fn(project, "pkg/workers.py", "_shared_step")
+    )
+    assert engine.is_thread_reachable(
+        _fn(project, "pkg/workers.py", "Loop.tick")
+    )
+    assert not engine.is_thread_reachable(
+        _fn(project, "pkg/workers.py", "untouched")
+    )
+
+
+def test_thread_reachability_through_typed_singleton_receiver(tmp_path):
+    """`REGISTRY.flush()` is invisible to the symbolic call graph (the
+    receiver is a value, not a name) — the typed-receiver edges close
+    the gap, across modules."""
+    project, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/state.py": """
+            class Registry:
+                def flush(self):
+                    self._drain()
+
+                def _drain(self):
+                    pass
+
+
+            REGISTRY = Registry()
+        """,
+        "pkg/daemon.py": """
+            import threading
+
+            from .state import REGISTRY
+
+            def beat():
+                REGISTRY.flush()
+
+            def start():
+                threading.Thread(target=beat).start()
+        """,
+    })
+    assert engine.is_thread_reachable(
+        _fn(project, "pkg/state.py", "Registry.flush")
+    )
+    assert engine.is_thread_reachable(
+        _fn(project, "pkg/state.py", "Registry._drain")
+    )
+
+
+# ---------------------------------------------------------------------------
+# lock-ownership inference
+# ---------------------------------------------------------------------------
+
+
+def _cc(tmp_path, body):
+    _, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/mod.py": body,
+    })
+    return engine.concurrency.get(("pkg.mod", "C"))
+
+
+def test_ownership_majority_guarded_wins(tmp_path):
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n = 0
+
+            def c(self):
+                self._n = 5
+    """)
+    assert cc.owner == {"_n": "_lock"}
+
+
+def test_ownership_tie_stays_unowned(tmp_path):
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def c(self):
+                self._n = 5
+    """)
+    assert cc.owner == {}
+
+
+def test_ownership_ignores_init_writes(tmp_path):
+    """__init__ runs before the object escapes: its unguarded writes
+    must not out-vote a consistently guarded steady state."""
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._n = 0
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+    """)
+    assert cc.owner == {"_n": "_lock"}
+
+
+def test_ownership_picks_majority_lock_of_two(tmp_path):
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._aux = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n += 1
+
+            def c(self):
+                with self._aux:
+                    self._n += 1
+    """)
+    assert cc.owner == {"_n": "_lock"}
+
+
+# ---------------------------------------------------------------------------
+# order-taint lattice
+# ---------------------------------------------------------------------------
+
+
+def _hits(tmp_path, body, fn="f"):
+    project, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/mod.py": body,
+    })
+    return engine.taint().analyze(_fn(project, "pkg/mod.py", fn))
+
+
+def test_taint_through_callee_return(tmp_path):
+    hits = _hits(tmp_path, """
+        from concurrent.futures import as_completed
+
+        def _collect(futs):
+            return [f.result() for f in as_completed(futs)]
+
+        def f(engine, q, ds, futs):
+            state = None
+            for r in _collect(futs):
+                state = engine.merge_groupby_states(q, ds, state, r)
+            return state
+    """)
+    assert {h.kind for h in hits} == {"loop-order"}
+    assert any("as_completed" in l for h in hits for l in h.labels)
+
+
+def test_taint_through_callee_kwargs_to_sink(tmp_path):
+    hits = _hits(tmp_path, """
+        from concurrent.futures import as_completed
+
+        def _fold(engine, q, ds, items=None):
+            state = None
+            for r in items:
+                state = engine.merge_sketch_states(q, ds, state, r)
+            return state
+
+        def f(engine, q, ds, futs):
+            rs = [x.result() for x in as_completed(futs)]
+            return _fold(engine, q, ds, items=rs)
+    """)
+    assert {h.kind for h in hits} == {"interprocedural"}
+    assert hits[0].via == "pkg.mod._fold"
+
+
+def test_sorted_sanitizes_the_gather(tmp_path):
+    assert _hits(tmp_path, """
+        from concurrent.futures import as_completed
+
+        def f(engine, q, ds, futs):
+            rs = [x.result() for x in as_completed(futs)]
+            state = None
+            for r in sorted(rs, key=lambda t: t[0]):
+                state = engine.merge_groupby_states(q, ds, state, r)
+            return state
+    """) == []
+
+
+def test_inplace_sort_sanitizes_the_receiver(tmp_path):
+    assert _hits(tmp_path, """
+        import os
+
+        def f(engine, q, ds, root):
+            names = list(os.listdir(root))
+            names.sort()
+            state = None
+            for n in names:
+                state = engine.merge_groupby_states(q, ds, state, n)
+            return state
+    """) == []
+
+
+def test_set_comprehension_is_itself_a_source(tmp_path):
+    hits = _hits(tmp_path, """
+        def f(engine, q, ds, cols):
+            state = None
+            for c in {c for c in cols}:
+                state = engine.merge_groupby_states(q, ds, state, c)
+            return state
+    """)
+    assert {h.kind for h in hits} == {"loop-order"}
+
+
+def test_dict_comprehension_absorbs_order_taint(tmp_path):
+    """Rebuilding into a dict keyed deterministically gives insertion
+    order — still arrival order here, but iterating a dict is NOT a
+    source, so the absorbed value folds clean (CPython dicts are
+    insertion-ordered; flagging every dict walk would bury the signal)."""
+    assert _hits(tmp_path, """
+        def f(engine, q, ds, by_key):
+            state = None
+            for k, v in by_key.items():
+                state = engine.merge_groupby_states(q, ds, state, v)
+            return state
+    """) == []
+
+
+def test_param_taint_never_fires_locally(tmp_path):
+    """A fold over a plain parameter is the CALLEE's half of an
+    interprocedural finding — it must not self-report (the summary
+    carries it to call sites that pass tainted data)."""
+    assert _hits(tmp_path, """
+        def f(engine, q, ds, items):
+            state = None
+            for r in items:
+                state = engine.merge_groupby_states(q, ds, state, r)
+            return state
+    """) == []
+
+
+def test_summary_records_param_to_sink_and_return_taint(tmp_path):
+    project, engine = engine_of(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/mod.py": """
+            from concurrent.futures import as_completed
+
+            def sink_half(engine, q, ds, items):
+                state = None
+                for r in items:
+                    state = engine.merge_groupby_states(q, ds, state, r)
+                return state
+
+            def tainted_return(futs):
+                return [f.result() for f in as_completed(futs)]
+        """,
+    })
+    taint = engine.taint()
+    s = taint.summary(_fn(project, "pkg/mod.py", "sink_half"))
+    assert "items" in s.params_to_sink
+    s = taint.summary(_fn(project, "pkg/mod.py", "tainted_return"))
+    assert s.returns_tainted
+    assert any("as_completed" in l for l in s.return_labels)
+
+
+# ---------------------------------------------------------------------------
+# regression anchors: the shipped tree's real idioms, both halves
+# ---------------------------------------------------------------------------
+
+# the broker's gather (cluster/broker.py): collect in completion order,
+# fold in sorted assignment order — the exemplar GL24xx protects.  The
+# violating twin folds at arrival; one edit distance from the real code.
+_BROKER_GATHER_CLEAN = {
+    "spark_druid_olap_tpu/cluster/mini_broker.py": """
+        from concurrent.futures import as_completed
+
+        def gather(engine, q, ds, futs, expect_version):
+            results = []
+            for fut in as_completed(futs):
+                results.append(fut.result())
+            state = None
+            for r in sorted(results, key=lambda t: t["chain"]):
+                if r["version"] != expect_version:
+                    continue
+                state = engine.merge_groupby_states(
+                    q, ds, state, r["state"]
+                )
+            return state
+    """,
+}
+
+_BROKER_GATHER_ARRIVAL = {
+    "spark_druid_olap_tpu/cluster/mini_broker.py": """
+        from concurrent.futures import as_completed
+
+        def gather(engine, q, ds, futs, expect_version):
+            state = None
+            for fut in as_completed(futs):
+                r = fut.result()
+                if r["version"] != expect_version:
+                    continue
+                state = engine.merge_groupby_states(
+                    q, ds, state, r["state"]
+                )
+            return state
+    """,
+}
+
+
+def test_broker_gather_exemplar_is_clean(tmp_path):
+    res = run_on(
+        tmp_path, _BROKER_GATHER_CLEAN, passes=["fold-determinism"]
+    )
+    assert res.new == [], [f.render() for f in res.new]
+
+
+def test_broker_gather_arrival_order_twin_is_flagged(tmp_path):
+    res = run_on(
+        tmp_path, _BROKER_GATHER_ARRIVAL, passes=["fold-determinism"]
+    )
+    assert {f.code for f in res.new} == {"GL2401"}
+    assert "as_completed" in res.new[0].message
+
+
+def test_breaker_style_guarded_class_is_clean(tmp_path):
+    """resilience.py's CircuitBreaker shape: every state transition
+    under the lock, public snapshot property — the GL25xx clean anchor."""
+    res = run_on(tmp_path, {
+        "spark_druid_olap_tpu/mini_resilience.py": """
+            import threading
+
+            class CircuitBreaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = "closed"
+                    self._failures = 0
+
+                def record_failure(self):
+                    with self._lock:
+                        self._failures += 1
+                        if self._failures >= 3:
+                            self._state = "open"
+
+                def record_ok(self):
+                    with self._lock:
+                        self._failures = 0
+                        self._state = "closed"
+
+                @property
+                def state(self):
+                    with self._lock:
+                        return self._state
+        """,
+    }, passes=["shared-state-races"])
+    assert res.new == [], [f.render() for f in res.new]
+
+
+def test_breaker_style_off_lock_transition_is_flagged(tmp_path):
+    res = run_on(tmp_path, {
+        "spark_druid_olap_tpu/mini_resilience.py": """
+            import threading
+
+            class CircuitBreaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._failures = 0
+
+                def record_failure(self):
+                    with self._lock:
+                        self._failures += 1
+
+                def record_ok(self):
+                    with self._lock:
+                        self._failures = 0
+
+                def reset_unsafely(self):
+                    self._failures = 0
+        """,
+    }, passes=["shared-state-races"])
+    assert {f.code for f in res.new} == {"GL2501"}
+    assert "_lock" in res.new[0].message
+
+
+def test_pragma_and_allow_config_suppress_races(tmp_path):
+    files = {
+        "spark_druid_olap_tpu/mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def a(self):
+                    with self._lock:
+                        self._n += 1
+
+                def b(self):
+                    with self._lock:
+                        self._n += 1
+
+                def fast_path(self):
+                    self._n = 0  # graftlint: disable=shared-state-races -- benchmark-only reset
+        """,
+    }
+    res = run_on(tmp_path, files, passes=["shared-state-races"])
+    assert res.new == [], [f.render() for f in res.new]
+    # same code without the pragma, allow-listed via config instead
+    files_plain = {
+        "spark_druid_olap_tpu/mod.py": files[
+            "spark_druid_olap_tpu/mod.py"
+        ].replace(
+            "  # graftlint: disable=shared-state-races -- "
+            "benchmark-only reset",
+            "",
+        ),
+    }
+    res = run_on(
+        tmp_path / "allow", files_plain, passes=["shared-state-races"],
+        config_overrides={"shared-state-races": {"allow": [
+            ["spark_druid_olap_tpu.mod", "C", "_n"],
+        ]}},
+    )
+    assert res.new == [], [f.render() for f in res.new]

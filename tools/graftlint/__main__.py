@@ -8,9 +8,18 @@ standard invocation).  Exit codes: 0 clean, 1 new findings, 2 stale
 baseline entries or configuration errors.
 
 `--changed [BASE]` lints only .py files that differ from
-`git merge-base HEAD BASE` (default BASE: main) plus untracked files —
-the fast pre-commit loop.  Positional paths, when given, scope the
-changed set; with none, every changed file is linted.
+`git merge-base HEAD BASE` (default BASE: main) plus untracked files,
+PLUS their reverse-dependency closure — every scanned module that
+(transitively) imports a changed file, computed from the engine's
+module dependency graph, because a changed contract can create or fix
+findings in its importers.  The fast pre-commit loop
+(`tools/lint_precommit.sh`).  Positional paths, when given, scope both
+the changed set and the closure.
+
+`--stats` emits a one-line machine-readable JSON summary (per-pass
+wall-time, per-pass finding counts, totals) so lint cost inside tier-1
+is attributable and CI can diff findings structurally; with `--format
+json` the same object is embedded under a "stats" key.
 
 `--format github` emits GitHub-Actions `::error file=...,line=...`
 workflow annotations so CI findings are clickable in the log; `--format
@@ -78,6 +87,35 @@ def git_changed_files(root: str, base: str):
     return merge_base, sorted(set(out))
 
 
+def expand_reverse_closure(root, changed):
+    """Changed files (root-relative posix) plus every module in the
+    repo tree that transitively imports one of them.  Builds a
+    throwaway project over the whole tree — parse only, no call-graph
+    finalize: module-level import edges are what the dependency graph
+    needs, and a changed callee reached WITHOUT an import (same module)
+    is already in the changed set.  Unparseable/foreign files are
+    skipped; changed files outside the scanned tree pass through
+    unchanged (run_lint reports on them directly)."""
+    import ast as _ast
+
+    from .core import ModuleContext, iter_target_files, _relpath
+    from .engine import DataflowEngine
+    from .project import Project
+
+    project = Project(root)
+    for path in iter_target_files(root, ["."]):
+        rel = _relpath(root, path)
+        try:
+            with open(path) as f:
+                source = f.read()
+            tree = _ast.parse(source, filename=path)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+        project.add_module(ModuleContext(path, rel, source, tree))
+    closure = DataflowEngine(project).reverse_closure(changed)
+    return sorted(set(changed) | closure)
+
+
 def _scope_changed(changed, scope_paths, root):
     """Restrict the changed set to files under the given paths.  Scope
     paths are normalized to root-relative posix form first so `./tools`
@@ -95,6 +133,30 @@ def _scope_changed(changed, scope_paths, root):
         f for f in changed
         if any(f == p or f.startswith(p + "/") for p in prefixes)
     ]
+
+
+def _stats_doc(result) -> dict:
+    """Machine-readable run summary: what CI diffs and the tier-1 cost
+    budget watches.  `total_seconds` is the sum of per-pass handler +
+    finish time plus the shared parse/project build."""
+    per_pass_findings: dict = {}
+    for f in list(result.new) + [f for f, _ in result.baselined]:
+        per_pass_findings[f.pass_name] = (
+            per_pass_findings.get(f.pass_name, 0) + 1
+        )
+    return {
+        "files_scanned": result.files_scanned,
+        "passes": len(result.pass_names),
+        "findings_new": len(result.new),
+        "findings_baselined": len(result.baselined),
+        "stale_baseline": len(result.stale),
+        "total_seconds": round(sum(result.timings.values()), 3),
+        "per_pass_seconds": {
+            name: round(secs, 4)
+            for name, secs in sorted(result.timings.items())
+        },
+        "per_pass_findings": dict(sorted(per_pass_findings.items())),
+    }
 
 
 def _emit_github(result) -> None:
@@ -232,7 +294,14 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--changed", nargs="?", const="main", default=None, metavar="BASE",
         help="lint only files differing from `git merge-base HEAD BASE` "
-             "(default BASE: main) plus untracked files",
+             "(default BASE: main) plus untracked files, plus their "
+             "reverse-dependency closure (modules importing them)",
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="emit a machine-readable JSON stats line (per-pass seconds "
+             "+ finding counts + totals); implies --profile timing "
+             "collection",
     )
     ap.add_argument(
         "--profile", action="store_true",
@@ -254,12 +323,20 @@ def main(argv=None) -> int:
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
     try:
         if args.changed is not None:
-            merge_base, targets = git_changed_files(root, args.changed)
+            merge_base, changed = git_changed_files(root, args.changed)
+            changed = _scope_changed(changed, args.paths, root)
+            targets = (
+                expand_reverse_closure(root, changed) if changed else []
+            )
+            # the closure stays inside the user's scope too: positional
+            # paths are a hard boundary on what gets linted
             targets = _scope_changed(targets, args.paths, root)
             if fmt == "text":
+                extra = len(targets) - len(changed)
+                dep = f" (+{extra} reverse-dependent)" if extra else ""
                 print(
-                    f"graftlint --changed: {len(targets)} file(s) differ "
-                    f"from merge-base {merge_base[:12]}"
+                    f"graftlint --changed: {len(changed)} file(s) differ "
+                    f"from merge-base {merge_base[:12]}{dep}"
                 )
         else:
             if not args.paths:
@@ -267,7 +344,8 @@ def main(argv=None) -> int:
             targets = args.paths
         result = run_lint(
             root, targets, pass_names=args.passes,
-            baseline_path=baseline_path, profile=args.profile,
+            baseline_path=baseline_path,
+            profile=args.profile or args.stats,
         )
     except LintConfigError as e:
         print(f"graftlint: {e}", file=sys.stderr)
@@ -288,7 +366,10 @@ def main(argv=None) -> int:
         return 0
 
     if fmt == "json":
-        print(json.dumps(result.to_dict(), indent=2))
+        doc = result.to_dict()
+        if args.stats:
+            doc["stats"] = _stats_doc(result)
+        print(json.dumps(doc, indent=2))
     elif fmt == "github":
         _emit_github(result)
     else:
@@ -309,6 +390,10 @@ def main(argv=None) -> int:
             f"{len(result.stale)} stale baseline entr"
             f"{'y' if len(result.stale) == 1 else 'ies'}"
         )
+    if args.stats and fmt != "json":
+        print("graftlint --stats " + json.dumps(
+            _stats_doc(result), sort_keys=True
+        ))
     if result.new:
         return 1
     if result.stale:

@@ -316,6 +316,7 @@ class LintPass:
         self.config = cfg
         self._sink: List[Finding] = []
         self.project = None  # bound by the runner before walking
+        self.engine = None  # interprocedural engine, bound with project
 
     # -- lifecycle (runner-managed) ------------------------------------------
 
@@ -324,6 +325,9 @@ class LintPass:
 
     def bind_project(self, project) -> None:
         self.project = project
+
+    def bind_engine(self, engine) -> None:
+        self.engine = engine
 
     def finish(self, project) -> None:
         """Called once after all modules are walked (project complete)."""
@@ -577,6 +581,7 @@ def run_lint(
     give every pass a `finish(project)` turn for cross-module checks —
     and reconcile all findings against the grandfathering baseline.
     `profile=True` accumulates per-pass seconds into `result.timings`."""
+    from .engine import DataflowEngine
     from .passes import build_passes
     from .project import Project
 
@@ -613,8 +618,12 @@ def run_lint(
     project.finalize()
     if timings is not None:
         timings["core:parse+project"] = time.perf_counter() - t_start
+    # one engine per run, built lazily on top of the finalized project:
+    # a run whose passes never ask interprocedural questions pays nothing
+    engine = DataflowEngine(project)
     for p in passes:
         p.bind_project(project)
+        p.bind_engine(engine)
     for ctx in ctxs:
         active = [p for p in passes if p.applies_to(ctx.relpath)]
         if active:

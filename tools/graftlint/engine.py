@@ -1,0 +1,1086 @@
+"""graftlint interprocedural engine: project-wide dataflow for passes.
+
+PR 2 gave every pass one parse and one walk; PR 5 added the project
+layer (symbol tables, call graph, constant propagation).  What neither
+can answer is a FLOW question that crosses functions and files: does the
+value this loop folds come from a nondeterministically-ordered producer
+three calls upstream?  Which lock does this class's own code believe
+guards this field, and who touches it off that lock from another
+module?  This module is that layer — built once per run on top of the
+finalized `project.Project` and handed to every pass as `self.engine`:
+
+  * **Module dependency graph** — which scanned modules import (or call
+    into) which, with reverse edges; `reverse_closure(...)` is the
+    `--changed` mode's "changed files plus everything whose contracts
+    they can break" set.
+  * **Thread-entry reachability** — functions handed to
+    `threading.Thread(target=...)`, executor `submit`/`map`, timers,
+    and `do_*` HTTP handler methods are thread roots; the transitive
+    call-graph closure over them is the code that actually runs
+    concurrently.  Race checks scope their read-side findings to it.
+  * **Lock-ownership inference** — for every scanned class, the engine
+    learns which `self.<lock>` guards which fields from the MAJORITY
+    guarded-access pattern of the class's own writes (project-wide, not
+    per-file): a field written under `with self._lock:` more often than
+    not is owned by that lock, and the minority unguarded accesses are
+    the race candidates (passes/shared_state_races.py, GL25xx).  The
+    engine also resolves module-level singletons (`X = Cls(...)`) and
+    class-annotated parameters so an off-lock write in ANOTHER module
+    still resolves against the owning class.
+  * **Forward order-taint lattice** — a small sources -> sanitizers ->
+    sinks dataflow (passes/fold_determinism.py, GL24xx).  Sources are
+    producers whose iteration order is not deterministic across
+    processes/runs: `set`/`frozenset` iteration (PYTHONHASHSEED),
+    `os.listdir`/`glob` (directory order), `as_completed`-style gathers
+    (thread completion order).  Plain `dict` iteration is NOT a source
+    by itself — CPython dicts are insertion-ordered, and this codebase's
+    insertion orders are deterministic — but a dict/list ACCUMULATED
+    under tainted iteration order inherits the taint, which is exactly
+    the nondeterministically-ordered-dict case that matters.
+    `sorted(...)`/`.sort()` (and configurable canonicalizers) are
+    sanitizers; dict/set comprehensions absorb order-taint (rebuilding
+    an unordered container is order-insensitive).  Sinks are the
+    ⊕-merge folds whose float/sketch algebra is order-sensitive.
+    Summaries make it interprocedural: a function whose RETURN is
+    order-tainted is a source at its call sites, and a parameter that
+    reaches a sink unsanitized inside a callee fires at the call site
+    that passes it tainted (positional or keyword).
+
+Everything stays best-effort static resolution with the project layer's
+contract: unresolvable means silent, never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .core import call_name, dotted_name
+from .project import FunctionInfo, ModuleInfo, Project
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# container methods that mutate in place (an append under tainted
+# iteration order makes the container arrival-ordered)
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "appendleft",
+}
+
+
+def _is_lockish(attr: str) -> bool:
+    return "lock" in attr.lower() or "cond" in attr.lower()
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.<attr>` -> attr, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _walk_own(node: ast.AST):
+    """Walk a statement/function body WITHOUT descending into nested
+    function bodies (a closure does not run when its definer does)."""
+    stack = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNC_NODES) and not first:
+            continue
+        first = False
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# Access records + lock ownership
+# ---------------------------------------------------------------------------
+
+
+class FieldAccess:
+    """One access to `<instance>.<field>` inside a function."""
+
+    __slots__ = ("fi", "node", "kind", "held", "external")
+
+    def __init__(self, fi: FunctionInfo, node: ast.AST, kind: str,
+                 held: FrozenSet[str], external: bool = False):
+        self.fi = fi
+        self.node = node
+        self.kind = kind  # "write" | "mutate" | "iter"
+        self.held = held  # lock attrs lexically held at the access
+        self.external = external  # via singleton/annotated param, not self
+
+
+class ClassConcurrency:
+    """Learned lock-ownership facts for one class."""
+
+    __slots__ = ("modname", "clsname", "lock_attrs", "owner", "accesses",
+                 "guarded_writes", "unguarded_writes")
+
+    def __init__(self, modname: str, clsname: str):
+        self.modname = modname
+        self.clsname = clsname
+        self.lock_attrs: Set[str] = set()
+        # field -> owning lock attr (majority-guarded fields only)
+        self.owner: Dict[str, str] = {}
+        # field -> [FieldAccess] (every non-__init__ access recorded)
+        self.accesses: Dict[str, List[FieldAccess]] = {}
+        # field -> {lock attr -> guarded write count}
+        self.guarded_writes: Dict[str, Dict[str, int]] = {}
+        self.unguarded_writes: Dict[str, int] = {}
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.modname, self.clsname)
+
+
+class DataflowEngine:
+    """Interprocedural queries over a finalized Project.  Everything is
+    built lazily and cached: a `--pass jit-cache` run never pays for the
+    taint lattice."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._fn_by_canon: Optional[Dict[str, FunctionInfo]] = None
+        self._imports: Optional[Dict[str, Set[str]]] = None
+        self._rimports: Optional[Dict[str, Set[str]]] = None
+        self._thread_roots: Optional[Set[Tuple[str, str]]] = None
+        self._thread_reachable: Optional[Set[Tuple[str, str]]] = None
+        self._concurrency: Optional[Dict[Tuple[str, str],
+                                         ClassConcurrency]] = None
+        self._instances: Optional[Dict[Tuple[str, str],
+                                       Tuple[str, str]]] = None
+
+    # -- canonical function index --------------------------------------------
+
+    @property
+    def fn_by_canonical(self) -> Dict[str, FunctionInfo]:
+        if self._fn_by_canon is None:
+            self._fn_by_canon = {}
+            for info in self.project.modules.values():
+                for fi in info.functions.values():
+                    self._fn_by_canon[f"{info.modname}.{fi.qualname}"] = fi
+        return self._fn_by_canon
+
+    # -- module dependency graph (imports + call edges) ------------------------
+
+    def _module_of_canonical(self, canon: str) -> Optional[ModuleInfo]:
+        """Longest-prefix project module of a canonical dotted name."""
+        by_name = self.project.by_name
+        parts = canon.split(".")
+        for cut in range(len(parts), 0, -1):
+            hit = by_name.get(".".join(parts[:cut]))
+            if hit is not None:
+                return hit
+        return None
+
+    @property
+    def import_graph(self) -> Dict[str, Set[str]]:
+        """relpath -> relpaths it imports or calls into (project-only)."""
+        if self._imports is None:
+            graph: Dict[str, Set[str]] = {
+                rel: set() for rel in self.project.modules
+            }
+            for rel, info in self.project.modules.items():
+                for target in info.import_aliases.values():
+                    dep = self._module_of_canonical(target)
+                    if dep is not None and dep.relpath != rel:
+                        graph[rel].add(dep.relpath)
+            for (rel, _qual), callees in self.project.call_graph.items():
+                for canon in callees:
+                    dep = self._module_of_canonical(canon)
+                    if dep is not None and dep.relpath != rel:
+                        graph[rel].add(dep.relpath)
+            self._imports = graph
+        return self._imports
+
+    @property
+    def reverse_import_graph(self) -> Dict[str, Set[str]]:
+        if self._rimports is None:
+            rg: Dict[str, Set[str]] = {
+                rel: set() for rel in self.project.modules
+            }
+            for rel, deps in self.import_graph.items():
+                for dep in deps:
+                    rg.setdefault(dep, set()).add(rel)
+            self._rimports = rg
+        return self._rimports
+
+    def reverse_closure(self, relpaths: Iterable[str]) -> Set[str]:
+        """The given files plus every scanned module that (transitively)
+        imports or calls into them — the set whose findings a change to
+        `relpaths` can create or fix."""
+        rg = self.reverse_import_graph
+        seen: Set[str] = set()
+        frontier = [r for r in relpaths if r in self.project.modules]
+        seen.update(frontier)
+        while frontier:
+            nxt: List[str] = []
+            for rel in frontier:
+                for dep in rg.get(rel, ()):
+                    if dep not in seen:
+                        seen.add(dep)
+                        nxt.append(dep)
+            frontier = nxt
+        return seen
+
+    # -- thread-entry reachability ---------------------------------------------
+
+    def _resolve_target_expr(
+        self, module: ModuleInfo, expr: ast.AST, cls
+    ) -> Optional[FunctionInfo]:
+        name = dotted_name(expr)
+        if not name:
+            return None
+        return self.project.resolve_function(module, name, cls=cls)
+
+    @property
+    def thread_roots(self) -> Set[Tuple[str, str]]:
+        """(relpath, qualname) of functions that are thread entry
+        points: Thread/Timer targets, executor submit/map callables,
+        `do_*` HTTP handler methods, and `run` methods of classes whose
+        bases mention Thread."""
+        if self._thread_roots is not None:
+            return self._thread_roots
+        roots: Set[Tuple[str, str]] = set()
+        for rel, info in self.project.modules.items():
+            for qual, fi in info.functions.items():
+                leaf = qual.rsplit(".", 1)[-1]
+                if fi.cls is not None and leaf.startswith("do_"):
+                    roots.add((rel, qual))
+                if fi.cls is not None and leaf == "run" and any(
+                    "Thread" in (dotted_name(b) or "")
+                    for b in fi.cls.bases
+                ):
+                    roots.add((rel, qual))
+                for node in _walk_own(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    canon = self.project.canonical(
+                        info, call_name(node)
+                    )
+                    target_expr: Optional[ast.AST] = None
+                    if canon in (
+                        "threading.Thread", "threading.Timer",
+                        "_thread.start_new_thread",
+                    ):
+                        for kw in node.keywords:
+                            if kw.arg in ("target", "function"):
+                                target_expr = kw.value
+                        if target_expr is None and node.args:
+                            target_expr = node.args[-1]
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("submit", "map")
+                        and node.args
+                    ):
+                        target_expr = node.args[0]
+                    if target_expr is None:
+                        continue
+                    t = self._resolve_target_expr(
+                        info, target_expr, fi.cls
+                    )
+                    if t is not None:
+                        roots.add((t.module.relpath, t.qualname))
+        self._thread_roots = roots
+        return roots
+
+    def _typed_call_edges(
+        self, info: ModuleInfo, fi: FunctionInfo
+    ) -> List[Tuple[str, str]]:
+        """Call targets the symbolic call graph cannot see: method calls
+        through a typed receiver (`SINGLETON.meth(...)`, or `x.meth(...)`
+        where `x` is a class-annotated parameter)."""
+        bases = self.typed_bases(info, fi)
+        if not bases:
+            return []
+        out: List[Tuple[str, str]] = []
+        for node in _walk_own(fi.node):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+            ):
+                continue
+            entry = bases.get(node.func.value.id)
+            if entry is None:
+                continue
+            owner = self.project.by_name.get(entry[0])
+            if owner is None:
+                continue
+            target = owner.functions.get(f"{entry[1]}.{node.func.attr}")
+            if target is not None:
+                out.append((target.module.relpath, target.qualname))
+        return out
+
+    @property
+    def thread_reachable(self) -> Set[Tuple[str, str]]:
+        """Thread roots plus everything reachable from them through the
+        intra-project call graph, including method calls through typed
+        receivers (singletons / annotated parameters)."""
+        if self._thread_reachable is not None:
+            return self._thread_reachable
+        seen: Set[Tuple[str, str]] = set(self.thread_roots)
+        frontier = list(seen)
+        while frontier:
+            key = frontier.pop()
+            info = self.project.modules.get(key[0])
+            fi = info.functions.get(key[1]) if info is not None else None
+            succ: List[Tuple[str, str]] = []
+            for callee in self.project.call_graph.get(key, ()):
+                cfi = self.fn_by_canonical.get(callee)
+                if cfi is not None:
+                    succ.append((cfi.module.relpath, cfi.qualname))
+            if fi is not None:
+                succ.extend(self._typed_call_edges(info, fi))
+            for k2 in succ:
+                if k2 not in seen:
+                    seen.add(k2)
+                    frontier.append(k2)
+        self._thread_reachable = seen
+        return seen
+
+    def is_thread_reachable(self, fi: FunctionInfo) -> bool:
+        return (fi.module.relpath, fi.qualname) in self.thread_reachable
+
+    # -- lock-ownership inference ----------------------------------------------
+
+    @property
+    def concurrency(self) -> Dict[Tuple[str, str], ClassConcurrency]:
+        """Per-class learned lock ownership, keyed (modname, clsname)."""
+        if self._concurrency is None:
+            self._concurrency = {}
+            for info in self.project.modules.values():
+                for qual, fi in info.functions.items():
+                    if fi.cls is None:
+                        continue
+                    self._scan_method(info, fi)
+            for cc in self._concurrency.values():
+                self._decide_ownership(cc)
+            self._scan_external_accesses()
+        return self._concurrency
+
+    def class_concurrency(
+        self, modname: str, clsname: str
+    ) -> Optional[ClassConcurrency]:
+        return self.concurrency.get((modname, clsname))
+
+    def _cc_for(self, info: ModuleInfo, clsname: str) -> ClassConcurrency:
+        key = (info.modname, clsname)
+        cc = self._concurrency.get(key)
+        if cc is None:
+            cc = self._concurrency[key] = ClassConcurrency(
+                info.modname, clsname
+            )
+        return cc
+
+    def _scan_method(self, info: ModuleInfo, fi: FunctionInfo) -> None:
+        cc = self._cc_for(info, fi.cls.name)
+        is_init = fi.qualname.endswith(".__init__")
+        self._descend_accesses(
+            cc, fi, fi.node, frozenset(), base="self",
+            record=not is_init, external=False,
+        )
+
+    def _held_after_with(
+        self, node, held: FrozenSet[str], base: str
+    ) -> FrozenSet[str]:
+        want = base + "."
+        for item in node.items:
+            dn = dotted_name(item.context_expr)
+            if dn and dn.startswith(want):
+                attr = dn[len(want):]
+                if "." not in attr and _is_lockish(attr):
+                    held = held | {attr}
+        return held
+
+    def _descend_accesses(self, cc, fi, node, held, base, record,
+                          external=False):
+        """Recursive lexical descent recording field accesses on `base`
+        (usually "self") with the currently held lock set."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                continue  # closure bodies do not run under the `with`
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                inner = self._held_after_with(child, held, base)
+                for attr in inner - held:
+                    cc.lock_attrs.add(attr)
+                self._descend_accesses(
+                    cc, fi, child, inner, base, record, external
+                )
+                continue
+            self._record_node(cc, fi, child, held, base, record, external)
+            self._descend_accesses(
+                cc, fi, child, held, base, record, external
+            )
+
+    def _base_attr(self, node: ast.AST, base: str) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == base
+        ):
+            return node.attr
+        return None
+
+    def _record_node(self, cc, fi, node, held, base, record, external):
+        def add(field: str, kind: str, at: ast.AST) -> None:
+            if _is_lockish(field):
+                return
+            if record:
+                cc.accesses.setdefault(field, []).append(
+                    FieldAccess(fi, at, kind, held, external)
+                )
+            if kind in ("write", "mutate"):
+                if held:
+                    for lk in held:
+                        g = cc.guarded_writes.setdefault(field, {})
+                        g[lk] = g.get(lk, 0) + 1
+                elif record:
+                    cc.unguarded_writes[field] = (
+                        cc.unguarded_writes.get(field, 0) + 1
+                    )
+
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                field = self._base_attr(t, base)
+                if field is not None:
+                    add(field, "write", node)
+                elif isinstance(t, ast.Subscript):
+                    field = self._base_attr(t.value, base)
+                    if field is not None:
+                        add(field, "mutate", node)
+        elif isinstance(node, ast.AugAssign):
+            field = self._base_attr(node.target, base)
+            if field is not None:
+                add(field, "write", node)
+            elif isinstance(node.target, ast.Subscript):
+                field = self._base_attr(node.target.value, base)
+                if field is not None:
+                    add(field, "mutate", node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    field = self._base_attr(t.value, base)
+                    if field is not None:
+                        add(field, "mutate", node)
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                _MUTATORS | {"pop", "popitem", "clear", "remove",
+                             "discard", "move_to_end"}
+            ):
+                field = self._base_attr(fn.value, base)
+                if field is not None:
+                    add(field, "mutate", node)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            field = self._iter_field(node.iter, base)
+            if field is not None:
+                add(field, "iter", node)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            # `[k for k in self._entries]` iterates the field exactly
+            # like the statement form does
+            for gen in node.generators:
+                field = self._iter_field(gen.iter, base)
+                if field is not None:
+                    add(field, "iter", node)
+
+    def _iter_field(self, it: ast.AST, base: str) -> Optional[str]:
+        """The owned field an iteration expression walks: `self._f`,
+        or `self._f.items()/.keys()/.values()`."""
+        field = self._base_attr(it, base)
+        if field is None and isinstance(it, ast.Call):
+            f2 = it.func
+            if isinstance(f2, ast.Attribute) and f2.attr in (
+                "items", "keys", "values"
+            ):
+                field = self._base_attr(f2.value, base)
+        return field
+
+    def _decide_ownership(self, cc: ClassConcurrency) -> None:
+        """A field is lock-owned when the class's own code guards its
+        writes by MAJORITY: some lock's guarded-write count strictly
+        exceeds the field's unguarded writes.  Ties stay unowned (no
+        convention to enforce), as do fields only ever written in
+        `__init__` plus unguarded sites (no guarded evidence)."""
+        for field, by_lock in cc.guarded_writes.items():
+            lock, guarded = max(by_lock.items(), key=lambda kv: kv[1])
+            if guarded > cc.unguarded_writes.get(field, 0):
+                cc.owner[field] = lock
+
+    # -- external typed references (singletons + annotated params) -------------
+
+    @property
+    def typed_singletons(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        """(modname, NAME) of module-level `NAME = Cls(...)` ->
+        (owning modname, clsname) for project classes."""
+        if self._instances is None:
+            self._instances = {}
+            for info in self.project.modules.values():
+                for name, expr in info.constants.items():
+                    if not isinstance(expr, ast.Call):
+                        continue
+                    cls_entry = self._resolve_class(
+                        info, call_name(expr)
+                    )
+                    if cls_entry is not None:
+                        self._instances[(info.modname, name)] = cls_entry
+        return self._instances
+
+    def _resolve_class(
+        self, module: ModuleInfo, dotted: str
+    ) -> Optional[Tuple[str, str]]:
+        """Dotted name -> (modname, clsname) of a scanned class."""
+        if not dotted:
+            return None
+        if dotted in module.classes:
+            return (module.modname, dotted)
+        canon = self.project.canonical(module, dotted)
+        modpath, _, clsname = canon.rpartition(".")
+        target = self.project.by_name.get(modpath)
+        if target is not None and clsname in target.classes:
+            return (target.modname, clsname)
+        return None
+
+    def typed_bases(
+        self, info: ModuleInfo, fi: FunctionInfo
+    ) -> Dict[str, Tuple[str, str]]:
+        """Names in `fi` that statically refer to an instance of a
+        scanned class: parameters annotated with one (including string
+        annotations), and module-level `NAME = Cls(...)` singletons
+        (local or imported).  Maps name -> (modname, clsname)."""
+        singletons = self.typed_singletons
+        bases: Dict[str, Tuple[str, str]] = {}
+        a = fi.node.args
+        for arg in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        ):
+            ann = arg.annotation
+            name = None
+            if ann is not None:
+                name = dotted_name(ann)
+                if not name and isinstance(ann, ast.Constant) and (
+                    isinstance(ann.value, str)
+                ):
+                    name = ann.value
+            if name:
+                entry = self._resolve_class(info, name)
+                if entry is not None:
+                    bases[arg.arg] = entry
+        for name in set(
+            n.id for n in _walk_own(fi.node) if isinstance(n, ast.Name)
+        ):
+            entry = singletons.get((info.modname, name))
+            if entry is None:
+                alias = info.import_aliases.get(name)
+                if alias and "." in alias:
+                    m, _, sym = alias.rpartition(".")
+                    entry = singletons.get((m, sym))
+            if entry is not None:
+                bases[name] = entry
+        return bases
+
+    def _scan_external_accesses(self) -> None:
+        """Record off-`self` accesses through typed references: a
+        module-level singleton of a scanned class, or a parameter
+        annotated with one.  These are the cross-module race sites the
+        per-class scan cannot see."""
+        for info in self.project.modules.values():
+            for fi in info.functions.values():
+                for base, entry in self.typed_bases(info, fi).items():
+                    if entry[0] == info.modname and fi.cls is not None \
+                            and fi.cls.name == entry[1]:
+                        continue  # the class's own methods use `self`
+                    cc = self._concurrency.get(entry)
+                    if cc is None:
+                        continue
+                    self._descend_accesses(
+                        cc, fi, fi.node, frozenset(), base=base,
+                        record=True, external=True,
+                    )
+
+    # -- order-taint analysis --------------------------------------------------
+
+    def taint(self, config: Optional[dict] = None) -> "OrderTaint":
+        return OrderTaint(self, config or {})
+
+
+# ---------------------------------------------------------------------------
+# Forward order-taint lattice
+# ---------------------------------------------------------------------------
+
+# default producers of nondeterministic iteration order
+_DEFAULT_SOURCES = {
+    "os.listdir": "os.listdir() directory order",
+    "os.scandir": "os.scandir() directory order",
+    "glob.glob": "glob.glob() match order",
+    "glob.iglob": "glob.iglob() match order",
+    "concurrent.futures.as_completed": "as_completed() completion order",
+    "as_completed": "as_completed() completion order",
+    "concurrent.futures.wait": "futures.wait() completion order",
+    "set": "set() iteration order",
+    "frozenset": "frozenset() iteration order",
+}
+
+_DEFAULT_SANITIZERS = {"sorted", "min", "max"}
+
+
+class SinkHit:
+    """One order-taint reaching a merge sink."""
+
+    __slots__ = ("fi", "node", "sink", "labels", "via", "kind")
+
+    def __init__(self, fi, node, sink: str, labels: FrozenSet[str],
+                 kind: str, via: Optional[str] = None):
+        self.fi = fi
+        self.node = node
+        self.sink = sink
+        self.labels = labels
+        self.kind = kind  # "loop-order" | "argument" | "interprocedural"
+        self.via = via
+
+
+class _FnSummary:
+    __slots__ = ("returns_tainted", "return_labels", "params_to_sink",
+                 "params_to_return")
+
+    def __init__(self):
+        self.returns_tainted = False
+        self.return_labels: FrozenSet[str] = frozenset()
+        # param name -> sink canonical it reaches unsanitized
+        self.params_to_sink: Dict[str, str] = {}
+        self.params_to_return: Set[str] = set()
+
+
+class OrderTaint:
+    """Forward taint over one function at a time, with memoized callee
+    summaries for interprocedural flow (returns + args/kwargs)."""
+
+    def __init__(self, engine: DataflowEngine, config: dict):
+        self.engine = engine
+        self.project = engine.project
+        self.sources = dict(_DEFAULT_SOURCES)
+        self.sources.update(config.get("sources", {}))
+        self.sanitizers = set(_DEFAULT_SANITIZERS)
+        self.sanitizers.update(config.get("sanitizers", ()))
+        # dotted suffixes that identify ⊕-merge sinks
+        self.sink_suffixes = tuple(
+            config.get(
+                "sink_suffixes",
+                (
+                    "merge_groupby_states",
+                    "merge_sketch_states",
+                    "merge_timeseries_states",
+                ),
+            )
+        )
+        self.max_depth = int(config.get("summary_depth", 3))
+        self._summaries: Dict[int, _FnSummary] = {}
+
+    # -- classification --------------------------------------------------------
+
+    def _is_sink(self, raw: str, canon: str) -> Optional[str]:
+        for cand in (canon, raw):
+            if not cand:
+                continue
+            for suf in self.sink_suffixes:
+                if cand == suf or cand.endswith("." + suf) or (
+                    cand.endswith(suf) and cand[: -len(suf)].endswith(".")
+                ):
+                    return cand
+            # `engine.merge_groupby_states` spells an attr chain whose
+            # root is a local: match the trailing attribute too
+            leaf = cand.rsplit(".", 1)[-1]
+            if leaf in self.sink_suffixes:
+                return cand
+        return None
+
+    def _source_label(self, module, node: ast.Call) -> Optional[str]:
+        raw = call_name(node)
+        canon = self.project.canonical(module, raw) if raw else ""
+        for cand in (canon, raw):
+            if cand in self.sources:
+                return self.sources[cand]
+        return None
+
+    def _is_sanitizer(self, module, node: ast.Call) -> bool:
+        raw = call_name(node)
+        canon = self.project.canonical(module, raw) if raw else ""
+        if raw in self.sanitizers or canon in self.sanitizers:
+            return True
+        # `x.sort()` / `.most_common()` produce a deterministic order
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "sort", "most_common"
+        ):
+            return True
+        return False
+
+    # -- function summaries ----------------------------------------------------
+
+    def summary(self, fi: FunctionInfo, _depth: int = 0) -> _FnSummary:
+        key = id(fi)
+        cached = self._summaries.get(key)
+        if cached is not None:
+            return cached
+        s = _FnSummary()
+        self._summaries[key] = s  # break recursion: empty until proven
+        if _depth > self.max_depth:
+            return s
+        param_names = self._param_names(fi)
+        env: Dict[str, FrozenSet[str]] = {
+            p: frozenset({f"param:{p}"}) for p in param_names
+        }
+        hits: List[SinkHit] = []
+        returns: List[FrozenSet[str]] = []
+        self._exec_block(
+            fi, self._body(fi), env, frozenset(), hits, returns,
+            _depth + 1,
+        )
+        labels: Set[str] = set()
+        for r in returns:
+            labels |= r
+        s.params_to_return = {
+            lbl[len("param:"):] for lbl in labels
+            if lbl.startswith("param:")
+        }
+        s.return_labels = frozenset(
+            lbl for lbl in labels if not lbl.startswith("param:")
+        )
+        s.returns_tainted = bool(s.return_labels)
+        for h in hits:
+            for lbl in h.labels:
+                if lbl.startswith("param:"):
+                    s.params_to_sink.setdefault(
+                        lbl[len("param:"):], h.sink
+                    )
+        self._summaries[key] = s
+        return s
+
+    @staticmethod
+    def _param_names(fi: FunctionInfo) -> List[str]:
+        a = fi.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        return [n for n in names if n != "self"]
+
+    @staticmethod
+    def _body(fi: FunctionInfo):
+        return list(getattr(fi.node, "body", ()))
+
+    # -- per-function analysis -------------------------------------------------
+
+    def analyze(self, fi: FunctionInfo) -> List[SinkHit]:
+        """Sink hits in one function with CLEAN parameters: what the
+        fold-determinism pass reports.  Parameter-labeled taint never
+        fires here (the caller's analysis owns it via summaries)."""
+        hits: List[SinkHit] = []
+        returns: List[FrozenSet[str]] = []
+        self._exec_block(
+            fi, self._body(fi), {}, frozenset(), hits, returns, 0
+        )
+        return [
+            h for h in hits
+            if any(not l.startswith("param:") for l in h.labels)
+        ]
+
+    # -- the small forward interpreter ----------------------------------------
+
+    def _exec_block(self, fi, stmts, env, order, hits, returns, depth):
+        for stmt in stmts:
+            self._exec_stmt(fi, stmt, env, order, hits, returns, depth)
+
+    def _exec_stmt(self, fi, stmt, env, order, hits, returns, depth):
+        module = fi.module
+        if isinstance(stmt, _FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+            return  # nested defs run elsewhere
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            if value is None:
+                return
+            t = self._taint_of(fi, value, env, order, hits, depth)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for tgt in targets:
+                self._bind_target(tgt, t, env, order, augment=isinstance(
+                    stmt, ast.AugAssign
+                ))
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self._taint_of(fi, stmt.iter, env, order, hits, depth)
+            inner_order = order | it
+            # loop targets carry the VALUES, whose content is fine; the
+            # ORDER is what inner_order tracks.  Bind clean.
+            self._bind_target(stmt.target, frozenset(), env, inner_order)
+            self._exec_block(
+                fi, stmt.body, env, inner_order, hits, returns, depth
+            )
+            self._exec_block(
+                fi, stmt.orelse, env, order, hits, returns, depth
+            )
+            return
+        if isinstance(stmt, ast.While):
+            self._taint_of(fi, stmt.test, env, order, hits, depth)
+            self._exec_block(
+                fi, stmt.body, env, order, hits, returns, depth
+            )
+            self._exec_block(
+                fi, stmt.orelse, env, order, hits, returns, depth
+            )
+            return
+        if isinstance(stmt, ast.If):
+            self._taint_of(fi, stmt.test, env, order, hits, depth)
+            self._exec_block(
+                fi, stmt.body, env, order, hits, returns, depth
+            )
+            self._exec_block(
+                fi, stmt.orelse, env, order, hits, returns, depth
+            )
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                t = self._taint_of(
+                    fi, item.context_expr, env, order, hits, depth
+                )
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, t, env, order)
+            self._exec_block(
+                fi, stmt.body, env, order, hits, returns, depth
+            )
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(
+                fi, stmt.body, env, order, hits, returns, depth
+            )
+            for handler in stmt.handlers:
+                self._exec_block(
+                    fi, handler.body, env, order, hits, returns, depth
+                )
+            self._exec_block(
+                fi, stmt.orelse, env, order, hits, returns, depth
+            )
+            self._exec_block(
+                fi, stmt.finalbody, env, order, hits, returns, depth
+            )
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                t = self._taint_of(
+                    fi, stmt.value, env, order, hits, depth
+                )
+                returns.append(t | order)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._taint_of(fi, stmt.value, env, order, hits, depth)
+            return
+        # anything else: evaluate child expressions for sink hits
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._taint_of(fi, child, env, order, hits, depth)
+            elif isinstance(child, ast.stmt):
+                self._exec_stmt(
+                    fi, child, env, order, hits, returns, depth
+                )
+
+    def _bind_target(self, tgt, taint, env, order, augment=False):
+        """Assignments inside a tainted-order region make the TARGET
+        arrival-ordered when it accumulates (subscript store), and plain
+        names inherit the value's taint."""
+        if isinstance(tgt, ast.Name):
+            base = env.get(tgt.id, frozenset()) if augment else frozenset()
+            env[tgt.id] = base | taint | (order if augment else frozenset())
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind_target(el, taint, env, order, augment)
+        elif isinstance(tgt, ast.Subscript):
+            # `acc[k] = v` under tainted order: acc becomes
+            # arrival-ordered (the nondeterministically-ordered dict)
+            if isinstance(tgt.value, ast.Name) and (order or taint):
+                env[tgt.value.id] = (
+                    env.get(tgt.value.id, frozenset()) | taint | order
+                )
+        elif isinstance(tgt, ast.Starred):
+            self._bind_target(tgt.value, taint, env, order, augment)
+
+    def _taint_of(self, fi, expr, env, order, hits, depth) -> FrozenSet[str]:
+        module = fi.module
+        if expr is None:
+            return frozenset()
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, frozenset())
+        if isinstance(expr, ast.Attribute):
+            return self._taint_of(fi, expr.value, env, order, hits, depth)
+        if isinstance(expr, ast.Subscript):
+            base = self._taint_of(fi, expr.value, env, order, hits, depth)
+            self._taint_of(fi, expr.slice, env, order, hits, depth)
+            return base
+        if isinstance(expr, (ast.List, ast.Tuple)):
+            out: FrozenSet[str] = frozenset()
+            for el in expr.elts:
+                out |= self._taint_of(fi, el, env, order, hits, depth)
+            return out
+        if isinstance(expr, ast.Set):
+            out = frozenset({self.sources["set"]})
+            for el in expr.elts:
+                out |= self._taint_of(fi, el, env, order, hits, depth)
+            return out
+        if isinstance(expr, (ast.SetComp, ast.DictComp)):
+            # rebuilding an unordered container absorbs order-taint —
+            # but a SET is itself unordered to iterate
+            for gen in expr.generators:
+                self._taint_of(fi, gen.iter, env, order, hits, depth)
+            if isinstance(expr, ast.SetComp):
+                return frozenset({self.sources["set"]})
+            return frozenset()
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+            out = frozenset()
+            for gen in expr.generators:
+                out |= self._taint_of(
+                    fi, gen.iter, env, order, hits, depth
+                )
+            out |= self._taint_of(fi, expr.elt, env, order, hits, depth)
+            return out
+        if isinstance(expr, ast.BinOp):
+            return self._taint_of(
+                fi, expr.left, env, order, hits, depth
+            ) | self._taint_of(fi, expr.right, env, order, hits, depth)
+        if isinstance(expr, ast.BoolOp):
+            out = frozenset()
+            for v in expr.values:
+                out |= self._taint_of(fi, v, env, order, hits, depth)
+            return out
+        if isinstance(expr, ast.Compare):
+            self._taint_of(fi, expr.left, env, order, hits, depth)
+            for c in expr.comparators:
+                self._taint_of(fi, c, env, order, hits, depth)
+            return frozenset()
+        if isinstance(expr, ast.IfExp):
+            self._taint_of(fi, expr.test, env, order, hits, depth)
+            return self._taint_of(
+                fi, expr.body, env, order, hits, depth
+            ) | self._taint_of(fi, expr.orelse, env, order, hits, depth)
+        if isinstance(expr, ast.Starred):
+            return self._taint_of(fi, expr.value, env, order, hits, depth)
+        if isinstance(expr, ast.Call):
+            return self._taint_of_call(fi, expr, env, order, hits, depth)
+        if isinstance(expr, ast.Dict):
+            out = frozenset()
+            for v in list(expr.keys) + list(expr.values):
+                if v is not None:
+                    self._taint_of(fi, v, env, order, hits, depth)
+            return out
+        return frozenset()
+
+    def _taint_of_call(self, fi, node, env, order, hits, depth):
+        module = fi.module
+        raw = call_name(node)
+        canon = self.project.canonical(module, raw) if raw else ""
+        arg_taints = [
+            self._taint_of(fi, a, env, order, hits, depth)
+            for a in node.args
+        ]
+        kw_taints = {
+            kw.arg: self._taint_of(fi, kw.value, env, order, hits, depth)
+            for kw in node.keywords
+        }
+        all_args = frozenset().union(
+            frozenset(), *arg_taints, *kw_taints.values()
+        )
+        if self._is_sanitizer(module, node):
+            # in-place `recv.sort()` sanitizes the RECEIVER, not just
+            # the (None) call value
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "sort"
+                and isinstance(node.func.value, ast.Name)
+            ):
+                env[node.func.value.id] = frozenset()
+            return frozenset()
+        sink = self._is_sink(raw, canon)
+        if sink is not None:
+            if all_args:
+                hits.append(
+                    SinkHit(fi, node, sink, all_args, kind="argument")
+                )
+            if order:
+                hits.append(
+                    SinkHit(fi, node, sink, order, kind="loop-order")
+                )
+            return frozenset()
+        label = self._source_label(module, node)
+        if label is not None:
+            return all_args | {label}
+        # mutator under tainted order: the receiver accumulates in
+        # arrival order
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+            and isinstance(node.func.value, ast.Name)
+            and (order or all_args)
+        ):
+            recv = node.func.value.id
+            env[recv] = env.get(recv, frozenset()) | order | all_args
+        # interprocedural: summaries of intra-project callees
+        if raw and depth <= self.max_depth:
+            target = self.project.resolve_function(
+                module, raw, cls=fi.cls
+            )
+            if target is not None and target is not fi:
+                s = self.summary(target, depth)
+                if all_args:
+                    mapped = self._map_args_to_params(
+                        target, node, arg_taints, kw_taints
+                    )
+                    for pname, t in mapped.items():
+                        if not t:
+                            continue
+                        sink = s.params_to_sink.get(pname)
+                        if sink is not None:
+                            hits.append(
+                                SinkHit(
+                                    fi, node, sink, t,
+                                    kind="interprocedural",
+                                    via=(
+                                        f"{target.module.modname}."
+                                        f"{target.qualname}"
+                                    ),
+                                )
+                            )
+                out = frozenset(s.return_labels)
+                if s.params_to_return and all_args:
+                    mapped = self._map_args_to_params(
+                        target, node, arg_taints, kw_taints
+                    )
+                    for pname in s.params_to_return:
+                        out |= mapped.get(pname, frozenset())
+                return out
+        # unknown callee: be conservative only about ordered wrappers —
+        # list()/tuple()/reversed() of a tainted iterable stay tainted
+        if canon in ("list", "tuple", "reversed", "enumerate", "zip",
+                     "iter"):
+            return all_args
+        return frozenset()
+
+    @staticmethod
+    def _map_args_to_params(target, node, arg_taints, kw_taints):
+        a = target.node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        out: Dict[str, FrozenSet[str]] = {}
+        for i, t in enumerate(arg_taints):
+            if i < len(params):
+                out[params[i]] = t
+        kwonly = {p.arg for p in a.kwonlyargs}
+        for name, t in kw_taints.items():
+            if name and (name in kwonly or name in params or True):
+                # keywords map by NAME; unknown names (e.g. **kwargs)
+                # still carry their taint under the spelled name
+                out[name] = out.get(name, frozenset()) | t
+        return out

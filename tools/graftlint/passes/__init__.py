@@ -13,8 +13,15 @@ span-discipline, GL12xx resource-budget, GL13xx jit-collision, GL14xx
 lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
 serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
 GL20xx storage-discipline, GL21xx dispatch-discipline, GL22xx
-mesh-discipline, GL23xx broker-discipline; GL00x are the core's own:
-GL001 unparseable file, GL002 malformed pragma).
+mesh-discipline, GL23xx broker-discipline, GL24xx fold-determinism,
+GL25xx shared-state-races; GL00x are the core's own: GL001 unparseable
+file, GL002 malformed pragma).
+
+The GL24xx/GL25xx families are interprocedural: they run on
+`engine.DataflowEngine` (bound to every pass as `self.engine`), which
+layers a module dependency graph, thread-entry reachability, inferred
+lock ownership, and a forward order-taint lattice on top of the
+project symbol tables.
 """
 
 from __future__ import annotations
@@ -29,6 +36,7 @@ from .compat_import import CompatImportPass
 from .dispatch_discipline import DispatchDisciplinePass
 from .dtype_x64 import DtypeX64Pass
 from .error_discipline import ErrorDisciplinePass
+from .fold_determinism import FoldDeterminismPass
 from .ingest_discipline import IngestDisciplinePass
 from .jit_cache import JitCachePass
 from .jit_collision import JitCollisionPass
@@ -40,6 +48,7 @@ from .pallas_shape import PallasShapePass
 from .partial_discipline import PartialDisciplinePass
 from .resource_budget import ResourceBudgetPass
 from .serving_discipline import ServingDisciplinePass
+from .shared_state_races import SharedStateRacesPass
 from .span_discipline import SpanDisciplinePass
 from .storage_discipline import StorageDisciplinePass
 from .trace_purity import TracePurityPass
@@ -70,6 +79,8 @@ ALL_PASSES = (
     DispatchDisciplinePass,
     MeshDisciplinePass,
     BrokerDisciplinePass,
+    FoldDeterminismPass,
+    SharedStateRacesPass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,105 @@
+"""Fold-determinism pass (GL24xx): order-taint must not reach ⊕-merges.
+
+Every correctness claim in this system — arena vs loop, flat vs 2-slice
+mesh, broker failover vs local — rests on byte-identical ⊕-folds of
+partial aggregate states.  The merge algebra is associative, but float
+addition and sketch unions are NOT bit-commutative under reordering, so
+a fold whose operand ORDER depends on directory listing order, set
+iteration, or thread completion order silently breaks the parity matrix
+the moment the scheduler hiccups.
+
+This pass runs the engine's forward order-taint lattice
+(`engine.OrderTaint`) over every function in scope and reports when
+taint reaches a merge sink without passing a canonical-ordering
+sanitizer (`sorted(...)`, `.sort()`, or a configured canonicalizer):
+
+* **GL2401** — a merge sink is called inside a loop whose iteration
+  order is tainted (`for fut in as_completed(...): merge(...)` — the
+  broker-gather shape without the sort).
+* **GL2402** — an order-tainted collection is passed as a merge-sink
+  argument (the accumulator was filled in arrival order).
+* **GL2403** — interprocedural: an order-tainted argument flows into a
+  callee whose parameter reaches a merge sink unsanitized (the hazard
+  lives two frames away from the source).
+
+Sources are producers whose order is genuinely nondeterministic across
+processes/runs: set/frozenset iteration, `os.listdir`/`glob`,
+`as_completed`-style gathers.  Plain dict iteration is deliberately NOT
+a source (CPython dicts are insertion-ordered), but containers
+ACCUMULATED under tainted order inherit the taint — which is exactly
+the nondeterministically-ordered-dict case that matters.  The clean
+exemplar is `cluster/broker.py`'s gather: collect from
+`as_completed(...)`, then fold `for ... in sorted(results, key=...)`.
+"""
+
+from __future__ import annotations
+
+from ..core import LintPass
+
+_CODES = {
+    "loop-order": "GL2401",
+    "argument": "GL2402",
+    "interprocedural": "GL2403",
+}
+
+
+class FoldDeterminismPass(LintPass):
+    name = "fold-determinism"
+    default_config = {
+        # the ⊕-merge algebra lives in the package; tools/tests build
+        # fixtures that would self-flag
+        "include": ("spark_druid_olap_tpu/",),
+        # extra {canonical-or-raw name: description} source calls
+        "sources": {},
+        # extra sanitizer names (canonical-ordering helpers)
+        "sanitizers": (),
+        # dotted suffixes identifying ⊕-merge sinks
+        "sink_suffixes": (
+            "merge_groupby_states",
+            "merge_sketch_states",
+            "merge_timeseries_states",
+        ),
+        "summary_depth": 3,
+    }
+
+    def finish(self, project) -> None:
+        if self.engine is None:
+            return
+        taint = self.engine.taint(self.config)
+        for info in sorted(
+            project.modules.values(), key=lambda m: m.relpath
+        ):
+            if not self.applies_to(info.relpath):
+                continue
+            for qual in sorted(info.functions):
+                fi = info.functions[qual]
+                for hit in taint.analyze(fi):
+                    self._flag(fi, hit)
+
+    def _flag(self, fi, hit) -> None:
+        labels = ", ".join(
+            sorted(l for l in hit.labels if not l.startswith("param:"))
+        )
+        code = _CODES[hit.kind]
+        if hit.kind == "loop-order":
+            msg = (
+                f"⊕-merge `{hit.sink}` folds under nondeterministic "
+                f"iteration order ({labels}) — float/sketch merges are "
+                "not bit-commutative; iterate `sorted(...)` over a "
+                "canonical key before folding"
+            )
+        elif hit.kind == "argument":
+            msg = (
+                f"order-tainted value reaches ⊕-merge `{hit.sink}` "
+                f"({labels}) — the operand was produced in "
+                "nondeterministic order; canonicalize with `sorted(...)` "
+                "before the fold"
+            )
+        else:
+            msg = (
+                f"order-tainted argument flows into `{hit.via}`, whose "
+                f"parameter reaches ⊕-merge `{hit.sink}` unsanitized "
+                f"({labels}) — sort at this call site or inside the "
+                "callee"
+            )
+        self.report(fi.module.ctx, hit.node, code, msg)

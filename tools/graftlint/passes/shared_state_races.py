@@ -1,0 +1,107 @@
+"""Cross-thread race pass (GL25xx): whole-program lock-ownership checks.
+
+`lock_discipline.py` (GL5xx) needs a hand-maintained registry of class
+-> (lock, fields) and only sees methods of that class in that file;
+`lock_order.py` (GL14xx) orders acquisitions but says nothing about
+unguarded access.  This pass supersedes both heuristics' blind spots
+with the engine's INFERRED ownership map: for every scanned class,
+which `self.<lock>` guards which fields is learned from the majority
+guarded-write pattern of the class's own code across the whole project
+(`engine.concurrency`), so no registry rots, and accesses through
+module-level singletons or class-annotated parameters in OTHER modules
+resolve against the same map.
+
+Findings, all "outside the owning lock":
+
+* **GL2501** — plain write to a lock-owned field (`self.f = v`,
+  `self.f += v`).
+* **GL2502** — container mutation of a lock-owned field
+  (`self.f[k] = v`, `del self.f[k]`, `.append`/`.pop`/...).
+* **GL2503** — write or mutation through an EXTERNAL typed reference:
+  a module-level `NAME = Cls(...)` singleton or a parameter annotated
+  with the class, touched from another module off the lock.
+* **GL2504** — iteration over a lock-owned container in
+  thread-reachable code (reached from `Thread(target=...)`, executor
+  submits, or `do_*` handler methods): iterating while another thread
+  mutates raises `RuntimeError: dict changed size during iteration`.
+
+Deliberate quiet zones: `__init__` (no concurrent access before
+construction), bare attribute READS (a single attribute load is atomic
+under the GIL and pervasively used for snapshots like
+`asg = self.assignment`), fields without majority-guarded evidence
+(ties and lock-free fields carry no convention to enforce).
+"""
+
+from __future__ import annotations
+
+from ..core import LintPass
+
+
+class SharedStateRacesPass(LintPass):
+    name = "shared-state-races"
+    default_config = {
+        "include": ("spark_druid_olap_tpu/",),
+        # (modname, clsname, field) triples to ignore entirely — for
+        # fields whose off-lock access is a documented protocol
+        "allow": (),
+    }
+
+    def finish(self, project) -> None:
+        engine = self.engine
+        if engine is None:
+            return
+        allow = {tuple(t) for t in self.config.get("allow", ())}
+        for key in sorted(engine.concurrency):
+            cc = engine.concurrency[key]
+            for field in sorted(cc.owner):
+                lock = cc.owner[field]
+                if (cc.modname, cc.clsname, field) in allow:
+                    continue
+                for acc in cc.accesses.get(field, ()):
+                    self._check(cc, field, lock, acc)
+
+    def _check(self, cc, field, lock, acc) -> None:
+        if lock in acc.held:
+            return
+        if not self.applies_to(acc.fi.module.relpath):
+            return
+        where = f"{cc.modname}.{cc.clsname}.{field}"
+        held = (
+            f" (holds {', '.join(sorted(acc.held))} — the wrong lock)"
+            if acc.held else ""
+        )
+        if acc.external:
+            self.report(
+                acc.fi.module.ctx, acc.node, "GL2503",
+                f"{acc.kind} of lock-owned {where} through an external "
+                f"reference outside `with .{lock}:`{held} — this field "
+                f"is majority-guarded by {cc.clsname}.{lock}; take the "
+                "lock at this cross-module site too",
+            )
+            return
+        if acc.kind == "write":
+            self.report(
+                acc.fi.module.ctx, acc.node, "GL2501",
+                f"write to lock-owned self.{field} outside "
+                f"`with self.{lock}:`{held} — the class guards this "
+                "field's writes by majority; take the lock (reentrantly "
+                "in helpers) or justify via pragma/baseline",
+            )
+        elif acc.kind == "mutate":
+            self.report(
+                acc.fi.module.ctx, acc.node, "GL2502",
+                f"mutation of lock-owned self.{field} outside "
+                f"`with self.{lock}:`{held} — container ops on "
+                "cross-thread state must run under the owning lock",
+            )
+        elif acc.kind == "iter" and self.engine.is_thread_reachable(
+            acc.fi
+        ):
+            self.report(
+                acc.fi.module.ctx, acc.node, "GL2504",
+                f"iteration over lock-owned self.{field} outside "
+                f"`with self.{lock}:`{held} in thread-reachable code — "
+                "a concurrent mutation breaks the iterator; snapshot "
+                f"under the lock (`list(self.{field})`) and iterate "
+                "the copy",
+            )

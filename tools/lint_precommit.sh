@@ -1,0 +1,27 @@
+#!/bin/sh
+# Fast pre-commit lint: only files changed since merge-base(HEAD, main)
+# plus their reverse-dependency closure (modules that import them),
+# computed from graftlint's module dependency graph.
+#
+# Usage:
+#   tools/lint_precommit.sh [BASE] [extra graftlint args...]
+#
+# BASE defaults to main.  Install as a git hook with:
+#   ln -s ../../tools/lint_precommit.sh .git/hooks/pre-commit
+# (the hook invocation passes no arguments, so BASE stays main).
+#
+# Exit codes follow graftlint: 0 clean, 1 new findings, 2 stale
+# baseline entries or configuration errors.
+set -eu
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+BASE="main"
+if [ "$#" -gt 0 ]; then
+    case "$1" in
+        -*) ;;  # first arg is a flag, keep default BASE
+        *) BASE="$1"; shift ;;
+    esac
+fi
+
+cd "$ROOT"
+exec python -m tools.graftlint --changed "$BASE" --stats "$@"
