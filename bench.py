@@ -2980,6 +2980,81 @@ def bench_cluster(scale: float):
     }
 
 
+def bench_sanitize(scale: float):
+    """graftsan overhead proof (ISSUE 18): the SSB query set runs over
+    two identical contexts — fully armed (SDOL_SANITIZE=1, lock witness
+    + fold recorder + schedule explorer against the committed contract
+    table) vs uninstalled — and the headline is the armed/bare wall
+    ratio.  The armed arm must finish with zero violations and zero
+    static<->runtime divergences; the bare arm must count EXACTLY zero
+    probes (disabled-means-free, measured rather than asserted)."""
+    import time as _t
+
+    from spark_druid_olap_tpu.workloads import ssb
+    from tools import graftsan
+
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def _arm_ctx():
+        ctx = _calibrated_ctx()
+        ssb.register(ctx, tables=ssb.gen_tables(scale=scale))
+        return ctx
+
+    def _sweep(ctx, reps=3):
+        # warm once (compiles), then best-of over the full query set
+        for name in ssb.QUERIES:
+            ctx.sql(ssb.QUERIES[name])
+        walls = []
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            for name in ssb.QUERIES:
+                ctx.sql(ssb.QUERIES[name])
+            walls.append(_t.perf_counter() - t0)
+        return min(walls)
+
+    prev_arm = os.environ.get(graftsan.ENV_ARM)
+    os.environ[graftsan.ENV_ARM] = "1"
+    san = graftsan.install(
+        contracts_path=os.path.join(root, "graftsan_contracts.json"),
+        root=root, seed=0,
+    )
+    try:
+        ctx = _arm_ctx()  # built INSIDE the window: witnessed locks
+        n_rows = ctx.catalog.get("lineorder").num_rows
+        armed_s = _sweep(ctx)
+        stats = graftsan.stats_doc(san)
+        divergences = graftsan.divergence_report(san)
+    finally:
+        graftsan.uninstall()
+        if prev_arm is None:
+            os.environ.pop(graftsan.ENV_ARM, None)
+        else:
+            os.environ[graftsan.ENV_ARM] = prev_arm
+
+    bare_s = _sweep(_arm_ctx())
+    ratio = armed_s / max(bare_s, 1e-9)
+    return {
+        "metric": "sanitize_sf%g_overhead_ratio" % scale,
+        "value": round(ratio, 3),
+        "unit": "x",
+        # vs_baseline reads as "armed costs this many bare runs"
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "rows": n_rows,
+            "queries": len(ssb.QUERIES),
+            "armed_wall_s": round(armed_s, 4),
+            "bare_wall_s": round(bare_s, 4),
+            "violations": stats["violations"],
+            "divergences": stats["divergences"],
+            "divergence_rows": divergences,
+            "unarmed_probes": graftsan.probe_count(),
+            "sanitizer_stats": stats,
+            "seed": san.seed,
+            "device": _device(),
+        },
+    }
+
+
 def bench_calibrate(rows_log2: int):
     import os
 
@@ -3016,6 +3091,7 @@ MODES = {
     "arena": (bench_arena, 1.0),
     "mesh_unified": (bench_mesh_unified, 10.0),
     "cluster": (bench_cluster, 1.0),
+    "sanitize": (bench_sanitize, 0.1),
     "calibrate": (bench_calibrate, 23),
 }
 

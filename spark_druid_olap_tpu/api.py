@@ -1388,7 +1388,10 @@ def execute_grouping_sets(q: Q.GroupByQuery, grouping_sets, ds, engine):
     pc = current_partial()
     set_labels = None
     if pc is not None:
-        pc.collect_sets = True
+        # under the collector's lock: collect_sets is `_lock`-owned and
+        # the runtime witness enforces it (an off-lock flip here was the
+        # first divergence graftsan caught on the shipped tree)
+        pc.arm_set_collection()
         set_labels = [
             ",".join(all_dims[i].name for i in s) or "()"
             for s in grouping_sets

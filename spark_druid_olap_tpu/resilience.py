@@ -345,6 +345,15 @@ class PartialCollector:
                 return
         self.set_records.append(rec)
 
+    def arm_set_collection(self) -> None:
+        """Arm per-grouping-set archiving (`collect_sets`) under the
+        collector's lock.  The expansion code used to flip the flag
+        directly through an untyped local — invisible to the static
+        lock-ownership inference AND an off-lock write to a `_lock`-owned
+        field, which the runtime witness (tools/graftsan) flags."""
+        with self._lock:
+            self.collect_sets = True
+
     def finish_sets(self) -> list:
         """Close grouping-set collection: archive the live pass and zero
         the live counters so the aggregate (coverage / to_dict) reads
@@ -687,12 +696,32 @@ def injector() -> FaultInjector:
 def fire(site: str) -> None:
     """Module-level shorthand for the hot instrumentation points: skips
     even the singleton construction when nothing was ever armed."""
+    if _sched_hook is not None:
+        _sched_hook(site)
     inj = _injector
     if inj is None:
         if not os.environ.get("SDOL_FAULTS"):
             return
         inj = injector()
     inj.fire(site)
+
+
+# ---------------------------------------------------------------------------
+# Schedule-exploration hook (tools/graftsan)
+# ---------------------------------------------------------------------------
+
+# The runtime sanitizer's deterministic schedule explorer rides the
+# SAME named sites the fault injector does: every `checkpoint`/`fire`
+# call is a potential thread-interleaving point.  Unarmed cost is one
+# module-global None check (the `_injector is None` idiom); the hook is
+# only ever set by graftsan's installer, never by product code.
+_sched_hook = None
+
+
+def set_schedule_hook(hook) -> None:
+    """Install (or clear, with None) the per-site scheduling hook."""
+    global _sched_hook
+    _sched_hook = hook
 
 
 # ---------------------------------------------------------------------------

@@ -143,6 +143,11 @@ def test_production_tag_keys_scale(monkeypatch):
     assert "%s_%g" % (mode, arg) == "cluster_1"
     assert fn is bench.bench_cluster
     assert isinstance(bench.MODES["cluster"][1], float)
+    # graftsan overhead proof (ISSUE 18): SSB scale-factor arg
+    mode, fn, arg = bench._parse_args(["sanitize", "0.1"])
+    assert "%s_%g" % (mode, arg) == "sanitize_0.1"
+    assert fn is bench.bench_sanitize
+    assert isinstance(bench.MODES["sanitize"][1], float)
 
 
 def test_emit_ingest_result_shape(capsys, tmp_path, monkeypatch):

@@ -294,6 +294,94 @@ def test_ownership_picks_majority_lock_of_two(tmp_path):
     assert cc.owner == {"_n": "_lock"}
 
 
+def test_ownership_pin_annotation_breaks_tie(tmp_path):
+    """A `# graftlint: owner=<lock>` pin on an access decides a
+    majority tie that would otherwise stay silently unowned."""
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1  # graftlint: owner=_lock
+
+            def c(self):
+                self._n = 5
+    """)
+    assert cc.owner == {"_n": "_lock"}
+    assert cc.pinned == {"_n": {"_lock"}}
+
+
+def test_ownership_pin_on_line_above(tmp_path):
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def c(self):
+                # graftlint: owner=_lock
+                self._n = 5
+    """)
+    assert cc.owner == {"_n": "_lock"}
+
+
+def test_ownership_pin_overrides_majority(tmp_path):
+    """An explicit pin beats the heuristic: the annotation names the
+    convention even when most writes sit under another lock."""
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._aux = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n += 1
+
+            def c(self):
+                with self._aux:
+                    self._n += 1  # graftlint: owner=_aux
+    """)
+    assert cc.owner == {"_n": "_aux"}
+    assert "_aux" in cc.lock_attrs
+
+
+def test_ownership_conflicting_pins_fall_back_to_majority(tmp_path):
+    cc = _cc(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._aux = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1  # graftlint: owner=_lock
+
+            def c(self):
+                self._n = 5  # graftlint: owner=_aux
+    """)
+    # two different pins cancel; majority (1 guarded vs 1 unguarded)
+    # ties, so the field stays unowned
+    assert cc.owner == {}
+
+
 # ---------------------------------------------------------------------------
 # order-taint lattice
 # ---------------------------------------------------------------------------

@@ -43,6 +43,12 @@ from .core import (
     run_lint,
     save_baseline,
 )
+from .contracts import (
+    CONTRACTS_NAME,
+    DEFAULT_TARGETS,
+    build_contract_doc,
+    save_contracts,
+)
 from .passes import PASS_BY_NAME
 
 
@@ -316,11 +322,36 @@ def main(argv=None) -> int:
              "snippet edits via (pass, code, path) identity; new entries "
              "get a placeholder reason to fill in before merging)",
     )
+    ap.add_argument(
+        "--export-contracts", nargs="?", const="", default=None,
+        metavar="PATH",
+        help="export the inferred contract table (lock ownership, fold "
+             "sinks, thread roots, sanctioned off-lock sites) as JSON "
+             f"for the runtime sanitizer (default: <root>/"
+             f"{CONTRACTS_NAME}); positional paths default to the repo "
+             "gate's target set so evidence matches the gate's",
+    )
     args = ap.parse_args(argv)
     fmt = "json" if args.json else args.fmt
 
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.export_contracts is not None:
+        out = args.export_contracts or os.path.join(root, CONTRACTS_NAME)
+        doc = build_contract_doc(
+            root,
+            paths=args.paths or DEFAULT_TARGETS,
+            baseline_path=baseline_path,
+        )
+        save_contracts(out, doc)
+        print(
+            f"contracts exported: {len(doc['lock_ownership'])} owned "
+            f"field(s) across {len(doc['lock_attrs'])} class(es), "
+            f"{len(doc['fold_sinks'])} fold sink(s), "
+            f"{len(doc['thread_roots'])} thread root(s), "
+            f"{len(doc['allow_sites'])} sanctioned site(s) -> {out}"
+        )
+        return 0
     try:
         if args.changed is not None:
             merge_base, changed = git_changed_files(root, args.changed)

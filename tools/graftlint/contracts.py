@@ -1,0 +1,210 @@
+"""Machine-readable contract export: the static table graftsan enforces.
+
+graftlint's dataflow engine *infers* the package's concurrency and
+determinism contracts — which `self.<lock>` owns which field (GL25xx
+majority rule + `# graftlint: owner=` pins), which functions are
+⊕-merge fold sinks with a canonical-order guarantee (GL24xx), and which
+functions are thread-entry roots.  This module serializes that table to
+`graftsan_contracts.json` so the runtime sanitizer (tools/graftsan) can
+enforce the same contracts live, without importing the lint engine at
+serve time.
+
+The export is DETERMINISTIC (sorted everywhere, no timestamps): the
+committed file mirrors the `graftlint_baseline.json` workflow — a
+stale-export guard test regenerates it and asserts a byte-identical
+no-op, so the contract table can never drift from the code it
+describes.
+
+Shape (version 1):
+
+  {
+    "version": 1,
+    "package": "spark_druid_olap_tpu",
+    "targets": [...scanned roots...],
+    "lock_ownership": [
+      {"module": ..., "class": ..., "field": ..., "lock": ...,
+       "source": "majority" | "annotation"}, ...],
+    "lock_attrs": {"<module>.<Class>": ["_lock", ...], ...},
+    "fold_sinks": [
+      {"name": ..., "kind": "canonical-fold" | "merge-sink",
+       "order": ...}, ...],
+    "thread_roots": [["<module>", "<qualname>"], ...],
+    "allow_sites": [{"path": ..., "snippet": ...}, ...]
+  }
+
+`allow_sites` are the statically SANCTIONED off-lock accesses — sites
+suppressed by a `# graftlint: disable=shared-state-races` pragma or
+grandfathered in the baseline.  The runtime witness skips them: a write
+a human has already justified to the static tier must not fail the
+dynamic one.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .core import (
+    BASELINE_NAME,
+    ModuleContext,
+    _pragma_suppressed,
+    _relpath,
+    iter_target_files,
+    load_baseline,
+)
+
+CONTRACTS_NAME = "graftsan_contracts.json"
+
+# the scan set must match the repo gate's (tests/lint_harness.TARGETS):
+# ownership evidence from tests/tools counts exactly like the gate's
+DEFAULT_TARGETS = ("spark_druid_olap_tpu", "tests", "tools", "bench.py")
+
+PACKAGE = "spark_druid_olap_tpu"
+
+# the one in-package fold accumulator with an explicit canonical-order
+# contract in its API (ascending batch index; see exec/pipeline.py)
+CANONICAL_FOLD = f"{PACKAGE}.exec.pipeline.CanonicalFold"
+
+
+def build_contract_doc(
+    root: str,
+    paths: Sequence[str] = DEFAULT_TARGETS,
+    baseline_path: Optional[str] = None,
+    package: str = PACKAGE,
+) -> dict:
+    """Parse the target tree, run the dataflow engine, and distill the
+    inferred contracts into the (sorted, deterministic) export doc."""
+    from .engine import DataflowEngine
+    from .passes import PASS_BY_NAME
+    from .project import Project
+
+    project = Project(root)
+    ctxs: List[ModuleContext] = []
+    for path in iter_target_files(root, paths):
+        with open(path) as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        ctx = ModuleContext(path, _relpath(root, path), source, tree)
+        project.add_module(ctx)
+        ctxs.append(ctx)
+    project.finalize()
+    engine = DataflowEngine(project)
+
+    prefix = package + "."
+
+    def in_package(modname: str) -> bool:
+        return modname == package or modname.startswith(prefix)
+
+    lock_ownership: List[dict] = []
+    lock_attrs: Dict[str, List[str]] = {}
+    for (modname, clsname), cc in sorted(engine.concurrency.items()):
+        if not in_package(modname) or not cc.owner:
+            continue
+        for field, lock in sorted(cc.owner.items()):
+            pins = cc.pinned.get(field, set())
+            lock_ownership.append({
+                "module": modname,
+                "class": clsname,
+                "field": field,
+                "lock": lock,
+                "source": "annotation" if pins == {lock} else "majority",
+            })
+        lock_attrs[f"{modname}.{clsname}"] = sorted(
+            cc.lock_attrs | set(cc.owner.values())
+        )
+
+    fold_cfg = PASS_BY_NAME["fold-determinism"].default_config
+    suffixes = set(fold_cfg["sink_suffixes"])
+    # who DEFINES each sink, so the runtime recorder wraps exactly the
+    # statically-known implementations (no sys.modules scanning)
+    sink_defs: Dict[str, set] = {}
+    for info in project.modules.values():
+        if not in_package(info.modname):
+            continue
+        for qual, fi in info.functions.items():
+            leaf = qual.rsplit(".", 1)[-1]
+            if leaf in suffixes:
+                sink_defs.setdefault(leaf, set()).add((
+                    info.modname,
+                    fi.cls.name if fi.cls is not None else None,
+                ))
+    fold_sinks = [{
+        "name": CANONICAL_FOLD,
+        "kind": "canonical-fold",
+        "order": "ascending-batch-index",
+    }]
+    for suffix in sorted(suffixes):
+        fold_sinks.append({
+            "name": suffix,
+            "kind": "merge-sink",
+            "order": "canonical-chain",
+            "defined_in": sorted(
+                ([m, c] for m, c in sink_defs.get(suffix, ())),
+                key=lambda mc: (mc[0], mc[1] or ""),
+            ),
+        })
+
+    # thread roots are keyed by relpath (engine convention)
+    thread_roots = sorted(
+        [rel, qualname]
+        for rel, qualname in engine.thread_roots
+        if rel.startswith(package + "/") or rel == package + ".py"
+    )
+
+    # statically sanctioned off-lock accesses: pragma-suppressed sites …
+    allow: set = set()
+    ctx_by_rel = {c.relpath: c for c in ctxs}
+    for (modname, clsname), cc in engine.concurrency.items():
+        for field, accesses in cc.accesses.items():
+            lock = cc.owner.get(field)
+            if lock is None:
+                continue
+            for acc in accesses:
+                if lock in acc.held or acc.kind not in ("write", "mutate"):
+                    continue
+                ctx = ctx_by_rel.get(acc.fi.module.relpath)
+                if ctx is None:
+                    continue
+                if _pragma_suppressed(
+                    ctx, acc.node.lineno, "shared-state-races"
+                ):
+                    allow.add((
+                        ctx.relpath, ctx.line_text(acc.node.lineno)
+                    ))
+    # … plus baseline-grandfathered GL25xx findings
+    if baseline_path is None:
+        baseline_path = os.path.join(root, BASELINE_NAME)
+    if os.path.exists(baseline_path):
+        for e in load_baseline(baseline_path):
+            if e.pass_name == "shared-state-races":
+                allow.add((e.path, e.snippet))
+
+    return {
+        "version": 1,
+        "generated_by": "python -m tools.graftlint --export-contracts",
+        "package": package,
+        "targets": sorted(paths),
+        "lock_ownership": lock_ownership,
+        "lock_attrs": dict(sorted(lock_attrs.items())),
+        "fold_sinks": fold_sinks,
+        "thread_roots": thread_roots,
+        "allow_sites": [
+            {"path": p, "snippet": s} for p, s in sorted(allow)
+        ],
+    }
+
+
+def save_contracts(path: str, doc: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_contracts(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -53,7 +53,8 @@ contract: unresolvable means silent, never guessed.
 from __future__ import annotations
 
 import ast
-from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .core import call_name, dotted_name
 from .project import FunctionInfo, ModuleInfo, Project
@@ -70,6 +71,22 @@ _MUTATORS = {
 
 def _is_lockish(attr: str) -> bool:
     return "lock" in attr.lower() or "cond" in attr.lower()
+
+
+# `# graftlint: owner=<lock>` — explicit ownership pin for a field whose
+# majority-rule inference ties (see ClassConcurrency.pinned)
+_OWNER_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*owner=([A-Za-z_]\w*)")
+
+
+def _owner_pragma(lines: Sequence[str], lineno: int) -> Optional[str]:
+    """Owner pin on the access's line or the line directly above it
+    (same placement convention as `# graftlint: disable=`)."""
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(lines):
+            m = _OWNER_PRAGMA_RE.search(lines[ln])
+            if m:
+                return m.group(1)
+    return None
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -120,7 +137,7 @@ class ClassConcurrency:
     """Learned lock-ownership facts for one class."""
 
     __slots__ = ("modname", "clsname", "lock_attrs", "owner", "accesses",
-                 "guarded_writes", "unguarded_writes")
+                 "guarded_writes", "unguarded_writes", "pinned")
 
     def __init__(self, modname: str, clsname: str):
         self.modname = modname
@@ -133,6 +150,9 @@ class ClassConcurrency:
         # field -> {lock attr -> guarded write count}
         self.guarded_writes: Dict[str, Dict[str, int]] = {}
         self.unguarded_writes: Dict[str, int] = {}
+        # field -> {lock attr} pinned by `# graftlint: owner=<lock>`
+        # annotations; a UNIQUE pin overrides the majority rule
+        self.pinned: Dict[str, Set[str]] = {}
 
     @property
     def key(self) -> Tuple[str, str]:
@@ -432,6 +452,9 @@ class DataflowEngine:
         def add(field: str, kind: str, at: ast.AST) -> None:
             if _is_lockish(field):
                 return
+            pin = _owner_pragma(fi.module.ctx.lines, at.lineno)
+            if pin is not None:
+                cc.pinned.setdefault(field, set()).add(pin)
             if record:
                 cc.accesses.setdefault(field, []).append(
                     FieldAccess(fi, at, kind, held, external)
@@ -508,11 +531,22 @@ class DataflowEngine:
         writes by MAJORITY: some lock's guarded-write count strictly
         exceeds the field's unguarded writes.  Ties stay unowned (no
         convention to enforce), as do fields only ever written in
-        `__init__` plus unguarded sites (no guarded evidence)."""
+        `__init__` plus unguarded sites (no guarded evidence).
+
+        A `# graftlint: owner=<lock>` annotation on (or directly above)
+        any access pins the field's owner explicitly, overriding the
+        majority rule — the escape hatch for ties.  Conflicting pins
+        (two different locks named for one field) cancel out and the
+        field falls back to majority."""
         for field, by_lock in cc.guarded_writes.items():
             lock, guarded = max(by_lock.items(), key=lambda kv: kv[1])
             if guarded > cc.unguarded_writes.get(field, 0):
                 cc.owner[field] = lock
+        for field, locks in cc.pinned.items():
+            if len(locks) == 1:
+                lock = next(iter(locks))
+                cc.owner[field] = lock
+                cc.lock_attrs.add(lock)
 
     # -- external typed references (singletons + annotated params) -------------
 

@@ -14,8 +14,8 @@ lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
 serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
 GL20xx storage-discipline, GL21xx dispatch-discipline, GL22xx
 mesh-discipline, GL23xx broker-discipline, GL24xx fold-determinism,
-GL25xx shared-state-races; GL00x are the core's own: GL001 unparseable
-file, GL002 malformed pragma).
+GL25xx shared-state-races, GL26xx sanitizer-discipline; GL00x are the
+core's own: GL001 unparseable file, GL002 malformed pragma).
 
 The GL24xx/GL25xx families are interprocedural: they run on
 `engine.DataflowEngine` (bound to every pass as `self.engine`), which
@@ -47,6 +47,7 @@ from .obs_discipline import ObsDisciplinePass
 from .pallas_shape import PallasShapePass
 from .partial_discipline import PartialDisciplinePass
 from .resource_budget import ResourceBudgetPass
+from .sanitizer_discipline import SanitizerDisciplinePass
 from .serving_discipline import ServingDisciplinePass
 from .shared_state_races import SharedStateRacesPass
 from .span_discipline import SpanDisciplinePass
@@ -81,6 +82,7 @@ ALL_PASSES = (
     BrokerDisciplinePass,
     FoldDeterminismPass,
     SharedStateRacesPass,
+    SanitizerDisciplinePass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,160 @@
+"""Sanitizer-discipline pass: graftsan probes must stay off traced paths
+and behind the arm check.
+
+The runtime sanitizer (tools/graftsan) is only sound if its probes are
+(a) invisible to XLA — a probe inside a `@jit`/kernel body runs at
+TRACE time, once, recording a single bogus witness and then vanishing
+from the compiled program — and (b) strictly free when disarmed, which
+means every probe call site in product code must be lexically guarded
+by the `SDOL_SANITIZE` arm check (or the `_sched_hook is not None`
+null-hook idiom resilience uses).  Checks:
+
+* **GL2601** — graftsan probe/assertion call inside a traced function
+  (jit decorator or configured kernel suffix): the witness would be
+  trace-time constant-folded, enforcing nothing, and the closure it
+  captures can leak tracers.
+* **GL2602** — graftsan probe call in product code not lexically inside
+  an `if` whose test mentions an arm symbol (`SDOL_SANITIZE`,
+  `_sched_hook`, `enabled`, ...): the probe would run — and pay — in
+  every unsanitized process.
+
+Probe calls are identified by canonical prefix (`tools.graftsan.`) or
+configured bare names (`_sched_hook`, the hook resilience dispatches
+through).  The sanitizer's own package and the tests are out of scope:
+graftsan calling itself is not a probe site, and fixtures must be able
+to spell violations.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import (
+    LintPass,
+    ModuleContext,
+    call_name,
+    has_jit_decorator,
+)
+
+
+class SanitizerDisciplinePass(LintPass):
+    name = "sanitizer-discipline"
+    default_config = {
+        # product code only: graftsan itself and the tests are exempt
+        "include": ("spark_druid_olap_tpu/",),
+        # canonical dotted prefixes that mark a call as a graftsan probe
+        "probe_prefixes": ("tools.graftsan.", "graftsan."),
+        # bare callable names that are probes wherever they appear
+        "probe_names": ("_sched_hook",),
+        # identifiers whose presence in an enclosing `if` test counts as
+        # the arm check
+        "arm_symbols": (
+            "SDOL_SANITIZE", "_sched_hook", "enabled", "sanitize",
+        ),
+        "kernel_name_suffixes": ("_kernel",),
+    }
+
+    # -- probe identification -------------------------------------------------
+
+    def _is_probe(self, ctx: ModuleContext, node: ast.Call) -> bool:
+        name = call_name(node)
+        if not name:
+            return False
+        # dotted_name strips leading underscores on the first segment,
+        # so compare probe names underscore-insensitively
+        if any(
+            name.lstrip("_") == p.lstrip("_")
+            for p in self.config["probe_names"]
+        ):
+            return True
+        canon = name
+        if self.project is not None:
+            info = self.project.modules.get(ctx.relpath)
+            if info is not None:
+                canon = self.project.canonical(info, name) or name
+        return any(
+            canon.startswith(p) or name.startswith(p)
+            for p in self.config["probe_prefixes"]
+        )
+
+    # -- traced-scope / guard tests -------------------------------------------
+
+    def _is_traced(self, func: ast.AST) -> bool:
+        if has_jit_decorator(func):
+            return True
+        name = getattr(func, "name", "")
+        return any(
+            name.endswith(sfx)
+            for sfx in self.config["kernel_name_suffixes"]
+        )
+
+    def _armed(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """Is the call lexically inside an `if`/`while`/ternary/boolop
+        whose test references an arm symbol?"""
+        arm = self.config["arm_symbols"]
+
+        def test_mentions(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and any(
+                    a in n.id for a in arm
+                ):
+                    return True
+                if isinstance(n, ast.Attribute) and any(
+                    a in n.attr for a in arm
+                ):
+                    return True
+                if isinstance(n, ast.Constant) and isinstance(
+                    n.value, str
+                ) and any(a in n.value for a in arm):
+                    return True
+            return False
+
+        prev = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.If, ast.While, ast.IfExp)):
+                # a probe INSIDE the test is the arm check itself
+                # (`if graftsan.enabled():`)
+                if anc.test is prev:
+                    return True
+                # guarded only when we sit in the BODY, not the test
+                # (and an `else` branch is the unarmed path)
+                orelse = getattr(anc, "orelse", None)
+                in_else = (
+                    prev in orelse if isinstance(orelse, list)
+                    else prev is orelse
+                )
+                if not in_else and test_mentions(anc.test):
+                    return True
+            elif isinstance(anc, ast.BoolOp) and isinstance(
+                anc.op, ast.And
+            ):
+                # `_sched_hook and _sched_hook(site)` short-circuit
+                if anc.values and anc.values[-1] is prev and any(
+                    test_mentions(v) for v in anc.values[:-1]
+                ):
+                    return True
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            prev = anc
+        return False
+
+    # -- handler ---------------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        if not self._is_probe(ctx, node):
+            return
+        if any(self._is_traced(f) for f in ctx.scope.func_stack):
+            self.report(
+                ctx, node, "GL2601",
+                f"graftsan probe `{call_name(node)}` inside a traced "
+                "body: it runs once at TRACE time (a constant-folded "
+                "witness enforces nothing) and can capture tracers",
+            )
+            return
+        if not self._armed(ctx, node):
+            self.report(
+                ctx, node, "GL2602",
+                f"graftsan probe `{call_name(node)}` is not guarded by "
+                "the SDOL_SANITIZE arm check (or a `<hook> is not "
+                "None` test): every unsanitized process pays for it",
+            )

@@ -1,0 +1,49 @@
+"""graftsan: runtime sanitizer enforcing graftlint's inferred contracts.
+
+graftlint (tools/graftlint) *infers* the package's concurrency and
+determinism contracts statically: majority-rule lock ownership (GL25xx),
+order-taint into the ⊕-merge folds (GL24xx), thread-entry roots.
+Inference is heuristic and static; nothing verified those contracts
+against what actually executes.  graftsan closes the loop — it consumes
+the machine-readable contract table exported by
+`python -m tools.graftlint --export-contracts` (committed as
+`graftsan_contracts.json`) and enforces it live:
+
+  * **Lock-witness layer** (witness.py) — monkey-wraps the owned
+    classes' `__setattr__`/container mutators (no `sys.setprofile`, no
+    tracing), records the actually-held lock set at every owned-field
+    write, and fails loudly on an off-lock write: GL2501-04 as runtime
+    assertions.
+  * **Fold-order recorder** (foldorder.py) — stamps each
+    `CanonicalFold` / `merge_*_states` invocation with the observed
+    operand order and asserts the canonical-order guarantee
+    (ascending batch index; no self-fold aliasing).
+  * **Deterministic schedule explorer** (scheduler.py) — rides the
+    existing `resilience.checkpoint`/`fire` sites as yield points; a
+    seeded scheduler perturbs thread interleavings and every failure
+    message carries the seed for exact replay (`SDOL_SCHED_SEED`).
+  * **Divergence report** (report.py) — reconciles runtime witness data
+    against the static table in both directions: fields graftlint calls
+    owned that runtime never saw locked, and fields runtime always saw
+    locked that graftlint left unowned (pin those with
+    `# graftlint: owner=<lock>`).
+
+Arming: `SDOL_SANITIZE=1` plus `install()`.  When not installed there
+are STRICTLY ZERO probes — no wrapper is in place anywhere, the only
+residue being `resilience.fire`'s `_sched_hook is None` check (the same
+zero-cost idiom as the fault injector); regression-tested by counting
+probe calls on the cached-program path.
+"""
+
+from .sanitizer import (  # noqa: F401
+    ENV_ARM,
+    ENV_SEED,
+    SanitizerViolation,
+    Sanitizer,
+    current,
+    enabled,
+    install,
+    probe_count,
+    uninstall,
+)
+from .report import divergence_report, stats_doc  # noqa: F401

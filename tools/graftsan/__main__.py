@@ -1,0 +1,164 @@
+"""graftsan CLI: sanitized smoke hammer + one-line stats.
+
+`python -m tools.graftsan --smoke` builds a small in-process serving
+context, hammers it from a few threads (queries + ingest appends) with
+every sanitizer layer armed, then prints the divergence report and a
+one-line `graftsan --stats {...}` JSON matching graftlint's `--stats`
+shape.  Exit 1 on any violation or divergence — this is what
+`tools/lint_precommit.sh --sanitize-smoke` runs.
+
+`--overhead` runs the same hammer twice (armed, then fully uninstalled)
+and adds the wall-clock ratio to the stats line: the probes-only-when-
+armed proof in one number.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _build_ctx():
+    import numpy as np
+
+    import spark_druid_olap_tpu as sd
+    from spark_druid_olap_tpu.config import SessionConfig
+
+    ctx = sd.TPUOlapContext(SessionConfig.load_calibrated())
+    n = 2000
+    rng = np.random.default_rng(7)
+    ctx.register_table(
+        "ev",
+        {
+            "city": rng.choice(
+                np.array(["NY", "SF", "LA", "CHI"], dtype=object), n
+            ),
+            "qty": rng.integers(1, 9, n).astype(np.int64),
+            "rev": rng.random(n).astype(np.float32),
+        },
+        dimensions=["city"],
+        metrics=["qty", "rev"],
+    )
+    return ctx
+
+
+def _hammer(ctx, threads: int = 4, iters: int = 3) -> None:
+    import numpy as np
+
+    errors = []
+
+    def worker(wid: int):
+        try:
+            for i in range(iters):
+                ctx.sql(
+                    "SELECT city, SUM(rev) AS r, COUNT(*) AS c "
+                    "FROM ev GROUP BY city"
+                )
+                if wid % 2 == 0:
+                    ctx.append_rows("ev", {
+                        "city": np.array(["NY"], dtype=object),
+                        "qty": np.array([1], dtype=np.int64),
+                        "rev": np.array([1.0], dtype=np.float32),
+                    })
+        except Exception as e:  # surfaced below; keep other workers going
+            errors.append(e)
+
+    ts = [
+        threading.Thread(target=worker, args=(w,), name=f"hammer-{w}")
+        for w in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.graftsan")
+    ap.add_argument(
+        "--contracts", default=None,
+        help="contract table path (default: <root>/graftsan_contracts"
+             ".json)",
+    )
+    ap.add_argument(
+        "--root", default=os.getcwd(),
+        help="repo root (frame paths resolve against it)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="run the sanitized in-process serve+ingest hammer",
+    )
+    ap.add_argument(
+        "--seed", type=int, default=None,
+        help="schedule-explorer seed (default: SDOL_SCHED_SEED or 0)",
+    )
+    ap.add_argument(
+        "--overhead", action="store_true",
+        help="also time the hammer with the sanitizer uninstalled and "
+             "report the armed/unarmed wall ratio",
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="emit the one-line machine-readable JSON stats "
+             "(graftlint --stats shape)",
+    )
+    args = ap.parse_args(argv)
+
+    if not args.smoke:
+        ap.error("nothing to do: pass --smoke")
+
+    from tools import graftsan
+
+    os.environ.setdefault(graftsan.ENV_ARM, "1")
+    san = graftsan.install(
+        contracts_path=args.contracts, root=args.root, seed=args.seed
+    )
+    try:
+        ctx = _build_ctx()
+        t0 = time.perf_counter()
+        _hammer(ctx)
+        armed_s = time.perf_counter() - t0
+    except graftsan.SanitizerViolation as e:
+        print(f"graftsan: VIOLATION {e}", file=sys.stderr)
+        return 1
+    finally:
+        divergences = graftsan.divergence_report(san)
+        doc = graftsan.stats_doc(san)
+        graftsan.uninstall()
+
+    doc["smoke_seconds"] = round(armed_s, 3)
+    if args.overhead:
+        ctx2 = _build_ctx()
+        t0 = time.perf_counter()
+        _hammer(ctx2)
+        bare_s = time.perf_counter() - t0
+        doc["overhead_ratio"] = round(armed_s / max(bare_s, 1e-9), 3)
+        doc["unarmed_probes"] = graftsan.probe_count()
+
+    for v in san.violations:
+        print(f"graftsan: VIOLATION [{v['kind']}] {v['message']} "
+              f"at {v['path']}:{v['line']}", file=sys.stderr)
+    for d in divergences:
+        print(f"graftsan: DIVERGENCE [{d['kind']}] {d['class']}."
+              f"{d['field']}: {d['detail']}", file=sys.stderr)
+    if args.stats:
+        print("graftsan --stats " + json.dumps(doc, sort_keys=True))
+    else:
+        print(
+            f"graftsan --smoke: {doc['violations']} violation(s), "
+            f"{doc['divergences']} divergence(s), "
+            f"{doc['witnesses']['writes']} witnessed write(s), "
+            f"{doc['witnesses']['sched_points']} schedule point(s) "
+            f"in {doc['smoke_seconds']}s [seed {san.seed}]"
+        )
+    return 1 if (san.violations or divergences) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""Fold-order recorder: the ⊕-merge algebra must fold canonically.
+
+Partial-state merges are not reassociation-safe (f32 sums, scatter-based
+sketch merges), so the static tier (GL24xx) pins two contracts this
+layer enforces live:
+
+  * **CanonicalFold** (`exec/pipeline.py`) drains per-batch results in
+    ascending batch index no matter the dispatch order.  The recorder
+    temporarily swaps the instance's `_fold` callable for a recording
+    shim around each `add`/`drain`, stamps the observed operand order
+    (batch indices, recovered by object identity from the pending map),
+    and asserts it is strictly ascending from the pre-call `_next`
+    watermark.  No fold logic is reimplemented — the original method
+    runs unmodified and the stamp is taken from what it actually did.
+  * **merge_*_states sinks** fold pairwise (`a ⊕ b`).  Chain/tree shape
+    is caller-dependent (the multi-slice merge trees reassociate
+    deliberately), so the always-true invariant asserted here is
+    aliasing: folding a state into ITSELF (`a is b`) double-counts and
+    is flagged; each invocation is stamped with its operand shape
+    (leaf vs prior-product per operand) for the report.
+
+Both hooks are installed by monkey-wrap and removed exactly on
+uninstall; an uninstalled process runs the original bytecode.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+import threading
+from time import perf_counter
+from typing import Dict, List, Optional, Tuple
+
+_tls = threading.local()
+
+# per-thread cap on remembered sink products (chain-shape stamping)
+_PRODUCED_CAP = 256
+
+
+def _produced() -> Dict[int, None]:
+    d = getattr(_tls, "produced", None)
+    if d is None:
+        d = _tls.produced = {}
+    return d
+
+
+class FoldOrderLayer:
+    def __init__(self, san):
+        self.san = san
+        self.probes = 0
+        self.seconds = 0.0
+        # sink name -> {"calls": n, "shapes": {"leaf⊕leaf": n, ...}}
+        self.sinks: Dict[str, dict] = {}
+        self.fold_calls = 0      # CanonicalFold add/drain observed
+        self.fold_unverified = 0  # identity-ambiguous operand sets
+        self._sink_lock = threading.Lock()
+        self._saved: List[Tuple[object, str, object]] = []
+
+    # -- install / uninstall -------------------------------------------------
+
+    def install(self) -> None:
+        for sink in self.san.contracts.get("fold_sinks", ()):
+            if sink["kind"] == "canonical-fold":
+                self._wrap_canonical_fold(sink["name"])
+            else:
+                for modname, clsname in sink.get("defined_in", ()):
+                    self._wrap_merge_sink(sink["name"], modname, clsname)
+
+    def uninstall(self) -> None:
+        for holder, name, orig in reversed(self._saved):
+            setattr(holder, name, orig)
+        self._saved = []
+
+    @staticmethod
+    def _import_holder(modname: str, clsname: Optional[str]):
+        mod = sys.modules.get(modname)
+        if mod is None:
+            try:
+                mod = importlib.import_module(modname)
+            except ImportError:
+                return None
+        if clsname is None:
+            return mod
+        holder = getattr(mod, clsname, None)
+        return holder if isinstance(holder, type) else None
+
+    # -- CanonicalFold -------------------------------------------------------
+
+    def _wrap_canonical_fold(self, dotted: str) -> None:
+        modname, _, clsname = dotted.rpartition(".")
+        cls = self._import_holder(modname, clsname)
+        if cls is None:
+            return
+        layer = self
+        orig_add = cls.add
+        orig_drain = cls.drain
+
+        def add(self, bi, value):
+            return layer._observed(
+                self, orig_add, (bi, value), extra={id(value): bi}
+            )
+
+        def drain(self):
+            return layer._observed(self, orig_drain, ())
+
+        self._saved.append((cls, "add", orig_add))
+        self._saved.append((cls, "drain", orig_drain))
+        cls.add = add
+        cls.drain = drain
+
+    def _observed(self, fold_self, orig, args, extra=None):
+        t0 = perf_counter()
+        self.probes += 1
+        self.fold_calls += 1
+        idmap = {id(v): bi for bi, v in fold_self._pending.items()}
+        if extra:
+            idmap.update(extra)
+        # identity-ambiguous pending set (one object under two batch
+        # indices): the stamp would lie, so skip the check, count it
+        ambiguous = len(idmap) < len(fold_self._pending) + len(extra or ())
+        next_before = fold_self._next
+        real = fold_self._fold
+        seen: List[Optional[int]] = []
+
+        def recording(v):
+            seen.append(idmap.get(id(v)))
+            return real(v)
+
+        fold_self._fold = recording
+        try:
+            return orig(fold_self, *args)
+        finally:
+            fold_self._fold = real
+            self.seconds += perf_counter() - t0
+            if ambiguous or None in seen:
+                self.fold_unverified += 1
+            elif seen:
+                ok = all(
+                    b > a for a, b in zip(seen, seen[1:])
+                ) and seen[0] >= next_before
+                if not ok:
+                    self.san.violation(
+                        "fold-order",
+                        f"CanonicalFold folded batches {seen} "
+                        f"(watermark {next_before}); the contract is "
+                        "strictly ascending batch index",
+                    )
+
+    # -- pairwise merge sinks ------------------------------------------------
+
+    def _wrap_merge_sink(self, name: str, modname: str,
+                         clsname: Optional[str]) -> None:
+        holder = self._import_holder(modname, clsname)
+        if holder is None:
+            return
+        orig = holder.__dict__.get(name) if isinstance(holder, type) \
+            else getattr(holder, name, None)
+        if orig is None:
+            return
+        layer = self
+
+        def wrapped(*args, **kwargs):
+            t0 = perf_counter()
+            layer.probes += 1
+            ops = list(args[-2:]) if len(args) >= 2 else []
+            if len(ops) == 2 and ops[0] is ops[1]:
+                layer.san.violation(
+                    "fold-aliasing",
+                    f"{name} folded a partial state into itself "
+                    "(a is b): the ⊕ result double-counts",
+                )
+            result = orig(*args, **kwargs)
+            produced = _produced()
+            shape = "⊕".join(
+                "product" if id(o) in produced else "leaf" for o in ops
+            ) or "unknown"
+            produced[id(result)] = None
+            while len(produced) > _PRODUCED_CAP:
+                produced.pop(next(iter(produced)))
+            with layer._sink_lock:
+                rec = layer.sinks.setdefault(
+                    name, {"calls": 0, "shapes": {}}
+                )
+                rec["calls"] += 1
+                rec["shapes"][shape] = rec["shapes"].get(shape, 0) + 1
+            layer.seconds += perf_counter() - t0
+            return result
+
+        self._saved.append((holder, name, orig))
+        setattr(holder, name, wrapped)

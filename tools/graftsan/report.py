@@ -1,0 +1,104 @@
+"""Divergence report + stats: reconcile runtime witnesses vs the table.
+
+Two directions shrink the static heuristic's gap:
+
+  * **static-owned-never-locked** — graftlint says `(cls, field)` is
+    owned by a lock, the field saw provable post-construction writes at
+    runtime, and NOT ONE of them held the owning lock.  Either the
+    majority rule latched onto incidental guarding, or every caller is
+    off-lock (and the witness already flagged each as a violation);
+    both deserve eyes.
+  * **runtime-locked-not-owned** — graftlint left the field unowned
+    (majority tie, or writes it cannot see through untyped locals), but
+    every one of ≥ `min_writes` runtime writes held the SAME non-empty
+    lock set.  The code clearly follows a convention the static tier
+    missed: pin it with `# graftlint: owner=<lock>` so the contract
+    table enforces it from then on.
+
+Unknown-held writes (raw pre-install locks) are excluded from both
+directions — the report never claims what the witness could not prove.
+
+`stats_doc` mirrors graftlint's `--stats` one-line JSON shape:
+violation/witness/divergence counts plus per-layer seconds.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def divergence_report(san, min_writes: int = 3) -> List[dict]:
+    out: List[dict] = []
+    owned = {}
+    for row in san.contracts.get("lock_ownership", ()):
+        owned[(f"{row['module']}.{row['class']}", row["field"])] = (
+            row["lock"]
+        )
+    records = san.witness.records
+    for (clskey, field), w in sorted(records.items()):
+        lock = owned.get((clskey, field))
+        if lock is not None:
+            if w.writes > 0 and not any(
+                lock in sig for sig in w.by_sig
+            ):
+                out.append({
+                    "kind": "static-owned-never-locked",
+                    "class": clskey,
+                    "field": field,
+                    "lock": lock,
+                    "writes": w.writes,
+                    "detail": (
+                        f"{w.writes} provable write(s), none under "
+                        f"{lock!r}"
+                    ),
+                })
+        else:
+            sigs = [s for s in w.by_sig if s]
+            if (
+                w.writes >= min_writes
+                and len(w.by_sig) == 1
+                and len(sigs) == 1
+            ):
+                locks = "+".join(sorted(sigs[0]))
+                out.append({
+                    "kind": "runtime-locked-not-owned",
+                    "class": clskey,
+                    "field": field,
+                    "lock": locks,
+                    "writes": w.writes,
+                    "detail": (
+                        f"all {w.writes} write(s) held {locks!r}; pin "
+                        f"with `# graftlint: owner={locks}`"
+                    ),
+                })
+    return out
+
+
+def stats_doc(san) -> dict:
+    """One-line machine-readable summary, graftlint `--stats` shaped."""
+    divergences = divergence_report(san)
+    return {
+        "violations": len(san.violations),
+        "witnesses": {
+            "writes": sum(
+                w.writes + w.init_writes + w.unknown
+                for w in san.witness.records.values()
+            ),
+            "fields": len(san.witness.records),
+            "fold_calls": san.foldorder.fold_calls,
+            "merge_sink_calls": sum(
+                rec["calls"] for rec in san.foldorder.sinks.values()
+            ),
+            "sched_points": san.scheduler.probes,
+            "sched_yields": san.scheduler.yields,
+        },
+        "divergences": len(divergences),
+        "classes_instrumented": len(san.classes),
+        "probes": san.probes,
+        "seed": san.seed,
+        "per_layer_seconds": {
+            "witness": round(san.witness.seconds, 4),
+            "foldorder": round(san.foldorder.seconds, 4),
+            "scheduler": round(san.scheduler.seconds, 4),
+        },
+    }

@@ -1,0 +1,233 @@
+"""Sanitizer core: contract loading, arming, violations, layer lifecycle.
+
+The `Sanitizer` object owns the three enforcement layers (lock witness,
+fold-order recorder, schedule explorer) plus the violation sink every
+layer reports into.  `install()` wraps the contract classes and hooks
+the scheduler; `uninstall()` restores every wrapped attribute exactly —
+the disabled process is byte-for-byte the unwrapped one.
+
+Violations are `SanitizerViolation` (an `AssertionError` subclass: a
+contract the static tier proved is being broken at runtime, not an
+operational error).  Every message carries the schedule seed so a
+failure found under an explored interleaving replays exactly with
+`SDOL_SCHED_SEED=<seed>`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import linecache
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_ARM = "SDOL_SANITIZE"
+ENV_SEED = "SDOL_SCHED_SEED"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def enabled() -> bool:
+    """The `SDOL_SANITIZE=1` arm check every probe helper is gated on."""
+    return os.environ.get(ENV_ARM, "").lower() in _TRUTHY
+
+
+class SanitizerViolation(AssertionError):
+    """A runtime breach of a statically inferred contract."""
+
+
+class ClassSpec:
+    """One contract class resolved against the live interpreter."""
+
+    __slots__ = ("key", "cls", "lock_attrs", "owned")
+
+    def __init__(self, key: str, cls: type, lock_attrs: Set[str],
+                 owned: Dict[str, str]):
+        self.key = key          # "pkg.module.Class"
+        self.cls = cls
+        self.lock_attrs = lock_attrs
+        self.owned = owned      # field -> owning lock attr
+
+
+_current: Optional["Sanitizer"] = None
+_install_lock = threading.Lock()
+
+
+def current() -> Optional["Sanitizer"]:
+    return _current
+
+
+def probe_count() -> int:
+    """Total probe invocations across all layers (0 when uninstalled —
+    the zero-cost regression tests count this on the cached path)."""
+    san = _current
+    return san.probes if san is not None else 0
+
+
+def default_contracts_path(root: Optional[str] = None) -> str:
+    from tools.graftlint.contracts import CONTRACTS_NAME
+
+    return os.path.join(root or os.getcwd(), CONTRACTS_NAME)
+
+
+class Sanitizer:
+    """Holds contracts + layers + the violation/witness sinks."""
+
+    def __init__(self, contracts: dict, root: str,
+                 raise_on_violation: bool = True,
+                 seed: Optional[int] = None):
+        from .foldorder import FoldOrderLayer
+        from .scheduler import ScheduleExplorer
+        from .witness import WitnessLayer
+
+        self.contracts = contracts
+        self.root = os.path.abspath(root)
+        self.raise_on_violation = raise_on_violation
+        if seed is None:
+            env = os.environ.get(ENV_SEED)
+            seed = int(env) if env else 0
+        self.seed = int(seed)
+        self.violations: List[dict] = []
+        self._vlock = threading.Lock()
+        self.classes: Dict[str, ClassSpec] = self._resolve_classes()
+        self.allow_sites: Set[Tuple[str, str]] = {
+            (a["path"], a["snippet"])
+            for a in contracts.get("allow_sites", ())
+        }
+        self.witness = WitnessLayer(self)
+        self.foldorder = FoldOrderLayer(self)
+        self.scheduler = ScheduleExplorer(self, self.seed)
+        self._installed = False
+
+    # -- contract resolution -------------------------------------------------
+
+    def _resolve_classes(self) -> Dict[str, ClassSpec]:
+        owned_by_cls: Dict[str, Dict[str, str]] = {}
+        for row in self.contracts.get("lock_ownership", ()):
+            key = f"{row['module']}.{row['class']}"
+            owned_by_cls.setdefault(key, {})[row["field"]] = row["lock"]
+        specs: Dict[str, ClassSpec] = {}
+        for key, locks in self.contracts.get("lock_attrs", {}).items():
+            modname, _, clsname = key.rpartition(".")
+            cls = self._import_class(modname, clsname)
+            if cls is None:
+                continue
+            specs[key] = ClassSpec(
+                key, cls, set(locks), owned_by_cls.get(key, {})
+            )
+        return specs
+
+    @staticmethod
+    def _import_class(modname: str, clsname: str) -> Optional[type]:
+        mod = sys.modules.get(modname)
+        if mod is None:
+            try:
+                mod = importlib.import_module(modname)
+            except ImportError:
+                return None
+        cls = getattr(mod, clsname, None)
+        return cls if isinstance(cls, type) else None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self, schedule: bool = True) -> "Sanitizer":
+        global _current
+        with _install_lock:
+            if _current is not None:
+                raise RuntimeError("a sanitizer is already installed")
+            self.witness.install()
+            self.foldorder.install()
+            if schedule:
+                self.scheduler.install()
+            self._installed = True
+            _current = self
+        return self
+
+    def uninstall(self) -> None:
+        global _current
+        with _install_lock:
+            if not self._installed:
+                return
+            self.scheduler.uninstall()
+            self.foldorder.uninstall()
+            self.witness.uninstall()
+            self._installed = False
+            if _current is self:
+                _current = None
+
+    # -- shared probe accounting --------------------------------------------
+
+    @property
+    def probes(self) -> int:
+        return (
+            self.witness.probes
+            + self.foldorder.probes
+            + self.scheduler.probes
+        )
+
+    # -- violations ----------------------------------------------------------
+
+    def caller_site(self, depth: int = 2) -> Tuple[str, int, str]:
+        """(relpath, lineno, stripped source line) of the first frame
+        outside the sanitizer itself — the code that performed the
+        offending access."""
+        pkg_dir = os.path.dirname(os.path.abspath(__file__))
+        f = sys._getframe(depth)
+        while f is not None:
+            fn = os.path.abspath(f.f_code.co_filename)
+            if not fn.startswith(pkg_dir):
+                rel = os.path.relpath(fn, self.root).replace(os.sep, "/")
+                snippet = linecache.getline(fn, f.f_lineno).strip()
+                return rel, f.f_lineno, snippet
+            f = f.f_back
+        return "<unknown>", 0, ""
+
+    def violation(self, kind: str, message: str,
+                  site: Optional[Tuple[str, int, str]] = None) -> None:
+        if site is None:
+            site = self.caller_site(depth=3)
+        rel, line, snippet = site
+        if (rel, snippet) in self.allow_sites:
+            return  # statically sanctioned (pragma / baseline)
+        entry = {
+            "kind": kind,
+            "message": message,
+            "path": rel,
+            "line": line,
+            "snippet": snippet,
+            "thread": threading.current_thread().name,
+            "seed": self.seed,
+        }
+        with self._vlock:
+            self.violations.append(entry)
+        if self.raise_on_violation:
+            raise SanitizerViolation(
+                f"graftsan[{kind}] {message} at {rel}:{line} "
+                f"({snippet!r}) [replay: {ENV_SEED}={self.seed}]"
+            )
+
+
+def install(contracts_path: Optional[str] = None, root: Optional[str] = None,
+            raise_on_violation: bool = True, seed: Optional[int] = None,
+            schedule: bool = True) -> Sanitizer:
+    """Load the contract table and arm every layer.  `root` defaults to
+    the directory holding the contracts file (frame relpaths and allow
+    sites are resolved against it)."""
+    if contracts_path is None:
+        contracts_path = default_contracts_path(root)
+    with open(contracts_path) as f:
+        contracts = json.load(f)
+    if root is None:
+        root = os.path.dirname(os.path.abspath(contracts_path))
+    san = Sanitizer(
+        contracts, root, raise_on_violation=raise_on_violation, seed=seed
+    )
+    return san.install(schedule=schedule)
+
+
+def uninstall() -> None:
+    san = _current
+    if san is not None:
+        san.uninstall()

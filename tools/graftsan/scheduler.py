@@ -1,0 +1,80 @@
+"""Deterministic schedule explorer riding the resilience fault sites.
+
+Every `resilience.checkpoint(site)` / `fire(site)` call is already a
+named instrumentation point on the hot concurrency paths (engine batch
+loops, WAL appends, broker scatter/gather, cluster RPC).  The explorer
+installs itself as `resilience.set_schedule_hook` and, at each firing,
+decides deterministically — from `hash(seed, site, per-site ordinal)` —
+whether to perturb the interleaving with a tiny sleep or a bare yield.
+
+Determinism model: the decision at the K-th firing of site S is a pure
+function of (seed, S, K).  Re-running the same test with the same seed
+replays the same per-site decision sequence, which is what makes a
+race found under exploration reproducible: the failure message carries
+the seed, `SDOL_SCHED_SEED=<seed>` replays it.
+
+The hook is product-code-free: resilience guards the call behind
+`if _sched_hook is not None` (the injector's zero-cost idiom), so an
+unarmed process pays one global None check per site.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from time import perf_counter
+from typing import Dict
+
+
+class ScheduleExplorer:
+    def __init__(self, san, seed: int, p_yield: float = 0.25,
+                 max_sleep_us: int = 300):
+        self.san = san
+        self.seed = int(seed)
+        self.p_yield = float(p_yield)
+        self.max_sleep_us = int(max_sleep_us)
+        self.probes = 0
+        self.yields = 0
+        self.seconds = 0.0
+        self.site_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._installed = False
+
+    def install(self) -> None:
+        from spark_druid_olap_tpu import resilience
+
+        resilience.set_schedule_hook(self.point)
+        self._installed = True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        from spark_druid_olap_tpu import resilience
+
+        resilience.set_schedule_hook(None)
+        self._installed = False
+
+    def decision(self, site: str, ordinal: int):
+        """(perturb?, sleep_seconds) — pure in (seed, site, ordinal)."""
+        h = int.from_bytes(
+            hashlib.sha256(
+                f"{self.seed}|{site}|{ordinal}".encode()
+            ).digest()[:8],
+            "big",
+        )
+        if (h & 0xFFFFF) / float(0x100000) >= self.p_yield:
+            return False, 0.0
+        return True, ((h >> 24) % (self.max_sleep_us + 1)) / 1e6
+
+    def point(self, site: str) -> None:
+        t0 = perf_counter()
+        self.probes += 1
+        with self._lock:
+            n = self.site_counts.get(site, 0)
+            self.site_counts[site] = n + 1
+        perturb, sleep_s = self.decision(site, n)
+        if perturb:
+            self.yields += 1
+            time.sleep(sleep_s)  # 0.0 is a bare GIL yield
+        self.seconds += perf_counter() - t0

@@ -1,0 +1,360 @@
+"""Lock-witness layer: owned-field writes must hold the owning lock.
+
+Instrumentation is a `sys.setprofile`-FREE monkey-wrap of each contract
+class (no tracing, no interpreter hooks — disabled means *no probe
+exists anywhere*):
+
+  * `__setattr__` is wrapped: every non-lock attribute write records a
+    witness (which of the instance's contract locks the writing thread
+    actually held) and an off-lock write to an OWNED field is a
+    violation.
+  * Lock attributes themselves are wrapped in a `WitnessLock` proxy at
+    assignment, which tracks the owning thread + reentrancy count so
+    "does the current thread hold `self._lock`" is answerable without
+    touching interpreter internals.
+  * Owned fields assigned a plain `dict`/`list` get a witness container
+    subclass whose mutators re-check the lock — `self._tables[k] = v`
+    off-lock is the GL2502 shape, invisible to `__setattr__`.
+  * `__init__` is wrapped to mark the instance under construction
+    (thread-local): constructor writes are exempt, like the static
+    engine's `__init__` exemption, but MORE precise — helpers called
+    from the constructor are exempt too, and a second thread touching a
+    half-built instance is not.
+
+Instances that predate `install()` (import-time singletons like the
+metrics registry) still carry raw locks; for those the witness falls
+back to `RLock._is_owned()`/`Lock.locked()` and treats "locked, but
+unattributable" as unknown rather than a violation — the witness never
+reports what it cannot prove.
+"""
+
+from __future__ import annotations
+
+import threading
+from time import perf_counter
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+_tls = threading.local()
+
+
+def _constructing() -> Set[int]:
+    s = getattr(_tls, "constructing", None)
+    if s is None:
+        s = _tls.constructing = set()
+    return s
+
+
+def _is_lock_like(value) -> bool:
+    return (
+        hasattr(value, "acquire")
+        and hasattr(value, "release")
+        and not isinstance(value, WitnessLock)
+    )
+
+
+class WitnessLock:
+    """Owner-tracking proxy around a `threading` lock/RLock/Condition.
+
+    All lock semantics delegate to the wrapped object; the proxy only
+    bookkeeps (owner thread id, reentrancy count) so `held_by_me()` is a
+    cheap exact answer.  The bookkeeping fields are written while the
+    inner lock is held (right after a successful acquire, right before
+    the matching release), so they are themselves race-free."""
+
+    __slots__ = ("_gs_inner", "_gs_label", "_gs_owner", "_gs_count")
+
+    def __init__(self, inner, label: str):
+        self._gs_inner = inner
+        self._gs_label = label
+        self._gs_owner: Optional[int] = None
+        self._gs_count = 0
+
+    def held_by_me(self) -> bool:
+        return (
+            self._gs_count > 0
+            and self._gs_owner == threading.get_ident()
+        )
+
+    def acquire(self, *args, **kwargs):
+        got = self._gs_inner.acquire(*args, **kwargs)
+        if got is not False:
+            self._gs_owner = threading.get_ident()
+            self._gs_count += 1
+        return got
+
+    def release(self):
+        self._gs_count -= 1
+        if self._gs_count <= 0:
+            self._gs_owner = None
+            self._gs_count = 0
+        self._gs_inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition support: wait() releases the lock inside the callee, so
+    # the bookkeeping must be parked and restored around it.
+    def wait(self, timeout=None):
+        saved = (self._gs_owner, self._gs_count)
+        self._gs_owner, self._gs_count = None, 0
+        try:
+            return self._gs_inner.wait(timeout)
+        finally:
+            self._gs_owner, self._gs_count = saved
+
+    def wait_for(self, predicate, timeout=None):
+        saved = (self._gs_owner, self._gs_count)
+        self._gs_owner, self._gs_count = None, 0
+        try:
+            return self._gs_inner.wait_for(predicate, timeout)
+        finally:
+            self._gs_owner, self._gs_count = saved
+
+    def __getattr__(self, name):
+        return getattr(self._gs_inner, name)
+
+    def __repr__(self):
+        return f"WitnessLock({self._gs_label}, {self._gs_inner!r})"
+
+
+def _raw_lock_state(lk) -> Optional[bool]:
+    """Best-effort held-by-me for a raw (pre-install) lock: True when
+    provably held by this thread, False when provably not held by
+    anyone, None when held but unattributable (plain Lock)."""
+    try:
+        is_owned = getattr(lk, "_is_owned", None)
+        if is_owned is not None:
+            return bool(is_owned())
+        locked = getattr(lk, "locked", None)
+        if locked is not None:
+            return None if locked() else False
+    except Exception:
+        pass
+    return None
+
+
+class FieldWitness:
+    """Runtime evidence for one (class, field)."""
+
+    __slots__ = ("writes", "init_writes", "unknown", "by_sig")
+
+    def __init__(self):
+        self.writes = 0        # post-init writes with a provable held set
+        self.init_writes = 0   # writes under construction (exempt)
+        self.unknown = 0       # held set unattributable (raw locks)
+        # frozenset(held lock attrs) -> count
+        self.by_sig: Dict[FrozenSet[str], int] = {}
+
+
+class _WitnessDict(dict):
+    __slots__ = ("_gs_check",)
+
+    def __setitem__(self, k, v):
+        self._gs_check()
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        self._gs_check()
+        dict.__delitem__(self, k)
+
+    def pop(self, *a):
+        self._gs_check()
+        return dict.pop(self, *a)
+
+    def popitem(self):
+        self._gs_check()
+        return dict.popitem(self)
+
+    def clear(self):
+        self._gs_check()
+        dict.clear(self)
+
+    def update(self, *a, **kw):
+        self._gs_check()
+        dict.update(self, *a, **kw)
+
+    def setdefault(self, *a):
+        self._gs_check()
+        return dict.setdefault(self, *a)
+
+
+class _WitnessList(list):
+    __slots__ = ("_gs_check",)
+
+    def append(self, x):
+        self._gs_check()
+        list.append(self, x)
+
+    def extend(self, it):
+        self._gs_check()
+        list.extend(self, it)
+
+    def insert(self, i, x):
+        self._gs_check()
+        list.insert(self, i, x)
+
+    def pop(self, *a):
+        self._gs_check()
+        return list.pop(self, *a)
+
+    def remove(self, x):
+        self._gs_check()
+        list.remove(self, x)
+
+    def clear(self):
+        self._gs_check()
+        list.clear(self)
+
+    def __setitem__(self, i, v):
+        self._gs_check()
+        list.__setitem__(self, i, v)
+
+    def __delitem__(self, i):
+        self._gs_check()
+        list.__delitem__(self, i)
+
+
+class WitnessLayer:
+    def __init__(self, san):
+        self.san = san
+        self.records: Dict[Tuple[str, str], FieldWitness] = {}
+        self._rec_lock = threading.Lock()
+        self.probes = 0
+        self.seconds = 0.0
+        # (cls, name, original or None-if-absent-from-__dict__)
+        self._saved: List[Tuple[type, str, Optional[object]]] = []
+
+    # -- install / uninstall -------------------------------------------------
+
+    def install(self) -> None:
+        for spec in self.san.classes.values():
+            self._wrap_class(spec)
+
+    def uninstall(self) -> None:
+        for cls, name, orig in reversed(self._saved):
+            if orig is None:
+                try:
+                    delattr(cls, name)
+                except AttributeError:
+                    pass
+            else:
+                setattr(cls, name, orig)
+        self._saved = []
+
+    def _wrap_class(self, spec) -> None:
+        cls = spec.cls
+        layer = self
+
+        orig_setattr = cls.__setattr__
+        orig_init = cls.__init__
+
+        def san_setattr(self, name, value):
+            t0 = perf_counter()
+            layer.probes += 1
+            if name in spec.lock_attrs:
+                if _is_lock_like(value):
+                    value = WitnessLock(value, f"{spec.key}.{name}")
+            elif not name.startswith("__"):
+                layer.record_write(self, spec, name)
+                if name in spec.owned:
+                    value = layer._maybe_wrap_container(
+                        self, spec, name, value
+                    )
+            layer.seconds += perf_counter() - t0
+            return orig_setattr(self, name, value)
+
+        def san_init(self, *args, **kwargs):
+            under = _constructing()
+            fresh = id(self) not in under
+            if fresh:
+                under.add(id(self))
+            try:
+                return orig_init(self, *args, **kwargs)
+            finally:
+                if fresh:
+                    under.discard(id(self))
+
+        self._saved.append((
+            cls, "__setattr__", cls.__dict__.get("__setattr__")
+        ))
+        self._saved.append((cls, "__init__", cls.__dict__.get("__init__")))
+        cls.__setattr__ = san_setattr
+        cls.__init__ = san_init
+
+    def _maybe_wrap_container(self, inst, spec, field, value):
+        wrapped = None
+        if type(value) is dict:
+            wrapped = _WitnessDict(value)
+        elif type(value) is list:
+            wrapped = _WitnessList(value)
+        if wrapped is None:
+            return value
+        layer = self
+
+        def check():
+            # wrapped containers outlive uninstall on live instances;
+            # once their sanitizer is no longer current they must go
+            # inert (no probes, no violations into a dead session)
+            from .sanitizer import current
+
+            if current() is not layer.san:
+                return
+            layer.probes += 1
+            layer.record_write(inst, spec, field, kind="off-lock-mutate")
+
+        wrapped._gs_check = check
+        return wrapped
+
+    # -- the witness itself --------------------------------------------------
+
+    def held_set(self, inst, spec) -> Tuple[Set[str], bool]:
+        """(lock attrs of `inst` held by the current thread, unknown?)"""
+        held: Set[str] = set()
+        unknown = False
+        for la in spec.lock_attrs:
+            lk = getattr(inst, la, None)
+            if lk is None:
+                continue
+            if isinstance(lk, WitnessLock):
+                if lk.held_by_me():
+                    held.add(la)
+            else:
+                state = _raw_lock_state(lk)
+                if state is True:
+                    held.add(la)
+                elif state is None:
+                    unknown = True
+        return held, unknown
+
+    def record_write(self, inst, spec, field: str,
+                     kind: str = "off-lock-write") -> None:
+        held, unknown = self.held_set(inst, spec)
+        constructing = id(inst) in _constructing()
+        with self._rec_lock:
+            w = self.records.setdefault((spec.key, field), FieldWitness())
+            if constructing:
+                w.init_writes += 1
+            elif unknown and not held:
+                w.unknown += 1
+            else:
+                w.writes += 1
+                sig = frozenset(held)
+                w.by_sig[sig] = w.by_sig.get(sig, 0) + 1
+        owner = spec.owned.get(field)
+        if (
+            owner is not None
+            and not constructing
+            and owner not in held
+            and not unknown
+        ):
+            self.san.violation(
+                kind,
+                f"{spec.key}.{field} written without owning lock "
+                f"{owner!r} (held: {sorted(held) or 'none'}, "
+                f"thread {threading.current_thread().name})",
+            )

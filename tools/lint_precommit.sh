@@ -4,14 +4,18 @@
 # computed from graftlint's module dependency graph.
 #
 # Usage:
-#   tools/lint_precommit.sh [BASE] [extra graftlint args...]
+#   tools/lint_precommit.sh [BASE] [--sanitize-smoke] [extra graftlint args...]
 #
 # BASE defaults to main.  Install as a git hook with:
 #   ln -s ../../tools/lint_precommit.sh .git/hooks/pre-commit
 # (the hook invocation passes no arguments, so BASE stays main).
 #
-# Exit codes follow graftlint: 0 clean, 1 new findings, 2 stale
-# baseline entries or configuration errors.
+# --sanitize-smoke additionally runs the graftsan in-process hammer
+# (SDOL_SANITIZE=1, every layer armed, on-CPU) after the lint pass and
+# fails on any contract violation or static<->runtime divergence.
+#
+# Exit codes follow graftlint: 0 clean, 1 new findings / sanitizer
+# violations, 2 stale baseline entries or configuration errors.
 set -eu
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -23,5 +27,28 @@ if [ "$#" -gt 0 ]; then
     esac
 fi
 
+SMOKE=0
+ARGS=""
+for a in "$@"; do
+    if [ "$a" = "--sanitize-smoke" ]; then
+        SMOKE=1
+    else
+        ARGS="$ARGS $a"
+    fi
+done
+
 cd "$ROOT"
-exec python -m tools.graftlint --changed "$BASE" --stats "$@"
+rc=0
+# shellcheck disable=SC2086  # ARGS is intentionally word-split
+python -m tools.graftlint --changed "$BASE" --stats $ARGS || rc=$?
+
+if [ "$SMOKE" -eq 1 ]; then
+    src=0
+    JAX_PLATFORMS=cpu SDOL_SANITIZE=1 \
+        python -m tools.graftsan --smoke --stats || src=$?
+    if [ "$src" -gt "$rc" ]; then
+        rc=$src
+    fi
+fi
+
+exit $rc
