@@ -2836,18 +2836,44 @@ def bench_cluster(scale: float):
             assert _close(oracles[q], df), "clustered answer drifted"
             cluster_oracles[q] = df
 
-        # one sampled receipt: scatter/gather/merge attribution with the
-        # per-historical RPC buckets (rendered by tools/obs_dump.py)
+        # one sampled GRAFTED receipt (ISSUE 19): the broker's trace now
+        # carries each historical's rendered span subtree under its
+        # cluster_rpc span, and the receipt folds the remote
+        # device/transfer/host buckets into per-historical attribution
+        # (rendered by tools/obs_dump.py)
         broker.tracer.force_sample_next()
         broker.sql(qset[0])
-        rc = broker.tracer.last_trace_dict()["receipt"]
+        tdoc = broker.tracer.last_trace_dict()
+        rc = tdoc["receipt"]
+
+        def _count_grafts(node):
+            a = node.get("attrs") or {}
+            n = 1 if (a.get("remote") and not a.get("untraced")) else 0
+            return n + sum(
+                _count_grafts(c) for c in node.get("children", ())
+            )
+
         receipt = {
             "scatter_ms": rc.get("scatter_ms"),
             "gather_ms": rc.get("gather_ms"),
             "cluster_merge_ms": rc.get("cluster_merge_ms"),
+            "wall_ms": rc.get("wall_ms"),
+            "unattributed_ms": rc.get("unattributed_ms"),
+            "grafted_subtrees": _count_grafts(tdoc["spans"]),
             "nodes": (rc.get("cluster") or {}).get("nodes"),
         }
         assert receipt["nodes"], "broker receipt lost its node buckets"
+        # cross-process grafting: real subprocess historicals shipped
+        # their subtrees back and the fold attributed their buckets
+        assert receipt["grafted_subtrees"] >= 1, receipt
+        assert any(
+            "device_ms" in b and "transfer_ms" in b
+            for b in receipt["nodes"].values()
+        ), receipt
+        # the ISSUE 19 accounting bar: >= 90% of wall attributed
+        assert (
+            receipt["unattributed_ms"] <= 0.10 * receipt["wall_ms"]
+        ), receipt
 
         # -- kill-and-recover timeline -----------------------------------
         victim = sorted(nodes)[-1]

@@ -160,6 +160,13 @@ class TPUOlapContext:
                 self.storage.start_flush_sweep(
                     self.config.snapshot_flush_s
                 )
+        # self-hosted telemetry (obs/telemetry.py, ISSUE 19): registry ->
+        # `__sys` datasource through the ingest/WAL tier.  Built lazily —
+        # config.sys_sampler_s > 0 starts the daemon tick loop here;
+        # start_sys_sampler() is the manual/test entry point.
+        self.sys_sampler = None
+        if self.config.sys_sampler_s > 0:
+            self.start_sys_sampler()
 
     # -- registration (CREATE TABLE ... USING ... OPTIONS analog) -----------
 
@@ -343,6 +350,29 @@ class TPUOlapContext:
 
     def stop_compaction(self):
         self.compactor.stop()
+
+    def start_sys_sampler(self, interval_s: Optional[float] = None):
+        """Start (or restart) the `__sys` telemetry sampler: the metrics
+        registry flushes into the `__sys` datasource every tick so
+        operational history is SQL-queryable (obs/telemetry.py)."""
+        from .obs.telemetry import SysSampler
+
+        if self.sys_sampler is None:
+            self.sys_sampler = SysSampler(
+                self,
+                interval_s=(
+                    interval_s
+                    if interval_s is not None
+                    else self.config.sys_sampler_s or 5.0
+                ),
+                max_series=self.config.sys_sampler_max_series,
+            )
+        self.sys_sampler.start()
+        return self.sys_sampler
+
+    def stop_sys_sampler(self):
+        if self.sys_sampler is not None:
+            self.sys_sampler.stop()
 
     def _on_segments_dropped(self, uids):
         self.engine.evict_segments(uids)

@@ -29,12 +29,18 @@ here).  The execution contract:
   queries never route here at all, so they serve through any breaker
   state.
 
-Tracing: ONE scatter span in the query thread carries a per-reply
-`rpc` event (node, ms, outcome, segments) — RPCs run on pool threads,
-which by design cannot open spans on the query's contextvar-confined
-trace — and gather/cluster_merge spans wrap the fold; obs/prof.py
-folds these into scatter/gather/merge receipt buckets plus the
-per-historical `cluster.nodes` section.
+Tracing (ISSUE 19): the scatter span in the query thread hands its
+(trace, span) pair EXPLICITLY to the pool workers — `span_in` records
+into the handle under the trace's own lock, so every replica attempt
+opens a `cluster_rpc` span (node/outcome/hedge attrs) even though the
+contextvar trace is invisible on a fresh pool thread.  Each RPC
+carries `X-Druid-Query-Id` + `X-Sdol-Parent-Span` headers; the
+historical traces under the same identity and returns its rendered
+subtree, which grafts under the attempt's span — `/druid/v2/trace/{id}`
+serves ONE tree spanning the cluster, and obs/prof.py folds the
+grafted device/transfer/host buckets into per-historical attribution.
+A torn/oversized trace payload degrades to an `untraced` stub, never a
+failed replica.
 """
 
 from __future__ import annotations
@@ -51,15 +57,19 @@ from ..exec.metrics import QueryMetrics
 from ..models import query as Q
 from ..obs import (
     SPAN_CLUSTER_MERGE,
+    SPAN_CLUSTER_RPC,
     SPAN_GATHER,
     SPAN_SCATTER,
     current_query_id,
+    current_trace,
     record_cluster_health,
     record_cluster_rpc,
     record_query_metrics,
     span,
     span_event,
+    span_in,
 )
+from ..obs.otlp import rpc_span_id
 from ..resilience import (
     CircuitBreaker,
     checkpoint,
@@ -74,7 +84,7 @@ from .assignment import (
     load_assignment,
     save_assignment,
 )
-from .wire import WireDecodeError, decode_state
+from .wire import WireDecodeError, decode_state, decode_trace, trace_headers
 
 log = get_logger("cluster.broker")
 
@@ -97,6 +107,7 @@ class ClusterClient:
         self.rpc_timeout_s = float(cfg.cluster_rpc_timeout_ms) / 1e3
         self.retries = max(0, int(cfg.cluster_rpc_retries))
         self.hedge_s = float(cfg.cluster_hedge_ms) / 1e3
+        self.scrape_timeout_s = float(cfg.cluster_scrape_timeout_ms) / 1e3
         self._lock = threading.Lock()
         # node_id -> base url ("http://host:port")
         self._nodes: Dict[str, str] = dict(nodes or {})
@@ -284,6 +295,45 @@ class ClusterClient:
             epoch=asg.epoch if asg else 0, deficit=under, lost=lost,
         )
 
+    # -- federated observability (ISSUE 19) -----------------------------------
+
+    def federated_metrics(self) -> str:
+        """The `/status/metrics?cluster=1` body: every historical's
+        exposition node-labeled and merged with the broker's own
+        (`node="broker"`); unreachable nodes are absent + stamped on
+        `sdol_cluster_scrape_stale`, never a failed scrape."""
+        from ..obs import get_registry
+        from .federation import merge_prometheus, scrape_nodes
+
+        sections: Dict[str, Optional[str]] = dict(
+            scrape_nodes(
+                self.nodes(), "/status/metrics", self.scrape_timeout_s
+            )
+        )
+        sections["broker"] = get_registry().render_prometheus()
+        return merge_prometheus(sections)
+
+    def federated_profile(self, local_doc: Optional[dict] = None) -> dict:
+        """The `/status/profile?cluster=1` document: the broker's own
+        profile plus every historical's under its node id; unreachable
+        nodes carry {"stale": true} and are listed in `stale`."""
+        from .federation import scrape_nodes_json
+
+        docs = scrape_nodes_json(
+            self.nodes(), "/status/profile", self.scrape_timeout_s
+        )
+        return {
+            "cluster": True,
+            "broker": local_doc or {},
+            "nodes": {
+                nid: (doc if doc is not None else {"stale": True})
+                for nid, doc in docs.items()
+            },
+            "stale": sorted(
+                nid for nid, doc in docs.items() if doc is None
+            ),
+        }
+
     # -- coverage -------------------------------------------------------------
 
     def covers(self, q, ds) -> bool:
@@ -307,11 +357,16 @@ class ClusterClient:
 
     # -- scatter --------------------------------------------------------------
 
-    def _rpc(self, url: str, payload: bytes) -> dict:
+    def _rpc(self, url: str, payload: bytes,
+             headers: Optional[Dict[str, str]] = None) -> dict:
+        # trace-propagation headers (GL2701): built by wire.trace_headers
+        # in the caller, merged under the content type here
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
         req = urllib.request.Request(
             url + "/druid/v2/cluster/partial",
             data=payload,
-            headers={"Content-Type": "application/json"},
+            headers=hdrs,
             method="POST",
         )
         with urllib.request.urlopen(
@@ -330,68 +385,108 @@ class ClusterClient:
             raise WireDecodeError(f"torn response body: {e}") from e
 
     def _attempt(self, node: str, payload: bytes, expect_version: int,
-                 attempts: list) -> dict:
+                 attempts: list, trace=None, parent=None, qid: str = "",
+                 hedge: bool = False) -> dict:
         """One replica attempt: breaker-gated RPC + strict decode +
-        version guard.  Appends (node, ms, outcome) to `attempts` and
-        raises on any failure."""
-        br = self._breaker(node)
-        if not br.allow():
-            attempts.append((node, 0.0, "breaker_open"))
-            record_cluster_rpc(node, "breaker_open")
-            raise ReplicaSetLost(f"breaker open for {node}")
-        url = self.nodes().get(node)
-        if url is None:
-            attempts.append((node, 0.0, "removed"))
-            raise ReplicaSetLost(f"node {node} left the membership")
-        t0 = time.perf_counter()
-        try:
-            # per-RPC chaos site: error mode IS a timed-out/refused
-            # connection; delay mode is a slow network path
-            checkpoint("cluster.rpc")
-            doc = self._rpc(url, payload)
-            ver = int(doc.get("version", -1))
-            if expect_version and ver != expect_version:
-                raise WireDecodeError(
-                    f"version skew: replica at {ver}, assignment epoch "
-                    f"expects {expect_version}"
+        version guard, under its own `cluster_rpc` span on the
+        EXPLICITLY-threaded trace handle (the contextvar trace is
+        invisible on a pool thread — `span_in` records through the
+        handle instead).  A successful reply's rendered subtree grafts
+        under the span; a failed attempt leaves an error span.  Appends
+        (node, ms, outcome) to `attempts` and raises on any failure."""
+        seq = len(attempts)
+        span_otlp = rpc_span_id(qid, node, seq)
+        with span_in(
+            trace, parent, SPAN_CLUSTER_RPC, node=node, attempt=seq,
+            hedge=hedge, otlp_span_id=span_otlp,
+        ) as s:
+            br = self._breaker(node)
+            if not br.allow():
+                attempts.append((node, 0.0, "breaker_open"))
+                record_cluster_rpc(node, "breaker_open")
+                if s is not None:
+                    s.attrs.update(outcome="breaker_open", error=True)
+                raise ReplicaSetLost(f"breaker open for {node}")
+            url = self.nodes().get(node)
+            if url is None:
+                attempts.append((node, 0.0, "removed"))
+                if s is not None:
+                    s.attrs.update(outcome="removed", error=True)
+                raise ReplicaSetLost(f"node {node} left the membership")
+            t0 = time.perf_counter()
+            try:
+                # per-RPC chaos site: error mode IS a timed-out/refused
+                # connection; delay mode is a slow network path
+                checkpoint("cluster.rpc")
+                doc = self._rpc(
+                    url, payload, headers=trace_headers(qid, span_otlp)
                 )
-            state = decode_state(doc.get("state"))
-        except Exception as e:
+                ver = int(doc.get("version", -1))
+                if expect_version and ver != expect_version:
+                    raise WireDecodeError(
+                        f"version skew: replica at {ver}, assignment "
+                        f"epoch expects {expect_version}"
+                    )
+                state = decode_state(doc.get("state"))
+            except Exception as e:
+                ms = (time.perf_counter() - t0) * 1e3
+                br.record_failure()
+                outcome = type(e).__name__
+                attempts.append((node, ms, outcome))
+                record_cluster_rpc(
+                    node, classify_error(e), ms,
+                    query_id=current_query_id() or qid, failover=True,
+                )
+                if s is not None:
+                    s.attrs.update(
+                        outcome=outcome, ms=round(ms, 3), error=True
+                    )
+                raise
             ms = (time.perf_counter() - t0) * 1e3
-            br.record_failure()
-            outcome = type(e).__name__
-            attempts.append((node, ms, outcome))
+            br.record_success()
+            with self._lock:
+                self._last_ok[node] = time.monotonic()
             record_cluster_rpc(
-                node, classify_error(e), ms,
-                query_id=current_query_id() or "", failover=True,
+                node, "ok", ms, query_id=current_query_id() or qid
             )
-            raise
-        ms = (time.perf_counter() - t0) * 1e3
-        br.record_success()
-        with self._lock:
-            self._last_ok[node] = time.monotonic()
-        record_cluster_rpc(
-            node, "ok", ms, query_id=current_query_id() or ""
-        )
-        return {
-            "node": node, "ms": ms, "version": ver, "state": state,
-            "rows": int(doc.get("rows", 0)),
-            "segments": list(doc.get("segments") or ()),
-            "receipt": doc.get("receipt"),
-        }
+            segments = list(doc.get("segments") or ())
+            if s is not None and trace is not None:
+                s.attrs.update(
+                    outcome="ok", ms=round(ms, 3), segments=len(segments)
+                )
+                # graft the historical's subtree (or its degraded
+                # `untraced` stub — trace trouble never fails a replica
+                # that computed a good state) under THIS attempt's span
+                graft = decode_trace(doc.get("trace"), node)
+                if graft.get("attrs", {}).get("untraced") and isinstance(
+                    doc.get("receipt"), dict
+                ):
+                    # the separately-shipped receipt often survives a
+                    # torn trace payload: keep per-node attribution
+                    graft["receipt"] = doc["receipt"]
+                trace.graft(s, graft)
+            return {
+                "node": node, "ms": ms, "version": ver, "state": state,
+                "rows": int(doc.get("rows", 0)),
+                "segments": segments,
+                "receipt": doc.get("receipt"),
+            }
 
     def _fetch_group(self, chain: Tuple[str, ...], payload: bytes,
-                     expect_version: int) -> dict:
+                     expect_version: int, trace=None, parent=None,
+                     qid: str = "") -> dict:
         """Fetch one replica group's partial state: walk the chain with
         failover (plus `cluster_rpc_retries` re-walks), hedging the
-        primary past `cluster_hedge_ms`.  Runs on a pool thread — no
-        spans here (the trace is contextvar-confined to the query
-        thread); the caller turns the returned attempt log into span
-        events."""
+        primary past `cluster_hedge_ms`.  Runs on a pool thread; the
+        caller threads (trace, scatter-span) through so every attempt
+        records its own `cluster_rpc` span — the contextvar trace is
+        deliberately invisible here, the explicit handle is the
+        sanctioned path (obs.trace.span_in)."""
         attempts: list = []
         if self.hedge_s > 0 and len(chain) > 1:
             r = self._fetch_hedged(chain, payload, expect_version,
-                                   attempts)
+                                   attempts, trace=trace, parent=parent,
+                                   qid=qid)
             if r is not None:
                 r["attempts"] = attempts
                 return r
@@ -405,7 +500,8 @@ class ClusterClient:
             # thread runs this inline
             checkpoint("cluster.scatter")
             try:
-                r = self._attempt(node, payload, expect_version, attempts)
+                r = self._attempt(node, payload, expect_version, attempts,
+                                  trace=trace, parent=parent, qid=qid)
                 r["attempts"] = attempts
                 return r
             except Exception as e:
@@ -415,26 +511,31 @@ class ClusterClient:
             f"{[a[2] for a in attempts]}"
         ) from last
 
-    def _fetch_hedged(self, chain, payload, expect_version, attempts):
+    def _fetch_hedged(self, chain, payload, expect_version, attempts,
+                      trace=None, parent=None, qid: str = ""):
         """First-of-two hedge: issue to the primary, wait
         `cluster_hedge_ms`, then issue to the secondary and take
-        whichever succeeds first.  Returns None when both hedged
+        whichever succeeds first.  Both racers record their own
+        `cluster_rpc` spans through the explicit trace handle (the
+        second with `hedge=True`).  Returns None when both hedged
         attempts fail (the caller falls back to the sequential walk)."""
         import queue as queue_mod
 
         results: "queue_mod.Queue" = queue_mod.Queue()
 
-        def run(node):
+        def run(node, hedged):
             try:
                 results.put(
                     ("ok", self._attempt(node, payload, expect_version,
-                                         attempts))
+                                         attempts, trace=trace,
+                                         parent=parent, qid=qid,
+                                         hedge=hedged))
                 )
             except Exception as e:  # fault-ok: collected, not raised
                 results.put(("err", e))
 
         threading.Thread(
-            target=run, args=(chain[0],), daemon=True
+            target=run, args=(chain[0], False), daemon=True
         ).start()
         launched = 1
         try:
@@ -442,7 +543,7 @@ class ClusterClient:
         except queue_mod.Empty:
             record_cluster_rpc(chain[0], "hedged", hedged=True)
             threading.Thread(
-                target=run, args=(chain[1],), daemon=True
+                target=run, args=(chain[1], True), daemon=True
             ).start()
             launched = 2
             kind, val = results.get(timeout=self.rpc_timeout_s * 2 + 1)
@@ -513,12 +614,18 @@ class ClusterClient:
 
         results: list = []
         lost: list = []
+        # the scatter workers run on pool threads where the contextvar
+        # trace is invisible — hand them the trace handle + scatter span
+        # explicitly so each attempt records its own cluster_rpc span
+        # (and grafts the historical's subtree under it)
+        tr = current_trace()
         with span(
             SPAN_SCATTER, groups=len(groups), nodes=len(self.nodes())
-        ):
+        ) as scatter_span:
             futs = {
                 self._pool.submit(
-                    self._fetch_group, chain, _payload(g), expect_version
+                    self._fetch_group, chain, _payload(g), expect_version,
+                    tr, scatter_span, qid,
                 ): (chain, g)
                 for chain, g in sorted(groups.items())
             }
@@ -533,15 +640,6 @@ class ClusterClient:
                         outcome="lost", segments=len(g),
                     )
                     continue
-                for node, ms, outcome in r["attempts"]:
-                    span_event(
-                        "rpc", node=node, ms=round(ms, 3),
-                        outcome=outcome, segments=0,
-                    )
-                span_event(
-                    "rpc", node=r["node"], ms=round(r["ms"], 3),
-                    outcome="ok", segments=len(r["segments"]),
-                )
                 results.append((chain, r, g))
 
         node_receipts: Dict[str, Optional[dict]] = {}

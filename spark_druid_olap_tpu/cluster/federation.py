@@ -1,0 +1,150 @@
+"""Federated observability scrape (cluster/, ISSUE 19).
+
+A cluster hides every historical's registry and workload profile behind
+its own port; this module gives the BROKER one merged surface:
+
+* `GET /status/metrics?cluster=1` — the broker scrapes each
+  historical's `/status/metrics`, injects a `node` label into every
+  sample line (node ids ride the `bounded_label` cardinality guard, so
+  membership churn cannot explode the merged exposition), merges the
+  family headers, and appends its own registry under `node="broker"`.
+* `GET /status/profile?cluster=1` — same shape over the JSON profile
+  docs: `{broker, nodes: {id: doc}, stale: [...]}`.
+
+Staleness model: an unreachable historical NEVER fails the scrape — it
+is simply absent from the merged series and stamped on the
+`sdol_cluster_scrape_stale` gauge (1 = last scrape failed), so a
+dashboard distinguishes "node reports zero" from "node unreachable".
+The federation loop passes `resilience.checkpoint("cluster.federate")`
+per node (trace-propagation/GL2703): deadlines bound a scrape fanned
+over a large membership, and the chaos matrix can arm the site.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..obs import bounded_label
+from ..resilience import checkpoint
+from ..utils.log import get_logger
+
+log = get_logger("cluster.federation")
+
+__all__ = [
+    "STALE_METRIC",
+    "scrape_nodes",
+    "scrape_nodes_json",
+    "merge_prometheus",
+]
+
+STALE_METRIC = "sdol_cluster_scrape_stale"
+
+# one scraped body is bounded so a misbehaving node cannot balloon the
+# merged exposition past what a scrape client will accept
+_SCRAPE_MAX_BYTES = 4 << 20
+
+
+def scrape_nodes(
+    nodes: Dict[str, str], path: str, timeout_s: float
+) -> Dict[str, Optional[str]]:
+    """GET `path` from every node; None marks an unreachable node (the
+    staleness stamp), never an exception — the merged scrape must serve
+    through any subset of the membership being down."""
+    out: Dict[str, Optional[str]] = {}
+    for nid, url in sorted(nodes.items()):
+        # federation checkpoint (GL2703): deadline + chaos-injection
+        # point, once per node in the fan-out
+        checkpoint("cluster.federate")
+        try:
+            with urllib.request.urlopen(
+                url + path, timeout=timeout_s
+            ) as resp:
+                out[nid] = resp.read(_SCRAPE_MAX_BYTES).decode(
+                    "utf-8", "replace"
+                )
+        except Exception as e:  # fault-ok: stale stamp, never a 500
+            log.warning("scrape of %s%s failed: %s", url, path, e)
+            out[nid] = None
+    return out
+
+
+def scrape_nodes_json(
+    nodes: Dict[str, str], path: str, timeout_s: float
+) -> Dict[str, Optional[dict]]:
+    """`scrape_nodes` + JSON decode; an unparseable body is stale too."""
+    docs: Dict[str, Optional[dict]] = {}
+    for nid, text in scrape_nodes(nodes, path, timeout_s).items():
+        if text is None:
+            docs[nid] = None
+            continue
+        try:
+            doc = json.loads(text)
+            docs[nid] = doc if isinstance(doc, dict) else None
+        except ValueError:
+            docs[nid] = None
+    return docs
+
+
+def _inject_node_label(line: str, node: str) -> str:
+    """Rewrite one exposition sample line to carry node="...": inserted
+    first in an existing label set, or as the whole set when bare."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        return f'{line[:brace + 1]}node="{node}",{line[brace + 1:]}'
+    if space == -1:
+        return line
+    return f'{line[:space]}{{node="{node}"}}{line[space:]}'
+
+
+def merge_prometheus(sections: Dict[str, Optional[str]]) -> str:
+    """Merge per-node exposition texts into ONE text 0.0.4 document:
+    family headers deduped (first writer wins the help text), every
+    sample line node-labeled, exemplar/other comments dropped (they
+    cannot be node-attributed), and the `sdol_cluster_scrape_stale`
+    gauge appended over the full membership."""
+    headers: "OrderedDict[str, List[str]]" = OrderedDict()
+    samples: Dict[str, List[str]] = {}
+    seen_headers: Set[Tuple[str, str]] = set()
+    staleness: List[Tuple[str, int]] = []
+    for node in sorted(sections):
+        text = sections[node]
+        nl = bounded_label("cluster_node", node or "unknown")
+        staleness.append((nl, 0 if text is not None else 1))
+        if text is None:
+            continue
+        fam = ""
+        for line in text.splitlines():
+            if line.startswith("# HELP") or line.startswith("# TYPE"):
+                parts = line.split(None, 3)
+                if len(parts) < 3:
+                    continue
+                kind, name = parts[1], parts[2]
+                if kind == "TYPE":
+                    fam = name
+                if (name, kind) not in seen_headers:
+                    seen_headers.add((name, kind))
+                    headers.setdefault(name, []).append(line)
+            elif not line or line.startswith("#"):
+                continue
+            else:
+                key = fam or line.split("{", 1)[0].split(" ", 1)[0]
+                headers.setdefault(key, [])
+                samples.setdefault(key, []).append(
+                    _inject_node_label(line, nl)
+                )
+    lines: List[str] = []
+    for fam, hdr in headers.items():
+        lines.extend(hdr)
+        lines.extend(samples.get(fam, ()))
+    lines.append(
+        f"# HELP {STALE_METRIC} last federated scrape of this node "
+        "failed (1 = metrics below exclude it)"
+    )
+    lines.append(f"# TYPE {STALE_METRIC} gauge")
+    for nl, stale in staleness:
+        lines.append(f'{STALE_METRIC}{{node="{nl}"}} {stale}')
+    return "\n".join(lines) + "\n"

@@ -286,6 +286,10 @@ class SessionConfig:
     # breaker opens, and how long it cools before a probe
     cluster_breaker_failures: int = 3
     cluster_breaker_cooldown_ms: float = 2000.0
+    # federated observability scrape (ISSUE 19): per-node budget for the
+    # broker's /status/metrics?cluster=1 and /status/profile?cluster=1
+    # fan-out — a node slower than this is stamped stale for the scrape
+    cluster_scrape_timeout_ms: float = 2000.0
 
     # -- observability (obs/) -----------------------------------------------
     # slow-query log: a finished query whose span-tree total exceeds this
@@ -299,6 +303,14 @@ class SessionConfig:
     # file (obs/otlp.py) — no collector or network dependency; None
     # disables
     otlp_export_path: Optional[str] = None
+    # self-hosted telemetry (obs/telemetry.py, ISSUE 19): when > 0, a
+    # daemon sampler flushes the metrics registry into the `__sys`
+    # datasource every this-many seconds (ingest/WAL tier, rollup at
+    # `second` granularity) so QPS/p99/breaker history is SQL-queryable.
+    # 0 (default) never registers `__sys` and starts no thread.
+    sys_sampler_s: float = 0.0
+    # per-tick series cap for the `__sys` sampler (cardinality guard)
+    sys_sampler_max_series: int = 512
 
     # -- performance attribution (obs/prof.py, ISSUE 9) ---------------------
     # fraction of queries sampled for HONEST device timing: a sampled

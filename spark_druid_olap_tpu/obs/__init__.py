@@ -37,6 +37,7 @@ from .trace import (  # noqa: F401
     SPAN_ADMISSION,
     SPAN_ARENA_BUILD,
     SPAN_CLUSTER_MERGE,
+    SPAN_CLUSTER_RPC,
     SPAN_COLLECTIVE_MERGE,
     SPAN_COMPACT,
     SPAN_DEGRADED,
@@ -77,4 +78,5 @@ from .trace import (  # noqa: F401
     new_query_id,
     span,
     span_event,
+    span_in,
 )

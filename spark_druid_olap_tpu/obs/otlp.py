@@ -16,6 +16,17 @@ spans are anchored at the EXPORT wall-clock minus the trace total —
 phase durations and tree structure are exact, absolute placement is
 approximate to within the export delay (documented, acceptable for an
 emit-only debug artifact).
+
+Cross-process join (ISSUE 19): because the trace id derives from the
+query_id alone, a broker and every historical serving the same query
+export under the SAME trace id in their separate OTLP files — an
+external collector joins them with no coordination.  The nesting joins
+too: the broker stamps each `cluster_rpc` span with a PRE-COMPUTED
+span id (`rpc_span_id`, carried on the span's `otlp_span_id` attr and
+sent in the `X-Sdol-Parent-Span` header), and a historical trace
+opened under that header exports its root with the matching
+`parentSpanId` — so the collector renders broker RPC -> remote query
+as parent/child across files.
 """
 
 from __future__ import annotations
@@ -28,6 +39,16 @@ from typing import Any, Dict, List, Optional
 
 def _hex_id(seed: str, nbytes: int) -> str:
     return hashlib.sha256(seed.encode()).hexdigest()[: 2 * nbytes]
+
+
+def rpc_span_id(query_id: str, node: str, attempt: int) -> str:
+    """Deterministic OTLP span id for ONE broker->historical attempt,
+    computable BEFORE the span closes — the broker must send the id in
+    the RPC headers while the span is still open, and the export must
+    later emit the same id.  Derives from (query id, node, attempt
+    ordinal): stable across re-exports, distinct across failover and
+    hedge attempts."""
+    return _hex_id(f"rpc:{query_id}:{node}:{int(attempt)}", 8)
 
 
 def _attr(key: str, value: Any) -> Dict[str, Any]:
@@ -58,7 +79,11 @@ def trace_to_otlp(
     def walk(node: Dict[str, Any], parent_id: str, path: str) -> None:
         start_ms = float(node.get("start_ms", 0.0))
         dur_ms = float(node.get("duration_ms", 0.0))
-        span_id = _hex_id(
+        # an `otlp_span_id` attr pins the exported id to one computed
+        # BEFORE export (the broker pre-computes `rpc_span_id` so the
+        # id it sent in X-Sdol-Parent-Span is the id it exports under)
+        pinned = (node.get("attrs") or {}).get("otlp_span_id")
+        span_id = str(pinned) if pinned else _hex_id(
             f"span:{qid}:{path}:{node.get('name')}:{start_ms}", 8
         )
         start_ns = epoch_ns + int(start_ms * 1e6)
@@ -104,7 +129,9 @@ def trace_to_otlp(
 
     root = doc.get("spans") or {}
     if root:
-        walk(root, "", "0")
+        # a historical opened under a broker RPC exports its root as a
+        # child of the broker's cluster_rpc span (cross-process join)
+        walk(root, str(doc.get("parent_span_id") or ""), "0")
     return {
         "resourceSpans": [
             {

@@ -96,6 +96,13 @@ ARENA_SPANS = frozenset({"arena_build"})
 SCATTER_SPANS = frozenset({"scatter"})
 GATHER_SPANS = frozenset({"gather"})
 CLUSTER_MERGE_SPANS = frozenset({"cluster_merge"})
+# per-attempt RPC spans (ISSUE 19): cluster_rpc spans run CONCURRENTLY
+# on pool threads under the one scatter span, so they are an OVERLAY on
+# the scatter wall, not a partition of it — their time (and the remote
+# subtrees grafted beneath them, which measure on the REMOTE clock) is
+# excluded from the additive local buckets and folded into the
+# per-historical `cluster.nodes` section instead
+CLUSTER_RPC_SPANS = frozenset({"cluster_rpc"})
 ROOT_SPAN = "query"
 
 # device LAUNCH spans — the receipt's `dispatch_count` (ISSUE 14): how
@@ -409,9 +416,29 @@ def note_lane(lane: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _is_remote(node: dict) -> bool:
+    """A grafted remote subtree root (broker-side clocks do not apply)."""
+    return bool((node.get("attrs") or {}).get("remote"))
+
+
+def _is_overlay(node: dict) -> bool:
+    """Spans excluded from the local timeline partition: concurrent
+    cluster_rpc attempts and grafted remote subtrees."""
+    return str(node.get("name", "")) in CLUSTER_RPC_SPANS or _is_remote(
+        node
+    )
+
+
 def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
+    if _is_overlay(node):
+        # concurrent overlay / remote clock: handled by
+        # _walk_cluster_nodes into per-node attribution, never the
+        # additive local buckets (their sum could exceed the wall)
+        return
     dur = float(node.get("duration_ms", 0.0))
-    children = node.get("children") or ()
+    children = [
+        c for c in (node.get("children") or ()) if not _is_overlay(c)
+    ]
     child_sum = sum(float(c.get("duration_ms", 0.0)) for c in children)
     excl = max(0.0, dur - child_sum)
     name = str(node.get("name", ""))
@@ -439,11 +466,76 @@ def _walk_exclusive(node: dict, acc: Dict[str, float], depth: int) -> None:
         _walk_exclusive(c, acc, depth + 1)
 
 
+def _fold_remote_buckets(graft: dict) -> Dict[str, float]:
+    """Per-historical device/transfer/host attribution of ONE grafted
+    remote subtree.  The remote receipt (riding inside the graft root)
+    is authoritative when present; otherwise the subtree folds through
+    the same bucket maps — remote spans use the same registered names."""
+    rc = graft.get("receipt")
+    if isinstance(rc, dict):
+        return {
+            "device_ms": float(rc.get("device_ms", 0.0) or 0.0),
+            "transfer_ms": float(rc.get("transfer_ms", 0.0) or 0.0),
+            "host_ms": float(rc.get("host_ms", 0.0) or 0.0),
+            "remote_wall_ms": float(rc.get("wall_ms", 0.0) or 0.0),
+        }
+    acc = {
+        "device": 0.0, "transfer": 0.0, "prefetch": 0.0, "host": 0.0,
+        "arena_build": 0.0, "unattributed": 0.0, "dispatch_count": 0,
+        "scatter": 0.0, "gather": 0.0, "cluster_merge": 0.0,
+    }
+    clean = dict(graft)
+    attrs = dict(clean.get("attrs") or {})
+    attrs.pop("remote", None)
+    clean["attrs"] = attrs
+    _walk_exclusive(clean, acc, 0)
+    return {
+        "device_ms": round(acc["device"], 3),
+        "transfer_ms": round(acc["transfer"], 3),
+        "host_ms": round(acc["host"], 3),
+        "remote_wall_ms": float(graft.get("duration_ms", 0.0) or 0.0),
+    }
+
+
+def _fold_rpc_span(c: dict, nodes: Dict[str, Dict[str, Any]]) -> None:
+    """One `cluster_rpc` span (ISSUE 19) into its node's bucket: attempt
+    count/latency/outcome plus the grafted remote buckets.  `untraced`
+    counts grafts that degraded to a stub (their receipt, when it
+    survived separately, still folds)."""
+    attrs = c.get("attrs") or {}
+    nid = str(attrs.get("node", "?"))
+    b = nodes.setdefault(
+        nid, {"ms": 0.0, "rpcs": 0, "ok": 0, "failed": 0, "segments": 0},
+    )
+    b["rpcs"] += 1
+    ms = float(attrs.get("ms", c.get("duration_ms", 0.0)) or 0.0)
+    b["ms"] = round(b["ms"] + ms, 3)
+    if attrs.get("outcome") == "ok":
+        b["ok"] += 1
+        b["segments"] += int(attrs.get("segments", 0) or 0)
+    else:
+        b["failed"] += 1
+    if attrs.get("hedge"):
+        b["hedged"] = int(b.get("hedged", 0)) + 1
+    for g in c.get("children") or ():
+        if not _is_remote(g):
+            continue
+        if (g.get("attrs") or {}).get("untraced"):
+            b["untraced"] = int(b.get("untraced", 0)) + 1
+            if not isinstance(g.get("receipt"), dict):
+                continue
+        for k, v in _fold_remote_buckets(g).items():
+            b[k] = round(float(b.get(k, 0.0)) + float(v), 3)
+
+
 def _walk_cluster_nodes(node: dict, nodes: Dict[str, Dict[str, Any]]):
-    """Aggregate the scatter span's per-reply `rpc` events into
-    per-historical receipt buckets: {node -> {ms, rpcs, ok, failed,
-    segments}}.  One bucket per historical the query touched — the
-    obs_dump table renders these as the per-node attribution row."""
+    """Aggregate the scatter span's per-attempt `cluster_rpc` child
+    spans — plus legacy per-reply `rpc` events (lost replica groups
+    still mark this way) — into per-historical receipt buckets:
+    {node -> {ms, rpcs, ok, failed, segments, device_ms, transfer_ms,
+    host_ms, remote_wall_ms, ...}}.  One bucket per historical the
+    query touched — the obs_dump table renders these as the per-node
+    attribution rows."""
     if str(node.get("name", "")) in SCATTER_SPANS:
         for e in node.get("events") or ():
             if e.get("name") != "rpc":
@@ -461,7 +553,12 @@ def _walk_cluster_nodes(node: dict, nodes: Dict[str, Dict[str, Any]]):
                 b["segments"] += int(attrs.get("segments", 0))
             else:
                 b["failed"] += 1
+        for c in node.get("children") or ():
+            if str(c.get("name", "")) in CLUSTER_RPC_SPANS:
+                _fold_rpc_span(c, nodes)
     for c in node.get("children") or ():
+        if _is_overlay(c):
+            continue
         _walk_cluster_nodes(c, nodes)
 
 

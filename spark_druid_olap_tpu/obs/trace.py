@@ -90,6 +90,7 @@ SPAN_ARENA_BUILD = "arena_build"  # segment-stacked arena assembly (exec/arena.p
 SPAN_SCATTER = "scatter"  # broker: replica fetches in flight (cluster/)
 SPAN_GATHER = "gather"  # broker: decode + coverage of gathered replies
 SPAN_CLUSTER_MERGE = "cluster_merge"  # broker: ⊕ fold of replica states
+SPAN_CLUSTER_RPC = "cluster_rpc"  # broker: ONE replica attempt (pool thread)
 
 SPAN_NAMES = frozenset(
     {
@@ -126,6 +127,7 @@ SPAN_NAMES = frozenset(
         SPAN_SCATTER,
         SPAN_GATHER,
         SPAN_CLUSTER_MERGE,
+        SPAN_CLUSTER_RPC,
     }
 )
 
@@ -145,9 +147,17 @@ class Span:
     `attrs` carry small JSON-able facts (segment index, retry attempt);
     `events` are point-in-time observations inside the phase (the
     breaker state read at routing time) — a name, a clock reading, and
-    small attrs, without opening a child span."""
+    small attrs, without opening a child span.
 
-    __slots__ = ("name", "start", "end", "attrs", "children", "events")
+    `grafts` hold PRE-RENDERED remote subtrees (cluster/, ISSUE 19): a
+    historical's already-serialized span tree splices under the broker's
+    `cluster_rpc` span at render time.  Grafted nodes keep their REMOTE
+    clock origin — `start_ms` inside a graft is relative to the remote
+    root, not this trace's (cross-process clocks don't join); they carry
+    `attrs.remote` so consumers can tell."""
+
+    __slots__ = ("name", "start", "end", "attrs", "children", "events",
+                 "grafts")
 
     def __init__(self, name: str, start: float, attrs: Optional[dict] = None):
         self.name = name
@@ -156,6 +166,7 @@ class Span:
         self.attrs = attrs or {}
         self.children: List["Span"] = []
         self.events: List[Dict[str, Any]] = []
+        self.grafts: List[dict] = []
 
     @property
     def duration_ms(self) -> float:
@@ -188,8 +199,10 @@ class Span:
                 }
                 for e in self.events
             ]
-        if self.children:
-            d["children"] = [c.to_dict(origin, now) for c in self.children]
+        if self.children or self.grafts:
+            d["children"] = [
+                c.to_dict(origin, now) for c in self.children
+            ] + list(self.grafts)
         return d
 
 
@@ -211,6 +224,10 @@ class QueryTrace:
         # rides every to_dict so the ring doc, bench detail artifacts,
         # and /druid/v2/trace/{id} all carry it
         self.receipt: Optional[dict] = None
+        # cross-process parentage (cluster/, ISSUE 19): a historical
+        # serving a broker RPC records the broker's span id here so the
+        # OTLP export joins both processes into one tree
+        self.parent_span_id: str = ""
 
     def start_span(
         self, name: str, parent: Optional[Span], attrs: Optional[dict] = None
@@ -234,6 +251,13 @@ class QueryTrace:
                 {"name": name, "at": self._clock(), "attrs": attrs or {}}
             )
 
+    def graft(self, s: Span, subtree: dict) -> None:
+        """Splice a PRE-RENDERED remote span subtree (a historical's
+        `to_dict()["spans"]` or an `untraced` stub) under `s`.  Lock-safe
+        like start_span — the scatter pool threads graft concurrently."""
+        with self._lock:
+            s.grafts.append(subtree)
+
     def finish(self) -> None:
         with self._lock:
             if self.root.end is None:
@@ -250,6 +274,8 @@ class QueryTrace:
             "total_ms": round(self.total_ms, 3),
             "spans": self.root.to_dict(self.root.start),
         }
+        if self.parent_span_id:
+            d["parent_span_id"] = self.parent_span_id
         if self.receipt is not None:
             d["receipt"] = self.receipt
         return d
@@ -340,6 +366,28 @@ def span(name: str, **attrs):
     finally:
         _active_span.reset(token)
         tr.end_span(s)
+
+
+@contextlib.contextmanager
+def span_in(trace: Optional[QueryTrace], parent: Optional[Span],
+            name: str, **attrs):
+    """Open a span on an EXPLICIT trace handle, under an explicit parent
+    — the sanctioned pairing for pool threads, where the contextvar
+    trace is invisible by design (a fresh thread starts with an empty
+    context).  The broker's scatter workers (cluster/broker.py) thread
+    (trace, scatter-span) through to here so every replica attempt gets
+    its own `cluster_rpc` span.  Owns the begin/end pairing exactly like
+    `span(...)` (span-discipline/GL1102, trace-propagation/GL2702: the
+    name must be a registered SPAN_* constant).  No-op when `trace` is
+    None (the caller ran without an active trace)."""
+    if trace is None:
+        yield None
+        return
+    s = trace.start_span(name, parent, attrs or None)
+    try:
+        yield s
+    finally:
+        trace.end_span(s)
 
 
 def span_event(name: str, **attrs) -> None:
@@ -434,11 +482,13 @@ class Tracer:
         query_id: Optional[str] = None,
         query_type: str = "",
         slow_ms: float = 0.0,
+        parent_span_id: str = "",
     ):
         """Open (or join) the per-query trace.  The OUTERMOST scope wins,
         exactly like `resilience.deadline_scope`: the server boundary
         starts the trace and `ctx.sql` inside it joins rather than
-        nesting a second root."""
+        nesting a second root.  `parent_span_id` stamps cross-process
+        parentage (a historical trace opened under a broker RPC span)."""
         existing = _active_trace.get()
         if existing is not None:
             yield existing
@@ -449,6 +499,8 @@ class Tracer:
             query_id or new_query_id(), clock=self.clock,
             query_type=query_type,
         )
+        if parent_span_id:
+            tr.parent_span_id = str(parent_span_id)
         tok_t = _active_trace.set(tr)
         tok_s = _active_span.set(tr.root)
         ps = _prof.ProfScope(sampled=self.sampler.take())

@@ -368,7 +368,23 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(200, doc)
         if path == "/status/metrics":
             # Prometheus text exposition of the process registry (engines,
-            # resilience, http counters, per-phase latency histograms)
+            # resilience, http counters, per-phase latency histograms).
+            # ?cluster=1 on a BROKER federates the scrape: every
+            # historical's registry merges in under a `node` label, with
+            # unreachable nodes stamped stale — the scrape never 500s on
+            # a dead historical (cluster/federation.py, ISSUE 19).
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            cluster = getattr(self.ctx, "cluster", None)
+            if qs.get("cluster", ["0"])[0] in ("1", "true") and (
+                cluster is not None
+            ):
+                return self._send_bytes(
+                    200,
+                    cluster.federated_metrics().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             return self._send_bytes(
                 200,
                 get_registry().render_prometheus().encode(),
@@ -378,7 +394,9 @@ class _Handler(BaseHTTPRequestHandler):
             # workload profiler (obs/prof.py, ISSUE 9): rolling-window
             # top-K queries by device time, per-family compile totals,
             # per-lane SLO burn-rate.  ?k= and ?window_s= override the
-            # configured defaults per request.
+            # configured defaults per request; ?cluster=1 on a broker
+            # federates every historical's profile under its node id
+            # (stale entries for unreachable nodes, never a 500).
             from urllib.parse import parse_qs, urlparse
 
             from .obs.prof import profile_doc
@@ -391,14 +409,19 @@ class _Handler(BaseHTTPRequestHandler):
                 except (KeyError, IndexError, TypeError, ValueError):
                     return None
 
-            return self._send(
-                200,
-                profile_doc(
-                    config=getattr(self.ctx, "config", None),
-                    top_k=_num("k", int),
-                    window_s=_num("window_s", float),
-                ),
+            local = profile_doc(
+                config=getattr(self.ctx, "config", None),
+                top_k=_num("k", int),
+                window_s=_num("window_s", float),
             )
+            cluster = getattr(self.ctx, "cluster", None)
+            if qs.get("cluster", ["0"])[0] in ("1", "true") and (
+                cluster is not None
+            ):
+                return self._send(
+                    200, cluster.federated_profile(local)
+                )
+            return self._send(200, local)
         if path.startswith("/druid/v2/trace/"):
             qid = path.rsplit("/", 1)[1]
             tr = self._tracer().ring.get(qid)
@@ -427,6 +450,14 @@ class _Handler(BaseHTTPRequestHandler):
                     # registry summary: counter/gauge values + histogram
                     # p50/p95/p99 (full series live at /status/metrics)
                     "metrics": get_registry().to_dict(),
+                    # __sys telemetry sampler (obs/telemetry.py): tick/
+                    # row/drop counters; None when never started
+                    "sys_sampler": (
+                        self.ctx.sys_sampler.status()
+                        if getattr(self.ctx, "sys_sampler", None)
+                        is not None
+                        else None
+                    ),
                 },
             )
         if path == "/druid/v2/datasources":
@@ -730,12 +761,22 @@ class _Handler(BaseHTTPRequestHandler):
         serving test pins this); a segment id or version this catalog
         cannot satisfy answers 409 (assignment skew — the broker treats
         the replica as failed and rebalances), never a wrong merge."""
+        from .cluster.wire import HEADER_PARENT_SPAN, HEADER_QUERY_ID
+
         res = self._resilience()
         cfg = getattr(self.ctx, "config", None)
         qctx = body.get("context")
         qctx = qctx if isinstance(qctx, dict) else {}
-        client_qid = qctx.get("queryId")
+        # trace propagation (ISSUE 19): the broker sends the query id
+        # both ways (context.queryId AND the X-Druid-Query-Id header) —
+        # context wins, the header covers native clients; the parent
+        # span id stamps this trace's cross-process parentage so the
+        # OTLP exports of both processes join under one trace id
+        client_qid = qctx.get("queryId") or self.headers.get(
+            HEADER_QUERY_ID
+        )
         self._query_id = str(client_qid) if client_qid else new_query_id()
+        parent_span = str(self.headers.get(HEADER_PARENT_SPAN) or "")
         storage = getattr(self.ctx, "storage", None)
         if storage is not None and storage.replay_in_progress:
             return self._error(
@@ -809,7 +850,12 @@ class _Handler(BaseHTTPRequestHandler):
                 query_id=self._query_id,
                 query_type="cluster_partial",
                 slow_ms=cfg.slow_query_ms if cfg else 0.0,
+                parent_span_id=parent_span,
             ) as tr:
+                if tr is not None:
+                    tr.root.attrs["node"] = getattr(
+                        self.ctx, "cluster_node_id", ""
+                    )
                 self.ctx._sync_engine_resilience(self.ctx.engine)
                 state, rows = self.ctx.engine.groupby_partials_host(
                     q, ds, within_uids=uids
@@ -826,6 +872,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # broker folds this into its own receipt's cluster
                 # section, so one query attributes across processes
                 doc["receipt"] = tr.receipt
+            if tr is not None:
+                # rendered span subtree for the broker to graft under
+                # its cluster_rpc span (ISSUE 19); size-capped, and any
+                # defect degrades to a stub — never a failed response
+                from .cluster.wire import encode_trace
+
+                subtree = encode_trace(tr.to_dict())
+                if subtree is not None:
+                    doc["trace"] = subtree
             return self._send(200, doc)
         except (WireError, ValueError) as e:
             return self._error(400, str(e), "BadQueryException")

@@ -2676,6 +2676,105 @@ _MATRIX = {
             """},
         ],
     },
+    "trace-propagation": {
+        "violating": [
+            # GL2701: scatter RPC built with no trace-header propagation
+            # anywhere in the enclosing function
+            (
+                {"spark_druid_olap_tpu/cluster/sender.py": """
+                    import urllib.request
+
+                    def rpc(url, payload):
+                        req = urllib.request.Request(
+                            url + "/druid/v2/cluster/partial",
+                            data=payload,
+                            method="POST",
+                        )
+                        return urllib.request.urlopen(req)
+                """},
+                {"GL2701"},
+            ),
+            # GL2702: graft-point span opened under an ad-hoc name the
+            # registry does not know
+            (
+                {
+                    "spark_druid_olap_tpu/obs/trace.py": """
+                        SPAN_CLUSTER_RPC = "cluster_rpc"
+                    """,
+                    "spark_druid_olap_tpu/cluster/graft.py": """
+                        from ..obs.trace import span_in
+
+                        def attempt(trace, parent, node):
+                            with span_in(trace, parent, "rpc-" + node):
+                                return node
+                    """,
+                },
+                {"GL2702"},
+            ),
+            # GL2703: federation fan-out loop with no checkpoint — one
+            # hung node stalls the whole merged scrape
+            (
+                {"spark_druid_olap_tpu/cluster/fed.py": """
+                    import urllib.request
+
+                    def scrape_all(nodes):
+                        out = {}
+                        for nid, url in sorted(nodes.items()):
+                            with urllib.request.urlopen(url) as r:
+                                out[nid] = r.read()
+                        return out
+                """},
+                {"GL2703"},
+            ),
+        ],
+        "clean": [
+            # GL2701 clean: headers built by wire.trace_headers and
+            # merged through
+            {"spark_druid_olap_tpu/cluster/sender.py": """
+                import urllib.request
+
+                def trace_headers(qid, span_id):
+                    return {"X-Druid-Query-Id": qid}
+
+                def rpc(url, payload, qid):
+                    req = urllib.request.Request(
+                        url + "/druid/v2/cluster/partial",
+                        data=payload,
+                        headers=trace_headers(qid, ""),
+                        method="POST",
+                    )
+                    return urllib.request.urlopen(req)
+            """},
+            # GL2702 clean: graft point named by a registered SPAN_*
+            # constant resolved through the import
+            {
+                "spark_druid_olap_tpu/obs/trace.py": """
+                    SPAN_CLUSTER_RPC = "cluster_rpc"
+                """,
+                "spark_druid_olap_tpu/cluster/graft.py": """
+                    from ..obs.trace import SPAN_CLUSTER_RPC, span_in
+
+                    def attempt(trace, parent, node):
+                        with span_in(trace, parent, SPAN_CLUSTER_RPC):
+                            return node
+                """,
+            },
+            # GL2703 clean: per-node checkpoint inside the fetch loop
+            {"spark_druid_olap_tpu/cluster/fed.py": """
+                import urllib.request
+
+                from ..resilience import checkpoint
+
+                def scrape_all(nodes):
+                    out = {}
+                    for nid, url in sorted(nodes.items()):
+                        checkpoint("cluster.federate")
+                        with urllib.request.urlopen(url) as r:
+                            out[nid] = r.read()
+                    return out
+            """},
+        ],
+    },
 }
 
 
@@ -3351,7 +3450,7 @@ def test_whole_tree_stats_meets_time_budget_acceptance():
         if l.startswith("graftlint --stats ")
     ][0]
     doc = json.loads(line[len("graftlint --stats "):])
-    assert doc["passes"] == len(ALL_PASSES) == 26
+    assert doc["passes"] == len(ALL_PASSES) == 27
     assert doc["findings_new"] == 0
     assert doc["total_seconds"] < 10.0, doc["per_pass_seconds"]
 

@@ -413,3 +413,119 @@ def test_degraded_trace_records_breaker_state_event():
     attrs = events[0]["attrs"]
     assert attrs["state"] in ("closed", "open", "half_open")
     assert "consecutive_failures" in attrs and "trips" in attrs
+
+
+# -- self-hosted telemetry: the __sys datasource (ISSUE 19) -------------------
+
+
+def _telemetry_ctx(tmp_path):
+    ctx = sd.TPUOlapContext(SessionConfig(storage_dir=str(tmp_path)))
+    rng = np.random.default_rng(7)
+    n = 1500
+    t0 = int(np.datetime64("2023-01-01", "ms").astype(np.int64))
+    ctx.register_table(
+        "ev",
+        {
+            "city": rng.choice(
+                np.array(["austin", "boston"], dtype=object), n
+            ),
+            "qty": rng.integers(1, 100, n).astype(np.int64),
+            "ts": np.full(n, t0, dtype=np.int64),
+        },
+        dimensions=["city"], metrics=["qty"], time_column="ts",
+    )
+    return ctx
+
+
+def test_sys_sampler_registers_and_appends_through_ingest(tmp_path):
+    from spark_druid_olap_tpu.obs.telemetry import SYS_TABLE
+
+    ctx = _telemetry_ctx(tmp_path)
+    ctx.sql("SELECT count(*) FROM ev")
+    s = ctx.start_sys_sampler(interval_s=60)
+    try:
+        n1 = s.sample_once()
+        assert n1 > 0
+        ds = ctx.catalog.get(SYS_TABLE)
+        assert ds is not None
+        assert ds.rollup_granularity == "second"
+        n2 = s.sample_once()
+        assert n2 > 0 and s.status()["ticks"] == 2
+        assert s.status()["errors"] == 0
+        # the second tick went through the ingest tier (WAL-journaled),
+        # not a re-registration
+        assert ctx.catalog.get(SYS_TABLE).num_rows >= n1
+    finally:
+        ctx.stop_sys_sampler()
+
+
+def test_sys_select_returns_qps_and_latency_history_under_churn(
+    tmp_path,
+):
+    """The ISSUE 19 acceptance cell: with the sampler running and
+    appends churning the store, a SELECT over __sys returns QPS and
+    latency history end-to-end."""
+    ctx = _telemetry_ctx(tmp_path)
+    s = ctx.start_sys_sampler(interval_s=60)
+    rng = np.random.default_rng(11)
+    t0 = int(np.datetime64("2023-01-02", "ms").astype(np.int64))
+    try:
+        for i in range(3):
+            # append churn: user-table ingests interleave the ticks
+            ctx.append_rows("ev", {
+                "city": np.array(["austin"] * 50, dtype=object),
+                "qty": rng.integers(1, 9, 50).astype(np.int64),
+                "ts": np.full(50, t0 + i, dtype=np.int64),
+            })
+            ctx.sql(f"SELECT city, sum(qty) FROM ev GROUP BY city "
+                    f"LIMIT {40 + i}")
+            assert s.sample_once() > 0
+        # QPS history: the per-tick delta of the query counter
+        qps = ctx.sql(
+            "SELECT sum(delta) AS d, max(value) AS total FROM __sys "
+            "WHERE metric = 'sdol_queries_total'"
+        )
+        assert qps["total"].iloc[0] >= 3
+        assert qps["d"].iloc[0] >= 2  # ticks after the first see deltas
+        # latency history: phase p99 rows flattened from the histogram
+        lat = ctx.sql(
+            "SELECT labels, max(value) AS p99 FROM __sys "
+            "WHERE metric = 'sdol_query_phase_ms_p99' GROUP BY labels"
+        )
+        assert len(lat) >= 1 and (lat["p99"] >= 0).all()
+        # ingest history proves the churn itself is observable too
+        ing = ctx.sql(
+            "SELECT max(value) AS v FROM __sys "
+            "WHERE metric = 'sdol_ingest_rows_total' "
+            "AND labels LIKE '%ev%'"
+        )
+        assert ing["v"].iloc[0] >= 150
+        st = s.status()
+        assert st["errors"] == 0 and st["rows_appended"] > 0
+    finally:
+        ctx.stop_sys_sampler()
+
+
+def test_sys_sampler_series_cap_and_fault_isolation(tmp_path):
+    ctx = _telemetry_ctx(tmp_path)
+    ctx.sql("SELECT count(*) FROM ev")
+    s = ctx.start_sys_sampler(interval_s=60)
+    try:
+        s.max_series = 5  # force the cardinality guard
+        assert s.sample_once() == 5
+        assert s.status()["rows_dropped"] > 0
+        # a failing append is fault-isolated: the tick logs and counts,
+        # the loop (and the process) never dies
+        orig = ctx.ingest.append_rows
+        ctx.ingest.append_rows = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("boom")
+        )
+        try:
+            assert s.sample_once() == 0
+        finally:
+            ctx.ingest.append_rows = orig
+        st = s.status()
+        assert st["errors"] == 1 and "boom" in st["last_error"]
+        assert s.sample_once() > 0  # next tick proceeds
+    finally:
+        ctx.stop_sys_sampler()

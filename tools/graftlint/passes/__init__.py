@@ -14,8 +14,9 @@ lock-order, GL15xx ingest-discipline, GL16xx partial-discipline, GL17xx
 serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
 GL20xx storage-discipline, GL21xx dispatch-discipline, GL22xx
 mesh-discipline, GL23xx broker-discipline, GL24xx fold-determinism,
-GL25xx shared-state-races, GL26xx sanitizer-discipline; GL00x are the
-core's own: GL001 unparseable file, GL002 malformed pragma).
+GL25xx shared-state-races, GL26xx sanitizer-discipline, GL27xx
+trace-propagation; GL00x are the core's own: GL001 unparseable file,
+GL002 malformed pragma).
 
 The GL24xx/GL25xx families are interprocedural: they run on
 `engine.DataflowEngine` (bound to every pass as `self.engine`), which
@@ -52,6 +53,7 @@ from .serving_discipline import ServingDisciplinePass
 from .shared_state_races import SharedStateRacesPass
 from .span_discipline import SpanDisciplinePass
 from .storage_discipline import StorageDisciplinePass
+from .trace_propagation import TracePropagationPass
 from .trace_purity import TracePurityPass
 from .transfer_discipline import TransferDisciplinePass
 from .wire_parity import WireParityPass
@@ -83,6 +85,7 @@ ALL_PASSES = (
     FoldDeterminismPass,
     SharedStateRacesPass,
     SanitizerDisciplinePass,
+    TracePropagationPass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

@@ -1,0 +1,257 @@
+"""trace-propagation pass: cross-process observability contracts
+(GL27xx, ISSUE 19 satellite).
+
+The cluster's observability story only works if every process-hop
+carries the trace with it: the broker stamps `X-Druid-Query-Id` /
+`X-Sdol-Parent-Span` onto each scatter RPC, the historical opens its
+trace under that identity, and the broker grafts the returned subtree
+under a REGISTERED span name that `/druid/v2/trace/{id}` consumers and
+the receipt folder match on.  Three contracts keep the chain auditable:
+
+* **GL2701 — cluster RPC sent without trace headers.**  A
+  `urllib.request.Request` built against the scatter endpoint
+  (`/druid/v2/cluster/partial`) inside a function with no header
+  propagation in sight — no `wire.trace_headers` call, no
+  `HEADER_QUERY_ID`/`HEADER_PARENT_SPAN` reference, not even a
+  `headers` parameter being merged through — ships an RPC the
+  historical cannot join to the broker's trace: the remote subtree
+  degrades to an `untraced` stub for every query, silently.  Like
+  GL2301 the check is deliberately loose (the discipline must be
+  PRESENT; the chaos matrix checks it is correct).
+* **GL2702 — graft point under an unregistered span name.**  The
+  explicit-handle span opener `span_in(trace, parent, name, ...)` is
+  how pool threads (invisible to the contextvar) record the
+  `cluster_rpc` attempt spans that remote subtrees graft under.  Its
+  name argument must statically resolve to a registered `SPAN_*`
+  constant from `obs/trace.py` — exactly GL1101's rule, extended to
+  the explicit-handle form: an ad-hoc graft-point name breaks the
+  receipt folder's per-node attribution and every name-matching trace
+  consumer.  A name the project layer cannot resolve is itself the
+  violation; when the registry module is outside the scanned tree the
+  name check stays silent (nothing to verify against).
+* **GL2703 — federation loop that never reaches a checkpoint.**  A
+  scrape/federation function's per-node fetch loop without a
+  `resilience.checkpoint(site)` call (lexically or one call level
+  down) is unbounded over a large membership and invisible to the
+  chaos matrix — a single hung node turns the merged scrape into a
+  stall instead of a stale-stamped row.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ..core import LintPass, ModuleContext, call_name, dotted_name
+
+_PARTIAL_ENDPOINT = "/druid/v2/cluster/partial"
+
+
+def _is_checkpoint(name: str, canon: str) -> bool:
+    return (
+        name == "checkpoint"
+        or name.endswith(".checkpoint")
+        or canon.endswith("resilience.checkpoint")
+    )
+
+
+def _mentions_any(root: ast.AST, needles) -> bool:
+    """Any identifier/attribute/string under `root` containing one of
+    `needles` (lower-cased substring match — presence check, GL2301
+    style)."""
+    for n in ast.walk(root):
+        if isinstance(n, ast.Name):
+            tok = n.id.lower()
+        elif isinstance(n, ast.Attribute):
+            tok = n.attr.lower()
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            tok = n.value.lower()
+        elif isinstance(n, ast.arg):
+            tok = n.arg.lower()
+        else:
+            continue
+        if any(m in tok for m in needles):
+            return True
+    return False
+
+
+class TracePropagationPass(LintPass):
+    name = "trace-propagation"
+    default_config = {
+        # the cross-process surface: the cluster tier + the server
+        # handler that opens the remote side of the trace
+        "include": (
+            "spark_druid_olap_tpu/cluster/",
+            "spark_druid_olap_tpu/server.py",
+        ),
+        # evidence of header propagation GL2701 accepts in the
+        # enclosing function (substring match on identifiers/strings)
+        "header_markers": (
+            "trace_headers", "header_query_id", "header_parent_span",
+            "x-druid-query-id", "x-sdol-parent-span", "headers",
+        ),
+        # GL2702 registry (same as span-discipline)
+        "registry_module": "spark_druid_olap_tpu/obs/trace.py",
+        "constant_prefix": "SPAN_",
+        # GL2703: functions considered federation fan-outs, and the
+        # call-name fragments that mark a loop as fetching
+        "federation_markers": ("federat", "scrape"),
+        "fetch_markers": ("urlopen", "scrape", "fetch", "request"),
+        "call_through_depth": 1,
+    }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        self._registered_cache: Optional[Set[str]] = None
+        self._registered_known = False
+
+    # -- registry resolution (GL2702) -----------------------------------------
+
+    def _registered(self) -> Optional[Set[str]]:
+        if self._registered_known:
+            return self._registered_cache
+        self._registered_known = True
+        if self.project is None:
+            return None
+        mod = self.project.modules.get(self.config["registry_module"])
+        if mod is None:
+            return None
+        prefix = self.config["constant_prefix"]
+        names: Set[str] = set()
+        for cname, expr in mod.constants.items():
+            if (
+                cname.startswith(prefix)
+                and isinstance(expr, ast.Constant)
+                and isinstance(expr.value, str)
+            ):
+                names.add(expr.value)
+        self._registered_cache = names or None
+        return self._registered_cache
+
+    # -- handlers -------------------------------------------------------------
+
+    def on_Call(self, node: ast.Call, ctx: ModuleContext):
+        self._check_rpc_sender(node, ctx)
+        self._check_graft_point(node, ctx)
+
+    # GL2701 ------------------------------------------------------------------
+
+    def _check_rpc_sender(self, node: ast.Call, ctx: ModuleContext):
+        name = call_name(node)
+        if not name or dotted_name(node.func).rsplit(".", 1)[-1] != (
+            "Request"
+        ):
+            return
+        if not any(
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and _PARTIAL_ENDPOINT in n.value
+            for n in ast.walk(node)
+        ):
+            return
+        scope = ctx.scope.current_func
+        if scope is not None and _mentions_any(
+            scope, self.config["header_markers"]
+        ):
+            return
+        self.report(
+            ctx, node, "GL2701",
+            "cluster RPC built with no trace-header propagation in the "
+            "enclosing function: without X-Druid-Query-Id / "
+            "X-Sdol-Parent-Span the historical cannot join the broker's "
+            "trace and every remote subtree degrades to an `untraced` "
+            "stub — build the headers with wire.trace_headers(query_id, "
+            "span_id) and pass them through",
+        )
+
+    # GL2702 ------------------------------------------------------------------
+
+    def _check_graft_point(self, node: ast.Call, ctx: ModuleContext):
+        if self.project is None:
+            return
+        name = call_name(node)
+        if not (name == "span_in" or name.endswith(".span_in")):
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is None:
+            return
+        registered = self._registered()
+        if registered is None:
+            return  # registry module not in this run's scope
+        arg = node.args[2] if len(node.args) > 2 else None
+        if arg is None:
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    arg = kw.value
+                    break
+        if arg is None:
+            self.report(
+                ctx, node, "GL2702",
+                "span_in() call without a name argument",
+            )
+            return
+        val = self.project.resolve_string(module, arg)
+        if val is None:
+            self.report(
+                ctx, node, "GL2702",
+                "span_in name is not a statically-resolvable string — "
+                "graft-point spans must use a registered SPAN_* constant "
+                "from obs/trace.py (the receipt folder and every trace "
+                "consumer match the graft point BY NAME)",
+            )
+        elif val not in registered:
+            self.report(
+                ctx, node, "GL2702",
+                f"span_in name {val!r} is not in the registered "
+                "span-name set (obs/trace.py SPAN_* constants) — "
+                "register the constant first, then use it",
+            )
+
+    # GL2703 ------------------------------------------------------------------
+
+    def _in_federation_scope(self, ctx: ModuleContext) -> bool:
+        markers = self.config["federation_markers"]
+        for f in ctx.scope.func_stack:
+            fname = getattr(f, "name", "").lower()
+            if any(m in fname for m in markers):
+                return True
+        return False
+
+    def _check_fetch_loop(self, node, ctx: ModuleContext):
+        if self.project is None or not self._in_federation_scope(ctx):
+            return
+        module = self.project.modules.get(ctx.relpath)
+        if module is None:
+            return
+        markers = tuple(self.config["fetch_markers"])
+        fetch = None
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            short = dotted_name(n.func).rsplit(".", 1)[-1]
+            short = short.lstrip("_").lower()
+            if fetch is None and any(m in short for m in markers):
+                fetch = n
+        if fetch is None:
+            return
+        covered = self.project.reaches_call(
+            module, node, _is_checkpoint,
+            depth=int(self.config["call_through_depth"]),
+            cls=ctx.scope.current_class,
+        )
+        if covered:
+            return
+        self.report(
+            ctx, node, "GL2703",
+            "federation fetch loop never reaches "
+            "resilience.checkpoint: one hung node stalls the whole "
+            "merged scrape unboundedly and the chaos matrix cannot "
+            "inject into the fan-out — call checkpoint(<site>) once "
+            "per node in the loop body",
+        )
+
+    def on_For(self, node: ast.For, ctx: ModuleContext):
+        self._check_fetch_loop(node, ctx)
+
+    def on_While(self, node: ast.While, ctx: ModuleContext):
+        self._check_fetch_loop(node, ctx)

@@ -207,21 +207,71 @@ def render_receipts(rows: List[Tuple[str, dict]]) -> str:
         )
         cluster = (rc.get("cluster") or {}).get("nodes") or {}
         if cluster:
-            # broker receipts (ISSUE 16): scatter/gather/merge wall
-            # attribution plus the per-historical RPC buckets the
-            # scatter span's rpc events aggregated
+            # broker receipts (ISSUE 16/19): scatter/gather/merge wall
+            # attribution plus the per-historical table folded from the
+            # grafted remote subtrees — device/transfer/host buckets on
+            # each historical's own clock next to the broker-side rpc
+            # wall, so a slow node splits into "slow device work" vs
+            # "slow network path" at a glance
             lines.append(
                 f"  cluster: scatter={rc.get('scatter_ms', 0):.2f}ms "
                 f"gather={rc.get('gather_ms', 0):.2f}ms "
                 f"merge={rc.get('cluster_merge_ms', 0):.2f}ms"
             )
+            lines.append(
+                f"    {'node':<18} {'rpc':>9} {'device':>9} "
+                f"{'xfer':>8} {'host':>8} {'remote':>9} "
+                f"{'rpcs':>4} {'ok':>3} {'fail':>4} {'seg':>4}  flags"
+            )
             for node, b in sorted(cluster.items()):
+                flags = []
+                if b.get("hedged"):
+                    flags.append(f"hedged={b['hedged']}")
+                if b.get("untraced"):
+                    flags.append(f"untraced={b['untraced']}")
                 lines.append(
-                    f"    {node[:24]:<24} {b.get('ms', 0):>8.2f}ms "
-                    f"rpcs={b.get('rpcs', 0)} ok={b.get('ok', 0)} "
-                    f"failed={b.get('failed', 0)} "
-                    f"segments={b.get('segments', 0)}"
+                    f"    {node[:18]:<18} {b.get('ms', 0):>8.2f}m "
+                    f"{b.get('device_ms', 0):>8.2f}m "
+                    f"{b.get('transfer_ms', 0):>7.2f}m "
+                    f"{b.get('host_ms', 0):>7.2f}m "
+                    f"{b.get('remote_wall_ms', 0):>8.2f}m "
+                    f"{b.get('rpcs', 0):>4} {b.get('ok', 0):>3} "
+                    f"{b.get('failed', 0):>4} "
+                    f"{b.get('segments', 0):>4}  {' '.join(flags)}".rstrip()
                 )
+    return "\n".join(lines)
+
+
+def _find_sampler_status(doc: Any) -> Optional[dict]:
+    """The __sys telemetry sampler's status dict (obs/telemetry.py):
+    under "sys_sampler" in a /status document, or the bare dict."""
+    if not isinstance(doc, dict):
+        return None
+    st = doc.get("sys_sampler", doc)
+    if (
+        isinstance(st, dict)
+        and st.get("table") == "__sys"
+        and "ticks" in st
+    ):
+        return st
+    return None
+
+
+def render_sampler_status(st: dict) -> str:
+    lines = ["__sys telemetry sampler (obs/telemetry.py)"]
+    run = "running" if st.get("running") else "stopped"
+    lines.append(
+        f"  {run}, every {st.get('interval_s', 0)}s, "
+        f"cap {st.get('max_series', 0)} series/tick"
+    )
+    lines.append(
+        f"  ticks={st.get('ticks', 0)} rows={st.get('rows_appended', 0)} "
+        f"dropped={st.get('rows_dropped', 0)} errors={st.get('errors', 0)} "
+        f"tracked_series={st.get('tracked_series', 0)} "
+        f"last_tick={st.get('last_tick_ms', 0)}ms"
+    )
+    if st.get("last_error"):
+        lines.append(f"  last_error: {st['last_error']}")
     return "\n".join(lines)
 
 
@@ -243,6 +293,9 @@ def dump(doc: Any) -> str:
     exemplars = _find_exemplars(doc)
     if exemplars:
         out.append(render_exemplars(exemplars))
+    sampler = _find_sampler_status(doc)
+    if sampler:
+        out.append(render_sampler_status(sampler))
     if not out:
         return "no span trees found in input"
     return "\n\n".join(out)
