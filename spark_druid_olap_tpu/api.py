@@ -132,6 +132,7 @@ class TPUOlapContext:
             rows_per_segment=self.config.compaction_rows_per_segment,
             min_delta_rows=self.config.compaction_min_delta_rows,
             interval_s=self.config.compaction_interval_s,
+            sys_retention_s=self.config.sys_retention_s,
         )
         # cluster tier (cluster/, ISSUE 16): set by ClusterClient.attach
         # when this context runs as a BROKER — the serving paths scatter

@@ -307,7 +307,8 @@ class ClusterClient:
 
         sections: Dict[str, Optional[str]] = dict(
             scrape_nodes(
-                self.nodes(), "/status/metrics", self.scrape_timeout_s
+                self.nodes(), "/status/metrics", self.scrape_timeout_s,
+                pool=self._pool,
             )
         )
         sections["broker"] = get_registry().render_prometheus()
@@ -320,7 +321,8 @@ class ClusterClient:
         from .federation import scrape_nodes_json
 
         docs = scrape_nodes_json(
-            self.nodes(), "/status/profile", self.scrape_timeout_s
+            self.nodes(), "/status/profile", self.scrape_timeout_s,
+            pool=self._pool,
         )
         return {
             "cluster": True,

@@ -15,9 +15,14 @@ Staleness model: an unreachable historical NEVER fails the scrape — it
 is simply absent from the merged series and stamped on the
 `sdol_cluster_scrape_stale` gauge (1 = last scrape failed), so a
 dashboard distinguishes "node reports zero" from "node unreachable".
-The federation loop passes `resilience.checkpoint("cluster.federate")`
+The federation fan-out passes `resilience.checkpoint("cluster.federate")`
 per node (trace-propagation/GL2703): deadlines bound a scrape fanned
-over a large membership, and the chaos matrix can arm the site.
+over a large membership, and the chaos matrix can arm the site.  The
+broker hands `scrape_nodes` its scatter pool so the per-node fetches
+run concurrently (one slowest-node round trip, not the serial sum);
+node ids are sorted before submission and folded in that order, so the
+merged exposition is byte-identical between the serial and parallel
+paths.
 """
 
 from __future__ import annotations
@@ -47,36 +52,58 @@ STALE_METRIC = "sdol_cluster_scrape_stale"
 _SCRAPE_MAX_BYTES = 4 << 20
 
 
+def _fetch_node(url: str, path: str, timeout_s: float) -> Optional[str]:
+    """One node's scrape body, or None (the staleness stamp) on any
+    fetch failure.  The federation checkpoint (GL2703) fires OUTSIDE
+    the fault-ok try: a deadline/chaos injection at the site must
+    propagate to the caller (via `Future.result()` on the parallel
+    path), never be mistaken for an unreachable node."""
+    checkpoint("cluster.federate")
+    try:
+        with urllib.request.urlopen(
+            url + path, timeout=timeout_s
+        ) as resp:
+            return resp.read(_SCRAPE_MAX_BYTES).decode(
+                "utf-8", "replace"
+            )
+    except Exception as e:  # fault-ok: stale stamp, never a 500
+        log.warning("scrape of %s%s failed: %s", url, path, e)
+        return None
+
+
 def scrape_nodes(
-    nodes: Dict[str, str], path: str, timeout_s: float
+    nodes: Dict[str, str], path: str, timeout_s: float, pool=None,
 ) -> Dict[str, Optional[str]]:
     """GET `path` from every node; None marks an unreachable node (the
     staleness stamp), never an exception — the merged scrape must serve
-    through any subset of the membership being down."""
-    out: Dict[str, Optional[str]] = {}
-    for nid, url in sorted(nodes.items()):
-        # federation checkpoint (GL2703): deadline + chaos-injection
-        # point, once per node in the fan-out
-        checkpoint("cluster.federate")
-        try:
-            with urllib.request.urlopen(
-                url + path, timeout=timeout_s
-            ) as resp:
-                out[nid] = resp.read(_SCRAPE_MAX_BYTES).decode(
-                    "utf-8", "replace"
-                )
-        except Exception as e:  # fault-ok: stale stamp, never a 500
-            log.warning("scrape of %s%s failed: %s", url, path, e)
-            out[nid] = None
-    return out
+    through any subset of the membership being down.
+
+    With `pool` (the broker passes its scatter executor) the fetches
+    fan out concurrently, so a scrape of N nodes costs one slowest-node
+    round trip instead of the serial sum — the per-node `timeout_s`
+    still bounds each fetch individually.  Node ids are sorted BEFORE
+    submission and the result dict is built in that same order, so the
+    downstream first-writer-wins merge fold sees an identical sequence
+    on the serial and parallel paths (fold-determinism/GL24xx)."""
+    items = sorted(nodes.items())
+    if pool is None:
+        return OrderedDict(
+            (nid, _fetch_node(url, path, timeout_s))
+            for nid, url in items
+        )
+    futs = [
+        (nid, pool.submit(_fetch_node, url, path, timeout_s))
+        for nid, url in items
+    ]
+    return OrderedDict((nid, fut.result()) for nid, fut in futs)
 
 
 def scrape_nodes_json(
-    nodes: Dict[str, str], path: str, timeout_s: float
+    nodes: Dict[str, str], path: str, timeout_s: float, pool=None,
 ) -> Dict[str, Optional[dict]]:
     """`scrape_nodes` + JSON decode; an unparseable body is stale too."""
     docs: Dict[str, Optional[dict]] = {}
-    for nid, text in scrape_nodes(nodes, path, timeout_s).items():
+    for nid, text in scrape_nodes(nodes, path, timeout_s, pool).items():
         if text is None:
             docs[nid] = None
             continue

@@ -311,6 +311,12 @@ class SessionConfig:
     sys_sampler_s: float = 0.0
     # per-tick series cap for the `__sys` sampler (cardinality guard)
     sys_sampler_max_series: int = 512
+    # age-based `__sys` retention: a second-granularity telemetry
+    # segment whose NEWEST row is older than this many seconds is
+    # dropped by the background compaction sweep (whole segments only —
+    # never a partial rewrite), so self-hosted telemetry is a ring, not
+    # a leak.  0 (default) retains everything.
+    sys_retention_s: float = 0.0
 
     # -- performance attribution (obs/prof.py, ISSUE 9) ---------------------
     # fraction of queries sampled for HONEST device timing: a sampled

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -57,6 +58,7 @@ class Compactor:
         min_delta_rows: int = 0,
         interval_s: float = 5.0,
         min_delta_segments: int = 64,
+        sys_retention_s: float = 0.0,
     ):
         self.ingest = ingest
         self.rows_per_segment = int(rows_per_segment)
@@ -67,6 +69,9 @@ class Compactor:
         # while staying under the row threshold
         self.min_delta_segments = max(1, int(min_delta_segments))
         self.interval_s = float(interval_s)
+        # `__sys` telemetry retention (config.sys_retention_s): the
+        # sweep drops whole aged rollup segments; 0 keeps everything
+        self.sys_retention_s = float(sys_retention_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -176,6 +181,71 @@ class Compactor:
         )
         return list(part.segments), absorbed
 
+    # -- age-based retention (`__sys` telemetry ring) ------------------------
+
+    def retire_aged(
+        self, name: str, retention_s: float,
+        now_ms: Optional[int] = None,
+    ) -> dict:
+        """Drop every HISTORICAL segment of `name` whose newest row is
+        older than `retention_s` seconds.  Whole segments only — the
+        second-granularity `__sys` rollup makes segments time-local, so
+        age-out never needs a partial rewrite; delta segments are left
+        for normal compaction to fold first (dropping an unfolded delta
+        would resurrect its rows from the WAL on recovery).  Runs under
+        the same per-datasource ingest lock appends and compactions
+        take, and flushes the shrunk snapshot through the storage tier's
+        rename-then-GC commit protocol when one is attached."""
+        if retention_s <= 0:
+            return {"datasource": name, "dropped_segments": 0}
+        if now_ms is None:
+            now_ms = int(time.time() * 1e3)
+        cutoff_ms = now_ms - retention_s * 1e3
+        buf = self.ingest.buffer(name)
+        with buf._lock:
+            ds = self.ingest.catalog.get(name)
+            if ds is None or ds.time_column is None:
+                return {"datasource": name, "dropped_segments": 0}
+            keep: List[Segment] = []
+            drop = []
+            for s in ds.segments:
+                checkpoint("compact.sweep_datasource")
+                t = s.time
+                if t is None or isinstance(s, DeltaSegment):
+                    keep.append(s)
+                    continue
+                tv = np.asarray(t)[s.valid]
+                if tv.size and float(tv.max()) < cutoff_ms:
+                    drop.append(s)
+                else:
+                    keep.append(s)
+            if not drop:
+                return {"datasource": name, "dropped_segments": 0}
+            published = self.ingest.catalog.put(
+                dataclasses.replace(ds, segments=tuple(keep))
+            )
+            self.ingest._dropped(frozenset(s.uid for s in drop))
+            if self.storage is not None:
+                self.storage.flush_locked(name, published)
+        n_rows = sum(s.num_rows for s in drop)
+        log.info(
+            "retired %d aged segment(s) (%d rows) from %s "
+            "(retention %.0fs)", len(drop), n_rows, name, retention_s,
+        )
+        return {
+            "datasource": name,
+            "dropped_segments": len(drop),
+            "dropped_rows": n_rows,
+            "datasourceVersion": published.version,
+        }
+
+    def _retire_sys(self) -> dict:
+        from ..obs.telemetry import SYS_TABLE
+
+        if self.ingest.catalog.get(SYS_TABLE) is None:
+            return {"datasource": SYS_TABLE, "dropped_segments": 0}
+        return self.retire_aged(SYS_TABLE, self.sys_retention_s)
+
     # -- background sweep ----------------------------------------------------
 
     def run_pending(self) -> List[dict]:
@@ -202,6 +272,13 @@ class Compactor:
                         "background compaction of %s failed", name,
                         exc_info=True,
                     )
+        if self.sys_retention_s > 0:
+            try:
+                res = self._retire_sys()
+                if res.get("dropped_segments"):
+                    out.append(res)
+            except Exception:  # fault-ok: retention must not stop the sweep
+                log.warning("__sys retention sweep failed", exc_info=True)
         return out
 
     def start(self) -> "Compactor":

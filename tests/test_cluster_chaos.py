@@ -23,6 +23,7 @@ subprocesses.
 """
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -510,3 +511,59 @@ def test_federated_scrape_with_dead_node_stale_never_500(tmp_path):
     finally:
         srv.shutdown()
         c.close()
+
+
+def test_parallel_scrape_matches_serial_and_propagates_faults():
+    """ISSUE 20 satellite: the broker-pooled scrape fan-out answers
+    byte-identically to the serial path (sorted submission + sorted
+    fold), stamps unreachable nodes stale, and lets an injected fault
+    at `cluster.federate` propagate out of `Future.result()` instead of
+    being swallowed as staleness."""
+    import http.server
+    from concurrent.futures import ThreadPoolExecutor
+
+    from spark_druid_olap_tpu.cluster.federation import (
+        merge_prometheus,
+        scrape_nodes,
+    )
+    from spark_druid_olap_tpu.resilience import InjectedFault
+
+    class _H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = (
+                "# HELP m x\n# TYPE m counter\n"
+                f"m{{port=\"{self.server.server_address[1]}\"}} 1\n"
+            ).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    servers, nodes = [], {}
+    for i in range(3):
+        s = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+        servers.append(s)
+        nodes[f"h{i}"] = f"http://127.0.0.1:{s.server_address[1]}"
+    nodes["zz-dead"] = "http://127.0.0.1:9"  # refused -> stale stamp
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        serial = scrape_nodes(nodes, "/status/metrics", 2.0)
+        par = scrape_nodes(nodes, "/status/metrics", 2.0, pool=pool)
+        assert list(par) == list(serial) == sorted(nodes)
+        assert par == serial
+        assert merge_prometheus(dict(par)) == merge_prometheus(
+            dict(serial)
+        )
+        assert par["zz-dead"] is None and par["h0"] is not None
+
+        injector().arm("cluster.federate", mode="error", times=1)
+        with pytest.raises(InjectedFault):
+            scrape_nodes(nodes, "/status/metrics", 2.0, pool=pool)
+    finally:
+        injector().disarm()
+        pool.shutdown(wait=False)
+        for s in servers:
+            s.shutdown()

@@ -487,3 +487,132 @@ def test_install_uninstall_roundtrip_restores_classes(monkeypatch):
     assert PartialCollector.__dict__.get("__setattr__") is before_setattr
     assert CanonicalFold.add is before_add
     assert graftsan.probe_count() == 0
+
+
+# -- protocol witness (ISSUE 20): GL28xx/GL29xx enforced live -----------------
+
+
+def _protocol_contracts():
+    """Hand-built table: just the durable-publish machine, two stamp
+    sites, and the admission-slot balance probes."""
+    from tools.graftlint.contracts import _jsonify
+    from tools.graftlint.passes.durability_protocol import (
+        DURABLE_PUBLISH,
+    )
+
+    return {
+        "version": 1,
+        "package": "tests",
+        "lock_ownership": [],
+        "lock_attrs": {},
+        "fold_sinks": [],
+        "thread_roots": [],
+        "allow_sites": [],
+        "protocol_automata": [_jsonify(DURABLE_PUBLISH)],
+        "effect_sites": {
+            "wal.journal_write": "journal",
+            "wal.post_fsync_pre_publish": "fsync",
+        },
+        "protocol_probes": [
+            {"module": "spark_druid_olap_tpu.resilience",
+             "class": "AdmissionController", "method": "acquire",
+             "effect": "acquire"},
+            {"module": "spark_druid_olap_tpu.resilience",
+             "class": "AdmissionController", "method": "release",
+             "effect": "release"},
+        ],
+    }
+
+
+@pytest.fixture()
+def protocol_san():
+    san = Sanitizer(_protocol_contracts(), ROOT, seed=9)
+    san.install(schedule=False)
+    try:
+        yield san
+    finally:
+        san.uninstall()
+
+
+def test_correct_publish_order_and_rearming_are_clean(protocol_san):
+    """journal -> fsync -> publish satisfies the machine; the next
+    journal re-arms it from the accept state for the next operation."""
+    for _ in range(2):
+        resilience.checkpoint("wal.journal_write")
+        resilience.checkpoint("wal.post_fsync_pre_publish")
+        protocol_san.protocol.stamp("publish", "catalog.put")
+    # an UNARMED publish (no journal in flight) is the ephemeral path:
+    # the static later:journal evidence rule maps to arming here
+    protocol_san.protocol.stamp("publish", "catalog.put")
+    assert protocol_san.violations == []
+    assert protocol_san.protocol.stamps == 7
+
+
+def test_injected_out_of_order_publish_caught_with_replay_seed(
+    protocol_san,
+):
+    resilience.checkpoint("wal.journal_write")  # arms the machine
+    with pytest.raises(graftsan.SanitizerViolation) as ei:
+        protocol_san.protocol.stamp("publish", "catalog.put")
+    msg = str(ei.value)
+    assert "GL2801" in msg and "durable-publish" in msg
+    # the stamp trail and the exact replay seed ride the message
+    assert "journal@wal.journal_write" in msg
+    assert f"{graftsan.ENV_SEED}=9" in msg
+    assert protocol_san.violations[-1]["kind"] == "protocol"
+    # the machine reset: the NEXT correctly-ordered operation is clean
+    resilience.checkpoint("wal.journal_write")
+    resilience.checkpoint("wal.post_fsync_pre_publish")
+    protocol_san.protocol.stamp("publish", "catalog.put")
+    assert len(protocol_san.violations) == 1
+
+
+def test_leaked_admission_slot_caught_by_quiesce_check(protocol_san):
+    from spark_druid_olap_tpu.resilience import AdmissionController
+
+    pool = AdmissionController(max_concurrent=2, queue_timeout_ms=50.0)
+    assert pool.acquire()
+    with pytest.raises(graftsan.SanitizerViolation) as ei:
+        protocol_san.protocol.check_leaks()
+    msg = str(ei.value)
+    assert "GL2901" in msg and "slot" in msg
+    assert f"{graftsan.ENV_SEED}=9" in msg
+    pool.release()
+    protocol_san.protocol.check_leaks()  # balanced: no violation
+    # a REJECTED acquire (False) holds nothing and must not count
+    a, b = pool.acquire(), pool.acquire()
+    assert a and b
+    assert pool.acquire() is False  # pool exhausted, times out
+    pool.release()
+    pool.release()
+    protocol_san.protocol.check_leaks()
+    assert len(protocol_san.violations) == 1
+
+
+def test_protocol_hook_chains_behind_scheduler_and_restores(monkeypatch):
+    """Full install: the effect stamp chains BEHIND the explorer's
+    perturbation hook (both see every site), and uninstall leaves the
+    process byte-for-byte unwrapped."""
+    monkeypatch.setenv(graftsan.ENV_ARM, "1")
+    from spark_druid_olap_tpu.resilience import AdmissionController
+
+    before_acquire = AdmissionController.__dict__["acquire"]
+    before_release = AdmissionController.__dict__["release"]
+    san = graftsan.install(
+        contracts_path=CONTRACTS_PATH, root=ROOT, seed=0
+    )
+    try:
+        assert AdmissionController.__dict__["acquire"] is not before_acquire
+        n0 = san.protocol.stamps
+        resilience.checkpoint("wal.journal_write")
+        assert san.scheduler.site_counts["wal.journal_write"] == 1
+        assert san.protocol.stamps == n0 + 1
+        # a site with no effect mapping reaches only the explorer
+        resilience.checkpoint("engine.batch")
+        assert san.protocol.stamps == n0 + 1
+    finally:
+        graftsan.uninstall()
+    assert resilience._sched_hook is None
+    assert AdmissionController.__dict__["acquire"] is before_acquire
+    assert AdmissionController.__dict__["release"] is before_release
+    assert graftsan.probe_count() == 0

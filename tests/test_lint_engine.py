@@ -678,3 +678,141 @@ def test_pragma_and_allow_config_suppress_races(tmp_path):
         ]}},
     )
     assert res.new == [], [f.render() for f in res.new]
+
+
+# ---------------------------------------------------------------------------
+# effect-summary layer + protocol automata (GL28xx/GL29xx substrate)
+# ---------------------------------------------------------------------------
+
+_EFFECT_TREE = {
+    "pkg/__init__.py": "",
+    "pkg/res.py": "def checkpoint(site):\n    pass\n",
+    "pkg/wal.py": """
+        from .res import checkpoint
+
+        class WriteAheadLog:
+            def append(self, ds):
+                checkpoint("wal.journal_write")
+                checkpoint("wal.post_fsync_pre_publish")
+                self.catalog.put(ds)
+                return True
+    """,
+    "pkg/gate.py": """
+        from .res import checkpoint
+
+        class Gate:
+            def run(self, res, q):
+                if not res.admission.acquire():
+                    return None
+                try:
+                    checkpoint("serve.lane_execute")
+                    return self._work(q)
+                finally:
+                    res.admission.release()
+
+            def leaky(self, res, q):
+                res.admission.acquire()
+                checkpoint("serve.lane_execute")
+                res.admission.release()
+
+            def locked(self):
+                self._lock.acquire()
+                self._lock.release()
+    """,
+}
+
+
+def _effect_seqs(eff, fi):
+    return {
+        (p.exit, tuple((e.kind, e.res) for e in p.effects))
+        for p in eff.paths(fi)
+    }
+
+
+def test_effect_paths_order_sites_and_exception_splits(tmp_path):
+    """The enumerated paths carry ordered effect sequences with one
+    raise variant per may-raise point, each holding the PRE-commit
+    state of the failing step (an injected fault means the step did
+    not happen)."""
+    project, engine = engine_of(tmp_path, _EFFECT_TREE)
+    eff = engine.effects({})
+    fi = project.modules["pkg/wal.py"].functions["WriteAheadLog.append"]
+    seqs = _effect_seqs(eff, fi)
+    assert ("return", (
+        ("journal", "wal.journal_write"),
+        ("fsync", "wal.post_fsync_pre_publish"),
+        ("publish", "self.catalog.put"),
+    )) in seqs
+    # checkpoint raises carry pre-site state; the publish raise carries
+    # journal+fsync (durable-but-unpublished: the GL2803 window)
+    assert ("raise", ()) in seqs
+    assert ("raise", (("journal", "wal.journal_write"),)) in seqs
+    assert ("raise", (
+        ("journal", "wal.journal_write"),
+        ("fsync", "wal.post_fsync_pre_publish"),
+    )) in seqs
+
+
+def test_effect_finally_balances_every_raise_edge(tmp_path):
+    project, engine = engine_of(tmp_path, _EFFECT_TREE)
+    eff = engine.effects({})
+    mod = project.modules["pkg/gate.py"]
+    # try/finally: every exit (return AND raise) releases the slot
+    for p in eff.paths(mod.functions["Gate.run"]):
+        kinds = [e.kind for e in p.effects]
+        assert kinds == ["acquire", "release"], (p.exit, kinds)
+    # no finally: the checkpoint's raise edge leaks the open acquire
+    leaky = _effect_seqs(eff, mod.functions["Gate.leaky"])
+    assert ("raise", (("acquire", "res.admission"),)) in leaky
+    # finally_paths exposes the finalizer's own effect paths (GL2903)
+    fps = eff.finally_paths(mod.functions["Gate.run"])
+    assert len(fps) == 1
+    _node, fpaths = fps[0]
+    assert {e.kind for p in fpaths for e in p.effects} == {"release"}
+
+
+def test_lockish_receivers_are_not_slot_resources(tmp_path):
+    """`self._lock.acquire()` is lock discipline (GL5xx/GL25xx), not a
+    slot/lane/span resource — the effect layer must not model it."""
+    project, engine = engine_of(tmp_path, _EFFECT_TREE)
+    eff = engine.effects({})
+    fi = project.modules["pkg/gate.py"].functions["Gate.locked"]
+    assert _effect_seqs(eff, fi) == {("return", ())}
+
+
+def test_effects_analysis_is_memoized_per_config(tmp_path):
+    _, engine = engine_of(tmp_path, _EFFECT_TREE)
+    a = engine.effects({"summary_depth": 3})
+    b = engine.effects({"summary_depth": 3})
+    c = engine.effects({"summary_depth": 2})
+    assert a is b and a is not c
+
+
+def test_protocol_automaton_static_run_and_whole_or_absent(tmp_path):
+    """The durable-publish machine flags a raise edge inside the
+    post-fsync pre-publish window — unless the function's canonical
+    name carries the whole-or-absent exemption."""
+    from tools.graftlint.engine import ProtocolAutomaton
+    from tools.graftlint.passes.durability_protocol import (
+        DURABLE_PUBLISH,
+    )
+
+    project, engine = engine_of(tmp_path, _EFFECT_TREE)
+    eff = engine.effects({})
+    fi = project.modules["pkg/wal.py"].functions["WriteAheadLog.append"]
+    a = ProtocolAutomaton(dict(DURABLE_PUBLISH))
+    canon = "pkg.wal.WriteAheadLog.append"
+    assert a.matches(canon)
+    assert not a.matches("pkg.wal.WriteAheadLog.replay")
+    findings = [
+        (code, msg)
+        for p in eff.paths(fi)
+        for _n, code, msg in a.run_static(p, canon, frozenset())
+    ]
+    assert [c for c, _ in findings] == ["GL2803"]
+    exempt = [
+        code
+        for p in eff.paths(fi)
+        for _n, code, _m in a.run_static(p, canon, frozenset({canon}))
+    ]
+    assert exempt == []

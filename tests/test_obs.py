@@ -529,3 +529,64 @@ def test_sys_sampler_series_cap_and_fault_isolation(tmp_path):
         assert s.sample_once() > 0  # next tick proceeds
     finally:
         ctx.stop_sys_sampler()
+
+
+def test_sys_retention_drops_aged_rollup_segments(tmp_path):
+    """config.sys_retention_s (ISSUE 20 satellite): aged second-
+    granularity `__sys` segments are dropped whole by the compaction
+    sweep — telemetry is a ring, not a leak — and the drop persists
+    through the storage tier's rename-then-GC commit."""
+    import time as _time
+
+    from spark_druid_olap_tpu.obs.telemetry import SYS_TABLE
+
+    ctx = sd.TPUOlapContext(
+        SessionConfig(storage_dir=str(tmp_path), sys_retention_s=3600.0)
+    )
+    assert ctx.compactor.sys_retention_s == 3600.0  # config plumbed
+    rng = np.random.default_rng(7)
+    ctx.register_table(
+        "ev",
+        {
+            "city": np.array(["austin"] * 50, dtype=object),
+            "qty": rng.integers(1, 9, 50).astype(np.int64),
+        },
+        dimensions=["city"], metrics=["qty"],
+    )
+    ctx.sql("SELECT count(*) FROM ev")
+    s = ctx.start_sys_sampler(interval_s=60)
+    try:
+        assert s.sample_once() > 0
+        assert s.sample_once() > 0
+    finally:
+        ctx.stop_sys_sampler()
+
+    # unfolded delta ticks are NEVER age-dropped (recovery would
+    # resurrect them from the WAL), even against a far-future horizon —
+    # only the registration-seed historical segment may age out here
+    far_future = int(_time.time() * 1e3) + 10**10
+    ctx.compactor.retire_aged(SYS_TABLE, 3600.0, now_ms=far_future)
+    ds0 = ctx.catalog.get(SYS_TABLE)
+    assert ds0.delta_segments() and ds0.delta_rows > 0
+
+    ctx.compact(SYS_TABLE)  # fold ticks into historical segments
+    ds = ctx.catalog.get(SYS_TABLE)
+    assert ds.num_rows > 0 and ds.historical_segments()
+
+    # a generous horizon with fresh data drops nothing (run_pending ride)
+    assert ctx.compactor.run_pending() == []
+    v0 = ctx.catalog.get(SYS_TABLE).version
+
+    # against the far-future clock every historical segment is aged out
+    res = ctx.compactor.retire_aged(SYS_TABLE, 3600.0, now_ms=far_future)
+    assert res["dropped_segments"] >= 1
+    ds2 = ctx.catalog.get(SYS_TABLE)
+    assert ds2.num_rows == 0 and ds2.version > v0
+
+    # the drop is durable: a restarted node does not resurrect the ring
+    ctx2 = sd.TPUOlapContext(SessionConfig(storage_dir=str(tmp_path)))
+    sys_ds = ctx2.catalog.get(SYS_TABLE)
+    assert sys_ds is None or sys_ds.num_rows == 0
+    # and the user table is untouched by the telemetry sweep
+    got = ctx2.sql("SELECT count(*) AS c FROM ev")
+    assert int(got["c"].iloc[0]) == 50

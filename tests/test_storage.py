@@ -537,3 +537,90 @@ def test_flush_sweep_timer_thread(tmp_path):
     ctx.storage.stop_flush_sweep()
     ctx3 = _ctx(tmp_path)
     assert ctx3.storage.state()["flush_sweep"]["running"] is False
+
+
+# -- raise (not kill) at every durability site: the LIVE process must ---------
+#    stay whole-or-absent and leak nothing (the GL29xx runtime contract)
+
+
+def _assert_no_leaked_slots(ctx):
+    """Every admission/lane slot released: the exception path must not
+    leave a slot held (the GL2901 leak shape), and the registry gauges
+    — what an operator actually watches — must agree."""
+    from spark_druid_olap_tpu.obs import get_registry
+
+    res = ctx.resilience
+    assert res.admission.in_use == 0
+    assert res.ingest_admission.in_use == 0
+    for lane, pool in res.lanes.items():
+        assert pool.in_use == 0, f"lane {lane} leaked a slot"
+    for line in get_registry().render_prometheus().splitlines():
+        if line.startswith("sdol_admission_slots_in_use") or (
+            line.startswith("sdol_lane_slots_in_use")
+        ):
+            assert float(line.rsplit(" ", 1)[1]) == 0.0, line
+
+
+@pytest.mark.parametrize(
+    "site",
+    ["wal.journal_write", "wal.pre_fsync", "wal.post_fsync_pre_publish"],
+)
+def test_raise_mid_append_whole_or_absent(tmp_path, site):
+    """Unlike the kill matrix, the process SURVIVES the exception: the
+    same live context must answer whole-or-absent (an un-acked batch is
+    fully visible or fully absent, never torn), keep serving, and hold
+    zero admission/lane slots afterwards."""
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    injector().arm(site, mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        ctx.append_rows("ev", extra)
+    injector().disarm()
+
+    without, with_ = _oracle(base), _oracle(base, extra)
+    got = ctx.sql(Q)
+    assert got.equals(without) or got.equals(with_), (
+        "live context answered a TORN batch after an in-process raise"
+    )
+    # a restart must also be whole-or-absent — note it may legitimately
+    # DISAGREE with the live answer at wal.post_fsync_pre_publish (the
+    # batch is durable but unpublished: invisible live, replayed on
+    # recovery); both states are within the un-acked contract
+    got2 = _ctx(tmp_path).sql(Q)
+    assert got2.equals(without) or got2.equals(with_)
+    # the survivor is fully live: the next append lands whole
+    more = _append_cols(seed=13)
+    ctx.append_rows("ev", more)
+    final = ctx.sql(Q)
+    assert final.equals(_oracle(base, more)) or final.equals(
+        _oracle(base, extra, more)
+    )
+    _assert_no_leaked_slots(ctx)
+
+
+@pytest.mark.parametrize("site", ["persist.snapshot_rename", "compact.retire"])
+def test_raise_mid_compaction_whole_or_absent(tmp_path, site):
+    """An exception inside the snapshot-commit window loses NOTHING in
+    the live process (every row was acked) and leaks no slot; the next
+    compaction completes the interrupted flush."""
+    base, extra = _base_cols(), _append_cols()
+    ctx = _ctx(tmp_path)
+    _register(ctx, base)
+    ctx.append_rows("ev", extra)
+    want = _oracle(base, extra)
+
+    injector().arm(site, mode="error", times=1)
+    with pytest.raises(InjectedFault):
+        ctx.compact("ev")
+    injector().disarm()
+
+    assert ctx.sql(Q).equals(want), "live answer changed across a raise"
+    assert _ctx(tmp_path).sql(Q).equals(want)
+    # the survivor finishes the job: append + compact + restart agree
+    more = _append_cols(seed=17)
+    ctx.append_rows("ev", more)
+    ctx.compact("ev")
+    assert ctx.sql(Q).equals(_oracle(base, extra, more))
+    assert _ctx(tmp_path).sql(Q).equals(_oracle(base, extra, more))
+    _assert_no_leaked_slots(ctx)

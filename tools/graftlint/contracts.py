@@ -184,6 +184,17 @@ def build_contract_doc(
             if e.pass_name == "shared-state-races":
                 allow.add((e.path, e.snippet))
 
+    # protocol automata (GL28xx) ride along verbatim: the graftsan
+    # protocol witness replays the SAME machines over runtime effect
+    # stamps that the static checker runs over effect paths
+    durability_cfg = PASS_BY_NAME["durability-protocol"].default_config
+    from .engine import _DEFAULT_SITE_EFFECTS
+    site_effects = dict(_DEFAULT_SITE_EFFECTS)
+    site_effects.update(durability_cfg.get("site_effects", {}))
+    automata = [
+        _jsonify(doc) for doc in durability_cfg.get("automata", ())
+    ]
+
     return {
         "version": 1,
         "generated_by": "python -m tools.graftlint --export-contracts",
@@ -196,7 +207,45 @@ def build_contract_doc(
         "allow_sites": [
             {"path": p, "snippet": s} for p, s in sorted(allow)
         ],
+        "protocol_automata": automata,
+        "effect_sites": dict(sorted(site_effects.items())),
+        "whole_or_absent": sorted(
+            durability_cfg.get("whole_or_absent", ())
+        ),
+        # runtime probe table: where the witness stamps effects that
+        # have no checkpoint site (publish) and which acquire/release
+        # pairs it balance-counts for leak detection
+        "protocol_probes": [
+            {
+                "module": f"{package}.catalog.cache",
+                "class": "MetadataCache",
+                "method": "put",
+                "effect": "publish",
+            },
+            {
+                "module": f"{package}.resilience",
+                "class": "AdmissionController",
+                "method": "acquire",
+                "effect": "acquire",
+            },
+            {
+                "module": f"{package}.resilience",
+                "class": "AdmissionController",
+                "method": "release",
+                "effect": "release",
+            },
+        ],
     }
+
+
+def _jsonify(obj):
+    """Tuples -> lists, recursively: the automata documents are Python
+    literals in the pass config but must export as plain JSON."""
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    return obj
 
 
 def save_contracts(path: str, doc: dict) -> None:

@@ -644,6 +644,26 @@ class DataflowEngine:
     def taint(self, config: Optional[dict] = None) -> "OrderTaint":
         return OrderTaint(self, config or {})
 
+    # -- effect summaries (durability / cleanup protocols) ---------------------
+
+    def effects(self, config: Optional[dict] = None) -> "EffectAnalysis":
+        """Memoized by the effect-relevant config keys: the GL28xx and
+        GL29xx passes run with the same tables, so they share one set
+        of path enumerations and callee summaries."""
+        cfg = config or {}
+        key = (
+            tuple(sorted(cfg.get("call_effects", {}).items())),
+            tuple(sorted(cfg.get("site_effects", {}).items())),
+            int(cfg.get("summary_depth", 3)),
+        )
+        cache = getattr(self, "_effects_cache", None)
+        if cache is None:
+            cache = self._effects_cache = {}
+        hit = cache.get(key)
+        if hit is None:
+            hit = cache[key] = EffectAnalysis(self, cfg)
+        return hit
+
 
 # ---------------------------------------------------------------------------
 # Forward order-taint lattice
@@ -1117,4 +1137,1109 @@ class OrderTaint:
                 # keywords map by NAME; unknown names (e.g. **kwargs)
                 # still carry their taint under the spelled name
                 out[name] = out.get(name, frozenset()) | t
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Effect summaries + protocol automata (GL28xx/GL29xx)
+# ---------------------------------------------------------------------------
+#
+# The order-taint lattice answers "can a nondeterministic ORDER reach a
+# fold"; the effect layer answers "in what ORDER do a function's paths
+# perform its durability- and resource-relevant side effects, and which
+# of those paths end in an exception".  Each function gets a bounded set
+# of per-path effect SEQUENCES (journal, fsync, publish, rename,
+# truncate, acquire, release, ownwrite), built by a small path-sensitive
+# interpreter: try/except/finally split paths, `checkpoint(...)`/
+# `fire(...)` and classified calls are may-raise points, short-circuit
+# BoolOps / IfExp / `is None` comparisons carry truthiness and nullness
+# facts so `admitted = res is None or res.admission.acquire()` followed
+# by `finally: if res is not None: ... release()` resolves to balanced
+# paths instead of a false leak.  `.acquire()` calls that can fail
+# (timeout/blocking args) split into a success path (effect + True) and
+# a failure path (no effect + False) — a slot is held exactly when the
+# call returned truthy.  Summaries splice resolvable intra-project
+# callees (generators excluded: calling one runs nothing), so the wal →
+# storage → catalog chain is checked end to end at every call site.
+#
+# Protocol automata (declared in pass config, exported to
+# graftsan_contracts.json for the runtime witness) run over those
+# sequences: symbols outside the alphabet are skipped, undefined
+# transitions stay put, an ["error", CODE, msg] transition is a finding,
+# an ["error", CODE, msg, "later:<sym>"] transition fires only when
+# <sym> occurs LATER on the same path (true reordering evidence — a
+# legitimately journal-less path never flags), and a raise path ending
+# in an `unsafe_raise` state flags unless the function is on the
+# `whole_or_absent` list (its all-or-nothing guarantee is discharged by
+# recovery-scan + raise-injection tests instead).
+
+# dotted suffixes -> ordered effect kinds a call to them performs
+_DEFAULT_CALL_EFFECTS = {
+    "wal.append": ("journal", "fsync"),
+    "journal_append": ("journal", "fsync"),
+    "os.fsync": ("fsync",),
+    "os.replace": ("rename",),
+    "os.rename": ("rename",),
+    "save_snapshot": ("fsync", "rename"),
+    "os.remove": ("truncate",),
+    "os.unlink": ("truncate",),
+    "gc_snapshot_files": ("truncate",),
+    "truncate_through": ("truncate",),
+    "catalog.put": ("publish",),
+}
+
+# `checkpoint("<site>")` / `fire("<site>")` markers -> the effect the
+# surrounding code performs at that site (the runtime witness stamps the
+# SAME table, keeping static and dynamic automata aligned)
+_DEFAULT_SITE_EFFECTS = {
+    "wal.journal_write": "journal",
+    "wal.post_fsync_pre_publish": "fsync",
+    "persist.snapshot_rename": "rename",
+    "compact.retire": "truncate",
+}
+
+_CHECKPOINT_LEAVES = ("checkpoint", "fire")
+
+# bound on enumerated paths: fall-through states alive per statement,
+# and terminal (return/raise) paths kept per function
+_MAX_LIVE = 32
+_MAX_TERMINAL = 128
+
+
+def _call_chain_name(expr: ast.AST) -> Optional[str]:
+    """Like `dotted_name` but flattens Calls and getattr() so
+    `self.wal(name).append` -> "self.wal.append" and
+    `getattr(res, "pool")` -> "res.pool"."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _call_chain_name(expr.value)
+        return f"{base}.{expr.attr}" if base else None
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if (
+            isinstance(f, ast.Name) and f.id == "getattr"
+            and len(expr.args) >= 2
+            and isinstance(expr.args[1], ast.Constant)
+            and isinstance(expr.args[1].value, str)
+        ):
+            base = _call_chain_name(expr.args[0])
+            return f"{base}.{expr.args[1].value}" if base else None
+        return _call_chain_name(f)
+    return None
+
+
+class Effect:
+    """One ordered side effect on one path."""
+
+    __slots__ = ("kind", "res", "node", "via")
+
+    def __init__(self, kind: str, res: str, node: ast.AST,
+                 via: Optional[str] = None):
+        self.kind = kind  # journal|fsync|publish|rename|truncate|
+        #                   acquire|release|ownwrite
+        self.res = res    # resource chain ("res.admission", field name…)
+        self.node = node  # caller-level node (call site for spliced)
+        self.via = via    # callee canonical when spliced
+
+    @property
+    def sig(self) -> Tuple[str, str]:
+        return (self.kind, self.res)
+
+    def __repr__(self) -> str:  # debugging aid
+        return f"{self.kind}({self.res})"
+
+
+class EffectPath:
+    """One enumerated path through a function."""
+
+    __slots__ = ("effects", "exit", "ret", "exc", "node", "param_nulls")
+
+    def __init__(self, effects, exit_kind, ret, exc, node, param_nulls):
+        self.effects: Tuple[Effect, ...] = tuple(effects)
+        self.exit = exit_kind   # "return" | "raise"
+        self.ret = ret          # True/False/None — return truthiness
+        self.exc = exc          # best-effort exception name for raises
+        self.node = node        # raise origin (raise paths only)
+        self.param_nulls: Dict[str, bool] = param_nulls
+
+    @property
+    def sig(self):
+        return (tuple(e.sig for e in self.effects), self.exit, self.ret,
+                self.exc)
+
+
+class _SumPath:
+    """Node-free path signature used when splicing a callee."""
+
+    __slots__ = ("effects", "exit", "ret", "exc", "param_nulls")
+
+    def __init__(self, effects, exit_kind, ret, exc, param_nulls):
+        self.effects: Tuple[Tuple[str, str], ...] = tuple(effects)
+        self.exit = exit_kind
+        self.ret = ret
+        self.exc = exc
+        self.param_nulls = param_nulls
+
+
+class _EffSummary:
+    __slots__ = ("paths",)
+
+    def __init__(self):
+        self.paths: List[_SumPath] = []
+
+
+class _Val:
+    """Abstract expression value: known truthiness plus the fact its
+    truth would prove (for branch pruning)."""
+
+    __slots__ = ("truth", "chain", "fact", "negated")
+
+    def __init__(self, truth=None, chain=None, fact=None, negated=False):
+        self.truth = truth    # True | False | None (unknown)
+        self.chain = chain    # dotted chain when the expr names one
+        self.fact = fact      # ("isnone", chain) | ("name", name) | None
+        self.negated = negated
+
+
+class _PathState:
+    __slots__ = ("effects", "bools", "nulls", "aliases")
+
+    def __init__(self, effects=None, bools=None, nulls=None,
+                 aliases=None):
+        self.effects: List[Effect] = effects if effects is not None else []
+        self.bools: Dict[str, bool] = bools if bools is not None else {}
+        self.nulls: Dict[str, bool] = nulls if nulls is not None else {}
+        self.aliases: Dict[str, str] = (
+            aliases if aliases is not None else {}
+        )
+
+    def fork(self) -> "_PathState":
+        return _PathState(list(self.effects), dict(self.bools),
+                          dict(self.nulls), dict(self.aliases))
+
+
+class ProtocolAutomaton:
+    """One declared ordering state machine, JSON-round-trippable so the
+    same document drives the static checker and the graftsan runtime
+    protocol witness."""
+
+    def __init__(self, doc: dict):
+        self.doc = doc
+        self.name: str = doc["name"]
+        self.scope: Tuple[str, ...] = tuple(doc.get("scope", ()))
+        self.alphabet: FrozenSet[str] = frozenset(doc.get("alphabet", ()))
+        self.arm_on: FrozenSet[str] = frozenset(doc.get("arm_on", ()))
+        self.start: str = doc["start"]
+        self.accept: FrozenSet[str] = frozenset(doc.get("accept", ()))
+        self.states: Dict[str, dict] = dict(doc.get("states", {}))
+        self.unsafe_raise: Dict[str, str] = dict(
+            doc.get("unsafe_raise", {})
+        )
+
+    def matches(self, canonical: str) -> bool:
+        from fnmatch import fnmatchcase
+        return any(fnmatchcase(canonical, pat) for pat in self.scope)
+
+    def run_static(self, path: EffectPath, canonical: str,
+                   whole_or_absent) -> List[Tuple[ast.AST, str, str]]:
+        """Evaluate one path; returns (node, code, message) findings."""
+        out: List[Tuple[ast.AST, str, str]] = []
+        pending: List[Tuple[int, ast.AST, str, str, str]] = []
+        symbols = [e.kind for e in path.effects]
+        state = self.start
+        for i, eff in enumerate(path.effects):
+            sym = eff.kind
+            if sym not in self.alphabet:
+                continue
+            edge = self.states.get(state, {}).get(sym)
+            if edge is None:
+                continue  # undefined transition: stay put
+            if isinstance(edge, str):
+                state = edge
+                continue
+            # ["error", CODE, msg] or ["error", CODE, msg, "later:sym"]
+            _, code, msg = edge[0], edge[1], edge[2]
+            cond = edge[3] if len(edge) > 3 else None
+            if cond is None:
+                out.append((eff.node, code, f"{msg} [{self.name}]"))
+            elif cond.startswith("later:"):
+                pending.append((i, eff.node, code, msg, cond[6:]))
+        for i, node, code, msg, want in pending:
+            if want in symbols[i + 1:]:
+                out.append((node, code, f"{msg} [{self.name}]"))
+        if (
+            path.exit == "raise"
+            and state in self.unsafe_raise
+            and canonical not in whole_or_absent
+        ):
+            code = self.unsafe_raise[state]
+            out.append((
+                path.node or (path.effects[-1].node if path.effects
+                              else None),
+                code,
+                f"exception can escape in protocol state {state!r} "
+                f"(after {'+'.join(s for s in symbols if s in self.alphabet) or 'start'}) "
+                f"without the whole-or-absent guarantee [{self.name}]",
+            ))
+        return [f for f in out if f[0] is not None]
+
+
+class EffectAnalysis:
+    """Path-sensitive effect-sequence builder with memoized callee
+    summaries, produced by `DataflowEngine.effects(config)`."""
+
+    def __init__(self, engine: DataflowEngine, config: dict):
+        self.engine = engine
+        self.project = engine.project
+        self.call_effects = dict(_DEFAULT_CALL_EFFECTS)
+        self.call_effects.update(config.get("call_effects", {}))
+        self.site_effects = dict(_DEFAULT_SITE_EFFECTS)
+        self.site_effects.update(config.get("site_effects", {}))
+        self.max_depth = int(config.get("summary_depth", 3))
+        self._summaries: Dict[int, _EffSummary] = {}
+        self._paths: Dict[int, List[EffectPath]] = {}
+        self._genmemo: Dict[int, bool] = {}
+
+    # -- public queries --------------------------------------------------------
+
+    def paths(self, fi: FunctionInfo) -> List[EffectPath]:
+        key = id(fi)
+        cached = self._paths.get(key)
+        if cached is None:
+            cached = self._paths[key] = self._enumerate(fi, 0)
+        return cached
+
+    def call_may_raise_or_write(self, fi, node, fields):
+        """For one Call node: (may_raise, own_fields_written & fields),
+        or None when nothing is known about the callee.  Classified
+        protocol calls are may-raise; resolvable project callees answer
+        from their memoized summaries (ownwrites only count for
+        `self.*` calls — another object's fields are its own)."""
+        raw = call_name(node)
+        canon = self.project.canonical(fi.module, raw) if raw else ""
+        chain = _call_chain_name(node)
+        kinds, _m = self._match_call_effects(canon, chain, raw)
+        if kinds is not None:
+            return (True, frozenset())
+        if not raw:
+            return None
+        target = self.project.resolve_function(fi.module, raw, cls=fi.cls)
+        if target is None or target is fi or self._is_generator(target):
+            return None
+        s = self.summary(target, 0)
+        raises = any(sp.exit == "raise" for sp in s.paths)
+        written = frozenset()
+        if raw.startswith("self."):
+            written = frozenset(
+                res for sp in s.paths for k, res in sp.effects
+                if k == "ownwrite" and res in fields
+            )
+        return (raises, written)
+
+    def finally_paths(self, fi: FunctionInfo):
+        """[(Try node, [EffectPath over its finalbody])] — the inputs of
+        the GL2903 re-acquire-in-release check."""
+        out = []
+        for node in _walk_own(fi.node):
+            if isinstance(node, ast.Try) and node.finalbody:
+                live, done = self._exec_stmts(
+                    fi, node.finalbody, [_PathState()], 1
+                )
+                paths = self._terminalize(fi, live, done)
+                out.append((node, paths))
+        return out
+
+    # -- summaries -------------------------------------------------------------
+
+    def summary(self, fi: FunctionInfo, _depth: int = 0) -> _EffSummary:
+        key = id(fi)
+        cached = self._summaries.get(key)
+        if cached is not None:
+            return cached
+        s = _EffSummary()
+        self._summaries[key] = s  # break recursion: empty until proven
+        if _depth > self.max_depth:
+            return s
+        params = set(self._param_names(fi))
+        seen = set()
+        for p in self._enumerate(fi, _depth + 1):
+            nulls = {
+                k: v for k, v in p.param_nulls.items() if k in params
+            }
+            sp = _SumPath(
+                tuple(e.sig for e in p.effects), p.exit, p.ret, p.exc,
+                nulls,
+            )
+            sig = (sp.effects, sp.exit, sp.ret, sp.exc,
+                   tuple(sorted(nulls.items())))
+            if sig not in seen:
+                seen.add(sig)
+                s.paths.append(sp)
+        return s
+
+    @staticmethod
+    def _param_names(fi: FunctionInfo) -> List[str]:
+        a = fi.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        return [n for n in names if n != "self"]
+
+    def _enumerate(self, fi: FunctionInfo, depth: int) -> List[EffectPath]:
+        body = list(getattr(fi.node, "body", ()))
+        live, done = self._exec_stmts(fi, body, [_PathState()], depth)
+        return self._terminalize(fi, live, done)
+
+    def _terminalize(self, fi, live, done) -> List[EffectPath]:
+        paths: List[EffectPath] = []
+        for st in live:  # fall off the end: implicit `return None`
+            paths.append(self._mk_path(st, "return", False, None, None))
+        for st, status, extra in done:
+            if status == "return":
+                paths.append(
+                    self._mk_path(st, "return", extra.get("ret"), None,
+                                  None)
+                )
+            elif status == "raise":
+                paths.append(
+                    self._mk_path(st, "raise", None, extra.get("exc"),
+                                  extra.get("node"))
+                )
+        seen = set()
+        out = []
+        for p in paths:
+            if p.sig not in seen:
+                seen.add(p.sig)
+                out.append(p)
+            if len(out) >= _MAX_TERMINAL:
+                break
+        return out
+
+    @staticmethod
+    def _mk_path(st, exit_kind, ret, exc, node) -> EffectPath:
+        nulls = {k: v for k, v in st.nulls.items() if "." not in k}
+        return EffectPath(st.effects, exit_kind, ret, exc, node, nulls)
+
+    # -- statement execution ---------------------------------------------------
+
+    def _exec_stmts(self, fi, stmts, states, depth):
+        """Run `stmts` over every live state.  Returns (live fall-through
+        states, [(state, status, extra)]) with status return|raise|
+        break|continue."""
+        done = []
+        live = list(states)
+        for stmt in stmts:
+            if not live:
+                break
+            nxt = []
+            for st in live:
+                for st2, status, extra in self._exec_stmt(
+                    fi, stmt, st, depth
+                ):
+                    if status == "fall":
+                        nxt.append(st2)
+                    else:
+                        done.append((st2, status, extra))
+            live = self._dedupe_states(nxt)
+        return live, done
+
+    @staticmethod
+    def _dedupe_states(states):
+        seen = set()
+        out = []
+        for st in states:
+            sig = (
+                tuple(e.sig for e in st.effects),
+                tuple(sorted(st.bools.items())),
+                tuple(sorted(st.nulls.items())),
+            )
+            if sig not in seen:
+                seen.add(sig)
+                out.append(st)
+            if len(out) >= _MAX_LIVE:
+                break
+        return out
+
+    def _exec_stmt(self, fi, stmt, st, depth):
+        if isinstance(stmt, _FUNC_NODES) or isinstance(stmt, ast.ClassDef):
+            return [(st, "fall", None)]
+        if isinstance(stmt, (ast.Import, ast.ImportFrom, ast.Global,
+                             ast.Nonlocal, ast.Pass)):
+            return [(st, "fall", None)]
+        if isinstance(stmt, ast.Return):
+            out = []
+            for st2, val, raised in self._eval(fi, stmt.value, st, depth):
+                if raised:
+                    out.append((st2, "raise", raised))
+                else:
+                    out.append((st2, "return",
+                                {"ret": val.truth if val else False}))
+            return out
+        if isinstance(stmt, ast.Raise):
+            exc = None
+            if stmt.exc is not None:
+                exc = dotted_name(stmt.exc) or _call_chain_name(stmt.exc)
+                if exc:
+                    exc = exc.rsplit(".", 1)[-1]
+            out = []
+            for st2, val, raised in self._eval(fi, stmt.exc, st, depth):
+                if raised:
+                    out.append((st2, "raise", raised))
+                else:
+                    out.append((st2, "raise", {"exc": exc, "node": stmt}))
+            return out
+        if isinstance(stmt, ast.Break):
+            return [(st, "break", None)]
+        if isinstance(stmt, ast.Continue):
+            return [(st, "continue", None)]
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return self._exec_assign(fi, stmt, st, depth)
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    self._own_target(fi, t.value, st, stmt)
+            return [(st, "fall", None)]
+        if isinstance(stmt, ast.Expr):
+            out = []
+            for st2, _val, raised in self._eval(fi, stmt.value, st, depth):
+                if raised:
+                    out.append((st2, "raise", raised))
+                else:
+                    out.append((st2, "fall", None))
+            return out
+        if isinstance(stmt, ast.If):
+            return self._exec_if(fi, stmt, st, depth)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._exec_loop(fi, stmt, st, depth, is_for=True)
+        if isinstance(stmt, ast.While):
+            return self._exec_loop(fi, stmt, st, depth, is_for=False)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._exec_with(fi, stmt, st, depth)
+        if isinstance(stmt, ast.Try):
+            return self._exec_try(fi, stmt, st, depth)
+        if isinstance(stmt, ast.Assert):
+            out = []
+            for st2, val, raised in self._eval(fi, stmt.test, st, depth):
+                if raised:
+                    out.append((st2, "raise", raised))
+                else:
+                    # assume the assertion holds (fact application)
+                    self._apply_fact(st2, val, True)
+                    out.append((st2, "fall", None))
+            return out
+        # anything else: evaluate child expressions for their effects
+        out = [(st, "fall", None)]
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                nxt = []
+                for st2, status, extra in out:
+                    if status != "fall":
+                        nxt.append((st2, status, extra))
+                        continue
+                    for st3, _v, raised in self._eval(
+                        fi, child, st2, depth
+                    ):
+                        if raised:
+                            nxt.append((st3, "raise", raised))
+                        else:
+                            nxt.append((st3, "fall", None))
+                out = nxt
+        return out
+
+    def _exec_assign(self, fi, stmt, st, depth):
+        value = getattr(stmt, "value", None)
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign)
+            else [stmt.target]
+        )
+        out = []
+        for st2, val, raised in self._eval(fi, value, st, depth):
+            if raised:
+                out.append((st2, "raise", raised))
+                continue
+            for tgt in targets:
+                self._bind(fi, tgt, value, val, st2, stmt,
+                           augment=isinstance(stmt, ast.AugAssign))
+            out.append((st2, "fall", None))
+        return out
+
+    def _bind(self, fi, tgt, value_expr, val, st, stmt, augment=False):
+        if isinstance(tgt, ast.Name):
+            name = tgt.id
+            if augment:
+                st.bools.pop(name, None)
+                st.nulls.pop(name, None)
+                return
+            st.bools.pop(name, None)
+            st.nulls.pop(name, None)
+            st.aliases.pop(name, None)
+            if val is not None and val.truth is not None:
+                st.bools[name] = val.truth
+            if isinstance(value_expr, ast.Constant) and (
+                value_expr.value is None
+            ):
+                st.nulls[name] = True
+            chain = _call_chain_name(value_expr) if (
+                value_expr is not None
+            ) else None
+            if chain and chain != name:
+                root = chain.split(".", 1)[0]
+                rooted = st.aliases.get(root)
+                if rooted:
+                    chain = rooted + chain[len(root):]
+                st.aliases[name] = chain
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._bind(fi, el, None, None, st, stmt, augment)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(fi, tgt.value, None, None, st, stmt, augment)
+        elif isinstance(tgt, ast.Attribute):
+            self._own_target(fi, tgt, st, stmt)
+        elif isinstance(tgt, ast.Subscript):
+            self._own_target(fi, tgt.value, st, stmt)
+
+    def _own_target(self, fi, node, st, stmt):
+        """`self.<field> = ...` (or mutation) -> ownwrite effect."""
+        field = _self_attr(node)
+        if field is not None and not _is_lockish(field):
+            st.effects.append(Effect("ownwrite", field, stmt))
+
+    def _exec_if(self, fi, stmt, st, depth):
+        out = []
+        for st2, val, raised in self._eval(fi, stmt.test, st, depth):
+            if raised:
+                out.append((st2, "raise", raised))
+                continue
+            truth = val.truth if val is not None else None
+            if truth is not False:
+                t_st = st2.fork() if truth is None else st2
+                self._apply_fact(t_st, val, True)
+                live, done = self._exec_stmts(
+                    fi, stmt.body, [t_st], depth
+                )
+                out.extend((s, "fall", None) for s in live)
+                out.extend(done)
+            if truth is not True:
+                f_st = st2
+                self._apply_fact(f_st, val, False)
+                live, done = self._exec_stmts(
+                    fi, stmt.orelse, [f_st], depth
+                )
+                out.extend((s, "fall", None) for s in live)
+                out.extend(done)
+        return out
+
+    def _exec_loop(self, fi, stmt, st, depth, is_for):
+        out = []
+        pre = [st]
+        if is_for:
+            pre = []
+            for st2, _v, raised in self._eval(fi, stmt.iter, st, depth):
+                if raised:
+                    out.append((st2, "raise", raised))
+                else:
+                    pre.append(st2)
+        else:
+            pre = []
+            for st2, _v, raised in self._eval(fi, stmt.test, st, depth):
+                if raised:
+                    out.append((st2, "raise", raised))
+                else:
+                    pre.append(st2)
+        for st2 in pre:
+            # zero-iteration variant (plus orelse)
+            skip = st2.fork()
+            live, done = self._exec_stmts(
+                fi, stmt.orelse, [skip], depth
+            )
+            out.extend((s, "fall", None) for s in live)
+            out.extend(done)
+            # once-through variant
+            once = st2
+            if is_for:
+                self._bind(fi, stmt.target, None, None, once, stmt)
+            live, done = self._exec_stmts(fi, stmt.body, [once], depth)
+            out.extend((s, "fall", None) for s in live)
+            for s, status, extra in done:
+                if status in ("break", "continue"):
+                    out.append((s, "fall", None))
+                else:
+                    out.append((s, status, extra))
+        return out
+
+    def _exec_with(self, fi, stmt, st, depth):
+        out = []
+        states = [st]
+        for item in stmt.items:
+            nxt = []
+            for st2 in states:
+                for st3, val, raised in self._eval(
+                    fi, item.context_expr, st2, depth
+                ):
+                    if raised:
+                        out.append((st3, "raise", raised))
+                        continue
+                    if item.optional_vars is not None:
+                        self._bind(fi, item.optional_vars,
+                                   item.context_expr, val, st3, stmt)
+                    nxt.append(st3)
+            states = nxt
+        live, done = self._exec_stmts(fi, stmt.body, states, depth)
+        out.extend((s, "fall", None) for s in live)
+        out.extend(done)
+        return out
+
+    # -- try/except/finally ----------------------------------------------------
+
+    @staticmethod
+    def _handler_match(handler, exc: Optional[str]):
+        """-> "always" | "maybe" | "never" for one except clause."""
+        if handler.type is None:
+            return "always"
+        names = []
+        t = handler.type
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        for e in elts:
+            dn = dotted_name(e)
+            if dn:
+                names.append(dn.rsplit(".", 1)[-1])
+        if any(n in ("Exception", "BaseException") for n in names):
+            return "always"
+        if exc is None:
+            return "maybe" if names else "always"
+        return "always" if exc in names else "never"
+
+    def _exec_try(self, fi, stmt, st, depth):
+        live, done = self._exec_stmts(fi, stmt.body, [st], depth)
+        returned = [(s, x) for s, status, x in done if status == "return"]
+        raised = [(s, x) for s, status, x in done if status == "raise"]
+        other = [(s, status, x) for s, status, x in done
+                 if status in ("break", "continue")]
+        after_fall: List[_PathState] = []
+        after_done: List[Tuple[_PathState, str, Optional[dict]]] = []
+        # orelse runs after a clean body
+        if live:
+            l2, d2 = self._exec_stmts(fi, stmt.orelse, live, depth)
+            after_fall.extend(l2)
+            after_done.extend(d2)
+        after_done.extend((s, status, x) for s, status, x in other)
+        for s, x in returned:
+            after_done.append((s, "return", x))
+        # handlers
+        escaped: List[Tuple[_PathState, dict]] = []
+        for s, x in raised:
+            exc = (x or {}).get("exc")
+            handled = False
+            for handler in stmt.handlers:
+                m = self._handler_match(handler, exc)
+                if m == "never":
+                    continue
+                h_st = s.fork() if m == "maybe" else s
+                l2, d2 = self._exec_stmts(
+                    fi, handler.body, [h_st], depth
+                )
+                after_fall.extend(l2)
+                for s2, status, x2 in d2:
+                    if status == "raise" and x2 is not None and (
+                        x2.get("exc") is None and x2.get("node") is not None
+                        and isinstance(x2.get("node"), ast.Raise)
+                        and x2["node"].exc is None
+                    ):
+                        # bare `raise` re-raises the original
+                        x2 = {"exc": exc, "node": x2.get("node")}
+                    after_done.append((s2, status, x2))
+                if m == "always":
+                    handled = True
+                    break
+                # "maybe": the escaping variant continues below
+            if not handled:
+                escaped.append((s, x or {}))
+        # finally runs over every outcome class
+        if stmt.finalbody:
+            out = []
+            # fall-through + handled outcomes
+            l2, d2 = self._exec_stmts(fi, stmt.finalbody, after_fall,
+                                      depth)
+            out.extend((s, "fall", None) for s in l2)
+            out.extend(d2)
+            for s, status, x in after_done:
+                l3, d3 = self._exec_stmts(fi, stmt.finalbody, [s], depth)
+                for s2 in l3:
+                    out.append((s2, status, x))
+                out.extend(d3)  # finally's own return/raise overrides
+            for s, x in escaped:
+                l3, d3 = self._exec_stmts(fi, stmt.finalbody, [s], depth)
+                for s2 in l3:
+                    out.append((s2, "raise", x))
+                out.extend(d3)
+            return out
+        out = [(s, "fall", None) for s in after_fall]
+        out.extend(after_done)
+        out.extend((s, "raise", x) for s, x in escaped)
+        return out
+
+    # -- facts -----------------------------------------------------------------
+
+    def _apply_fact(self, st: _PathState, val: Optional[_Val],
+                    assumed: bool) -> None:
+        if val is None or val.fact is None:
+            return
+        if val.negated:
+            assumed = not assumed
+        kind, chain = val.fact
+        if kind == "isnone":
+            st.nulls[chain] = assumed
+        elif kind == "notnone":
+            st.nulls[chain] = not assumed
+        elif kind == "name":
+            st.bools[chain] = assumed
+
+    def _chain_of(self, expr, st: _PathState) -> Optional[str]:
+        chain = _call_chain_name(expr)
+        if not chain:
+            return None
+        root, sep, rest = chain.partition(".")
+        rooted = st.aliases.get(root)
+        if rooted:
+            return rooted + sep + rest if sep else rooted
+        return chain
+
+    # -- expressions -----------------------------------------------------------
+
+    def _eval(self, fi, expr, st, depth):
+        """-> [(state, _Val|None, raised_extra|None)].  `raised_extra`
+        non-None marks a terminal raise during evaluation."""
+        if expr is None:
+            return [(st, _Val(truth=False), None)]
+        if isinstance(expr, ast.Constant):
+            v = expr.value
+            truth = bool(v) if not isinstance(v, (bytes,)) else bool(v)
+            return [(st, _Val(truth=truth), None)]
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            truth = st.bools.get(name)
+            if truth is None and st.nulls.get(name) is True:
+                truth = False
+            return [(st, _Val(truth=truth, chain=name,
+                              fact=("name", name)), None)]
+        if isinstance(expr, ast.Attribute):
+            out = []
+            for st2, _v, raised in self._eval(fi, expr.value, st, depth):
+                if raised:
+                    out.append((st2, None, raised))
+                    continue
+                chain = self._chain_of(expr, st2)
+                out.append((st2, _Val(chain=chain), None))
+            return out
+        if isinstance(expr, ast.Call):
+            return self._eval_call(fi, expr, st, depth)
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            out = []
+            for st2, v, raised in self._eval(fi, expr.operand, st, depth):
+                if raised:
+                    out.append((st2, None, raised))
+                    continue
+                truth = None if v is None or v.truth is None else (
+                    not v.truth
+                )
+                nv = _Val(truth=truth)
+                if v is not None and v.fact is not None:
+                    nv.fact = v.fact
+                    nv.negated = not v.negated
+                out.append((st2, nv, None))
+            return out
+        if isinstance(expr, ast.Compare):
+            return self._eval_compare(fi, expr, st, depth)
+        if isinstance(expr, ast.BoolOp):
+            return self._eval_boolop(fi, expr, st, depth)
+        if isinstance(expr, ast.IfExp):
+            out = []
+            for st2, v, raised in self._eval(fi, expr.test, st, depth):
+                if raised:
+                    out.append((st2, None, raised))
+                    continue
+                truth = v.truth if v is not None else None
+                if truth is not False:
+                    t_st = st2.fork() if truth is None else st2
+                    self._apply_fact(t_st, v, True)
+                    out.extend(self._eval(fi, expr.body, t_st, depth))
+                if truth is not True:
+                    f_st = st2
+                    self._apply_fact(f_st, v, False)
+                    out.extend(self._eval(fi, expr.orelse, f_st, depth))
+            return out
+        # generic: evaluate child expressions sequentially for effects
+        states = [(st, None)]
+        for child in ast.iter_child_nodes(expr):
+            if not isinstance(child, ast.expr):
+                continue
+            nxt = []
+            raised_out = []
+            for st2, _ in states:
+                for st3, _v, raised in self._eval(fi, child, st2, depth):
+                    if raised:
+                        raised_out.append((st3, None, raised))
+                    else:
+                        nxt.append((st3, None))
+            states = nxt or states
+            if raised_out:
+                return raised_out + [
+                    (s, _Val(), None) for s, _ in states
+                ]
+        return [(s, _Val(), None) for s, _ in states]
+
+    def _eval_compare(self, fi, expr, st, depth):
+        out = []
+        states = [st]
+        for sub in [expr.left] + list(expr.comparators):
+            nxt = []
+            for st2 in states:
+                for st3, _v, raised in self._eval(fi, sub, st2, depth):
+                    if raised:
+                        out.append((st3, None, raised))
+                    else:
+                        nxt.append(st3)
+            states = nxt
+        is_none_test = (
+            len(expr.ops) == 1
+            and isinstance(expr.ops[0], (ast.Is, ast.IsNot))
+            and isinstance(expr.comparators[0], ast.Constant)
+            and expr.comparators[0].value is None
+        )
+        for st2 in states:
+            if is_none_test:
+                chain = self._chain_of(expr.left, st2)
+                neg = isinstance(expr.ops[0], ast.IsNot)
+                if chain is not None:
+                    known = st2.nulls.get(chain)
+                    truth = None
+                    if known is not None:
+                        truth = known if not neg else not known
+                    out.append((st2, _Val(
+                        truth=truth,
+                        fact=("isnone" if not neg else "notnone", chain),
+                    ), None))
+                    continue
+            out.append((st2, _Val(), None))
+        return out
+
+    def _eval_boolop(self, fi, expr, st, depth):
+        is_or = isinstance(expr.op, ast.Or)
+        results = []
+
+        def step(state, idx):
+            if idx >= len(expr.values):
+                # fell past the last operand: result is that operand's
+                # value — handled below by evaluating it as terminal
+                return
+            last = idx == len(expr.values) - 1
+            for st2, v, raised in self._eval(
+                fi, expr.values[idx], state, depth
+            ):
+                if raised:
+                    results.append((st2, None, raised))
+                    continue
+                truth = v.truth if v is not None else None
+                if last:
+                    results.append((st2, v or _Val(), None))
+                    continue
+                if is_or:
+                    if truth is True:
+                        results.append((st2, _Val(truth=True), None))
+                    elif truth is False:
+                        step(st2, idx + 1)
+                    else:
+                        t_st = st2.fork()
+                        self._apply_fact(t_st, v, True)
+                        results.append((t_st, _Val(truth=True), None))
+                        self._apply_fact(st2, v, False)
+                        step(st2, idx + 1)
+                else:
+                    if truth is False:
+                        results.append((st2, _Val(truth=False), None))
+                    elif truth is True:
+                        step(st2, idx + 1)
+                    else:
+                        f_st = st2.fork()
+                        self._apply_fact(f_st, v, False)
+                        results.append((f_st, _Val(truth=False), None))
+                        self._apply_fact(st2, v, True)
+                        step(st2, idx + 1)
+
+        step(st, 0)
+        return results
+
+    # -- calls -----------------------------------------------------------------
+
+    def _match_call_effects(self, *cands):
+        for cand in cands:
+            if not cand:
+                continue
+            for suf, kinds in self.call_effects.items():
+                if cand == suf or cand.endswith("." + suf):
+                    return tuple(kinds), cand
+        return None, None
+
+    def _is_generator(self, fi: FunctionInfo) -> bool:
+        hit = self._genmemo.get(id(fi))
+        if hit is None:
+            hit = self._genmemo[id(fi)] = any(
+                isinstance(n, (ast.Yield, ast.YieldFrom))
+                for n in _walk_own(fi.node)
+            )
+        return hit
+
+    def _eval_call(self, fi, node, st, depth):
+        module = fi.module
+        raw = call_name(node)
+        canon = self.project.canonical(module, raw) if raw else ""
+        chain = self._chain_of(node, st)
+        # arguments evaluate first (their effects + raises thread through)
+        states = [st]
+        raised_out = []
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            nxt = []
+            for st2 in states:
+                for st3, _v, raised in self._eval(fi, arg, st2, depth):
+                    if raised:
+                        raised_out.append((st3, None, raised))
+                    else:
+                        nxt.append(st3)
+            states = nxt
+        out = list(raised_out)
+        leaf = (chain or raw or "").rsplit(".", 1)[-1]
+
+        # 1) checkpoint()/fire() protocol sites: the marker for an effect
+        #    the surrounding code performs HERE; always a may-raise point
+        #    (deadline / chaos injection).  The effect lands on the
+        #    fall-through path only — an injected raise at the site means
+        #    the marked operation did not commit.
+        if leaf in _CHECKPOINT_LEAVES:
+            site = None
+            if node.args and isinstance(node.args[0], ast.Constant) and (
+                isinstance(node.args[0].value, str)
+            ):
+                site = node.args[0].value
+            kind = self.site_effects.get(site) if site else None
+            for st2 in states:
+                r_st = st2.fork()
+                out.append((r_st, None, {"exc": None, "node": node}))
+                if kind is not None:
+                    st2.effects.append(Effect(kind, site, node))
+                out.append((st2, _Val(), None))
+            return out
+
+        # 2) slot/span/run acquire-release (lock receivers excluded:
+        #    `with`-managed locks are the shared-state passes' domain)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("acquire", "release")
+        ):
+            recv = self._chain_of(node.func.value, st)
+            if recv and not _is_lockish(recv.rsplit(".", 1)[-1]):
+                kind = node.func.attr
+                failable = bool(node.args or node.keywords)
+                for st2 in states:
+                    if kind == "acquire" and failable:
+                        ok = st2.fork()
+                        ok.effects.append(Effect("acquire", recv, node))
+                        out.append((ok, _Val(truth=True), None))
+                        out.append((st2, _Val(truth=False), None))
+                    else:
+                        st2.effects.append(Effect(kind, recv, node))
+                        out.append((st2, _Val(
+                            truth=True if kind == "acquire" else None
+                        ), None))
+                return out
+
+        # 3) declared effect calls (wal.append, os.replace, catalog.put…)
+        kinds, _m = self._match_call_effects(canon, chain, raw)
+        if kinds is not None:
+            # a raise out of a classified protocol call means NOTHING
+            # committed (the callee's own scope check / whole-or-absent
+            # guarantee vouches for its internal atomicity), so the
+            # raise variant carries the pre-call state
+            for st2 in states:
+                r_st = st2.fork()
+                out.append((r_st, None, {"exc": None, "node": node}))
+                for k in kinds:
+                    st2.effects.append(Effect(k, _m, node))
+                out.append((st2, _Val(), None))
+            return out
+
+        # 4) in-place mutators on own fields -> ownwrite
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in (
+                _MUTATORS | {"pop", "popitem", "clear", "remove",
+                             "discard", "move_to_end"}
+            )
+        ):
+            field = _self_attr(node.func.value)
+            if field is not None and not _is_lockish(field):
+                for st2 in states:
+                    st2.effects.append(Effect("ownwrite", field, node))
+                return [(st2, _Val(), None) for st2 in states] + out
+
+        # 5) resolvable intra-project callee: splice its summary paths
+        if raw and depth <= self.max_depth:
+            target = self.project.resolve_function(module, raw, cls=fi.cls)
+            if (
+                target is not None and target is not fi
+                and not self._is_generator(target)
+            ):
+                return out + self._splice(
+                    fi, node, raw, target, states, depth
+                )
+        return out + [(st2, _Val(), None) for st2 in states]
+
+    def _splice(self, fi, node, raw, target, states, depth):
+        s = self.summary(target, depth)
+        if not s.paths:
+            return [(st2, _Val(), None) for st2 in states]
+        via = f"{target.module.modname}.{target.qualname}"
+        own_call = raw.startswith("self.")
+        # param name -> caller-side chain for resource/nullness remap
+        a = target.node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        remap: Dict[str, Optional[str]] = {}
+        base_st = states[0] if states else _PathState()
+        for i, argx in enumerate(node.args):
+            if i < len(params):
+                remap[params[i]] = self._chain_of(argx, base_st)
+        for kw in node.keywords:
+            if kw.arg:
+                remap[kw.arg] = self._chain_of(kw.value, base_st)
+
+        def fix(res: str) -> Optional[str]:
+            root, sep, rest = res.partition(".")
+            if root in remap:
+                mapped = remap[root]
+                if mapped is None:
+                    return None
+                return mapped + sep + rest if sep else mapped
+            return res
+
+        out = []
+        for st2 in states:
+            for sp in s.paths:
+                st3 = st2.fork()
+                dropped = False
+                for kind, res in sp.effects:
+                    if kind == "ownwrite" and not own_call:
+                        continue  # another object's fields
+                    res2 = fix(res)
+                    if res2 is None:
+                        dropped = True
+                        continue
+                    st3.effects.append(Effect(kind, res2, node, via=via))
+                for p, v in sp.param_nulls.items():
+                    c = remap.get(p)
+                    if c is not None:
+                        st3.nulls[c] = v
+                if sp.exit == "raise":
+                    out.append((st3, None, {"exc": sp.exc, "node": node}))
+                else:
+                    out.append((st3, _Val(truth=sp.ret), None))
         return out

@@ -15,14 +15,19 @@ serving-discipline, GL18xx obs-discipline, GL19xx transfer-discipline,
 GL20xx storage-discipline, GL21xx dispatch-discipline, GL22xx
 mesh-discipline, GL23xx broker-discipline, GL24xx fold-determinism,
 GL25xx shared-state-races, GL26xx sanitizer-discipline, GL27xx
-trace-propagation; GL00x are the core's own: GL001 unparseable file,
-GL002 malformed pragma).
+trace-propagation, GL28xx durability-protocol, GL29xx cleanup-safety;
+GL00x are the core's own: GL001 unparseable file, GL002 malformed
+pragma).
 
 The GL24xx/GL25xx families are interprocedural: they run on
 `engine.DataflowEngine` (bound to every pass as `self.engine`), which
 layers a module dependency graph, thread-entry reachability, inferred
 lock ownership, and a forward order-taint lattice on top of the
-project symbol tables.
+project symbol tables.  The GL28xx/GL29xx families add the engine's
+per-function effect-summary layer (ordered journal/fsync/publish/
+rename/truncate/acquire/release sequences per exception-split path)
+and run declared protocol automata over it; the automata export into
+`graftsan_contracts.json` for the runtime protocol witness.
 """
 
 from __future__ import annotations
@@ -32,10 +37,12 @@ from typing import Dict, List, Optional, Sequence
 from ..core import LintConfigError, LintPass
 from .broker_discipline import BrokerDisciplinePass
 from .checkpoint_coverage import CheckpointCoveragePass
+from .cleanup_safety import CleanupSafetyPass
 from .collective_axis import CollectiveAxisPass
 from .compat_import import CompatImportPass
 from .dispatch_discipline import DispatchDisciplinePass
 from .dtype_x64 import DtypeX64Pass
+from .durability_protocol import DurabilityProtocolPass
 from .error_discipline import ErrorDisciplinePass
 from .fold_determinism import FoldDeterminismPass
 from .ingest_discipline import IngestDisciplinePass
@@ -86,6 +93,8 @@ ALL_PASSES = (
     SharedStateRacesPass,
     SanitizerDisciplinePass,
     TracePropagationPass,
+    DurabilityProtocolPass,
+    CleanupSafetyPass,
 )
 
 PASS_BY_NAME = {cls.name: cls for cls in ALL_PASSES}

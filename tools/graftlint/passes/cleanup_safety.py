@@ -1,0 +1,307 @@
+"""Cleanup-safety pass (GL29xx): exception paths must not leak state.
+
+The serving tier is built on paired acquire/release resources
+(admission slots, per-lane pools, spans, prefetch runs) and on
+lock-owned fields updated in multi-step groups.  The effect layer
+(`engine.EffectAnalysis`) enumerates each function's paths with
+try/except/finally splitting, short-circuit truthiness and nullness
+facts, and success/failure splits for failable `.acquire(...)` calls —
+so `admitted = res is None or res.admission.acquire()` followed by
+`finally: if res is not None: res.admission.release()` resolves to
+balanced paths, while a genuinely skipped release flags:
+
+* **GL2901** — a function that both acquires AND releases a
+  slot/lane/span/run resource has an exception path on which an
+  acquire's matching release never runs (the leaked-slot shape the
+  chaos matrix can only sample).  Pure acquire-wrappers that hand the
+  held resource to their caller are out of scope — only raise paths
+  flag, never early returns (returning `False` after a failed acquire
+  is the admission-control contract, not a leak).
+* **GL2902** — a multi-step mutation of lock-OWNED fields (the
+  engine's majority-rule ownership inference) where an exception can
+  escape mid-group: the unwind releases the `with` lock and the torn
+  prefix becomes visible to every other thread.
+* **GL2903** — a `finally` block that releases a resource and
+  re-acquires the same resource inside that release path: the cleanup
+  can then fail/deadlock exactly when it must not, and the "released"
+  resource leaves the block held.
+
+May-raise points are the protocol-relevant ones — `checkpoint`/`fire`
+sites, classified durability calls, explicit `raise`, and spliced
+callee raise paths — so a leak finding always names an exception edge
+the kill/raise matrices can actually drive.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass
+from ..engine import _is_lockish, _self_attr, _walk_own
+
+
+def _flavor(res: str) -> str:
+    low = res.rsplit(".", 1)[-1].lower()
+    for word in ("span", "run", "lane"):
+        if word in low:
+            return word
+    return "slot"
+
+
+class CleanupSafetyPass(LintPass):
+    name = "cleanup-safety"
+    default_config = {
+        # the serving tier lives in the package; tools/tests build
+        # fixtures that would self-flag
+        "include": ("spark_druid_olap_tpu/",),
+        "call_effects": {},
+        "site_effects": {},
+        "summary_depth": 3,
+    }
+
+    def finish(self, project) -> None:
+        if self.engine is None:
+            return
+        eff = self.engine.effects(self.config)
+        for info in sorted(
+            project.modules.values(), key=lambda m: m.relpath
+        ):
+            if not self.applies_to(info.relpath):
+                continue
+            for qual in sorted(info.functions):
+                fi = info.functions[qual]
+                # cheap syntactic prefilter: full path enumeration only
+                # where a finding is even possible
+                kinds = self._acquire_release_kinds(fi)
+                if "acquire" in kinds and "release" in kinds:
+                    self._check_leaks(info, fi, eff.paths(fi))
+                if self._owned_writes(info, fi):
+                    self._check_torn_writes(info, fi, eff)
+                if "release" in kinds:
+                    self._check_finally_reacquire(info, fi, eff)
+
+    @staticmethod
+    def _acquire_release_kinds(fi):
+        kinds = set()
+        for n in _walk_own(fi.node):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("acquire", "release")
+            ):
+                kinds.add(n.func.attr)
+        return kinds
+
+    def _owned_writes(self, info, fi) -> bool:
+        if fi.cls is None or fi.qualname.endswith(".__init__"):
+            return False
+        cc = self.engine.class_concurrency(info.modname, fi.cls.name)
+        if cc is None or not cc.owner:
+            return False
+        for n in _walk_own(fi.node):
+            targets = ()
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = (n.target,)
+            for t in targets:
+                node = t.value if isinstance(t, ast.Subscript) else t
+                field = _self_attr(node)
+                if field is not None and field in cc.owner:
+                    return True
+        return False
+
+    # -- GL2901: exception path skips the release ------------------------------
+
+    def _check_leaks(self, info, fi, paths) -> None:
+        acq = set()
+        rel = set()
+        for p in paths:
+            for e in p.effects:
+                if e.kind == "acquire":
+                    acq.add(e.res)
+                elif e.kind == "release":
+                    rel.add(e.res)
+        both = acq & rel
+        if not both:
+            return  # acquire-only wrappers hand the resource to callers
+        seen = set()
+        for p in paths:
+            if p.exit != "raise":
+                continue
+            for res in both:
+                open_acquires = []
+                for e in p.effects:
+                    if e.res != res:
+                        continue
+                    if e.kind == "acquire":
+                        open_acquires.append(e)
+                    elif e.kind == "release" and open_acquires:
+                        open_acquires.pop()
+                if not open_acquires:
+                    continue
+                node = open_acquires[-1].node
+                key = (res, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.report(
+                    info.ctx, node, "GL2901",
+                    f"acquired {_flavor(res)} `{res}` leaks on an "
+                    "exception path: the matching release is skipped "
+                    "when the exception escapes — release in a "
+                    "`finally` (or guard with the acquire result)",
+                )
+
+    # -- GL2902: torn owned-field update ---------------------------------------
+    #
+    # The hazard is scoped to ONE lock region: a `with self.<lock>:`
+    # block that writes owned field A, hits a may-raise point, then
+    # writes owned field B — the unwind releases the lock with only the
+    # prefix applied.  Owned writes in SEPARATE lock acquisitions are
+    # each individually consistent (the lock is not held between them),
+    # so crossing regions never flags — `flush_locked`'s lazy
+    # `self.wal(name)` registration followed by a may-raise snapshot and
+    # a later `_snap_versions` update under a fresh lock is the clean
+    # exemplar.
+
+    def _check_torn_writes(self, info, fi, eff) -> None:
+        cc = self.engine.class_concurrency(info.modname, fi.cls.name)
+        owner_locks = set(cc.owner.values())
+        for node in _walk_own(fi.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = set()
+            for item in node.items:
+                field = _self_attr(item.context_expr)
+                if field in owner_locks:
+                    locks.add(field)
+            if not locks:
+                continue
+            fields = {f for f, lk in cc.owner.items() if lk in locks}
+            events = []
+            self._region_events(info, fi, eff, node.body, fields, events)
+            self._flag_torn(info, cc, events)
+
+    def _region_events(self, info, fi, eff, stmts, fields, events):
+        """Flatten one lock region into ordered ("write", field, node) /
+        ("mayraise", None, node) events.  A try with a catch-all
+        handler repairs its body's raises; nested defs do not run."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Try):
+                caught_all = any(
+                    h.type is None
+                    or "Exception" in ast.dump(h.type)
+                    or "BaseException" in ast.dump(h.type)
+                    for h in stmt.handlers
+                )
+                inner = []
+                self._region_events(info, fi, eff, stmt.body, fields,
+                                    inner)
+                if caught_all:
+                    inner = [e for e in inner if e[0] != "mayraise"]
+                events.extend(inner)
+                for h in stmt.handlers:
+                    self._region_events(info, fi, eff, h.body, fields,
+                                        events)
+                self._region_events(info, fi, eff,
+                                    stmt.orelse + stmt.finalbody,
+                                    fields, events)
+                continue
+            for n in _walk_own(stmt):
+                if isinstance(n, ast.Raise):
+                    events.append(("mayraise", None, n))
+                elif isinstance(n, ast.Call):
+                    self._call_events(info, fi, eff, n, fields, events)
+                else:
+                    targets = ()
+                    if isinstance(n, ast.Assign):
+                        targets = n.targets
+                    elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                        targets = (n.target,)
+                    for t in targets:
+                        tn = t.value if isinstance(t, ast.Subscript) else t
+                        field = _self_attr(tn)
+                        if field in fields:
+                            events.append(("write", field, n))
+
+    def _call_events(self, info, fi, eff, n, fields, events):
+        leaf = ""
+        if isinstance(n.func, ast.Attribute):
+            leaf = n.func.attr
+            field = _self_attr(n.func.value)
+            if field in fields and leaf in (
+                "append", "extend", "insert", "add", "update",
+                "setdefault", "pop", "popitem", "clear", "remove",
+                "discard", "move_to_end",
+            ):
+                events.append(("write", field, n))
+                return
+        elif isinstance(n.func, ast.Name):
+            leaf = n.func.id
+        if leaf in ("checkpoint", "fire"):
+            events.append(("mayraise", None, n))
+            return
+        hit = eff.call_may_raise_or_write(fi, n, fields)
+        if hit is None:
+            return
+        raises, written = hit
+        for f in written:
+            events.append(("write", f, n))
+        if raises:
+            events.append(("mayraise", None, n))
+
+    def _flag_torn(self, info, cc, events) -> None:
+        seen = set()
+        for i, (kind, _f, node) in enumerate(events):
+            if kind != "mayraise":
+                continue
+            pre = [f for k, f, _n in events[:i] if k == "write"]
+            post = {f for k, f, _n in events[i + 1:] if k == "write"}
+            if not pre or not (post - set(pre)):
+                continue
+            if node.lineno in seen:
+                continue
+            seen.add(node.lineno)
+            pending = ", ".join(sorted(post - set(pre)))
+            lock = cc.owner.get(pre[-1], "?")
+            self.report(
+                info.ctx, node, "GL2902",
+                f"exception can escape mid-update of lock-owned state "
+                f"(wrote {', '.join(dict.fromkeys(pre))}; "
+                f"{pending} still pending) inside `with self.{lock}`: "
+                "the unwind releases the lock and other threads see "
+                "the torn prefix — finish the group before any "
+                "may-raise point, or repair in an except/finally",
+            )
+
+    # -- GL2903: release path re-acquires its own resource ---------------------
+
+    def _check_finally_reacquire(self, info, fi, eff) -> None:
+        for _trynode, fpaths in eff.finally_paths(fi):
+            released = set()
+            for p in fpaths:
+                for e in p.effects:
+                    if e.kind == "release":
+                        released.add(e.res)
+            if not released:
+                continue
+            seen = set()
+            for p in fpaths:
+                for e in p.effects:
+                    if e.kind == "acquire" and e.res in released:
+                        key = (e.res, e.node.lineno)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        self.report(
+                            info.ctx, e.node, "GL2903",
+                            f"`finally` cleanup re-acquires "
+                            f"{_flavor(e.res)} `{e.res}` inside its own "
+                            "release path: the cleanup can block or "
+                            "fail exactly when it must not, leaving "
+                            "the resource held after the release",
+                        )

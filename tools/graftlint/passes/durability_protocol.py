@@ -1,0 +1,166 @@
+"""Durability-protocol pass (GL28xx): ordering automata over effect paths.
+
+The durable tier's correctness claim is an ORDERING protocol (ISSUE 13):
+journal -> fsync -> publish on the append path, snapshot-rename ->
+GC/WAL-truncate on the flush path.  Straight-line reachability cannot
+see an exception edge between fsync and publish, or a GC hoisted above
+the rename commit point — this pass runs declared protocol automata
+(`engine.ProtocolAutomaton`) over every enumerated effect path
+(`engine.EffectAnalysis`) of every function the automaton's `scope`
+patterns match:
+
+* **GL2801** — a publish is reachable before the journal+fsync pair on
+  some path.  In the automaton start state this fires only with true
+  reordering evidence (`later:journal`): a path that legitimately never
+  journals (ephemeral datasource, `storage is None` gate) stays clean,
+  but `catalog.put(...)` hoisted above `journal_append(...)` flags.
+* **GL2802** — a GC/truncate effect is reachable before the
+  snapshot-rename commit point (`later:rename`): retired segment files
+  must only disappear AFTER the new snapshot rename commits, or a crash
+  between the two loses rows the WAL was already truncated through.
+* **GL2803** — an exception edge escapes in the post-fsync pre-publish
+  window (automaton state `durable`) in a function that does NOT carry
+  the whole-or-absent guarantee.  `whole_or_absent` lists the canonical
+  names whose all-or-nothing contract is discharged elsewhere (WAL
+  torn-tail scan on recovery + the raise-injection kill matrix);
+  everything else must not let an acked-but-unpublished row escape.
+
+The automata are plain JSON documents so `--export-contracts` ships
+them verbatim into `graftsan_contracts.json`, where the graftsan
+protocol witness replays the SAME machines over runtime effect stamps
+(static<->runtime agreement, PR 18).  Runtime arming uses `arm_on`;
+static evaluation starts armed and uses `later:` look-ahead instead.
+The clean exemplar is `storage.DurableStorage.flush_locked`.
+"""
+
+from __future__ import annotations
+
+from ..core import LintPass
+from ..engine import ProtocolAutomaton
+
+# the two shipped machines; JSON-serializable by construction
+DURABLE_PUBLISH = {
+    "name": "durable-publish",
+    "scope": (
+        "*.WriteAheadLog.append",
+        "*.IngestManager.append_rows",
+        "*.DurableStorage.journal_append",
+    ),
+    "alphabet": ("journal", "fsync", "publish"),
+    "arm_on": ("journal",),
+    "start": "S0",
+    "accept": ("published",),
+    "states": {
+        "S0": {
+            "journal": "journaled",
+            "publish": (
+                "error", "GL2801",
+                "publish reachable before journal+fsync on this path",
+                "later:journal",
+            ),
+        },
+        "journaled": {
+            "fsync": "durable",
+            "journal": "journaled",
+            "publish": (
+                "error", "GL2801",
+                "publish after journal but before the fsync commit "
+                "point",
+            ),
+        },
+        "durable": {
+            "publish": "published",
+            "journal": "journaled",
+        },
+        "published": {
+            "journal": "journaled",
+            "publish": "published",
+        },
+    },
+    "unsafe_raise": {"durable": "GL2803"},
+}
+
+SNAPSHOT_COMMIT = {
+    "name": "snapshot-commit",
+    "scope": (
+        "*.DurableStorage.flush_locked",
+        "*.DurableStorage.flush",
+        "*.save_snapshot",
+        "*.Compactor.compact",
+    ),
+    "alphabet": ("rename", "truncate"),
+    "arm_on": ("rename", "truncate"),
+    "start": "P0",
+    "accept": ("committed",),
+    "states": {
+        "P0": {
+            "rename": "committed",
+            "truncate": (
+                "error", "GL2802",
+                "GC/truncate reachable before the snapshot-rename "
+                "commit point",
+                "later:rename",
+            ),
+        },
+        "committed": {
+            "rename": "committed",
+            "truncate": "committed",
+        },
+    },
+    "unsafe_raise": {},
+}
+
+
+class DurabilityProtocolPass(LintPass):
+    name = "durability-protocol"
+    default_config = {
+        # the durable tier lives in the package; tools/tests build
+        # fixtures that would self-flag
+        "include": ("spark_druid_olap_tpu/",),
+        # protocol automata documents (exported to graftsan contracts)
+        "automata": (DURABLE_PUBLISH, SNAPSHOT_COMMIT),
+        # canonical names whose whole-or-absent guarantee is discharged
+        # by recovery-scan + raise-injection tests, not by GL2803
+        "whole_or_absent": (
+            "spark_druid_olap_tpu.ingest.wal.WriteAheadLog.append",
+            "spark_druid_olap_tpu.ingest.delta.IngestManager.append_rows",
+            "spark_druid_olap_tpu.storage.DurableStorage.journal_append",
+        ),
+        # extra {dotted-suffix: (effect, ...)} / {site: effect} tables
+        "call_effects": {},
+        "site_effects": {},
+        "summary_depth": 3,
+    }
+
+    def finish(self, project) -> None:
+        if self.engine is None:
+            return
+        eff = self.engine.effects(self.config)
+        automata = [
+            ProtocolAutomaton(dict(doc))
+            for doc in self.config.get("automata", ())
+        ]
+        whole = frozenset(self.config.get("whole_or_absent", ()))
+        for info in sorted(
+            project.modules.values(), key=lambda m: m.relpath
+        ):
+            if not self.applies_to(info.relpath):
+                continue
+            for qual in sorted(info.functions):
+                fi = info.functions[qual]
+                canon = f"{info.modname}.{fi.qualname}"
+                machines = [a for a in automata if a.matches(canon)]
+                if not machines:
+                    continue
+                seen = set()
+                for path in eff.paths(fi):
+                    for a in machines:
+                        for node, code, msg in a.run_static(
+                            path, canon, whole
+                        ):
+                            key = (code, node.lineno,
+                                   getattr(node, "col_offset", 0))
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            self.report(info.ctx, node, code, msg)

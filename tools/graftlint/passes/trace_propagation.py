@@ -34,7 +34,11 @@ the receipt folder match on.  Three contracts keep the chain auditable:
   `resilience.checkpoint(site)` call (lexically or one call level
   down) is unbounded over a large membership and invisible to the
   chaos matrix — a single hung node turns the merged scrape into a
-  stall instead of a stale-stamped row.
+  stall instead of a stale-stamped row.  Only calls in the loop BODY
+  count as per-iteration fetches: `for nid, text in scrape_all(...):`
+  fetches once, in the iterable, before the first iteration — the
+  per-node bound belongs inside `scrape_all`'s own fan-out, not on the
+  decode loop that consumes its result.
 """
 
 from __future__ import annotations
@@ -225,13 +229,17 @@ class TracePropagationPass(LintPass):
             return
         markers = tuple(self.config["fetch_markers"])
         fetch = None
-        for n in ast.walk(node):
-            if not isinstance(n, ast.Call):
-                continue
-            short = dotted_name(n.func).rsplit(".", 1)[-1]
-            short = short.lstrip("_").lower()
-            if fetch is None and any(m in short for m in markers):
-                fetch = n
+        # the ITER expression runs once before the loop: a fetch there
+        # is not per-iteration work, so only the body (and orelse) can
+        # make this a fetch loop
+        for stmt in list(node.body) + list(node.orelse):
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                short = dotted_name(n.func).rsplit(".", 1)[-1]
+                short = short.lstrip("_").lower()
+                if fetch is None and any(m in short for m in markers):
+                    fetch = n
         if fetch is None:
             return
         covered = self.project.reaches_call(

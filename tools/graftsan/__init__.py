@@ -22,6 +22,14 @@ the machine-readable contract table exported by
     existing `resilience.checkpoint`/`fire` sites as yield points; a
     seeded scheduler perturbs thread interleavings and every failure
     message carries the seed for exact replay (`SDOL_SCHED_SEED`).
+  * **Protocol witness** (protocol.py) — replays the GL28xx ordering
+    automata (exported verbatim in `protocol_automata`) over the
+    effect stamps the process actually emits: checkpoint sites map to
+    journal/fsync/rename/truncate effects via `effect_sites`, the
+    `protocol_probes` rows wrap `MetadataCache.put` (publish) and
+    `AdmissionController.acquire`/`release` (slot-leak balance — the
+    runtime face of GL2901).  An out-of-order publish or a slot still
+    held after quiesce fails with the stamp trail and the replay seed.
   * **Divergence report** (report.py) — reconciles runtime witness data
     against the static table in both directions: fields graftlint calls
     owned that runtime never saw locked, and fields runtime always saw

@@ -18,17 +18,26 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
 
-def _build_ctx():
+def _build_ctx(storage_dir=None):
+    import dataclasses
+
     import numpy as np
 
     import spark_druid_olap_tpu as sd
     from spark_druid_olap_tpu.config import SessionConfig
 
-    ctx = sd.TPUOlapContext(SessionConfig.load_calibrated())
+    cfg = SessionConfig.load_calibrated()
+    if storage_dir is not None:
+        # durable mode: the hammer's appends then drive the real
+        # journal -> fsync -> publish path, so the protocol witness
+        # replays its automata over live stamps instead of vacuously
+        cfg = dataclasses.replace(cfg, storage_dir=str(storage_dir))
+    ctx = sd.TPUOlapContext(cfg)
     n = 2000
     rng = np.random.default_rng(7)
     ctx.register_table(
@@ -119,10 +128,17 @@ def main(argv=None) -> int:
     san = graftsan.install(
         contracts_path=args.contracts, root=args.root, seed=args.seed
     )
+    tmp = tempfile.TemporaryDirectory(prefix="graftsan-smoke-")
     try:
-        ctx = _build_ctx()
+        # durable storage_dir so the hammer's appends exercise the
+        # journal/fsync/publish protocol, then a compaction drives the
+        # snapshot-rename/retire machine — the protocol witness must
+        # see real stamps, and a quiesced hammer must hold zero slots
+        ctx = _build_ctx(storage_dir=os.path.join(tmp.name, "store"))
         t0 = time.perf_counter()
         _hammer(ctx)
+        ctx.compact("ev")
+        san.protocol.check_leaks()
         armed_s = time.perf_counter() - t0
     except graftsan.SanitizerViolation as e:
         print(f"graftsan: VIOLATION {e}", file=sys.stderr)
@@ -131,6 +147,7 @@ def main(argv=None) -> int:
         divergences = graftsan.divergence_report(san)
         doc = graftsan.stats_doc(san)
         graftsan.uninstall()
+        tmp.cleanup()
 
     doc["smoke_seconds"] = round(armed_s, 3)
     if args.overhead:

@@ -1,0 +1,258 @@
+"""Protocol-witness layer: replay the GL28xx automata over live stamps.
+
+The static durability-protocol pass runs declared ordering machines
+(journal -> fsync -> publish; snapshot-rename before GC/truncate) over
+ENUMERATED effect paths.  This layer runs the SAME machines — shipped
+verbatim in `graftsan_contracts.json` under `protocol_automata` — over
+the effect stamps the process actually emits, closing the
+static<->runtime agreement loop for the GL28xx/GL29xx families the way
+the lock witness closes it for GL25xx:
+
+  * **Effect stamps** ride the existing `resilience.checkpoint`/`fire`
+    sites (the contract table's `effect_sites` maps site -> effect), so
+    the durable hot path grows ZERO new probe points — the layer chains
+    itself behind whatever schedule hook is installed and pays one dict
+    lookup per site when armed, nothing when not.
+  * **Publish** has no checkpoint site (it is a catalog mutation, not a
+    crash point), so the contract table's `protocol_probes` rows name
+    the methods to monkey-wrap: `MetadataCache.put` stamps `publish`,
+    `AdmissionController.acquire`/`release` feed the slot-leak balance
+    (the runtime face of GL2901).
+  * **Machines are per-thread**: the protocol is a per-operation
+    ordering claim and operations do not migrate threads mid-append.
+    Each machine starts UNARMED and arms when an `arm_on` symbol
+    arrives (re-arming from an accept state starts the next operation).
+    Error transitions carrying a static `later:` look-ahead are
+    static-only — a runtime stream cannot look ahead, and arming
+    already encodes "the protocol is in flight" — so only unconditional
+    error transitions fire here.  A violation carries the thread's
+    recent stamp ring and the schedule seed for exact replay.
+  * **Slot-leak balance** (GL2901 at runtime): truthy `acquire()`
+    returns increment a per-instance counter, `release()` decrements;
+    `check_leaks()` after a quiesced hammer fails on any pool still
+    holding slots — the leaked-lane-slot shape the raise matrix drives.
+"""
+
+from __future__ import annotations
+
+import threading
+from time import perf_counter
+from typing import Dict, List, Optional, Tuple
+
+_RING = 16  # stamps kept per thread for the violation message
+
+
+class _Machine:
+    """One automaton instance bound to one thread."""
+
+    __slots__ = ("doc", "state", "armed")
+
+    def __init__(self, doc: dict):
+        self.doc = doc
+        self.state = doc.get("start", "")
+        self.armed = False
+
+
+class _ThreadState(threading.local):
+    def __init__(self):
+        self.machines: Optional[List[_Machine]] = None
+        self.ring: List[Tuple[str, str]] = []
+
+
+class ProtocolWitnessLayer:
+    """Automaton replay + acquire/release balance over runtime stamps."""
+
+    def __init__(self, san):
+        self.san = san
+        contracts = san.contracts
+        self.automata: List[dict] = list(
+            contracts.get("protocol_automata", ())
+        )
+        self.effect_sites: Dict[str, str] = dict(
+            contracts.get("effect_sites", {})
+        )
+        self.probe_rows: List[dict] = list(
+            contracts.get("protocol_probes", ())
+        )
+        self.probes = 0
+        self.stamps = 0
+        self.seconds = 0.0
+        self._tls = _ThreadState()
+        self._prev_hook = None
+        self._hook_installed = False
+        self._saved: List[Tuple[type, str, Optional[object]]] = []
+        # id(pool) -> (held count, human label); under _lock
+        self._held: Dict[int, List] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self) -> None:
+        if self.automata or self.effect_sites:
+            from spark_druid_olap_tpu import resilience
+
+            # chain BEHIND whatever hook is up (the schedule explorer,
+            # or None): the explorer's perturbation runs first, then the
+            # stamp lands — so an explored interleaving and its replay
+            # stamp in the same order
+            self._prev_hook = resilience._sched_hook
+            prev = self._prev_hook
+
+            if prev is None:
+                resilience.set_schedule_hook(self.on_site)
+            else:
+                def chained(site: str, _prev=prev, _self=self) -> None:
+                    _prev(site)
+                    _self.on_site(site)
+
+                resilience.set_schedule_hook(chained)
+            self._hook_installed = True
+        for row in self.probe_rows:
+            self._wrap_probe(row)
+
+    def uninstall(self) -> None:
+        if self._hook_installed:
+            from spark_druid_olap_tpu import resilience
+
+            resilience.set_schedule_hook(self._prev_hook)
+            self._prev_hook = None
+            self._hook_installed = False
+        for holder, name, orig in reversed(self._saved):
+            if orig is None:
+                if name in holder.__dict__:
+                    delattr(holder, name)
+            else:
+                setattr(holder, name, orig)
+        self._saved.clear()
+
+    # -- probe wrapping ------------------------------------------------------
+
+    def _wrap_probe(self, row: dict) -> None:
+        cls = self.san._import_class(row["module"], row["class"])
+        if cls is None:
+            return
+        name = row["method"]
+        orig = cls.__dict__.get(name)
+        if orig is None:
+            return
+        effect = row["effect"]
+        layer = self
+
+        if effect == "acquire":
+            def wrapper(pool, *a, _orig=orig, _layer=layer, **kw):
+                got = _orig(pool, *a, **kw)
+                _layer._balance(pool, +1 if got else 0)
+                _layer.stamp("acquire", f"{type(pool).__name__}.acquire")
+                return got
+        elif effect == "release":
+            def wrapper(pool, *a, _orig=orig, _layer=layer, **kw):
+                _layer._balance(pool, -1)
+                _layer.stamp("release", f"{type(pool).__name__}.release")
+                return _orig(pool, *a, **kw)
+        else:
+            def wrapper(obj, *a, _orig=orig, _layer=layer,
+                        _eff=effect, _nm=name, **kw):
+                # stamp at ENTRY: the protocol point is "the publish
+                # became reachable", not "it completed"
+                _layer.stamp(_eff, f"{type(obj).__name__}.{_nm}")
+                return _orig(obj, *a, **kw)
+
+        wrapper.__name__ = getattr(orig, "__name__", name)
+        wrapper.__qualname__ = getattr(orig, "__qualname__", name)
+        wrapper.__doc__ = getattr(orig, "__doc__", None)
+        setattr(cls, name, wrapper)
+        self._saved.append((cls, name, orig))
+
+    # -- stamping ------------------------------------------------------------
+
+    def on_site(self, site: str) -> None:
+        effect = self.effect_sites.get(site)
+        if effect is None:
+            return
+        self.stamp(effect, site)
+
+    def stamp(self, effect: str, origin: str) -> None:
+        t0 = perf_counter()
+        self.probes += 1
+        self.stamps += 1
+        tls = self._tls
+        if tls.machines is None:
+            tls.machines = [_Machine(doc) for doc in self.automata]
+        tls.ring.append((effect, origin))
+        if len(tls.ring) > _RING:
+            del tls.ring[0]
+        for m in tls.machines:
+            self._advance(m, effect, origin, tls)
+        self.seconds += perf_counter() - t0
+
+    def _advance(self, m: _Machine, effect: str, origin: str,
+                 tls: _ThreadState) -> None:
+        doc = m.doc
+        if effect not in doc.get("alphabet", ()):
+            return
+        accept = doc.get("accept", ())
+        if not m.armed or m.state in accept:
+            if effect not in doc.get("arm_on", ()):
+                return
+            m.armed = True
+            m.state = doc.get("start", "")
+        trans = doc.get("states", {}).get(m.state, {}).get(effect)
+        if trans is None:
+            return  # undefined: the machine holds its state
+        if isinstance(trans, str):
+            m.state = trans
+            return
+        # ["error", CODE, msg] (+ optional static-only "later:" cond)
+        if len(trans) > 3 and str(trans[3]).startswith("later:"):
+            return  # look-ahead condition: static evaluation only
+        code, msg = trans[1], trans[2]
+        trail = " -> ".join(f"{e}@{o}" for e, o in tls.ring)
+        m.armed = False
+        m.state = doc.get("start", "")
+        self.san.violation(
+            "protocol",
+            f"{code} {doc.get('name', '?')}: {msg} "
+            f"(observed {trail})",
+        )
+
+    # -- acquire/release balance (runtime GL2901) ----------------------------
+
+    def _balance(self, pool, delta: int) -> None:
+        self.probes += 1
+        if delta == 0:
+            return
+        label = (
+            f"{type(pool).__name__}"
+            f"(lane={getattr(pool, 'lane', '') or '-'})"
+        )
+        with self._lock:
+            rec = self._held.setdefault(id(pool), [0, label])
+            rec[0] += delta
+            if rec[0] < 0:
+                rec[0] = 0  # release of an un-acquired slot: not a leak
+
+    def held_slots(self) -> Dict[str, int]:
+        """Snapshot of currently-held slot counts by pool label."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for count, label in self._held.values():
+                if count:
+                    out[label] = out.get(label, 0) + count
+        return out
+
+    def check_leaks(self) -> None:
+        """After the workload has quiesced, every acquire must have been
+        balanced by a release — anything still held is the GL2901 leak
+        shape observed live."""
+        held = self.held_slots()
+        if not held:
+            return
+        detail = ", ".join(
+            f"{label}:{count}" for label, count in sorted(held.items())
+        )
+        self.san.violation(
+            "protocol",
+            f"GL2901 slot leak: {sum(held.values())} slot(s) still "
+            f"held after quiesce ({detail}) — an exception path "
+            "skipped the matching release",
+        )

@@ -91,6 +91,10 @@ def stats_doc(san) -> dict:
             ),
             "sched_points": san.scheduler.probes,
             "sched_yields": san.scheduler.yields,
+            "protocol_stamps": san.protocol.stamps,
+            "protocol_slots_held": sum(
+                san.protocol.held_slots().values()
+            ),
         },
         "divergences": len(divergences),
         "classes_instrumented": len(san.classes),
@@ -100,5 +104,6 @@ def stats_doc(san) -> dict:
             "witness": round(san.witness.seconds, 4),
             "foldorder": round(san.foldorder.seconds, 4),
             "scheduler": round(san.scheduler.seconds, 4),
+            "protocol": round(san.protocol.seconds, 4),
         },
     }

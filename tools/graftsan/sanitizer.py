@@ -1,8 +1,8 @@
 """Sanitizer core: contract loading, arming, violations, layer lifecycle.
 
-The `Sanitizer` object owns the three enforcement layers (lock witness,
-fold-order recorder, schedule explorer) plus the violation sink every
-layer reports into.  `install()` wraps the contract classes and hooks
+The `Sanitizer` object owns the four enforcement layers (lock witness,
+fold-order recorder, schedule explorer, protocol witness) plus the
+violation sink every layer reports into.  `install()` wraps the contract classes and hooks
 the scheduler; `uninstall()` restores every wrapped attribute exactly —
 the disabled process is byte-for-byte the unwrapped one.
 
@@ -79,6 +79,7 @@ class Sanitizer:
                  raise_on_violation: bool = True,
                  seed: Optional[int] = None):
         from .foldorder import FoldOrderLayer
+        from .protocol import ProtocolWitnessLayer
         from .scheduler import ScheduleExplorer
         from .witness import WitnessLayer
 
@@ -99,6 +100,7 @@ class Sanitizer:
         self.witness = WitnessLayer(self)
         self.foldorder = FoldOrderLayer(self)
         self.scheduler = ScheduleExplorer(self, self.seed)
+        self.protocol = ProtocolWitnessLayer(self)
         self._installed = False
 
     # -- contract resolution -------------------------------------------------
@@ -141,6 +143,9 @@ class Sanitizer:
             self.foldorder.install()
             if schedule:
                 self.scheduler.install()
+            # protocol AFTER the scheduler: its stamp hook chains
+            # BEHIND the explorer's perturbation hook
+            self.protocol.install()
             self._installed = True
             _current = self
         return self
@@ -150,6 +155,10 @@ class Sanitizer:
         with _install_lock:
             if not self._installed:
                 return
+            # protocol FIRST (reverse of install): restoring its saved
+            # previous hook hands the site back to the explorer, whose
+            # own uninstall then leaves `_sched_hook is None`
+            self.protocol.uninstall()
             self.scheduler.uninstall()
             self.foldorder.uninstall()
             self.witness.uninstall()
@@ -165,6 +174,7 @@ class Sanitizer:
             self.witness.probes
             + self.foldorder.probes
             + self.scheduler.probes
+            + self.protocol.probes
         )
 
     # -- violations ----------------------------------------------------------

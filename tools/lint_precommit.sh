@@ -4,7 +4,8 @@
 # computed from graftlint's module dependency graph.
 #
 # Usage:
-#   tools/lint_precommit.sh [BASE] [--sanitize-smoke] [extra graftlint args...]
+#   tools/lint_precommit.sh [BASE] [--sanitize-smoke] [--protocol] \
+#       [extra graftlint args...]
 #
 # BASE defaults to main.  Install as a git hook with:
 #   ln -s ../../tools/lint_precommit.sh .git/hooks/pre-commit
@@ -13,6 +14,12 @@
 # --sanitize-smoke additionally runs the graftsan in-process hammer
 # (SDOL_SANITIZE=1, every layer armed, on-CPU) after the lint pass and
 # fails on any contract violation or static<->runtime divergence.
+#
+# --protocol verifies the static<->runtime protocol bridge: re-exports
+# the contract table to a temp file and fails (exit 2) if the committed
+# graftsan_contracts.json is stale, then runs the armed smoke so the
+# protocol witness replays the GL28xx automata and the GL2901 slot
+# balance against live traffic.
 #
 # Exit codes follow graftlint: 0 clean, 1 new findings / sanitizer
 # violations, 2 stale baseline entries or configuration errors.
@@ -28,10 +35,13 @@ if [ "$#" -gt 0 ]; then
 fi
 
 SMOKE=0
+PROTO=0
 ARGS=""
 for a in "$@"; do
     if [ "$a" = "--sanitize-smoke" ]; then
         SMOKE=1
+    elif [ "$a" = "--protocol" ]; then
+        PROTO=1
     else
         ARGS="$ARGS $a"
     fi
@@ -42,7 +52,21 @@ rc=0
 # shellcheck disable=SC2086  # ARGS is intentionally word-split
 python -m tools.graftlint --changed "$BASE" --stats $ARGS || rc=$?
 
-if [ "$SMOKE" -eq 1 ]; then
+if [ "$PROTO" -eq 1 ]; then
+    # stale-contract check: the committed table must match a fresh
+    # export byte for byte, or graftsan is enforcing yesterday's rules
+    tmp="$(mktemp)"
+    trap 'rm -f "$tmp"' EXIT
+    JAX_PLATFORMS=cpu \
+        python -m tools.graftlint --export-contracts "$tmp" >/dev/null
+    if ! cmp -s graftsan_contracts.json "$tmp"; then
+        echo "lint_precommit: graftsan_contracts.json is STALE" >&2
+        echo "  regenerate: python -m tools.graftlint --export-contracts graftsan_contracts.json" >&2
+        if [ "$rc" -lt 2 ]; then rc=2; fi
+    fi
+fi
+
+if [ "$SMOKE" -eq 1 ] || [ "$PROTO" -eq 1 ]; then
     src=0
     JAX_PLATFORMS=cpu SDOL_SANITIZE=1 \
         python -m tools.graftsan --smoke --stats || src=$?
